@@ -1,0 +1,26 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid 1.8 (reference: /root/reference).
+
+Static-graph programs (fluid.Program) are JIT-compiled whole-block via
+XLA; distributed training uses jax.sharding meshes + XLA collectives over
+ICI/DCN; hot kernels use Pallas. See SURVEY.md for the design blueprint.
+"""
+__version__ = "0.1.0"
+
+from . import fluid, ops  # noqa: F401
+from .fluid import (  # noqa: F401
+    CPUPlace,
+    Executor,
+    ParamAttr,
+    Program,
+    TPUPlace,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+    scope_guard,
+)
+
+CUDAPlace = fluid.CUDAPlace
+XLAPlace = fluid.XLAPlace

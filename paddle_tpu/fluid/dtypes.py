@@ -1,0 +1,65 @@
+"""Dtype utilities: paddle-style dtype strings <-> numpy/jax dtypes.
+
+Mirrors the VarType.Type enum surface of the reference
+(/root/reference/paddle/fluid/framework/framework.proto:104) without the
+protobuf dependency on the hot path: dtypes are canonicalized to numpy dtypes,
+which is what JAX/XLA consume natively.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# Canonical names follow the reference's VarType.Type spelling (lowered).
+_STR2NP = {
+    "bool": np.dtype("bool"),
+    "int8": np.dtype("int8"),
+    "uint8": np.dtype("uint8"),
+    "int16": np.dtype("int16"),
+    "int32": np.dtype("int32"),
+    "int64": np.dtype("int64"),
+    "float16": np.dtype("float16"),
+    "bfloat16": None,  # filled lazily to avoid importing jax at module load
+    "float32": np.dtype("float32"),
+    "float64": np.dtype("float64"),
+    "complex64": np.dtype("complex64"),
+    "complex128": np.dtype("complex128"),
+}
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Canonicalize any dtype spec (str, np.dtype, jnp dtype) to np.dtype."""
+    if dtype is None:
+        return np.dtype("float32")
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key == "bfloat16":
+            return _bf16()
+        if key in _STR2NP:
+            return _STR2NP[key]
+        return np.dtype(dtype)
+    try:
+        d = np.dtype(dtype)
+        return d
+    except TypeError:
+        # jax weak types / ml_dtypes scalars
+        return np.dtype(getattr(dtype, "dtype", dtype))
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.floating) or d.name == "bfloat16"
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.integer)

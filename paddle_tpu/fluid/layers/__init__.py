@@ -1,0 +1,5 @@
+"""fluid.layers namespace. Parity: python/paddle/fluid/layers/__init__.py."""
+from . import nn, ops, tensor  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403

@@ -1,0 +1,162 @@
+"""Auto-generated unary activation layers (reference layers/ops.py pattern:
+`__activations_noattr__` generated from the op registry)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "relu", "sigmoid", "tanh", "exp", "log", "sqrt", "rsqrt", "abs",
+    "ceil", "floor", "round", "cos", "sin", "tan", "acos", "asin", "atan",
+    "sinh", "cosh", "square", "reciprocal", "softplus", "softsign",
+    "logsigmoid", "erf", "mish", "sign", "silu", "log2", "log10", "log1p",
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+for _op in _UNARY_OPS:
+    globals()[_op] = _make_unary(_op)
+
+
+def gelu(x, approximate=False):
+    helper = LayerHelper("gelu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="gelu",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"approximate": approximate},
+    )
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="leaky_relu",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"alpha": alpha},
+    )
+    return out
+
+
+def elu(x, alpha=1.0, name=None):
+    helper = LayerHelper("elu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="elu", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"alpha": alpha}
+    )
+    return out
+
+
+def relu6(x, threshold=6.0, name=None):
+    helper = LayerHelper("relu6", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="relu6",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"threshold": threshold},
+    )
+    return out
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    helper = LayerHelper("hard_sigmoid", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="hard_sigmoid",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"slope": slope, "offset": offset},
+    )
+    return out
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    helper = LayerHelper("hard_swish", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="hard_swish",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"threshold": threshold, "scale": scale, "offset": offset},
+    )
+    return out
+
+
+def swish(x, beta=1.0, name=None):
+    helper = LayerHelper("swish", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="swish", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"beta": beta}
+    )
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="pow", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"factor": factor}
+    )
+    return out
+
+
+def soft_shrink(x, alpha=0.5):
+    helper = LayerHelper("soft_shrink")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="soft_shrink",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"lambda": alpha},
+    )
+    return out
+
+
+def hard_shrink(x, threshold=0.5):
+    helper = LayerHelper("hard_shrink")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="hard_shrink",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"threshold": threshold},
+    )
+    return out
+
+
+def thresholded_relu(x, threshold=1.0):
+    helper = LayerHelper("thresholded_relu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="thresholded_relu",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"threshold": threshold},
+    )
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="maxout",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"groups": groups},
+    )
+    return out

@@ -1,0 +1,323 @@
+"""Tensor-creation / manipulation layers.
+
+Parity surface: python/paddle/fluid/layers/tensor.py in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework, unique_name
+from ..dtypes import convert_dtype
+from ..framework import Variable
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """fluid.layers.data — prepends a -1 batch dim unless told otherwise."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    shape = [-1 if s is None else int(s) for s in shape]
+    block = framework.default_main_program().global_block()
+    return block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        is_data=True,
+        stop_gradient=True,
+    )
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable_for_type_inference(dtype=dtype)
+
+
+def create_parameter(
+    shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None
+):
+    helper = LayerHelper("create_parameter", name=name, param_attr=attr)
+    attr = helper.param_attr
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        persistable=persistable, shape=tuple(shape), dtype=convert_dtype(dtype)
+    )
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    if not persistable:
+        # non-persistable globals still need a runtime value
+        helper.main_program.global_block().append_op(
+            type="fill_constant",
+            outputs={"Out": [var]},
+            attrs={"shape": list(shape), "dtype": var.dtype, "value": float(value)},
+        )
+    return var
+
+
+def fill_constant(shape, dtype, value, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": convert_dtype(dtype), "value": float(value)},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(
+    input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0
+):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": list(shape),
+            "dtype": convert_dtype(dtype),
+            "value": float(value),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+        },
+    )
+    out.stop_gradient = True
+    return out
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="cast",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"out_dtype": convert_dtype(dtype)},
+    )
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op(
+        type="concat",
+        inputs={"X": input},
+        outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sums")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(dtype=input.dtype)
+        helper.append_op(
+            type="assign_value",
+            outputs={"Out": [output]},
+            attrs={
+                "shape": list(input.shape),
+                "dtype": convert_dtype(input.dtype),
+                "values": input.flatten().tolist(),
+            },
+        )
+        return output
+    if output is None:
+        output = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="assign", inputs={"X": [input]}, outputs={"Out": [output]})
+    return output
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="fill_zeros_like", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="fill_any_like",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"value": 1.0},
+    )
+    return out
+
+
+def full_like(x, fill_value, dtype=None):
+    helper = LayerHelper("full_like")
+    out = helper.create_variable_for_type_inference(dtype=dtype or x.dtype)
+    attrs = {"value": float(fill_value)}
+    if dtype is not None:
+        attrs["dtype"] = convert_dtype(dtype)
+    helper.append_op(
+        type="fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]}, attrs=attrs
+    )
+    return out
+
+
+def range(start, end, step, dtype="int64"):
+    helper = LayerHelper("range")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="range",
+        outputs={"Out": [out]},
+        attrs={
+            "start": float(start),
+            "end": float(end),
+            "step": float(step),
+            "dtype": convert_dtype(dtype),
+        },
+    )
+    out.stop_gradient = True
+    return out
+
+
+arange = range
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="linspace",
+        outputs={"Out": [out]},
+        attrs={
+            "start": float(start),
+            "stop": float(stop),
+            "num": int(num),
+            "dtype": convert_dtype(dtype),
+        },
+    )
+    return out
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="eye",
+        outputs={"Out": [out]},
+        attrs={
+            "num_rows": int(num_rows),
+            "num_columns": int(num_columns or num_rows),
+            "dtype": convert_dtype(dtype),
+        },
+    )
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag")
+    out = helper.create_variable_for_type_inference(dtype=diagonal.dtype)
+    helper.append_op(
+        type="diag_v2", inputs={"X": [diagonal]}, outputs={"Out": [out]}, attrs={}
+    )
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper("argmax")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="arg_max",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("argmin")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="arg_min",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def argsort(x, axis=-1, descending=False):
+    helper = LayerHelper("argsort")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    ids = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="argsort",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Indices": [ids]},
+        attrs={"axis": axis, "descending": descending},
+    )
+    return out, ids
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    if isinstance(axis, int):
+        axis = [axis]
+    helper.append_op(
+        type="flip",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"axis": list(axis)},
+    )
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("has_inf")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isinf", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper("has_nan")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isnan", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out

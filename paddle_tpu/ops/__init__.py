@@ -1,0 +1,16 @@
+"""Op library: JAX emitters registered by op type.
+
+Importing this package registers all built-in ops (the analog of the
+reference's static REGISTER_OPERATOR initializers).
+"""
+from . import registry  # noqa: F401
+from . import (  # noqa: F401
+    compare_ops,
+    creation,
+    manipulation,
+    math_ops,
+    nn_ops,
+    optimizer_ops,
+    reduce_ops,
+)
+from .registry import EmitContext, OpSpec, get, register, registered_ops  # noqa: F401

@@ -1,0 +1,62 @@
+"""Comparison & logical ops. Parity surface: reference
+operators/controlflow/compare_op.cc and logical_op.cc."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _cmp(name, fn):
+    @register(name, stop_gradient=True, no_vjp_grad=True)
+    def _emit(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], ins["Y"][0])]}
+
+    return _emit
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+
+
+@register("logical_and", stop_gradient=True, no_vjp_grad=True)
+def logical_and(ctx, ins, attrs):
+    return {"Out": [jnp.logical_and(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_or", stop_gradient=True, no_vjp_grad=True)
+def logical_or(ctx, ins, attrs):
+    return {"Out": [jnp.logical_or(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_xor", stop_gradient=True, no_vjp_grad=True)
+def logical_xor(ctx, ins, attrs):
+    return {"Out": [jnp.logical_xor(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_not", stop_gradient=True, no_vjp_grad=True)
+def logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register("allclose", stop_gradient=True, no_vjp_grad=True)
+def allclose(ctx, ins, attrs):
+    x, y = ins["Input"][0], ins["Other"][0]
+    rtol = float(attrs.get("rtol", 1e-5))
+    atol = float(attrs.get("atol", 1e-8))
+    out = jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=attrs.get("equal_nan", False))
+    return {"Out": [jnp.asarray(out)]}
+
+
+@register("maximum")
+def maximum(ctx, ins, attrs):
+    return {"Out": [jnp.maximum(ins["X"][0], ins["Y"][0])]}
+
+
+@register("minimum")
+def minimum(ctx, ins, attrs):
+    return {"Out": [jnp.minimum(ins["X"][0], ins["Y"][0])]}

@@ -1,0 +1,161 @@
+"""Tensor creation / initialization ops.
+
+Parity surface: reference ops fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, truncated_gaussian_random_op.cc, assign_op.cc,
+cast_op.cc, scale_op.cc, shape_op.cc, range_op.cc
+(/root/reference/paddle/fluid/operators/*.cc). Random ops draw from the
+functional PRNG threaded by the Executor (ctx.rng()) instead of a global
+generator — deterministic per compiled step, reproducible across replays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid.dtypes import convert_dtype
+from .registry import register
+
+
+def _attr_dtype(attrs, default="float32"):
+    return convert_dtype(attrs.get("dtype", default))
+
+
+def _attr_shape(attrs):
+    return tuple(int(d) for d in attrs.get("shape", ()))
+
+
+@register("fill_constant", no_vjp_grad=True)
+def fill_constant(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    shape = _attr_shape(attrs)
+    val = attrs.get("value", 0.0)
+    if attrs.get("str_value"):
+        val = float(attrs["str_value"])
+    return {"Out": [jnp.full(shape, val, dtype=dt)]}
+
+
+@register("fill_constant_batch_size_like", no_vjp_grad=True)
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    dt = _attr_dtype(attrs)
+    shape = list(_attr_shape(attrs))
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register("uniform_random", no_vjp_grad=True)
+def uniform_random(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    shape = _attr_shape(attrs)
+    lo = float(attrs.get("min", -1.0))
+    hi = float(attrs.get("max", 1.0))
+    out = jax.random.uniform(ctx.rng(), shape, dtype=jnp.float32, minval=lo, maxval=hi)
+    return {"Out": [out.astype(dt)]}
+
+
+@register("gaussian_random", no_vjp_grad=True)
+def gaussian_random(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    shape = _attr_shape(attrs)
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    out = mean + std * jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)
+    return {"Out": [out.astype(dt)]}
+
+
+@register("truncated_gaussian_random", no_vjp_grad=True)
+def truncated_gaussian_random(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    shape = _attr_shape(attrs)
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    out = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, dtype=jnp.float32)
+    return {"Out": [(mean + std * out).astype(dt)]}
+
+
+@register("assign")
+def assign(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["X"][0])]}
+
+
+@register("cast")
+def cast(ctx, ins, attrs):
+    dt = convert_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    return {"Out": [ins["X"][0].astype(dt)]}
+
+
+@register("scale")
+def scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + jnp.asarray(b, x.dtype)]}
+    return {"Out": [(x + jnp.asarray(b, x.dtype)) * s]}
+
+
+@register("shape", stop_gradient=True, no_vjp_grad=True)
+def shape_op(ctx, ins, attrs):
+    x = ins["Input"][0]
+    return {"Out": [jnp.asarray(np.array(x.shape, dtype=np.int32))]}
+
+
+@register("range", no_vjp_grad=True)
+def range_op(ctx, ins, attrs):
+    # XLA needs static shapes: bounds are attrs, not tensors (the layers API
+    # converts python scalars; tensor bounds would make the shape dynamic).
+    start = attrs["start"]
+    end = attrs["end"]
+    step = attrs.get("step", 1)
+    dt = _attr_dtype(attrs, "int64")
+    return {"Out": [jnp.arange(start, end, step, dtype=dt)]}
+
+
+@register("fill_zeros_like", no_vjp_grad=True)
+def fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register("fill_any_like", no_vjp_grad=True)
+def fill_any_like(ctx, ins, attrs):
+    dt = attrs.get("dtype")
+    x = ins["X"][0]
+    dtype = convert_dtype(dt) if dt is not None else x.dtype
+    return {"Out": [jnp.full_like(x, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register("eye", no_vjp_grad=True)
+def eye(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    n = int(attrs["num_rows"])
+    m = int(attrs.get("num_columns", n))
+    return {"Out": [jnp.eye(n, m, dtype=dt)]}
+
+
+@register("assign_value", no_vjp_grad=True)
+def assign_value(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    shape = _attr_shape(attrs)
+    vals = attrs.get("values")
+    if vals is None:
+        vals = attrs.get("fp32_values") or attrs.get("int32_values") or attrs.get("int64_values")
+    arr = np.asarray(vals, dtype=dt).reshape(shape)
+    return {"Out": [jnp.asarray(arr)]}
+
+
+@register("increment")
+def increment(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register("linspace", no_vjp_grad=True)
+def linspace(ctx, ins, attrs):
+    dt = _attr_dtype(attrs)
+    out = jnp.linspace(
+        attrs["start"], attrs["stop"], int(attrs["num"]), dtype=dt
+    )
+    return {"Out": [out]}

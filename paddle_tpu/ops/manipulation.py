@@ -1,0 +1,523 @@
+"""Tensor manipulation ops: reshape/transpose/concat/split/slice/gather/...
+
+Parity surface: reference root-level manipulation ops (~40k LoC C++/CUDA):
+reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc, slice_op.cc,
+strided_slice_op.cc, stack_op.cc, squeeze_op.cc, unsqueeze_op.cc,
+expand_op.cc, tile (expand v2), gather_op.cc, gather_nd_op.cc,
+scatter_op.cc, pad_op.cc, flatten_op.cc, arg_min_max_op_base.h,
+top_k_op.cc, cumsum_op.cc, flip_op.cc, roll_op.cc, tril_triu_op.cc,
+index_select_op.cc, where_op.cc. All are pure jnp/lax calls — XLA folds
+most of them into layout changes or fuses them away entirely.
+
+The *2 variants (reshape2/transpose2/squeeze2/unsqueeze2/flatten2) also
+emit an XShape output carrying the pre-op shape, matching the reference's
+grad plumbing; here it is a zero-size tensor kept only for desc parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, set_grad_maker
+
+
+def _xshape(x):
+    return jnp.zeros((0,) + tuple(x.shape), x.dtype)
+
+
+def _infer_reshape(shape_attr, in_shape):
+    shape = list(int(s) for s in shape_attr)
+    numel = int(np.prod(in_shape))
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = in_shape[i]
+    if -1 in shape:
+        i = shape.index(-1)
+        rest = int(np.prod([s for s in shape if s != -1]))
+        shape[i] = numel // max(rest, 1)
+    return tuple(shape)
+
+
+@register("reshape")
+def reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_infer_reshape(attrs["shape"], x.shape))]}
+
+
+@register("reshape2")
+def reshape2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x.reshape(_infer_reshape(attrs["shape"], x.shape))
+    return {"Out": [out], "XShape": [_xshape(x)]}
+
+
+@register("transpose")
+def transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register("transpose2")
+def transpose2(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.transpose(x, attrs["axis"])], "XShape": [_xshape(x)]}
+
+
+@register("concat")
+def concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("split")
+def split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idxs = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idxs, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("slice")
+def slice_op(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    decrease = attrs.get("decrease_axis", [])
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = int(np.clip(st if st >= 0 else st + dim, 0, dim))
+        en = int(np.clip(en if en >= 0 else en + dim, 0, dim))
+        idx[ax] = slice(st, en)
+    out = x[tuple(idx)]
+    if decrease:
+        out = out.reshape(
+            tuple(d for i, d in enumerate(out.shape) if i not in set(decrease))
+        )
+    return {"Out": [out]}
+
+
+@register("strided_slice")
+def strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(
+        attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]
+    ):
+        idx[ax] = slice(st, en, sd)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("stack")
+def stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("unstack")
+def unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = x.shape[axis]
+    outs = [jnp.squeeze(a, axis=axis) for a in jnp.split(x, num, axis=axis)]
+    return {"Y": outs}
+
+
+@register("unbind")
+def unbind(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    outs = [jnp.squeeze(a, axis=axis) for a in jnp.split(x, x.shape[axis], axis=axis)]
+    return {"Out": outs}
+
+
+def _squeeze_axes(x, axes):
+    if not axes:
+        return tuple(i for i, d in enumerate(x.shape) if d == 1)
+    return tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+
+
+@register("squeeze")
+def squeeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.squeeze(x, axis=_squeeze_axes(x, attrs.get("axes", [])))]}
+
+
+@register("squeeze2")
+def squeeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jnp.squeeze(x, axis=_squeeze_axes(x, attrs.get("axes", [])))
+    return {"Out": [out], "XShape": [_xshape(x)]}
+
+
+@register("unsqueeze")
+def unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+@register("unsqueeze2")
+def unsqueeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out], "XShape": [_xshape(x)]}
+
+
+@register("flatten")
+def flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape((lead, -1))]}
+
+
+@register("flatten2")
+def flatten2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape((lead, -1))], "XShape": [_xshape(x)]}
+
+
+@register("flatten_contiguous_range")
+def flatten_contiguous_range(ctx, ins, attrs):
+    x = ins["X"][0]
+    start = attrs.get("start_axis", 1) % max(x.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(x.ndim, 1)
+    mid = int(np.prod(x.shape[start : stop + 1]))
+    new_shape = tuple(x.shape[:start]) + (mid,) + tuple(x.shape[stop + 1 :])
+    return {"Out": [x.reshape(new_shape)], "XShape": [_xshape(x)]}
+
+
+@register("expand")
+def expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, tuple(times))]}
+
+
+@register("expand_v2")
+def expand_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # -1 entries keep the input dim
+    xshape = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    tgt = tuple(xs if s == -1 else s for s, xs in zip(shape, xshape))
+    return {"Out": [jnp.broadcast_to(x.reshape(xshape), tgt)]}
+
+
+@register("expand_as")
+def expand_as(ctx, ins, attrs):
+    x, tgt = ins["X"][0], ins["target_tensor"][0]
+    return {"Out": [jnp.broadcast_to(x, tgt.shape)]}
+
+
+@register("tile")
+def tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], tuple(attrs["repeat_times"]))]}
+
+
+@register("gather")
+def gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.take(x, idx.reshape(-1), axis=axis)]}
+
+
+@register("gather_nd")
+def gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    nd = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(nd))
+    return {"Out": [x[flat_idx]]}
+
+
+@register("scatter")
+def scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    overwrite = attrs.get("overwrite", True)
+    ids = ids.reshape(-1)
+    if overwrite:
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].set(jnp.zeros_like(upd[0]))
+        out = out.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register("scatter_nd_add")
+def scatter_nd_add(ctx, ins, attrs):
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    nd = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(nd))
+    return {"Out": [x.at[flat_idx].add(upd)]}
+
+
+@register("pad")
+def pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    pads = attrs["paddings"]
+    val = attrs.get("pad_value", 0.0)
+    cfg = [(pads[2 * i], pads[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, cfg, constant_values=val)]}
+
+
+@register("pad2d")
+def pad2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    val = attrs.get("pad_value", 0.0)
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if attrs.get("data_format", "NCHW") == "NHWC":
+        cfg = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[mode]
+    kw = {"constant_values": val} if mode == "constant" else {}
+    return {"Out": [jnp.pad(x, cfg, mode=jmode, **kw)]}
+
+
+@register("pad3d")
+def pad3d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCDHW
+    p = attrs["paddings"]  # [left, right, top, bottom, front, back]
+    mode = attrs.get("mode", "constant")
+    val = attrs.get("value", 0.0)
+    cfg = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if attrs.get("data_format", "NCDHW") == "NDHWC":
+        cfg = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": val} if mode == "constant" else {}
+    return {"Out": [jnp.pad(x, cfg, mode=jmode, **kw)]}
+
+
+@register("arg_max", stop_gradient=True, no_vjp_grad=True)
+def arg_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    keepdims = attrs.get("keepdims", False)
+    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    if keepdims:
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out]}
+
+
+@register("arg_min", stop_gradient=True, no_vjp_grad=True)
+def arg_min(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    keepdims = attrs.get("keepdims", False)
+    out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+    if keepdims:
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out]}
+
+
+@register("argsort", no_vjp_grad=True)
+def argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis).astype(jnp.int64)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx]}
+
+
+def _scatter_back_grad(x, idx, dout, axis):
+    """d(gather-by-index)/dx: scatter dout back through saved indices."""
+    return jnp.put_along_axis(
+        jnp.zeros_like(x), idx, dout.astype(x.dtype), axis=axis, inplace=False
+    )
+
+
+@register("argsort_grad", no_vjp_grad=True)
+def argsort_grad(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    dx = _scatter_back_grad(
+        ins["X"][0], ins["Indices"][0], ins["Out@GRAD"][0], axis
+    )
+    return {"X@GRAD": [dx]}
+
+
+def _indices_grad_maker(grad_type):
+    # Out is differentiable via saved Indices (reference top_k_op.cc /
+    # argsort_op.cc grad kernels); Indices itself carries no gradient.
+    def maker(op, out_grads, block):
+        og = out_grads.get("Out")
+        if og is None:
+            return [], {}
+        xname = op.input("X")[0]
+        gname = xname + "@GRAD"
+        desc = {
+            "type": grad_type,
+            "inputs": {
+                "X": [xname],
+                "Indices": [op.output("Indices")[0]],
+                "Out@GRAD": [og[0]],
+            },
+            "outputs": {"X@GRAD": [gname]},
+            "attrs": dict(op.attrs),
+        }
+        return [desc], {xname: gname}
+
+    return maker
+
+
+set_grad_maker("argsort", _indices_grad_maker("argsort_grad"))
+
+
+@register("top_k", no_vjp_grad=True)
+def top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("top_k_grad", no_vjp_grad=True)
+def top_k_grad(ctx, ins, attrs):
+    dx = _scatter_back_grad(
+        ins["X"][0], ins["Indices"][0], ins["Out@GRAD"][0], -1
+    )
+    return {"X@GRAD": [dx]}
+
+
+set_grad_maker("top_k", _indices_grad_maker("top_k_grad"))
+
+
+@register("top_k_v2_grad", no_vjp_grad=True)
+def top_k_v2_grad(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1) % x.ndim
+    dx = _scatter_back_grad(x, ins["Indices"][0], ins["Out@GRAD"][0], axis)
+    return {"X@GRAD": [dx]}
+
+
+@register("top_k_v2", no_vjp_grad=True)
+def top_k_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs["k"]
+    axis = attrs.get("axis", -1) % x.ndim
+    largest = attrs.get("largest", True)
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    return {
+        "Out": [jnp.moveaxis(vals, -1, axis)],
+        "Indices": [jnp.moveaxis(idx.astype(jnp.int64), -1, axis)],
+    }
+
+
+set_grad_maker("top_k_v2", _indices_grad_maker("top_k_v2_grad"))
+
+
+@register("cumsum")
+def cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    reverse = attrs.get("reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+@register("flip")
+def flip(ctx, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))]}
+
+
+@register("roll")
+def roll(ctx, ins, attrs):
+    x = ins["X"][0]
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis", None)
+    if axis is not None and len(axis) == 0:
+        axis = None
+    return {
+        "Out": [
+            jnp.roll(
+                x,
+                tuple(shifts) if len(shifts) > 1 else shifts[0],
+                axis=tuple(axis) if axis is not None else None,
+            )
+        ]
+    }
+
+
+@register("tril_triu")
+def tril_triu(ctx, ins, attrs):
+    x = ins["X"][0]
+    d = attrs.get("diagonal", 0)
+    lower = attrs.get("lower", True)
+    return {"Out": [jnp.tril(x, d) if lower else jnp.triu(x, d)]}
+
+
+@register("diag_v2", no_vjp_grad=True)
+def diag_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    offset = attrs.get("offset", 0)
+    if x.ndim == 1:
+        n = x.shape[0] + abs(offset)
+        out = jnp.full((n, n), attrs.get("padding_value", 0.0), x.dtype)
+        idx = jnp.arange(x.shape[0])
+        r = idx if offset >= 0 else idx - offset
+        c = idx + offset if offset >= 0 else idx
+        out = out.at[r, c].set(x)
+        return {"Out": [out]}
+    return {"Out": [jnp.diagonal(x, offset=offset)]}
+
+
+@register("index_select")
+def index_select(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx, axis=attrs.get("dim", 0))]}
+
+
+@register("where")
+def where(ctx, ins, attrs):
+    cond, x, y = ins["Condition"][0], ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.where(cond, x, y)]}
+
+
+@register("meshgrid")
+def meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register("take_along_axis")
+def take_along_axis(ctx, ins, attrs):
+    x, idx = ins["Input"][0], ins["Index"][0]
+    return {"Result": [jnp.take_along_axis(x, idx, axis=attrs.get("Axis", 0))]}
+
+
+@register("shard_index", stop_gradient=True, no_vjp_grad=True)
+def shard_index(ctx, ins, attrs):
+    """Remap global ids to shard-local ids (reference shard_index_op.cc),
+    used by model-parallel embedding/fc layers."""
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    out = jnp.where(in_shard, x % shard_size, ignore_value)
+    return {"Out": [out]}

@@ -1,0 +1,354 @@
+"""Dense math ops: elementwise (with paddle axis-broadcast), matmul family,
+activations, softmax.
+
+Parity surface: reference operators/elementwise/* (~6.9k LoC),
+matmul_op.cc, mul_op.cc, activation_op.cc (~30 activations),
+softmax_op.cc, log_softmax_op.cc. On TPU these are single jnp/lax calls
+that XLA fuses into surrounding matmuls; matmuls hit the MXU in bf16 when
+AMP is on.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _paddle_broadcast(x, y, axis):
+    """Paddle elementwise broadcast: align y's dims to x starting at `axis`
+    (reference: operators/elementwise/elementwise_op_function.h)."""
+    xr, yr = x.ndim, y.ndim
+    if xr == yr:
+        return x, y
+    if xr < yr:  # numpy-style broadcast from the left for x
+        return x, y
+    a = axis if axis is not None and axis >= 0 else xr - yr
+    new_shape = (1,) * a + tuple(y.shape) + (1,) * (xr - a - yr)
+    return x, y.reshape(new_shape)
+
+
+def _ew(name, fn):
+    @register(name)
+    def _emit(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _paddle_broadcast(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+
+    return _emit
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod)
+_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+@register("sum")
+def sum_op(ctx, ins, attrs):
+    """Add N tensors (grad accumulation op; reference sum_op.cc)."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("matmul")
+def matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    # transpose of a 1-D operand is the identity; jnp.matmul already gives
+    # vec@mat -> (n,) and mat@vec -> (m,) like the reference
+    if tx and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": [out]}
+
+
+@register("matmul_v2")
+def matmul_v2(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [jnp.matmul(x, y)]}
+
+
+@register("mul")
+def mul(ctx, ins, attrs):
+    """Flattening matmul (reference mul_op.cc): x flattened at
+    x_num_col_dims, y at y_num_col_dims, then 2-D matmul."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = x2 @ y2
+    return {"Out": [out.reshape(tuple(xs[:xn]) + tuple(ys[yn:]))]}
+
+
+@register("dot")
+def dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=x.ndim > 1)]}
+
+
+# ---------------------------------------------------------------------------
+# activations (reference activation_op.cc registers these as separate ops)
+# ---------------------------------------------------------------------------
+
+
+def _act(name, fn):
+    @register(name)
+    def _emit(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], attrs)]}
+
+    return _emit
+
+
+_act("relu", lambda x, a: jax.nn.relu(x))
+_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_act("tanh", lambda x, a: jnp.tanh(x))
+_act("exp", lambda x, a: jnp.exp(x))
+_act("log", lambda x, a: jnp.log(x))
+_act("log2", lambda x, a: jnp.log2(x))
+_act("log10", lambda x, a: jnp.log10(x))
+_act("log1p", lambda x, a: jnp.log1p(x))
+_act("sqrt", lambda x, a: jnp.sqrt(x))
+_act("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_act("abs", lambda x, a: jnp.abs(x))
+_act("ceil", lambda x, a: jnp.ceil(x))
+_act("floor", lambda x, a: jnp.floor(x))
+_act("round", lambda x, a: jnp.round(x))
+_act("cos", lambda x, a: jnp.cos(x))
+_act("sin", lambda x, a: jnp.sin(x))
+_act("tan", lambda x, a: jnp.tan(x))
+_act("acos", lambda x, a: jnp.arccos(x))
+_act("asin", lambda x, a: jnp.arcsin(x))
+_act("atan", lambda x, a: jnp.arctan(x))
+_act("sinh", lambda x, a: jnp.sinh(x))
+_act("cosh", lambda x, a: jnp.cosh(x))
+_act("square", lambda x, a: jnp.square(x))
+_act("reciprocal", lambda x, a: 1.0 / x)
+_act("softplus", lambda x, a: jax.nn.softplus(x))
+_act("softsign", lambda x, a: jax.nn.soft_sign(x))
+_act("silu", lambda x, a: jax.nn.silu(x))
+_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_act("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_act(
+    "leaky_relu",
+    lambda x, a: jax.nn.leaky_relu(x, negative_slope=a.get("alpha", 0.02)),
+)
+_act("elu", lambda x, a: jax.nn.elu(x, alpha=a.get("alpha", 1.0)))
+_act(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0
+    ),
+)
+_act(
+    "hard_swish",
+    lambda x, a: x
+    * jnp.clip(x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0))
+    / a.get("scale", 6.0),
+)
+_act(
+    "thresholded_relu",
+    lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+)
+_act(
+    "hard_shrink",
+    lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+)
+_act(
+    "soft_shrink",
+    lambda x, a: jnp.sign(x)
+    * jnp.maximum(jnp.abs(x) - a.get("lambda", 0.5), 0.0),
+)
+_act("erf", lambda x, a: jax.lax.erf(x))
+_act(
+    "gelu",
+    lambda x, a: jax.nn.gelu(x, approximate=bool(a.get("approximate", False))),
+)
+_act("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_act("sign", lambda x, a: jnp.sign(x))
+
+
+@register("pow")
+def pow_op(ctx, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+@register("clip")
+def clip(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.clip(x, attrs.get("min"), attrs.get("max"))]}
+
+
+@register("clip_by_norm")
+def clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = max_norm / jnp.maximum(norm, max_norm)
+    return {"Out": [x * scale]}
+
+
+@register("prelu")
+def prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register("softmax")
+def softmax(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=axis)]}
+
+
+@register("log_softmax")
+def log_softmax(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=axis)]}
+
+
+@register("maxout")
+def maxout(ctx, ins, attrs):
+    x = ins["X"][0]
+    groups = attrs["groups"]
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    x = x.reshape((n, c // groups, groups) + rest)
+    return {"Out": [jnp.max(x, axis=2)]}
+
+
+@register("isfinite", stop_gradient=True, no_vjp_grad=True)
+def isfinite(ctx, ins, attrs):
+    # reference isfinite_op: reduces to a single bool over all inputs
+    xs = ins["X"]
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": [ok.reshape((1,))]}
+
+
+@register("isfinite_v2", stop_gradient=True, no_vjp_grad=True)
+def isfinite_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isfinite(ins["X"][0])]}
+
+
+@register("isinf", stop_gradient=True, no_vjp_grad=True)
+def isinf_reduce(ctx, ins, attrs):
+    # reference overflow_op: has_inf reduces to a single bool
+    return {"Out": [jnp.any(jnp.isinf(ins["X"][0])).reshape(1)]}
+
+
+@register("isnan", stop_gradient=True, no_vjp_grad=True)
+def isnan_reduce(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isnan(ins["X"][0])).reshape(1)]}
+
+
+@register("isnan_v2", stop_gradient=True, no_vjp_grad=True)
+def isnan_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isnan(ins["X"][0])]}
+
+
+@register("isinf_v2", stop_gradient=True, no_vjp_grad=True)
+def isinf_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isinf(ins["X"][0])]}
+
+
+@register("squared_l2_norm")
+def squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(jnp.square(x)).reshape((1,))]}
+
+
+@register("p_norm")
+def p_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+    return {"Out": [out]}
+
+
+@register("addmm")
+def addmm(ctx, ins, attrs):
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    alpha = attrs.get("Alpha", 1.0)
+    beta = attrs.get("Beta", 1.0)
+    return {"Out": [beta * inp + alpha * (x @ y)]}
+
+
+@register("kron")
+def kron(ctx, ins, attrs):
+    return {"Out": [jnp.kron(ins["X"][0], ins["Y"][0])]}
+
+
+@register("trace")
+def trace_op(ctx, ins, attrs):
+    x = ins["Input"][0]
+    out = jnp.trace(
+        x,
+        offset=attrs.get("offset", 0),
+        axis1=attrs.get("axis1", 0),
+        axis2=attrs.get("axis2", 1),
+    )
+    return {"Out": [out]}
+
+
+@register("cholesky")
+def cholesky(ctx, ins, attrs):
+    x = ins["X"][0]
+    u = attrs.get("upper", False)
+    out = jnp.linalg.cholesky(x)
+    if u:
+        out = jnp.swapaxes(out, -1, -2)
+    return {"Out": [out]}
+
+
+@register("inverse")
+def inverse(ctx, ins, attrs):
+    return {"Output": [jnp.linalg.inv(ins["Input"][0])]}
+
+
+@register("matrix_power")
+def matrix_power(ctx, ins, attrs):
+    return {"Out": [jnp.linalg.matrix_power(ins["X"][0], attrs["n"])]}
+
+
+@register("logsumexp")
+def logsumexp(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", None)
+    if axis is not None and len(axis) == 0:
+        axis = None
+    keepdim = attrs.get("keepdim", False)
+    return {
+        "Out": [
+            jax.scipy.special.logsumexp(
+                x, axis=tuple(axis) if axis is not None else None, keepdims=keepdim
+            )
+        ]
+    }

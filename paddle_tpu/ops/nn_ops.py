@@ -1,0 +1,596 @@
+"""Neural-net ops: conv/pool/norm/dropout/embedding/losses.
+
+Parity surface: reference conv_op.cc + conv_cudnn_op.cu.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc, instance_norm_op.cc,
+dropout_op.cc, lookup_table_v2_op.cc, one_hot_v2_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+squared_error / huber / log_loss ops, metrics/accuracy_op.cc.
+
+TPU notes: convs lower to lax.conv_general_dilated (XLA tiles them onto the
+MXU); embedding grad becomes a fused scatter-add via the generic vjp path —
+the TPU-native replacement for the reference's SelectedRows sparse grad
+(framework/selected_rows.h:32). dropout registers an explicit grad op that
+reuses the saved Mask so backward sees the same randomness as forward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid.dtypes import convert_dtype
+from .registry import register, set_grad_maker
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_padding(paddings, algo, ndim_spatial):
+    if algo == "SAME":
+        return "SAME"
+    if algo == "VALID":
+        return "VALID"
+    p = list(paddings)
+    if len(p) == ndim_spatial:
+        return [(pi, pi) for pi in p]
+    if len(p) == 2 * ndim_spatial:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(ndim_spatial)]
+    raise ValueError(f"bad paddings {paddings}")
+
+
+def _conv2d_impl(x, w, attrs):
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    pad = _conv_padding(attrs.get("paddings", [0, 0]), algo, 2)
+    df = attrs.get("data_format", "NCHW")
+    if df in ("NCHW", "AnyLayout"):
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        if w.ndim == 4 and w.shape[-1] != x.shape[-1] // groups:
+            # weights always stored OIHW in paddle; convert for NHWC math
+            w = jnp.transpose(w, (2, 3, 1, 0))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+    )
+
+
+@register("conv2d")
+def conv2d(ctx, ins, attrs):
+    return {"Output": [_conv2d_impl(ins["Input"][0], ins["Filter"][0], attrs)]}
+
+
+@register("depthwise_conv2d")
+def depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    a = dict(attrs)
+    a["groups"] = x.shape[1] if a.get("data_format", "NCHW") == "NCHW" else x.shape[-1]
+    return {"Output": [_conv2d_impl(x, w, a)]}
+
+
+@register("conv2d_transpose")
+def conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    pad = _conv_padding(attrs.get("paddings", [0, 0]),
+                        attrs.get("padding_algorithm", "EXPLICIT"), 2)
+    # emulate gradient-of-conv semantics: lhs dilation
+    if isinstance(pad, str):
+        padding = pad
+    else:
+        kh = (w.shape[2] - 1) * dil[0] + 1
+        kw = (w.shape[3] - 1) * dil[1] + 1
+        padding = [
+            (kh - 1 - pad[0][0], kh - 1 - pad[0][1]),
+            (kw - 1 - pad[1][0], kw - 1 - pad[1][1]),
+        ]
+    w = jnp.flip(w, axis=(2, 3))  # (Cin, Cout/g, kh, kw)
+    w = jnp.swapaxes(w, 0, 1) if groups == 1 else w.reshape(
+        (groups, w.shape[0] // groups) + w.shape[1:]
+    ).swapaxes(1, 2).reshape((w.shape[1] * groups, w.shape[0] // groups) + w.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=padding,
+        lhs_dilation=strides, rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=groups,
+    )
+    if attrs.get("output_padding"):
+        op_ = attrs["output_padding"]
+        if any(op_):
+            out = jnp.pad(out, [(0, 0), (0, 0), (0, op_[0]), (0, op_[1])])
+    return {"Output": [out]}
+
+
+@register("conv3d")
+def conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1))
+    pad = _conv_padding(attrs.get("paddings", [0, 0, 0]),
+                        attrs.get("padding_algorithm", "EXPLICIT"), 3)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+@register("pool2d")
+def pool2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [1, 1]))
+    strides = list(attrs.get("strides", ksize))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    gp = attrs.get("global_pooling", False)
+    adaptive = attrs.get("adaptive", False)
+    exclusive = attrs.get("exclusive", True)
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    H, W = x.shape[2], x.shape[3]
+
+    if gp or (adaptive and ksize == [1, 1]):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3), keepdims=True)]}
+    if adaptive:
+        oh, ow = ksize
+        if H % oh == 0 and W % ow == 0:
+            xr = x.reshape(x.shape[0], x.shape[1], oh, H // oh, ow, W // ow)
+            red = jnp.max if ptype == "max" else jnp.mean
+            return {"Out": [red(xr, axis=(3, 5))]}
+        raise NotImplementedError("adaptive pool with non-divisible sizes")
+
+    if algo == "SAME":
+        pad = "SAME"
+    elif algo == "VALID":
+        pad = [(0, 0), (0, 0)]
+    else:
+        if len(paddings) == 2:
+            pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+        else:
+            pad = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    if attrs.get("ceil_mode", False) and pad != "SAME":
+        # extend right/bottom padding so the window count rounds up
+        def extra(dim, k, s, p):
+            import math
+
+            out = math.ceil((dim + p[0] + p[1] - k) / s) + 1
+            need = (out - 1) * s + k - dim - p[0]
+            return max(need - p[1], 0)
+
+        pad = [
+            (pad[0][0], pad[0][1] + extra(H, ksize[0], strides[0], pad[0])),
+            (pad[1][0], pad[1][1] + extra(W, ksize[1], strides[1], pad[1])),
+        ]
+    window = (1, 1, ksize[0], ksize[1])
+    strid = (1, 1, strides[0], strides[1])
+    full_pad = "SAME" if pad == "SAME" else [(0, 0), (0, 0)] + pad
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strid, full_pad)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strid, full_pad)
+        if exclusive:
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strid, full_pad)
+            out = s / cnt
+        else:
+            out = s / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register("batch_norm")
+def batch_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    use_global = attrs.get("use_global_stats", False) or is_test
+    layout = attrs.get("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = tuple(x.shape[ch_axis] if i == ch_axis else 1 for i in range(x.ndim))
+
+    if use_global:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        mean_out = momentum * mean + (1 - momentum) * m
+        var_out = momentum * var + (1 - momentum) * v
+        saved_mean = m
+        saved_var = 1.0 / jnp.sqrt(v + eps)
+    inv = 1.0 / jnp.sqrt(v + eps)
+    y = (x - m.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register("layer_norm")
+def layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("begin_norm_axis", 1)
+    lead = tuple(x.shape[:axis])
+    m = jnp.mean(x, axis=tuple(range(axis, x.ndim)), keepdims=True)
+    v = jnp.var(x, axis=tuple(range(axis, x.ndim)), keepdims=True)
+    y = (x - m) / jnp.sqrt(v + eps)
+    tail_shape = (1,) * axis + tuple(x.shape[axis:])
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(tail_shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(tail_shape)
+    return {
+        "Y": [y],
+        "Mean": [m.reshape(lead)],
+        "Variance": [v.reshape(lead)],
+    }
+
+
+@register("group_norm")
+def group_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    groups = attrs["groups"]
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + rest)
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=axes, keepdims=True)
+    v = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - m) / jnp.sqrt(v + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * len(rest)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {
+        "Y": [y],
+        "Mean": [m.reshape(n, groups)],
+        "Variance": [v.reshape(n, groups)],
+    }
+
+
+@register("instance_norm")
+def instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) / jnp.sqrt(v + eps)
+    c = x.shape[1]
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    n = x.shape[0]
+    return {
+        "Y": [y],
+        "SavedMean": [m.reshape(n * c)],
+        "SavedVariance": [(1.0 / jnp.sqrt(v + eps)).reshape(n * c)],
+    }
+
+
+@register("norm")
+def norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / nrm], "Norm": [nrm]}
+
+
+# ---------------------------------------------------------------------------
+# dropout (explicit grad op reusing the saved mask)
+# ---------------------------------------------------------------------------
+
+
+@register("dropout", no_vjp_grad=True)
+def dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = float(attrs.get("dropout_prob", 0.5))
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": [out], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(jnp.uint8)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / max(1.0 - p, 1e-12), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register("dropout_grad", no_vjp_grad=True)
+def dropout_grad(ctx, ins, attrs):
+    dout = ins["Out@GRAD"][0]
+    mask = ins["Mask"][0]
+    p = float(attrs.get("dropout_prob", 0.5))
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        # forward was out = x*(1-p) (downgrade) or out = x (upscale)
+        dx = dout * (1.0 - p) if impl == "downgrade_in_infer" else dout
+        return {"X@GRAD": [dx]}
+    dx = dout * mask.astype(dout.dtype)
+    if impl == "upscale_in_train":
+        dx = dx / max(1.0 - p, 1e-12)
+    return {"X@GRAD": [dx]}
+
+
+def _dropout_grad_maker(op, out_grads, block):
+    og = out_grads.get("Out")
+    if og is None:
+        return [], {}
+    xname = op.input("X")[0]
+    gname = xname + "@GRAD"
+    desc = {
+        "type": "dropout_grad",
+        "inputs": {"Mask": [op.output("Mask")[0]], "Out@GRAD": [og[0]]},
+        "outputs": {"X@GRAD": [gname]},
+        "attrs": {k: v for k, v in op.attrs.items()},
+    }
+    return [desc], {xname: gname}
+
+
+set_grad_maker("dropout", _dropout_grad_maker)
+
+
+# ---------------------------------------------------------------------------
+# embedding / one-hot
+# ---------------------------------------------------------------------------
+
+
+def _lookup(w, ids, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        padmask = (ids == padding_idx)[..., None]
+        out = jnp.where(padmask, 0.0, out)
+    return out
+
+
+@register("lookup_table")
+def lookup_table(ctx, ins, attrs):
+    # v1 ids carry a trailing [,1] dim (LoD heritage); result keeps it dense
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids2 = ids.reshape(ids.shape[:-1])
+    out = _lookup(w, ids2, attrs.get("padding_idx", -1))
+    return {"Out": [out]}
+
+
+@register("lookup_table_v2")
+def lookup_table_v2(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    return {"Out": [_lookup(w, ids, attrs.get("padding_idx", -1))]}
+
+
+@register("one_hot_v2", stop_gradient=True, no_vjp_grad=True)
+def one_hot_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register("one_hot", stop_gradient=True, no_vjp_grad=True)
+def one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    x = x.reshape(x.shape[:-1])  # trailing 1 dim
+    depth = attrs["depth"]
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register("embedding_with_scaled_gradient")
+def embedding_with_scaled_gradient(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    return {"Out": [_lookup(w, ids, attrs.get("padding_idx", -1))]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1) % logits.ndim
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    lse = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
+    logp = logits - lse
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        # hard labels: label has shape of logits with the class axis = 1
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            idx = lbl.astype(jnp.int32)
+        else:
+            idx = jnp.expand_dims(lbl.astype(jnp.int32), axis)
+        n_cls = logp.shape[axis]
+        safe_idx = jnp.clip(idx, 0, n_cls - 1)
+        picked = jnp.take_along_axis(logp, safe_idx, axis=axis)
+        # kIgnoreIndex (-100) is itself a valid ignore value — mask always
+        loss = jnp.where(idx == ignore_index, 0.0, -picked)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register("cross_entropy")
+def cross_entropy(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    eps = 1e-12
+    if soft_label:
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, axis=-1)
+        p = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(p, eps))
+        loss = jnp.where(lbl[..., None] == ignore_index, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register("cross_entropy2")
+def cross_entropy2(ctx, ins, attrs):
+    out = cross_entropy(ctx, ins, attrs)
+    x = ins["X"][0]
+    from .manipulation import _xshape
+
+    return {
+        "Y": out["Y"],
+        "XShape": [_xshape(x)],
+        "MatchX": [jnp.exp(-out["Y"][0])],
+    }
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    ignore_index = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    return {"Out": [loss]}
+
+
+@register("bce_loss")
+def bce_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(x, eps)) + (1 - label) * jnp.log(jnp.maximum(1 - x, eps)))
+    return {"Out": [loss]}
+
+
+@register("square_error_cost")
+def square_error_cost(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register("smooth_l1_loss")
+def smooth_l1_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        loss = loss * ins["OutsideWeight"][0]
+    out = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [diff]}
+
+
+@register("huber_loss")
+def huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("log_loss")
+def log_loss(ctx, ins, attrs):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register("kldiv_loss")
+def kldiv_loss(ctx, ins, attrs):
+    x, tgt = ins["X"][0], ins["Target"][0]
+    red = attrs.get("reduction", "mean")
+    loss = jnp.where(tgt > 0, tgt * (jnp.log(tgt) - x), 0.0)
+    if red == "mean":
+        loss = jnp.mean(loss).reshape((1,))
+    elif red == "sum":
+        loss = jnp.sum(loss).reshape((1,))
+    elif red == "batchmean":
+        loss = (jnp.sum(loss) / x.shape[0]).reshape((1,))
+    return {"Loss": [loss]}
+
+
+@register("label_smooth")
+def label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return {"Out": [out]}
+
+
+@register("mse_loss")
+def mse_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.mean(jnp.square(x - y)).reshape((1,))]}
+
+
+@register("margin_rank_loss")
+def margin_rank_loss(ctx, ins, attrs):
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [act], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference operators/metrics/)
+# ---------------------------------------------------------------------------
+
+
+@register("accuracy", stop_gradient=True, no_vjp_grad=True)
+def accuracy(ctx, ins, attrs):
+    idx = ins["Indices"][0]
+    label = ins["Label"][0]
+    correct = jnp.any(idx == label.reshape(-1, 1), axis=1)
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    num_correct = jnp.sum(correct).astype(jnp.int32)
+    acc = num_correct.astype(jnp.float32) / jnp.maximum(total, 1)
+    return {
+        "Accuracy": [acc.reshape((1,))],
+        "Correct": [num_correct.reshape((1,))],
+        "Total": [total.reshape((1,))],
+    }

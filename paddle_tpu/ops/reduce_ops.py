@@ -1,0 +1,56 @@
+"""Reduction ops. Parity surface: reference operators/reduce_ops/ (~2.2k LoC):
+reduce_sum/mean/max/min/prod/all/any with attrs dim / keep_dim / reduce_all."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _axes(x, attrs):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    if len(dim) == 0:
+        return None
+    return tuple(d % x.ndim for d in dim)
+
+
+def _reduce(name, fn, stop_grad=False):
+    @register(name, stop_gradient=stop_grad)
+    def _emit(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        out = _fn(x, axis=_axes(x, attrs), keepdims=attrs.get("keep_dim", False))
+        if out.ndim == 0:
+            out = out.reshape((1,))  # fluid reductions keep at least rank 1
+        return {"Out": [out]}
+
+    return _emit
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, stop_grad=True)
+_reduce("reduce_any", jnp.any, stop_grad=True)
+
+
+@register("mean")
+def mean(ctx, ins, attrs):
+    """Whole-tensor mean to a [1] tensor (reference mean_op.cc)."""
+    return {"Out": [jnp.mean(ins["X"][0]).reshape((1,))]}
+
+
+@register("frobenius_norm")
+def frobenius_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jnp.sqrt(
+        jnp.sum(jnp.square(x), axis=_axes(x, attrs), keepdims=attrs.get("keep_dim", False))
+    )
+    if out.ndim == 0:
+        out = out.reshape((1,))
+    return {"Out": [out]}

@@ -1,0 +1,40 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+Mirrors the reference's test strategy (SURVEY.md §4): CPUPlace serves as the
+fake device; the 8 virtual devices let distributed tests exercise real mesh
+sharding + collectives without TPU hardware (the driver separately dry-runs
+the multi-chip path). Must run before jax initializes.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/TPU: tests need f32 exactness
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope + name generator."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid import executor as executor_mod
+
+    old_main = framework.switch_main_program(framework.Program())
+    old_startup = framework.switch_startup_program(framework.Program())
+    old_gen = unique_name.switch()
+    old_scope = executor_mod._global_scope
+    executor_mod._global_scope = executor_mod.Scope()
+    yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    unique_name.switch(old_gen)
+    executor_mod._global_scope = old_scope

@@ -1,0 +1,65 @@
+"""IR construction + shape inference tests (reference analog:
+test_program.py, test_variable.py, test_operator_desc.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_program_block_structure():
+    prog = fluid.default_main_program()
+    x = layers.data("x", shape=[4, 8], append_batch_size=False)
+    assert x.shape == (4, 8)
+    y = layers.fc(x, size=16)
+    block = prog.global_block()
+    assert len(block.ops) >= 1
+    types = [op.type for op in block.ops]
+    assert "mul" in types
+    params = prog.all_parameters()
+    assert len(params) == 2  # weight + bias
+    assert y.shape == (4, 16)
+
+
+def test_shape_inference_static():
+    x = layers.data("x", shape=[2, 3, 8, 8], append_batch_size=False)
+    y = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+    assert y.shape == (2, 4, 8, 8)
+    z = layers.pool2d(y, pool_size=2, pool_stride=2)
+    assert z.shape == (2, 4, 4, 4)
+
+
+def test_shape_inference_dynamic_batch():
+    x = layers.data("img", shape=[1, 28, 28])  # batch prepended as -1
+    assert x.shape == (-1, 1, 28, 28)
+    y = layers.conv2d(x, num_filters=6, filter_size=5)
+    assert y.shape == (-1, 6, 24, 24)
+    f = layers.flatten(y)
+    assert f.shape == (-1, 6 * 24 * 24)
+    o = layers.fc(f, size=10)
+    assert o.shape == (-1, 10)
+
+
+def test_elementwise_broadcast_axis():
+    x = layers.data("x", shape=[2, 3, 4], append_batch_size=False)
+    b = layers.data("b", shape=[3], append_batch_size=False)
+    y = layers.elementwise_add(x, b, axis=1)
+    assert y.shape == (2, 3, 4)
+
+
+def test_program_clone_for_test():
+    x = layers.data("x", shape=[4, 8], append_batch_size=False)
+    y = layers.dropout(layers.fc(x, size=4), dropout_prob=0.5)
+    prog = fluid.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    d_ops = [op for op in test_prog.global_block().ops if op.type == "dropout"]
+    assert d_ops and d_ops[0].attr("is_test") is True
+    # original untouched
+    d_ops0 = [op for op in prog.global_block().ops if op.type == "dropout"]
+    assert d_ops0[0].attr("is_test") is False
+
+
+def test_variable_repr_and_grad_name():
+    x = layers.data("x", shape=[4], append_batch_size=False)
+    assert x.grad_name == "x@GRAD"
+    assert "x" in repr(x)

@@ -1,0 +1,75 @@
+"""End-to-end: MNIST LeNet static-graph training, loss must decrease.
+
+Parity with the reference's book test
+(python/paddle/fluid/tests/book/test_recognize_digits.py) using synthetic
+data (no dataset downloads in CI).
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.optimizer import AdamOptimizer, SGDOptimizer
+
+
+def lenet(img, label):
+    conv1 = layers.conv2d(img, num_filters=6, filter_size=5, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = layers.fc(layers.flatten(pool2), size=120, act="relu")
+    fc2 = layers.fc(fc1, size=84, act="relu")
+    logits = layers.fc(fc2, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label)
+    )
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc
+
+
+def _synthetic_batch(bs, seed):
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 10, size=(bs, 1)).astype(np.int64)
+    img = rng.randn(bs, 1, 28, 28).astype(np.float32) * 0.1
+    # plant a learnable signal per class
+    for i, l in enumerate(label[:, 0]):
+        img[i, 0, l, :] += 1.0
+    return img, label
+
+
+def test_mnist_lenet_trains():
+    img = layers.data("img", shape=[1, 28, 28])
+    label = layers.data("label", shape=[1], dtype="int64")
+    loss, acc = lenet(img, label)
+    opt = AdamOptimizer(learning_rate=1e-3)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for step in range(30):
+        x, y = _synthetic_batch(32, seed=step)
+        lv, av = exe.run(feed={"img": x, "label": y}, fetch_list=[loss, acc])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.7, f"loss did not decrease: {losses[:3]} -> {losses[-3:]}"
+
+
+def test_sgd_linear_regression_converges():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(8, 1).astype(np.float32)
+    first = last = None
+    for step in range(60):
+        xv = rng.randn(64, 8).astype(np.float32)
+        yv = xv @ true_w
+        (lv,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        if first is None:
+            first = float(lv[0])
+        last = float(lv[0])
+    assert last < first * 0.05
