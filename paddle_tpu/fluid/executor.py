@@ -233,7 +233,10 @@ class Executor:
                         env[n] = v
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in state_out}
-            return fetches, new_state, ctx.rng_state
+            # advance the scope key even if no op split it, so salted_rng
+            # (per-op fold_in of the base key) differs across steps
+            next_key = jax.random.fold_in(ctx.rng_state, 0x5EED)
+            return fetches, new_state, next_key
 
         jit_fn = jax.jit(fn, donate_argnums=(1,))
         return _CompiledBlock(

@@ -1106,5 +1106,35 @@ def prelu(x, mode, param_attr=None, name=None):
     return out
 
 
+_rng_salt_counter = [0]
+
+
+def fused_multihead_attention(
+    q, k, v, attn_bias=None, num_heads=1, dropout_prob=0.0, is_test=False, name=None
+):
+    """Fused scaled-dot-product attention over head-interleaved [B,S,H]
+    tensors (TPU: Pallas flash attention; see ops/attention.py). The
+    reference gets this via graph fusion passes (multihead_matmul_fuse_pass);
+    here it is a first-class op."""
+    helper = LayerHelper("fused_multihead_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    _rng_salt_counter[0] += 1
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if attn_bias is not None:
+        inputs["BiasQK"] = [attn_bias]
+    helper.append_op(
+        type="fused_multihead_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "num_heads": num_heads,
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "rng_salt": _rng_salt_counter[0],
+        },
+    )
+    return out
+
+
 def unique_name_layer():  # pragma: no cover - placeholder parity stub
     raise NotImplementedError

@@ -1,0 +1,11 @@
+"""Model zoo built on the paddle_tpu static-graph API.
+
+Mirrors the reference's model coverage (tests/book/ classic models,
+dist_transformer.py, BERT/ERNIE encoder layers backed by
+multihead_matmul_fuse_pass.cc / bert_encoder_functor.cu) — here the models
+are first-class builders emitting Programs that the XLA executor compiles
+whole, so the "fusion passes" of the reference are unnecessary: XLA +
+Pallas attention give the fused kernels directly.
+"""
+from . import bert  # noqa: F401
+from .bert import BertConfig, build_bert_pretrain_program  # noqa: F401

@@ -5,6 +5,7 @@ reference's static REGISTER_OPERATOR initializers).
 """
 from . import registry  # noqa: F401
 from . import (  # noqa: F401
+    attention,
     compare_ops,
     creation,
     manipulation,

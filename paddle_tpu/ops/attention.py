@@ -1,0 +1,83 @@
+"""Fused multi-head attention op.
+
+Replaces the reference's BERT attention fusion machinery
+(/root/reference/paddle/fluid/framework/ir/multihead_matmul_fuse_pass.cc and
+ /root/reference/paddle/fluid/operators/math/bert_encoder_functor.cu):
+there, a graph pass pattern-matches the decomposed attention subgraph and
+swaps in a hand-written CUDA kernel. Here attention is a first-class op;
+on TPU it lowers to a Pallas flash-attention kernel (online softmax, O(S)
+memory), elsewhere to a jnp composition that XLA fuses.
+
+Semantics: Q,K,V are [B, S, H] (head-interleaved, pre-split); BiasQK is an
+additive mask broadcastable to [B, nh, S, S]. Output is [B, S, H].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _split_heads(x, num_heads):
+    b, s, h = x.shape
+    return x.reshape(b, s, num_heads, h // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, nh, s, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, nh * dh)
+
+
+def _reference_attention(q, k, v, bias, dropout_prob, deterministic, rng_key):
+    """jnp composition: [B,nh,S,dh] in, [B,nh,S,dh] out."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) * (1.0 / math.sqrt(dh))
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_prob > 0.0:
+        keep = jax.random.bernoulli(rng_key, 1.0 - dropout_prob, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0)
+    return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+
+
+# test hook: force the pallas path (interpret mode) on CPU
+FORCE_PALLAS = False
+
+
+def _use_pallas(q, dropout_prob, deterministic):
+    if not deterministic and dropout_prob > 0.0:
+        return False  # pallas path has no dropout; jnp path handles it
+    dh = q.shape[-1]
+    # MXU-friendly head dims only; otherwise XLA fusion is competitive
+    shapes_ok = dh in (64, 128, 256) and q.shape[2] % 128 == 0
+    if FORCE_PALLAS:
+        return shapes_ok
+    return shapes_ok and jax.default_backend() in ("tpu", "axon")
+
+
+@register("fused_multihead_attention")
+def fused_multihead_attention(ctx, ins, attrs):
+    q3, k3, v3 = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins.get("BiasQK", [None])[0]
+    nh = int(attrs["num_heads"])
+    dropout_prob = float(attrs.get("dropout_prob", 0.0))
+    is_test = bool(attrs.get("is_test", False))
+
+    q = _split_heads(q3, nh)
+    k = _split_heads(k3, nh)
+    v = _split_heads(v3, nh)
+
+    if _use_pallas(q, dropout_prob, is_test):
+        from .pallas.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, bias)
+    else:
+        rng = None
+        if not is_test and dropout_prob > 0.0:
+            rng = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+        out = _reference_attention(q, k, v, bias, dropout_prob, is_test, rng)
+    return {"Out": [_merge_heads(out)]}

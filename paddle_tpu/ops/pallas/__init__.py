@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hot-op layer.
+
+Where the reference ships hand-written CUDA (e.g.
+/root/reference/paddle/fluid/operators/math/bert_encoder_functor.cu), this
+package ships Pallas kernels tuned for the MXU/VMEM; everything else rides
+XLA fusion.
+"""

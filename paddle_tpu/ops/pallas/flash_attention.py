@@ -1,0 +1,311 @@
+"""Flash attention (online-softmax) Pallas TPU kernel with custom VJP.
+
+TPU-native replacement for the reference's fused BERT attention CUDA kernel
+(/root/reference/paddle/fluid/operators/math/bert_encoder_functor.cu —
+softmax over scores in shared memory) — here the whole attention is one
+kernel: scores never materialize in HBM (O(S) memory instead of O(S^2)),
+and the backward pass recomputes probabilities blockwise from the saved
+log-sum-exp, the standard flash-attention-2 scheme.
+
+Layout: q, k, v are [BH, S, D] (batch*heads flattened); optional additive
+per-key bias is [B, S] (the BERT padding mask); heads of one batch share it.
+Block sizes are 128 to match the MXU; D must be one of (64, 128, 256).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # 'axon' is a tunneled real TPU backend; anything else (cpu tests) runs
+    # the kernel in interpreter mode for exact-semantics checking
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, sm_scale, num_heads):
+    # q_ref [1, BQ, D]; k_ref/v_ref [1, S, D]; bias_ref [1, S] or None
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    seq_len = k_ref.shape[1]
+    d = q.shape[-1]
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(i * BLOCK_K, BLOCK_K)][None, :]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((BLOCK_Q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
+    acc0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, seq_len // BLOCK_K, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, bias, sm_scale, num_heads):
+    bh, s, d = q.shape
+    grid = (bh, s // BLOCK_Q)
+    in_specs = [
+        pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, s), lambda b, i: (b // num_heads, 0), memory_space=pltpu.VMEM
+            )
+        )
+        args.append(bias)
+        kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, num_heads=num_heads)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, o, lse, **kw: _fwd_kernel(qr, kr, vr, None, o, lse, **kw),
+            sm_scale=sm_scale,
+            num_heads=num_heads,
+        )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, num_heads
+):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    seq_len = k_ref.shape[1]
+    d = q.shape[-1]
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(i * BLOCK_K, BLOCK_K)][None, :]
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, seq_len // BLOCK_K, body, jnp.zeros((BLOCK_Q, d), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, num_heads
+):
+    k = k_ref[0].astype(jnp.float32)  # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    seq_len = q_ref.shape[1]
+    d = k.shape[-1]
+    if bias_ref is not None:
+        b_block = bias_ref[0, pl.ds(pl.program_id(1) * BLOCK_K, BLOCK_K)]
+    else:
+        b_block = None
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        delta = delta_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )
+        if b_block is not None:
+            s = s + b_block[None, :]
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale  # [BQ, BK]
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((BLOCK_K, d), jnp.float32)
+    dv0 = jnp.zeros((BLOCK_K, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, seq_len // BLOCK_Q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, sm_scale, num_heads):
+    q, k, v, bias, o, lse = res
+    bh, s, d = q.shape
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)  # [BH,S]
+
+    qspec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+    fullspec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), memory_space=pltpu.VMEM)
+    fullrow = pl.BlockSpec((1, s), lambda b, i: (b, 0), memory_space=pltpu.VMEM)
+    bias_spec = pl.BlockSpec((1, s), lambda b, i: (b // num_heads, 0), memory_space=pltpu.VMEM)
+
+    # dq: grid over q blocks
+    args = [q, k, v] + ([bias] if bias is not None else []) + [g, lse, delta]
+    in_specs = [qspec, fullspec, fullspec] + ([bias_spec] if bias is not None else []) + [
+        qspec,
+        rowspec,
+        rowspec,
+    ]
+    if bias is not None:
+        dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, num_heads=num_heads)
+    else:
+        dq_kernel = functools.partial(
+            lambda qr, kr, vr, dor, lser, dr, dqr, **kw: _bwd_dq_kernel(
+                qr, kr, vr, None, dor, lser, dr, dqr, **kw
+            ),
+            sm_scale=sm_scale,
+            num_heads=num_heads,
+        )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, s // BLOCK_Q),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=_interpret(),
+    )(*args)
+
+    # dk/dv: grid over k blocks
+    kspec = pl.BlockSpec((1, BLOCK_K, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+    fullq = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+    args2 = [q, k, v] + ([bias] if bias is not None else []) + [g, lse, delta]
+    in_specs2 = [fullq, kspec, kspec] + ([bias_spec] if bias is not None else []) + [
+        fullq,
+        fullrow,
+        fullrow,
+    ]
+    if bias is not None:
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, num_heads=num_heads)
+    else:
+        dkv_kernel = functools.partial(
+            lambda qr, kr, vr, dor, lser, dr, dkr, dvr, **kw: _bwd_dkv_kernel(
+                qr, kr, vr, None, dor, lser, dr, dkr, dvr, **kw
+            ),
+            sm_scale=sm_scale,
+            num_heads=num_heads,
+        )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, s // BLOCK_K),
+        in_specs=in_specs2,
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(*args2)
+
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+# ---------------------------------------------------------------------------
+# public entry: [B, nh, S, D] ± per-key bias [B, S]
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_core(q, k, v, bias, sm_scale, num_heads):
+    o, _ = _flash_fwd(q, k, v, bias, sm_scale, num_heads)
+    return o
+
+
+def _flash_core_fwd(q, k, v, bias, sm_scale, num_heads):
+    o, lse = _flash_fwd(q, k, v, bias, sm_scale, num_heads)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_core_bwd(sm_scale, num_heads, res, g):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd((q, k, v, bias, o, lse), g, sm_scale, num_heads)
+    return dq, dk, dv, dbias
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, bias=None, sm_scale=None):
+    """q,k,v: [B, nh, S, D]; bias: additive, broadcastable to [B,nh,S,S]
+    but only the per-key form [B,1,1,S] is kernelized (BERT padding mask).
+    Returns [B, nh, S, D]."""
+    b, nh, s, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    key_bias = None
+    if bias is not None:
+        if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1:
+            key_bias = bias.reshape(b, bias.shape[-1]).astype(jnp.float32)
+        else:
+            raise ValueError(
+                f"flash_attention kernel supports per-key bias [B,1,1,S]; got {bias.shape}"
+            )
+    qf = q.reshape(b * nh, s, d)
+    kf = k.reshape(b * nh, s, d)
+    vf = v.reshape(b * nh, s, d)
+    o = _flash_core(qf, kf, vf, key_bias, sm_scale, nh)
+    return o.reshape(b, nh, s, d)

@@ -32,6 +32,7 @@ class EmitContext:
 
     def __init__(self, rng_key=None, mesh=None, axis_env=None):
         self._key = rng_key
+        self._base_key = rng_key  # frozen per-step key for salted_rng
         self.mesh = mesh
         # mapping of logical ring_id -> mesh axis name, for collective ops
         self.axis_env = axis_env or {}
@@ -44,6 +45,19 @@ class EmitContext:
             self._key = jax.random.PRNGKey(0)
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def salted_rng(self, salt: int):
+        """Deterministic per-op key: fold a graph-build-time salt into the
+        per-step base key. Unlike rng(), the result does not depend on trace
+        order, so an op with internal randomness (fused attention dropout)
+        gets the SAME mask when its forward emitter is re-traced under
+        jax.vjp by the generic grad path — no saved mask needed."""
+        import jax
+
+        base = self._base_key
+        if base is None:
+            base = jax.random.PRNGKey(0)
+        return jax.random.fold_in(base, salt)
 
     @property
     def rng_state(self):

@@ -1,0 +1,50 @@
+"""Flash-attention Pallas kernel vs jnp reference (interpret mode on CPU).
+
+Mirrors the reference's OpTest numeric-oracle pattern (SURVEY.md §4):
+numpy/jnp oracle for forward, finite-check via jax.grad comparison.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.attention import _reference_attention
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _make(b=2, nh=2, s=256, d=64, bias=True, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(b, nh, s, d).astype(np.float32)
+    k = rng.randn(b, nh, s, d).astype(np.float32)
+    v = rng.randn(b, nh, s, d).astype(np.float32)
+    bias_arr = None
+    if bias:
+        mask = (rng.rand(b, s) > 0.2).astype(np.float32)
+        mask[:, 0] = 1.0
+        bias_arr = (1e4 * (mask - 1.0)).reshape(b, 1, 1, s).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), (
+        None if bias_arr is None else jnp.asarray(bias_arr)
+    )
+
+
+@pytest.mark.parametrize("use_bias", [False, True])
+def test_forward_matches_reference(use_bias):
+    q, k, v, bias = _make(bias=use_bias)
+    out = flash_attention(q, k, v, bias)
+    ref = _reference_attention(q, k, v, bias, 0.0, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_grads_match_reference():
+    q, k, v, bias = _make(b=1, nh=2, s=128, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, bias) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, bias, 0.0, True, None) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
