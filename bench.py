@@ -1,0 +1,105 @@
+"""Benchmark: BERT-base pretraining step (fwd+bwd+Adam) tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.35 (the BASELINE.json north star:
+ERNIE/BERT-base pretraining at >=35% MFU; the reference publishes no
+in-repo numbers — see BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _peak_flops_per_chip():
+    """bf16 peak FLOP/s for the local chip (best-effort detect)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12,  # v5e
+        "v5e": 197e12,
+        "v5p": 459e12,
+        "v4": 275e12,
+        "v6": 918e12,  # trillium
+        "v3": 123e12,
+        "v2": 45e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12  # conservative default
+
+
+def _bert_step_flops(cfg, batch, seq):
+    """fwd+bwd FLOPs per step: 6*N per token for matmul params (fwd 2N,
+    bwd 4N) + attention scores/context 12*L*S*H per token."""
+    h, L, ff, v = cfg.hidden_size, cfg.num_hidden_layers, cfg.intermediate_size, cfg.vocab_size
+    # parameter FLOP-active matmuls: qkv+out (4 h^2) + ffn (2 h ff) per layer
+    n_matmul = L * (4 * h * h + 2 * h * ff) + v * h  # + lm head / embedding tie
+    per_token = 6 * n_matmul + 12 * L * seq * h
+    return per_token * batch * seq
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.bert import (
+        BertConfig,
+        build_bert_pretrain_program,
+        random_pretrain_batch,
+    )
+
+    cfg = BertConfig.base()
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    seq = int(os.environ.get("BENCH_SEQ", 512))
+    max_preds = 76
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    main_p = fluid.Program()
+    startup = fluid.Program()
+    m, st, feeds, loss = build_bert_pretrain_program(
+        cfg, batch, seq, max_preds, main_program=main_p, startup_program=startup
+    )
+    with fluid.program_guard(m, st):
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(st)
+    data = random_pretrain_batch(cfg, batch, seq, max_preds, seed=0)
+
+    # warmup (compile)
+    for _ in range(2):
+        (lv,) = exe.run(m, feed=data, fetch_list=[loss])
+    float(np.asarray(lv).reshape(()))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (lv,) = exe.run(m, feed=data, fetch_list=[loss])
+    float(np.asarray(lv).reshape(()))  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    mfu = _bert_step_flops(cfg, batch, seq) * steps / dt / _peak_flops_per_chip()
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.35, 4),
+                "mfu": round(mfu, 4),
+                "batch": batch,
+                "seq_len": seq,
+                "steps": steps,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""DistributedStrategy: structured distributed-training config.
+
+Parity: /root/reference/python/paddle/fleet/base/distributed_strategy.py
+wrapping framework/distributed_strategy.proto:95-130. The reference's
+fields (amp, recompute, gradient_merge, localsgd, dgc, pipeline,
+nccl_comm_num, hierarchical_allreduce...) are kept where meaningful;
+NCCL-topology knobs become mesh-axis knobs (XLA owns the rings). New
+TPU-era fields: mesh_axes, tensor_parallel, sequence_parallel.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # --- parity fields (reference distributed_strategy.proto) ---
+        self.amp: bool = False
+        self.amp_configs: Dict = {}
+        self.recompute: bool = False
+        self.recompute_configs: Dict = {"checkpoints": []}
+        self.gradient_merge: bool = False
+        self.gradient_merge_configs: Dict = {"k_steps": 1, "avg": True}
+        self.pipeline: bool = False
+        self.pipeline_configs: Dict = {"accumulate_steps": 1}
+        self.localsgd: bool = False
+        self.localsgd_configs: Dict = {"k_steps": 1}
+        self.dgc: bool = False
+        self.lars: bool = False
+        self.lamb: bool = False
+        self.sharding: bool = False  # ZeRO-style optimizer-state sharding
+        self.sharding_configs: Dict = {}
+        self.elastic: bool = False
+        self.auto: bool = False
+        # legacy NCCL knobs accepted but inert (XLA owns collectives)
+        self.nccl_comm_num: int = 1
+        self.hierarchical_allreduce_inter_nranks: int = 1
+        self.sync_nccl_allreduce: bool = True
+        self.fuse_grad_size_in_MB: int = 32
+        # --- TPU-era extensions ---
+        # ordered mesh axes, e.g. {"dp": -1} or {"dp": 2, "tp": 4}
+        self.mesh_axes: Dict[str, int] = {}
+        self.mesh = None  # pre-built jax.sharding.Mesh (wins over mesh_axes)
+        self.tensor_parallel: bool = False
+        # [(param-name regex, PartitionSpec tuple)]
+        self.tensor_parallel_rules: List[Tuple[str, tuple]] = []
+        self.sequence_parallel: bool = False
+
+    def __repr__(self):
+        on = [
+            k for k, v in vars(self).items()
+            if isinstance(v, bool) and v
+        ]
+        return f"DistributedStrategy(enabled={on}, mesh_axes={self.mesh_axes})"

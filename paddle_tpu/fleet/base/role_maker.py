@@ -1,0 +1,56 @@
+"""Role makers: rank/endpoint discovery.
+
+Parity: /root/reference/python/paddle/fleet/base/role_maker.py. On TPU the
+coordination service replaces Gloo/MPI; these classes keep the env-var
+protocol (PaddleCloud convention) so launch scripts port unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+
+class RoleMakerBase:
+    def worker_index(self) -> int:
+        from ..  import worker_index
+
+        return worker_index()
+
+    def worker_num(self) -> int:
+        from .. import worker_num
+
+        return worker_num()
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Reads PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS env."""
+
+    def __init__(self, is_collective: bool = True):
+        self.is_collective = is_collective
+
+    def worker_index(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def worker_num(self) -> int:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return len(eps.split(",")) if eps else 1
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id: int = 0, worker_num: int = 1, role=None, worker_endpoints=None):
+        self._id = current_id
+        self._num = worker_num
+
+    def worker_index(self) -> int:
+        return self._id
+
+    def worker_num(self) -> int:
+        return self._num

@@ -80,6 +80,10 @@ class _CompiledBlock:
         self.keep_names = keep_names
         self.state_out_names = state_out_names
         self.fetch_names = fetch_names
+        # name -> NamedSharding when compiled over a mesh (else empty):
+        # scope arrays produced by an unsharded startup run are resharded
+        # on first use (device_put is a no-op when already placed right)
+        self.state_shardings: Dict[str, Any] = {}
 
 
 class Executor:
@@ -137,6 +141,11 @@ class Executor:
                         f"Variable {n!r} is used before initialization; "
                         f"run the startup program first."
                     )
+                target = compiled.state_shardings.get(n)
+                if target is not None and getattr(v, "sharding", None) != target:
+                    import jax
+
+                    v = jax.device_put(v, target)
                 d[n] = v
             return d
 
@@ -238,6 +247,43 @@ class Executor:
             next_key = jax.random.fold_in(ctx.rng_state, 0x5EED)
             return fetches, new_state, next_key
 
+        if mesh is not None:
+            # GSPMD path: every var maps to a NamedSharding (default
+            # replicated); XLA SPMD inserts the collectives. This replaces
+            # the reference's ParallelExecutor SSA-graph cloning + NCCL op
+            # handles (parallel_executor.cc:470, details/all_reduce_op_handle.cc).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            gblock = program.global_block()
+
+            def sh(name):
+                v = gblock._find_var_recursive(name)
+                spec = getattr(v, "_sharding", None) if v is not None else None
+                return NamedSharding(mesh, spec if spec is not None else PartitionSpec())
+
+            repl = NamedSharding(mesh, PartitionSpec())
+            in_shardings = (
+                {n: sh(n) for n in feed_names},
+                {n: sh(n) for n in donate_names},
+                {n: sh(n) for n in keep_names},
+                repl,
+            )
+            out_shardings = (
+                [sh(n) for n in fetch_names],
+                {n: sh(n) for n in state_out},
+                repl,
+            )
+            jit_fn = jax.jit(
+                fn,
+                donate_argnums=(1,),
+                in_shardings=in_shardings,
+                out_shardings=out_shardings,
+            )
+            cb = _CompiledBlock(
+                jit_fn, list(feed_names), donate_names, keep_names, state_out, fetch_names
+            )
+            cb.state_shardings = {n: sh(n) for n in donate_names + keep_names}
+            return cb
         jit_fn = jax.jit(fn, donate_argnums=(1,))
         return _CompiledBlock(
             jit_fn, list(feed_names), donate_names, keep_names, state_out, fetch_names

@@ -302,6 +302,26 @@ def build_bert_pretrain_program(
     return main, startup, feed_names, loss
 
 
+def tensor_parallel_rules():
+    """Megatron-style PartitionSpec rules for the encoder parameters:
+    QKV and FFN-in are column-parallel (shard output dim on "tp"), the
+    attention-output and FFN-out projections are row-parallel, and the
+    word embedding is vocab-sharded. XLA SPMD inserts the all-reduces the
+    reference would have needed explicit ops for — and tensor parallelism
+    itself is a capability the 2020 reference lacks (SURVEY.md §2.5)."""
+    col_w = (None, "tp")
+    row_w = ("tp", None)
+    return [
+        (r"_(query|key|value)_fc\.w_0$", col_w),
+        (r"_(query|key|value)_fc\.b_0$", ("tp",)),
+        (r"_output_fc\.w_0$", row_w),
+        (r"_ffn_fc_0\.w_0$", col_w),
+        (r"_ffn_fc_0\.b_0$", ("tp",)),
+        (r"_ffn_fc_1\.w_0$", row_w),
+        (r"^word_embedding$", row_w),  # vocab-sharded
+    ]
+
+
 def random_pretrain_batch(cfg: BertConfig, batch_size: int, seq_len: int, max_preds: int, seed: int = 0):
     """Synthetic data batch for benchmarking / tests."""
     import numpy as np

@@ -1,0 +1,80 @@
+"""Distributed front end: device meshes + sharding annotations (GSPMD).
+
+Capability parity with the reference's distributed stack (SURVEY.md §2.5):
+where the reference rewrites programs to insert NCCL collective ops
+(transpiler/collective.py, ir/multi_devices_graph_pass/) and runs
+per-device SSA graphs, the TPU build annotates Variables with
+jax.sharding.PartitionSpec and jits the whole train step over a
+jax.sharding.Mesh — XLA's SPMD partitioner inserts all collectives
+(all-reduce for replicated-param grads, all-gather/reduce-scatter for
+tensor parallel) on ICI/DCN automatically. ring_id -> mesh axis name.
+
+Axes convention: "dp" (data), "tp" (tensor/model), "pp" (pipeline stage),
+"sp" (sequence/context), "ep" (expert).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401
+
+
+def create_mesh(axes: Dict[str, int], devices=None):
+    """Build a jax.sharding.Mesh with named axes.
+
+    axes: ordered {axis_name: size}. Product must equal #devices used.
+    A size of -1 on exactly one axis means "fill with remaining devices".
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {len(devices)}")
+    dev_array = np.array(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def partition_spec(*axes):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*axes)
+
+
+def set_var_sharding(var, spec: Optional[Sequence[Optional[str]]]):
+    """Annotate a program Variable with a PartitionSpec (tuple of mesh axis
+    names / None per dim). The Executor turns this into NamedSharding on
+    the jitted step; unannotated vars default to replicated."""
+    from jax.sharding import PartitionSpec
+
+    if spec is not None and not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    var._sharding = spec
+    var.block.program._bump_version()  # invalidate executor compile cache
+
+
+def get_var_sharding(var):
+    return getattr(var, "_sharding", None)
+
+
+def shard_program_data_parallel(program, mesh, axis: str = "dp"):
+    """Mark every data (feed) variable as batch-sharded along `axis` —
+    the GSPMD analog of the reference's GradAllReduce transpile
+    (/root/reference/python/paddle/fluid/transpiler/collective.py:178):
+    with inputs sharded and parameters replicated, XLA emits the gradient
+    all-reduce on its own."""
+    for v in program.list_vars():
+        if getattr(v, "is_data", False) and v.shape:
+            set_var_sharding(v, (axis,) + (None,) * (len(v.shape) - 1))
+    program._mesh = mesh

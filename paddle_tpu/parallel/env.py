@@ -1,0 +1,52 @@
+"""Multi-host environment: rank/world discovery + coordination bootstrap.
+
+Replaces the reference's launcher env protocol (PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS, /root/reference/python/paddle/distributed/launch.py:193)
+and the NCCL-id gRPC rendezvous (c_gen_nccl_id_op.cc): on TPU the
+JAX distributed coordination service is the bootstrap — one
+jax.distributed.initialize() call per host, then every chip on every host
+appears in jax.devices() and XLA collectives ride ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def get_rank() -> int:
+    for k in ("PADDLE_TRAINER_ID", "JAX_PROCESS_ID", "RANK"):
+        if k in os.environ:
+            return int(os.environ[k])
+    return 0
+
+
+def get_world_size() -> int:
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if eps:
+        return len(eps.split(","))
+    if "JAX_NUM_PROCESSES" in os.environ:
+        return int(os.environ["JAX_NUM_PROCESSES"])
+    return 1
+
+
+def init_parallel_env() -> None:
+    """Initialize the JAX coordination service when launched multi-host
+    (paddle launcher env convention); single-process no-op."""
+    global _initialized
+    if _initialized:
+        return
+    world = get_world_size()
+    if world > 1:
+        import jax
+
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        coordinator = eps[0] if eps and eps[0] else None
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world,
+            process_id=get_rank(),
+        )
+    _initialized = True
