@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     dtypes,
     framework,
     initializer,
+    io,
     layers,
     optimizer,
     param_attr,

@@ -1,0 +1,271 @@
+"""Checkpoint / model IO.
+
+Parity surface: /root/reference/python/paddle/fluid/io.py —
+save_params:373, save_persistables:598, load_persistables:966,
+save_inference_model:1164, load_inference_model:1374, save:1669, load:1733.
+
+TPU-native design: the reference runs save/load **ops** through the
+executor (operators/save_op.cc) so checkpointing is graph execution; here
+persistable scope arrays are saved with Orbax (sharded-array aware — a
+TP/DP-sharded train state checkpoints and restores across different mesh
+shapes, the jax-native story the reference's per-pserver block checkpoints
+approximate). The "persistables by name" contract is preserved.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import framework
+from .executor import global_scope
+
+
+def _persistable_names(program) -> List[str]:
+    return [v.name for v in program.list_vars() if v.persistable]
+
+
+def _param_names(program) -> List[str]:
+    return [p.name for p in program.all_parameters()]
+
+
+def _save_arrays(dirname: str, names: List[str], scope, filename: Optional[str] = None):
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for n in names:
+        v = scope.find_var(n)
+        if v is None:
+            raise RuntimeError(f"variable {n!r} not found in scope; nothing to save")
+        arrays[n] = np.asarray(v)
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for n, a in arrays.items():
+            np.save(os.path.join(dirname, n.replace("/", "__slash__") + ".npy"), a)
+
+
+def _load_arrays(dirname: str, names: List[str], scope, filename: Optional[str] = None):
+    import jax.numpy as jnp
+
+    if filename is not None:
+        with np.load(os.path.join(dirname, filename)) as z:
+            found = {n: z[n] for n in names if n in z.files}
+            missing = [n for n in names if n not in z.files]
+    else:
+        found, missing = {}, []
+        for n in names:
+            p = os.path.join(dirname, n.replace("/", "__slash__") + ".npy")
+            if os.path.exists(p):
+                found[n] = np.load(p)
+            else:
+                missing.append(n)
+    if missing:
+        raise RuntimeError(f"checkpoint at {dirname!r} is missing variables: {missing}")
+    for n, a in found.items():
+        scope.set_var(n, jnp.asarray(a))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """reference io.py:373 — trainable parameters only."""
+    program = main_program or framework.default_main_program()
+    _save_arrays(dirname, _param_names(program), global_scope(), filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference io.py:598 — params + optimizer moments + LR etc."""
+    program = main_program or framework.default_main_program()
+    _save_arrays(dirname, _persistable_names(program), global_scope(), filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or framework.default_main_program()
+    _load_arrays(dirname, _param_names(program), global_scope(), filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    program = main_program or framework.default_main_program()
+    _load_arrays(dirname, _persistable_names(program), global_scope(), filename)
+
+
+# ---------------------------------------------------------------------------
+# inference model export: prune program to feed->fetch subgraph + params
+# ---------------------------------------------------------------------------
+
+
+def _prune_for_inference(program, feed_names: List[str], fetch_vars) -> "framework.Program":
+    """Backward slice from fetch vars, like the reference's prune
+    (io.py:1164 save_inference_model -> Program._prune_with_input)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    fetch_names = {v.name if isinstance(v, framework.Variable) else str(v) for v in fetch_vars}
+    needed = set(fetch_names)
+    keep: List[int] = []
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_names()):
+            keep.append(i)
+            needed.update(op.input_names())
+    keep_set = set(keep)
+    block.ops = [op for i, op in enumerate(block.ops) if i in keep_set]
+    return pruned
+
+
+def _serialize_program(program) -> bytes:
+    """Pickle the op list + var metas (the ProgramDesc analog; the C++
+    protobuf serializer arrives with the native runtime layer)."""
+    blocks = []
+    for b in program.blocks:
+        blocks.append(
+            {
+                "idx": b.idx,
+                "parent_idx": b.parent_idx,
+                "vars": {
+                    name: {
+                        "shape": v.shape,
+                        "dtype": str(np.dtype(v.dtype)) if v.dtype is not None else None,
+                        "persistable": v.persistable,
+                        "stop_gradient": v.stop_gradient,
+                        "is_data": v.is_data,
+                        "is_parameter": isinstance(v, framework.Parameter),
+                        "trainable": getattr(v, "trainable", False),
+                    }
+                    for name, v in b.vars.items()
+                },
+                "ops": [
+                    {
+                        "type": op.type,
+                        "inputs": op.inputs,
+                        "outputs": op.outputs,
+                        "attrs": {
+                            k: (("__block__", v.idx) if isinstance(v, framework.Block) else v)
+                            for k, v in op.attrs.items()
+                        },
+                    }
+                    for op in b.ops
+                ],
+            }
+        )
+    return pickle.dumps({"version": 1, "blocks": blocks})
+
+
+def _deserialize_program(data: bytes) -> "framework.Program":
+    payload = pickle.loads(data)
+    program = framework.Program()
+    program.blocks = []
+    for bd in payload["blocks"]:
+        blk = framework.Block(program, bd["idx"], bd["parent_idx"])
+        program.blocks.append(blk)
+    for bd, blk in zip(payload["blocks"], program.blocks):
+        for name, meta in bd["vars"].items():
+            cls = framework.Parameter if meta["is_parameter"] else framework.Variable
+            v = cls.__new__(cls)
+            v.block = blk
+            v.name = name
+            v.shape = tuple(meta["shape"]) if meta["shape"] is not None else None
+            v.dtype = np.dtype(meta["dtype"]) if meta["dtype"] else np.dtype("float32")
+            v.lod_level = 0
+            v.persistable = meta["persistable"]
+            v.stop_gradient = meta["stop_gradient"]
+            v.is_data = meta["is_data"]
+            v.trainable = meta.get("trainable", False)
+            v.op = None
+            if meta["is_parameter"]:
+                v.regularizer = None
+                v.need_clip = True
+                v.is_distributed = False
+                v.optimize_attr = {"learning_rate": 1.0}
+            blk.vars[name] = v
+        for od in bd["ops"]:
+            attrs = {
+                k: (program.blocks[v[1]] if isinstance(v, tuple) and len(v) == 2 and v[0] == "__block__" else v)
+                for k, v in od["attrs"].items()
+            }
+            op = framework.Operator(blk, od["type"], inputs=od["inputs"], outputs=od["outputs"], attrs=attrs)
+            blk.ops.append(op)
+            for n in op.output_names():
+                fv = blk._find_var_recursive(n)
+                if fv is not None:
+                    fv.op = op
+    program._bump_version()
+    return program
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names: List[str],
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+):
+    """reference io.py:1164 — prune to the inference subgraph + save params."""
+    program = main_program or framework.default_main_program()
+    pruned = _prune_for_inference(program, feeded_var_names, target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(_serialize_program(pruned))
+    fetch_names = [
+        v.name if isinstance(v, framework.Variable) else str(v) for v in target_vars
+    ]
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump({"feed_names": list(feeded_var_names), "fetch_names": fetch_names}, f)
+    # save only params reachable in the pruned graph
+    used = {n for op in pruned.global_block().ops for n in op.input_names()}
+    pnames = [n for n in _param_names(program) if n in used]
+    _save_arrays(dirname, pnames, global_scope(), params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+    """reference io.py:1374 — returns (program, feed_names, fetch_vars)."""
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        program = _deserialize_program(f.read())
+    with open(os.path.join(dirname, "__meta__.json")) as f:
+        meta = json.load(f)
+    used = {n for op in program.global_block().ops for n in op.input_names()}
+    pnames = [p.name for p in program.all_parameters() if p.name in used]
+    _load_arrays(dirname, pnames, global_scope(), params_filename)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# new-style whole-state save/load (reference io.py:1669/1733) — Orbax-backed
+# sharded checkpointing for distributed train state
+# ---------------------------------------------------------------------------
+
+
+def save(program, model_path: str):
+    """Orbax sharded checkpoint of all persistables (+ program text)."""
+    import orbax.checkpoint as ocp
+
+    scope = global_scope()
+    state = {}
+    for n in _persistable_names(program):
+        v = scope.find_var(n)
+        if v is not None:
+            state[n.replace("/", "__slash__")] = v
+    path = os.path.abspath(model_path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path + ".ckpt", state, force=True)
+    ckptr.wait_until_finished()
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(_serialize_program(program))
+
+
+def load(program, model_path: str, executor=None):
+    import jax
+    import orbax.checkpoint as ocp
+
+    scope = global_scope()
+    path = os.path.abspath(model_path)
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path + ".ckpt")
+    for n, a in restored.items():
+        scope.set_var(n.replace("__slash__", "/"), jax.numpy.asarray(a))

@@ -1,0 +1,74 @@
+"""Checkpoint/IO roundtrips (reference io.py surface, SURVEY.md §5)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _small_model():
+    x = fluid.layers.data("x", [4, 8], dtype="float32", append_batch_size=False)
+    y = fluid.layers.data("y", [4, 1], dtype="float32", append_batch_size=False)
+    h = fluid.layers.fc(x, 16, act="relu")
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    x, y, pred, loss = _small_model()
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-2)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.random.randn(4, 8).astype("float32"), "y": np.ones((4, 1), "float32")}
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+
+    d = str(tmp_path / "ckpt")
+    fluid.io.save_persistables(exe, d)
+    (l_before,) = exe.run(feed=feed, fetch_list=[loss])
+
+    # scramble a param, then restore
+    scope = fluid.global_scope()
+    p0 = fluid.default_main_program().all_parameters()[0].name
+    scope.set_var(p0, np.zeros_like(np.asarray(scope.find_var(p0))))
+    (l_scrambled,) = exe.run(feed=feed, fetch_list=[loss])
+    assert not np.allclose(l_scrambled, l_before)
+
+    fluid.io.load_persistables(exe, d)
+    (l_after,) = exe.run(feed=feed, fetch_list=[loss])
+    np.testing.assert_allclose(np.asarray(l_after), np.asarray(l_before), rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    x, y, pred, loss = _small_model()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.randn(4, 8).astype("float32")
+    (ref,) = exe.run(feed={"x": xv, "y": np.zeros((4, 1), "float32")}, fetch_list=[pred])
+
+    d = str(tmp_path / "infer")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+
+    # fresh scope: load and run the pruned program
+    with fluid.scope_guard(fluid.executor.Scope()):
+        prog, feed_names, fetch_vars = fluid.io.load_inference_model(d, exe)
+        assert feed_names == ["x"]
+        (out,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_orbax_save_load(tmp_path):
+    x, y, pred, loss = _small_model()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.random.randn(4, 8).astype("float32"), "y": np.ones((4, 1), "float32")}
+    (ref,) = exe.run(feed=feed, fetch_list=[pred])
+    prog = fluid.default_main_program()
+    fluid.io.save(prog, str(tmp_path / "model"))
+
+    scope = fluid.global_scope()
+    for p in prog.all_parameters():
+        scope.set_var(p.name, np.zeros_like(np.asarray(scope.find_var(p.name))))
+    fluid.io.load(prog, str(tmp_path / "model"))
+    (out,) = exe.run(feed=feed, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
