@@ -16,7 +16,22 @@ from . import (  # noqa: F401
     regularizer,
     unique_name,
 )
+from . import learning_rate_scheduler, metrics  # noqa: F401
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+
+# reference exposes schedules under fluid.layers.* too
+for _n in (
+    "noam_decay",
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "cosine_decay",
+    "linear_lr_warmup",
+):
+    setattr(layers, _n, getattr(learning_rate_scheduler, _n))
+del _n
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .framework import (  # noqa: F401
     Program,

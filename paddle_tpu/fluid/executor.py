@@ -219,27 +219,7 @@ class Executor:
             env.update(kept_vals)
             env.update(donated_vals)
             env.update(feed_vals)
-            for op in ops:
-                spec = registry.get(op.type)
-                ins = {}
-                for slot, names in op.inputs.items():
-                    vals = []
-                    for n in names:
-                        if n not in env:
-                            raise RuntimeError(
-                                f"op {op.type}: input var {n!r} not produced "
-                                f"nor fed nor in scope"
-                            )
-                        vals.append(env[n])
-                    if vals:
-                        ins[slot] = vals
-                outs = spec.emit(ctx, ins, op.attrs)
-                for slot, names in op.outputs.items():
-                    vals = outs.get(slot)
-                    if vals is None:
-                        continue
-                    for n, v in zip(names, vals):
-                        env[n] = v
+            registry.emit_ops(ctx, ops, env)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in state_out}
             # advance the scope key even if no op split it, so salted_rng

@@ -1,5 +1,6 @@
 """fluid.layers namespace. Parity: python/paddle/fluid/layers/__init__.py."""
-from . import nn, ops, tensor  # noqa: F401
+from . import control_flow, nn, ops, tensor  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401

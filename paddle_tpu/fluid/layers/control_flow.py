@@ -1,0 +1,208 @@
+"""Control-flow layers: cond, while_loop, Switch/case helpers.
+
+Parity surface: /root/reference/python/paddle/fluid/layers/control_flow.py
+(cond, while_loop, While, Switch, increment, array ops). The TPU build
+SSA-ifies sub-blocks at graph-build time: captured outer variables are
+collected as explicit op inputs so the emitters can lower to
+lax.cond / lax.while_loop (compiler-friendly control flow; no per-step
+scopes at runtime).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from .. import framework
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+
+def _captured_inputs(blocks, exclude: Sequence[str] = ()) -> List[str]:
+    """Var names read by ops in `blocks` (recursively through block attrs)
+    but created outside them — the SSA captures. Unique-name generation
+    guarantees no shadowing, so "created inside" == present in a traced
+    block's var map."""
+    inside = set()
+
+    def collect_inside(blk):
+        inside.update(blk.vars)
+        for op in blk.ops:
+            for a in op.attrs.values():
+                if isinstance(a, framework.Block):
+                    collect_inside(a)
+
+    for b in blocks:
+        collect_inside(b)
+
+    captured: List[str] = []
+    seen = set(exclude) | inside
+
+    def walk(blk):
+        for op in blk.ops:
+            for n in op.input_names():
+                if n not in seen:
+                    seen.add(n)
+                    captured.append(n)
+            for a in op.attrs.values():
+                if isinstance(a, framework.Block):
+                    walk(a)
+
+    for b in blocks:
+        walk(b)
+    return captured
+
+
+def _as_var_list(x):
+    if x is None:
+        return []
+    if isinstance(x, Variable):
+        return [x]
+    return list(x)
+
+
+def cond(pred, true_fn: Optional[Callable] = None, false_fn: Optional[Callable] = None, name=None):
+    """reference layers/control_flow.py cond -> HLO Conditional.
+
+    true_fn/false_fn take no args and return a Variable or (nested) list of
+    Variables with matching shapes/dtypes."""
+    prog = default_main_program()
+
+    true_block = prog._create_block()
+    true_out = true_fn() if true_fn is not None else None
+    prog._rollback()
+    false_block = prog._create_block()
+    false_out = false_fn() if false_fn is not None else None
+    prog._rollback()
+
+    t_list, f_list = _as_var_list(true_out), _as_var_list(false_out)
+    if len(t_list) != len(f_list):
+        raise ValueError(
+            f"cond branches must return the same number of outputs "
+            f"({len(t_list)} vs {len(f_list)})"
+        )
+
+    captured = _captured_inputs([true_block, false_block])
+    helper = LayerHelper("cond", name=name)
+    parent = prog.current_block()
+    out_vars = [
+        parent.create_var(
+            shape=v.shape, dtype=v.dtype, stop_gradient=v.stop_gradient
+        )
+        for v in t_list
+    ]
+    inputs = {"Cond": [pred]}
+    if captured:
+        inputs["Input"] = captured
+    parent.append_op(
+        type="cond",
+        inputs=inputs,
+        outputs={"Out": out_vars},
+        attrs={
+            "true_block": true_block,
+            "false_block": false_block,
+            "true_out_names": [v.name for v in t_list],
+            "false_out_names": [v.name for v in f_list],
+            "captured_names": captured,
+        },
+        infer=False,  # shapes already copied from the true branch
+    )
+    if true_out is None:
+        return None
+    if isinstance(true_out, Variable):
+        return out_vars[0]
+    return out_vars
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence[Variable], is_test=False, name=None):
+    """reference layers/control_flow.py while_loop -> HLO While.
+
+    Carried state is exactly `loop_vars` (SSA: body returns the next
+    values); captured outer vars are loop-invariant."""
+    prog = default_main_program()
+    loop_vars = list(loop_vars)
+    loop_names = [v.name for v in loop_vars]
+
+    cond_block = prog._create_block()
+    c_out = cond_fn(*loop_vars)
+    prog._rollback()
+    body_block = prog._create_block()
+    b_out = body_fn(*loop_vars)
+    prog._rollback()
+
+    b_list = _as_var_list(b_out)
+    if len(b_list) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body must return {len(loop_vars)} values, got {len(b_list)}"
+        )
+
+    captured = [
+        n
+        for n in _captured_inputs([cond_block, body_block])
+        if n not in set(loop_names)
+    ]
+    parent = prog.current_block()
+    out_vars = [
+        parent.create_var(shape=v.shape, dtype=v.dtype, stop_gradient=True)
+        for v in loop_vars
+    ]
+    inputs = {"LoopVars": loop_vars}
+    if captured:
+        inputs["Input"] = captured
+    parent.append_op(
+        type="while_loop",
+        inputs=inputs,
+        outputs={"Out": out_vars},
+        attrs={
+            "cond_block": cond_block,
+            "body_block": body_block,
+            "loop_var_names": loop_names,
+            "cond_out_name": c_out.name,
+            "body_out_names": [v.name for v in b_list],
+            "captured_names": captured,
+        },
+        infer=False,
+    )
+    return out_vars
+
+
+class Switch:
+    """reference layers/control_flow.py Switch — sugar over nested cond.
+    Usage:
+        with Switch() as switch:
+            with switch.case(cond1): ... assign to out ...
+            with switch.default(): ...
+    Only the assignment-free functional style is supported: each case body
+    must write the SAME set of vars via layers.assign(x, out)."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "Switch requires scope-mutation semantics; use layers.cond / "
+            "layers.case instead (functional control flow)"
+        )
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference layers.case: first matching pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default))
+    if default is None:
+        return cond(pred, fn, fn)
+    return cond(pred, fn, default)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference layers.switch_case."""
+    from . import tensor as tensor_layers
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    pairs = []
+    for idx, fn in items:
+        idx_var = tensor_layers.fill_constant([1], branch_index.dtype, float(idx))
+        pairs.append((tensor_layers.equal(branch_index, idx_var), fn))
+    return case(pairs, default=default or items[-1][1])

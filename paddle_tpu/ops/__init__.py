@@ -7,6 +7,7 @@ from . import registry  # noqa: F401
 from . import (  # noqa: F401
     attention,
     compare_ops,
+    control_flow_ops,
     creation,
     manipulation,
     math_ops,

@@ -1,0 +1,98 @@
+"""Control-flow ops: cond (lax.cond) and while (lax.while_loop).
+
+Parity: /root/reference/paddle/fluid/operators/controlflow/
+(conditional_block_op.cc, while_op.cc). The reference interprets
+sub-blocks with per-step Scopes; here sub-blocks are SSA-ified —
+captured outer vars become explicit operands, block-carried state becomes
+lax loop carries — so the whole construct compiles into HLO
+Conditional/While (SURVEY.md §7 "hard parts": per-step scopes -> SSA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .registry import register
+
+
+def _scalar_pred(p):
+    p = jnp.asarray(p)
+    if p.ndim > 0:
+        p = p.reshape(())
+    return p.astype(jnp.bool_)
+
+
+def _cond_infer(in_metas, attrs):
+    blk = attrs["true_block"]
+    metas = []
+    for n in attrs["true_out_names"]:
+        v = blk._find_var_recursive(n)
+        metas.append((v.shape, v.dtype))
+    return {"Out": metas}
+
+
+@register("cond", infer_shape=_cond_infer)
+def cond_op(ctx, ins, attrs):
+    captured = list(attrs["captured_names"])
+    cap_vals = list(ins.get("Input", []))
+    t_blk, f_blk = attrs["true_block"], attrs["false_block"]
+    t_outs, f_outs = attrs["true_out_names"], attrs["false_out_names"]
+
+    def make_branch(blk, out_names):
+        def f(cap):
+            env = dict(zip(captured, cap))
+            registry.emit_ops(ctx, blk.ops, env)
+            return tuple(env[n] for n in out_names)
+
+        return f
+
+    outs = jax.lax.cond(
+        _scalar_pred(ins["Cond"][0]),
+        make_branch(t_blk, t_outs),
+        make_branch(f_blk, f_outs),
+        tuple(cap_vals),
+    )
+    return {"Out": list(outs)}
+
+
+def _while_infer(in_metas, attrs):
+    return {"Out": list(in_metas.get("LoopVars", []))}
+
+
+@register("while_loop", infer_shape=_while_infer, no_vjp_grad=True)
+def while_loop_op(ctx, ins, attrs):
+    """inputs: LoopVars (carried state), Input (captured constants).
+    attrs: cond_block/body_block, loop_var_names (names the blocks use for
+    the carries), cond_out_name, body_out_names, captured_names."""
+    captured = dict(zip(attrs["captured_names"], ins.get("Input", [])))
+    loop_names = list(attrs["loop_var_names"])
+    cond_blk, body_blk = attrs["cond_block"], attrs["body_block"]
+
+    def cond_fn(carry):
+        env = dict(captured)
+        env.update(zip(loop_names, carry))
+        registry.emit_ops(ctx, cond_blk.ops, env)
+        return _scalar_pred(env[attrs["cond_out_name"]])
+
+    def body_fn(carry):
+        env = dict(captured)
+        env.update(zip(loop_names, carry))
+        registry.emit_ops(ctx, body_blk.ops, env)
+        out = []
+        for init, name in zip(carry, attrs["body_out_names"]):
+            v = env[name]
+            out.append(jnp.asarray(v, jnp.asarray(init).dtype))
+        return tuple(out)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["LoopVars"]))
+    return {"Out": list(final)}
+
+
+@register("select_input", infer_shape=lambda m, a: {"Out": [m["X"][0]]})
+def select_input(ctx, ins, attrs):
+    """Out = X[Mask] — reference controlflow/select_input_op."""
+    mask = _scalar_pred(ins["Mask"][0]).astype(jnp.int32)
+    xs = ins["X"]
+    out = jax.lax.switch(jnp.clip(mask, 0, len(xs) - 1), [lambda x=x: x for x in xs])
+    return {"Out": [out]}

@@ -199,6 +199,41 @@ def _make_generic_grad_emit(base: OpSpec):
 
 
 # ---------------------------------------------------------------------------
+# block emission: shared by the Executor's whole-block trace and by
+# control-flow op emitters (cond/while) that recursively evaluate sub-blocks
+# ---------------------------------------------------------------------------
+
+
+def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
+    """Trace a list of framework Operators into JAX values. `env` maps var
+    name -> value and is mutated in place (op outputs land there)."""
+    for op in ops:
+        spec = get(op.type)
+        if spec is None:
+            raise KeyError(f"op {op.type!r} has no registered emitter")
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if n not in env:
+                    raise RuntimeError(
+                        f"op {op.type}: input var {n!r} not produced, fed, "
+                        f"captured, nor in scope"
+                    )
+                vals.append(env[n])
+            if vals:
+                ins[slot] = vals
+        outs = spec.emit(ctx, ins, op.attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                env[n] = v
+    return env
+
+
+# ---------------------------------------------------------------------------
 # abstract evaluation (shape/dtype inference service for framework.py)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,106 @@
+"""cond / while_loop / case lowering to lax control flow."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(fetch, feed=None):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetch)
+
+
+def test_cond_basic():
+    x = fluid.data("x", [1], "float32")
+    a = layers.fill_constant([2], "float32", 2.0)
+    b = layers.fill_constant([2], "float32", 5.0)
+    pred = layers.less_than(x, layers.fill_constant([1], "float32", 0.0))
+    out = layers.cond(pred, lambda: layers.elementwise_add(a, b), lambda: layers.elementwise_mul(a, b))
+    (r_neg,) = _run([out], feed={"x": np.array([-1.0], "float32")})
+    np.testing.assert_allclose(r_neg, [7.0, 7.0])
+    (r_pos,) = _run([out], feed={"x": np.array([1.0], "float32")})
+    np.testing.assert_allclose(r_pos, [10.0, 10.0])
+
+
+def test_cond_captures_outer_and_params():
+    x = fluid.data("x", [1], "float32")
+    y = layers.scale(x, scale=3.0)  # outer computed var captured by branch
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    out = layers.cond(pred, lambda: layers.scale(y, 2.0), lambda: layers.scale(y, -1.0))
+    (r,) = _run([out], feed={"x": np.array([2.0], "float32")})
+    np.testing.assert_allclose(r, [12.0])
+
+
+def test_cond_gradient_flows():
+    x = fluid.data("x", [1], "float32")
+    x.stop_gradient = False
+    w = layers.create_parameter([1], "float32", name="w_cond")
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    out = layers.cond(
+        pred,
+        lambda: layers.elementwise_mul(x, w),
+        lambda: layers.elementwise_add(x, w),
+    )
+    loss = layers.reduce_mean(out)
+    grads = fluid.gradients([loss], [w])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (g,) = exe.run(feed={"x": np.array([3.0], "float32")}, fetch_list=[grads[0]])
+    np.testing.assert_allclose(g, [3.0])  # d(x*w)/dw = x
+
+
+def test_while_loop_sum():
+    i = layers.fill_constant([1], "float32", 0.0)
+    acc = layers.fill_constant([1], "float32", 0.0)
+    ten = layers.fill_constant([1], "float32", 10.0)
+
+    def cond_fn(i, acc):
+        return layers.less_than(i, ten)
+
+    def body_fn(i, acc):
+        return [layers.increment(i, 1.0, in_place=False), layers.elementwise_add(acc, i)]
+
+    i_out, acc_out = layers.while_loop(cond_fn, body_fn, [i, acc])
+    r_i, r_acc = _run([i_out, acc_out])
+    np.testing.assert_allclose(r_i, [10.0])
+    np.testing.assert_allclose(r_acc, [45.0])  # 0+1+...+9
+
+
+def test_case_multiway():
+    x = fluid.data("x", [1], "float32")
+    zero = layers.fill_constant([1], "float32", 0.0)
+    hundred = layers.fill_constant([1], "float32", 100.0)
+    out = layers.case(
+        [
+            (layers.less_than(x, zero), lambda: layers.fill_constant([1], "float32", -1.0)),
+            (layers.greater_than(x, hundred), lambda: layers.fill_constant([1], "float32", 2.0)),
+        ],
+        default=lambda: layers.fill_constant([1], "float32", 0.5),
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for xv, expect in [(-5.0, -1.0), (500.0, 2.0), (50.0, 0.5)]:
+        (r,) = exe.run(feed={"x": np.array([xv], "float32")}, fetch_list=[out])
+        np.testing.assert_allclose(r, [expect])
+
+
+def test_lr_schedulers_values():
+    import math
+
+    lr = fluid.layers.noam_decay(d_model=64, warmup_steps=10, learning_rate=1.0)
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=lr)
+    x = fluid.data("x", [1], "float32")
+    w = layers.create_parameter([1], "float32", name="w_lr")
+    loss = layers.reduce_mean(layers.elementwise_mul(x, w))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    got = []
+    for _ in range(3):
+        (lv,) = exe.run(feed={"x": np.ones([1], "float32")}, fetch_list=[lr])
+        got.append(float(np.asarray(lv).reshape(())))
+    expect = [
+        64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 10 ** -1.5) for s in range(3)
+    ]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
