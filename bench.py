@@ -54,6 +54,7 @@ def main():
     )
 
     cfg = BertConfig.base()
+    cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
     batch = int(os.environ.get("BENCH_BATCH", 8))
     seq = int(os.environ.get("BENCH_SEQ", 512))
     max_preds = 76

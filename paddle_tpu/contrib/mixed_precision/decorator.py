@@ -1,0 +1,198 @@
+"""AMP optimizer decorator.
+
+Parity: /root/reference/python/paddle/fluid/contrib/mixed_precision/decorator.py
+(decorate:218, OptimizerWithMixedPrecision:27, backward:112).
+
+bfloat16 is the TPU default (no loss scaling: bf16 has the f32 exponent
+range). float16 mode keeps the reference's dynamic loss-scaling protocol:
+scale the loss, unscale grads, detect inf/nan, grow/shrink the scale, and
+zero the grads on overflow so the whole step stays one XLA program
+(branch-free; the reference conditionally skips the update instead).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...fluid import framework, layers
+from ...fluid.initializer import ConstantInitializer
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(
+        self,
+        optimizer,
+        amp_lists: Optional[AutoMixedPrecisionLists] = None,
+        init_loss_scaling: float = 2.0 ** 15,
+        use_dynamic_loss_scaling: bool = True,
+        incr_every_n_steps: int = 1000,
+        decr_every_n_nan_or_inf: int = 2,
+        incr_ratio: float = 2.0,
+        decr_ratio: float = 0.8,
+        use_bf16: bool = True,
+    ):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._dest_dtype = "bfloat16" if use_bf16 else "float16"
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling and not use_bf16
+        self._init_loss_scaling = init_loss_scaling if not use_bf16 else 1.0
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._loss_scaling = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def _create_scaling_state(self):
+        def persist(name, value):
+            main_block = framework.default_main_program().global_block()
+            v = main_block.create_var(
+                name=name, shape=(1,), dtype="float32", persistable=True
+            )
+            sblock = framework.default_startup_program().global_block()
+            sv = sblock.create_var(
+                name=name, shape=(1,), dtype="float32", persistable=True
+            )
+            ConstantInitializer(value)(sv, sblock)
+            return v
+
+        from ...fluid import unique_name
+
+        self._loss_scaling = persist(
+            unique_name.generate("loss_scaling"), self._init_loss_scaling
+        )
+        if self._use_dynamic_loss_scaling:
+            self._good_steps = persist(unique_name.generate("good_steps"), 0.0)
+            self._bad_steps = persist(unique_name.generate("bad_steps"), 0.0)
+
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None, callbacks=None):
+        program = loss.block.program
+        rewrite_program(program, self._amp_lists, self._dest_dtype)
+        self._create_scaling_state()
+        with framework.program_guard(program, startup_program or framework.default_startup_program()):
+            scaled_loss = layers.elementwise_mul(loss, self._loss_scaling)
+        params_grads = self._optimizer.backward(
+            scaled_loss, startup_program, parameter_list, no_grad_set, callbacks
+        )
+        return scaled_loss, params_grads
+
+    def apply_gradients(self, params_grads):
+        return self._apply(params_grads)
+
+    def _apply(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        with framework.program_guard(
+            params_grads[0][0].block.program, framework.default_startup_program()
+        ):
+            inv = layers.elementwise_div(
+                layers.fill_constant([1], "float32", 1.0), self._loss_scaling
+            )
+            # found_inf = any grad non-finite (after cast to f32)
+            found_inf = layers.fill_constant([1], "bool", 0.0)
+            new_pgs = []
+            for p, g in params_grads:
+                if g is None:
+                    new_pgs.append((p, g))
+                    continue
+                g32 = layers.cast(g, "float32") if str(g.dtype) != "float32" else g
+                bad = layers.logical_not(
+                    layers.reduce_all(layers.isfinite_v2(g32))
+                )
+                found_inf = layers.logical_or(found_inf, bad)
+                new_pgs.append((p, g32))
+            keep = layers.cast(layers.logical_not(found_inf), "float32")
+            final = []
+            for p, g in new_pgs:
+                if g is None:
+                    final.append((p, g))
+                    continue
+                g = layers.elementwise_mul(g, layers.elementwise_mul(inv, keep))
+                final.append((p, g))
+            if self._use_dynamic_loss_scaling:
+                self._update_loss_scaling(found_inf)
+            return self._optimizer.apply_gradients(final)
+
+    def _update_loss_scaling(self, found_inf):
+        """Branch-free grow/shrink of the scale (reference
+        fp16_utils.update_loss_scaling:333 semantics)."""
+        bad = layers.cast(found_inf, "float32")
+        good = layers.scale(bad, scale=-1.0, bias=1.0)
+        # counters
+        new_good = layers.elementwise_mul(
+            layers.increment(self._good_steps, 1.0, in_place=False), good
+        )
+        new_bad = layers.elementwise_mul(
+            layers.increment(self._bad_steps, 1.0, in_place=False), bad
+        )
+        grow = layers.cast(
+            layers.greater_equal(
+                new_good, layers.fill_constant([1], "float32", float(self._incr_every_n_steps))
+            ),
+            "float32",
+        )
+        shrink = layers.cast(
+            layers.greater_equal(
+                new_bad, layers.fill_constant([1], "float32", float(self._decr_every_n_nan_or_inf))
+            ),
+            "float32",
+        )
+        factor = (
+            1.0
+            + grow * (self._incr_ratio - 1.0)
+        )
+        factor = layers.elementwise_mul(
+            factor, layers.scale(shrink, scale=self._decr_ratio - 1.0, bias=1.0)
+        )
+        new_scale = layers.elementwise_mul(self._loss_scaling, factor)
+        layers.assign(new_scale, self._loss_scaling)
+        # reset counters when they fire
+        layers.assign(
+            layers.elementwise_mul(new_good, layers.scale(grow, scale=-1.0, bias=1.0)),
+            self._good_steps,
+        )
+        layers.assign(
+            layers.elementwise_mul(new_bad, layers.scale(shrink, scale=-1.0, bias=1.0)),
+            self._bad_steps,
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        scaled_loss, params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        with framework.program_guard(
+            loss.block.program,
+            startup_program or framework.default_startup_program(),
+        ):
+            optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(
+    optimizer,
+    amp_lists=None,
+    init_loss_scaling=2.0 ** 15,
+    incr_every_n_steps=1000,
+    decr_every_n_nan_or_inf=2,
+    incr_ratio=2.0,
+    decr_ratio=0.8,
+    use_dynamic_loss_scaling=True,
+    use_bf16=True,
+):
+    """reference decorator.py:218 — wrap an optimizer with AMP."""
+    return OptimizerWithMixedPrecision(
+        optimizer,
+        amp_lists=amp_lists,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio,
+        decr_ratio=decr_ratio,
+        use_bf16=use_bf16,
+    )

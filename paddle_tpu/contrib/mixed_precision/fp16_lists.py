@@ -1,0 +1,54 @@
+"""AMP op lists.
+
+Parity: /root/reference/python/paddle/fluid/contrib/mixed_precision/fp16_lists.py.
+White = compute in low precision (MXU ops), black = keep float32
+(reductions / loss / normalization statistics), gray = follow neighbors
+(here: left untouched; mixed-dtype elementwise promotes to f32 naturally).
+"""
+from __future__ import annotations
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
+
+
+white_list = {
+    "matmul",
+    "matmul_v2",
+    "mul",
+    "conv2d",
+    "conv3d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+    "fused_multihead_attention",
+    "fc",
+}
+
+black_list = {
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "cross_entropy2",
+    "layer_norm",
+    "batch_norm",
+    "group_norm",
+    "instance_norm",
+    "reduce_sum",
+    "reduce_mean",
+    "mean",
+    "sum",
+    "softmax",
+    "log_softmax",
+    "exp",
+    "square",
+    "sigmoid_cross_entropy_with_logits",
+    "bce_loss",
+    "squared_l2_norm",
+}

@@ -43,6 +43,9 @@ class BertConfig:
     type_vocab_size: int = 2
     initializer_range: float = 0.02
     use_flash_attention: bool = True
+    # scan over stacked layer params (fused_encoder_stack op): O(1)-in-depth
+    # compile time; param names become encoder_stack.* instead of per-layer
+    fuse_stack: bool = False
 
     @staticmethod
     def base() -> "BertConfig":
@@ -183,10 +186,65 @@ def bert_encoder(
     attn_bias = layers.scale(mask_f, scale=1e4, bias=-1e4)  # 1e4*(mask-1)
     attn_bias = layers.unsqueeze(layers.unsqueeze(attn_bias, [1]), [1])  # [B,1,1,S]
 
+    if cfg.fuse_stack:
+        return _encoder_stack(cfg, emb, attn_bias, is_test)
     hidden = emb
     for i in range(cfg.num_hidden_layers):
         hidden = encoder_layer(cfg, hidden, attn_bias, f"encoder_layer_{i}", is_test)
     return hidden
+
+
+def _encoder_stack(cfg: BertConfig, hidden, attn_bias, is_test: bool):
+    """Scan-based stack (ops/encoder_stack.py): stacked [L, ...] params."""
+    from ..fluid.layer_helper import LayerHelper
+    from ..fluid.layers.nn import _rng_salt_counter
+
+    L, h, f = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+    helper = LayerHelper("fused_encoder_stack")
+
+    def param(name, shape, init=None):
+        return helper.create_parameter(
+            ParamAttr(
+                name=f"encoder_stack.{name}",
+                initializer=init or TruncatedNormalInitializer(scale=cfg.initializer_range),
+            ),
+            shape=shape,
+            dtype="float32",
+        )
+
+    ones = ConstantInitializer(1.0)
+    zeros = ConstantInitializer(0.0)
+    p = {
+        "QKVW": param("qkv_w", [L, h, 3 * h]),
+        "QKVB": param("qkv_b", [L, 3 * h], zeros),
+        "OutW": param("out_w", [L, h, h]),
+        "OutB": param("out_b", [L, h], zeros),
+        "Ln1S": param("ln1_scale", [L, h], ones),
+        "Ln1B": param("ln1_bias", [L, h], zeros),
+        "FfnW1": param("ffn_w1", [L, h, f]),
+        "FfnB1": param("ffn_b1", [L, f], zeros),
+        "FfnW2": param("ffn_w2", [L, f, h]),
+        "FfnB2": param("ffn_b2", [L, h], zeros),
+        "Ln2S": param("ln2_scale", [L, h], ones),
+        "Ln2B": param("ln2_bias", [L, h], zeros),
+    }
+    out = helper.create_variable_for_type_inference("float32")
+    _rng_salt_counter[0] += 1
+    helper.append_op(
+        type="fused_encoder_stack",
+        inputs={"Hidden": [hidden], "AttnBias": [attn_bias], **{k: [v] for k, v in p.items()}},
+        outputs={"Out": [out]},
+        attrs={
+            "num_heads": cfg.num_attention_heads,
+            "act": cfg.hidden_act,
+            "dropout_prob": cfg.hidden_dropout_prob,
+            "attn_dropout_prob": cfg.attention_probs_dropout_prob,
+            "is_test": is_test,
+            "use_flash_attention": cfg.use_flash_attention,
+            "rng_salt": _rng_salt_counter[0],
+        },
+    )
+    return out
 
 
 def bert_pooler(cfg: BertConfig, sequence_output):

@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     compare_ops,
     control_flow_ops,
     creation,
+    encoder_stack,
     manipulation,
     math_ops,
     nn_ops,

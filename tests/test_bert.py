@@ -22,10 +22,11 @@ def _build(cfg, b, s, mp):
     return main, startup, feeds, loss
 
 
-@pytest.mark.parametrize("use_flash", [False, True])
-def test_bert_tiny_loss_decreases(use_flash):
+@pytest.mark.parametrize("use_flash,fuse_stack", [(False, False), (True, False), (False, True)])
+def test_bert_tiny_loss_decreases(use_flash, fuse_stack):
     cfg = BertConfig.tiny()
     cfg.use_flash_attention = use_flash
+    cfg.fuse_stack = fuse_stack
     b, s, mp = 2, 64, 4
     main, startup, feeds, loss = _build(cfg, b, s, mp)
     exe = fluid.Executor()
