@@ -392,3 +392,12 @@ def increment(x, value=1.0, in_place=True):
         type="increment", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"step": float(value)}
     )
     return out
+
+
+def isfinite_v2(x, name=None):
+    """Elementwise finite test (op isfinite_v2); reference isfinite reduces."""
+    helper = LayerHelper("isfinite_v2")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isfinite_v2", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.stop_gradient = True
+    return out
