@@ -1,0 +1,75 @@
+"""Fleet GSPMD distributed training on the 8-device virtual CPU mesh.
+
+Mirrors the reference's collective tests (test_dist_base.py pattern,
+SURVEY.md §4.3) without subprocesses: the virtual mesh exercises real
+XLA SPMD partitioning + collectives.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fleet as fleet
+from paddle_tpu.fluid import layers
+
+
+def _build(seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [16, 8], "float32")
+        y = fluid.data("y", [16, 1], "float32")
+        h = layers.fc(x, 32, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(16, 8).astype("float32"), "y": rng.randn(16, 1).astype("float32")}
+
+
+def _train(mesh_axes, steps=5, tp_rules=None, seed=7):
+    main, startup, loss = _build(seed)
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            strategy = fleet.DistributedStrategy()
+            strategy.mesh_axes = mesh_axes
+            if tp_rules:
+                strategy.tensor_parallel = True
+                strategy.tensor_parallel_rules = tp_rules
+            fleet.init()
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.AdamOptimizer(1e-2), strategy
+            )
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = []
+        for i in range(steps):
+            (lv,) = exe.run(main, feed=_feed(i), fetch_list=[loss])
+            out.append(float(np.asarray(lv).reshape(())))
+    return out
+
+
+def test_dp8_matches_single_device():
+    import jax
+
+    assert jax.device_count() == 8
+    single = _train({"dp": 1})
+    dp8 = _train({"dp": 8})
+    np.testing.assert_allclose(single, dp8, rtol=2e-5)
+
+
+def test_dp_times_tp_matches_single_device():
+    tp_rules = [
+        # column-parallel first fc, row-parallel second
+        (r"^fc_0\.w_0$", (None, "tp")),
+        (r"^fc_0\.b_0$", ("tp",)),
+        (r"^fc_1\.w_0$", ("tp", None)),
+    ]
+    single = _train({"dp": 1})
+    dptp = _train({"dp": 4, "tp": 2}, tp_rules=tp_rules)
+    np.testing.assert_allclose(single, dptp, rtol=2e-5)
