@@ -7,6 +7,7 @@ from . import (  # noqa: F401
     backward,
     clip,
     dtypes,
+    dygraph,
     framework,
     initializer,
     io,

@@ -1,0 +1,29 @@
+"""Dygraph (imperative/eager) mode.
+
+Parity surface: /root/reference/python/paddle/fluid/dygraph/ — guard,
+to_variable, Layer, nn layers, no_grad, save/load_dygraph, jit tracing.
+Eager execution runs the same op emitters per-op under jax (each gets
+jax's own per-op jit cache); training at scale should use the static
+Program path, which compiles whole steps (reference parity: dygraph is
+the development/debug mode there too).
+"""
+from . import nn  # noqa: F401
+from .base import (  # noqa: F401
+    VarBase,
+    Tracer,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)

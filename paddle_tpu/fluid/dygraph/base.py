@@ -1,0 +1,286 @@
+"""Dygraph core: VarBase, Tracer (eager execution + tape autograd).
+
+Parity surface: /root/reference/paddle/fluid/imperative/
+(Tracer::TraceOp tracer.cc:45, VarBase layer.h:56, BasicEngine::Execute
+basic_engine.cc:161, GradientAccumulator).
+
+TPU-native design: eager mode IS jax eager — each traced op calls the
+same registered emitter the static executor uses, so kernels are
+per-op-jitted by jax with its own caching. The tape records
+(op, in VarBases, out VarBases, attrs); backward() is a reverse tape walk
+calling the SAME grad emitters as static append_backward, accumulating
+into VarBase.grad (the GradientAccumulator role). No separate kernel
+library and no separate autodiff.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import framework, unique_name
+from ...ops import registry
+
+GRAD = "@GRAD"
+
+
+class VarBase:
+    """Eager tensor (reference imperative/layer.h:56)."""
+
+    def __init__(
+        self,
+        value=None,
+        name: Optional[str] = None,
+        stop_gradient: bool = False,
+        persistable: bool = False,
+    ):
+        import jax.numpy as jnp
+
+        self.value = None if value is None else jnp.asarray(value)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad: Optional[Any] = None
+
+    # -- introspection --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape) if self.value is not None else None
+
+    @property
+    def dtype(self):
+        return self.value.dtype if self.value is not None else None
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def astype(self, dtype):
+        return _trace_op("cast", {"X": [self]}, {"out_dtype": dtype}, ["Out"])[0]
+
+    def backward(self, retain_graph: bool = False):
+        tracer = framework._dygraph_tracer()
+        if tracer is None:
+            raise RuntimeError("VarBase.backward() outside dygraph guard")
+        tracer.run_backward(self, retain_graph=retain_graph)
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, stop_gradient={self.stop_gradient})\n{self.value}"
+
+    # -- arithmetic sugar ------------------------------------------------
+    def _binary(self, other, op, reverse=False):
+        if not isinstance(other, VarBase):
+            other = VarBase(np.asarray(other, dtype=np.asarray(self.value).dtype), stop_gradient=True)
+        x, y = (other, self) if reverse else (self, other)
+        return _trace_op(op, {"X": [x], "Y": [y]}, {}, ["Out"])[0]
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
+    def __matmul__(self, o):
+        return _trace_op("matmul_v2", {"X": [self], "Y": [o]}, {}, ["Out"])[0]
+
+    def __neg__(self):
+        return _trace_op("scale", {"X": [self]}, {"scale": -1.0}, ["Out"])[0]
+
+
+class Tracer:
+    """Eager executor + tape recorder (reference imperative/tracer.cc:45)."""
+
+    def __init__(self):
+        self.tape: List[tuple] = []
+        self._no_grad_depth = 0
+        self._rng_key = None
+        self.train_mode = True
+
+    def _ctx(self):
+        import jax
+
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(0)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return registry.EmitContext(rng_key=sub)
+
+    @property
+    def grad_enabled(self) -> bool:
+        return self._no_grad_depth == 0
+
+    def trace_op(
+        self,
+        type: str,
+        inputs: Dict[str, List[VarBase]],
+        attrs: Dict[str, Any],
+        out_slots: List[str],
+    ) -> Dict[str, List[VarBase]]:
+        spec = registry.get(type)
+        if spec is None:
+            raise KeyError(f"op {type!r} has no registered emitter")
+        ins_vals = {
+            slot: [v.value for v in vs] for slot, vs in inputs.items() if vs
+        }
+        outs_vals = spec.emit(self._ctx(), ins_vals, dict(attrs))
+        outputs: Dict[str, List[VarBase]] = {}
+        for slot in outs_vals if out_slots is None else out_slots:
+            vals = outs_vals.get(slot)
+            if vals is None:
+                continue
+            outputs[slot] = [VarBase(v) for v in vals]
+        requires = self.grad_enabled and any(
+            not v.stop_gradient for vs in inputs.values() for v in vs
+        ) and not spec.stop_gradient
+        if requires:
+            self.tape.append((type, dict(inputs), dict(outputs), dict(attrs)))
+        else:
+            for vs in outputs.values():
+                for v in vs:
+                    v.stop_gradient = True
+        return outputs
+
+    # -- autograd (reference BasicEngine::Execute) -----------------------
+    def run_backward(self, root: VarBase, retain_graph: bool = False):
+        import jax.numpy as jnp
+
+        grads: Dict[int, Any] = {id(root): jnp.ones_like(root.value)}
+        holders: Dict[int, VarBase] = {id(root): root}
+
+        for type, inputs, outputs, attrs in reversed(self.tape):
+            out_grads: Dict[str, List[Optional[Any]]] = {}
+            any_grad = False
+            for slot, vs in outputs.items():
+                gs = [grads.get(id(v)) for v in vs]
+                if any(g is not None for g in gs):
+                    any_grad = True
+                out_grads[slot] = gs
+            if not any_grad:
+                continue
+
+            spec = registry.get(type)
+            gspec = registry.get(type + "_grad")
+            if gspec is None:
+                raise NotImplementedError(f"op {type!r} has no gradient path")
+
+            # assemble grad-emitter inputs: fwd ins + fwd outs + out grads
+            gins: Dict[str, List[Any]] = {}
+            for slot, vs in inputs.items():
+                gins[slot] = [v.value for v in vs]
+            for slot, vs in outputs.items():
+                gins.setdefault(slot, [v.value for v in vs])
+            for slot, gs in out_grads.items():
+                filled = []
+                for g, v in zip(gs, outputs[slot]):
+                    filled.append(
+                        g if g is not None else jnp.zeros_like(v.value)
+                    )
+                gins[slot + GRAD] = filled
+
+            gattrs = dict(attrs)
+            gattrs["__fwd_in_slots__"] = list(inputs.keys())
+            gouts = gspec.emit(self._ctx(), gins, gattrs)
+
+            for slot, vs in inputs.items():
+                gvals = gouts.get(slot + GRAD)
+                if gvals is None:
+                    continue
+                for v, g in zip(vs, gvals):
+                    if v.stop_gradient or g is None:
+                        continue
+                    cur = grads.get(id(v))
+                    grads[id(v)] = g if cur is None else cur + g
+                    holders[id(v)] = v
+
+        for vid, g in grads.items():
+            v = holders[vid]
+            if v.stop_gradient:
+                continue
+            v.grad = g if v.grad is None else v.grad + g
+        if not retain_graph:
+            self.tape.clear()
+
+
+def _trace_op(type, inputs, attrs, out_slots):
+    tracer = framework._dygraph_tracer()
+    outs = tracer.trace_op(type, inputs, attrs, out_slots)
+    flat = [v for slot in out_slots for v in outs.get(slot, [])]
+    return flat
+
+
+# ---------------------------------------------------------------------------
+# mode guards (reference dygraph/base.py)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    tracer = Tracer()
+    old = framework._dygraph_tracer_
+    framework._dygraph_tracer_ = tracer
+    try:
+        yield
+    finally:
+        framework._dygraph_tracer_ = old
+
+
+def enabled() -> bool:
+    return framework.in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    tracer = framework._dygraph_tracer()
+    if tracer is None:
+        yield
+        return
+    tracer._no_grad_depth += 1
+    try:
+        yield
+    finally:
+        tracer._no_grad_depth -= 1
+
+
+def no_grad(fn=None):
+    """Decorator or context manager."""
+    if fn is None:
+        return no_grad_ctx()
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with no_grad_ctx():
+            return fn(*a, **k)
+
+    return wrapper
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)

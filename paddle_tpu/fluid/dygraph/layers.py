@@ -1,0 +1,195 @@
+"""Dygraph Layer base class.
+
+Parity surface: /root/reference/python/paddle/fluid/dygraph/layers.py
+(Layer: parameters, sublayers, state_dict, train/eval, __call__).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import unique_name
+from ..initializer import ConstantInitializer, XavierInitializer
+from ..param_attr import ParamAttr
+from .base import VarBase
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._dtype = dtype
+        self.training = True
+        self._parameters: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+
+    # -- construction ----------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> VarBase:
+        attr = ParamAttr._to_attr(attr)
+        dtype = dtype or self._dtype
+        init = (
+            default_initializer
+            or (attr.initializer if attr is not None and attr.initializer else None)
+            or (ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        )
+        value = _init_numpy(init, shape, dtype)
+        name = attr.name if attr and attr.name else unique_name.generate(
+            f"{self._full_name}.{'b' if is_bias else 'w'}"
+        )
+        p = VarBase(value, name=name, persistable=True)
+        p.stop_gradient = not (attr.trainable if attr else True)
+        p.is_parameter = True
+        return p
+
+    def add_parameter(self, name: str, parameter: VarBase) -> VarBase:
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, value: VarBase):
+        value.stop_gradient = True
+        self._buffers[name] = value
+        return value
+
+    # -- attribute protocol (auto-register params/sublayers) -------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and getattr(value, "is_parameter", False):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ first")
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{self.__class__.__name__} has no attribute {name!r}")
+
+    # -- traversal -------------------------------------------------------
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
+        for n, p in self._parameters.items():
+            yield (f"{prefix}.{n}" if prefix else n), p
+        for sn, sub in self._sub_layers.items():
+            yield from sub.named_parameters(f"{prefix}.{sn}" if prefix else sn)
+
+    def parameters(self, include_sublayers=True) -> List[VarBase]:
+        return [p for _, p in self.named_parameters()]
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for s in self._sub_layers.values():
+            out.append(s)
+            out.extend(s.sublayers())
+        return out
+
+    def train(self):
+        self.training = True
+        for s in self._sub_layers.values():
+            s.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for s in self._sub_layers.values():
+            s.eval()
+        return self
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict ------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, prefix=""):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters():
+            dest[name] = p.numpy()
+        for store in ("_buffers",):
+            for n, b in getattr(self, store).items():
+                dest[n] = b.numpy()
+        return dest
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        import jax.numpy as jnp
+
+        named = dict(self.named_parameters())
+        for k, v in state_dict.items():
+            if k in named:
+                named[k].value = jnp.asarray(v)
+            elif k in self._buffers:
+                self._buffers[k].value = jnp.asarray(v)
+
+    load_dict = set_dict
+
+    # -- call ------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    @property
+    def full_name(self):
+        return self._full_name
+
+
+def _init_numpy(initializer, shape, dtype) -> np.ndarray:
+    """Evaluate a static-graph Initializer eagerly: run its op emitter on
+    a scratch block-free path (initializers only need shape/dtype)."""
+    from ..initializer import (
+        BilinearInitializer,
+        ConstantInitializer,
+        MSRAInitializer,
+        NormalInitializer,
+        NumpyArrayInitializer,
+        TruncatedNormalInitializer,
+        UniformInitializer,
+        XavierInitializer,
+    )
+
+    rng = np.random
+    shape = tuple(int(s) for s in shape)
+    if isinstance(initializer, ConstantInitializer):
+        return np.full(shape, initializer.value, dtype=dtype)
+    if isinstance(initializer, NumpyArrayInitializer):
+        return np.asarray(initializer.value, dtype=dtype).reshape(shape)
+    if isinstance(initializer, UniformInitializer):
+        return rng.uniform(initializer.low, initializer.high, shape).astype(dtype)
+    if isinstance(initializer, TruncatedNormalInitializer):
+        a = rng.normal(initializer.loc, initializer.scale, shape)
+        lim = 2 * initializer.scale
+        return np.clip(a, initializer.loc - lim, initializer.loc + lim).astype(dtype)
+    if isinstance(initializer, NormalInitializer):
+        return rng.normal(initializer.loc, initializer.scale, shape).astype(dtype)
+    if isinstance(initializer, (XavierInitializer, MSRAInitializer)):
+        fan_in = shape[0] if len(shape) > 1 else max(shape[0], 1)
+        fan_out = shape[1] if len(shape) > 1 else max(shape[0], 1)
+        if getattr(initializer, "uniform", True):
+            limit = np.sqrt(6.0 / (fan_in + fan_out))
+            return rng.uniform(-limit, limit, shape).astype(dtype)
+        std = np.sqrt(2.0 / (fan_in + fan_out))
+        return rng.normal(0.0, std, shape).astype(dtype)
+    raise TypeError(f"unsupported initializer for dygraph: {initializer!r}")

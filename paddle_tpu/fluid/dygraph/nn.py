@@ -1,0 +1,200 @@
+"""Dygraph nn layer classes.
+
+Parity surface: /root/reference/python/paddle/fluid/dygraph/nn.py
+(Linear, Conv2D, Pool2D, Embedding, LayerNorm, BatchNorm, Dropout, GRUUnit...).
+Each forward traces the same registered op emitters as the static graph.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..initializer import ConstantInitializer
+from ..param_attr import ParamAttr
+from .base import VarBase, _trace_op
+from .layers import Layer
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([input_dim, output_dim], attr=ParamAttr._to_attr(param_attr))
+        self.bias = (
+            None
+            if bias_attr is False
+            else self.create_parameter([output_dim], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+        )
+        self._act = act
+
+    def forward(self, x):
+        out = _trace_op(
+            "mul", {"X": [x], "Y": [self.weight]},
+            {"x_num_col_dims": len(x.shape) - 1, "y_num_col_dims": 1}, ["Out"]
+        )[0]
+        if self.bias is not None:
+            out = _trace_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]}, {"axis": -1}, ["Out"]
+            )[0]
+        if self._act:
+            out = _trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(list(size), attr=ParamAttr._to_attr(param_attr))
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        return _trace_op(
+            "lookup_table_v2",
+            {"W": [self.weight], "Ids": [ids]},
+            {"padding_idx": self._padding_idx},
+            ["Out"],
+        )[0]
+
+
+class Conv2D(Layer):
+    def __init__(
+        self, num_channels, num_filters, filter_size, stride=1, padding=0,
+        dilation=1, groups=1, param_attr=None, bias_attr=None, act=None, dtype="float32",
+    ):
+        super().__init__(dtype=dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+            "groups": groups or 1,
+        }
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // (groups or 1)] + fs,
+            attr=ParamAttr._to_attr(param_attr),
+        )
+        self.bias = (
+            None
+            if bias_attr is False
+            else self.create_parameter([num_filters], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+        )
+        self._act = act
+
+    def forward(self, x):
+        out = _trace_op(
+            "conv2d", {"Input": [x], "Filter": [self.weight]}, self._attrs, ["Output"]
+        )[0]
+        if self.bias is not None:
+            out = _trace_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]}, {"axis": 1}, ["Out"]
+            )[0]
+        if self._act:
+            out = _trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0, global_pooling=False, ceil_mode=False):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+        }
+
+    def forward(self, x):
+        return _trace_op("pool2d", {"X": [x]}, self._attrs, ["Out"])[0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._epsilon = epsilon
+        self.weight = (
+            self.create_parameter(
+                normalized_shape, attr=ParamAttr._to_attr(param_attr),
+                default_initializer=ConstantInitializer(1.0),
+            )
+            if scale
+            else None
+        )
+        self.bias = (
+            self.create_parameter(normalized_shape, attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+            if shift
+            else None
+        )
+        self._norm_ndim = len(normalized_shape)
+
+    def forward(self, x):
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _trace_op(
+            "layer_norm",
+            ins,
+            {"epsilon": self._epsilon, "begin_norm_axis": len(x.shape) - self._norm_ndim},
+            ["Y"],
+        )[0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32", data_layout="NCHW"):
+        super().__init__(dtype=dtype)
+        self._momentum, self._epsilon, self._layout = momentum, epsilon, data_layout
+        self.weight = self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(param_attr),
+            default_initializer=ConstantInitializer(1.0),
+        )
+        self.bias = self.create_parameter([num_channels], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+        self._mean = self.register_buffer("_mean", VarBase(np.zeros(num_channels, dtype), persistable=True))
+        self._variance = self.register_buffer("_variance", VarBase(np.ones(num_channels, dtype), persistable=True))
+
+    def forward(self, x):
+        outs = framework_trace = _trace_op(
+            "batch_norm",
+            {
+                "X": [x],
+                "Scale": [self.weight],
+                "Bias": [self.bias],
+                "Mean": [self._mean],
+                "Variance": [self._variance],
+            },
+            {
+                "momentum": self._momentum,
+                "epsilon": self._epsilon,
+                "data_layout": self._layout,
+                "is_test": not self.training,
+            },
+            ["Y", "MeanOut", "VarianceOut"],
+        )
+        y, mean_out, var_out = outs[0], outs[1], outs[2]
+        # running stats update (buffers are plain values, not graph state)
+        self._mean.value = mean_out.value
+        self._variance.value = var_out.value
+        return y
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, x):
+        return _trace_op(
+            "dropout",
+            {"X": [x]},
+            {
+                "dropout_prob": self._p,
+                "is_test": not self.training,
+                "dropout_implementation": self._impl,
+            },
+            ["Out"],
+        )[0]

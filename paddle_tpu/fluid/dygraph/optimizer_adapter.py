@@ -1,0 +1,140 @@
+"""Eager (dygraph) optimizer stepping.
+
+Parity: the reference shares optimizer *ops* between static and dygraph
+modes (dygraph traces adam ops eagerly through the same OpKernel registry,
+imperative/tracer.cc). Here likewise: the same registered update emitters
+(ops/optimizer_ops.py) are invoked eagerly on VarBase values; accumulator
+state lives on the Optimizer instance keyed by parameter name.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...ops import registry
+
+
+def _zeros_like(p):
+    import jax.numpy as jnp
+
+    return jnp.zeros_like(p.value)
+
+
+def _scalar(v):
+    import jax.numpy as jnp
+
+    return jnp.full((1,), v, jnp.float32)
+
+
+# type -> (state spec: name -> init(p, opt), ins builder, attrs builder,
+#          out-slot -> state-name bindings)
+_SPECS = {
+    "sgd": (
+        {},
+        lambda p, g, st, o: {"Param": [p.value], "Grad": [g], "LearningRate": [o._lr_value()]},
+        lambda o: {},
+        {"ParamOut": "__param__"},
+    ),
+    "momentum": (
+        {"velocity": lambda p, o: _zeros_like(p)},
+        lambda p, g, st, o: {
+            "Param": [p.value], "Grad": [g], "Velocity": [st["velocity"]],
+            "LearningRate": [o._lr_value()],
+        },
+        lambda o: {"mu": o._momentum, "use_nesterov": getattr(o, "_use_nesterov", False)},
+        {"ParamOut": "__param__", "VelocityOut": "velocity"},
+    ),
+    "adam": (
+        {
+            "moment1": lambda p, o: _zeros_like(p),
+            "moment2": lambda p, o: _zeros_like(p),
+            "beta1_pow": lambda p, o: _scalar(o._beta1),
+            "beta2_pow": lambda p, o: _scalar(o._beta2),
+        },
+        lambda p, g, st, o: {
+            "Param": [p.value], "Grad": [g],
+            "Moment1": [st["moment1"]], "Moment2": [st["moment2"]],
+            "Beta1Pow": [st["beta1_pow"]], "Beta2Pow": [st["beta2_pow"]],
+            "LearningRate": [o._lr_value()],
+        },
+        lambda o: {"beta1": o._beta1, "beta2": o._beta2, "epsilon": o._epsilon},
+        {
+            "ParamOut": "__param__", "Moment1Out": "moment1", "Moment2Out": "moment2",
+            "Beta1PowOut": "beta1_pow", "Beta2PowOut": "beta2_pow",
+        },
+    ),
+    "adagrad": (
+        {"moment": lambda p, o: _zeros_like(p)},
+        lambda p, g, st, o: {
+            "Param": [p.value], "Grad": [g], "Moment": [st["moment"]],
+            "LearningRate": [o._lr_value()],
+        },
+        lambda o: {"epsilon": o._epsilon},
+        {"ParamOut": "__param__", "MomentOut": "moment"},
+    ),
+    "rmsprop": (
+        {
+            "mean_square": lambda p, o: _zeros_like(p),
+            "mean_grad": lambda p, o: _zeros_like(p),
+            "momentum": lambda p, o: _zeros_like(p),
+        },
+        lambda p, g, st, o: {
+            "Param": [p.value], "Grad": [g],
+            "MeanSquare": [st["mean_square"]], "MeanGrad": [st["mean_grad"]],
+            "Moment": [st["momentum"]], "LearningRate": [o._lr_value()],
+        },
+        lambda o: {
+            "epsilon": o._epsilon, "decay": o._rho, "momentum": o._momentum,
+            "centered": getattr(o, "_centered", False),
+        },
+        {
+            "ParamOut": "__param__", "MeanSquareOut": "mean_square",
+            "MeanGradOut": "mean_grad", "MomentOut": "momentum",
+        },
+    ),
+}
+_SPECS["adamw"] = _SPECS["adam"]
+_SPECS["lamb"] = (
+    _SPECS["adam"][0],
+    _SPECS["adam"][1],
+    lambda o: {
+        "beta1": o._beta1, "beta2": o._beta2, "epsilon": o._epsilon,
+        "weight_decay": getattr(o, "_lamb_weight_decay", 0.01),
+    },
+    _SPECS["adam"][3],
+)
+
+
+def dygraph_step(optimizer, params) -> None:
+    """Apply one eager update to every param carrying a gradient."""
+    spec = registry.get(optimizer.type)
+    table = _SPECS.get(optimizer.type)
+    if table is None:
+        raise NotImplementedError(
+            f"dygraph mode: optimizer {optimizer.type!r} has no eager adapter"
+        )
+    state_spec, ins_fn, attrs_fn, out_bind = table
+    if not hasattr(optimizer, "_eager_state"):
+        optimizer._eager_state: Dict[str, Dict] = {}
+    if optimizer.type == "adamw":
+        attrs = attrs_fn(optimizer)
+        attrs["coeff"] = optimizer._weight_decay
+    else:
+        attrs = attrs_fn(optimizer)
+    ctx = registry.EmitContext()
+    for p in params:
+        if p.grad is None or p.stop_gradient:
+            continue
+        st = optimizer._eager_state.setdefault(
+            p.name, {k: f(p, optimizer) for k, f in state_spec.items()}
+        )
+        outs = spec.emit(ctx, ins_fn(p, p.grad, st, optimizer), attrs)
+        for slot, target in out_bind.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            if target == "__param__":
+                p.value = vals[0]
+            else:
+                st[target] = vals[0]

@@ -141,6 +141,19 @@ class Optimizer:
         with program_guard(loss.block.program, startup_program):
             return self.apply_gradients(params_grads)
 
+    # -- dygraph (eager) path -------------------------------------------
+    def _lr_value(self):
+        """Current LR as a jax scalar array (dygraph path)."""
+        import jax.numpy as jnp
+
+        lr = self._learning_rate
+        if isinstance(lr, Variable):
+            raise TypeError(
+                "dygraph mode needs a float learning rate (in-graph LR "
+                "schedules are static-graph; use set_lr for manual decay)"
+            )
+        return jnp.full((1,), float(lr), jnp.float32)
+
     def minimize(
         self,
         loss,
@@ -148,6 +161,17 @@ class Optimizer:
         parameter_list=None,
         no_grad_set=None,
     ):
+        if framework.in_dygraph_mode():
+            from .dygraph.optimizer_adapter import dygraph_step
+
+            params = parameter_list or self._parameter_list
+            if params is None:
+                raise ValueError(
+                    "dygraph minimize() needs parameter_list (pass "
+                    "model.parameters() to the optimizer)"
+                )
+            dygraph_step(self, list(params))
+            return [], []
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )

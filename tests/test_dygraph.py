@@ -1,0 +1,128 @@
+"""Dygraph: eager execution, tape autograd, Layer system, optimizers.
+
+Mirrors the reference's test_imperative_* suite (SURVEY.md §4.5):
+dygraph-vs-static equivalence and eager training convergence.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph import Linear, to_variable
+
+
+def test_eager_arithmetic_and_backward():
+    with dygraph.guard():
+        x = to_variable(np.array([2.0, 3.0], "float32"))
+        x.stop_gradient = False
+        y = x * x + x  # y = x^2 + x
+        loss = fluid.layers.reduce_sum(y) if False else None
+        # sum via arithmetic: use matmul-free path
+        s = y._binary(1.0, "elementwise_mul")  # identity-ish; just backward y
+        y.backward()
+        # dy/dx = 2x + 1
+        np.testing.assert_allclose(x.gradient, [5.0, 7.0], rtol=1e-6)
+
+
+def test_linear_trains():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 4).astype("float32")
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], "float32")
+    ys = xs @ w_true
+
+    with dygraph.guard():
+        model = Linear(4, 1)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameter_list=model.parameters()
+        )
+        losses = []
+        for _ in range(30):
+            pred = model(to_variable(xs))
+            diff = pred - to_variable(ys)
+            loss = diff * diff
+            # mean via trace
+            from paddle_tpu.fluid.dygraph.base import _trace_op
+
+            loss = _trace_op("reduce_mean", {"X": [loss]}, {"reduce_all": True}, ["Out"])[0]
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss.numpy().reshape(())))
+        assert losses[-1] < 0.05 * losses[0], losses[::6]
+
+
+def test_dygraph_static_equivalence():
+    """Same weights, same data: dygraph forward == static forward."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(8, 6).astype("float32")
+
+    with dygraph.guard():
+        model = Linear(6, 3, act="tanh")
+        dy_out = model(to_variable(xs)).numpy()
+        w, b = model.weight.numpy(), model.bias.numpy()
+
+    x = fluid.data("x", [8, 6], "float32")
+    out = fluid.layers.fc(x, 3, act="tanh")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    pnames = [p.name for p in fluid.default_main_program().all_parameters()]
+    scope.set_var(pnames[0], w)
+    scope.set_var(pnames[1], b)
+    (st_out,) = exe.run(feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(dy_out, st_out, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_conv_mnist_style():
+    rng = np.random.RandomState(2)
+    xs = rng.randn(8, 1, 8, 8).astype("float32")
+    labels = (rng.rand(8, 1) > 0.5).astype("int32")
+
+    with dygraph.guard():
+        from paddle_tpu.fluid.dygraph import Conv2D, Pool2D
+        from paddle_tpu.fluid.dygraph.base import _trace_op
+
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = Conv2D(1, 4, 3, padding=1, act="relu")
+                self.pool = Pool2D(2, "max", 2)
+                self.fc = Linear(4 * 4 * 4, 2)
+
+            def forward(self, x):
+                h = self.pool(self.conv(x))
+                h = _trace_op("reshape", {"X": [h]}, {"shape": [8, 64]}, ["Out"])[0]
+                return self.fc(h)
+
+        net = Net()
+        opt = fluid.optimizer.AdamOptimizer(1e-2, parameter_list=net.parameters())
+        losses = []
+        for _ in range(10):
+            logits = net(to_variable(xs))
+            loss = _trace_op(
+                "softmax_with_cross_entropy",
+                {"Logits": [logits], "Label": [to_variable(labels)]},
+                {"soft_label": False, "ignore_index": -100, "axis": -1},
+                ["Loss"],
+            )[0]
+            loss = _trace_op("reduce_mean", {"X": [loss]}, {"reduce_all": True}, ["Out"])[0]
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(loss.numpy().reshape(())))
+        assert losses[-1] < losses[0], losses
+
+
+def test_no_grad_and_state_dict(tmp_path):
+    with dygraph.guard():
+        model = Linear(3, 2)
+        with dygraph.no_grad():
+            out = model(to_variable(np.ones((1, 3), "float32")))
+        assert out.stop_gradient
+
+        sd = model.state_dict()
+        dygraph.save_dygraph(sd, str(tmp_path / "m"))
+        params, _ = dygraph.load_dygraph(str(tmp_path / "m"))
+        model2 = Linear(3, 2)
+        model2.set_dict(params)
+        np.testing.assert_allclose(model2.weight.numpy(), model.weight.numpy())
