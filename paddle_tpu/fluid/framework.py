@@ -378,15 +378,33 @@ class Program:
                 nv.op = None
                 nb.vars[name] = nv
             for op in b.ops:
+                attrs = {
+                    k: (v if not isinstance(v, Block) else p.blocks[v.idx])
+                    for k, v in op.attrs.items()
+                }
+                # fused recompute segments carry live sub-Operator lists:
+                # copy them (no aliasing with the source program) and apply
+                # the is_test rewrite inside the segment too (dropout etc.)
+                if "recompute_sub_ops" in attrs:
+                    subs = []
+                    for sop in attrs["recompute_sub_ops"]:
+                        nsop = Operator(
+                            nb,
+                            sop.type,
+                            inputs=copy.deepcopy(sop.inputs),
+                            outputs=copy.deepcopy(sop.outputs),
+                            attrs=dict(sop.attrs),
+                        )
+                        if for_test and "is_test" in nsop.attrs:
+                            nsop.attrs["is_test"] = True
+                        subs.append(nsop)
+                    attrs["recompute_sub_ops"] = subs
                 nop = Operator(
                     nb,
                     op.type,
                     inputs=copy.deepcopy(op.inputs),
                     outputs=copy.deepcopy(op.outputs),
-                    attrs={
-                        k: (v if not isinstance(v, Block) else p.blocks[v.idx])
-                        for k, v in op.attrs.items()
-                    },
+                    attrs=attrs,
                 )
                 if for_test and "is_test" in nop.attrs:
                     nop.attrs["is_test"] = True

@@ -543,6 +543,658 @@ class DpsgdOptimizer(Optimizer):
         )
 
 
+# ---------------------------------------------------------------------------
+# meta-optimizers: wrappers that rewrite the program around an inner optimizer
+# (reference optimizer.py:3627-5171). On TPU all of them are branchless
+# program rewrites — conditional updates use `where` selects instead of the
+# reference's conditional_block op, so the step stays a single XLA program.
+# ---------------------------------------------------------------------------
+
+
+def _create_persistable_var(name, shape, dtype, fill_value=0.0):
+    """Main-program persistable var + zero/constant startup init (the
+    pattern of Optimizer._add_accumulator)."""
+    main_block = framework.default_main_program().global_block()
+    if name in main_block.vars:
+        return main_block.vars[name]
+    v = main_block.create_var(
+        name=name, shape=tuple(shape), dtype=dtype, persistable=True,
+        stop_gradient=True,
+    )
+    startup_block = framework.default_startup_program().global_block()
+    sv = startup_block.create_var(
+        name=name, shape=tuple(shape), dtype=dtype, persistable=True
+    )
+    ConstantInitializer(float(fill_value))(sv, startup_block)
+    return v
+
+
+def _append_step_cond(block, counter_name, k):
+    """Emit: counter += 1; cond = (counter % k == 0). Returns the bool
+    cond var (shape (1,)). int64 counter: a float32 one saturates at 2^24
+    steps and would freeze the boundary condition forever."""
+    step = _create_persistable_var(counter_name, (1,), "int64", 0.0)
+    block.append_op(
+        type="increment", inputs={"X": [step]}, outputs={"Out": [step]},
+        attrs={"step": 1.0},
+    )
+    k_name = unique_name.generate(counter_name + "_k")
+    block.append_op(
+        type="fill_constant", outputs={"Out": [k_name]},
+        attrs={"shape": [1], "dtype": "int64", "value": float(k)},
+    )
+    mod_name = unique_name.generate(counter_name + "_mod")
+    block.append_op(
+        type="elementwise_mod", inputs={"X": [step], "Y": [k_name]},
+        outputs={"Out": [mod_name]},
+    )
+    zero_name = unique_name.generate(counter_name + "_zero")
+    block.append_op(
+        type="fill_constant", outputs={"Out": [zero_name]},
+        attrs={"shape": [1], "dtype": "int64", "value": 0.0},
+    )
+    cond_name = unique_name.generate(counter_name + "_cond")
+    block.append_op(
+        type="equal", inputs={"X": [mod_name], "Y": [zero_name]},
+        outputs={"Out": [cond_name]},
+    )
+    return block.var(cond_name)
+
+
+def _mask_region(block, cond, start_idx):
+    """Make the persistable-state writes of ops[start_idx:] conditional on
+    `cond`: snapshot each written persistable var before the region, then
+    select(cond, new, old) after it. Branchless equivalent of running the
+    region inside the reference's conditional_block
+    (operators/controlflow/conditional_block_op.cc)."""
+    region = list(block.ops[start_idx:])
+    written = []
+    for op in region:
+        for n in op.output_names():
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable and n not in written:
+                written.append(n)
+    for i, n in enumerate(written):
+        block._insert_op(
+            start_idx + i,
+            type="assign",
+            inputs={"X": [n]},
+            outputs={"Out": [n + "@MASK_OLD"]},
+        )
+    for n in written:
+        block.append_op(
+            type="where",
+            inputs={"Condition": [cond], "X": [n], "Y": [n + "@MASK_OLD"]},
+            outputs={"Out": [n]},
+        )
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads over k_steps microbatches, apply the inner update
+    on the k-th (reference optimizer.py:4948). The inner optimizer's update
+    ops run every step but their persistable-state writes are masked by a
+    (step % k == 0) select, so parameters and moments only change on the
+    boundary step — one compiled program, no control-flow divergence."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if framework.in_dygraph_mode():
+            raise RuntimeError("GradientMergeOptimizer is static-graph only")
+        params_grads = self.inner_opt.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        main = loss.block.program
+        startup = (
+            startup_program
+            if startup_program is not None
+            else framework.default_startup_program()
+        )
+        with program_guard(main, startup):
+            block = main.global_block()
+            cond = _append_step_cond(
+                block, unique_name.generate("gradient_merge_step"), self.k_steps
+            )
+            merged = []
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                acc = _create_persistable_var(
+                    p.name + "@GradientMerge", p.shape, p.dtype, 0.0
+                )
+                block.append_op(
+                    type="elementwise_add",
+                    inputs={"X": [acc], "Y": [g]},
+                    outputs={"Out": [acc]},
+                )
+                if self.avg:
+                    avg_name = acc.name + "@AVG"
+                    block.append_op(
+                        type="scale",
+                        inputs={"X": [acc]},
+                        outputs={"Out": [avg_name]},
+                        attrs={"scale": 1.0 / self.k_steps, "bias": 0.0},
+                    )
+                    merged.append((p, block.var(avg_name)))
+                else:
+                    merged.append((p, acc))
+            start_idx = len(block.ops)
+            optimize_ops = self.inner_opt.apply_optimize(loss, startup, merged)
+            _mask_region(block, cond, start_idx)
+            # reset accumulators on the boundary step
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                acc_name = p.name + "@GradientMerge"
+                z = unique_name.generate(acc_name + "_zero")
+                block.append_op(
+                    type="fill_zeros_like",
+                    inputs={"X": [acc_name]},
+                    outputs={"Out": [z]},
+                )
+                block.append_op(
+                    type="where",
+                    inputs={"Condition": [cond], "X": [z], "Y": [acc_name]},
+                    outputs={"Out": [acc_name]},
+                )
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
+class LookaheadOptimizer:
+    """Lookahead (k steps forward, 1 step back; reference optimizer.py:4787):
+    the fast (inner) optimizer steps every iteration; every k steps the slow
+    weights move toward the fast ones and the fast weights are reset."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert 0.0 <= alpha <= 1.0
+        self.inner_opt = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if framework.in_dygraph_mode():
+            raise RuntimeError("LookaheadOptimizer is static-graph only")
+        optimize_ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+        )
+        main = loss.block.program
+        startup = (
+            startup_program
+            if startup_program is not None
+            else framework.default_startup_program()
+        )
+        with program_guard(main, startup):
+            block = main.global_block()
+            cond = _append_step_cond(
+                block, unique_name.generate("lookahead_step"), self.k
+            )
+            for p, _ in params_grads:
+                slow_name = p.name + "@SLOW"
+                _create_persistable_var(slow_name, p.shape, p.dtype, 0.0)
+                # slow weights start as a copy of the initialized params
+                sblock = framework.default_startup_program().global_block()
+                sblock.append_op(
+                    type="assign",
+                    inputs={"X": [p.name]},
+                    outputs={"Out": [slow_name]},
+                )
+                diff = unique_name.generate(p.name + "_la_diff")
+                block.append_op(
+                    type="elementwise_sub",
+                    inputs={"X": [p.name], "Y": [slow_name]},
+                    outputs={"Out": [diff]},
+                )
+                scaled = unique_name.generate(p.name + "_la_scaled")
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [diff]},
+                    outputs={"Out": [scaled]},
+                    attrs={"scale": self.alpha, "bias": 0.0},
+                )
+                new_slow = unique_name.generate(p.name + "_la_new_slow")
+                block.append_op(
+                    type="elementwise_add",
+                    inputs={"X": [slow_name], "Y": [scaled]},
+                    outputs={"Out": [new_slow]},
+                )
+                for target in (slow_name, p.name):
+                    block.append_op(
+                        type="where",
+                        inputs={"Condition": [cond], "X": [new_slow], "Y": [target]},
+                        outputs={"Out": [target]},
+                    )
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
+class RecomputeOptimizer:
+    """Activation recompute between user-marked checkpoints (reference
+    optimizer.py:4478 + backward.py:629). See ops/recompute.py for the
+    TPU-native mechanism: each segment between checkpoints is fused into a
+    `recompute_segment` op replayed under jax.checkpoint, so XLA stores only
+    the checkpoint tensors across forward->backward and rematerializes the
+    rest inside the grad op. Intermediates inside a segment can no longer be
+    fetched (same observable contract as the reference's recompute)."""
+
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [
+            c.name if isinstance(c, framework.Variable) else str(c)
+            for c in (checkpoints or [])
+        ]
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if not self._checkpoints:
+            raise ValueError("RecomputeOptimizer needs _set_checkpoints(...)")
+        _fuse_recompute_segments(loss, self._checkpoints)
+        return self.inner_opt.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks
+        )
+
+    def apply_gradients(self, params_grads):
+        return self.inner_opt.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.inner_opt.apply_optimize(loss, startup_program, params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if framework.in_dygraph_mode():
+            raise RuntimeError("RecomputeOptimizer is static-graph only")
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        optimize_ops = self.inner_opt.apply_optimize(
+            loss,
+            startup_program
+            if startup_program is not None
+            else framework.default_startup_program(),
+            params_grads,
+        )
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
+def _fuse_recompute_segments(loss, checkpoint_names):
+    """Split the forward region of loss's block at checkpoint-producing ops
+    and collapse each multi-op segment into one `recompute_segment` op."""
+    block = loss.block
+    ckpts = set(checkpoint_names)
+    loss_idx = None
+    for i in reversed(range(len(block.ops))):
+        if loss.name in block.ops[i].output_names():
+            loss_idx = i
+            break
+    if loss_idx is None:
+        raise ValueError(f"loss var {loss.name!r} is not produced by any op")
+    fwd_ops = block.ops[: loss_idx + 1]
+    tail_ops = block.ops[loss_idx + 1:]
+
+    segments, cur = [], []
+    for op in fwd_ops:
+        cur.append(op)
+        if any(n in ckpts for n in op.output_names()):
+            segments.append(cur)
+            cur = []
+    if cur:
+        segments.append(cur)
+
+    new_ops = []
+    for si, seg in enumerate(segments):
+        if len(seg) < 2:
+            new_ops.extend(seg)
+            continue
+        produced = []
+        for op in seg:
+            for n in op.output_names():
+                if n not in produced:
+                    produced.append(n)
+        in_names = []
+        seen_out = set()
+        for op in seg:
+            for n in op.input_names():
+                if n not in seen_out and n not in in_names:
+                    in_names.append(n)
+            seen_out.update(op.output_names())
+        # names still observable after the segment: later forward reads,
+        # checkpoints, persistables (bn running stats), and the loss
+        consumed_later = set()
+        for later_seg in segments[si + 1:]:
+            for op in later_seg:
+                consumed_later.update(op.input_names())
+        for op in tail_ops:
+            consumed_later.update(op.input_names())
+        out_names = []
+        for n in produced:
+            v = block._find_var_recursive(n)
+            if (
+                n in consumed_later
+                or n in ckpts
+                or n == loss.name
+                or (v is not None and v.persistable)
+            ):
+                out_names.append(n)
+        if not out_names:
+            out_names = [produced[-1]]
+        out_metas = []
+        for n in out_names:
+            v = block._find_var_recursive(n)
+            out_metas.append((v.shape, v.dtype))
+        # in_names was collected before each op's own outputs were marked
+        # produced, so every entry is an external read — including vars the
+        # segment reads then overwrites in place (batch_norm Mean/MeanOut
+        # share one name); those must stay inputs AND outputs.
+        fused = framework.Operator(
+            block,
+            "recompute_segment",
+            inputs={"X": in_names},
+            outputs={"Out": out_names},
+            attrs={
+                "recompute_sub_ops": seg,
+                "recompute_in_names": in_names,
+                "recompute_out_names": out_names,
+                "recompute_out_metas": out_metas,
+                "recompute_seg_salt": 0x7EC0 + si,
+            },
+        )
+        for n in out_names:
+            v = block._find_var_recursive(n)
+            if v is not None:
+                v.op = fused
+        new_ops.append(fused)
+    block.ops = new_ops + tail_ops
+    block.program._bump_version()
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference optimizer.py:3381).
+
+    update() appends the in-graph accumulation ops (call after
+    optimizer.minimize); apply()/restore() swap scope values host-side
+    (checkpointed persistables stay by-name compatible).
+
+    thres_steps (reference :3413): a Variable scheduling the decay as
+    min(decay, (1+thres_steps)/(10+thres_steps)). The zero-init bias is
+    corrected at apply() by 1 - prod(decay_t) — for constant decay that is
+    exactly the reference's 1 - decay^t factor, and it stays exact under
+    scheduling (where a decay^t correction would not)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._name = name or ""
+        self._pairs = []  # (param_name, ema_name)
+        self._step_name = unique_name.generate(self._name + "@EMA@step")
+        self._decay_pow_name = unique_name.generate(self._name + "@EMA@decay_pow")
+        self._backup = {}
+
+    def _append_decay_var(self, block):
+        """Emit the per-step effective decay var (shape (1,) float32)."""
+        if self._thres_steps is None:
+            name = unique_name.generate(self._name + "@EMA@decay")
+            block.append_op(
+                type="fill_constant", outputs={"Out": [name]},
+                attrs={"shape": [1], "dtype": "float32", "value": self._decay},
+            )
+            return name
+        thres = self._thres_steps
+        tname = thres.name if isinstance(thres, Variable) else str(thres)
+        tf = unique_name.generate(tname + "_f")
+        block.append_op(
+            type="cast", inputs={"X": [tname]}, outputs={"Out": [tf]},
+            attrs={"out_dtype": "float32"},
+        )
+        num = unique_name.generate(tname + "_num")
+        block.append_op(
+            type="scale", inputs={"X": [tf]}, outputs={"Out": [num]},
+            attrs={"scale": 1.0, "bias": 1.0},
+        )
+        den = unique_name.generate(tname + "_den")
+        block.append_op(
+            type="scale", inputs={"X": [tf]}, outputs={"Out": [den]},
+            attrs={"scale": 1.0, "bias": 10.0},
+        )
+        ramp = unique_name.generate(tname + "_ramp")
+        block.append_op(
+            type="elementwise_div", inputs={"X": [num], "Y": [den]},
+            outputs={"Out": [ramp]},
+        )
+        dconst = unique_name.generate(tname + "_dconst")
+        block.append_op(
+            type="fill_constant", outputs={"Out": [dconst]},
+            attrs={"shape": [1], "dtype": "float32", "value": self._decay},
+        )
+        name = unique_name.generate(self._name + "@EMA@decay")
+        block.append_op(
+            type="elementwise_min", inputs={"X": [dconst], "Y": [ramp]},
+            outputs={"Out": [name]},
+        )
+        return name
+
+    def update(self):
+        main = framework.default_main_program()
+        block = main.global_block()
+        step = _create_persistable_var(self._step_name, (1,), "int64", 0.0)
+        block.append_op(
+            type="increment", inputs={"X": [step]}, outputs={"Out": [step]},
+            attrs={"step": 1.0},
+        )
+        decay_name = self._append_decay_var(block)
+        one_minus = unique_name.generate(decay_name + "_om")
+        block.append_op(
+            type="scale", inputs={"X": [decay_name]}, outputs={"Out": [one_minus]},
+            attrs={"scale": -1.0, "bias": 1.0},
+        )
+        # running prod of effective decays (debias denominator at apply)
+        _create_persistable_var(self._decay_pow_name, (1,), "float32", 1.0)
+        block.append_op(
+            type="elementwise_mul",
+            inputs={"X": [self._decay_pow_name], "Y": [decay_name]},
+            outputs={"Out": [self._decay_pow_name]},
+        )
+        for p in main.all_parameters():
+            if not p.trainable:
+                continue
+            ema_name = p.name + "@EMA" + self._name
+            _create_persistable_var(ema_name, p.shape, p.dtype, 0.0)
+            t1 = unique_name.generate(ema_name + "_t1")
+            block.append_op(
+                type="elementwise_mul", inputs={"X": [ema_name], "Y": [decay_name]},
+                outputs={"Out": [t1]},
+            )
+            t2 = unique_name.generate(ema_name + "_t2")
+            block.append_op(
+                type="elementwise_mul", inputs={"X": [p.name], "Y": [one_minus]},
+                outputs={"Out": [t2]},
+            )
+            block.append_op(
+                type="elementwise_add", inputs={"X": [t1], "Y": [t2]},
+                outputs={"Out": [ema_name]},
+            )
+            self._pairs.append((p.name, ema_name))
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: swap params for debiased EMA values in scope."""
+        import contextlib
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def _guard():
+            scope = global_scope()
+            decay_pow = float(np.asarray(scope.find_var(self._decay_pow_name))[0])
+            debias = max(1.0 - decay_pow, 1e-12)
+            self._backup = {}
+            for pname, ename in self._pairs:
+                self._backup[pname] = scope.find_var(pname)
+                ema = np.asarray(scope.find_var(ename))
+                scope.set_var(pname, (ema / debias).astype(ema.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _guard()
+
+    def restore(self, executor=None):
+        from .executor import global_scope
+
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
+class ModelAverage:
+    """Running average of parameters over a trailing window (reference
+    optimizer.py:3068). Window rule (reference :3091): restart when
+    num_accumulates >= min_average_window AND
+    num_accumulates >= min(max_average_window, num_updates*average_window_rate).
+    The reference rotates sum_1/sum_2/sum_3 buffers; here a single
+    (sum, count) pair restarts from the current parameter — same
+    averaged-weights contract."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._pairs = []  # (param, sum_name, num_name)
+        self._backup = {}
+        main = framework.default_main_program()
+        block = main.global_block()
+
+        num_upd = _create_persistable_var(
+            unique_name.generate("@MA@num_updates"), (1,), "int64", 0.0
+        )
+        block.append_op(
+            type="increment", inputs={"X": [num_upd]}, outputs={"Out": [num_upd]},
+            attrs={"step": 1.0},
+        )
+        updf = unique_name.generate("@MA@num_updates_f")
+        block.append_op(
+            type="cast", inputs={"X": [num_upd]}, outputs={"Out": [updf]},
+            attrs={"out_dtype": "float32"},
+        )
+        ratew = unique_name.generate("@MA@rate_window")
+        block.append_op(
+            type="scale", inputs={"X": [updf]}, outputs={"Out": [ratew]},
+            attrs={"scale": self.average_window, "bias": 0.0},
+        )
+        maxw = unique_name.generate("@MA@maxw")
+        block.append_op(
+            type="fill_constant", outputs={"Out": [maxw]},
+            attrs={"shape": [1], "dtype": "float32",
+                   "value": float(self.max_average_window)},
+        )
+        window = unique_name.generate("@MA@window")
+        block.append_op(
+            type="elementwise_min", inputs={"X": [maxw], "Y": [ratew]},
+            outputs={"Out": [window]},
+        )
+        minw = unique_name.generate("@MA@minw")
+        block.append_op(
+            type="fill_constant", outputs={"Out": [minw]},
+            attrs={"shape": [1], "dtype": "float32",
+                   "value": float(self.min_average_window)},
+        )
+
+        for p in main.all_parameters():
+            if not p.trainable:
+                continue
+            sum_name = p.name + "@MA_SUM"
+            num_name = p.name + "@MA_NUM"
+            _create_persistable_var(sum_name, p.shape, p.dtype, 0.0)
+            _create_persistable_var(num_name, (1,), "float32", 0.0)
+            ge_min = unique_name.generate(num_name + "_ge_min")
+            block.append_op(
+                type="greater_equal", inputs={"X": [num_name], "Y": [minw]},
+                outputs={"Out": [ge_min]},
+            )
+            ge_win = unique_name.generate(num_name + "_ge_win")
+            block.append_op(
+                type="greater_equal", inputs={"X": [num_name], "Y": [window]},
+                outputs={"Out": [ge_win]},
+            )
+            restart = unique_name.generate(num_name + "_restart")
+            block.append_op(
+                type="logical_and", inputs={"X": [ge_min], "Y": [ge_win]},
+                outputs={"Out": [restart]},
+            )
+            acc = unique_name.generate(sum_name + "_acc")
+            block.append_op(
+                type="elementwise_add", inputs={"X": [sum_name], "Y": [p.name]},
+                outputs={"Out": [acc]},
+            )
+            block.append_op(
+                type="where",
+                inputs={"Condition": [restart], "X": [p.name], "Y": [acc]},
+                outputs={"Out": [sum_name]},
+            )
+            bumped = unique_name.generate(num_name + "_bump")
+            block.append_op(
+                type="increment", inputs={"X": [num_name]},
+                outputs={"Out": [bumped]}, attrs={"step": 1.0},
+            )
+            one = unique_name.generate(num_name + "_one")
+            block.append_op(
+                type="fill_constant", outputs={"Out": [one]},
+                attrs={"shape": [1], "dtype": "float32", "value": 1.0},
+            )
+            block.append_op(
+                type="where",
+                inputs={"Condition": [restart], "X": [one], "Y": [bumped]},
+                outputs={"Out": [num_name]},
+            )
+            self._pairs.append((p.name, sum_name, num_name))
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def _guard():
+            scope = global_scope()
+            self._backup = {}
+            for pname, sname, nname in self._pairs:
+                self._backup[pname] = scope.find_var(pname)
+                s = np.asarray(scope.find_var(sname))
+                n = float(np.asarray(scope.find_var(nname))[0])
+                if n > 0:
+                    scope.set_var(pname, (s / n).astype(s.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _guard()
+
+    def restore(self, executor=None):
+        from .executor import global_scope
+
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
 # paddle-style short aliases (fluid.optimizer.SGD etc.)
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer

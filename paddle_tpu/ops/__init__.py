@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     math_ops,
     nn_ops,
     optimizer_ops,
+    recompute,
     reduce_ops,
 )
 from .registry import EmitContext, OpSpec, get, register, registered_ops  # noqa: F401

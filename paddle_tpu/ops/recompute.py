@@ -1,0 +1,54 @@
+"""Recompute (activation checkpointing) as a fused segment op.
+
+Parity surface: the reference's RecomputeOptimizer
+(/root/reference/python/paddle/fluid/optimizer.py:4478) and the
+checkpoint-aware backward builder
+(/root/reference/python/paddle/fluid/backward.py:629), which re-append
+forward op descs into the backward region so activations between
+checkpoints are recomputed instead of stored.
+
+TPU-native design: re-appending forward ops would be a no-op here —
+the whole block is one XLA program and XLA's CSE would fold the
+duplicated pure subgraph straight back into the primal one. Instead,
+each checkpoint segment is collapsed into ONE `recompute_segment` op
+whose emitter replays the segment's sub-ops under `jax.checkpoint`
+(remat). The generic vjp-based grad op then differentiates through the
+checkpointed function, so XLA receives real remat regions guarded by
+optimization barriers: only the segment inputs (the checkpoints) are
+kept live across forward→backward, and the segment body is recomputed
+in the backward pass.
+"""
+from __future__ import annotations
+
+from .registry import EmitContext, emit_ops, register
+
+
+def _infer_recompute(in_metas, attrs):
+    # outputs keep the metadata recorded at fusion time; segment sub-op
+    # tracing under eval_shape would re-run the whole body per insert.
+    return {"Out": [tuple(m) for m in attrs["recompute_out_metas"]]}
+
+
+@register("recompute_segment", infer_shape=_infer_recompute)
+def recompute_segment(ctx: EmitContext, ins, attrs):
+    import jax
+
+    sub_ops = attrs["recompute_sub_ops"]
+    in_names = attrs["recompute_in_names"]
+    out_names = attrs["recompute_out_names"]
+    salt = int(attrs.get("recompute_seg_salt", 0))
+
+    def body(*in_vals):
+        # Deterministic per-segment rng: both the primal emit and the grad
+        # op's re-trace (jax.vjp over this emitter) fold the same salt into
+        # the frozen per-step base key, so ops with internal randomness
+        # (dropout) draw identical masks in both traces.
+        sub_ctx = EmitContext(
+            rng_key=ctx.salted_rng(salt), mesh=ctx.mesh, axis_env=ctx.axis_env
+        )
+        env = dict(zip(in_names, in_vals))
+        emit_ops(sub_ctx, sub_ops, env)
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.checkpoint(body)(*ins["X"])
+    return {"Out": list(outs)}

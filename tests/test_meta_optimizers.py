@@ -1,0 +1,313 @@
+"""Meta-optimizer wrappers: recompute, gradient merge, lookahead, EMA,
+model average.
+
+Parity with the reference's optimizer-wrapper tests
+(python/paddle/fluid/tests/unittests/test_recompute_optimizer.py,
+test_gradient_merge_optimizer.py, test_lookahead.py, test_ema.py,
+test_model_average.py): train a small model and compare against either an
+unwrapped baseline or a numpy simulation of the wrapper's update rule.
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, layers
+from paddle_tpu.fluid.optimizer import (
+    AdamOptimizer,
+    ExponentialMovingAverage,
+    GradientMergeOptimizer,
+    LookaheadOptimizer,
+    ModelAverage,
+    RecomputeOptimizer,
+    SGDOptimizer,
+)
+
+
+def _mlp(x, label, hidden=32):
+    h1 = layers.fc(x, size=hidden, act="relu")
+    h2 = layers.fc(h1, size=hidden, act="relu")
+    logits = layers.fc(h2, size=4)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    return loss, (h1, h2)
+
+
+def _batches(n, bs=16, dim=8, seed0=0):
+    out = []
+    for s in range(n):
+        rng = np.random.RandomState(seed0 + s)
+        x = rng.randn(bs, dim).astype(np.float32)
+        y = rng.randint(0, 4, size=(bs, 1)).astype(np.int64)
+        out.append((x, y))
+    return out
+
+
+def _train(wrap, data, seed=7):
+    """Build a fresh program+scope (deterministic init via random_seed, the
+    test_fleet pattern), train over `data`, return the loss trace and the
+    final first-fc weight read from the scope (no extra step)."""
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        with framework.program_guard(main, startup):
+            x = layers.data("x", shape=[8])
+            label = layers.data("label", shape=[1], dtype="int64")
+            loss, (h1, h2) = _mlp(x, label)
+            if wrap is not None:
+                wrap(loss, h1, h2)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for bx, by in data:
+                (lv,) = exe.run(main, feed={"x": bx, "label": by}, fetch_list=[loss])
+                losses.append(float(lv[0]))
+            pname = main.global_block().all_parameters()[0].name
+            w = np.asarray(scope.find_var(pname))
+    return losses, w
+
+
+def test_recompute_matches_baseline():
+    data = _batches(6)
+
+    def base(loss, h1, h2):
+        SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    def recompute(loss, h1, h2):
+        opt = RecomputeOptimizer(SGDOptimizer(learning_rate=0.1))
+        opt._set_checkpoints([h1, h2])
+        opt.minimize(loss)
+
+    base_losses, base_w = _train(base, data)
+    rc_losses, rc_w = _train(recompute, data)
+    np.testing.assert_allclose(base_losses, rc_losses, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(base_w, rc_w, rtol=2e-5, atol=2e-6)
+
+
+def test_recompute_fuses_segments():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, (h1, h2) = _mlp(x, label)
+        opt = RecomputeOptimizer(SGDOptimizer(learning_rate=0.1))
+        opt._set_checkpoints([h1, h2])
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "recompute_segment" in types
+    # forward intermediates between checkpoints are no longer block-level ops
+    assert types.count("recompute_segment") >= 2
+
+
+def test_gradient_merge_equals_large_batch():
+    data = _batches(6)
+
+    def merged(loss, h1, h2):
+        GradientMergeOptimizer(
+            SGDOptimizer(learning_rate=0.1), k_steps=2, avg=True
+        ).minimize(loss)
+
+    m_losses, m_w = _train(merged, data)
+
+    # baseline: plain SGD stepping once per PAIR of microbatches on the
+    # concatenated batch (same gradient as averaging the two microbatch grads)
+    big = []
+    for i in range(0, 6, 2):
+        bx = np.concatenate([data[i][0], data[i + 1][0]])
+        by = np.concatenate([data[i][1], data[i + 1][1]])
+        big.append((bx, by))
+
+    def base(loss, h1, h2):
+        SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    b_losses, b_w = _train(base, big)
+    np.testing.assert_allclose(m_w, b_w, rtol=1e-4, atol=1e-5)
+
+
+def test_lookahead_update_rule():
+    data = _batches(4)
+    k, alpha, lr = 2, 0.5, 0.1
+
+    def look(loss, h1, h2):
+        LookaheadOptimizer(SGDOptimizer(learning_rate=lr), alpha=alpha, k=k).minimize(loss)
+
+    def base(loss, h1, h2):
+        SGDOptimizer(learning_rate=lr).minimize(loss)
+
+    # after 2 steps (one lookahead boundary): fast = w0 + alpha*(fast2 - w0)
+    l_losses, l_w = _train(look, data[:2])
+    b_losses, b_w = _train(base, data[:2])
+    # identical params until the first boundary -> first-step losses match
+    np.testing.assert_allclose(l_losses[0], b_losses[0], rtol=1e-5)
+    _, w0 = _train(None, [])  # 0 steps: the deterministic initial weight
+    expected = w0 + alpha * (b_w - w0)
+    np.testing.assert_allclose(l_w, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ema_apply_restore():
+    decay = 0.9
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, _hs = _mlp(x, label)
+        SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ema = ExponentialMovingAverage(decay)
+        ema.update()
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        pname = main.global_block().all_parameters()[0].name
+        snapshots = []
+        for bx, by in _batches(3):
+            exe.run(main, feed={"x": bx, "label": by}, fetch_list=[loss])
+            snapshots.append(
+                np.asarray(fluid.global_scope().find_var(pname))
+            )
+        # numpy EMA over the post-update parameter snapshots
+        ema_np = np.zeros_like(snapshots[0])
+        for s in snapshots:
+            ema_np = decay * ema_np + (1 - decay) * s
+        debias = 1 - decay ** len(snapshots)
+        raw = np.asarray(fluid.global_scope().find_var(pname))
+        with ema.apply():
+            applied = np.asarray(fluid.global_scope().find_var(pname))
+            np.testing.assert_allclose(applied, ema_np / debias, rtol=1e-5, atol=1e-6)
+        restored = np.asarray(fluid.global_scope().find_var(pname))
+        np.testing.assert_allclose(restored, raw, rtol=0, atol=0)
+
+
+def test_model_average_apply_restore():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, _hs = _mlp(x, label)
+        SGDOptimizer(learning_rate=0.1).minimize(loss)
+        # min_average_window=10 > #steps: no restart fires, the average
+        # covers every post-update snapshot (restart rule: num_acc >= min
+        # AND num_acc >= min(max, num_updates*rate), reference :3091)
+        ma = ModelAverage(0.15, min_average_window=10, max_average_window=100)
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        pname = main.global_block().all_parameters()[0].name
+        snapshots = []
+        for bx, by in _batches(4):
+            exe.run(main, feed={"x": bx, "label": by}, fetch_list=[loss])
+            snapshots.append(np.asarray(fluid.global_scope().find_var(pname)))
+        raw = np.asarray(fluid.global_scope().find_var(pname))
+        with ma.apply():
+            applied = np.asarray(fluid.global_scope().find_var(pname))
+            np.testing.assert_allclose(
+                applied, np.mean(snapshots, axis=0), rtol=1e-5, atol=1e-6
+            )
+        restored = np.asarray(fluid.global_scope().find_var(pname))
+        np.testing.assert_allclose(restored, raw, rtol=0, atol=0)
+        # window restart: tiny min window -> average over the trailing
+        # window only, not all history
+        num = np.asarray(fluid.global_scope().find_var(pname + "@MA_NUM"))
+        assert float(num[0]) == 4.0
+
+
+def test_fleet_recompute_and_gradient_merge_strategy():
+    """DistributedStrategy.recompute / gradient_merge paths compile+run."""
+    import paddle_tpu.fleet as fleet
+
+    fleet.init()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, (h1, h2) = _mlp(x, label)
+        strategy = fleet.DistributedStrategy()
+        strategy.recompute = True
+        strategy.recompute_configs = {"checkpoints": [h1.name, h2.name]}
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        opt = fleet.distributed_optimizer(SGDOptimizer(learning_rate=0.1), strategy)
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for bx, by in _batches(4):
+            (lv,) = exe.run(main, feed={"x": bx, "label": by}, fetch_list=[loss])
+            losses.append(float(lv[0]))
+        assert np.isfinite(losses).all()
+
+
+def test_recompute_segment_with_batch_norm_and_dropout():
+    """Regression: in-place read-modify-write vars (batch_norm running
+    stats) must stay segment inputs; dropout in a segment must get
+    consistent masks between primal and remat traces, and clone(for_test)
+    must rewrite is_test inside the fused segment."""
+    data = _batches(4, bs=16, dim=8)
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 3
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        with framework.program_guard(main, startup):
+            x = layers.data("x", shape=[8])
+            label = layers.data("label", shape=[1], dtype="int64")
+            h1 = layers.fc(x, size=16)
+            h1 = layers.batch_norm(h1, act="relu")
+            h1 = layers.dropout(h1, dropout_prob=0.3)
+            h2 = layers.fc(h1, size=16, act="relu")
+            logits = layers.fc(h2, size=4)
+            loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+            test_prog = main.clone(for_test=True)
+            opt = RecomputeOptimizer(SGDOptimizer(learning_rate=0.05))
+            opt._set_checkpoints([h1, h2])
+            opt.minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for bx, by in data:
+                (lv,) = exe.run(main, feed={"x": bx, "label": by}, fetch_list=[loss])
+                losses.append(float(lv[0]))
+            assert np.isfinite(losses).all()
+            # eval clone: deterministic (dropout off)
+            e1 = exe.run(test_prog, feed={"x": data[0][0], "label": data[0][1]},
+                         fetch_list=[loss.name])[0]
+            e2 = exe.run(test_prog, feed={"x": data[0][0], "label": data[0][1]},
+                         fetch_list=[loss.name])[0]
+            np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), rtol=0, atol=0)
+
+
+def test_ema_thres_steps_ramp():
+    """Scheduled decay: min(decay, (1+t)/(10+t)), debiased by 1-prod(decay)."""
+    decay = 0.999
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, _hs = _mlp(x, label)
+        SGDOptimizer(learning_rate=0.1).minimize(loss)
+        step_var = layers.fill_constant([1], "int64", 0)
+        # use the EMA's own int64 step as thres via a persistable counter
+        gstep = fluid.framework.default_main_program().global_block().create_var(
+            name="gstep", shape=(1,), dtype="int64", persistable=True)
+        sb = fluid.default_startup_program().global_block()
+        sv = sb.create_var(name="gstep", shape=(1,), dtype="int64", persistable=True)
+        from paddle_tpu.fluid.initializer import ConstantInitializer
+        ConstantInitializer(0.0)(sv, sb)
+        main.global_block().append_op(
+            type="increment", inputs={"X": ["gstep"]}, outputs={"Out": ["gstep"]},
+            attrs={"step": 1.0})
+        ema = ExponentialMovingAverage(decay, thres_steps=gstep)
+        ema.update()
+        exe = fluid.Executor()
+        exe.run(startup)
+        pname = main.global_block().all_parameters()[0].name
+        snapshots = []
+        for bx, by in _batches(3):
+            exe.run(main, feed={"x": bx, "label": by}, fetch_list=[loss])
+            snapshots.append(np.asarray(fluid.global_scope().find_var(pname)))
+        ema_np = np.zeros_like(snapshots[0])
+        prod = 1.0
+        for t, s in enumerate(snapshots, start=1):
+            d = min(decay, (1.0 + t) / (10.0 + t))
+            ema_np = d * ema_np + (1 - d) * s
+            prod *= d
+        with ema.apply():
+            applied = np.asarray(fluid.global_scope().find_var(pname))
+        np.testing.assert_allclose(applied, ema_np / (1 - prod), rtol=1e-5, atol=1e-6)
