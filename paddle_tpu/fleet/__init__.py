@@ -64,6 +64,23 @@ class DistributedOptimizer:
 
         strategy = self.user_defined_strategy
         inner = self.inner_opt
+        program = loss.block.program
+
+        mesh = strategy.mesh
+        if mesh is None:
+            axes = dict(strategy.mesh_axes) if strategy.mesh_axes else {"dp": -1}
+            mesh = create_mesh(axes)
+
+        sp_active = (
+            strategy.sequence_parallel
+            and "sp" in mesh.axis_names
+            and mesh.shape["sp"] > 1
+        )
+        # sequence parallel marks forward attention ops BEFORE backward, so
+        # the synthesized grad ops capture the attr and the backward ring
+        # is sequence-parallel too
+        if sp_active:
+            apply_sequence_parallel(program, mesh)
 
         # program rewrites that precede backward (AMP, recompute)
         if strategy.amp:
@@ -88,12 +105,12 @@ class DistributedOptimizer:
             parameter_list=parameter_list, no_grad_set=no_grad_set,
         )
 
-        program = loss.block.program
-        mesh = strategy.mesh
-        if mesh is None:
-            axes = dict(strategy.mesh_axes) if strategy.mesh_axes else {"dp": -1}
-            mesh = create_mesh(axes)
-        _parallel.shard_program_data_parallel(program, mesh, axis="dp")
+        if "dp" in mesh.axis_names:
+            _parallel.shard_program_data_parallel(program, mesh, axis="dp")
+        else:
+            program._mesh = mesh
+        if sp_active:
+            _parallel.shard_program_sequence_parallel(program, mesh, axis="sp")
         if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
             apply_tensor_parallel_rules(program, strategy.tensor_parallel_rules)
         program._mesh = mesh
@@ -107,6 +124,16 @@ class DistributedOptimizer:
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     return DistributedOptimizer(optimizer, strategy)
+
+
+def apply_sequence_parallel(program, mesh):
+    """Mark every attention-bearing op to use the ring-attention path over
+    the "sp" axis (parallel/ring_attention.py). Must run before
+    append_backward: grad ops snapshot forward attrs at creation."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in ("fused_multihead_attention", "fused_encoder_stack"):
+                op._set_attr("sequence_parallel", True)
 
 
 def apply_tensor_parallel_rules(program, rules):

@@ -6,6 +6,7 @@ reference's static REGISTER_OPERATOR initializers).
 from . import registry  # noqa: F401
 from . import (  # noqa: F401
     attention,
+    collective_ops,
     compare_ops,
     control_flow_ops,
     creation,

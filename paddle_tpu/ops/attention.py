@@ -61,17 +61,48 @@ def _use_pallas(q, dropout_prob, deterministic):
 
 @register("fused_multihead_attention")
 def fused_multihead_attention(ctx, ins, attrs):
+    from ..parallel.ring_attention import (
+        key_bias_from_attn_bias,
+        ring_attention_global,
+        use_ring,
+    )
+
     q3, k3, v3 = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins.get("BiasQK", [None])[0]
     nh = int(attrs["num_heads"])
     dropout_prob = float(attrs.get("dropout_prob", 0.0))
     is_test = bool(attrs.get("is_test", False))
+    causal = bool(attrs.get("causal", False))
+
+    if use_ring(ctx, attrs):
+        # sequence-parallel ring attention over the "sp" mesh axis; probs
+        # dropout is applied inside the ring (numerator-only masking)
+        b, s, h = q3.shape
+        key_bias = key_bias_from_attn_bias(bias, b)
+        dkey = None
+        if not is_test and dropout_prob > 0.0:
+            dkey = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+        out = ring_attention_global(
+            _split_heads(q3, nh), _split_heads(k3, nh), _split_heads(v3, nh),
+            ctx.mesh, axis="sp", bias=key_bias, causal=causal,
+            dropout_prob=0.0 if is_test else dropout_prob, dropout_key=dkey,
+        )
+        return {"Out": [_merge_heads(out)]}
 
     q = _split_heads(q3, nh)
     k = _split_heads(k3, nh)
     v = _split_heads(v3, nh)
 
-    if _use_pallas(q, dropout_prob, is_test):
+    if causal:
+        import numpy as _np
+
+        s = q.shape[2]
+        cmask = jnp.where(
+            _np.tril(_np.ones((s, s), bool)), 0.0, -1e30
+        )[None, None, :, :]
+        bias = cmask if bias is None else bias + cmask
+
+    if not causal and _use_pallas(q, dropout_prob, is_test):
         from .pallas.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, bias)

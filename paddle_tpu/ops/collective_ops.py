@@ -1,0 +1,108 @@
+"""Collective ops: c_allreduce_* / c_broadcast / c_allgather / c_reducescatter.
+
+Parity surface: /root/reference/paddle/fluid/operators/collective/
+(c_allreduce_op.h:73-106 calls ncclAllReduce on the ring keyed by ring_id;
+c_gen_nccl_id_op.cc + c_comm_init_op.cc bootstrap the rings).
+
+TPU-native design: there are no NCCL rings — a collective is an HLO op over
+a named mesh axis, and XLA schedules it on ICI/DCN. `ring_id` maps to a
+mesh axis name through EmitContext.axis_env, which is populated when the
+op is emitted inside a manual-SPMD region (shard_map — pipeline stages,
+ring attention, and the paddle_tpu.distributed functional API). Emitted
+outside any axis binding (the whole-program GSPMD path, where XLA inserts
+collectives from shardings, or a world-size-1 run) each op degrades to its
+single-participant semantics. Bootstrap ops (c_gen_nccl_id, c_comm_init)
+are no-ops kept for program compatibility: the JAX distributed coordination
+service replaces the NCCL-id gRPC exchange.
+
+Numerics are delegated to paddle_tpu.distributed — one implementation per
+collective.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _axis(ctx, attrs):
+    return ctx.axis_env.get(int(attrs.get("ring_id", 0)))
+
+
+def _allreduce(op_name):
+    def emit(ctx, ins, attrs):
+        from .. import distributed as dist
+
+        x = ins["X"][0]
+        ax = _axis(ctx, attrs)
+        if ax is None:
+            return {"Out": [x]}
+        return {"Out": [dist.all_reduce(x, op=op_name, group=ax)]}
+
+    return emit
+
+
+register("c_allreduce_sum")(_allreduce("sum"))
+register("c_allreduce_max")(_allreduce("max"))
+register("c_allreduce_min")(_allreduce("min"))
+register("c_allreduce_prod")(_allreduce("prod"))
+
+
+@register("c_broadcast")
+def c_broadcast(ctx, ins, attrs):
+    from .. import distributed as dist
+
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [dist.broadcast(x, src=int(attrs.get("root", 0)), group=ax)]}
+
+
+@register("c_allgather")
+def c_allgather(ctx, ins, attrs):
+    from .. import distributed as dist
+
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [dist.all_gather(x, group=ax)]}
+
+
+@register("c_reducescatter")
+def c_reducescatter(ctx, ins, attrs):
+    from .. import distributed as dist
+
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [dist.reduce_scatter(x, group=ax)]}
+
+
+@register("c_identity")
+def c_identity(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+def _noop(ctx, ins, attrs):
+    out = ins.get("X")
+    return {"Out": [out[0]]} if out else {}
+
+
+# stream-sync and bootstrap ops: single-program XLA has no separate
+# comm/calc streams and no NCCL-id exchange — kept as no-ops for parity
+register("c_sync_calc_stream", no_vjp_grad=True)(_noop)
+register("c_sync_comm_stream", no_vjp_grad=True)(_noop)
+register("c_gen_nccl_id", no_vjp_grad=True, no_infer=True)(lambda ctx, ins, attrs: {})
+register("c_comm_init", no_vjp_grad=True, no_infer=True)(lambda ctx, ins, attrs: {})
+register("c_comm_init_all", no_vjp_grad=True, no_infer=True)(lambda ctx, ins, attrs: {})
+
+
+@register("c_wait_compute", no_vjp_grad=True)
+def c_wait_compute(ctx, ins, attrs):
+    return _noop(ctx, ins, attrs)
+
+
+@register("c_wait_comm", no_vjp_grad=True)
+def c_wait_comm(ctx, ins, attrs):
+    return _noop(ctx, ins, attrs)

@@ -45,6 +45,10 @@ def fused_encoder_stack(ctx, ins, attrs):
     is_test = bool(attrs.get("is_test", False))
     eps = float(attrs.get("epsilon", 1e-5))
     use_flash = bool(attrs.get("use_flash_attention", True))
+    from ..parallel import ring_attention as ring_mod
+
+    ring = ring_mod.use_ring(ctx, attrs)
+    mesh = ctx.mesh
     base_key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
 
     stacked = {
@@ -81,7 +85,17 @@ def fused_encoder_stack(ctx, ins, attrs):
             return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
 
         q, k, v = split_heads(q), split_heads(k), split_heads(v)
-        if use_flash and (is_test or attn_dropout_prob == 0.0) and _flash_ok(s, dh):
+        if ring:
+            # sequence-parallel ring attention over "sp"; probs dropout runs
+            # inside the ring. shard_map inside the scan body is fine — XLA
+            # sees one ring schedule per layer iteration
+            key_bias = ring_mod.key_bias_from_attn_bias(bias, b)
+            ctx_l = ring_mod.ring_attention_global(
+                q, k, v, mesh, axis="sp", bias=key_bias, batch_axis="dp",
+                dropout_prob=0.0 if is_test else attn_dropout_prob,
+                dropout_key=None if is_test else k1,
+            )
+        elif use_flash and (is_test or attn_dropout_prob == 0.0) and _flash_ok(s, dh):
             from .pallas.flash_attention import flash_attention
 
             ctx_l = flash_attention(q, k, v, bias)

@@ -78,3 +78,25 @@ def shard_program_data_parallel(program, mesh, axis: str = "dp"):
         if getattr(v, "is_data", False) and v.shape:
             set_var_sharding(v, (axis,) + (None,) * (len(v.shape) - 1))
     program._mesh = mesh
+
+
+def shard_program_sequence_parallel(program, mesh, axis: str = "sp"):
+    """Additionally shard the sequence dim (dim 1) of feed variables over
+    `axis` — activations between attention ops then stay sequence-sharded
+    and XLA only gathers where an op genuinely needs the full sequence.
+    Vars whose dim 1 does not divide by the axis size (labels [B,1] etc.)
+    stay replicated on that dim, which is always correct under GSPMD."""
+    from jax.sharding import PartitionSpec
+
+    sp_size = mesh.shape[axis]
+    for v in program.list_vars():
+        if not (getattr(v, "is_data", False) and v.shape and len(v.shape) >= 2):
+            continue
+        s = v.shape[1]
+        if s is None or s <= 1 or (s > 0 and s % sp_size != 0):
+            continue
+        cur = get_var_sharding(v)
+        dims = list(cur) if cur is not None else []
+        dims += [None] * (len(v.shape) - len(dims))
+        dims[1] = axis
+        set_var_sharding(v, PartitionSpec(*dims))
