@@ -82,6 +82,12 @@ class DistributedOptimizer:
         if sp_active:
             apply_sequence_parallel(program, mesh)
 
+        pp_active = (
+            strategy.pipeline
+            and "pp" in mesh.axis_names
+            and mesh.shape["pp"] > 1
+        )
+
         # program rewrites that precede backward (AMP, recompute)
         if strategy.amp:
             from ..contrib.mixed_precision import decorate
@@ -99,6 +105,18 @@ class DistributedOptimizer:
                 inner, k_steps=strategy.gradient_merge_configs.get("k_steps", 1),
                 avg=strategy.gradient_merge_configs.get("avg", True),
             )
+        if pp_active:
+            # outermost: its minimize marks encoder stacks for the GPipe
+            # schedule before any wrapped pass appends backward ops.
+            # accumulate_steps <= 1 (the DistributedStrategy default) would
+            # mean M=1 — every stage idle (pp-1)/pp of the time — so fall
+            # back to one microbatch per stage
+            from ..fluid.optimizer import PipelineOptimizer
+
+            acc = int(strategy.pipeline_configs.get("accumulate_steps", 1))
+            if acc <= 1:
+                acc = mesh.shape["pp"]
+            inner = PipelineOptimizer(inner, num_microbatches=acc)
 
         result = inner.minimize(
             loss, startup_program=startup_program,
@@ -107,12 +125,12 @@ class DistributedOptimizer:
 
         if "dp" in mesh.axis_names:
             _parallel.shard_program_data_parallel(program, mesh, axis="dp")
-        else:
-            program._mesh = mesh
         if sp_active:
             _parallel.shard_program_sequence_parallel(program, mesh, axis="sp")
         if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
             apply_tensor_parallel_rules(program, strategy.tensor_parallel_rules)
+        if pp_active:
+            _shard_pipeline_params(program)
         program._mesh = mesh
         if startup_program is not None:
             startup_program._mesh = mesh
@@ -134,6 +152,25 @@ def apply_sequence_parallel(program, mesh):
         for op in block.ops:
             if op.type in ("fused_multihead_attention", "fused_encoder_stack"):
                 op._set_attr("sequence_parallel", True)
+
+
+def _shard_pipeline_params(program):
+    """Shard stacked encoder-layer parameters (dim 0 = layer) over "pp", so
+    each stage's weights live only on its own shard — the placement analog
+    of the reference's per-section scopes (pipeline_trainer.cc:212)."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type != "fused_encoder_stack" or not op.attr("pipeline"):
+                continue
+            for slot, names in op.inputs.items():
+                if slot in ("Hidden", "AttnBias"):
+                    continue
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable and v.shape:
+                        set_var_sharding(
+                            v, ("pp",) + (None,) * (len(v.shape) - 1)
+                        )
 
 
 def apply_tensor_parallel_rules(program, rules):

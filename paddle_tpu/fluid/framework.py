@@ -259,6 +259,9 @@ class Block:
             outputs=_normalize_io(outputs),
             attrs=attrs,
         )
+        dev = _current_op_device()
+        if dev is not None and "op_device" not in op.attrs:
+            op.attrs["op_device"] = dev
         self.ops.append(op)
         self._post_insert(op, infer)
         return op
@@ -543,6 +546,31 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
         switch_main_program(old_main)
         if old_startup is not None:
             switch_startup_program(old_startup)
+
+
+# ---------------------------------------------------------------------------
+# device_guard: pipeline-stage annotation (reference fluid.device_guard;
+# ops get attr "op_device" like the reference's OpDesc attribute consumed by
+# PipelineOptimizer, optimizer.py:3627)
+# ---------------------------------------------------------------------------
+
+_op_device_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Annotate ops appended in this scope with a device/stage tag, e.g.
+    "gpu:0". On TPU the tag names a pipeline stage, not a physical device —
+    placement is the mesh's job."""
+    _op_device_stack.append(device)
+    try:
+        yield
+    finally:
+        _op_device_stack.pop()
+
+
+def _current_op_device() -> Optional[str]:
+    return _op_device_stack[-1] if _op_device_stack else None
 
 
 # dygraph mode switch (filled in by paddle_tpu.fluid.dygraph)

@@ -920,6 +920,57 @@ def _fuse_recompute_segments(loss, checkpoint_names):
     block.program._bump_version()
 
 
+class PipelineOptimizer:
+    """Pipeline-parallel training (reference optimizer.py:3627 +
+    PipelineTrainer/SectionWorker, framework/section_worker.cc:82).
+
+    TPU-native design: the reference splits the program into per-device
+    sections and streams microbatches through them on threads connected by
+    concurrent queues. Here the pipeline is expressed INSIDE the compiled
+    step: scan-based encoder stacks (`fused_encoder_stack`) get a GPipe
+    schedule over the "pp" mesh axis (layer-dim-sharded params, microbatch
+    activations rotating via ppermute — ops/encoder_stack.py:_gpipe_stack),
+    and the whole fwd+bwd+update remains one differentiable XLA program.
+    `device_guard` stage tags (attr "op_device") are accepted for program
+    parity; ops carrying them run co-scheduled by XLA — with SPMD there is
+    no benefit to thread-level sections, the pp axis carries the
+    parallelism."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self.inner_opt = optimizer
+        self._num_microbatches = int(num_microbatches)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if framework.in_dygraph_mode():
+            raise RuntimeError("PipelineOptimizer is static-graph only")
+        program = loss.block.program
+        # mark pipeline-able ops BEFORE backward so grad ops capture attrs
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type == "fused_encoder_stack":
+                    op._set_attr("pipeline", True)
+                    op._set_attr("num_microbatches", self._num_microbatches)
+        self._stage_ops = self._collect_stages(program)
+        return self.inner_opt.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+        )
+
+    @staticmethod
+    def _collect_stages(program):
+        """Group ops by device_guard tag (diagnostics/parity)."""
+        stages = {}
+        for block in program.blocks:
+            for op in block.ops:
+                dev = op.attr("op_device")
+                if dev is not None:
+                    stages.setdefault(dev, []).append(op)
+        return stages
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
 class ExponentialMovingAverage:
     """EMA of trainable parameters (reference optimizer.py:3381).
 

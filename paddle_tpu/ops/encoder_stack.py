@@ -9,6 +9,19 @@ steady-state FLOPs. Used by the flagship bench path; the unrolled
 per-layer builder (models/bert.py encoder_layer) stays for parity and
 per-layer tensor-parallel rules.
 
+Parallel modes (attrs set by fleet; see fleet/__init__.py):
+  sequence_parallel — ring attention over the "sp" mesh axis
+  pipeline          — GPipe over the "pp" mesh axis: stacked layer params
+                      are sharded on the layer dim (each stage owns L/pp
+                      consecutive layers); the batch is split into
+                      num_microbatches and activations flow stage-to-stage
+                      with lax.ppermute inside a lax.scan over
+                      M + pp - 1 ticks. The TPU-native replacement for the
+                      reference's SectionWorker thread pipeline
+                      (/root/reference/paddle/fluid/framework/section_worker.cc:82,
+                       pipeline_trainer.cc:24) — same microbatch schedule,
+                      but expressed as one differentiable XLA program.
+
 Slots (all stacked on dim 0 = layer):
   Hidden [B,S,H], AttnBias [B,1,1,S],
   QKVW [L,H,3H], QKVB [L,3H], OutW [L,H,H], OutB [L,H],
@@ -24,6 +37,11 @@ import jax.numpy as jnp
 
 from .registry import register
 
+_PARAM_KEYS = (
+    "QKVW", "QKVB", "OutW", "OutB", "Ln1S", "Ln1B",
+    "FfnW1", "FfnB1", "FfnW2", "FfnB2", "Ln2S", "Ln2B",
+)
+
 
 def _act(name):
     return {
@@ -32,6 +50,15 @@ def _act(name):
         "tanh": jnp.tanh,
         "silu": jax.nn.silu,
     }[name]
+
+
+def _use_gpipe(ctx, attrs):
+    return (
+        bool(attrs.get("pipeline", False))
+        and ctx.mesh is not None
+        and "pp" in ctx.mesh.axis_names
+        and ctx.mesh.shape["pp"] > 1
+    )
 
 
 @register("fused_encoder_stack")
@@ -51,15 +78,7 @@ def fused_encoder_stack(ctx, ins, attrs):
     mesh = ctx.mesh
     base_key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
 
-    stacked = {
-        k: ins[k][0]
-        for k in (
-            "QKVW", "QKVB", "OutW", "OutB", "Ln1S", "Ln1B",
-            "FfnW1", "FfnB1", "FfnW2", "FfnB2", "Ln2S", "Ln2B",
-        )
-    }
-    b, s, h = hidden.shape
-    dh = h // nh
+    stacked = {k: ins[k][0] for k in _PARAM_KEYS}
 
     def ln(x, scale, shift):
         mu = jnp.mean(x, axis=-1, keepdims=True)
@@ -72,54 +91,159 @@ def fused_encoder_stack(ctx, ins, attrs):
         keep = jax.random.bernoulli(key, 1.0 - prob, x.shape)
         return jnp.where(keep, x / (1.0 - prob), 0.0)
 
-    def layer(carry, xs):
-        hid, idx = carry
-        p = xs
-        key = jax.random.fold_in(base_key, idx)
-        k1, k2, k3 = jax.random.split(key, 3)
+    def make_layer(bias_arr, mb_salt=None):
+        """Layer body closed over a (possibly microbatch-sliced) attention
+        bias; batch size is read from the carried hidden state. mb_salt
+        (pipeline path) decorrelates dropout masks across microbatches."""
 
-        qkv = jnp.einsum("bsh,hk->bsk", hid, p["QKVW"]) + p["QKVB"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        def layer(carry, p):
+            hid, idx = carry
+            b, s, h = hid.shape
+            dh = h // nh
+            key = jax.random.fold_in(base_key, idx)
+            if mb_salt is not None:
+                key = jax.random.fold_in(key, mb_salt)
+            k1, k2, k3 = jax.random.split(key, 3)
 
-        def split_heads(x):
-            return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+            qkv = jnp.einsum("bsh,hk->bsk", hid, p["QKVW"]) + p["QKVB"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+            def split_heads(x):
+                return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+
+            q, k, v = split_heads(q), split_heads(k), split_heads(v)
+            if ring:
+                # sequence-parallel ring attention over "sp"; probs dropout
+                # runs inside the ring. shard_map inside the scan body is
+                # fine — XLA sees one ring schedule per layer iteration
+                key_bias = ring_mod.key_bias_from_attn_bias(bias_arr, b)
+                ctx_l = ring_mod.ring_attention_global(
+                    q, k, v, mesh, axis="sp", bias=key_bias, batch_axis="dp",
+                    dropout_prob=0.0 if is_test else attn_dropout_prob,
+                    dropout_key=None if is_test else k1,
+                )
+            elif use_flash and (is_test or attn_dropout_prob == 0.0) and _flash_ok(s, dh):
+                from .pallas.flash_attention import flash_attention
+
+                ctx_l = flash_attention(q, k, v, bias_arr)
+            else:
+                scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(dh)
+                if bias_arr is not None:
+                    scores = scores + bias_arr.astype(scores.dtype)
+                probs = jax.nn.softmax(scores, axis=-1)
+                probs = dropout(probs, attn_dropout_prob, k1)
+                ctx_l = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+            ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
+
+            attn_out = jnp.einsum("bsh,hk->bsk", ctx_l, p["OutW"]) + p["OutB"]
+            attn_out = dropout(attn_out, dropout_prob, k2)
+            hid = ln(hid + attn_out, p["Ln1S"], p["Ln1B"])
+
+            inter = act(jnp.einsum("bsh,hf->bsf", hid, p["FfnW1"]) + p["FfnB1"])
+            ffn_out = jnp.einsum("bsf,fh->bsh", inter, p["FfnW2"]) + p["FfnB2"]
+            ffn_out = dropout(ffn_out, dropout_prob, k3)
+            hid = ln(hid + ffn_out, p["Ln2S"], p["Ln2B"])
+            return (hid, idx + 1), None
+
+        return layer
+
+    if _use_gpipe(ctx, attrs):
         if ring:
-            # sequence-parallel ring attention over "sp"; probs dropout runs
-            # inside the ring. shard_map inside the scan body is fine — XLA
-            # sees one ring schedule per layer iteration
-            key_bias = ring_mod.key_bias_from_attn_bias(bias, b)
-            ctx_l = ring_mod.ring_attention_global(
-                q, k, v, mesh, axis="sp", bias=key_bias, batch_axis="dp",
-                dropout_prob=0.0 if is_test else attn_dropout_prob,
-                dropout_key=None if is_test else k1,
+            raise NotImplementedError(
+                "pipeline + sequence_parallel on one encoder stack is not "
+                "supported yet; use pp with dp/tp"
             )
-        elif use_flash and (is_test or attn_dropout_prob == 0.0) and _flash_ok(s, dh):
-            from .pallas.flash_attention import flash_attention
+        M = int(attrs.get("num_microbatches", 0)) or mesh.shape["pp"]
+        out = _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer)
+        return {"Out": [out]}
 
-            ctx_l = flash_attention(q, k, v, bias)
-        else:
-            scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(dh)
-            if bias is not None:
-                scores = scores + bias.astype(scores.dtype)
-            probs = jax.nn.softmax(scores, axis=-1)
-            probs = dropout(probs, attn_dropout_prob, k1)
-            ctx_l = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
-        ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
-
-        attn_out = jnp.einsum("bsh,hk->bsk", ctx_l, p["OutW"]) + p["OutB"]
-        attn_out = dropout(attn_out, dropout_prob, k2)
-        hid = ln(hid + attn_out, p["Ln1S"], p["Ln1B"])
-
-        inter = act(jnp.einsum("bsh,hf->bsf", hid, p["FfnW1"]) + p["FfnB1"])
-        ffn_out = jnp.einsum("bsf,fh->bsh", inter, p["FfnW2"]) + p["FfnB2"]
-        ffn_out = dropout(ffn_out, dropout_prob, k3)
-        hid = ln(hid + ffn_out, p["Ln2S"], p["Ln2B"])
-        return (hid, idx + 1), None
-
+    layer = make_layer(bias)
     (out, _), _ = jax.lax.scan(layer, (hidden, jnp.int32(0)), stacked)
     return {"Out": [out]}
+
+
+def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer):
+    """GPipe schedule over the "pp" axis. Stage s owns layers
+    [s*L/pp, (s+1)*L/pp); microbatch m enters stage 0 at tick m and leaves
+    stage pp-1 at tick m+pp-1. Activations rotate via ppermute; the
+    attention bias is replicated over pp, so each stage just indexes the
+    microbatch it is currently processing (m = t - s) — no transfer."""
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    npp = mesh.shape["pp"]
+    dp = "dp" if "dp" in mesh.axis_names else None
+    dp_size = mesh.shape[dp] if dp else 1
+    L = stacked["QKVW"].shape[0]
+    if L % npp != 0:
+        raise ValueError(f"num layers {L} must divide by pp={npp}")
+    B = hidden.shape[0]
+    if B % (dp_size * M) != 0:
+        raise ValueError(
+            f"per-dp-shard batch {B}//{dp_size} must divide by "
+            f"num_microbatches={M}"
+        )
+
+    keys = list(_PARAM_KEYS)
+    hid_spec = P(dp, None, None)
+    bias_spec = P(dp, None, None, None)
+    p_specs = tuple(P("pp") for _ in keys)
+    perm = [(i, i + 1) for i in range(npp - 1)]
+
+    def body(hid_l, bias_l, *p_locals):
+        s_idx = lax.axis_index("pp")
+        l_loc = L // npp
+        b_loc = hid_l.shape[0]
+        mb = b_loc // M
+        mbs = hid_l.reshape(M, mb, *hid_l.shape[1:])
+        bias_mbs = (
+            bias_l.reshape(M, mb, *bias_l.shape[1:]) if bias_l is not None else None
+        )
+        p_local = dict(zip(keys, p_locals))
+
+        def stage(x, bias_x, mb_salt):
+            layer = make_layer(bias_x, mb_salt)
+            start = s_idx * l_loc
+            (out, _), _ = lax.scan(layer, (x, start), p_local)
+            return out
+
+        def tick(carry, t):
+            recv_x = carry
+            # the microbatch this stage works on at tick t (bubble ticks
+            # clamp to a valid index; their output is discarded)
+            m_cur = jnp.clip(t - s_idx, 0, M - 1)
+            x0 = lax.dynamic_index_in_dim(mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(s_idx == 0, x0, recv_x)
+            b_in = (
+                lax.dynamic_index_in_dim(bias_mbs, m_cur, 0, keepdims=False)
+                if bias_mbs is not None
+                else None
+            )
+            out = stage(x_in, b_in, m_cur)
+            send_x = lax.ppermute(out, "pp", perm)
+            emit = jnp.logical_and(s_idx == npp - 1, t >= npp - 1)
+            y = jnp.where(emit, out, jnp.zeros_like(out))
+            return send_x, y
+
+        _, ys = lax.scan(tick, jnp.zeros_like(mbs[0]), jnp.arange(M + npp - 1))
+        # microbatch m finishes at tick m + npp - 1 (on the last stage)
+        out_l = ys[npp - 1:].reshape(b_loc, *hid_l.shape[1:])
+        # only the last stage holds nonzero output; psum broadcasts it
+        return lax.psum(out_l, "pp")
+
+    if bias is None:
+        def body_nobias(hid_l, *p_locals):
+            return body(hid_l, None, *p_locals)
+
+        return shard_map(
+            body_nobias, mesh=mesh, in_specs=(hid_spec,) + p_specs,
+            out_specs=hid_spec, check_vma=False,
+        )(hidden, *[stacked[k] for k in keys])
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(hid_spec, bias_spec) + p_specs,
+        out_specs=hid_spec, check_vma=False,
+    )(hidden, bias, *[stacked[k] for k in keys])
 
 
 def _flash_ok(s, dh):
