@@ -7,7 +7,7 @@ ICI/DCN; hot kernels use Pallas. See SURVEY.md for the design blueprint.
 """
 __version__ = "0.1.0"
 
-from . import fluid, ops  # noqa: F401
+from . import fluid, ops, reader  # noqa: F401
 from .fluid import (  # noqa: F401
     CPUPlace,
     Executor,
@@ -24,3 +24,20 @@ from .fluid import (  # noqa: F401
 
 CUDAPlace = fluid.CUDAPlace
 XLAPlace = fluid.XLAPlace
+
+
+def batch(reader_fn, batch_size, drop_last=False):
+    """Group a sample reader into a batch reader (reference
+    python/paddle/batch.py)."""
+
+    def batch_reader():
+        b = []
+        for sample in reader_fn():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,152 @@
+"""Dataset API over the native data feed.
+
+Parity surface: /root/reference/python/paddle/fluid/dataset.py
+(DatasetFactory:22, InMemoryDataset:328 with load_into_memory:611 and
+global_shuffle:684, QueueDataset:852), backed in the reference by the C++
+Dataset/DataFeed (framework/data_set.h, data_feed.h). Here the backend is
+paddle_tpu/native/datafeed.cc (reader threads -> channel -> batches) with
+a pure-Python fallback.
+
+Records are text lines of whitespace-separated floats; set_use_var
+declares the per-sample schema — each row is the concatenation of the
+flattened vars in order (the dense MultiSlot layout)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from . import framework
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist: List[str] = []
+        self._use_vars: List[framework.Variable] = []
+        self._seed = 0
+        self._shuffle_buffer = 0
+        self._feed = None
+
+    # -- reference surface -------------------------------------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+        self._feed = None
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):  # parity stub: no pipe preprocessing
+        self._pipe_command = cmd
+
+    # -- schema ------------------------------------------------------------
+    def _widths(self):
+        ws = []
+        for v in self._use_vars:
+            shape = [d for d in (v.shape or (1,)) if d != -1]
+            ws.append(int(np.prod(shape)) if shape else 1)
+        return ws
+
+    def _ncols(self):
+        return sum(self._widths())
+
+    def _make_feed(self, shuffle_buffer=0):
+        from ..native import make_datafeed
+
+        return make_datafeed(
+            self._ncols(), self._batch_size,
+            shuffle_buffer=shuffle_buffer, seed=self._seed,
+        )
+
+    def _split_batch(self, rows: np.ndarray):
+        """rows [n, ncols] -> feed dict keyed by use_var names."""
+        out = {}
+        off = 0
+        n = rows.shape[0]
+        for v, w in zip(self._use_vars, self._widths()):
+            chunk = rows[:, off:off + w]
+            off += w
+            shape = [d for d in (v.shape or ()) if d != -1]
+            arr = chunk.reshape((n, *shape)) if shape else chunk.reshape(n)
+            if v.dtype is not None and arr.dtype != v.dtype:
+                arr = arr.astype(v.dtype)
+            out[v.name] = arr
+        return out
+
+    def _as_loader(self, drop_last=True):
+        feed = self._iter_feed()
+        for rows in feed:
+            if drop_last and rows.shape[0] < self._batch_size:
+                continue
+            yield self._split_batch(rows)
+
+    def _iter_feed(self):
+        raise NotImplementedError
+
+
+class QueueDataset(DatasetBase):
+    """Streaming mode (reference dataset.py:852): reader threads feed the
+    channel; batches stream out without landing in host memory."""
+
+    def _iter_feed(self):
+        feed = self._make_feed(shuffle_buffer=self._shuffle_buffer)
+        feed.set_filelist(self._filelist)
+        return iter(feed)
+
+    def local_shuffle(self, buffer_size: int = 1024):
+        self._shuffle_buffer = int(buffer_size)
+
+
+class InMemoryDataset(DatasetBase):
+    """Out-of-core -> in-memory mode (reference dataset.py:328)."""
+
+    def __init__(self):
+        super().__init__()
+        self._loaded = None
+
+    def load_into_memory(self):
+        self._loaded = self._make_feed()
+        self._loaded.set_filelist(self._filelist)
+        self._loaded.load_into_memory()
+
+    def local_shuffle(self):
+        self._require_loaded()
+        self._loaded.shuffle()
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Single-host build: all data is already local, so global == local
+        (the reference shuffles across trainers via the PS; multi-host
+        sharding belongs to each host's filelist split)."""
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        self._require_loaded()
+        return self._loaded.memory_size()
+
+    def release_memory(self):
+        self._loaded = None
+
+    def _require_loaded(self):
+        if self._loaded is None:
+            raise RuntimeError("call load_into_memory() first")
+
+    def _iter_feed(self):
+        self._require_loaded()
+        self._loaded.rewind()
+        return iter(self._loaded)

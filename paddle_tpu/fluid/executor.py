@@ -270,4 +270,44 @@ class Executor:
         )
 
 
+    # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Train by streaming batches from a Dataset (reference
+        executor.py:1546 → C++ MultiTrainer/HogwildWorker hot loop,
+        hogwild_worker.cc:191). The TPU executor has no per-thread scopes:
+        the dataset iterator feeds the one compiled step, which is already
+        the whole fwd+bwd+update program."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        fetch_list = list(fetch_list or [])
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in fetch_list
+        ]
+        last = None
+        for step, feed in enumerate(dataset._as_loader(drop_last=True)):
+            last = self.run(
+                program, feed=feed, fetch_list=fetch_names, scope=scope
+            )
+            if debug and fetch_names and step % print_period == 0:
+                info = fetch_info or fetch_names
+                vals = ", ".join(
+                    f"{n}={np.asarray(v).reshape(-1)[0]:.6f}"
+                    for n, v in zip(info, last)
+                )
+                print(f"step {step}: {vals}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Same loop over a test-mode program (reference executor.py)."""
+        return self.train_from_dataset(
+            program, dataset, scope, thread, debug, fetch_list, fetch_info,
+            print_period,
+        )
+
+
 # parity alias: reference as_lodtensor etc. are unnecessary (numpy in/out)

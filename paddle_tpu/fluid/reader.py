@@ -1,0 +1,150 @@
+"""DataLoader / GeneratorLoader / PyReader: host data pipeline.
+
+Parity surface: /root/reference/python/paddle/fluid/reader.py
+(DataLoader:112, from_generator:372, GeneratorLoader:953, PyReader:1213)
+and the C++ reader ops (operators/reader/buffered_reader.cc — async
+double buffering).
+
+TPU-native design: the reference pushes LoDTensors into a C++ blocking
+queue consumed by read ops inside the program. Here feeding is explicit
+(Executor.run(feed=...)), so the loader's job is pipelining: a background
+thread drains the user generator into a bounded queue (double buffering)
+while the previous step runs on device; batches come out as feed dicts.
+The file-backed path is the native C++ feed (paddle_tpu/native)."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from . import framework
+
+_END = object()
+
+
+class GeneratorLoader:
+    """Reference reader.py:953. iterable mode only (the non-iterable
+    start()/reset() protocol existed for in-program read ops, which the
+    whole-block XLA executor does not need)."""
+
+    def __init__(self, feed_list=None, capacity=64, iterable=True,
+                 return_list=False, drop_last=True):
+        self._feed_list = list(feed_list or [])
+        self._names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in self._feed_list
+        ]
+        self._capacity = int(capacity)
+        self._iterable = iterable
+        self._return_list = return_list
+        self._drop_last = drop_last
+        self._batch_reader: Optional[Callable] = None
+
+    # -- generator flavors (reference from_generator API) ----------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):
+        def batch_reader():
+            batch = []
+            for sample in reader():
+                if not isinstance(sample, (tuple, list)):
+                    sample = (sample,)
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    yield _stack_samples(batch)
+                    batch = []
+            if batch and not drop_last:
+                yield _stack_samples(batch)
+
+        self._batch_reader = batch_reader
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batch_reader():
+            for sample_list in reader():
+                yield _stack_samples(sample_list)
+
+        self._batch_reader = batch_reader
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        return self
+
+    # -- iteration with background prefetch ------------------------------
+    def __iter__(self):
+        if self._batch_reader is None:
+            raise RuntimeError(
+                "DataLoader: call set_sample_generator / "
+                "set_sample_list_generator / set_batch_generator first"
+            )
+        q: queue.Queue = queue.Queue(maxsize=self._capacity)
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for batch in self._batch_reader():
+                    q.put(batch)
+            except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            arrays = [np.asarray(a) for a in item]
+            if self._return_list or not self._names:
+                yield arrays
+            else:
+                yield dict(zip(self._names, arrays))
+
+
+def _stack_samples(samples):
+    ncol = len(samples[0])
+    return [np.stack([np.asarray(s[i]) for s in samples]) for i in range(ncol)]
+
+
+class DataLoader:
+    """Reference reader.py:112."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        return GeneratorLoader(
+            feed_list=feed_list, capacity=capacity, iterable=iterable,
+            return_list=return_list, drop_last=drop_last,
+        )
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Iterate a Dataset (fluid/dataset.py) as feed dicts."""
+        return dataset._as_loader(drop_last=drop_last)
+
+
+class PyReader:
+    """Legacy wrapper (reference reader.py:1213): decorate_* map onto the
+    GeneratorLoader flavors."""
+
+    def __init__(self, feed_list=None, capacity=64, iterable=True,
+                 return_list=False):
+        self._loader = GeneratorLoader(feed_list, capacity, iterable, return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        self._loader.set_sample_generator(sample_generator, batch_size, drop_last)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._loader.set_sample_list_generator(reader)
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._loader.set_batch_generator(reader)
+
+    def __iter__(self):
+        return iter(self._loader)

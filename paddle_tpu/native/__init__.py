@@ -1,0 +1,191 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference keeps its data pipeline in C++ (framework/data_feed.cc,
+data_set.cc — reader threads, channels, global shuffle) because Python
+readers can't keep accelerators fed. Same decision here: datafeed.cc is
+compiled on first use with the system g++ into libdatafeed.so next to
+this file (no pybind11 in the image; ctypes keeps the binding
+dependency-free). Every native class has a pure-Python fallback so the
+framework works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "datafeed.cc")
+_SO = os.path.join(_HERE, "libdatafeed.so")
+_lock = threading.Lock()
+_lib = None
+_build_err: str | None = None
+
+
+def _load():
+    global _lib, _build_err
+    with _lock:
+        if _lib is not None or _build_err is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
+                     "-o", _SO, "-lpthread"],
+                    check=True, capture_output=True, text=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.df_create.restype = ctypes.c_void_p
+            lib.df_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_uint64]
+            lib.df_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.df_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.df_next_batch.restype = ctypes.c_int
+            lib.df_next_batch.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_float),
+                                          ctypes.c_int]
+            lib.df_load_into_memory.argtypes = [ctypes.c_void_p]
+            lib.df_shuffle.argtypes = [ctypes.c_void_p]
+            lib.df_memory_size.restype = ctypes.c_long
+            lib.df_memory_size.argtypes = [ctypes.c_void_p]
+            lib.df_rewind.argtypes = [ctypes.c_void_p]
+            lib.df_destroy.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 — record and fall back
+            _build_err = str(e)
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeDataFeed:
+    """Threaded file->channel->batch reader over dense float32 rows.
+
+    Rows are whitespace-separated floats, `ncols` per line (the dense
+    MultiSlot layout). shuffle_buffer > 1 enables channel-level local
+    shuffle; load_into_memory()+shuffle() is the global-shuffle mode."""
+
+    def __init__(self, ncols: int, batch_size: int, channel_capacity: int = 4096,
+                 shuffle_buffer: int = 0, seed: int = 0):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(f"native datafeed unavailable: {_build_err}")
+        self.ncols = ncols
+        self.batch_size = batch_size
+        self._h = self._lib.df_create(
+            ncols, batch_size, channel_capacity, shuffle_buffer, seed
+        )
+        self._started = False
+        self._loaded = False
+
+    def set_filelist(self, files):
+        for f in files:
+            self._lib.df_add_file(self._h, os.fsencode(f))
+
+    def load_into_memory(self):
+        self._lib.df_load_into_memory(self._h)
+        self._loaded = True
+
+    def shuffle(self):
+        self._lib.df_shuffle(self._h)
+
+    def memory_size(self) -> int:
+        return int(self._lib.df_memory_size(self._h))
+
+    def rewind(self):
+        self._lib.df_rewind(self._h)
+
+    def __iter__(self):
+        if not self._loaded and not self._started:
+            self._lib.df_start(self._h, 4)
+            self._started = True
+        buf = np.empty((self.batch_size, self.ncols), np.float32)
+        while True:
+            n = self._lib.df_next_batch(
+                self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.batch_size,
+            )
+            if n == 0:
+                return
+            yield buf[:n].copy()
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.df_destroy(h)
+
+
+class PythonDataFeed:
+    """Pure-Python fallback with the same surface (no reader threads)."""
+
+    def __init__(self, ncols, batch_size, channel_capacity=4096,
+                 shuffle_buffer=0, seed=0):
+        self.ncols = ncols
+        self.batch_size = batch_size
+        self.shuffle_buffer = shuffle_buffer
+        self.seed = seed
+        self.files = []
+        self._memory = None
+
+    def set_filelist(self, files):
+        self.files = list(files)
+
+    def _rows(self):
+        rng = np.random.RandomState(self.seed)
+        window = []
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) < self.ncols:
+                        continue
+                    row = np.asarray(parts[: self.ncols], np.float32)
+                    if self.shuffle_buffer > 1:
+                        window.append(row)
+                        if len(window) >= self.shuffle_buffer:
+                            j = rng.randint(len(window))
+                            window[j], window[-1] = window[-1], window[j]
+                            yield window.pop()
+                    else:
+                        yield row
+        while window:
+            j = rng.randint(len(window))
+            window[j], window[-1] = window[-1], window[j]
+            yield window.pop()
+
+    def load_into_memory(self):
+        self._memory = list(self._rows())
+
+    def shuffle(self):
+        rng = np.random.RandomState(self.seed ^ 0x9E3779B9)
+        rng.shuffle(self._memory)
+
+    def memory_size(self):
+        return len(self._memory or [])
+
+    def rewind(self):
+        pass
+
+    def __iter__(self):
+        rows = self._memory if self._memory is not None else self._rows()
+        batch = []
+        for row in rows:
+            batch.append(row)
+            if len(batch) == self.batch_size:
+                yield np.stack(batch)
+                batch = []
+        if batch:
+            yield np.stack(batch)
+
+
+def make_datafeed(ncols, batch_size, **kw):
+    """Native feed when the toolchain is available, Python fallback else."""
+    if native_available():
+        return NativeDataFeed(ncols, batch_size, **kw)
+    return PythonDataFeed(ncols, batch_size, **kw)

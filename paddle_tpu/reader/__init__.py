@@ -1,0 +1,182 @@
+"""Reader decorators (reference python/paddle/reader/decorator.py).
+
+A reader is a zero-arg callable returning an iterable of samples. These
+combinators compose readers; paddle_tpu.batch() groups samples into
+batches. Pure host-side Python — device feeding is the Executor's job.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "cache", "xmap_readers", "multiprocess_reader",
+]
+
+
+def map_readers(func, *readers):
+    """Apply func elementwise over samples zipped from readers."""
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Window-shuffle: fill a buf_size buffer, emit randomly."""
+
+    def shuffled():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into flat tuples: (a, (b, c)) -> (a, b, c)."""
+
+    def _flatten(x):
+        out = []
+        for item in x:
+            if isinstance(item, tuple):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)
+
+    def reader():
+        its = [r() for r in readers]
+        for items in (zip(*its) if not check_alignment else _strict_zip(its)):
+            yield _flatten(items)
+
+    def _strict_zip(its):
+        sentinel = object()
+        for items in itertools.zip_longest(*its, fillvalue=sentinel):
+            if sentinel in items:
+                raise ValueError("compose: readers have different lengths")
+            yield items
+
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples."""
+
+    def buffered_reader():
+        q: Queue = Queue(maxsize=size)
+        end = object()
+
+        def worker():
+            for d in reader():
+                q.put(d)
+            q.put(end)
+
+        Thread(target=worker, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            yield item
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def reader_n():
+        return itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def cache(reader):
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+
+    return cached
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads."""
+
+    def xreader():
+        in_q: Queue = Queue(buffer_size)
+        out_q: Queue = Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        Thread(target=feed, daemon=True).start()
+        workers = [Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        done = 0
+        pending = {}
+        next_i = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is end:
+                done += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Parity alias: thread-based fan-in (TPU hosts feed via one process;
+    the reference's fork-based version exists for CPU-bound decode)."""
+    def reader():
+        qs = [buffered(r, queue_size // max(len(readers), 1))() for r in readers]
+        for items in itertools.zip_longest(*qs, fillvalue=None):
+            for it in items:
+                if it is not None:
+                    yield it
+
+    return reader
