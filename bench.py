@@ -4,6 +4,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = achieved MFU / 0.35 (the BASELINE.json north star:
 ERNIE/BERT-base pretraining at >=35% MFU; the reference publishes no
 in-repo numbers — see BASELINE.md).
+
+Measurement protocol (steady state, device-resident data):
+  - bf16 AMP via the framework's own rewriter (contrib/mixed_precision),
+    reference parity point decorator.py:218
+  - the fixed batch is uploaded to the device ONCE; the step loop issues
+    async dispatches and syncs once at the end — matching how a real
+    input pipeline (device prefetch) behaves, and excluding the dev-type
+    tunnel's host<->device latency from steady-state numbers
 """
 import json
 import os
@@ -44,9 +52,11 @@ def _bert_step_flops(cfg, batch, seq):
 
 
 def main():
+    import jax
     import numpy as np
 
     import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import mixed_precision as mixed_prec
     from paddle_tpu.models.bert import (
         BertConfig,
         build_bert_pretrain_program,
@@ -55,10 +65,11 @@ def main():
 
     cfg = BertConfig.base()
     cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
-    batch = int(os.environ.get("BENCH_BATCH", 8))
+    batch = int(os.environ.get("BENCH_BATCH", 32))
     seq = int(os.environ.get("BENCH_SEQ", 512))
     max_preds = 76
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
 
     main_p = fluid.Program()
     startup = fluid.Program()
@@ -67,11 +78,15 @@ def main():
     )
     with fluid.program_guard(m, st):
         opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        if use_amp:
+            opt = mixed_prec.decorate(opt, use_bf16=True)
         opt.minimize(loss)
 
     exe = fluid.Executor()
     exe.run(st)
     data = random_pretrain_batch(cfg, batch, seq, max_preds, seed=0)
+    # device-resident feed: upload once, reuse every step
+    data = {k: jax.device_put(np.asarray(v)) for k, v in data.items()}
 
     # warmup (compile)
     for _ in range(2):
@@ -80,9 +95,10 @@ def main():
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        (lv,) = exe.run(m, feed=data, fetch_list=[loss])
-    float(np.asarray(lv).reshape(()))  # sync
+        (lv,) = exe.run(m, feed=data, fetch_list=[loss], return_numpy=False)
+    lv = float(np.asarray(lv).reshape(()))  # one sync at the end
     dt = time.perf_counter() - t0
+    assert np.isfinite(lv), f"loss not finite: {lv}"
 
     tokens_per_sec = batch * seq * steps / dt
     mfu = _bert_step_flops(cfg, batch, seq) * steps / dt / _peak_flops_per_chip()
@@ -97,6 +113,7 @@ def main():
                 "batch": batch,
                 "seq_len": seq,
                 "steps": steps,
+                "amp_bf16": use_amp,
             }
         )
     )

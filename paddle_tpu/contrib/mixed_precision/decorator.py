@@ -83,6 +83,21 @@ class OptimizerWithMixedPrecision:
         return self._apply(params_grads)
 
     def _apply(self, params_grads):
+        if self._dest_dtype == "bfloat16" and not self._use_dynamic_loss_scaling:
+            # bf16 has f32 exponent range: scale stays 1.0 and overflow
+            # can't occur from the cast itself, so the unscale +
+            # found_inf pass (a full extra read of every gradient) is
+            # pure overhead — feed f32 grads straight to the optimizer
+            with framework.program_guard(
+                params_grads[0][0].block.program,
+                framework.default_startup_program(),
+            ):
+                final = []
+                for p, g in params_grads:
+                    if g is not None and str(g.dtype) != "float32":
+                        g = layers.cast(g, "float32")
+                    final.append((p, g))
+                return self._optimizer.apply_gradients(final)
         grads = [g for _, g in params_grads if g is not None]
         with framework.program_guard(
             params_grads[0][0].block.program, framework.default_startup_program()

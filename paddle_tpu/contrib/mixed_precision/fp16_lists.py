@@ -29,6 +29,9 @@ white_list = {
     "depthwise_conv2d",
     "conv2d_transpose",
     "fused_multihead_attention",
+    # the whole fused stack runs in bf16; its emitter keeps layer_norm and
+    # softmax internals in f32 (ops/encoder_stack.py) so this is safe
+    "fused_encoder_stack",
     "fc",
 }
 

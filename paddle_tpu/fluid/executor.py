@@ -163,8 +163,18 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _prepare_feed(self, block, feed):
+        import jax
+
         out = {}
         for name, value in feed.items():
+            if isinstance(value, jax.Array):
+                # device-resident feed: zero host->device traffic per step.
+                # The TPU answer to the reference's double-buffered reader
+                # (operators/reader/buffered_reader.cc async GPU copy):
+                # callers (DataLoader, bench) device_put batches ahead of
+                # the step that consumes them.
+                out[name] = value
+                continue
             if block.has_var(name):
                 var = block.var(name)
                 arr = np.asarray(value)

@@ -34,10 +34,12 @@ def _merge_heads(x):
 def _reference_attention(q, k, v, bias, dropout_prob, deterministic, rng_key):
     """jnp composition: [B,nh,S,dh] in, [B,nh,S,dh] out."""
     dh = q.shape[-1]
-    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) * (1.0 / math.sqrt(dh))
+    scores = jnp.einsum(
+        "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(dh))
     if bias is not None:
         scores = scores + bias.astype(scores.dtype)
-    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     if not deterministic and dropout_prob > 0.0:
         keep = jax.random.bernoulli(rng_key, 1.0 - dropout_prob, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0)

@@ -81,9 +81,13 @@ def fused_encoder_stack(ctx, ins, attrs):
     stacked = {k: ins[k][0] for k in _PARAM_KEYS}
 
     def ln(x, scale, shift):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
-        return (x - mu) * jax.lax.rsqrt(var + eps) * scale + shift
+        # f32 statistics regardless of compute dtype (bf16 under AMP)
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32) \
+            + shift.astype(jnp.float32)
+        return y.astype(x.dtype)
 
     def dropout(x, prob, key):
         if is_test or prob <= 0.0:
@@ -127,10 +131,13 @@ def fused_encoder_stack(ctx, ins, attrs):
 
                 ctx_l = flash_attention(q, k, v, bias_arr)
             else:
-                scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(dh)
+                scores = jnp.einsum(
+                    "bnqd,bnkd->bnqk", q, k,
+                    preferred_element_type=jnp.float32,
+                ) / math.sqrt(dh)
                 if bias_arr is not None:
                     scores = scores + bias_arr.astype(scores.dtype)
-                probs = jax.nn.softmax(scores, axis=-1)
+                probs = jax.nn.softmax(scores, axis=-1).astype(hid.dtype)
                 probs = dropout(probs, attn_dropout_prob, k1)
                 ctx_l = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
             ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
