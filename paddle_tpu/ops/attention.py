@@ -50,9 +50,7 @@ def _reference_attention(q, k, v, bias, dropout_prob, deterministic, rng_key):
 FORCE_PALLAS = False
 
 
-def _use_pallas(q, dropout_prob, deterministic):
-    if not deterministic and dropout_prob > 0.0:
-        return False  # pallas path has no dropout; jnp path handles it
+def _use_pallas(q):
     dh = q.shape[-1]
     # MXU-friendly head dims only; otherwise XLA fusion is competitive
     shapes_ok = dh in (64, 128, 256) and q.shape[2] % 128 == 0
@@ -95,20 +93,26 @@ def fused_multihead_attention(ctx, ins, attrs):
     k = _split_heads(k3, nh)
     v = _split_heads(v3, nh)
 
-    if causal:
-        import numpy as _np
-
-        s = q.shape[2]
-        cmask = jnp.where(
-            _np.tril(_np.ones((s, s), bool)), 0.0, -1e30
-        )[None, None, :, :]
-        bias = cmask if bias is None else bias + cmask
-
-    if not causal and _use_pallas(q, dropout_prob, is_test):
+    if _use_pallas(q):
         from .pallas.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, bias)
+        dkey = None
+        if not is_test and dropout_prob > 0.0:
+            dkey = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+        out = flash_attention(
+            q, k, v, bias, causal=causal,
+            dropout_prob=0.0 if is_test else dropout_prob,
+            dropout_key=dkey, mesh=ctx.mesh,
+        )
     else:
+        if causal:
+            import numpy as _np
+
+            s = q.shape[2]
+            cmask = jnp.where(
+                _np.tril(_np.ones((s, s), bool)), 0.0, -1e30
+            )[None, None, :, :]
+            bias = cmask if bias is None else bias + cmask
         rng = None
         if not is_test and dropout_prob > 0.0:
             rng = ctx.salted_rng(int(attrs.get("rng_salt", 0)))

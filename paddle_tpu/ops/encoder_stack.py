@@ -95,10 +95,12 @@ def fused_encoder_stack(ctx, ins, attrs):
         keep = jax.random.bernoulli(key, 1.0 - prob, x.shape)
         return jnp.where(keep, x / (1.0 - prob), 0.0)
 
-    def make_layer(bias_arr, mb_salt=None):
+    def make_layer(bias_arr, mb_salt=None, manual=False):
         """Layer body closed over a (possibly microbatch-sliced) attention
         bias; batch size is read from the carried hidden state. mb_salt
-        (pipeline path) decorrelates dropout masks across microbatches."""
+        (pipeline path) decorrelates dropout masks across microbatches.
+        manual=True means we are already inside a shard_map (GPipe) and
+        the flash kernel must not wrap itself in another one."""
 
         def layer(carry, p):
             hid, idx = carry
@@ -126,10 +128,15 @@ def fused_encoder_stack(ctx, ins, attrs):
                     dropout_prob=0.0 if is_test else attn_dropout_prob,
                     dropout_key=None if is_test else k1,
                 )
-            elif use_flash and (is_test or attn_dropout_prob == 0.0) and _flash_ok(s, dh):
+            elif use_flash and _flash_ok(s, dh):
                 from .pallas.flash_attention import flash_attention
 
-                ctx_l = flash_attention(q, k, v, bias_arr)
+                ctx_l = flash_attention(
+                    q, k, v, bias_arr,
+                    dropout_prob=0.0 if is_test else attn_dropout_prob,
+                    dropout_key=None if is_test else k1,
+                    mesh=None if manual else mesh,
+                )
             else:
                 scores = jnp.einsum(
                     "bnqd,bnkd->bnqk", q, k,
@@ -209,7 +216,7 @@ def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer):
         p_local = dict(zip(keys, p_locals))
 
         def stage(x, bias_x, mb_salt):
-            layer = make_layer(bias_x, mb_salt)
+            layer = make_layer(bias_x, mb_salt, manual=True)
             start = s_idx * l_loc
             (out, _), _ = lax.scan(layer, (x, start), p_local)
             return out

@@ -7,9 +7,27 @@ kernel: scores never materialize in HBM (O(S) memory instead of O(S^2)),
 and the backward pass recomputes probabilities blockwise from the saved
 log-sum-exp, the standard flash-attention-2 scheme.
 
-Layout: q, k, v are [BH, S, D] (batch*heads flattened); optional additive
-per-key bias is [B, S] (the BERT padding mask); heads of one batch share it.
-Block sizes are 128 to match the MXU; D must be one of (64, 128, 256).
+Capabilities (round 2 — all TPU-lowering-legal layouts):
+  - additive bias: per-key [B,1,1,S] (BERT padding mask, cheap correct
+    dbias) or full [B,nh,S,S] / [B,1,S,S] / [1,1,S,S]
+  - causal masking with block-level skipping (lower-triangular work only)
+  - attention-probs dropout folded into the kernel: on TPU the mask is
+    regenerated from the hardware PRNG (pltpu.prng_*) per (bh, q-block,
+    k-block) in both forward and backward — zero HBM traffic for masks.
+    Masking only the numerator accumulator and never the normalizer is
+    exactly post-softmax dropout (same scheme as parallel/ring_attention).
+    In interpret mode (CPU tests) the TPU PRNG is unavailable, so the
+    mask is precomputed host-side and passed as an input — the dropout
+    MATH (fwd + custom VJP) is identical and fully testable on CPU.
+  - SPMD: `mesh=` wraps the kernel in shard_map over (dp, tp) — batch on
+    dp, heads on tp (megatron split); dropout seeds are decorrelated per
+    shard and per-key dbias is psum'd over tp.
+
+Layout rules honored (Mosaic requires the last two block dims divisible
+by (8, 128) or equal to the array dims): lse/delta ride as
+[BH, NQ, 1, BQ]; the per-key bias as [B, 1, S].
+
+Block sizes are 128 to match the MXU; S must be a multiple of 128.
 """
 from __future__ import annotations
 
@@ -21,9 +39,24 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BLOCK_Q = 128
-BLOCK_K = 128
+MIN_BLOCK = 128
+
+
+def _pick_block(s):
+    """Largest block that tiles s, capped at 512: at BERT-scale sequence
+    lengths the whole score tile fits VMEM and bigger dots keep the MXU
+    busy (128-blocks are latency-bound: profiled 4x slower at S=512)."""
+    for cand in (512, 256, 128):
+        if s % cand == 0:
+            return cand
+    raise ValueError(f"seq {s} not a multiple of {MIN_BLOCK}")
 NEG_INF = -1e30
+
+# mixing constants for the per-(bh, qi, ki) dropout seed (fwd and bwd must
+# regenerate the exact same mask for a block pair); wrapped to signed i32
+_SEED_BH = 0x9E3779B9 - (1 << 32)
+_SEED_QI = 0x85EBCA6B - (1 << 32)
+_SEED_KI = 0xC2B2AE35 - (1 << 32)
 
 
 def _interpret() -> bool:
@@ -32,75 +65,167 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _block_seed(seed_ref, b, qi, ki):
+    base = seed_ref[0]
+    return (
+        base
+        + b * jnp.int32(_SEED_BH)
+        + qi * jnp.int32(_SEED_QI)
+        + ki * jnp.int32(_SEED_KI)
+    )
+
+
+def _dropout_keep(seed_ref, b, qi, ki, keep_prob, bq, bk):
+    """[bq, bk] keep mask from the TPU hardware PRNG.
+
+    Compare in int32 throughout: Mosaic's u32 compare/shift lowerings are
+    signed, so mask the sign bit off the bitcast bits and compare 23-bit
+    values — well-defined signed arithmetic with ~8e6 resolution."""
+    pltpu.prng_seed(_block_seed(seed_ref, b, qi, ki))
+    bits = pltpu.bitcast(
+        pltpu.prng_random_bits((bq, bk)), jnp.int32
+    )
+    thresh = jnp.int32(int(keep_prob * float(1 << 23)))
+    return (bits & jnp.int32(0x7FFFFF)) < thresh
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, sm_scale, num_heads):
-    # q_ref [1, BQ, D]; k_ref/v_ref [1, S, D]; bias_ref [1, S] or None
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    seq_len = k_ref.shape[1]
-    d = q.shape[-1]
+def _make_fwd_kernel(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
+                     use_prng, has_mask, bq, bk):
+    """bias_mode: None | 'key' ([B,1,S] input) | 'full' ([G,S,S] input)."""
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
-        if bias_ref is not None:
-            s = s + bias_ref[0, pl.ds(i * BLOCK_K, BLOCK_K)][None, :]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l, acc
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref = next(it)          # [1, BQ, D]
+        k_ref = next(it)          # [1, S, D]
+        v_ref = next(it)          # [1, S, D]
+        bias_ref = next(it) if bias_mode else None
+        mask_ref = next(it) if has_mask else None     # [1, BQ, S] uint8
+        seed_ref = next(it) if use_prng else None     # [1] int32 (SMEM)
+        o_ref = next(it)          # [1, BQ, D]
+        lse_ref = next(it)        # [1, 1, 1, BQ]
 
-    m0 = jnp.full((BLOCK_Q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
-    acc0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, seq_len // BLOCK_K, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+        b = pl.program_id(0)
+        qi = pl.program_id(1)
+        # keep the input dtype (bf16 under AMP) for the MXU dots — f32
+        # inputs would force multi-pass f32 matmuls; accumulate in f32
+        q = q_ref[0]
+        seq_len = k_ref.shape[1]
+        d = q.shape[-1]
+        keep_prob = 1.0 - dropout_prob
+
+        def body(i, carry):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(i * bk, bk), :]
+            v = v_ref[0, pl.ds(i * bk, bk), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * sm_scale  # [BQ, BK]
+            if bias_mode == "key":
+                s = s + bias_ref[0, 0, pl.ds(i * bk, bk)][None, :]
+            elif bias_mode == "full":
+                s = s + bias_ref[0, :, pl.ds(i * bk, bk)].astype(jnp.float32)
+            if causal:
+                qpos = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
+                kpos = i * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1
+                )
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            # numerator-only dropout: l accumulates undropped p, acc the
+            # masked p/(keep_prob) — exactly post-softmax dropout
+            p_num = p
+            if dropout_prob > 0.0:
+                if use_prng:
+                    keep = _dropout_keep(seed_ref, b, qi, i, keep_prob, bq, bk)
+                else:
+                    keep = mask_ref[0, :, pl.ds(i * bk, bk)] != 0
+                p_num = jnp.where(keep, p / keep_prob, 0.0)
+            acc = acc * alpha + jax.lax.dot_general(
+                p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l, acc
+
+        m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((bq, 1), jnp.float32)
+        acc0 = jnp.zeros((bq, d), jnp.float32)
+        hi = (qi + 1) if causal else (seq_len // bk)
+        m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+    return kernel
 
 
-def _flash_fwd(q, k, v, bias, sm_scale, num_heads):
+def _flash_fwd(q, k, v, bias, mask, seed, *, sm_scale, num_heads, causal,
+               dropout_prob, bias_mode, bias_dims):
     bh, s, d = q.shape
-    grid = (bh, s // BLOCK_Q)
+    bq = bk = _pick_block(s)
+    nq = s // bq
+    use_prng = dropout_prob > 0.0 and mask is None
+    has_mask = mask is not None and dropout_prob > 0.0
+    grid = (bh, nq)
     in_specs = [
-        pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
     ]
     args = [q, k, v]
-    if bias is not None:
-        in_specs.append(
-            pl.BlockSpec(
-                (1, s), lambda b, i: (b // num_heads, 0), memory_space=pltpu.VMEM
+    if bias_mode:
+        dv_, md_ = _bias_row_map(bias_dims, num_heads)
+        if bias_mode == "key":
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, 1, s),
+                    lambda b, i, dv=dv_, md=md_: ((b // dv) % md, 0, 0),
+                    memory_space=pltpu.VMEM,
+                )
             )
-        )
+        else:
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, bq, s),
+                    lambda b, i, dv=dv_, md=md_: ((b // dv) % md, i, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            )
         args.append(bias)
-        kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, num_heads=num_heads)
-    else:
-        kernel = functools.partial(
-            lambda qr, kr, vr, o, lse, **kw: _fwd_kernel(qr, kr, vr, None, o, lse, **kw),
-            sm_scale=sm_scale,
-            num_heads=num_heads,
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, bq, s), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
         )
+        args.append(mask)
+    if use_prng:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    kernel = _make_fwd_kernel(
+        sm_scale=sm_scale, num_heads=num_heads, causal=causal,
+        dropout_prob=dropout_prob, bias_mode=bias_mode, use_prng=use_prng,
+        has_mask=has_mask, bq=bq, bk=bk,
+    )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, 1, bq), jnp.float32),
         ],
         interpret=_interpret(),
     )(*args)
@@ -112,200 +237,511 @@ def _flash_fwd(q, k, v, bias, sm_scale, num_heads):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, num_heads
-):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    seq_len = k_ref.shape[1]
-    d = q.shape[-1]
+def _make_bwd_dq_kernel(*, sm_scale, num_heads, causal, dropout_prob,
+                        bias_mode, use_prng, has_mask, bq, bk):
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref = next(it)          # [1, BQ, D]
+        k_ref = next(it)          # [1, S, D]
+        v_ref = next(it)          # [1, S, D]
+        bias_ref = next(it) if bias_mode else None
+        mask_ref = next(it) if has_mask else None
+        seed_ref = next(it) if use_prng else None
+        do_ref = next(it)         # [1, BQ, D]
+        lse_ref = next(it)        # [1, 1, 1, BQ]
+        delta_ref = next(it)      # [1, 1, 1, BQ]
+        dq_ref = next(it)         # [1, BQ, D]
 
-    def body(i, dq):
-        k = k_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        b = pl.program_id(0)
+        qi = pl.program_id(1)
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0, 0][:, None]
+        delta = delta_ref[0, 0, 0][:, None]
+        seq_len = k_ref.shape[1]
+        d = q.shape[-1]
+        keep_prob = 1.0 - dropout_prob
+
+        def body(i, dq):
+            k = k_ref[0, pl.ds(i * bk, bk), :]
+            v = v_ref[0, pl.ds(i * bk, bk), :]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                * sm_scale
             )
-            * sm_scale
-        )
-        if bias_ref is not None:
-            s = s + bias_ref[0, pl.ds(i * BLOCK_K, BLOCK_K)][None, :]
-        p = jnp.exp(s - lse)  # [BQ, BK]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta) * sm_scale
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(
-        0, seq_len // BLOCK_K, body, jnp.zeros((BLOCK_Q, d), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, num_heads
-):
-    k = k_ref[0].astype(jnp.float32)  # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
-    seq_len = q_ref.shape[1]
-    d = k.shape[-1]
-    if bias_ref is not None:
-        b_block = bias_ref[0, pl.ds(pl.program_id(1) * BLOCK_K, BLOCK_K)]
-    else:
-        b_block = None
-
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-        delta = delta_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            if bias_mode == "key":
+                s = s + bias_ref[0, 0, pl.ds(i * bk, bk)][None, :]
+            elif bias_mode == "full":
+                s = s + bias_ref[0, :, pl.ds(i * bk, bk)].astype(jnp.float32)
+            if causal:
+                qpos = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
+                kpos = i * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1
+                )
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            p = jnp.exp(s - lse)  # normalized probs P [BQ, BK]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
-            * sm_scale
-        )
-        if b_block is not None:
-            s = s + b_block[None, :]
-        p = jnp.exp(s - lse)  # [BQ, BK]
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta) * sm_scale  # [BQ, BK]
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
+            if dropout_prob > 0.0:
+                if use_prng:
+                    keep = _dropout_keep(seed_ref, b, qi, i, keep_prob, bq, bk)
+                else:
+                    keep = mask_ref[0, :, pl.ds(i * bk, bk)] != 0
+                c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                ds = p * (c * dp - delta) * sm_scale
+            else:
+                ds = p * (dp - delta) * sm_scale
+            return dq + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
-    dk0 = jnp.zeros((BLOCK_K, d), jnp.float32)
-    dv0 = jnp.zeros((BLOCK_K, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, seq_len // BLOCK_Q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        hi = (qi + 1) if causal else (seq_len // bk)
+        dq = jax.lax.fori_loop(
+            0, hi, body, jnp.zeros((bq, d), jnp.float32)
+        )
+        dq_ref[0] = dq.astype(dq_ref.dtype)
+
+    return kernel
 
 
-def _flash_bwd(res, g, sm_scale, num_heads):
-    q, k, v, bias, o, lse = res
+def _make_bwd_dkv_kernel(*, sm_scale, num_heads, causal, dropout_prob,
+                         bias_mode, use_prng, has_mask, want_dbias, bq, bk):
+    """Grid (BH, NK); loops over q blocks. Also accumulates dbias:
+    per-key mode -> row-sums into [1,1,1,BK]; full mode -> writes the
+    [S, BK] column of ds (pre-scale) when want_dbias."""
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref = next(it)          # [1, S, D]
+        k_ref = next(it)          # [1, BK, D]
+        v_ref = next(it)          # [1, BK, D]
+        bias_ref = next(it) if bias_mode else None
+        mask_ref = next(it) if has_mask else None    # [1, S, BK]
+        seed_ref = next(it) if use_prng else None
+        do_ref = next(it)         # [1, S, D]
+        lse_ref = next(it)        # [1, NQ, 1, BQ]
+        delta_ref = next(it)      # [1, NQ, 1, BQ]
+        dk_ref = next(it)         # [1, BK, D]
+        dv_ref = next(it)         # [1, BK, D]
+        dbias_key_ref = None
+        dbias_full_ref = None
+        if want_dbias and bias_mode == "key":
+            dbias_key_ref = next(it)   # [1, 1, 1, BK]
+        elif want_dbias and bias_mode == "full":
+            dbias_full_ref = next(it)  # [1, S, BK]
+
+        b = pl.program_id(0)
+        ki = pl.program_id(1)
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]
+        seq_len = q_ref.shape[1]
+        d = k.shape[-1]
+        keep_prob = 1.0 - dropout_prob
+        if bias_mode == "key":
+            b_block = bias_ref[0, 0, pl.ds(ki * bk, bk)]
+        if dbias_full_ref is not None:
+            dbias_full_ref[0] = jnp.zeros_like(dbias_full_ref[0])
+
+        def body(i, carry):
+            dk, dv, dbsum = carry
+            q = q_ref[0, pl.ds(i * bq, bq), :]
+            do = do_ref[0, pl.ds(i * bq, bq), :]
+            lse = lse_ref[0, i, 0][:, None]
+            delta = delta_ref[0, i, 0][:, None]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                * sm_scale
+            )
+            if bias_mode == "key":
+                s = s + b_block[None, :]
+            elif bias_mode == "full":
+                s = s + bias_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            if causal:
+                qpos = i * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
+                kpos = ki * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1
+                )
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            p = jnp.exp(s - lse)  # [BQ, BK]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            if dropout_prob > 0.0:
+                if use_prng:
+                    keep = _dropout_keep(seed_ref, b, i, ki, keep_prob, bq, bk)
+                else:
+                    keep = mask_ref[0, pl.ds(i * bq, bq), :] != 0
+                c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                p_num = p * c
+            else:
+                p_num = p
+            dv = dv + jax.lax.dot_general(
+                p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds_nos = p * ((dp * (c if dropout_prob > 0.0 else 1.0)) - delta)
+            ds = ds_nos * sm_scale  # [BQ, BK]
+            dk = dk + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dbias_full_ref is not None:
+                dbias_full_ref[0, pl.ds(i * bq, bq), :] = ds_nos.astype(
+                    dbias_full_ref.dtype
+                )
+            if dbias_key_ref is not None:
+                dbsum = dbsum + jnp.sum(ds_nos, axis=0)
+            return dk, dv, dbsum
+
+        dk0 = jnp.zeros((bk, d), jnp.float32)
+        dv0 = jnp.zeros((bk, d), jnp.float32)
+        db0 = jnp.zeros((bk,), jnp.float32)
+        lo = ki if causal else 0
+        dk, dv, dbsum = jax.lax.fori_loop(lo, seq_len // bq, body, (dk0, dv0, db0))
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+        if dbias_key_ref is not None:
+            dbias_key_ref[0, 0, 0] = dbsum
+
+    return kernel
+
+
+def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
+               bias_mode, bias_dims, want_dbias):
+    q, k, v, bias, mask, seed, o, lse = res
     bh, s, d = q.shape
+    bq = bk = _pick_block(s)
+    nq, nk = s // bq, s // bk
+    use_prng = dropout_prob > 0.0 and mask is None
+    has_mask = mask is not None and dropout_prob > 0.0
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)  # [BH,S]
+    delta = delta.reshape(bh, nq, 1, bq)
 
-    qspec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
     fullspec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
-    rowspec = pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), memory_space=pltpu.VMEM)
-    fullrow = pl.BlockSpec((1, s), lambda b, i: (b, 0), memory_space=pltpu.VMEM)
-    bias_spec = pl.BlockSpec((1, s), lambda b, i: (b // num_heads, 0), memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, 1, 1, bq), lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM)
+    fullrow = pl.BlockSpec((1, nq, 1, bq), lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
 
-    # dq: grid over q blocks
-    args = [q, k, v] + ([bias] if bias is not None else []) + [g, lse, delta]
-    in_specs = [qspec, fullspec, fullspec] + ([bias_spec] if bias is not None else []) + [
-        qspec,
-        rowspec,
-        rowspec,
-    ]
-    if bias is not None:
-        dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, num_heads=num_heads)
-    else:
-        dq_kernel = functools.partial(
-            lambda qr, kr, vr, dor, lser, dr, dqr, **kw: _bwd_dq_kernel(
-                qr, kr, vr, None, dor, lser, dr, dqr, **kw
-            ),
-            sm_scale=sm_scale,
-            num_heads=num_heads,
+    dv_, md_ = _bias_row_map(bias_dims, num_heads) if bias_mode else (1, 1)
+
+    def bias_specs(block_rows, rows_idx):
+        if bias_mode == "key":
+            return pl.BlockSpec(
+                (1, 1, s),
+                lambda b, i, dv=dv_, md=md_: ((b // dv) % md, 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        return pl.BlockSpec(
+            (1, block_rows, s) if rows_idx else (1, s, bk),
+            (lambda b, i, dv=dv_, md=md_: ((b // dv) % md, i, 0))
+            if rows_idx
+            else (lambda b, i, dv=dv_, md=md_: ((b // dv) % md, 0, i)),
+            memory_space=pltpu.VMEM,
         )
+
+    # ---- dq: grid over q blocks
+    args = [q, k, v]
+    in_specs = [qspec, fullspec, fullspec]
+    if bias_mode:
+        in_specs.append(bias_specs(bq, True))
+        args.append(bias)
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, bq, s), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+        )
+        args.append(mask)
+    if use_prng:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [qspec, rowspec, rowspec]
+    args += [g, lse, delta]
     dq = pl.pallas_call(
-        dq_kernel,
-        grid=(bh, s // BLOCK_Q),
+        _make_bwd_dq_kernel(
+            sm_scale=sm_scale, num_heads=num_heads, causal=causal,
+            dropout_prob=dropout_prob, bias_mode=bias_mode, use_prng=use_prng,
+            has_mask=has_mask, bq=bq, bk=bk,
+        ),
+        grid=(bh, nq),
         in_specs=in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=_interpret(),
     )(*args)
 
-    # dk/dv: grid over k blocks
-    kspec = pl.BlockSpec((1, BLOCK_K, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+    # ---- dk/dv (+dbias): grid over k blocks
+    kspec = pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
     fullq = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
-    args2 = [q, k, v] + ([bias] if bias is not None else []) + [g, lse, delta]
-    in_specs2 = [fullq, kspec, kspec] + ([bias_spec] if bias is not None else []) + [
-        fullq,
-        fullrow,
-        fullrow,
-    ]
-    if bias is not None:
-        dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, num_heads=num_heads)
-    else:
-        dkv_kernel = functools.partial(
-            lambda qr, kr, vr, dor, lser, dr, dkr, dvr, **kw: _bwd_dkv_kernel(
-                qr, kr, vr, None, dor, lser, dr, dkr, dvr, **kw
-            ),
-            sm_scale=sm_scale,
-            num_heads=num_heads,
+    args2 = [q, k, v]
+    in_specs2 = [fullq, kspec, kspec]
+    if bias_mode:
+        in_specs2.append(bias_specs(s, False))
+        args2.append(bias)
+    if has_mask:
+        in_specs2.append(
+            pl.BlockSpec((1, s, bk), lambda b, i: (b, 0, i), memory_space=pltpu.VMEM)
         )
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(bh, s // BLOCK_K),
+        args2.append(mask)
+    if use_prng:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(seed)
+    in_specs2 += [fullq, fullrow, fullrow]
+    args2 += [g, lse, delta]
+
+    out_specs2 = [kspec, kspec]
+    out_shapes2 = [
+        jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+    ]
+    if want_dbias and bias_mode == "key":
+        out_specs2.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM)
+        )
+        out_shapes2.append(jax.ShapeDtypeStruct((bh, nk, 1, bk), jnp.float32))
+    elif want_dbias and bias_mode == "full":
+        out_specs2.append(
+            pl.BlockSpec((1, s, bk), lambda b, i: (b, 0, i), memory_space=pltpu.VMEM)
+        )
+        out_shapes2.append(jax.ShapeDtypeStruct((bh, s, s), jnp.float32))
+
+    outs = pl.pallas_call(
+        _make_bwd_dkv_kernel(
+            sm_scale=sm_scale, num_heads=num_heads, causal=causal,
+            dropout_prob=dropout_prob, bias_mode=bias_mode, use_prng=use_prng,
+            has_mask=has_mask, want_dbias=want_dbias and bias_mode is not None,
+            bq=bq, bk=bk,
+        ),
+        grid=(bh, nk),
         in_specs=in_specs2,
-        out_specs=[kspec, kspec],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
-        ],
+        out_specs=out_specs2,
+        out_shape=out_shapes2,
         interpret=_interpret(),
     )(*args2)
+    dk, dv = outs[0], outs[1]
 
-    dbias = None if bias is None else jnp.zeros_like(bias)
-    return dq, dk, dv, dbias
-
-
-# ---------------------------------------------------------------------------
-# public entry: [B, nh, S, D] ± per-key bias [B, S]
-# ---------------------------------------------------------------------------
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_core(q, k, v, bias, sm_scale, num_heads):
-    o, _ = _flash_fwd(q, k, v, bias, sm_scale, num_heads)
-    return o
-
-
-def _flash_core_fwd(q, k, v, bias, sm_scale, num_heads):
-    o, lse = _flash_fwd(q, k, v, bias, sm_scale, num_heads)
-    return o, (q, k, v, bias, o, lse)
-
-
-def _flash_core_bwd(sm_scale, num_heads, res, g):
-    q, k, v, bias, o, lse = res
-    dq, dk, dv, dbias = _flash_bwd((q, k, v, bias, o, lse), g, sm_scale, num_heads)
-    return dq, dk, dv, dbias
-
-
-_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
-
-
-def flash_attention(q, k, v, bias=None, sm_scale=None):
-    """q,k,v: [B, nh, S, D]; bias: additive, broadcastable to [B,nh,S,S]
-    but only the per-key form [B,1,1,S] is kernelized (BERT padding mask).
-    Returns [B, nh, S, D]."""
-    b, nh, s, d = q.shape
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(d)
-    key_bias = None
-    if bias is not None:
-        if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1:
-            key_bias = bias.reshape(b, bias.shape[-1]).astype(jnp.float32)
+    # reduce the raw dbias to bias3's shape ([G,1,S] key / [G,S,S] full);
+    # JAX autodiff maps it back to the user's 4-D bias through the
+    # reshape/astype that produced bias3
+    dbias = None
+    if want_dbias and bias_mode is not None:
+        bb, bn = bias_dims
+        batch = bh // num_heads
+        if bias_mode == "key":
+            # [BH, NK, 1, BK] -> [BH, S]; queries were summed in-kernel
+            db = outs[2].reshape(batch, num_heads, s)
         else:
-            raise ValueError(
-                f"flash_attention kernel supports per-key bias [B,1,1,S]; got {bias.shape}"
-            )
+            db = outs[2].reshape(batch, num_heads, s, s)
+        # sum grid cells that shared one bias row (broadcast transpose)
+        if bn == 1 and num_heads > 1:
+            db = db.sum(axis=1, keepdims=True)
+        if bb == 1 and batch > 1:
+            db = db.sum(axis=0, keepdims=True)
+        if bias_mode == "key":
+            dbias = db.reshape(bb, 1, s)
+        else:
+            dbias = db.reshape(bb * bn, s, s)
+    return dq, dk, dv, dbias
+
+
+# ---------------------------------------------------------------------------
+# core with custom VJP (created per call; closes over static config)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _make_flash_core(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
+                     bias_dims, want_dbias):
+    """Cached per static config: eager callers reuse the same custom_vjp
+    (and therefore JAX's trace/lowering caches) across calls."""
+    statics = dict(
+        sm_scale=sm_scale, num_heads=num_heads, causal=causal,
+        dropout_prob=dropout_prob, bias_mode=bias_mode, bias_dims=bias_dims,
+    )
+
+    @jax.custom_vjp
+    def core(q, k, v, bias, mask, seed):
+        o, _ = _flash_fwd(q, k, v, bias, mask, seed, **statics)
+        return o
+
+    def core_fwd(q, k, v, bias, mask, seed):
+        o, lse = _flash_fwd(q, k, v, bias, mask, seed, **statics)
+        return o, (q, k, v, bias, mask, seed, o, lse)
+
+    def core_bwd(res, g):
+        dq, dk, dv, dbias = _flash_bwd(
+            res, g, want_dbias=want_dbias, **statics
+        )
+        if res[3] is not None and dbias is None:
+            # bias_requires_grad=False: zero cotangent (padding masks)
+            dbias = jnp.zeros_like(res[3])
+        elif dbias is not None:
+            dbias = dbias.astype(res[3].dtype)
+        return (dq, dk, dv, dbias, None, None)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+# ---------------------------------------------------------------------------
+# public entry: [B, nh, S, D] with bias/causal/dropout/SPMD
+# ---------------------------------------------------------------------------
+
+
+def _classify_bias(bias, b, nh, s):
+    """Returns (bias_3d, bias_mode, (bb, bn)). bias_3d is a plain traced
+    reshape of the user bias, so dbias (returned in bias_3d's shape) flows
+    back to the user shape through ordinary autodiff.
+
+    Grid cell bh = b_idx * nh + h_idx maps to bias row
+    (bh // div) % mod with div = nh if bn == 1 else 1 and mod = bb * bn —
+    covering all four broadcast patterns ([B|1, nh|1, ...])."""
+    if bias is None:
+        return None, None, None
+    if bias.ndim != 4:
+        raise ValueError(f"flash_attention bias must be 4-D, got {bias.shape}")
+    bb, bn, bq, bk = bias.shape
+    if bb not in (1, b) or bn not in (1, nh):
+        raise ValueError(
+            f"bias dims {bias.shape} not broadcastable to batch={b}, heads={nh}"
+        )
+    if bk != s:
+        raise ValueError(f"bias key dim {bk} != seq {s}")
+    if bn == 1 and bq == 1:
+        # per-key padding mask [B|1, 1, 1, S] -> [G, 1, S]
+        b3 = bias.reshape(bb, 1, s).astype(jnp.float32)
+        return b3, "key", (bb, 1)
+    if bq != s:
+        raise ValueError(f"bias query dim {bq} != seq {s}")
+    b3 = bias.reshape(bb * bn, s, s)
+    return b3, "full", (bb, bn)
+
+
+def _bias_row_map(bias_dims, num_heads):
+    """(div, mod) such that bias row = (bh // div) % mod."""
+    bb, bn = bias_dims
+    return (num_heads if bn == 1 else 1), bb * bn
+
+
+def _flash_local(q, k, v, bias, mask, seed, *, sm_scale, causal, dropout_prob,
+                 bias_requires_grad):
+    """[B, nh, S, D] local (per-shard) flash attention."""
+    b, nh, s, d = q.shape
+    bias3, bias_mode, bias_dims = _classify_bias(bias, b, nh, s)
+    mask3 = mask.reshape(b * nh, s, s) if mask is not None else None
     qf = q.reshape(b * nh, s, d)
     kf = k.reshape(b * nh, s, d)
     vf = v.reshape(b * nh, s, d)
-    o = _flash_core(qf, kf, vf, key_bias, sm_scale, nh)
+    core = _make_flash_core(
+        sm_scale=float(sm_scale), num_heads=nh, causal=causal,
+        dropout_prob=dropout_prob, bias_mode=bias_mode, bias_dims=bias_dims,
+        want_dbias=bias_requires_grad and bias_mode is not None,
+    )
+    o = core(qf, kf, vf, bias3, mask3, seed)
     return o.reshape(b, nh, s, d)
+
+
+def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
+                    dropout_prob=0.0, dropout_key=None, dropout_seed=None,
+                    bias_requires_grad=False, mesh=None, batch_axis="dp",
+                    head_axis="tp"):
+    """Flash attention with optional bias, causal mask, dropout and SPMD.
+
+    q, k, v: [B, nh, S, D]. bias: additive, [B,1,1,S] (per-key padding
+    mask) or [B|1, nh|1, S, S]. Returns [B, nh, S, D].
+
+    dropout: `dropout_prob` with either `dropout_key` (a jax PRNG key) or
+    `dropout_seed` (int32 scalar). On TPU the mask comes from the in-kernel
+    hardware PRNG; in interpret mode (CPU) it is precomputed host-side.
+
+    bias_requires_grad=False returns zero cotangent for the bias (the
+    padding-mask case); set True to compute the real dbias.
+
+    mesh: wrap in shard_map over (batch_axis, head_axis) if present —
+    batch sharded on dp, heads on tp (megatron attention).
+    """
+    b, nh, s, d = q.shape
+    if s % MIN_BLOCK != 0:
+        raise ValueError(f"flash_attention needs seq % {MIN_BLOCK} == 0, got {s}")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    seed = None
+    mask = None
+    if dropout_prob > 0.0:
+        if dropout_seed is not None:
+            seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+        elif dropout_key is not None:
+            seed = jax.random.randint(
+                dropout_key, (1,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+            )
+        else:
+            raise ValueError("dropout needs dropout_key or dropout_seed")
+        if _interpret():
+            # CPU tests: TPU hardware PRNG is unavailable in interpret
+            # mode; draw the mask host-side (same numerator-only math)
+            mkey = dropout_key if dropout_key is not None else jax.random.PRNGKey(
+                seed[0]
+            )
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(mkey, 7), 1.0 - dropout_prob, (b, nh, s, s)
+            ).astype(jnp.uint8)
+
+    kwargs = dict(
+        sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
+        bias_requires_grad=bias_requires_grad,
+    )
+
+    axes = [
+        ax for ax in (batch_axis, head_axis)
+        if mesh is not None and ax in mesh.axis_names and mesh.shape[ax] > 1
+    ]
+    if not axes:
+        return _flash_local(q, k, v, bias, mask, seed, **kwargs)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ba = batch_axis if batch_axis in axes else None
+    ha = head_axis if head_axis in axes else None
+    qspec = P(ba, ha, None, None)
+
+    def spec_for(x):
+        if x is None:
+            return None
+        return P(
+            ba if x.shape[0] != 1 else None,
+            ha if x.shape[1] != 1 else None,
+            None,
+            None,
+        )
+
+    bias_spec = spec_for(bias)
+    mask_spec = P(ba, ha, None, None) if mask is not None else None
+
+    def body(ql, kl, vl, bl, ml, sl):
+        local_seed = sl
+        if sl is not None:
+            import jax.lax as lax
+
+            salt = jnp.int32(0)
+            if ba:
+                salt = salt + lax.axis_index(ba) * jnp.int32(0x632BE59B)
+            if ha:
+                salt = salt + lax.axis_index(ha) * jnp.int32(0x1B873593)
+            local_seed = sl + salt
+        out = _flash_local(ql, kl, vl, bl, ml, local_seed, **kwargs)
+        return out
+
+    in_specs = (qspec, qspec, qspec, bias_spec, mask_spec, P() if seed is not None else None)
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=qspec, check_vma=False,
+    )(q, k, v, bias, mask, seed)

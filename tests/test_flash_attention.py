@@ -1,7 +1,10 @@
 """Flash-attention Pallas kernel vs jnp reference (interpret mode on CPU).
 
 Mirrors the reference's OpTest numeric-oracle pattern (SURVEY.md §4):
-numpy/jnp oracle for forward, finite-check via jax.grad comparison.
+numpy/jnp oracle for forward, grad comparison via jax.grad of an oracle
+attention. Dropout runs the kernel's mask-input path (interpret mode);
+the in-kernel hardware PRNG path shares all other code and is exercised
+on real TPU by bench.py.
 """
 import jax
 import jax.numpy as jnp
@@ -27,6 +30,12 @@ def _make(b=2, nh=2, s=256, d=64, bias=True, seed=0):
     )
 
 
+def _causal_bias(s):
+    return jnp.where(
+        np.tril(np.ones((s, s), bool)), 0.0, -1e30
+    )[None, None, :, :].astype(jnp.float32)
+
+
 @pytest.mark.parametrize("use_bias", [False, True])
 def test_forward_matches_reference(use_bias):
     q, k, v, bias = _make(bias=use_bias)
@@ -47,4 +56,163 @@ def test_grads_match_reference():
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+
+def test_causal_forward_and_grad():
+    q, k, v, _ = _make(b=1, nh=2, s=256, d=64, bias=False)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        ref = _reference_attention(q, k, v, _causal_bias(q.shape[2]), 0.0, True, None)
+        return jnp.sum(ref ** 2)
+
+    out = flash_attention(q, k, v, causal=True)
+    ref = _reference_attention(q, k, v, _causal_bias(256), 0.0, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "bias_shape", ["full", "shared_heads", "shared_batch", "shared_both"]
+)
+def test_full_bias_forward_and_dbias(bias_shape):
+    # b>1 so batch-major vs head-major bias grouping is distinguishable
+    b, nh, s, d = 2, 2, 128, 64
+    q, k, v, _ = _make(b=b, nh=nh, s=s, d=d, bias=False)
+    rng = np.random.RandomState(3)
+    shape = {
+        "full": (b, nh, s, s),
+        "shared_heads": (b, 1, s, s),
+        "shared_batch": (1, nh, s, s),
+        "shared_both": (1, 1, s, s),
+    }[bias_shape]
+    bias = jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.5)
+
+    out = flash_attention(q, k, v, bias, bias_requires_grad=True)
+    ref = _reference_attention(q, k, v, bias, 0.0, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def loss_flash(bias):
+        return jnp.sum(flash_attention(q, k, v, bias, bias_requires_grad=True) ** 2)
+
+    def loss_ref(bias):
+        return jnp.sum(_reference_attention(q, k, v, bias, 0.0, True, None) ** 2)
+
+    db_f = jax.grad(loss_flash)(bias)
+    db_r = jax.grad(loss_ref)(bias)
+    np.testing.assert_allclose(np.asarray(db_f), np.asarray(db_r), rtol=1e-3, atol=1e-3)
+
+
+def test_per_key_dbias():
+    b, nh, s, d = 2, 2, 128, 64
+    q, k, v, bias = _make(b=b, nh=nh, s=s, d=d, bias=True)
+    soft_bias = bias * 1e-4  # soft (non-masking) so grads are nontrivial
+
+    def loss_flash(bias):
+        return jnp.sum(flash_attention(q, k, v, bias, bias_requires_grad=True) ** 2)
+
+    def loss_ref(bias):
+        return jnp.sum(_reference_attention(q, k, v, bias, 0.0, True, None) ** 2)
+
+    db_f = jax.grad(loss_flash)(soft_bias)
+    db_r = jax.grad(loss_ref)(soft_bias)
+    np.testing.assert_allclose(np.asarray(db_f), np.asarray(db_r), rtol=1e-3, atol=1e-3)
+
+
+def test_padding_mask_zero_dbias_by_default():
+    q, k, v, bias = _make(b=1, nh=2, s=128, d=64, bias=True)
+    db = jax.grad(
+        lambda bias: jnp.sum(flash_attention(q, k, v, bias) ** 2)
+    )(bias)
+    assert float(jnp.abs(db).max()) == 0.0
+
+
+def test_dropout_forward_semantics():
+    """Numerator-only masking == post-softmax dropout: rows where the mask
+    keeps everything match the deterministic output scaled paths; the
+    mean over dropout randomness approximates the no-dropout output."""
+    b, nh, s, d = 1, 2, 128, 64
+    q, k, v, _ = _make(b=b, nh=nh, s=s, d=d, bias=False)
+    base = flash_attention(q, k, v)
+    outs = []
+    for i in range(8):
+        key = jax.random.PRNGKey(100 + i)
+        outs.append(
+            np.asarray(
+                flash_attention(q, k, v, dropout_prob=0.3, dropout_key=key)
+            )
+        )
+    mean = np.mean(outs, axis=0)
+    # stochastic: loose tolerance, but must be clearly centered on base
+    err = np.abs(mean - np.asarray(base)).mean()
+    scale = np.abs(np.asarray(base)).mean()
+    assert err < 0.25 * scale, (err, scale)
+    # dropout must actually do something
+    assert np.abs(outs[0] - np.asarray(base)).mean() > 0.05 * scale
+
+
+def test_dropout_grad_consistency():
+    """Analytic grad of the dropped function vs finite differences with
+    the SAME mask (deterministic given the key)."""
+    b, nh, s, d = 1, 1, 128, 64
+    q, k, v, _ = _make(b=b, nh=nh, s=s, d=d, bias=False, seed=5)
+    key = jax.random.PRNGKey(42)
+
+    def loss(q):
+        return jnp.sum(
+            flash_attention(q, k, v, dropout_prob=0.25, dropout_key=key) ** 2
+        )
+
+    g = np.asarray(jax.grad(loss)(q))
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        i = tuple(rng.randint(0, dim) for dim in q.shape)
+        eps = 1e-2
+        qp = np.asarray(q).copy(); qp[i] += eps
+        qm = np.asarray(q).copy(); qm[i] -= eps
+        num = (float(loss(jnp.asarray(qp))) - float(loss(jnp.asarray(qm)))) / (2 * eps)
+        np.testing.assert_allclose(g[i], num, rtol=2e-2, atol=2e-2)
+
+
+def test_spmd_shard_map_matches_single_device():
+    """dp x tp sharded flash == single-device flash (8 virtual CPU devs)."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+    b, nh, s, d = 8, 4, 128, 64
+    q, k, v, bias = _make(b=b, nh=nh, s=s, d=d, bias=True, seed=9)
+
+    out_single = flash_attention(q, k, v, bias)
+    out_sharded = jax.jit(
+        lambda q, k, v, bias: flash_attention(q, k, v, bias, mesh=mesh)
+    )(q, k, v, bias)
+    np.testing.assert_allclose(
+        np.asarray(out_sharded), np.asarray(out_single), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_spmd_grads_match_single_device():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+    b, nh, s, d = 4, 4, 128, 64
+    q, k, v, bias = _make(b=b, nh=nh, s=s, d=d, bias=True, seed=11)
+
+    def loss_single(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, bias) ** 2)
+
+    def loss_sharded(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, bias, mesh=mesh) ** 2)
+
+    gs = jax.grad(loss_single, argnums=(0, 1, 2))(q, k, v)
+    gm = jax.jit(jax.grad(loss_sharded, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gs, gm):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
