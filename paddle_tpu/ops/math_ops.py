@@ -345,10 +345,9 @@ def logsumexp(ctx, ins, attrs):
     if axis is not None and len(axis) == 0:
         axis = None
     keepdim = attrs.get("keepdim", False)
-    return {
-        "Out": [
-            jax.scipy.special.logsumexp(
-                x, axis=tuple(axis) if axis is not None else None, keepdims=keepdim
-            )
-        ]
-    }
+    out = jax.scipy.special.logsumexp(
+        x, axis=tuple(axis) if axis is not None else None, keepdims=keepdim
+    )
+    if out.ndim == 0:
+        out = out.reshape((1,))  # fluid reductions keep at least rank 1
+    return {"Out": [out]}

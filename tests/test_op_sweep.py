@@ -1,0 +1,1980 @@
+"""Per-op sweep: every registered op gets a numpy-oracle OpTest case or an
+explicit, justified exemption (reference contract: tests/unittests/
+op_test.py — ~700 test_*_op.py files; here one parameterized table).
+
+test_coverage asserts CASES ∪ EXEMPT == registry.registered_ops().
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import registry
+
+from op_test import OpTest
+
+R = np.random.RandomState  # shorthand
+
+
+def f32(a):
+    return np.asarray(a, np.float32)
+
+
+def _pos(rng, *shape):
+    """Positive, away from 0 (safe for log/sqrt/div grads)."""
+    return f32(rng.uniform(0.3, 1.5, shape))
+
+
+def _mix(rng, *shape):
+    """Mixed sign, away from kinks at 0 (safe for abs/relu grads)."""
+    return f32(rng.uniform(0.25, 1.25, shape) * np.where(rng.rand(*shape) < 0.5, -1, 1))
+
+
+def _softmax(z, axis=-1):
+    e = np.exp(z - z.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# case table: op_type -> list of zero-arg factories returning OpTest
+# ---------------------------------------------------------------------------
+
+CASES = {}
+
+
+def case(op_type):
+    def deco(fn):
+        CASES.setdefault(op_type, []).append(fn)
+        return fn
+
+    return deco
+
+
+def unary(op_type, np_fn, inp=_mix, grad=True, attrs=None, tol=1e-5, grad_tol=1e-2):
+    def make():
+        x = inp(R(7), 3, 5)
+        return OpTest(
+            op_type, {"X": x},
+            lambda ins, a, fn=np_fn: {"Out": [f32(fn(ins["X"][0], a))]},
+            attrs=attrs, grad=("X",) if grad else (), tol=tol, grad_tol=grad_tol,
+        )
+
+    CASES.setdefault(op_type, []).append(make)
+
+
+# ---- activations / unary elementwise --------------------------------------
+unary("abs", lambda x, a: np.abs(x))
+unary("acos", lambda x, a: np.arccos(x), inp=lambda r, *s: f32(r.uniform(-0.8, 0.8, s)))
+unary("asin", lambda x, a: np.arcsin(x), inp=lambda r, *s: f32(r.uniform(-0.8, 0.8, s)))
+unary("atan", lambda x, a: np.arctan(x))
+unary("ceil", lambda x, a: np.ceil(x), grad=False)
+unary("floor", lambda x, a: np.floor(x), grad=False)
+unary("round", lambda x, a: np.round(x), grad=False)
+unary("sign", lambda x, a: np.sign(x), grad=False)
+unary("cos", lambda x, a: np.cos(x))
+unary("sin", lambda x, a: np.sin(x))
+unary("tan", lambda x, a: np.tan(x))
+unary("sinh", lambda x, a: np.sinh(x))
+unary("cosh", lambda x, a: np.cosh(x))
+unary("erf", lambda x, a: np.vectorize(__import__("math").erf)(x).astype(np.float32))
+unary("exp", lambda x, a: np.exp(x))
+unary("log", lambda x, a: np.log(x), inp=_pos)
+unary("log2", lambda x, a: np.log2(x), inp=_pos)
+unary("log10", lambda x, a: np.log10(x), inp=_pos)
+unary("log1p", lambda x, a: np.log1p(x), inp=_pos)
+unary("sqrt", lambda x, a: np.sqrt(x), inp=_pos)
+unary("rsqrt", lambda x, a: 1.0 / np.sqrt(x), inp=_pos)
+unary("square", lambda x, a: np.square(x))
+unary("reciprocal", lambda x, a: 1.0 / x, inp=_pos)
+unary("sigmoid", lambda x, a: 1 / (1 + np.exp(-x)))
+unary("logsigmoid", lambda x, a: -np.log1p(np.exp(-x)))
+unary("tanh", lambda x, a: np.tanh(x))
+unary("relu", lambda x, a: np.maximum(x, 0))
+unary("relu6", lambda x, a: np.clip(x, 0, 6.0))
+unary("softplus", lambda x, a: np.log1p(np.exp(x)))
+unary("softsign", lambda x, a: x / (1 + np.abs(x)))
+unary("silu", lambda x, a: x / (1 + np.exp(-x)))
+unary("swish", lambda x, a: x / (1 + np.exp(-x)))
+unary("mish", lambda x, a: x * np.tanh(np.log1p(np.exp(x))))
+unary("leaky_relu", lambda x, a: np.where(x > 0, x, 0.02 * x))
+unary("elu", lambda x, a: np.where(x > 0, x, np.exp(x) - 1.0))
+unary(
+    "gelu",
+    lambda x, a: x * 0.5 * (1 + np.vectorize(__import__("math").erf)(x / np.sqrt(2.0))),
+    tol=1e-4,
+)
+unary("hard_sigmoid", lambda x, a: np.clip(0.2 * x + 0.5, 0, 1))
+unary("hard_swish", lambda x, a: x * np.clip(x + 3.0, 0, 6.0) / 6.0)
+unary("thresholded_relu", lambda x, a: np.where(x > 1.0, x, 0.0), inp=lambda r, *s: f32(r.uniform(0.5, 1.6, s)))
+unary("hard_shrink", lambda x, a: np.where(np.abs(x) > 0.5, x, 0.0), inp=lambda r, *s: f32(r.uniform(0.7, 1.5, s)))
+unary("soft_shrink", lambda x, a: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0), inp=lambda r, *s: f32(r.uniform(0.8, 1.5, s) * np.where(r.rand(*s) < 0.5, -1, 1)))
+unary("scale", lambda x, a: x * 3.0 + 0.5, attrs={"scale": 3.0, "bias": 0.5})
+unary("increment", lambda x, a: x + 2.0, attrs={"step": 2.0})
+unary("assign", lambda x, a: x)
+unary("pow", lambda x, a: np.power(x, 2.0), inp=_pos, attrs={"factor": 2.0})
+unary("clip", lambda x, a: np.clip(x, -0.5, 0.5), attrs={"min": -0.5, "max": 0.5}, grad=False)
+unary("logsumexp", lambda x, a: f32([np.log(np.sum(np.exp(x)))]), attrs={"axis": [], "keepdim": False})
+unary("softmax", lambda x, a: _softmax(x))
+unary("log_softmax", lambda x, a: np.log(_softmax(x)))
+unary("mean", lambda x, a: f32([x.mean()]))
+unary("squared_l2_norm", lambda x, a: f32([np.sum(x * x)]))
+
+
+@case("cast")
+def _cast():
+    x = _mix(R(3), 3, 4)
+    return OpTest(
+        "cast", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].astype(np.int32)]},
+        attrs={"in_dtype": np.dtype("float32"), "out_dtype": np.dtype("int32")},
+    )
+
+
+# ---- binary elementwise ----------------------------------------------------
+
+
+def binary(op_type, np_fn, y_inp=None, grad=("X", "Y"), attrs=None):
+    def make():
+        rng = R(11)
+        x = _mix(rng, 3, 4)
+        if y_inp is None:
+            # keep |x-y| >= 0.15: min/max kinks stay out of finite-diff reach
+            y = x + f32(np.where(rng.rand(3, 4) < 0.5, -1, 1) * rng.uniform(0.15, 0.8, (3, 4)))
+        else:
+            y = y_inp(rng, 3, 4)
+        return OpTest(
+            op_type, {"X": x, "Y": y},
+            lambda ins, a, fn=np_fn: {"Out": [fn(ins["X"][0], ins["Y"][0])]},
+            attrs=attrs, grad=grad,
+        )
+
+    CASES.setdefault(op_type, []).append(make)
+
+
+binary("elementwise_add", lambda x, y: x + y)
+binary("elementwise_sub", lambda x, y: x - y)
+binary("elementwise_mul", lambda x, y: x * y)
+binary("elementwise_div", lambda x, y: x / y, y_inp=_pos)
+binary("elementwise_min", lambda x, y: np.minimum(x, y))
+binary("elementwise_max", lambda x, y: np.maximum(x, y))
+binary("maximum", lambda x, y: np.maximum(x, y))
+binary("minimum", lambda x, y: np.minimum(x, y))
+
+
+@case("elementwise_pow")
+def _epow():
+    rng = R(2)
+    x, y = _pos(rng, 3, 4), _pos(rng, 3, 4)
+    return OpTest(
+        "elementwise_pow", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [np.power(ins["X"][0], ins["Y"][0])]},
+        grad=("X", "Y"),
+    )
+
+
+@case("elementwise_mod")
+def _emod():
+    rng = R(5)
+    x = rng.randint(1, 50, (3, 4)).astype(np.int32)
+    y = rng.randint(1, 7, (3, 4)).astype(np.int32)
+    return OpTest(
+        "elementwise_mod", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [np.mod(ins["X"][0], ins["Y"][0])]},
+    )
+
+
+@case("elementwise_floordiv")
+def _efdiv():
+    rng = R(5)
+    x = rng.randint(1, 50, (3, 4)).astype(np.int32)
+    y = rng.randint(1, 7, (3, 4)).astype(np.int32)
+    return OpTest(
+        "elementwise_floordiv", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [ins["X"][0] // ins["Y"][0]]},
+    )
+
+
+@case("elementwise_add")
+def _eadd_axis():
+    """paddle axis-broadcast: y [4] into x [2,4,3] at axis=1."""
+    rng = R(13)
+    x = _mix(rng, 2, 4, 3)
+    y = _mix(rng, 4)
+    return OpTest(
+        "elementwise_add", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [ins["X"][0] + ins["Y"][0].reshape(1, 4, 1)]},
+        attrs={"axis": 1}, grad=("X", "Y"),
+    )
+
+
+@case("sum")
+def _sum():
+    rng = R(17)
+    xs = [_mix(rng, 3, 4) for _ in range(3)]
+    return OpTest(
+        "sum", {"X": xs},
+        lambda ins, a: {"Out": [ins["X"][0] + ins["X"][1] + ins["X"][2]]},
+        grad=("X",),
+    )
+
+
+# ---- compare / logical -----------------------------------------------------
+
+
+def cmp_case(op_type, np_fn):
+    def make():
+        rng = R(23)
+        x = rng.randint(0, 3, (3, 4)).astype(np.float32)
+        y = rng.randint(0, 3, (3, 4)).astype(np.float32)
+        return OpTest(
+            op_type, {"X": x, "Y": y},
+            lambda ins, a, fn=np_fn: {"Out": [fn(ins["X"][0], ins["Y"][0])]},
+        )
+
+    CASES.setdefault(op_type, []).append(make)
+
+
+cmp_case("equal", np.equal)
+cmp_case("not_equal", np.not_equal)
+cmp_case("less_than", np.less)
+cmp_case("less_equal", np.less_equal)
+cmp_case("greater_than", np.greater)
+cmp_case("greater_equal", np.greater_equal)
+
+
+def logical_case(op_type, np_fn, nin=2):
+    def make():
+        rng = R(29)
+        x = rng.rand(3, 4) > 0.5
+        y = rng.rand(3, 4) > 0.5
+        ins = {"X": x} if nin == 1 else {"X": x, "Y": y}
+        return OpTest(
+            op_type, ins,
+            lambda i, a, fn=np_fn: {
+                "Out": [fn(i["X"][0]) if nin == 1 else fn(i["X"][0], i["Y"][0])]
+            },
+        )
+
+    CASES.setdefault(op_type, []).append(make)
+
+
+logical_case("logical_and", np.logical_and)
+logical_case("logical_or", np.logical_or)
+logical_case("logical_xor", np.logical_xor)
+logical_case("logical_not", np.logical_not, nin=1)
+
+
+@case("allclose")
+def _allclose():
+    x = f32([[1.0, 2.0], [3.0, 4.0]])
+    return OpTest(
+        "allclose", {"Input": x, "Other": x + 1e-7},
+        lambda ins, a: {"Out": [np.asarray(True)]},
+        attrs={"rtol": 1e-5, "atol": 1e-8},
+    )
+
+
+def isx_case(op_type, np_fn, reduced):
+    def make():
+        x = f32([[1.0, np.inf], [np.nan, 2.0]])
+        if reduced:
+            oracle = lambda ins, a, fn=np_fn: {"Out": [np.asarray([fn(ins["X"][0]).any() if op_type != "isfinite" else fn(ins["X"][0]).all()])]}
+        else:
+            oracle = lambda ins, a, fn=np_fn: {"Out": [fn(ins["X"][0])]}
+        return OpTest(op_type, {"X": x}, oracle)
+
+    CASES.setdefault(op_type, []).append(make)
+
+
+isx_case("isfinite", np.isfinite, True)
+isx_case("isinf", np.isinf, True)
+isx_case("isnan", np.isnan, True)
+isx_case("isfinite_v2", np.isfinite, False)
+isx_case("isinf_v2", np.isinf, False)
+isx_case("isnan_v2", np.isnan, False)
+
+
+# ---- reductions ------------------------------------------------------------
+
+
+def reduce_case(op_type, np_fn, grad=True, boolean=False):
+    def make():
+        rng = R(31)
+        x = (rng.rand(2, 3, 4) > 0.5) if boolean else _mix(rng, 2, 3, 4)
+        return OpTest(
+            op_type, {"X": x},
+            lambda ins, a, fn=np_fn: {"Out": [fn(ins["X"][0], axis=1)]},
+            attrs={"dim": [1], "keep_dim": False},
+            grad=("X",) if grad else (),
+        )
+
+    def make_all():
+        rng = R(37)
+        x = (rng.rand(2, 3) > 0.5) if boolean else _pos(rng, 2, 3)
+        return OpTest(
+            op_type, {"X": x},
+            lambda ins, a, fn=np_fn: {"Out": [np.asarray([fn(ins["X"][0])])]},
+            attrs={"reduce_all": True, "keep_dim": False, "dim": [0]},
+            grad=("X",) if grad else (),
+        )
+
+    CASES.setdefault(op_type, []).extend([make, make_all])
+
+
+reduce_case("reduce_sum", np.sum)
+reduce_case("reduce_mean", np.mean)
+reduce_case("reduce_max", np.max)
+reduce_case("reduce_min", np.min)
+reduce_case("reduce_prod", np.prod)
+reduce_case("reduce_all", np.all, grad=False, boolean=True)
+reduce_case("reduce_any", np.any, grad=False, boolean=True)
+
+
+@case("frobenius_norm")
+def _frob():
+    x = _mix(R(41), 3, 4)
+    return OpTest(
+        "frobenius_norm", {"X": x},
+        lambda ins, a: {"Out": [f32([np.sqrt(np.sum(np.square(ins["X"][0])))])]},
+        attrs={"reduce_all": True, "keep_dim": False}, grad=("X",),
+    )
+
+
+@case("p_norm")
+def _pnorm():
+    x = _mix(R(43), 3, 4)
+    return OpTest(
+        "p_norm", {"X": x},
+        lambda ins, a: {"Out": [np.linalg.norm(ins["X"][0], ord=2, axis=-1).astype(np.float32)]},
+        attrs={"porder": 2.0, "axis": -1, "keepdim": False}, grad=("X",),
+    )
+
+
+@case("norm")
+def _norm():
+    x = _mix(R(47), 3, 4)
+
+    def oracle(ins, a):
+        n = np.sqrt(np.sum(np.square(ins["X"][0]), axis=-1, keepdims=True) + 1e-10)
+        return {"Out": [f32(ins["X"][0] / n)], "Norm": [f32(n)]}
+
+    return OpTest(
+        "norm", {"X": x}, oracle, attrs={"axis": -1},
+        outputs={"Out": 1, "Norm": 1}, grad=("X",),
+    )
+
+
+@case("trace")
+def _trace():
+    x = _mix(R(53), 4, 4)
+    return OpTest(
+        "trace", {"Input": x},
+        lambda ins, a: {"Out": [np.trace(ins["Input"][0]).astype(np.float32)]},
+        grad=("Input",),
+    )
+
+
+# ---- matmul family ---------------------------------------------------------
+
+
+@case("matmul")
+def _matmul():
+    rng = R(59)
+    return OpTest(
+        "matmul", {"X": _mix(rng, 3, 5), "Y": _mix(rng, 2, 5)},
+        lambda ins, a: {"Out": [2.0 * ins["X"][0] @ ins["Y"][0].T]},
+        attrs={"transpose_Y": True, "alpha": 2.0}, grad=("X", "Y"), grad_tol=2e-2,
+    )
+
+
+@case("matmul_v2")
+def _matmul_v2():
+    rng = R(61)
+    return OpTest(
+        "matmul_v2", {"X": _mix(rng, 2, 3, 5), "Y": _mix(rng, 2, 5, 4)},
+        lambda ins, a: {"Out": [ins["X"][0] @ ins["Y"][0]]},
+        grad=("X", "Y"), grad_tol=2e-2,
+    )
+
+
+@case("mul")
+def _mul():
+    rng = R(67)
+    x, y = _mix(rng, 2, 3, 4), _mix(rng, 12, 5)
+    return OpTest(
+        "mul", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [(ins["X"][0].reshape(2, 12) @ ins["Y"][0]).reshape(2, 5)]},
+        attrs={"x_num_col_dims": 1, "y_num_col_dims": 1}, grad=("X", "Y"), grad_tol=2e-2,
+    )
+
+
+@case("dot")
+def _dot():
+    rng = R(71)
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 4)
+    return OpTest(
+        "dot", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [np.sum(ins["X"][0] * ins["Y"][0], -1, keepdims=True)]},
+        grad=("X", "Y"),
+    )
+
+
+@case("addmm")
+def _addmm():
+    rng = R(73)
+    return OpTest(
+        "addmm", {"Input": _mix(rng, 2, 4), "X": _mix(rng, 2, 3), "Y": _mix(rng, 3, 4)},
+        lambda ins, a: {"Out": [0.5 * ins["Input"][0] + 2.0 * (ins["X"][0] @ ins["Y"][0])]},
+        attrs={"Alpha": 2.0, "Beta": 0.5}, grad=("Input", "X", "Y"), grad_tol=2e-2,
+    )
+
+
+@case("kron")
+def _kron():
+    rng = R(79)
+    return OpTest(
+        "kron", {"X": _mix(rng, 2, 3), "Y": _mix(rng, 2, 2)},
+        lambda ins, a: {"Out": [np.kron(ins["X"][0], ins["Y"][0])]},
+        grad=("X", "Y"),
+    )
+
+
+@case("matrix_power")
+def _matpow():
+    x = f32(np.eye(3) * 0.8 + R(83).rand(3, 3) * 0.1)
+    return OpTest(
+        "matrix_power", {"X": x},
+        lambda ins, a: {"Out": [np.linalg.matrix_power(ins["X"][0], 3).astype(np.float32)]},
+        attrs={"n": 3}, grad=("X",), grad_tol=3e-2,
+    )
+
+
+@case("inverse")
+def _inverse():
+    x = f32(np.eye(3) + R(89).rand(3, 3) * 0.2)
+    return OpTest(
+        "inverse", {"Input": x},
+        lambda ins, a: {"Output": [np.linalg.inv(ins["Input"][0]).astype(np.float32)]},
+        outputs={"Output": 1}, grad=("Input",), grad_tol=3e-2, tol=1e-4,
+    )
+
+
+@case("cholesky")
+def _cholesky():
+    rng = R(97)
+    a = f32(rng.rand(3, 3) * 0.3)
+    x = a @ a.T + np.eye(3, dtype=np.float32)
+    return OpTest(
+        "cholesky", {"X": x},
+        lambda ins, a_: {"Out": [np.linalg.cholesky(ins["X"][0]).astype(np.float32)]},
+        tol=1e-4,
+    )
+
+
+@case("clip_by_norm")
+def _clip_by_norm():
+    x = _mix(R(101), 3, 4) * 5.0
+
+    def oracle(ins, a):
+        n = np.sqrt(np.sum(np.square(ins["X"][0])))
+        return {"Out": [f32(ins["X"][0] * (1.0 / max(n / 1.0, 1.0)))]}
+
+    return OpTest("clip_by_norm", {"X": x}, oracle, attrs={"max_norm": 1.0})
+
+
+@case("prelu")
+def _prelu():
+    rng = R(103)
+    x = _mix(rng, 2, 3)
+    alpha = f32([0.25])
+    return OpTest(
+        "prelu", {"X": x, "Alpha": alpha},
+        lambda ins, a: {"Out": [np.where(ins["X"][0] > 0, ins["X"][0], 0.25 * ins["X"][0])]},
+        attrs={"mode": "all"}, grad=("X",),
+    )
+
+
+@case("maxout")
+def _maxout():
+    x = _mix(R(107), 2, 6, 3)
+    return OpTest(
+        "maxout", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].reshape(2, 2, 3, 3).max(axis=2)]},
+        attrs={"groups": 3}, grad=("X",),
+    )
+
+
+# ---- manipulation ----------------------------------------------------------
+
+
+@case("reshape")
+def _reshape():
+    x = _mix(R(109), 2, 6)
+    return OpTest(
+        "reshape", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].reshape(3, 4)]},
+        attrs={"shape": [3, -1]}, grad=("X",),
+    )
+
+
+@case("reshape2")
+def _reshape2():
+    x = _mix(R(113), 2, 6)
+    return OpTest(
+        "reshape2", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].reshape(3, 4)]},
+        attrs={"shape": [3, 4]}, outputs={"Out": 1, "XShape": 1}, grad=("X",),
+    )
+
+
+@case("transpose")
+def _transpose():
+    x = _mix(R(127), 2, 3, 4)
+    return OpTest(
+        "transpose", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].transpose(2, 0, 1)]},
+        attrs={"axis": [2, 0, 1]}, grad=("X",),
+    )
+
+
+@case("transpose2")
+def _transpose2():
+    x = _mix(R(131), 2, 3)
+    return OpTest(
+        "transpose2", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].T]},
+        attrs={"axis": [1, 0]}, outputs={"Out": 1, "XShape": 1}, grad=("X",),
+    )
+
+
+@case("concat")
+def _concat():
+    rng = R(137)
+    xs = [_mix(rng, 2, 3), _mix(rng, 2, 2)]
+    return OpTest(
+        "concat", {"X": xs},
+        lambda ins, a: {"Out": [np.concatenate(ins["X"], axis=1)]},
+        attrs={"axis": 1}, grad=("X",),
+    )
+
+
+@case("split")
+def _split():
+    x = _mix(R(139), 2, 6)
+    return OpTest(
+        "split", {"X": x},
+        lambda ins, a: {"Out": list(np.split(ins["X"][0], 3, axis=1))},
+        attrs={"num": 3, "axis": 1}, outputs={"Out": 3}, grad=("X",),
+    )
+
+
+@case("slice")
+def _slice():
+    x = _mix(R(149), 4, 5)
+    return OpTest(
+        "slice", {"Input": x},
+        lambda ins, a: {"Out": [ins["Input"][0][1:3, 0:4]]},
+        attrs={"axes": [0, 1], "starts": [1, 0], "ends": [3, 4], "decrease_axis": []},
+        grad=("Input",),
+    )
+
+
+@case("strided_slice")
+def _strided_slice():
+    x = _mix(R(151), 6, 5)
+    return OpTest(
+        "strided_slice", {"Input": x},
+        lambda ins, a: {"Out": [ins["Input"][0][0:6:2]]},
+        attrs={"axes": [0], "starts": [0], "ends": [6], "strides": [2]},
+        grad=("Input",),
+    )
+
+
+@case("stack")
+def _stack():
+    rng = R(157)
+    xs = [_mix(rng, 2, 3) for _ in range(3)]
+    return OpTest(
+        "stack", {"X": xs},
+        lambda ins, a: {"Y": [np.stack(ins["X"], axis=1)]},
+        attrs={"axis": 1}, outputs={"Y": 1}, grad=("X",),
+    )
+
+
+@case("unstack")
+def _unstack():
+    x = _mix(R(163), 3, 2, 4)
+    return OpTest(
+        "unstack", {"X": x},
+        lambda ins, a: {"Y": [ins["X"][0][i] for i in range(3)]},
+        attrs={"axis": 0, "num": 3}, outputs={"Y": 3}, grad=("X",),
+    )
+
+
+@case("unbind")
+def _unbind():
+    x = _mix(R(167), 2, 3, 2)
+    return OpTest(
+        "unbind", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0][:, i] for i in range(3)]},
+        attrs={"axis": 1}, outputs={"Out": 3}, grad=("X",),
+    )
+
+
+@case("squeeze")
+def _squeeze():
+    x = _mix(R(173), 2, 1, 3)
+    return OpTest(
+        "squeeze", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].squeeze(1)]},
+        attrs={"axes": [1]}, grad=("X",),
+    )
+
+
+@case("squeeze2")
+def _squeeze2():
+    x = _mix(R(179), 2, 1, 3)
+    return OpTest(
+        "squeeze2", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].squeeze(1)]},
+        attrs={"axes": [1]}, outputs={"Out": 1, "XShape": 1}, grad=("X",),
+    )
+
+
+@case("unsqueeze")
+def _unsqueeze():
+    x = _mix(R(181), 2, 3)
+    return OpTest(
+        "unsqueeze", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0][:, None, :]]},
+        attrs={"axes": [1]}, grad=("X",),
+    )
+
+
+@case("unsqueeze2")
+def _unsqueeze2():
+    x = _mix(R(191), 2, 3)
+    return OpTest(
+        "unsqueeze2", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0][:, None, :]]},
+        attrs={"axes": [1]}, outputs={"Out": 1, "XShape": 1}, grad=("X",),
+    )
+
+
+@case("flatten")
+def _flatten():
+    x = _mix(R(193), 2, 3, 4)
+    return OpTest(
+        "flatten", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].reshape(2, 12)]},
+        attrs={"axis": 1}, grad=("X",),
+    )
+
+
+@case("flatten2")
+def _flatten2():
+    x = _mix(R(197), 2, 3, 4)
+    return OpTest(
+        "flatten2", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].reshape(2, 12)]},
+        attrs={"axis": 1}, outputs={"Out": 1, "XShape": 1}, grad=("X",),
+    )
+
+
+@case("flatten_contiguous_range")
+def _flatten_cr():
+    x = _mix(R(199), 2, 3, 4, 2)
+    return OpTest(
+        "flatten_contiguous_range", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].reshape(2, 12, 2)]},
+        attrs={"start_axis": 1, "stop_axis": 2},
+        outputs={"Out": 1, "XShape": 1}, grad=("X",),
+    )
+
+
+@case("expand")
+def _expand():
+    x = _mix(R(211), 2, 3)
+    return OpTest(
+        "expand", {"X": x},
+        lambda ins, a: {"Out": [np.tile(ins["X"][0], (2, 1))]},
+        attrs={"expand_times": [2, 1]}, grad=("X",),
+    )
+
+
+@case("expand_v2")
+def _expand_v2():
+    x = _mix(R(223), 1, 3)
+    return OpTest(
+        "expand_v2", {"X": x},
+        lambda ins, a: {"Out": [np.broadcast_to(ins["X"][0], (4, 3))]},
+        attrs={"shape": [4, 3]}, grad=("X",),
+    )
+
+
+@case("expand_as")
+def _expand_as():
+    rng = R(227)
+    x, tgt = _mix(rng, 1, 3), _mix(rng, 4, 3)
+    return OpTest(
+        "expand_as", {"X": x, "target_tensor": tgt},
+        lambda ins, a: {"Out": [np.broadcast_to(ins["X"][0], (4, 3))]},
+        grad=("X",),
+    )
+
+
+@case("tile")
+def _tile():
+    x = _mix(R(229), 2, 3)
+    return OpTest(
+        "tile", {"X": x},
+        lambda ins, a: {"Out": [np.tile(ins["X"][0], (2, 2))]},
+        attrs={"repeat_times": [2, 2]}, grad=("X",),
+    )
+
+
+@case("gather")
+def _gather():
+    rng = R(233)
+    x = _mix(rng, 5, 3)
+    idx = np.asarray([0, 2, 4], np.int32)
+    return OpTest(
+        "gather", {"X": x, "Index": idx},
+        lambda ins, a: {"Out": [ins["X"][0][ins["Index"][0]]]},
+        grad=("X",),
+    )
+
+
+@case("gather_nd")
+def _gather_nd():
+    rng = R(239)
+    x = _mix(rng, 3, 4)
+    idx = np.asarray([[0, 1], [2, 3]], np.int32)
+    return OpTest(
+        "gather_nd", {"X": x, "Index": idx},
+        lambda ins, a: {"Out": [f32([ins["X"][0][0, 1], ins["X"][0][2, 3]])]},
+        grad=("X",),
+    )
+
+
+@case("scatter")
+def _scatter():
+    rng = R(241)
+    x = _mix(rng, 5, 3)
+    ids = np.asarray([1, 3], np.int32)
+    upd = _mix(rng, 2, 3)
+
+    def oracle(ins, a):
+        out = ins["X"][0].copy()
+        out[ins["Ids"][0]] = ins["Updates"][0]
+        return {"Out": [out]}
+
+    return OpTest(
+        "scatter", {"X": x, "Ids": ids, "Updates": upd}, oracle,
+        attrs={"overwrite": True}, grad=("X", "Updates"),
+    )
+
+
+@case("scatter_nd_add")
+def _scatter_nd_add():
+    rng = R(251)
+    x = _mix(rng, 4, 3)
+    idx = np.asarray([[1], [3]], np.int32)
+    upd = _mix(rng, 2, 3)
+
+    def oracle(ins, a):
+        out = ins["X"][0].copy()
+        out[1] += ins["Updates"][0][0]
+        out[3] += ins["Updates"][0][1]
+        return {"Out": [out]}
+
+    return OpTest(
+        "scatter_nd_add", {"X": x, "Index": idx, "Updates": upd}, oracle,
+        grad=("X", "Updates"),
+    )
+
+
+@case("pad")
+def _pad():
+    x = _mix(R(257), 2, 3)
+    return OpTest(
+        "pad", {"X": x},
+        lambda ins, a: {"Out": [np.pad(ins["X"][0], [(1, 0), (0, 2)], constant_values=0.5)]},
+        attrs={"paddings": [1, 0, 0, 2], "pad_value": 0.5}, grad=("X",),
+    )
+
+
+@case("pad2d")
+def _pad2d():
+    x = _mix(R(263), 2, 3, 4, 4)
+    return OpTest(
+        "pad2d", {"X": x},
+        lambda ins, a: {
+            "Out": [np.pad(ins["X"][0], [(0, 0), (0, 0), (1, 2), (0, 1)])]
+        },
+        attrs={"paddings": [1, 2, 0, 1], "mode": "constant", "pad_value": 0.0},
+        grad=("X",),
+    )
+
+
+@case("pad3d")
+def _pad3d():
+    x = _mix(R(269), 1, 2, 3, 3, 3)
+    return OpTest(
+        "pad3d", {"X": x},
+        lambda ins, a: {
+            "Out": [np.pad(ins["X"][0], [(0, 0), (0, 0), (1, 1), (1, 0), (0, 1)])]
+        },
+        attrs={"paddings": [0, 1, 1, 0, 1, 1], "mode": "constant", "value": 0.0},
+        grad=("X",),
+    )
+
+
+@case("flip")
+def _flip():
+    x = _mix(R(271), 2, 3)
+    return OpTest(
+        "flip", {"X": x},
+        lambda ins, a: {"Out": [np.flip(ins["X"][0], axis=(1,))]},
+        attrs={"axis": [1]}, grad=("X",),
+    )
+
+
+@case("roll")
+def _roll():
+    x = _mix(R(277), 2, 4)
+    return OpTest(
+        "roll", {"X": x},
+        lambda ins, a: {"Out": [np.roll(ins["X"][0], 1, axis=1)]},
+        attrs={"shifts": [1], "axis": [1]}, grad=("X",),
+    )
+
+
+@case("where")
+def _where():
+    rng = R(281)
+    cond = rng.rand(3, 4) > 0.5
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 4)
+    return OpTest(
+        "where", {"Condition": cond, "X": x, "Y": y},
+        lambda ins, a: {"Out": [np.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]},
+        grad=("X", "Y"),
+    )
+
+
+@case("arg_max")
+def _arg_max():
+    x = _mix(R(283), 3, 5)
+    return OpTest(
+        "arg_max", {"X": x},
+        lambda ins, a: {"Out": [np.argmax(ins["X"][0], -1)]},
+        attrs={"axis": -1},
+    )
+
+
+@case("arg_min")
+def _arg_min():
+    x = _mix(R(293), 3, 5)
+    return OpTest(
+        "arg_min", {"X": x},
+        lambda ins, a: {"Out": [np.argmin(ins["X"][0], -1)]},
+        attrs={"axis": -1},
+    )
+
+
+@case("argsort")
+def _argsort():
+    x = _mix(R(307), 3, 5)
+
+    def oracle(ins, a):
+        idx = np.argsort(ins["X"][0], -1)
+        return {"Out": [np.take_along_axis(ins["X"][0], idx, -1)], "Indices": [idx]}
+
+    return OpTest(
+        "argsort", {"X": x}, oracle, attrs={"axis": -1},
+        outputs={"Out": 1, "Indices": 1}, grad=("X",),
+    )
+
+
+@case("top_k")
+def _top_k():
+    x = f32(R(311).permutation(np.arange(18) * 0.3 - 2.0).reshape(3, 6))
+
+    def oracle(ins, a):
+        idx = np.argsort(-ins["X"][0], -1)[:, :2]
+        return {"Out": [np.take_along_axis(ins["X"][0], idx, -1)], "Indices": [idx]}
+
+    return OpTest(
+        "top_k", {"X": x}, oracle, attrs={"k": 2},
+        outputs={"Out": 1, "Indices": 1}, grad=("X",),
+    )
+
+
+@case("top_k_v2")
+def _top_k_v2():
+    x = f32(R(313).permutation(np.arange(18) * 0.3 - 2.0).reshape(3, 6))
+
+    def oracle(ins, a):
+        idx = np.argsort(-ins["X"][0], -1)[:, :2]
+        return {"Out": [np.take_along_axis(ins["X"][0], idx, -1)], "Indices": [idx]}
+
+    return OpTest(
+        "top_k_v2", {"X": x}, oracle, attrs={"k": 2, "axis": -1, "largest": True},
+        outputs={"Out": 1, "Indices": 1}, grad=("X",),
+    )
+
+
+@case("cumsum")
+def _cumsum():
+    x = _mix(R(317), 3, 4)
+    return OpTest(
+        "cumsum", {"X": x},
+        lambda ins, a: {"Out": [np.cumsum(ins["X"][0], axis=1)]},
+        attrs={"axis": 1}, grad=("X",),
+    )
+
+
+@case("tril_triu")
+def _tril_triu():
+    x = _mix(R(331), 4, 4)
+    return OpTest(
+        "tril_triu", {"X": x},
+        lambda ins, a: {"Out": [np.tril(ins["X"][0])]},
+        attrs={"lower": True, "diagonal": 0}, grad=("X",),
+    )
+
+
+@case("diag_v2")
+def _diag_v2():
+    x = _mix(R(337), 4)
+    return OpTest(
+        "diag_v2", {"X": x},
+        lambda ins, a: {"Out": [np.diag(ins["X"][0])]},
+        attrs={"offset": 0, "padding_value": 0.0},
+    )
+
+
+@case("index_select")
+def _index_select():
+    rng = R(347)
+    x = _mix(rng, 4, 3)
+    idx = np.asarray([0, 2], np.int32)
+    return OpTest(
+        "index_select", {"X": x, "Index": idx},
+        lambda ins, a: {"Out": [ins["X"][0][[0, 2]]]},
+        attrs={"dim": 0}, grad=("X",),
+    )
+
+
+@case("take_along_axis")
+def _take_along_axis():
+    rng = R(349)
+    x = _mix(rng, 3, 4)
+    idx = rng.randint(0, 4, (3, 2)).astype(np.int32)
+    return OpTest(
+        "take_along_axis", {"Input": x, "Index": idx},
+        lambda ins, a: {"Result": [np.take_along_axis(ins["Input"][0], ins["Index"][0], 1)]},
+        attrs={"Axis": 1}, outputs={"Result": 1}, grad=("Input",),
+    )
+
+
+@case("meshgrid")
+def _meshgrid():
+    rng = R(353)
+    xs = [_mix(rng, 3), _mix(rng, 4)]
+
+    def oracle(ins, a):
+        a_, b_ = np.meshgrid(ins["X"][0], ins["X"][1], indexing="ij")
+        return {"Out": [a_, b_]}
+
+    return OpTest("meshgrid", {"X": xs}, oracle, outputs={"Out": 2}, grad=("X",))
+
+
+@case("shard_index")
+def _shard_index():
+    ids = np.asarray([[1], [7], [12], [19]], np.int32)
+
+    def oracle(ins, a):
+        x = ins["X"][0]
+        shard = x // 10 == 1
+        return {"Out": [np.where(shard, x % 10, -1).astype(x.dtype)]}
+
+    return OpTest(
+        "shard_index", {"X": ids}, oracle,
+        attrs={"index_num": 20, "nshards": 2, "shard_id": 1, "ignore_value": -1},
+    )
+
+
+@case("one_hot")
+def _one_hot():
+    x = np.asarray([[0], [2], [1]], np.int32)
+
+    def oracle(ins, a):
+        return {"Out": [np.eye(3, dtype=np.float32)[ins["X"][0].reshape(-1)]]}
+
+    return OpTest("one_hot", {"X": x}, oracle, attrs={"depth": 3})
+
+
+@case("one_hot_v2")
+def _one_hot_v2():
+    x = np.asarray([0, 2, 1], np.int32)
+
+    def oracle(ins, a):
+        return {"Out": [np.eye(3, dtype=np.float32)[ins["X"][0]]]}
+
+    return OpTest("one_hot_v2", {"X": x}, oracle, attrs={"depth": 3})
+
+
+# ---- creation --------------------------------------------------------------
+
+
+@case("fill_constant")
+def _fill_constant():
+    return OpTest(
+        "fill_constant", {},
+        lambda ins, a: {"Out": [np.full((2, 3), 1.5, np.float32)]},
+        attrs={"shape": [2, 3], "value": 1.5, "dtype": np.dtype("float32")},
+    )
+
+
+@case("fill_constant_batch_size_like")
+def _fill_cbsl():
+    x = _mix(R(359), 4, 2)
+    return OpTest(
+        "fill_constant_batch_size_like", {"Input": x},
+        lambda ins, a: {"Out": [np.full((4, 7), 2.0, np.float32)]},
+        attrs={"shape": [1, 7], "value": 2.0, "dtype": np.dtype("float32"),
+               "input_dim_idx": 0, "output_dim_idx": 0},
+    )
+
+
+@case("fill_zeros_like")
+def _fill_zeros_like():
+    x = _mix(R(367), 2, 3)
+    return OpTest(
+        "fill_zeros_like", {"X": x},
+        lambda ins, a: {"Out": [np.zeros_like(ins["X"][0])]},
+    )
+
+
+@case("fill_any_like")
+def _fill_any_like():
+    x = _mix(R(373), 2, 3)
+    return OpTest(
+        "fill_any_like", {"X": x},
+        lambda ins, a: {"Out": [np.full_like(ins["X"][0], 3.5)]},
+        attrs={"value": 3.5},
+    )
+
+
+@case("eye")
+def _eye():
+    return OpTest(
+        "eye", {},
+        lambda ins, a: {"Out": [np.eye(3, 4, dtype=np.float32)]},
+        attrs={"num_rows": 3, "num_columns": 4, "dtype": np.dtype("float32")},
+    )
+
+
+@case("assign_value")
+def _assign_value():
+    vals = [1.0, 2.0, 3.0, 4.0]
+    return OpTest(
+        "assign_value", {},
+        lambda ins, a: {"Out": [f32(vals).reshape(2, 2)]},
+        attrs={"shape": [2, 2], "dtype": np.dtype("float32"), "fp32_values": vals},
+    )
+
+
+@case("range")
+def _range():
+    return OpTest(
+        "range", {},
+        lambda ins, a: {"Out": [np.arange(1, 9, 2, np.int32)]},
+        attrs={"start": 1, "end": 9, "step": 2, "dtype": np.dtype("int32")},
+    )
+
+
+@case("linspace")
+def _linspace():
+    return OpTest(
+        "linspace", {},
+        lambda ins, a: {"Out": [np.linspace(0.0, 1.0, 5).astype(np.float32)]},
+        attrs={"start": 0.0, "stop": 1.0, "num": 5, "dtype": np.dtype("float32")},
+    )
+
+
+@case("shape")
+def _shape():
+    x = _mix(R(379), 2, 5)
+    return OpTest(
+        "shape", {"Input": x},
+        lambda ins, a: {"Out": [np.asarray([2, 5], np.int32)]},
+    )
+
+
+# ---- nn: conv / pool / norm ------------------------------------------------
+
+
+def _np_conv2d(x, w, stride=1, pad=0):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+@case("conv2d")
+def _conv2d():
+    rng = R(383)
+    x = _mix(rng, 2, 3, 5, 5)
+    w = _mix(rng, 4, 3, 3, 3) * 0.2
+    return OpTest(
+        "conv2d", {"Input": x, "Filter": w},
+        lambda ins, a: {"Output": [_np_conv2d(ins["Input"][0], ins["Filter"][0], 1, 1)]},
+        attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1], "groups": 1},
+        outputs={"Output": 1}, grad=("Input", "Filter"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("conv3d")
+def _conv3d():
+    rng = R(389)
+    x = _mix(rng, 1, 2, 3, 4, 4)
+    w = _mix(rng, 3, 2, 2, 2, 2) * 0.2
+
+    def oracle(ins, a):
+        import jax.numpy as jnp
+        import jax.lax as lax
+
+        out = lax.conv_general_dilated(
+            jnp.asarray(ins["Input"][0]), jnp.asarray(ins["Filter"][0]),
+            (1, 1, 1), [(0, 0)] * 3,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+        return {"Output": [np.asarray(out)]}
+
+    # oracle via jax.lax on *numpy* inputs is independent of the Program
+    # path under test (the executor+emitter), matching the reference's use
+    # of scipy in conv oracles
+    return OpTest(
+        "conv3d", {"Input": x, "Filter": w}, oracle,
+        attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0], "dilations": [1, 1, 1], "groups": 1},
+        outputs={"Output": 1}, grad=("Input", "Filter"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("depthwise_conv2d")
+def _depthwise_conv2d():
+    rng = R(397)
+    x = _mix(rng, 1, 3, 5, 5)
+    w = _mix(rng, 3, 1, 3, 3) * 0.3
+
+    def oracle(ins, a):
+        xx, ww = ins["Input"][0], ins["Filter"][0]
+        out = np.zeros((1, 3, 3, 3), np.float32)
+        for c in range(3):
+            out[:, c:c + 1] = _np_conv2d(xx[:, c:c + 1], ww[c:c + 1])
+        return {"Output": [out]}
+
+    return OpTest(
+        "depthwise_conv2d", {"Input": x, "Filter": w}, oracle,
+        attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1]},
+        outputs={"Output": 1}, grad=("Input", "Filter"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("conv2d_transpose")
+def _conv2d_transpose():
+    rng = R(401)
+    x = _mix(rng, 1, 2, 3, 3)
+    w = _mix(rng, 2, 3, 2, 2) * 0.3
+
+    def oracle(ins, a):
+        xx, ww = ins["Input"][0], ins["Filter"][0]
+        out = np.zeros((1, 3, 4, 4), np.float32)
+        for i in range(3):
+            for j in range(3):
+                out[:, :, i:i + 2, j:j + 2] += np.einsum(
+                    "nc,cohw->nohw", xx[:, :, i, j], ww
+                )
+        return {"Output": [out]}
+
+    return OpTest(
+        "conv2d_transpose", {"Input": x, "Filter": w}, oracle,
+        attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1], "groups": 1},
+        outputs={"Output": 1}, grad=("Input", "Filter"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("pool2d")
+def _pool2d_max():
+    x = _mix(R(409), 1, 2, 4, 4)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        out = np.zeros((1, 2, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                out[:, :, i, j] = xx[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].max((2, 3))
+        return {"Out": [out]}
+
+    return OpTest(
+        "pool2d", {"X": x}, oracle,
+        attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+        grad=("X",),
+    )
+
+
+@case("pool2d")
+def _pool2d_avg_global():
+    x = _mix(R(419), 1, 2, 4, 4)
+    return OpTest(
+        "pool2d", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0].mean((2, 3), keepdims=True)]},
+        attrs={"pooling_type": "avg", "global_pooling": True, "ksize": [1, 1]},
+        grad=("X",),
+    )
+
+
+@case("batch_norm")
+def _batch_norm():
+    rng = R(421)
+    x = _mix(rng, 3, 2, 4)
+    scale, bias = _pos(rng, 2), _mix(rng, 2)
+    mean, var = np.zeros(2, np.float32), np.ones(2, np.float32)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        m = xx.mean((0, 2))
+        v = xx.var((0, 2))
+        y = (xx - m[None, :, None]) / np.sqrt(v[None, :, None] + 1e-5)
+        y = y * ins["Scale"][0][None, :, None] + ins["Bias"][0][None, :, None]
+        return {"Y": [f32(y)], "SavedMean": [f32(m)]}
+
+    return OpTest(
+        "batch_norm",
+        {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+        oracle, attrs={"epsilon": 1e-5, "momentum": 0.9, "data_layout": "NCHW"},
+        outputs={"Y": 1, "MeanOut": 1, "VarianceOut": 1, "SavedMean": 1, "SavedVariance": 1},
+        tol=1e-4,
+    )
+
+
+@case("layer_norm")
+def _layer_norm():
+    rng = R(431)
+    x = _mix(rng, 3, 4)
+    scale, bias = _pos(rng, 4), _mix(rng, 4)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        m = xx.mean(-1, keepdims=True)
+        v = xx.var(-1, keepdims=True)
+        y = (xx - m) / np.sqrt(v + 1e-5) * ins["Scale"][0] + ins["Bias"][0]
+        return {"Y": [f32(y)]}
+
+    return OpTest(
+        "layer_norm", {"X": x, "Scale": scale, "Bias": bias}, oracle,
+        attrs={"epsilon": 1e-5, "begin_norm_axis": 1},
+        outputs={"Y": 1, "Mean": 1, "Variance": 1},
+        grad=("X", "Scale", "Bias"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("group_norm")
+def _group_norm():
+    rng = R(433)
+    x = _mix(rng, 2, 4, 3)
+    scale, bias = _pos(rng, 4), _mix(rng, 4)
+
+    def oracle(ins, a):
+        xx = ins["X"][0].reshape(2, 2, 2, 3)
+        m = xx.mean((2, 3), keepdims=True)
+        v = xx.var((2, 3), keepdims=True)
+        y = ((xx - m) / np.sqrt(v + 1e-5)).reshape(2, 4, 3)
+        y = y * ins["Scale"][0][None, :, None] + ins["Bias"][0][None, :, None]
+        return {"Y": [f32(y)]}
+
+    return OpTest(
+        "group_norm", {"X": x, "Scale": scale, "Bias": bias}, oracle,
+        attrs={"groups": 2, "epsilon": 1e-5},
+        outputs={"Y": 1, "Mean": 1, "Variance": 1},
+        grad=("X",), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("instance_norm")
+def _instance_norm():
+    rng = R(439)
+    x = _mix(rng, 2, 3, 4)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        m = xx.mean(-1, keepdims=True)
+        v = xx.var(-1, keepdims=True)
+        return {"Y": [f32((xx - m) / np.sqrt(v + 1e-5))]}
+
+    return OpTest(
+        "instance_norm", {"X": x}, oracle, attrs={"epsilon": 1e-5},
+        outputs={"Y": 1, "SavedMean": 1, "SavedVariance": 1},
+        grad=("X",), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("dropout")
+def _dropout_test_mode():
+    x = _mix(R(443), 3, 4)
+    return OpTest(
+        "dropout", {"X": x},
+        lambda ins, a: {"Out": [ins["X"][0] * 0.7]},
+        attrs={"dropout_prob": 0.3, "is_test": True,
+               "dropout_implementation": "downgrade_in_infer"},
+        outputs={"Out": 1, "Mask": 1}, grad=("X",),
+    )
+
+
+@case("lookup_table")
+def _lookup_table():
+    rng = R(449)
+    w = _mix(rng, 6, 3)
+    ids = np.asarray([[0], [5], [2]], np.int32)
+    return OpTest(
+        "lookup_table", {"W": w, "Ids": ids},
+        lambda ins, a: {"Out": [ins["W"][0][[0, 5, 2]]]},
+        grad=("W",),
+    )
+
+
+@case("lookup_table_v2")
+def _lookup_table_v2():
+    rng = R(457)
+    w = _mix(rng, 6, 3)
+    ids = np.asarray([[0, 5], [2, 1]], np.int32)
+    return OpTest(
+        "lookup_table_v2", {"W": w, "Ids": ids},
+        lambda ins, a: {"Out": [ins["W"][0][ins["Ids"][0]]]},
+        grad=("W",),
+    )
+
+
+@case("embedding_with_scaled_gradient")
+def _emb_scaled():
+    rng = R(461)
+    w = _mix(rng, 6, 3)
+    ids = np.asarray([1, 4], np.int32)
+    return OpTest(
+        "embedding_with_scaled_gradient", {"W": w, "Ids": ids},
+        lambda ins, a: {"Out": [ins["W"][0][ins["Ids"][0]]]},
+        grad=("W",),
+    )
+
+
+# ---- losses ----------------------------------------------------------------
+
+
+@case("softmax_with_cross_entropy")
+def _swce():
+    rng = R(463)
+    logits = _mix(rng, 4, 5)
+    label = rng.randint(0, 5, (4, 1)).astype(np.int32)
+
+    def oracle(ins, a):
+        sm = _softmax(ins["Logits"][0])
+        lbl = ins["Label"][0].reshape(-1)
+        loss = -np.log(sm[np.arange(4), lbl])[:, None]
+        return {"Softmax": [f32(sm)], "Loss": [f32(loss)]}
+
+    return OpTest(
+        "softmax_with_cross_entropy", {"Logits": logits, "Label": label},
+        oracle, outputs={"Softmax": 1, "Loss": 1}, grad=("Logits",),
+    )
+
+
+@case("cross_entropy")
+def _cross_entropy():
+    rng = R(467)
+    x = _softmax(_mix(rng, 4, 5)).astype(np.float32)
+    label = rng.randint(0, 5, (4, 1)).astype(np.int32)
+
+    def oracle(ins, a):
+        lbl = ins["Label"][0].reshape(-1)
+        return {"Y": [f32(-np.log(ins["X"][0][np.arange(4), lbl]))[:, None]]}
+
+    return OpTest(
+        "cross_entropy", {"X": x, "Label": label}, oracle,
+        outputs={"Y": 1}, grad=("X",),
+    )
+
+
+@case("cross_entropy2")
+def _cross_entropy2():
+    rng = R(479)
+    x = _softmax(_mix(rng, 4, 5)).astype(np.float32)
+    label = rng.randint(0, 5, (4, 1)).astype(np.int32)
+
+    def oracle(ins, a):
+        lbl = ins["Label"][0].reshape(-1)
+        y = f32(-np.log(ins["X"][0][np.arange(4), lbl]))[:, None]
+        return {"Y": [y], "MatchX": [np.exp(-y)]}
+
+    return OpTest(
+        "cross_entropy2", {"X": x, "Label": label}, oracle,
+        outputs={"Y": 1, "XShape": 1, "MatchX": 1}, grad=("X",),
+    )
+
+
+@case("sigmoid_cross_entropy_with_logits")
+def _scel():
+    rng = R(487)
+    x = _mix(rng, 3, 4)
+    label = rng.randint(0, 2, (3, 4)).astype(np.float32)
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0], ins["Label"][0]
+        loss = np.maximum(xx, 0) - xx * ll + np.log1p(np.exp(-np.abs(xx)))
+        return {"Out": [f32(loss)]}
+
+    return OpTest(
+        "sigmoid_cross_entropy_with_logits", {"X": x, "Label": label},
+        oracle, grad=("X",),
+    )
+
+
+@case("bce_loss")
+def _bce():
+    rng = R(491)
+    x = f32(rng.uniform(0.1, 0.9, (3, 4)))
+    label = rng.randint(0, 2, (3, 4)).astype(np.float32)
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0], ins["Label"][0]
+        return {"Out": [f32(-(ll * np.log(xx) + (1 - ll) * np.log(1 - xx)))]}
+
+    return OpTest("bce_loss", {"X": x, "Label": label}, oracle, grad=("X",))
+
+
+@case("square_error_cost")
+def _sec():
+    rng = R(499)
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 4)
+    return OpTest(
+        "square_error_cost", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [np.square(ins["X"][0] - ins["Y"][0])]},
+        grad=("X", "Y"),
+    )
+
+
+@case("smooth_l1_loss")
+def _sl1():
+    rng = R(503)
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 4)
+
+    def oracle(ins, a):
+        d = ins["X"][0] - ins["Y"][0]
+        ad = np.abs(d)
+        loss = np.where(ad < 1.0, 0.5 * d * d, ad - 0.5)
+        return {"Out": [f32(loss.sum(1, keepdims=True))], "Diff": [f32(d)]}
+
+    return OpTest(
+        "smooth_l1_loss", {"X": x, "Y": y}, oracle,
+        attrs={"sigma": 1.0}, outputs={"Out": 1, "Diff": 1}, grad=("X", "Y"),
+    )
+
+
+@case("huber_loss")
+def _huber():
+    rng = R(509)
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 4)
+
+    def oracle(ins, a):
+        r = ins["Y"][0] - ins["X"][0]
+        ar = np.abs(r)
+        loss = np.where(ar <= 1.0, 0.5 * r * r, ar - 0.5)
+        return {"Out": [f32(loss)], "Residual": [f32(r)]}
+
+    return OpTest(
+        "huber_loss", {"X": x, "Y": y}, oracle, attrs={"delta": 1.0},
+        outputs={"Out": 1, "Residual": 1}, grad=("X",),
+    )
+
+
+@case("log_loss")
+def _log_loss():
+    rng = R(521)
+    p = f32(rng.uniform(0.2, 0.8, (4, 1)))
+    l = rng.randint(0, 2, (4, 1)).astype(np.float32)
+
+    def oracle(ins, a):
+        pp, ll = ins["Predicted"][0], ins["Labels"][0]
+        eps = 1e-4
+        return {"Loss": [f32(-ll * np.log(pp + eps) - (1 - ll) * np.log(1 - pp + eps))]}
+
+    return OpTest(
+        "log_loss", {"Predicted": p, "Labels": l}, oracle,
+        attrs={"epsilon": 1e-4}, outputs={"Loss": 1}, grad=("Predicted",),
+    )
+
+
+@case("kldiv_loss")
+def _kldiv():
+    rng = R(523)
+    x = _mix(rng, 3, 4)
+    t = _softmax(_mix(rng, 3, 4)).astype(np.float32)
+
+    def oracle(ins, a):
+        tt = ins["Target"][0]
+        loss = np.where(tt > 0, tt * (np.log(tt) - ins["X"][0]), 0.0)
+        return {"Loss": [f32([loss.mean()])]}
+
+    return OpTest(
+        "kldiv_loss", {"X": x, "Target": t}, oracle,
+        attrs={"reduction": "mean"}, outputs={"Loss": 1}, grad=("X",),
+    )
+
+
+@case("label_smooth")
+def _label_smooth():
+    x = np.eye(4, dtype=np.float32)[[0, 2, 1]]
+    return OpTest(
+        "label_smooth", {"X": x},
+        lambda ins, a: {"Out": [f32(0.9 * ins["X"][0] + 0.1 / 4)]},
+        attrs={"epsilon": 0.1}, grad=("X",),
+    )
+
+
+@case("mse_loss")
+def _mse():
+    rng = R(541)
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 4)
+    return OpTest(
+        "mse_loss", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [f32([np.mean(np.square(ins["X"][0] - ins["Y"][0]))])]},
+        grad=("X", "Y"),
+    )
+
+
+@case("margin_rank_loss")
+def _mrl():
+    rng = R(547)
+    x1, x2 = _mix(rng, 4, 1), _mix(rng, 4, 1)
+    label = np.where(rng.rand(4, 1) < 0.5, -1.0, 1.0).astype(np.float32)
+
+    def oracle(ins, a):
+        act = np.maximum(0.0, -ins["Label"][0] * (ins["X1"][0] - ins["X2"][0]) + 0.1)
+        return {"Out": [f32(act)]}
+
+    return OpTest(
+        "margin_rank_loss", {"X1": x1, "X2": x2, "Label": label}, oracle,
+        attrs={"margin": 0.1}, outputs={"Out": 1, "Activated": 1},
+    )
+
+
+@case("accuracy")
+def _accuracy():
+    idx = np.asarray([[0, 1], [2, 3], [1, 0]], np.int64)
+    label = np.asarray([[1], [0], [2]], np.int64)
+
+    def oracle(ins, a):
+        return {
+            "Accuracy": [f32([1.0 / 3.0])],
+            "Correct": [np.asarray([1], np.int32)],
+            "Total": [np.asarray([3], np.int32)],
+        }
+
+    return OpTest(
+        "accuracy", {"Indices": idx, "Label": label}, oracle,
+        outputs={"Accuracy": 1, "Correct": 1, "Total": 1},
+    )
+
+
+# ---- optimizer update ops --------------------------------------------------
+
+
+@case("sgd")
+def _sgd():
+    rng = R(557)
+    p, g = _mix(rng, 3, 4), _mix(rng, 3, 4)
+    lr = f32([0.1])
+    return OpTest(
+        "sgd", {"Param": p, "Grad": g, "LearningRate": lr},
+        lambda ins, a: {"ParamOut": [ins["Param"][0] - 0.1 * ins["Grad"][0]]},
+        outputs={"ParamOut": 1},
+    )
+
+
+@case("momentum")
+def _momentum():
+    rng = R(563)
+    p, g, v = _mix(rng, 3), _mix(rng, 3), _mix(rng, 3)
+    lr = f32([0.1])
+
+    def oracle(ins, a):
+        vo = 0.9 * ins["Velocity"][0] + ins["Grad"][0]
+        return {"ParamOut": [f32(ins["Param"][0] - 0.1 * vo)], "VelocityOut": [f32(vo)]}
+
+    return OpTest(
+        "momentum", {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr},
+        oracle, attrs={"mu": 0.9},
+        outputs={"ParamOut": 1, "VelocityOut": 1},
+    )
+
+
+@case("adam")
+def _adam():
+    rng = R(569)
+    p, g = _mix(rng, 4), _mix(rng, 4)
+    m1, m2 = _mix(rng, 4) * 0.1, _pos(rng, 4) * 0.01
+    b1p, b2p = f32([0.9]), f32([0.999])
+    lr = f32([0.01])
+
+    def oracle(ins, a):
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        gg = ins["Grad"][0]
+        m1o = b1 * ins["Moment1"][0] + (1 - b1) * gg
+        m2o = b2 * ins["Moment2"][0] + (1 - b2) * gg * gg
+        lr_t = 0.01 * np.sqrt(1 - ins["Beta2Pow"][0][0]) / (1 - ins["Beta1Pow"][0][0])
+        po = ins["Param"][0] - lr_t * m1o / (np.sqrt(m2o) + eps)
+        return {
+            "ParamOut": [f32(po)], "Moment1Out": [f32(m1o)], "Moment2Out": [f32(m2o)],
+            "Beta1PowOut": [f32([0.9 * 0.9])], "Beta2PowOut": [f32([0.999 * 0.999])],
+        }
+
+    return OpTest(
+        "adam",
+        {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+         "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr},
+        oracle, attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+        outputs={"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
+                 "Beta1PowOut": 1, "Beta2PowOut": 1},
+        tol=1e-4,
+    )
+
+
+@case("adamw")
+def _adamw():
+    rng = R(571)
+    p, g = _mix(rng, 4), _mix(rng, 4)
+    m1, m2 = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    b1p, b2p = f32([0.9]), f32([0.999])
+    lr = f32([0.01])
+
+    def oracle(ins, a):
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        gg = ins["Grad"][0]
+        m1o = (1 - b1) * gg
+        m2o = (1 - b2) * gg * gg
+        lr_t = 0.01 * np.sqrt(1 - ins["Beta2Pow"][0][0]) / (1 - ins["Beta1Pow"][0][0])
+        po = ins["Param"][0] - lr_t * m1o / (np.sqrt(m2o) + eps)
+        po = po - 0.01 * 0.01 * ins["Param"][0]
+        return {"ParamOut": [f32(po)]}
+
+    return OpTest(
+        "adamw",
+        {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+         "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr},
+        oracle, attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "coeff": 0.01},
+        outputs={"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
+                 "Beta1PowOut": 1, "Beta2PowOut": 1},
+        tol=1e-4,
+    )
+
+
+@case("adamax")
+def _adamax():
+    rng = R(577)
+    p, g = _mix(rng, 4), _mix(rng, 4)
+    m, inf = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    b1p = f32([0.9])
+    lr = f32([0.01])
+
+    def oracle(ins, a):
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        gg = ins["Grad"][0]
+        mo = (1 - b1) * gg
+        info = np.maximum(0.0, np.abs(gg))
+        po = ins["Param"][0] - (0.01 / (1 - 0.9)) * mo / (info + eps)
+        return {"ParamOut": [f32(po)], "MomentOut": [f32(mo)], "InfNormOut": [f32(info)]}
+
+    return OpTest(
+        "adamax",
+        {"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+         "Beta1Pow": b1p, "LearningRate": lr},
+        oracle, attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+        outputs={"ParamOut": 1, "MomentOut": 1, "InfNormOut": 1}, tol=1e-4,
+    )
+
+
+@case("adagrad")
+def _adagrad():
+    rng = R(587)
+    p, g, m = _mix(rng, 4), _mix(rng, 4), _pos(rng, 4) * 0.1
+    lr = f32([0.1])
+
+    def oracle(ins, a):
+        mo = ins["Moment"][0] + ins["Grad"][0] ** 2
+        po = ins["Param"][0] - 0.1 * ins["Grad"][0] / (np.sqrt(mo) + 1e-6)
+        return {"ParamOut": [f32(po)], "MomentOut": [f32(mo)]}
+
+    return OpTest(
+        "adagrad", {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+        oracle, attrs={"epsilon": 1e-6},
+        outputs={"ParamOut": 1, "MomentOut": 1}, tol=1e-4,
+    )
+
+
+@case("decayed_adagrad")
+def _decayed_adagrad():
+    rng = R(593)
+    p, g, m = _mix(rng, 4), _mix(rng, 4), _pos(rng, 4) * 0.1
+    lr = f32([0.1])
+
+    def oracle(ins, a):
+        mo = 0.95 * ins["Moment"][0] + 0.05 * ins["Grad"][0] ** 2
+        po = ins["Param"][0] - 0.1 * ins["Grad"][0] / (np.sqrt(mo) + 1e-6)
+        return {"ParamOut": [f32(po)], "MomentOut": [f32(mo)]}
+
+    return OpTest(
+        "decayed_adagrad", {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+        oracle, attrs={"decay": 0.95, "epsilon": 1e-6},
+        outputs={"ParamOut": 1, "MomentOut": 1}, tol=1e-4,
+    )
+
+
+@case("rmsprop")
+def _rmsprop():
+    rng = R(599)
+    p, g = _mix(rng, 4), _mix(rng, 4)
+    ms, mom = _pos(rng, 4) * 0.1, np.zeros(4, np.float32)
+    lr = f32([0.01])
+
+    def oracle(ins, a):
+        ms_out = 0.95 * ins["MeanSquare"][0] + 0.05 * ins["Grad"][0] ** 2
+        mo = 0.9 * ins["Moment"][0] + 0.01 * ins["Grad"][0] / np.sqrt(ms_out + 1e-6)
+        return {
+            "ParamOut": [f32(ins["Param"][0] - mo)],
+            "MomentOut": [f32(mo)], "MeanSquareOut": [f32(ms_out)],
+        }
+
+    return OpTest(
+        "rmsprop",
+        {"Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom, "LearningRate": lr},
+        oracle, attrs={"decay": 0.95, "epsilon": 1e-6, "momentum": 0.9},
+        outputs={"ParamOut": 1, "MomentOut": 1, "MeanSquareOut": 1}, tol=1e-4,
+    )
+
+
+@case("lamb")
+def _lamb():
+    rng = R(601)
+    p, g = _pos(rng, 4), _mix(rng, 4)
+    m1, m2 = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    b1p, b2p = f32([0.9]), f32([0.999])
+    lr = f32([0.01])
+
+    def oracle(ins, a):
+        b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+        gg = ins["Grad"][0]
+        m1o = (1 - b1) * gg
+        m2o = (1 - b2) * gg * gg
+        mhat = m1o / (1 - 0.9)
+        vhat = m2o / (1 - 0.999)
+        r = mhat / (np.sqrt(vhat) + eps) + wd * ins["Param"][0]
+        trust = np.linalg.norm(ins["Param"][0]) / np.linalg.norm(r)
+        po = ins["Param"][0] - 0.01 * trust * r
+        return {"ParamOut": [f32(po)], "Moment1Out": [f32(m1o)], "Moment2Out": [f32(m2o)]}
+
+    return OpTest(
+        "lamb",
+        {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+         "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr},
+        oracle, attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6, "weight_decay": 0.01},
+        outputs={"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
+                 "Beta1PowOut": 1, "Beta2PowOut": 1},
+        tol=1e-4,
+    )
+
+
+@case("lars_momentum")
+def _lars():
+    rng = R(607)
+    p, g, v = _pos(rng, 4), _mix(rng, 4), np.zeros(4, np.float32)
+    lr = f32([0.1])
+
+    def oracle(ins, a):
+        mu, coeff, wd = 0.9, 0.001, 0.0005
+        pn = np.linalg.norm(ins["Param"][0])
+        gn = np.linalg.norm(ins["Grad"][0])
+        local_lr = 0.1 * coeff * pn / (gn + wd * pn)
+        vo = mu * ins["Velocity"][0] + local_lr * (ins["Grad"][0] + wd * ins["Param"][0])
+        return {"ParamOut": [f32(ins["Param"][0] - vo)], "VelocityOut": [f32(vo)]}
+
+    return OpTest(
+        "lars_momentum",
+        {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr},
+        oracle, attrs={"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005},
+        outputs={"ParamOut": 1, "VelocityOut": 1}, tol=1e-4,
+    )
+
+
+@case("ftrl")
+def _ftrl():
+    rng = R(613)
+    p, g = _mix(rng, 4), _mix(rng, 4)
+    sq, lin = _pos(rng, 4) * 0.1, np.zeros(4, np.float32)
+    lr = f32([0.1])
+
+    def oracle(ins, a):
+        gg, pp = ins["Grad"][0], ins["Param"][0]
+        sq0 = ins["SquaredAccumulator"][0]
+        new_sq = sq0 + gg * gg
+        sigma = (np.sqrt(new_sq) - np.sqrt(sq0)) / 0.1
+        lin_out = ins["LinearAccumulator"][0] + gg - sigma * pp
+        denom = np.sqrt(new_sq) / 0.1
+        po = (np.clip(lin_out, 0, 0) - lin_out) / denom
+        return {
+            "ParamOut": [f32(po)], "SquaredAccumOut": [f32(new_sq)],
+            "LinearAccumOut": [f32(lin_out)],
+        }
+
+    return OpTest(
+        "ftrl",
+        {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+         "LinearAccumulator": lin, "LearningRate": lr},
+        oracle, attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5},
+        outputs={"ParamOut": 1, "SquaredAccumOut": 1, "LinearAccumOut": 1},
+        tol=1e-4,
+    )
+
+
+# ---------------------------------------------------------------------------
+# exemptions: ops whose contract is verified elsewhere or is stochastic
+# ---------------------------------------------------------------------------
+
+EXEMPT = {
+    # collectives need a mesh + axis env; numerics are checked against
+    # numpy on an 8-device virtual mesh in tests/test_collectives.py
+    "c_allgather": "test_collectives.py",
+    "c_allreduce_max": "test_collectives.py",
+    "c_allreduce_min": "test_collectives.py",
+    "c_allreduce_prod": "test_collectives.py",
+    "c_allreduce_sum": "test_collectives.py",
+    "c_broadcast": "test_collectives.py",
+    "c_reducescatter": "test_collectives.py",
+    "c_identity": "test_collectives.py",
+    # comm bootstrap/sync ops are no-ops under XLA (PJRT owns streams);
+    # exercised by every fleet/dryrun program in test_fleet.py
+    "c_comm_init": "no-op under XLA; test_fleet.py",
+    "c_comm_init_all": "no-op under XLA; test_fleet.py",
+    "c_gen_nccl_id": "no-op under XLA; test_fleet.py",
+    "c_sync_calc_stream": "no-op under XLA; test_fleet.py",
+    "c_sync_comm_stream": "no-op under XLA; test_fleet.py",
+    "c_wait_comm": "no-op under XLA; test_fleet.py",
+    "c_wait_compute": "no-op under XLA; test_fleet.py",
+    # control flow needs sub-block programs: tests/test_control_flow.py
+    "cond": "test_control_flow.py",
+    "while_loop": "test_control_flow.py",
+    "select_input": "test_control_flow.py",
+    # fused mega-ops have dedicated oracle suites
+    "fused_encoder_stack": "test_bert.py (vs per-layer composition)",
+    "fused_multihead_attention": "test_flash_attention.py + test_bert.py",
+    "recompute_segment": "test_meta_optimizers.py (recompute)",
+    # explicit grad kernels: exercised by check_grad of their forward op
+    "dropout_grad": "via dropout case's check_grad",
+    "argsort_grad": "via argsort case's check_grad",
+    "top_k_grad": "via top_k case's check_grad",
+    "top_k_v2_grad": "via top_k_v2 case's check_grad",
+    # stochastic draws: distribution checked in test_random_ops below
+    "uniform_random": "test_random_ops",
+    "gaussian_random": "test_random_ops",
+    "truncated_gaussian_random": "test_random_ops",
+    "dpsgd": "test_random_ops (noisy update; mean drift checked)",
+}
+
+
+# ---------------------------------------------------------------------------
+# the tests
+# ---------------------------------------------------------------------------
+
+
+def test_coverage():
+    registered = set(registry.registered_ops())
+    covered = set(CASES) | set(EXEMPT)
+    missing = registered - covered
+    assert not missing, f"ops with neither case nor exemption: {sorted(missing)}"
+    double = set(CASES) & set(EXEMPT)
+    assert not double, f"ops both cased and exempted: {sorted(double)}"
+    stale = covered - registered
+    assert not stale, f"cases/exemptions for unregistered ops: {sorted(stale)}"
+
+
+_ALL = [(op, i) for op, fns in sorted(CASES.items()) for i in range(len(fns))]
+
+
+@pytest.mark.parametrize("op_type,i", _ALL, ids=[f"{o}-{i}" for o, i in _ALL])
+def test_op(op_type, i):
+    CASES[op_type][i]().run()
+
+
+def test_random_ops():
+    """Statistical checks for the stochastic creation ops + dpsgd."""
+    import paddle_tpu.fluid as fluid
+
+    def run_op(op_type, attrs, inputs=None, outputs=("Out",)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            feed = {}
+            in_names = {}
+            for slot, arr in (inputs or {}).items():
+                n = f"in_{slot}"
+                block.create_var(name=n, shape=arr.shape, dtype=arr.dtype)
+                feed[n] = arr
+                in_names[slot] = [n]
+            for o in outputs:
+                block.create_var(name=f"out_{o}")
+            block.append_op(
+                type=op_type, inputs=in_names,
+                outputs={o: [f"out_{o}"] for o in outputs}, attrs=attrs,
+            )
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            return [
+                np.asarray(v)
+                for v in exe.run(main, feed=feed, fetch_list=[f"out_{o}" for o in outputs])
+            ]
+
+    (u,) = run_op(
+        "uniform_random",
+        {"shape": [1000], "min": -2.0, "max": 2.0, "dtype": np.dtype("float32")},
+    )
+    assert u.min() >= -2.0 and u.max() <= 2.0
+    assert abs(u.mean()) < 0.2
+
+    (g,) = run_op(
+        "gaussian_random",
+        {"shape": [2000], "mean": 1.0, "std": 2.0, "dtype": np.dtype("float32")},
+    )
+    assert abs(g.mean() - 1.0) < 0.2 and abs(g.std() - 2.0) < 0.3
+
+    (t,) = run_op(
+        "truncated_gaussian_random",
+        {"shape": [2000], "mean": 0.0, "std": 1.0, "dtype": np.dtype("float32")},
+    )
+    assert np.abs(t).max() <= 2.01 and abs(t.mean()) < 0.15
+
+    rng = R(617)
+    p = f32(rng.rand(200))
+    gr = f32(rng.rand(200) * 0.1)
+    (po,) = run_op(
+        "dpsgd",
+        {"clip": 1e6, "sigma": 0.0, "batch_size": 1.0},
+        inputs={"Param": p, "Grad": gr, "LearningRate": f32([0.1])},
+        outputs=("ParamOut",),
+    )
+    np.testing.assert_allclose(po, p - 0.1 * gr, rtol=1e-5, atol=1e-5)
