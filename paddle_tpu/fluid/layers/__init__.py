@@ -1,6 +1,7 @@
 """fluid.layers namespace. Parity: python/paddle/fluid/layers/__init__.py."""
-from . import control_flow, nn, ops, tensor  # noqa: F401
+from . import control_flow, nn, ops, sequence, tensor  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401

@@ -1,0 +1,338 @@
+"""Sequence & RNN layers (`fluid.layers.sequence_* / dynamic_lstm / ...`).
+
+Parity surface: reference python/paddle/fluid/layers/sequence_lod.py +
+nn.py (dynamic_lstm:466, dynamic_gru:855, sequence_conv, sequence_pool,
+sequence_softmax, sequence_expand, linear_chain_crf, crf_decoding, warpctc,
+edit_distance, beam_search).
+
+Padded+mask convention (ops/sequence_ops.py): sequences are dense
+[B, T, ...] tensors; pass `length` ([B] int32 variable) wherever the
+reference relied on LoD to mark ragged rows.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_first_step",
+    "sequence_last_step", "sequence_softmax", "sequence_reverse",
+    "sequence_expand", "sequence_expand_as", "sequence_conv",
+    "sequence_pad", "sequence_unpad", "dynamic_lstm", "dynamic_gru",
+    "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
+    "beam_search",
+]
+
+
+def _seq_inputs(x, length):
+    ins = {"X": [x]}
+    if length is not None:
+        ins["Length"] = [length]
+    return ins
+
+
+def sequence_mask(x, maxlen, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"maxlen": int(maxlen), "out_dtype": np.dtype(dtype)},
+    )
+    return out
+
+
+def sequence_pool(input, pool_type, length=None, name=None):
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    outs = {"Out": [out]}
+    if pool_type.upper() == "MAX":
+        idx = helper.create_variable_for_type_inference("int32")
+        outs["MaxIndex"] = [idx]
+    helper.append_op(
+        type="sequence_pool", inputs=_seq_inputs(input, length),
+        outputs=outs, attrs={"pooltype": pool_type.upper()},
+    )
+    return out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "FIRST", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "LAST", length)
+
+
+def sequence_softmax(input, length=None, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_softmax", inputs=_seq_inputs(input, length),
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_reverse", inputs=_seq_inputs(x, length),
+        outputs={"Y": [out]},
+    )
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]}, attrs={"ref_level": ref_level},
+    )
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand_as", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_conv(
+    input, num_filters, filter_size=3, filter_stride=1, padding=True,
+    padding_start=None, length=None, param_attr=None, bias_attr=None,
+    act=None, name=None,
+):
+    if filter_stride != 1:
+        raise NotImplementedError(
+            "sequence_conv: filter_stride must be 1 (the reference enforces "
+            "the same)"
+        )
+    helper = LayerHelper(
+        "sequence_conv", param_attr=param_attr, bias_attr=bias_attr,
+        act=act, name=name,
+    )
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        helper.param_attr, shape=[filter_size * d, num_filters], dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    if padding_start is None:
+        padding_start = -(filter_size - 1) // 2
+    ins = _seq_inputs(input, length)
+    ins["Filter"] = [w]
+    helper.append_op(
+        type="sequence_conv", inputs=ins, outputs={"Out": [out]},
+        attrs={"contextLength": filter_size, "contextStart": padding_start,
+               "contextStride": filter_stride},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, length=None, name=None):
+    if pad_value is not None:
+        raise NotImplementedError(
+            "sequence_pad: inputs are already dense/padded in this framework; "
+            "a custom pad_value is not representable (pads stay as provided)"
+        )
+    if maxlen is not None and maxlen != x.shape[1]:
+        raise NotImplementedError(
+            f"sequence_pad: maxlen={maxlen} != static time width {x.shape[1]}"
+        )
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    lvar = helper.create_variable_for_type_inference("int32")
+    ins = _seq_inputs(x, length)
+    helper.append_op(
+        type="sequence_pad", inputs=ins,
+        outputs={"Out": [out], "Length": [lvar]}, attrs={},
+    )
+    return out, lvar
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_unpad", inputs=_seq_inputs(x, length),
+        outputs={"Out": [out]}, attrs={},
+    )
+    return out
+
+
+def dynamic_lstm(
+    input, size, h_0=None, c_0=None, length=None, param_attr=None,
+    bias_attr=None, use_peepholes=False, is_reverse=False,
+    gate_activation="sigmoid", cell_activation="tanh",
+    candidate_activation="tanh", dtype="float32", name=None,
+):
+    """reference layers/nn.py dynamic_lstm:466 — input is the pre-projected
+    [B, T, 4*H] tensor (apply fc(size*4) first, as in the reference)."""
+    if use_peepholes:
+        raise NotImplementedError(
+            "peephole connections are not supported (reference default path)"
+        )
+    helper = LayerHelper(
+        "dynamic_lstm", param_attr=param_attr, bias_attr=bias_attr, name=name
+    )
+    h = size // 4
+    w = helper.create_parameter(helper.param_attr, shape=[h, 4 * h], dtype=dtype)
+    bias = helper.create_parameter(
+        helper.bias_attr, shape=[1, 4 * h], dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w]}
+    if bias is not None:  # bias_attr=False disables the bias
+        ins["Bias"] = [bias]
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="lstm", inputs=ins,
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden, cell
+
+
+def dynamic_gru(
+    input, size, h_0=None, length=None, param_attr=None, bias_attr=None,
+    is_reverse=False, gate_activation="sigmoid", candidate_activation="tanh",
+    origin_mode=False, dtype="float32", name=None,
+):
+    """reference layers/nn.py dynamic_gru:855 — input is the pre-projected
+    [B, T, 3*H] tensor."""
+    helper = LayerHelper(
+        "dynamic_gru", param_attr=param_attr, bias_attr=bias_attr, name=name
+    )
+    h = size
+    w = helper.create_parameter(helper.param_attr, shape=[h, 3 * h], dtype=dtype)
+    bias = helper.create_parameter(
+        helper.bias_attr, shape=[1, 3 * h], dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w]}
+    if bias is not None:  # bias_attr=False disables the bias
+        ins["Bias"] = [bias]
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="gru", inputs=ins, outputs={"Hidden": [hidden]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+            "origin_mode": origin_mode,
+        },
+    )
+    return hidden
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """reference layers/nn.py linear_chain_crf — returns the per-sequence
+    negative log-likelihood [B,1] (minimize its mean)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    trans = helper.create_parameter(
+        helper.param_attr, shape=[d + 2, d], dtype=input.dtype
+    )
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [trans], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=ins,
+        outputs={"LogLikelihood": [ll]}, attrs={},
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr, length=None, name=None):
+    from .. import framework
+
+    helper = LayerHelper("crf_decoding", name=name)
+    pname = param_attr if isinstance(param_attr, str) else param_attr.name
+    transition = framework.default_main_program().global_block()._find_var_recursive(pname)
+    if transition is None:
+        raise ValueError(f"crf_decoding: transition parameter {pname!r} not found")
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="crf_decoding", inputs=ins, outputs={"ViterbiPath": [path]},
+        attrs={},
+    )
+    return path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None, name=None):
+    helper = LayerHelper("warpctc", name=name)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op(
+        type="warpctc", inputs=ins, outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int64")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op(
+        type="edit_distance", inputs=ins,
+        outputs={"Out": [out], "SequenceNum": [num]},
+        attrs={"normalized": normalized},
+    )
+    return out, num
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None):
+    """One step of beam search over a flattened [B*W] beam batch
+    (reference layers/nn.py beam_search / beam_search_op.cc). Returns
+    (selected_ids [B*W,1], selected_scores [B*W,1], parent_idx [B*W])."""
+    helper = LayerHelper("beam_search", name=name)
+    ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    sc = helper.create_variable_for_type_inference("float32")
+    parent = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores], "scores": [scores]},
+        outputs={
+            "selected_ids": [ids], "selected_scores": [sc], "parent_idx": [parent],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return ids, sc, parent

@@ -17,5 +17,6 @@ from . import (  # noqa: F401
     optimizer_ops,
     recompute,
     reduce_ops,
+    sequence_ops,
 )
 from .registry import EmitContext, OpSpec, get, register, registered_ops  # noqa: F401

@@ -1,0 +1,594 @@
+"""Sequence & RNN ops on the padded+mask representation.
+
+Parity surface: reference operators/sequence_ops/ (~6.1k LoC:
+sequence_pool_op.cc, sequence_conv_op.cc, sequence_softmax_op.cc,
+sequence_reverse_op.h, sequence_expand_as_op.cc, sequence_pad_op.cc,
+sequence_mask_op.cc), lstm_op.cc + math/detail/lstm_kernel.h,
+gru_op.cc + math/detail/gru_kernel.h, edit_distance_op.cc.
+
+TPU-native representation (SURVEY.md §7 "hard parts" — LoD): the
+reference stores ragged sequences as LoD tensors ([sum(len), D] plus
+offsets) and every sequence_* kernel walks the offsets. XLA wants static
+shapes, so here a batch of sequences is a dense [B, T, ...] tensor plus
+an optional per-row `Length` [B] int32; padding lives at the tail of the
+time axis and is masked out inside each op. Recurrences (lstm/gru) are
+`lax.scan` over the time axis — one compiled step body, O(1)-in-T
+compile time, and the scan carries are exactly the reference's per-step
+state (SSA-ified, no per-step Scopes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+NEG_INF = -1e30
+
+
+def _length_mask(x, ins, time_axis=1):
+    """[B, T] float mask from the optional Length input (None = all valid)."""
+    if not ins.get("Length"):
+        return None
+    length = ins["Length"][0]
+    t = x.shape[time_axis]
+    return (jnp.arange(t)[None, :] < length[:, None]).astype(jnp.float32)
+
+
+def _bcast(mask, x):
+    """[B,T] mask broadcast to x's rank ([B,T,...])."""
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+@register("sequence_mask", stop_gradient=True, no_vjp_grad=True)
+def sequence_mask(ctx, ins, attrs):
+    """X = lengths [B] -> [B, maxlen] (reference sequence_mask_op.cc)."""
+    length = ins["X"][0]
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_mask needs a static maxlen attr on TPU")
+    from ..fluid.dtypes import convert_dtype
+
+    dt = convert_dtype(attrs.get("out_dtype", "int64"))
+    out = (jnp.arange(maxlen)[None, :] < length[..., None]).astype(dt)
+    return {"Y": [out]}
+
+
+@register("sequence_pool")
+def sequence_pool(ctx, ins, attrs):
+    """X [B,T,...] (+Length) -> Out [B,...]; pooltype AVERAGE/SUM/SQRT/
+    MAX/LAST/FIRST (reference sequence_pool_op.cc)."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = _length_mask(x, ins)
+    t = x.shape[1]
+    if mask is None:
+        n = jnp.full((x.shape[0],) + (1,) * (x.ndim - 2), float(t), jnp.float32)
+        last_idx = jnp.full((x.shape[0],), t - 1, jnp.int32)
+        xm = x
+    else:
+        n = jnp.maximum(jnp.sum(mask, axis=1), 1.0).reshape(
+            (x.shape[0],) + (1,) * (x.ndim - 2)
+        )
+        last_idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        xm = x * _bcast(mask, x).astype(x.dtype)
+    nonempty = None
+    if mask is not None:
+        nonempty = (jnp.sum(mask, axis=1) > 0).reshape(
+            (x.shape[0],) + (1,) * (x.ndim - 2)
+        )
+    if ptype == "SUM":
+        out = jnp.sum(xm, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(xm, axis=1) / n.astype(x.dtype)
+    elif ptype == "SQRT":
+        out = jnp.sum(xm, axis=1) / jnp.sqrt(n).astype(x.dtype)
+    elif ptype == "MAX":
+        xmax = x if mask is None else jnp.where(
+            _bcast(mask, x) > 0, x, jnp.asarray(NEG_INF, x.dtype)
+        )
+        idx = jnp.argmax(xmax, axis=1).astype(jnp.int32)
+        out = jnp.max(xmax, axis=1)
+        if nonempty is not None:
+            # zero-length rows: 0, like the other pooltypes (not NEG_INF)
+            out = jnp.where(nonempty, out, 0.0).astype(x.dtype)
+        return {"Out": [out], "MaxIndex": [idx]}
+    elif ptype == "LAST":
+        out = jnp.take_along_axis(
+            x, last_idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype!r}")
+    return {"Out": [out]}
+
+
+@register("sequence_softmax")
+def sequence_softmax(ctx, ins, attrs):
+    """Masked softmax over the time axis (reference sequence_softmax_op)."""
+    x = ins["X"][0]
+    mask = _length_mask(x, ins)
+    if mask is None:
+        return {"Out": [jax.nn.softmax(x, axis=1)]}
+    m = _bcast(mask, x)
+    z = jnp.where(m > 0, x, NEG_INF)
+    out = jax.nn.softmax(z, axis=1)
+    return {"Out": [out * m.astype(out.dtype)]}
+
+
+@register("sequence_reverse")
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse the valid prefix of each row; padding stays at the tail
+    (reference sequence_reverse_op.h)."""
+    x = ins["X"][0]
+    t = x.shape[1]
+    if not ins.get("Length"):
+        return {"Y": [jnp.flip(x, axis=1)]}
+    length = ins["Length"][0]
+    pos = jnp.arange(t)[None, :]
+    rev = length[:, None] - 1 - pos  # index of the element that lands at pos
+    idx = jnp.where(pos < length[:, None], rev, pos).astype(jnp.int32)
+    out = jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return {"Y": [out]}
+
+
+@register("sequence_expand_as")
+def sequence_expand_as(ctx, ins, attrs):
+    """X [B, ...] (one row per sequence) broadcast over Y's time axis ->
+    [B, T, ...] (padded analog of reference sequence_expand_as_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    t = y.shape[1]
+    out = jnp.broadcast_to(
+        jnp.expand_dims(x, 1), (x.shape[0], t) + tuple(x.shape[1:])
+    )
+    return {"Out": [out]}
+
+
+@register("sequence_expand")
+def sequence_expand(ctx, ins, attrs):
+    """Same dense semantics as sequence_expand_as: with padded batches the
+    LoD ref-level distinction vanishes (every row expands to T steps)."""
+    return {"Out": [sequence_expand_as(ctx, ins, attrs)["Out"][0]]}
+
+
+@register("sequence_conv")
+def sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (reference sequence_conv_op.cc):
+    window of context_length rows starting at t+context_start is flattened
+    to [ctx*D] and matmul'd with Filter [ctx*D, num_filters]."""
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]
+    cl = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    cs = int(attrs.get("contextStart", attrs.get("context_start", -(cl - 1) // 2)))
+    b, t, d = x.shape
+    mask = _length_mask(x, ins)
+    if mask is not None:
+        x = x * _bcast(mask, x).astype(x.dtype)
+    pad_lo = max(-cs, 0)
+    pad_hi = max(cs + cl - 1, 0)
+    xp = jnp.pad(x, [(0, 0), (pad_lo, pad_hi), (0, 0)])
+    cols = [xp[:, pad_lo + cs + j: pad_lo + cs + j + t, :] for j in range(cl)]
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [B, T, cl*D]
+    out = jnp.einsum("btc,cf->btf", ctx_mat, w)
+    if mask is not None:
+        out = out * _bcast(mask, out).astype(out.dtype)
+    return {"Out": [out]}
+
+
+@register("sequence_pad")
+def sequence_pad(ctx, ins, attrs):
+    """Dense-representation identity + length passthrough: inputs are
+    already padded [B,T,...]; emits Length so downstream sequence ops can
+    mask (the LoD->padded conversion of reference sequence_pad_op.cc is
+    a no-op here)."""
+    x = ins["X"][0]
+    if ins.get("Length"):
+        length = ins["Length"][0]
+    else:
+        length = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return {"Out": [x], "Length": [length]}
+
+
+@register("sequence_unpad")
+def sequence_unpad(ctx, ins, attrs):
+    """Inverse of sequence_pad: zero out the padding tail (a true unpad
+    would be ragged; masking is the static-shape equivalent)."""
+    x = ins["X"][0]
+    mask = _length_mask(x, ins)
+    if mask is None:
+        return {"Out": [x]}
+    return {"Out": [x * _bcast(mask, x).astype(x.dtype)]}
+
+
+@register("edit_distance", stop_gradient=True, no_vjp_grad=True)
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between padded int rows (reference
+    edit_distance_op.cc). Hyps [B,Th]+HypsLength, Refs [B,Tr]+RefsLength.
+    DP over a lax.scan per hypothesis position."""
+    hyp, ref = ins["Hyps"][0], ins["Refs"][0]
+    b, th = hyp.shape
+    tr = ref.shape[1]
+    hlen = ins["HypsLength"][0] if ins.get("HypsLength") else jnp.full((b,), th, jnp.int32)
+    rlen = ins["RefsLength"][0] if ins.get("RefsLength") else jnp.full((b,), tr, jnp.int32)
+
+    # row[j] = edit distance between hyp[:i] and ref[:j]
+    init = jnp.broadcast_to(jnp.arange(tr + 1, dtype=jnp.float32), (b, tr + 1))
+
+    def body(i, row):
+        hy = jax.lax.dynamic_index_in_dim(hyp, i, axis=1, keepdims=False)  # [B]
+        sub_cost = (ref != hy[:, None]).astype(jnp.float32)  # [B, Tr]
+        # new_row[0] = i+1; new_row[j] = min(row[j]+1, new_row[j-1]+1,
+        #                                    row[j-1]+sub(j-1))
+        del_cost = row[:, 1:] + 1.0
+        sub = row[:, :-1] + sub_cost
+        # prefix-min recurrence for insertions via associative scan:
+        # new_row[j] = min over k<=j of (cand[k] + (j-k)) where cand is
+        # min(del, sub) prefixed with i+1
+        first = jnp.full((b, 1), i + 1.0, jnp.float32)
+        cand = jnp.concatenate([first, jnp.minimum(del_cost, sub)], axis=1)
+        j = jnp.arange(tr + 1, dtype=jnp.float32)[None, :]
+        shifted = cand - j
+        run_min = jax.lax.associative_scan(jnp.minimum, shifted, axis=1)
+        new_row = run_min + j
+        valid = (i < hlen).reshape(b, 1)
+        return jnp.where(valid, new_row, row)
+
+    row = jax.lax.fori_loop(0, th, body, init)
+    dist = jnp.take_along_axis(row, rlen[:, None], axis=1)[:, 0]
+    if attrs.get("normalized", False):
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    seq_num = jnp.asarray([b], jnp.int64)
+    return {"Out": [dist.reshape(b, 1)], "SequenceNum": [seq_num]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent cells: lstm / gru (reference lstm_op.cc, gru_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _act_by_name(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+    }[name]
+
+
+@register("lstm")
+def lstm(ctx, ins, attrs):
+    """Full-sequence LSTM. Input [B,T,4H] is the pre-projected x (the fc
+    lives outside, as in reference dynamic_lstm); Weight [H,4H] recurrent,
+    Bias [1,4H]. Gate layout matches math/detail/lstm_kernel.h:
+    [candidate c, input i, forget f, output o]; no peepholes.
+    Outputs Hidden/Cell [B,T,H]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    b, t, h4 = x.shape
+    h = h4 // 4
+    act_gate = _act_by_name(attrs.get("gate_activation", "sigmoid"))
+    act_cand = _act_by_name(attrs.get("candidate_activation", "tanh"))
+    act_cell = _act_by_name(attrs.get("cell_activation", "tanh"))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    mask = _length_mask(x, ins)
+
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, h), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, h), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, 4H]
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else None
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+        ms = jnp.flip(ms, 0) if ms is not None else None
+
+    def step(carry, inp):
+        hp, cp = carry
+        if ms is None:
+            xt, mt = inp, None
+        else:
+            xt, mt = inp
+        g = xt + hp @ w
+        if bias is not None:
+            g = g + bias
+        c_t, i_t, f_t, o_t = jnp.split(g, 4, axis=-1)
+        cand = act_cand(c_t)
+        i = act_gate(i_t)
+        f = act_gate(f_t)
+        c = cand * i + cp * f
+        o = act_gate(o_t)
+        hh = o * act_cell(c)
+        if mt is not None:
+            keep = mt[:, None].astype(hh.dtype)
+            hh = hh * keep + hp * (1 - keep)
+            c = c * keep + cp * (1 - keep)
+        return (hh, c), (hh, c)
+
+    inputs = xs if ms is None else (xs, ms)
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), inputs)
+    if is_reverse:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+    }
+
+
+@register("gru")
+def gru(ctx, ins, attrs):
+    """Full-sequence GRU. Input [B,T,3H] pre-projected; Weight [H,3H]
+    ([:, :2H] = update+reset recurrent, [:, 2H:] = candidate); Bias [1,3H].
+    Semantics of math/detail/gru_kernel.h (origin_mode=False):
+      u = σ(x_u + h W_u); r = σ(x_r + h W_r)
+      c̃ = tanh(x_c + (r ⊙ h) W_c);  h' = (1-u) ⊙ h + u ⊙ c̃
+    origin_mode=True flips the blend: h' = u ⊙ h + (1-u) ⊙ c̃."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    b, t, h3 = x.shape
+    h = h3 // 3
+    act_gate = _act_by_name(attrs.get("gate_activation", "sigmoid"))
+    act_cand = _act_by_name(attrs.get("activation", "tanh"))
+    origin = bool(attrs.get("origin_mode", False))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    mask = _length_mask(x, ins)
+
+    w_ur = w[:, : 2 * h]
+    w_c = w[:, 2 * h:]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, h), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else None
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+        ms = jnp.flip(ms, 0) if ms is not None else None
+
+    def step(hp, inp):
+        if ms is None:
+            xt, mt = inp, None
+        else:
+            xt, mt = inp
+        g_ur = xt[:, : 2 * h] + hp @ w_ur
+        g_c = xt[:, 2 * h:]
+        if bias is not None:
+            g_ur = g_ur + bias[: 2 * h]
+            g_c = g_c + bias[2 * h:]
+        u = act_gate(g_ur[:, :h])
+        r = act_gate(g_ur[:, h:])
+        cand = act_cand(g_c + (r * hp) @ w_c)
+        hh = u * hp + (1 - u) * cand if origin else (1 - u) * hp + u * cand
+        if mt is not None:
+            keep = mt[:, None].astype(hh.dtype)
+            hh = hh * keep + hp * (1 - keep)
+        return hh, hh
+
+    inputs = xs if ms is None else (xs, ms)
+    _, hs = jax.lax.scan(step, h0, inputs)
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# structured prediction: linear-chain CRF + viterbi (reference
+# linear_chain_crf_op.cc, crf_decoding_op.cc) and CTC (warpctc_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _crf_unpack(transition):
+    """Paddle layout: Transition [D+2, D]; row 0 = start weights, row 1 =
+    stop weights, rows 2.. = square transition matrix."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register("linear_chain_crf", no_vjp_grad=False)
+def linear_chain_crf(ctx, ins, attrs):
+    """Negative log-likelihood of a linear-chain CRF on padded inputs.
+    Emission [B,T,D] (+Length), Transition [D+2,D], Label [B,T] int.
+    LogLikelihood [B,1] (the op returns -nll like the reference: its
+    output is the log-likelihood maximized by *minimizing* the mean of
+    the negated value; we return nll so book models can minimize mean)."""
+    em = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    label = ins["Label"][0]
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    b, t, d = em.shape
+    start_w, stop_w, tr = _crf_unpack(trans)
+    mask = _length_mask(em, ins)
+    if mask is None:
+        mask = jnp.ones((b, t), jnp.float32)
+    length = jnp.sum(mask, axis=1).astype(jnp.int32)
+
+    emf = em.astype(jnp.float32)
+    # --- log partition via forward algorithm (scan over time)
+    alpha0 = start_w[None, :] + emf[:, 0, :]
+
+    def fwd(alpha, inp):
+        e_t, m_t = inp  # [B,D], [B]
+        scores = alpha[:, :, None] + tr[None, :, :]  # [B, D_from, D_to]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + e_t
+        keep = m_t[:, None]
+        return jnp.where(keep > 0, new, alpha), None
+
+    es = jnp.swapaxes(emf, 0, 1)[1:]
+    msk = jnp.swapaxes(mask, 0, 1)[1:]
+    alpha, _ = jax.lax.scan(fwd, alpha0, (es, msk))
+    last_lbl_idx = jnp.maximum(length - 1, 0)
+    logz = jax.scipy.special.logsumexp(alpha + stop_w[None, :], axis=1)
+
+    # --- gold path score
+    lbl = label.astype(jnp.int32)
+    em_score = jnp.sum(
+        jnp.take_along_axis(emf, lbl[..., None], axis=2)[..., 0] * mask, axis=1
+    )
+    frm = lbl[:, :-1]
+    to = lbl[:, 1:]
+    tr_score = jnp.sum(tr[frm, to] * mask[:, 1:], axis=1)
+    start_score = start_w[lbl[:, 0]]
+    last_lbl = jnp.take_along_axis(lbl, last_lbl_idx[:, None], axis=1)[:, 0]
+    stop_score = stop_w[last_lbl]
+    gold = em_score + tr_score + start_score + stop_score
+    nll = (logz - gold)[:, None]
+    return {"LogLikelihood": [nll]}
+
+
+@register("crf_decoding", stop_gradient=True, no_vjp_grad=True)
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (reference crf_decoding_op.cc). Emission [B,T,D]
+    (+Length), Transition [D+2,D] -> ViterbiPath [B,T] int64 (padding
+    positions 0). If Label is given, outputs correctness mask instead
+    semantics kept simple: always the path."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    trans = ins["Transition"][0].astype(jnp.float32)
+    b, t, d = em.shape
+    start_w, stop_w, tr = _crf_unpack(trans)
+    mask = _length_mask(em, ins)
+    if mask is None:
+        mask = jnp.ones((b, t), jnp.float32)
+    length = jnp.sum(mask, axis=1).astype(jnp.int32)
+
+    def fwd(carry, inp):
+        score = carry
+        e_t, m_t = inp
+        cand = score[:, :, None] + tr[None, :, :]  # [B, from, to]
+        best_prev = jnp.argmax(cand, axis=1).astype(jnp.int32)  # [B, to]
+        new = jnp.max(cand, axis=1) + e_t
+        keep = m_t[:, None]
+        new = jnp.where(keep > 0, new, score)
+        best_prev = jnp.where(
+            keep.astype(jnp.int32) > 0, best_prev,
+            jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[None, :], (b, d)),
+        )
+        return new, best_prev
+
+    es = jnp.swapaxes(em, 0, 1)[1:]
+    msk = jnp.swapaxes(mask, 0, 1)[1:]
+    score0 = start_w[None, :] + em[:, 0, :]
+    final, back = jax.lax.scan(fwd, score0, (es, msk))
+    final = final + stop_w[None, :]
+    last = jnp.argmax(final, axis=1).astype(jnp.int32)  # [B]
+
+    def bwd(lbl, bp):
+        prev = jnp.take_along_axis(bp, lbl[:, None], axis=1)[:, 0]
+        return prev, lbl
+
+    # reverse scan emits the label at times 1..T-1 (in forward order) and
+    # carries the time-0 label out
+    first, path_rev = jax.lax.scan(bwd, last, back, reverse=True)
+    path = jnp.concatenate([first[None, :], path_rev], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1)
+    path = (path * mask.astype(path.dtype)).astype(jnp.int64)
+    return {"ViterbiPath": [path]}
+
+
+@register("warpctc")
+def warpctc(ctx, ins, attrs):
+    """CTC loss on padded inputs (reference warpctc_op.cc — here a pure
+    lax.scan log-alpha recursion instead of the warp-ctc CUDA library).
+    Logits [B,T,C] (+LogitsLength), Label [B,L] (+LabelLength);
+    blank = attrs['blank']. Loss [B,1]."""
+    logits = ins["Logits"][0].astype(jnp.float32)
+    label = ins["Label"][0].astype(jnp.int32)
+    b, t, c = logits.shape
+    l = label.shape[1]
+    blank = int(attrs.get("blank", 0))
+    tlen = (
+        ins["LogitsLength"][0].astype(jnp.int32)
+        if ins.get("LogitsLength")
+        else jnp.full((b,), t, jnp.int32)
+    )
+    llen = (
+        ins["LabelLength"][0].astype(jnp.int32)
+        if ins.get("LabelLength")
+        else jnp.full((b,), l, jnp.int32)
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    # extended label sequence: blank, l1, blank, l2, ..., blank  (2L+1)
+    s = 2 * l + 1
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.full((b, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    def lp_at(t_idx):
+        lp_t = jax.lax.dynamic_index_in_dim(logp, t_idx, axis=1, keepdims=False)
+        return jnp.take_along_axis(lp_t, ext, axis=1)  # [B, S]
+
+    neg = jnp.float32(NEG_INF)
+    alpha0 = jnp.full((b, s), neg)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(llen > 0, jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0], neg)
+    )
+
+    def step(alpha, t_idx):
+        a_shift1 = jnp.concatenate([jnp.full((b, 1), neg), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((b, 2), neg), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(can_skip, a_shift2, neg)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a2)
+        new = merged + lp_at(t_idx)
+        valid = (t_idx < tlen)[:, None]
+        return jnp.where(valid, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t))
+    # final: sum of positions 2*llen (last blank) and 2*llen-1 (last label)
+    idx_last = jnp.clip(2 * llen, 0, s - 1)[:, None]
+    idx_prev = jnp.clip(2 * llen - 1, 0, s - 1)[:, None]
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0],
+        jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0],
+    )
+    loss = -ll
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(tlen.astype(jnp.float32), 1.0)
+    return {"Loss": [loss[:, None]]}
+
+
+# ---------------------------------------------------------------------------
+# beam search step (reference beam_search_op.cc): used inside a decode loop
+# ---------------------------------------------------------------------------
+
+
+@register("beam_search", stop_gradient=True, no_vjp_grad=True)
+def beam_search(ctx, ins, attrs):
+    """One beam-search step on a flattened beam batch.
+
+    pre_ids [B*W, 1] int, pre_scores [B*W, 1] f32, scores [B*W, V]
+    (log-probabilities of the next token per live hypothesis).
+    attrs: beam_size W, end_id.
+    Outputs: selected_ids [B*W, 1], selected_scores [B*W, 1],
+    parent_idx [B*W] int32 (index into the flattened beam the selection
+    came from — the caller uses it to gather carried decoder state).
+    Finished hypotheses (pre_id == end_id) propagate with frozen score.
+    """
+    pre_ids = ins["pre_ids"][0].reshape(-1)
+    pre_scores = ins["pre_scores"][0].reshape(-1).astype(jnp.float32)
+    scores = ins["scores"][0].astype(jnp.float32)
+    w = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    bw, v = scores.shape
+    b = bw // w
+
+    finished = pre_ids == end_id
+    # frozen: only the end_id continuation keeps the old score
+    cont = pre_scores[:, None] + scores
+    frozen = jnp.full((bw, v), NEG_INF, jnp.float32)
+    frozen = frozen.at[:, end_id].set(pre_scores)
+    total = jnp.where(finished[:, None], frozen, cont)  # [B*W, V]
+
+    total_b = total.reshape(b, w * v)
+    top_scores, top_idx = jax.lax.top_k(total_b, w)  # [B, W]
+    parent_in_beam = (top_idx // v).astype(jnp.int32)  # [B, W]
+    token = (top_idx % v).astype(pre_ids.dtype)
+    parent_flat = (
+        parent_in_beam + (jnp.arange(b, dtype=jnp.int32) * w)[:, None]
+    ).reshape(-1)
+    return {
+        "selected_ids": [token.reshape(bw, 1)],
+        "selected_scores": [top_scores.reshape(bw, 1)],
+        "parent_idx": [parent_flat],
+    }

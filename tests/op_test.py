@@ -143,7 +143,6 @@ class OpTest:
             fetch = [n for slot in expect for n in out_names[slot]]
             got = exe.run(main, feed=feed, fetch_list=fetch)
             got_iter = iter(got)
-            shapes = {}
             for slot, exps in expect.items():
                 exps = _as_list(exps)
                 for i, e in enumerate(exps):
@@ -161,7 +160,6 @@ class OpTest:
                         np.testing.assert_array_equal(
                             g, e, err_msg=f"{self.op_type}.{slot}[{i}]"
                         )
-            # full shapes for the loss builder (including non-compared slots)
         return expect
 
     def _out_shapes(self):

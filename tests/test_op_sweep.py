@@ -1851,6 +1851,410 @@ def _ftrl():
     )
 
 
+# ---- sequence / RNN ops ----------------------------------------------------
+
+
+def _lens(*vals):
+    return np.asarray(vals, np.int32)
+
+
+@case("sequence_mask")
+def _sequence_mask():
+    return OpTest(
+        "sequence_mask", {"X": _lens(2, 4, 0)},
+        lambda ins, a: {"Y": [(np.arange(5)[None, :] < ins["X"][0][:, None]).astype(np.int64)]},
+        attrs={"maxlen": 5, "out_dtype": np.dtype("int64")}, outputs={"Y": 1},
+    )
+
+
+def _seq_x(rng=None):
+    rng = rng or R(619)
+    return _mix(rng, 3, 4, 2), _lens(2, 4, 1)
+
+
+@case("sequence_pool")
+def _sequence_pool_avg():
+    x, ln = _seq_x()
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0], ins["Length"][0]
+        out = np.stack([xx[i, :ll[i]].mean(0) if ll[i] else xx[i, :1].sum(0) * 0
+                        for i in range(3)])
+        return {"Out": [f32(out)]}
+
+    return OpTest(
+        "sequence_pool", {"X": x, "Length": ln}, oracle,
+        attrs={"pooltype": "AVERAGE"}, grad=("X",),
+    )
+
+
+@case("sequence_pool")
+def _sequence_pool_max():
+    x, ln = _seq_x(R(621))
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0], ins["Length"][0]
+        out = np.stack([xx[i, :max(ll[i], 1)].max(0) for i in range(3)])
+        return {"Out": [f32(out)]}
+
+    return OpTest(
+        "sequence_pool", {"X": x, "Length": ln}, oracle,
+        attrs={"pooltype": "MAX"}, outputs={"Out": 1, "MaxIndex": 1}, grad=("X",),
+    )
+
+
+@case("sequence_pool")
+def _sequence_pool_last():
+    x, ln = _seq_x(R(623))
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0], ins["Length"][0]
+        out = np.stack([xx[i, max(ll[i] - 1, 0)] for i in range(3)])
+        return {"Out": [f32(out)]}
+
+    return OpTest(
+        "sequence_pool", {"X": x, "Length": ln}, oracle,
+        attrs={"pooltype": "LAST"}, grad=("X",),
+    )
+
+
+@case("sequence_softmax")
+def _sequence_softmax():
+    rng = R(627)
+    x = _mix(rng, 2, 4)
+    ln = _lens(3, 4)
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0], ins["Length"][0]
+        out = np.zeros_like(xx)
+        for i in range(2):
+            out[i, :ll[i]] = _softmax(xx[i, :ll[i]])
+        return {"Out": [f32(out)]}
+
+    return OpTest(
+        "sequence_softmax", {"X": x, "Length": ln}, oracle, grad=("X",),
+    )
+
+
+@case("sequence_reverse")
+def _sequence_reverse():
+    x, ln = _seq_x(R(631))
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0].copy(), ins["Length"][0]
+        out = xx.copy()
+        for i in range(3):
+            out[i, :ll[i]] = xx[i, :ll[i]][::-1]
+        return {"Y": [out]}
+
+    return OpTest(
+        "sequence_reverse", {"X": x, "Length": ln}, oracle,
+        outputs={"Y": 1}, grad=("X",),
+    )
+
+
+@case("sequence_expand")
+def _sequence_expand():
+    rng = R(641)
+    x, y = _mix(rng, 3, 2), _mix(rng, 3, 4, 5)
+    return OpTest(
+        "sequence_expand", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [np.broadcast_to(ins["X"][0][:, None, :], (3, 4, 2)).copy()]},
+        grad=("X",),
+    )
+
+
+@case("sequence_expand_as")
+def _sequence_expand_as():
+    rng = R(643)
+    x, y = _mix(rng, 3, 2), _mix(rng, 3, 5, 1)
+    return OpTest(
+        "sequence_expand_as", {"X": x, "Y": y},
+        lambda ins, a: {"Out": [np.broadcast_to(ins["X"][0][:, None, :], (3, 5, 2)).copy()]},
+        grad=("X",),
+    )
+
+
+@case("sequence_conv")
+def _sequence_conv():
+    rng = R(647)
+    x = _mix(rng, 2, 5, 3)
+    w = _mix(rng, 9, 4) * 0.3
+
+    def oracle(ins, a):
+        xx, ww = ins["X"][0], ins["Filter"][0]
+        xp = np.pad(xx, [(0, 0), (1, 1), (0, 0)])
+        ctx = np.concatenate([xp[:, j:j + 5] for j in range(3)], axis=-1)
+        return {"Out": [f32(np.einsum("btc,cf->btf", ctx, ww))]}
+
+    return OpTest(
+        "sequence_conv", {"X": x, "Filter": w}, oracle,
+        attrs={"contextLength": 3, "contextStart": -1},
+        grad=("X", "Filter"), tol=1e-4,
+    )
+
+
+@case("sequence_pad")
+def _sequence_pad():
+    x, ln = _seq_x(R(653))
+    return OpTest(
+        "sequence_pad", {"X": x, "Length": ln},
+        lambda ins, a: {"Out": [ins["X"][0]], "Length": [ins["Length"][0]]},
+        outputs={"Out": 1, "Length": 1},
+    )
+
+
+@case("sequence_unpad")
+def _sequence_unpad():
+    x, ln = _seq_x(R(659))
+
+    def oracle(ins, a):
+        xx, ll = ins["X"][0].copy(), ins["Length"][0]
+        for i in range(3):
+            xx[i, ll[i]:] = 0
+        return {"Out": [xx]}
+
+    return OpTest("sequence_unpad", {"X": x, "Length": ln}, oracle, grad=("X",))
+
+
+@case("edit_distance")
+def _edit_distance():
+    hyp = np.asarray([[1, 2, 3, 0], [4, 4, 4, 4]], np.int64)
+    ref = np.asarray([[1, 3, 3], [4, 5, 6]], np.int64)
+    hlen = _lens(3, 4)
+    rlen = _lens(3, 3)
+
+    # dist(123, 133)=1; dist(4444, 456)=3
+    def oracle(ins, a):
+        return {"Out": [f32([[1.0], [3.0]])]}
+
+    return OpTest(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref, "HypsLength": hlen, "RefsLength": rlen},
+        oracle, attrs={"normalized": False},
+        outputs={"Out": 1, "SequenceNum": 1},
+    )
+
+
+def _np_lstm(x, w, bias, lens):
+    b, t, h4 = x.shape
+    h = h4 // 4
+    sig = lambda z: 1 / (1 + np.exp(-z))
+    hp = np.zeros((b, h), np.float32)
+    cp = np.zeros((b, h), np.float32)
+    hs = np.zeros((b, t, h), np.float32)
+    cs = np.zeros((b, t, h), np.float32)
+    for i in range(t):
+        g = x[:, i] + hp @ w + bias.reshape(-1)
+        c_t, i_t, f_t, o_t = np.split(g, 4, -1)
+        c = np.tanh(c_t) * sig(i_t) + cp * sig(f_t)
+        hh = sig(o_t) * np.tanh(c)
+        keep = (i < lens)[:, None]
+        hh = np.where(keep, hh, hp)
+        c = np.where(keep, c, cp)
+        hs[:, i], cs[:, i] = hh, c
+        hp, cp = hh, c
+    return f32(hs), f32(cs)
+
+
+@case("lstm")
+def _lstm():
+    rng = R(661)
+    b, t, h = 2, 3, 4
+    x = _mix(rng, b, t, 4 * h) * 0.5
+    w = _mix(rng, h, 4 * h) * 0.3
+    bias = _mix(rng, 1, 4 * h) * 0.1
+    lens = _lens(2, 3)
+
+    def oracle(ins, a):
+        hs, cs = _np_lstm(ins["Input"][0], ins["Weight"][0], ins["Bias"][0],
+                          ins["Length"][0])
+        return {"Hidden": [hs], "Cell": [cs]}
+
+    return OpTest(
+        "lstm", {"Input": x, "Weight": w, "Bias": bias, "Length": lens},
+        oracle, outputs={"Hidden": 1, "Cell": 1},
+        grad=("Input", "Weight"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+def _np_gru(x, w, bias, lens, origin=False):
+    b, t, h3 = x.shape
+    h = h3 // 3
+    sig = lambda z: 1 / (1 + np.exp(-z))
+    hp = np.zeros((b, h), np.float32)
+    hs = np.zeros((b, t, h), np.float32)
+    for i in range(t):
+        g_ur = x[:, i, :2 * h] + hp @ w[:, :2 * h] + bias.reshape(-1)[:2 * h]
+        u, r = sig(g_ur[:, :h]), sig(g_ur[:, h:])
+        cand = np.tanh(x[:, i, 2 * h:] + (r * hp) @ w[:, 2 * h:] + bias.reshape(-1)[2 * h:])
+        hh = u * hp + (1 - u) * cand if origin else (1 - u) * hp + u * cand
+        keep = (i < lens)[:, None]
+        hh = np.where(keep, hh, hp)
+        hs[:, i] = hh
+        hp = hh
+    return f32(hs)
+
+
+@case("gru")
+def _gru():
+    rng = R(673)
+    b, t, h = 2, 3, 4
+    x = _mix(rng, b, t, 3 * h) * 0.5
+    w = _mix(rng, h, 3 * h) * 0.3
+    bias = _mix(rng, 1, 3 * h) * 0.1
+    lens = _lens(2, 3)
+
+    def oracle(ins, a):
+        return {"Hidden": [_np_gru(ins["Input"][0], ins["Weight"][0],
+                                   ins["Bias"][0], ins["Length"][0])]}
+
+    return OpTest(
+        "gru", {"Input": x, "Weight": w, "Bias": bias, "Length": lens},
+        oracle, outputs={"Hidden": 1},
+        grad=("Input", "Weight"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("linear_chain_crf")
+def _crf():
+    rng = R(677)
+    b, t, d = 2, 4, 3
+    em = _mix(rng, b, t, d)
+    trans = _mix(rng, d + 2, d) * 0.5
+    label = rng.randint(0, d, (b, t)).astype(np.int64)
+    lens = _lens(3, 4)
+
+    def oracle(ins, a):
+        e, tr_all, lbl, ll = (ins["Emission"][0], ins["Transition"][0],
+                              ins["Label"][0], ins["Length"][0])
+        start, stop, tr = tr_all[0], tr_all[1], tr_all[2:]
+        out = np.zeros((b, 1), np.float32)
+        import itertools
+
+        for i in range(b):
+            n = ll[i]
+            paths = []
+            for path in itertools.product(range(d), repeat=int(n)):
+                s = start[path[0]] + stop[path[-1]]
+                s += sum(e[i, j, path[j]] for j in range(n))
+                s += sum(tr[path[j], path[j + 1]] for j in range(n - 1))
+                paths.append(s)
+            logz = np.log(np.sum(np.exp(np.asarray(paths))))
+            g = start[lbl[i, 0]] + stop[lbl[i, n - 1]]
+            g += sum(e[i, j, lbl[i, j]] for j in range(n))
+            g += sum(tr[lbl[i, j], lbl[i, j + 1]] for j in range(n - 1))
+            out[i, 0] = logz - g
+        return {"LogLikelihood": [out]}
+
+    return OpTest(
+        "linear_chain_crf",
+        {"Emission": em, "Transition": trans, "Label": label, "Length": lens},
+        oracle, outputs={"LogLikelihood": 1},
+        grad=("Emission", "Transition"), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("crf_decoding")
+def _crf_decoding():
+    rng = R(683)
+    b, t, d = 2, 3, 3
+    em = _mix(rng, b, t, d)
+    trans = _mix(rng, d + 2, d) * 0.5
+    lens = _lens(2, 3)
+
+    def oracle(ins, a):
+        e, tr_all, ll = ins["Emission"][0], ins["Transition"][0], ins["Length"][0]
+        start, stop, tr = tr_all[0], tr_all[1], tr_all[2:]
+        import itertools
+
+        out = np.zeros((b, t), np.int64)
+        for i in range(b):
+            n = ll[i]
+            best, best_s = None, -np.inf
+            for path in itertools.product(range(d), repeat=int(n)):
+                s = start[path[0]] + stop[path[-1]]
+                s += sum(e[i, j, path[j]] for j in range(n))
+                s += sum(tr[path[j], path[j + 1]] for j in range(n - 1))
+                if s > best_s:
+                    best, best_s = path, s
+            out[i, :n] = best
+        return {"ViterbiPath": [out]}
+
+    return OpTest(
+        "crf_decoding",
+        {"Emission": em, "Transition": trans, "Length": lens},
+        oracle, outputs={"ViterbiPath": 1},
+    )
+
+
+@case("warpctc")
+def _warpctc():
+    rng = R(691)
+    b, t, c, l = 2, 5, 4, 2
+    logits = _mix(rng, b, t, c)
+    label = rng.randint(1, c, (b, l)).astype(np.int32)
+    tlen = _lens(5, 4)
+    llen = _lens(2, 1)
+
+    def oracle(ins, a):
+        import itertools
+
+        lg, lb = ins["Logits"][0], ins["Label"][0]
+        tl, ll = ins["LogitsLength"][0], ins["LabelLength"][0]
+        lp = np.log(_softmax(lg))
+        out = np.zeros((b, 1), np.float32)
+        for i in range(b):
+            n, m = int(tl[i]), int(ll[i])
+            target = list(lb[i, :m])
+            total = -np.inf
+            # brute force: all alignments of length n that collapse to target
+            for ali in itertools.product(range(c), repeat=n):
+                col = []
+                prev = None
+                for s in ali:
+                    if s != 0 and s != prev:
+                        col.append(s)
+                    prev = s
+                if col == target:
+                    sc = sum(lp[i, j, ali[j]] for j in range(n))
+                    total = np.logaddexp(total, sc)
+            out[i, 0] = -total
+        return {"Loss": [out]}
+
+    return OpTest(
+        "warpctc",
+        {"Logits": logits, "Label": label, "LogitsLength": tlen, "LabelLength": llen},
+        oracle, attrs={"blank": 0}, outputs={"Loss": 1},
+        grad=("Logits",), tol=1e-4, grad_tol=2e-2,
+    )
+
+
+@case("beam_search")
+def _beam_search():
+    # B=1, W=2, V=4: hand-checked one step
+    pre_ids = np.asarray([[1], [2]], np.int64)
+    pre_scores = f32([[-0.5], [-1.0]])
+    scores = f32([[-1.0, -2.0, -0.1, -3.0], [-0.2, -0.4, -5.0, -0.6]])
+
+    def oracle(ins, a):
+        # candidates: beam0: -0.5 + scores[0], beam1: -1.0 + scores[1]
+        # beam0: [-1.5, -2.5, -0.6, -3.5]; beam1: [-1.2, -1.4, -6.0, -1.6]
+        # top2 = -0.6 (b0, tok2), -1.2 (b1, tok0)
+        return {
+            "selected_ids": [np.asarray([[2], [0]], np.int64)],
+            "selected_scores": [f32([[-0.6], [-1.2]])],
+            "parent_idx": [np.asarray([0, 1], np.int32)],
+        }
+
+    return OpTest(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores},
+        oracle, attrs={"beam_size": 2, "end_id": 3},
+        outputs={"selected_ids": 1, "selected_scores": 1, "parent_idx": 1},
+    )
+
+
 # ---------------------------------------------------------------------------
 # exemptions: ops whose contract is verified elsewhere or is stochastic
 # ---------------------------------------------------------------------------
@@ -1903,6 +2307,15 @@ EXEMPT = {
 
 def test_coverage():
     registered = set(registry.registered_ops())
+    # registry.get() caches lazily synthesized generic "<op>_grad" specs;
+    # those are the vjp of an already-covered forward op, not independent
+    # kernels. Keep only grad ops with their own explicit registration
+    # (they appear in EXEMPT with a justification).
+    registered -= {
+        n for n in registered
+        if n.endswith("_grad") and n[: -len("_grad")] in registered
+        and n not in EXEMPT and n not in CASES
+    }
     covered = set(CASES) | set(EXEMPT)
     missing = registered - covered
     assert not missing, f"ops with neither case nor exemption: {sorted(missing)}"
