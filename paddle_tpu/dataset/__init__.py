@@ -1,0 +1,13 @@
+"""Built-in datasets (reference python/paddle/dataset/: mnist, cifar,
+imdb, uci_housing — same reader-creator API: `train()` returns a callable
+producing a sample generator).
+
+This environment has no network egress, so each loader first looks for
+the reference's cache files under ~/.cache/paddle/dataset/ and otherwise
+falls back to a DETERMINISTIC SYNTHETIC set with the exact shapes/dtypes
+of the real data (class-prototype images + noise for mnist/cifar, a
+linear task for uci_housing, a keyword task for imdb). The synthetic
+sets are learnable, so end-to-end examples and tests behave like the
+real pipelines.
+"""
+from . import cifar, imdb, mnist, uci_housing  # noqa: F401

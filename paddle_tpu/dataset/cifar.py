@@ -1,0 +1,73 @@
+"""CIFAR loader (reference python/paddle/dataset/cifar.py — train10/
+test10/train100/test100 yield (image[3072] float32 in [0,1], label)).
+Synthetic fallback: per-class color/texture prototypes + noise."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/cifar")
+TRAIN_N, TEST_N = 4000, 800
+
+
+def _synthetic(n, n_cls, seed):
+    rng = np.random.RandomState(seed)
+    protos = np.random.RandomState(7).rand(n_cls, 3072).astype(np.float32)
+    labels = rng.randint(0, n_cls, n).astype(np.int64)
+    imgs = 0.6 * protos[labels] + 0.4 * rng.rand(n, 3072).astype(np.float32)
+    return imgs.astype(np.float32), labels
+
+
+def _real(tar_path, n_cls, split):
+    imgs, labels = [], []
+    want = "test" if split == "test" else "data"
+    with tarfile.open(tar_path) as tf:
+        for m in tf.getmembers():
+            base = os.path.basename(m.name)
+            if n_cls == 10 and base.startswith(want + "_batch"):
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+            elif n_cls == 100 and base == ("test" if split == "test" else "train"):
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+            else:
+                continue
+            imgs.append(np.asarray(d[b"data"], np.float32) / 255.0)
+            key = b"labels" if n_cls == 10 else b"fine_labels"
+            labels.append(np.asarray(d[key], np.int64))
+    return np.concatenate(imgs), np.concatenate(labels)
+
+
+def _load(n_cls, split):
+    tar = os.path.join(
+        CACHE, "cifar-10-python.tar.gz" if n_cls == 10 else "cifar-100-python.tar.gz"
+    )
+    if os.path.exists(tar):
+        return _real(tar, n_cls, split)
+    n = TRAIN_N if split == "train" else TEST_N
+    return _synthetic(n, n_cls, seed=0 if split == "train" else 1)
+
+
+def _reader(images, labels):
+    def reader():
+        for i in range(images.shape[0]):
+            yield images[i], int(labels[i])
+
+    return reader
+
+
+def train10():
+    return _reader(*_load(10, "train"))
+
+
+def test10():
+    return _reader(*_load(10, "test"))
+
+
+def train100():
+    return _reader(*_load(100, "train"))
+
+
+def test100():
+    return _reader(*_load(100, "test"))

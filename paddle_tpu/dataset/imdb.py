@@ -1,0 +1,51 @@
+"""IMDB sentiment loader (reference python/paddle/dataset/imdb.py —
+word_dict() + train(word_idx)/test(word_idx) yielding
+(word_id_sequence, label)). Synthetic fallback: vocabulary of 2000 ids
+with class-indicative keyword distributions — learnable by the
+sentiment book models."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/imdb/aclImdb_v1.tar.gz")
+VOCAB = 2000
+TRAIN_N, TEST_N = 2000, 400
+SEQ_MIN, SEQ_MAX = 16, 64
+
+
+def word_dict():
+    """word -> id. Synthetic fallback: w0..wN placeholder tokens."""
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    # positive reviews oversample ids [0,200); negative [200,400)
+    samples = []
+    for _ in range(n):
+        label = int(rng.randint(0, 2))
+        ln = int(rng.randint(SEQ_MIN, SEQ_MAX + 1))
+        base = rng.randint(0, VOCAB, ln)
+        key = rng.randint(label * 200, label * 200 + 200, ln)
+        use_key = rng.rand(ln) < 0.3
+        seq = np.where(use_key, key, base).astype(np.int64)
+        samples.append((seq, label))
+    return samples
+
+
+def _reader(samples):
+    def reader():
+        for seq, label in samples:
+            yield seq, label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(_synthetic(TRAIN_N, seed=0))
+
+
+def test(word_idx=None):
+    return _reader(_synthetic(TEST_N, seed=1))

@@ -1,0 +1,72 @@
+"""MNIST loader (reference python/paddle/dataset/mnist.py — same reader
+API: train()/test() return creators yielding (image[784] float32 in
+[-1,1], label int)). Falls back to a deterministic synthetic set (10
+blurred digit prototypes + noise) when the idx-ubyte cache is absent."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/mnist")
+TRAIN_N, TEST_N = 8000, 1600  # synthetic sizes (real: 60000/10000)
+
+
+def _real(path_img, path_lbl):
+    with gzip.open(path_lbl, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    with gzip.open(path_img, "rb") as f:
+        magic, n, r, c = struct.unpack(">IIII", f.read(16))
+        imgs = np.frombuffer(f.read(), np.uint8).reshape(n, r * c)
+    imgs = imgs.astype(np.float32) / 127.5 - 1.0
+    return imgs, labels.astype(np.int64)
+
+
+def _prototypes(rng):
+    """10 class prototypes: smoothed random blobs, fixed by seed."""
+    protos = rng.randn(10, 28, 28).astype(np.float32)
+    # cheap blur for spatial structure
+    k = np.ones((5, 5), np.float32) / 25.0
+    out = np.zeros_like(protos)
+    pp = np.pad(protos, [(0, 0), (2, 2), (2, 2)], mode="edge")
+    for i in range(28):
+        for j in range(28):
+            out[:, i, j] = (pp[:, i:i + 5, j:j + 5] * k).sum((1, 2))
+    return out.reshape(10, 784) * 3.0
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    protos = _prototypes(np.random.RandomState(42))
+    labels = rng.randint(0, 10, n).astype(np.int64)
+    imgs = protos[labels] + 0.35 * rng.randn(n, 784).astype(np.float32)
+    return np.clip(imgs, -1.0, 1.0).astype(np.float32), labels
+
+
+def _reader(images, labels):
+    def reader():
+        for i in range(images.shape[0]):
+            yield images[i], int(labels[i])
+
+    return reader
+
+
+def _load(split):
+    img = os.path.join(CACHE, f"{split}-images-idx3-ubyte.gz")
+    lbl = os.path.join(CACHE, f"{split}-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _real(img, lbl)
+    if split == "train":
+        return _synthetic(TRAIN_N, seed=0)
+    return _synthetic(TEST_N, seed=1)
+
+
+def train():
+    return _reader(*_load("train"))
+
+
+def test():
+    return _reader(*_load("t10k"))

@@ -1,0 +1,45 @@
+"""UCI housing loader (reference python/paddle/dataset/uci_housing.py —
+train()/test() yield (features[13] float32, price[1] float32)).
+Synthetic fallback: fixed linear model + noise (feature-normalized like
+the real pipeline)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/uci_housing/housing.data")
+FEATURES = 13
+TRAIN_N, TEST_N = 404, 102
+
+
+def _load_all():
+    if os.path.exists(CACHE):
+        data = np.loadtxt(CACHE).astype(np.float32)
+        x, y = data[:, :-1], data[:, -1:]
+    else:
+        rng = np.random.RandomState(0)
+        w = np.random.RandomState(3).randn(FEATURES, 1).astype(np.float32)
+        x = rng.randn(TRAIN_N + TEST_N, FEATURES).astype(np.float32)
+        y = x @ w + 0.1 * rng.randn(TRAIN_N + TEST_N, 1).astype(np.float32)
+    mu, sd = x.mean(0), x.std(0) + 1e-6
+    x = (x - mu) / sd
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _reader(x, y):
+    def reader():
+        for i in range(x.shape[0]):
+            yield x[i], y[i]
+
+    return reader
+
+
+def train():
+    x, y = _load_all()
+    return _reader(x[:TRAIN_N], y[:TRAIN_N])
+
+
+def test():
+    x, y = _load_all()
+    return _reader(x[TRAIN_N:TRAIN_N + TEST_N], y[TRAIN_N:TRAIN_N + TEST_N])

@@ -180,6 +180,8 @@ def dynamic_lstm(
         raise NotImplementedError(
             "peephole connections are not supported (reference default path)"
         )
+    if size % 4 != 0:
+        raise ValueError(f"dynamic_lstm size must be divisible by 4, got {size}")
     helper = LayerHelper(
         "dynamic_lstm", param_attr=param_attr, bias_attr=bias_attr, name=name
     )

@@ -1,0 +1,279 @@
+"""hapi: the Keras-like high-level API (`Model.fit/evaluate/predict`).
+
+Parity surface: reference python/paddle/incubate/hapi/model.py
+(Model:664, prepare:1062, fit:1119, evaluate:1320, predict:1417,
+Input:50, StaticGraphAdapter:84).
+
+TPU-native design: one static Program per mode (train/eval/test) built
+from a user network callable over symbolic inputs; the whole train step
+(fwd+bwd+opt) is a single XLA computation via the Executor. The
+reference's DynamicGraphAdapter is unnecessary — static is the fast path
+on TPU, and dygraph models reach it through dygraph-to-static.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import layers
+from . import callbacks as callbacks_mod
+from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .metrics import Accuracy, Metric  # noqa: F401
+
+__all__ = [
+    "Input", "Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+    "EarlyStopping", "Metric", "Accuracy",
+]
+
+
+class Input:
+    """Symbolic input spec (reference hapi Input:50)."""
+
+    def __init__(self, name, shape=None, dtype="float32"):
+        self.name = name
+        self.shape = list(shape or [])
+        self.dtype = dtype
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """Static-graph Model (reference hapi Model:664).
+
+    network: callable taking the input Variables (not labels) and
+    returning the output Variable(s). inputs/labels: Input specs.
+    """
+
+    def __init__(self, network: Callable, inputs, labels=None):
+        self._network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        if not self._inputs:
+            raise ValueError("Model needs at least one Input spec")
+        self._optimizer = None
+        self._loss_function = None
+        self._metrics: List[Metric] = []
+        self._progs: Dict[str, tuple] = {}
+        self._exe = fluid.Executor()
+        self._scope = fluid.executor.Scope()
+        self._prepared = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss_function = loss_function
+        self._metrics = _to_list(metrics)
+        startup = fluid.Program()
+        for mode in ("train", "eval", "test"):
+            if mode == "train" and (optimizer is None or loss_function is None):
+                continue
+            if mode == "eval" and loss_function is None:
+                continue
+            self._progs[mode] = self._build_program(mode, startup)
+        self._startup = startup
+        with fluid.scope_guard(self._scope):
+            self._exe.run(startup)
+        self._prepared = True
+        return self
+
+    def _build_program(self, mode, startup):
+        from ..fluid import unique_name
+
+        main = fluid.Program()
+        # every mode rebuilds the same network: reset the name generator so
+        # parameters share names (and therefore scope storage) across the
+        # train/eval/test programs — reference StaticGraphAdapter._make_program
+        with unique_name.guard(), fluid.program_guard(main, startup):
+            in_vars = [
+                layers.data(i.name, i.shape, dtype=i.dtype, append_batch_size=False)
+                for i in self._inputs
+            ]
+            lbl_vars = [
+                layers.data(l.name, l.shape, dtype=l.dtype, append_batch_size=False)
+                for l in self._labels
+            ] if mode != "test" else []
+            if mode == "test":
+                main._hapi_is_test = True
+            outs = _to_list(self._network(*in_vars))
+            fetches = list(outs)
+            loss_var = None
+            if mode in ("train", "eval") and self._loss_function is not None:
+                loss_var = self._loss_function(*(outs + lbl_vars))
+                if isinstance(loss_var, (list, tuple)):
+                    loss_var = loss_var[0]
+                if tuple(loss_var.shape or ()) not in ((), (1,)):
+                    loss_var = layers.mean(loss_var)
+                fetches = [loss_var] + fetches
+            if mode == "train":
+                self._optimizer.minimize(loss_var)
+        feed_names = [i.name for i in self._inputs] + (
+            [l.name for l in self._labels] if mode != "test" else []
+        )
+        return main, feed_names, fetches, loss_var
+
+    # ------------------------------------------------------------------
+    def _run_batch(self, mode, inputs, labels=None):
+        if not self._prepared:
+            raise RuntimeError("call prepare() first")
+        main, feed_names, fetches, loss_var = self._progs[mode]
+        vals = _to_list(inputs) + _to_list(labels)
+        feed = {n: np.asarray(v) for n, v in zip(feed_names, vals)}
+        with fluid.scope_guard(self._scope):
+            return self._exe.run(main, feed=feed, fetch_list=fetches)
+
+    def train_batch(self, inputs, labels=None):
+        return self._run_batch("train", inputs, labels)
+
+    def eval_batch(self, inputs, labels=None):
+        return self._run_batch("eval", inputs, labels)
+
+    def test_batch(self, inputs):
+        return self._run_batch("test", inputs)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _batches(data, batch_size, shuffle, seed):
+        """Normalize data to an iterator of lists of numpy arrays.
+
+        Accepts: tuple/list of full numpy arrays; a callable returning a
+        sample generator (reference reader creator, e.g.
+        dataset.mnist.train); or an iterable of prepared batches."""
+        if callable(data):
+            samples = list(data())
+            cols = [np.asarray([s[i] for s in samples]) for i in range(len(samples[0]))]
+            return Model._batches(cols, batch_size, shuffle, seed)
+        data = list(data)
+        if all(isinstance(a, np.ndarray) for a in data):
+            n = data[0].shape[0]
+            idx = np.arange(n)
+            if shuffle:
+                np.random.RandomState(seed).shuffle(idx)
+            out = []
+            for s in range(0, n - n % batch_size or n, batch_size):
+                sel = idx[s: s + batch_size]
+                if len(sel) < batch_size:
+                    break
+                out.append([a[sel] for a in data])
+            return out
+        return data  # already an iterable of batches
+
+    def fit(
+        self,
+        train_data,
+        eval_data=None,
+        batch_size=32,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        shuffle=True,
+        callbacks=None,
+    ):
+        """reference hapi fit:1119."""
+        cbks = callbacks_mod.CallbackList(
+            _to_list(callbacks)
+            or ([ProgBarLogger(log_freq, verbose=verbose)] if verbose else [])
+        )
+        cbks.set_model(self)
+        cbks.on_train_begin()
+        history = {"loss": []}
+        stop = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            batches = self._batches(train_data, batch_size, shuffle, seed=epoch)
+            losses = []
+            for step, batch in enumerate(batches):
+                cbks.on_batch_begin("train", step)
+                n_in = len(self._inputs)
+                outs = self.train_batch(batch[:n_in], batch[n_in:])
+                loss = float(np.asarray(outs[0]).reshape(()))
+                losses.append(loss)
+                cbks.on_batch_end("train", step, {"loss": loss})
+            logs = {"loss": float(np.mean(losses))}
+            history["loss"].append(logs["loss"])
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size, verbose=0)
+                logs.update({f"val_{k}": v for k, v in eval_logs.items()})
+                history.setdefault("val_loss", []).append(eval_logs.get("loss"))
+            if save_dir and (epoch + 1) % save_freq == 0:
+                import os
+
+                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+            if cbks.on_epoch_end(epoch, logs):
+                stop = True
+            if stop:
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=32, log_freq=10, verbose=2,
+                 callbacks=None):
+        """reference hapi evaluate:1320 — returns {loss, metric values}."""
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        n_in = len(self._inputs)
+        for batch in self._batches(eval_data, batch_size, False, 0):
+            outs = self.eval_batch(batch[:n_in], batch[n_in:])
+            losses.append(float(np.asarray(outs[0]).reshape(())))
+            preds = outs[1:]
+            for m in self._metrics:
+                m.update(
+                    *[np.asarray(p) for p in preds],
+                    *[np.asarray(l) for l in batch[n_in:]],
+                )
+        logs = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=32, stack_outputs=True,
+                callbacks=None):
+        """reference hapi predict:1417."""
+        outs_all: List[List[np.ndarray]] = []
+        n_in = len(self._inputs)
+        for batch in self._batches(test_data, batch_size, False, 0):
+            outs = self.test_batch(batch[:n_in])
+            outs_all.append([np.asarray(o) for o in outs])
+        n_out = len(outs_all[0])
+        cols = [[b[i] for b in outs_all] for i in range(n_out)]
+        if stack_outputs:
+            cols = [np.concatenate(c, axis=0) for c in cols]
+        return cols
+
+    # ------------------------------------------------------------------
+    def save(self, path):
+        """Persistables of the train (or first) program -> '<path>.pdparams'
+        (reference hapi save:892 writes the same split)."""
+        import os
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        main = next(iter(self._progs.values()))[0]
+        with fluid.scope_guard(self._scope):
+            fluid.io.save_persistables(self._exe, path + ".pdparams", main)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        main = next(iter(self._progs.values()))[0]
+        with fluid.scope_guard(self._scope):
+            fluid.io.load_persistables(self._exe, path + ".pdparams", main)
+
+    def parameters(self):
+        main = next(iter(self._progs.values()))[0]
+        with fluid.scope_guard(self._scope):
+            scope = fluid.global_scope()
+            return {
+                v.name: np.asarray(scope.find_var(v.name))
+                for v in main.list_vars()
+                if isinstance(v, fluid.framework.Parameter)
+                and scope.find_var(v.name) is not None
+            }

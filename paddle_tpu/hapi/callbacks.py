@@ -1,0 +1,132 @@
+"""hapi callbacks (reference python/paddle/incubate/hapi/callbacks.py:
+Callback, ProgBarLogger, ModelCheckpoint; EarlyStopping is the one
+post-1.8 addition users expect from a Keras-like API)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self):
+        pass
+
+    def on_train_end(self):
+        pass
+
+    def on_epoch_begin(self, epoch):
+        pass
+
+    def on_epoch_end(self, epoch, logs: Optional[Dict] = None):
+        """Return True to stop training."""
+        return False
+
+    def on_batch_begin(self, mode, step):
+        pass
+
+    def on_batch_end(self, mode, step, logs: Optional[Dict] = None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = list(callbacks)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def on_train_begin(self):
+        for c in self.callbacks:
+            c.on_train_begin()
+
+    def on_train_end(self):
+        for c in self.callbacks:
+            c.on_train_end()
+
+    def on_epoch_begin(self, epoch):
+        for c in self.callbacks:
+            c.on_epoch_begin(epoch)
+
+    def on_epoch_end(self, epoch, logs=None) -> bool:
+        stop = False
+        for c in self.callbacks:
+            stop = bool(c.on_epoch_end(epoch, logs)) or stop
+        return stop
+
+    def on_batch_begin(self, mode, step):
+        for c in self.callbacks:
+            c.on_batch_begin(mode, step)
+
+    def on_batch_end(self, mode, step, logs=None):
+        for c in self.callbacks:
+            c.on_batch_end(mode, step, logs)
+
+
+class ProgBarLogger(Callback):
+    """Epoch/step logging (reference callbacks.ProgBarLogger)."""
+
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch):
+        self._epoch = epoch
+        self._steps = 0
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._steps += 1
+        if self.verbose > 1 and mode == "train" and step % self.log_freq == 0:
+            msg = ", ".join(f"{k}: {v:.6f}" for k, v in (logs or {}).items())
+            print(f"epoch {self._epoch} step {step}: {msg}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            msg = ", ".join(
+                f"{k}: {v:.6f}" for k, v in (logs or {}).items() if v is not None
+            )
+            print(f"epoch {epoch}: {msg}")
+        return False
+
+
+class ModelCheckpoint(Callback):
+    """Save persistables every `save_freq` epochs (reference
+    callbacks.ModelCheckpoint)."""
+
+    def __init__(self, save_freq=1, save_dir="checkpoints"):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            import os
+
+            self.model.save(os.path.join(self.save_dir, f"epoch_{epoch}"))
+        return False
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="val_loss", patience=3, min_delta=0.0,
+                 mode="min"):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.best = np.inf
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return False
+        score = self.sign * float(val)
+        if score < self.best - self.min_delta:
+            self.best = score
+            self.wait = 0
+            return False
+        self.wait += 1
+        return self.wait > self.patience

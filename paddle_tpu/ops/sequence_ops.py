@@ -98,8 +98,12 @@ def sequence_pool(ctx, ins, attrs):
         out = jnp.take_along_axis(
             x, last_idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
         ).squeeze(1)
+        if nonempty is not None:
+            out = jnp.where(nonempty, out, 0.0).astype(x.dtype)
     elif ptype == "FIRST":
         out = x[:, 0]
+        if nonempty is not None:
+            out = jnp.where(nonempty, out, 0.0).astype(x.dtype)
     else:
         raise ValueError(f"unknown pooltype {ptype!r}")
     return {"Out": [out]}
@@ -538,10 +542,11 @@ def warpctc(ctx, ins, attrs):
     # final: sum of positions 2*llen (last blank) and 2*llen-1 (last label)
     idx_last = jnp.clip(2 * llen, 0, s - 1)[:, None]
     idx_prev = jnp.clip(2 * llen - 1, 0, s - 1)[:, None]
-    ll = jnp.logaddexp(
-        jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0],
-        jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0],
-    )
+    a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0]
+    # empty target: only the all-blank path exists (idx_prev would alias
+    # idx_last and double-count it by log 2)
+    ll = jnp.where(llen > 0, jnp.logaddexp(a_last, a_prev), a_last)
     loss = -ll
     if attrs.get("norm_by_times", False):
         loss = loss / jnp.maximum(tlen.astype(jnp.float32), 1.0)

@@ -1,0 +1,156 @@
+"""hapi Model.fit/evaluate/predict + datasets (reference
+incubate/hapi/model.py + tests/book/test_recognize_digits.py /
+test_fit_a_line.py / test_understand_sentiment.py patterns)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.hapi import Accuracy, EarlyStopping, Input, Model
+
+
+def _mnist_arrays(reader_fn):
+    samples = list(reader_fn()())
+    x = np.stack([s[0] for s in samples]).astype(np.float32)
+    y = np.asarray([s[1] for s in samples], np.int64)[:, None]
+    return x, y
+
+
+def _lenet(x):
+    img = layers.reshape(x, [-1, 1, 28, 28])
+    c1 = layers.conv2d(img, 6, 5, act="relu")
+    p1 = layers.pool2d(c1, 2, pool_stride=2)
+    c2 = layers.conv2d(p1, 16, 5, act="relu")
+    p2 = layers.pool2d(c2, 2, pool_stride=2)
+    return layers.fc(p2, 10)
+
+
+def test_model_fit_mnist_lenet():
+    """Done-criterion: Model(...).fit(mnist) reaches >=97% val accuracy."""
+    from paddle_tpu.dataset import mnist
+
+    xtr, ytr = _mnist_arrays(lambda: mnist.train())
+    xte, yte = _mnist_arrays(lambda: mnist.test())
+
+    def loss_fn(logits, label):
+        return layers.mean(layers.softmax_with_cross_entropy(logits, label))
+
+    model = Model(_lenet, Input("img", [64, 784]), Input("label", [64, 1], "int64"))
+    model.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-3),
+        loss_fn,
+        metrics=Accuracy(),
+    )
+    hist = model.fit((xtr, ytr), eval_data=(xte, yte), batch_size=64,
+                     epochs=3, verbose=0)
+    logs = model.evaluate((xte, yte), batch_size=64, verbose=0)
+    assert logs["acc"] >= 0.97, logs
+    assert hist["loss"][-1] < hist["loss"][0]
+
+    # predict returns stacked logits for the whole set
+    preds = model.predict((xte,), batch_size=64)
+    n = (xte.shape[0] // 64) * 64
+    assert preds[0].shape == (n, 10)
+    acc = (np.argmax(preds[0], 1) == yte[:n, 0]).mean()
+    assert acc >= 0.97
+
+
+def test_model_fit_a_line_uci_housing():
+    """book/test_fit_a_line.py: linear regression on uci_housing."""
+    from paddle_tpu.dataset import uci_housing
+
+    tr = list(uci_housing.train()())
+    xtr = np.stack([s[0] for s in tr]); ytr = np.stack([s[1] for s in tr])
+
+    model = Model(
+        lambda x: layers.fc(x, 1),
+        Input("x", [32, 13]), Input("y", [32, 1]),
+    )
+    model.prepare(
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05),
+        lambda pred, label: layers.mean(layers.square_error_cost(pred, label)),
+    )
+    hist = model.fit((xtr, ytr), batch_size=32, epochs=12, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.2, hist["loss"]
+
+
+def test_model_sentiment_imdb():
+    """book/test_understand_sentiment.py (conv variant) on imdb via hapi."""
+    from paddle_tpu.dataset import imdb
+
+    T = 64
+    samples = list(imdb.train()())[:512]
+    x = np.zeros((len(samples), T), np.int64)
+    ln = np.zeros((len(samples),), np.int32)
+    y = np.zeros((len(samples), 1), np.int64)
+    for i, (seq, label) in enumerate(samples):
+        n = min(len(seq), T)
+        x[i, :n] = seq[:n]
+        ln[i] = n
+        y[i, 0] = label
+
+    def net(words, lens):
+        emb = layers.embedding(words, size=[imdb.VOCAB, 32])
+        conv = layers.sequence_conv(emb, 32, 3, length=lens, act="tanh")
+        pooled = layers.sequence_pool(conv, "MAX", length=lens)
+        return layers.fc(pooled, 2)
+
+    model = Model(
+        net,
+        [Input("words", [64, T], "int64"), Input("lens", [64], "int32")],
+        Input("label", [64, 1], "int64"),
+    )
+    model.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-3),
+        lambda logits, label: layers.mean(
+            layers.softmax_with_cross_entropy(logits, label)
+        ),
+        metrics=Accuracy(),
+    )
+    model.fit((x, ln, y), batch_size=64, epochs=6, verbose=0)
+    logs = model.evaluate((x, ln, y), batch_size=64, verbose=0)
+    assert logs["acc"] > 0.8, logs
+
+
+def test_callbacks_early_stopping_and_checkpoint(tmp_path):
+    xtr = np.random.RandomState(0).randn(128, 4).astype(np.float32)
+    ytr = (xtr @ np.ones((4, 1), np.float32)).astype(np.float32)
+
+    model = Model(lambda x: layers.fc(x, 1), Input("x", [16, 4]), Input("y", [16, 1]))
+    model.prepare(
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+        lambda p, l: layers.mean(layers.square_error_cost(p, l)),
+    )
+    es = EarlyStopping(monitor="val_loss", patience=1, min_delta=0.0)
+    hist = model.fit((xtr, ytr), eval_data=(xtr, ytr), batch_size=16,
+                     epochs=50, verbose=0, callbacks=[es])
+    assert len(hist["loss"]) < 50  # stopped early once converged
+
+    # save / load round trip restores parameters
+    p0 = model.parameters()
+    path = os.path.join(str(tmp_path), "ckpt")
+    model.save(path)
+    model.fit((xtr, ytr), batch_size=16, epochs=1, verbose=0)
+    model.load(path)
+    p1 = model.parameters()
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=1e-6)
+
+
+def test_dataset_readers_shapes():
+    from paddle_tpu.dataset import cifar, imdb, mnist, uci_housing
+
+    img, lbl = next(mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    img, lbl = next(cifar.train10()())
+    assert img.shape == (3072,)
+    x, y = next(uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    seq, label = next(imdb.train()())
+    assert seq.dtype == np.int64 and label in (0, 1)
+    # paddle.batch groups samples (reference python/paddle/batch.py)
+    b = next(paddle.batch(mnist.train(), 32)())
+    assert len(b) == 32
