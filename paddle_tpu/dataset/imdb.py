@@ -15,8 +15,41 @@ TRAIN_N, TEST_N = 2000, 400
 SEQ_MIN, SEQ_MAX = 16, 64
 
 
+def _real_samples(split):
+    """Parse the reference aclImdb tarball: train|test / pos|neg / *.txt."""
+    import re
+    import tarfile
+
+    wd = word_dict()
+    unk = len(wd)
+    out = []
+    with tarfile.open(CACHE) as tf:
+        for m in tf.getmembers():
+            mm = re.match(rf"aclImdb/{split}/(pos|neg)/.*\.txt$", m.name)
+            if not mm:
+                continue
+            text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
+            toks = re.findall(r"[a-z']+", text)
+            seq = np.asarray([wd.get(t, unk) for t in toks], np.int64)
+            out.append((seq, 1 if mm.group(1) == "pos" else 0))
+    return out
+
+
 def word_dict():
-    """word -> id. Synthetic fallback: w0..wN placeholder tokens."""
+    """word -> id (reference imdb.word_dict). Real tarball: the VOCAB most
+    frequent training words; synthetic fallback: w0..wN placeholders."""
+    if os.path.exists(CACHE):
+        import collections
+        import re
+        import tarfile
+
+        counts = collections.Counter()
+        with tarfile.open(CACHE) as tf:
+            for m in tf.getmembers():
+                if re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name):
+                    text = tf.extractfile(m).read().decode("utf-8", "ignore")
+                    counts.update(re.findall(r"[a-z']+", text.lower()))
+        return {w: i for i, (w, _) in enumerate(counts.most_common(VOCAB - 1))}
     return {f"w{i}": i for i in range(VOCAB)}
 
 
@@ -44,8 +77,12 @@ def _reader(samples):
 
 
 def train(word_idx=None):
+    if os.path.exists(CACHE):
+        return _reader(_real_samples("train"))
     return _reader(_synthetic(TRAIN_N, seed=0))
 
 
 def test(word_idx=None):
+    if os.path.exists(CACHE):
+        return _reader(_real_samples("test"))
     return _reader(_synthetic(TEST_N, seed=1))

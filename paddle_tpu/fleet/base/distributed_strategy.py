@@ -23,12 +23,21 @@ class DistributedStrategy:
         self.gradient_merge_configs: Dict = {"k_steps": 1, "avg": True}
         self.pipeline: bool = False
         self.pipeline_configs: Dict = {"accumulate_steps": 1}
+        # localsgd needs per-worker divergent weights, which the GSPMD
+        # executor (replicated params) cannot express yet: setting it makes
+        # minimize raise. dgc is N/A over TPU ICI (compression exists for
+        # slow interconnects); elastic is a dead flag in the reference too.
+        # None of these is silently ignored — fleet.minimize rejects them.
         self.localsgd: bool = False
         self.localsgd_configs: Dict = {"k_steps": 1}
         self.dgc: bool = False
+        # lamb/lars swap the inner optimizer (reference meta-optimizer chain)
         self.lars: bool = False
+        self.lars_configs: Dict = {}
         self.lamb: bool = False
-        self.sharding: bool = False  # ZeRO-style optimizer-state sharding
+        self.lamb_configs: Dict = {}
+        # ZeRO-2 analog: shard optimizer moments over "dp" (memory / dp)
+        self.sharding: bool = False
         self.sharding_configs: Dict = {}
         self.elastic: bool = False
         self.auto: bool = False

@@ -72,6 +72,7 @@ class DatasetBase:
         return make_datafeed(
             self._ncols(), self._batch_size,
             shuffle_buffer=shuffle_buffer, seed=self._seed,
+            num_threads=self._thread_num,
         )
 
     def _split_batch(self, rows: np.ndarray):

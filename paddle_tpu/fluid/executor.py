@@ -300,6 +300,8 @@ class Executor:
         the whole fwd+bwd+update program."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
+        if thread:
+            dataset.set_thread(thread)
         fetch_list = list(fetch_list or [])
         fetch_names = [
             v.name if isinstance(v, framework.Variable) else str(v)

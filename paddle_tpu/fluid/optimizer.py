@@ -571,9 +571,10 @@ def _create_persistable_var(name, shape, dtype, fill_value=0.0):
 
 def _append_step_cond(block, counter_name, k):
     """Emit: counter += 1; cond = (counter % k == 0). Returns the bool
-    cond var (shape (1,)). int64 counter: a float32 one saturates at 2^24
-    steps and would freeze the boundary condition forever."""
-    step = _create_persistable_var(counter_name, (1,), "int64", 0.0)
+    cond var (shape (1,)). int32 counter: exact to 2^31 steps (a float32
+    one saturates at 2^24 and would freeze the boundary forever; int64
+    would be silently truncated to int32 anyway with x64 disabled)."""
+    step = _create_persistable_var(counter_name, (1,), "int32", 0.0)
     block.append_op(
         type="increment", inputs={"X": [step]}, outputs={"Out": [step]},
         attrs={"step": 1.0},
@@ -581,7 +582,7 @@ def _append_step_cond(block, counter_name, k):
     k_name = unique_name.generate(counter_name + "_k")
     block.append_op(
         type="fill_constant", outputs={"Out": [k_name]},
-        attrs={"shape": [1], "dtype": "int64", "value": float(k)},
+        attrs={"shape": [1], "dtype": "int32", "value": float(k)},
     )
     mod_name = unique_name.generate(counter_name + "_mod")
     block.append_op(
@@ -591,7 +592,7 @@ def _append_step_cond(block, counter_name, k):
     zero_name = unique_name.generate(counter_name + "_zero")
     block.append_op(
         type="fill_constant", outputs={"Out": [zero_name]},
-        attrs={"shape": [1], "dtype": "int64", "value": 0.0},
+        attrs={"shape": [1], "dtype": "int32", "value": 0.0},
     )
     cond_name = unique_name.generate(counter_name + "_cond")
     block.append_op(
@@ -1039,7 +1040,7 @@ class ExponentialMovingAverage:
     def update(self):
         main = framework.default_main_program()
         block = main.global_block()
-        step = _create_persistable_var(self._step_name, (1,), "int64", 0.0)
+        step = _create_persistable_var(self._step_name, (1,), "int32", 0.0)
         block.append_op(
             type="increment", inputs={"X": [step]}, outputs={"Out": [step]},
             attrs={"step": 1.0},
@@ -1076,7 +1077,12 @@ class ExponentialMovingAverage:
                 type="elementwise_add", inputs={"X": [t1], "Y": [t2]},
                 outputs={"Out": [ema_name]},
             )
-            self._pairs.append((p.name, ema_name))
+            if (p.name, ema_name) not in self._pairs:
+                # update() may be called more than once (reference allows
+                # re-issuing the update ops); duplicated pairs would make
+                # apply() back up an already-swapped value and restore()
+                # leave EMA weights in the parameters permanently
+                self._pairs.append((p.name, ema_name))
 
     def apply(self, executor=None, need_restore=True):
         """Context manager: swap params for debiased EMA values in scope."""
@@ -1091,7 +1097,7 @@ class ExponentialMovingAverage:
             debias = max(1.0 - decay_pow, 1e-12)
             self._backup = {}
             for pname, ename in self._pairs:
-                self._backup[pname] = scope.find_var(pname)
+                self._backup.setdefault(pname, scope.find_var(pname))
                 ema = np.asarray(scope.find_var(ename))
                 scope.set_var(pname, (ema / debias).astype(ema.dtype))
             try:
@@ -1131,7 +1137,7 @@ class ModelAverage:
         block = main.global_block()
 
         num_upd = _create_persistable_var(
-            unique_name.generate("@MA@num_updates"), (1,), "int64", 0.0
+            unique_name.generate("@MA@num_updates"), (1,), "int32", 0.0
         )
         block.append_op(
             type="increment", inputs={"X": [num_upd]}, outputs={"Out": [num_upd]},

@@ -80,29 +80,46 @@ class GeneratorLoader:
             )
         q: queue.Queue = queue.Queue(maxsize=self._capacity)
         err: List[BaseException] = []
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # timed put + stop flag: when the consumer abandons iteration
+            # (break / early stop) the worker exits instead of blocking on
+            # a full queue forever (one leaked thread per abandoned epoch)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for batch in self._batch_reader():
-                    q.put(batch)
+                    if not _put(batch):
+                        return
             except BaseException as e:  # noqa: BLE001 — re-raised on consumer
                 err.append(e)
             finally:
-                q.put(_END)
+                _put(_END)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                if err:
-                    raise err[0]
-                return
-            arrays = [np.asarray(a) for a in item]
-            if self._return_list or not self._names:
-                yield arrays
-            else:
-                yield dict(zip(self._names, arrays))
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    if err:
+                        raise err[0]
+                    return
+                arrays = [np.asarray(a) for a in item]
+                if self._return_list or not self._names:
+                    yield arrays
+                else:
+                    yield dict(zip(self._names, arrays))
+        finally:
+            stop.set()
 
 
 def _stack_samples(samples):

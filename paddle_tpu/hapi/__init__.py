@@ -43,6 +43,24 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+# op types whose semantics switch on the is_test attr (the set the
+# reference's Program.clone(for_test=True) _inference_optimize flips)
+_TEST_MODE_OPS = {
+    "dropout", "batch_norm", "fused_multihead_attention",
+    "fused_encoder_stack", "instance_norm",
+}
+
+
+def _flip_to_test_mode(program):
+    """Eval/test programs run inference semantics: dropout off, batch_norm
+    on the running statistics (reference StaticGraphAdapter builds eval
+    programs via clone(for_test=True))."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in _TEST_MODE_OPS:
+                op._set_attr("is_test", True)
+
+
 class Model:
     """Static-graph Model (reference hapi Model:664).
 
@@ -98,8 +116,6 @@ class Model:
                 layers.data(l.name, l.shape, dtype=l.dtype, append_batch_size=False)
                 for l in self._labels
             ] if mode != "test" else []
-            if mode == "test":
-                main._hapi_is_test = True
             outs = _to_list(self._network(*in_vars))
             fetches = list(outs)
             loss_var = None
@@ -112,6 +128,8 @@ class Model:
                 fetches = [loss_var] + fetches
             if mode == "train":
                 self._optimizer.minimize(loss_var)
+        if mode != "train":
+            _flip_to_test_mode(main)
         feed_names = [i.name for i in self._inputs] + (
             [l.name for l in self._labels] if mode != "test" else []
         )
@@ -138,17 +156,27 @@ class Model:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _batches(data, batch_size, shuffle, seed):
-        """Normalize data to an iterator of lists of numpy arrays.
-
-        Accepts: tuple/list of full numpy arrays; a callable returning a
-        sample generator (reference reader creator, e.g.
-        dataset.mnist.train); or an iterable of prepared batches."""
+    def _materialize(data):
+        """Resolve data ONCE per fit/evaluate/predict call: a reader
+        creator (callable returning a sample generator) or a one-shot
+        iterator of prepared batches is consumed a single time, so
+        multi-epoch fit never re-iterates or exhausts it."""
         if callable(data):
             samples = list(data())
-            cols = [np.asarray([s[i] for s in samples]) for i in range(len(samples[0]))]
-            return Model._batches(cols, batch_size, shuffle, seed)
+            if not samples:
+                raise ValueError("empty dataset")
+            return [
+                np.asarray([s[i] for s in samples]) for i in range(len(samples[0]))
+            ]
         data = list(data)
+        if not data:
+            raise ValueError("empty dataset")
+        return data
+
+    @staticmethod
+    def _batches(data, batch_size, shuffle, seed):
+        """data: output of _materialize — full column arrays or a list of
+        prepared batches. Returns a list of per-batch array lists."""
         if all(isinstance(a, np.ndarray) for a in data):
             n = data[0].shape[0]
             idx = np.arange(n)
@@ -161,7 +189,7 @@ class Model:
                     break
                 out.append([a[sel] for a in data])
             return out
-        return data  # already an iterable of batches
+        return data  # already a list of batches
 
     def fit(
         self,
@@ -186,6 +214,9 @@ class Model:
         cbks.on_train_begin()
         history = {"loss": []}
         stop = False
+        train_data = self._materialize(train_data)
+        if eval_data is not None:
+            eval_data = self._materialize(eval_data)
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             batches = self._batches(train_data, batch_size, shuffle, seed=epoch)
@@ -221,15 +252,15 @@ class Model:
             m.reset()
         losses = []
         n_in = len(self._inputs)
+        eval_data = self._materialize(eval_data)
         for batch in self._batches(eval_data, batch_size, False, 0):
             outs = self.eval_batch(batch[:n_in], batch[n_in:])
             losses.append(float(np.asarray(outs[0]).reshape(())))
             preds = outs[1:]
             for m in self._metrics:
-                m.update(
-                    *[np.asarray(p) for p in preds],
-                    *[np.asarray(l) for l in batch[n_in:]],
-                )
+                # Keras-style binding: (first output, first label). Metrics
+                # over multi-output networks should subclass and override.
+                m.update(np.asarray(preds[0]), np.asarray(batch[n_in]))
         logs = {"loss": float(np.mean(losses)) if losses else float("nan")}
         for m in self._metrics:
             logs[m.name()] = m.accumulate()
@@ -240,6 +271,7 @@ class Model:
         """reference hapi predict:1417."""
         outs_all: List[List[np.ndarray]] = []
         n_in = len(self._inputs)
+        test_data = self._materialize(test_data)
         for batch in self._batches(test_data, batch_size, False, 0):
             outs = self.test_batch(batch[:n_in])
             outs_all.append([np.asarray(o) for o in outs])

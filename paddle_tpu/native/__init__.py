@@ -19,8 +19,19 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "datafeed.cc")
-_SO = os.path.join(_HERE, "libdatafeed.so")
 _lock = threading.Lock()
+
+
+def _so_path() -> str:
+    """Build artifact keyed by a source hash: a stale or foreign-arch
+    binary can never be dlopen'd (the .so is not version-controlled)."""
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:12]
+    d = os.path.join(_HERE, "build")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"libdatafeed-{h}.so")
 _lib = None
 _build_err: str | None = None
 
@@ -31,13 +42,14 @@ def _load():
         if _lib is not None or _build_err is not None:
             return _lib
         try:
-            if (not os.path.exists(_SO)) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            so = _so_path()
+            if not os.path.exists(so):
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
-                     "-o", _SO, "-lpthread"],
+                     "-o", so, "-lpthread"],
                     check=True, capture_output=True, text=True,
                 )
-            lib = ctypes.CDLL(_SO)
+            lib = ctypes.CDLL(so)
             lib.df_create.restype = ctypes.c_void_p
             lib.df_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                       ctypes.c_int, ctypes.c_uint64]
@@ -47,7 +59,7 @@ def _load():
             lib.df_next_batch.argtypes = [ctypes.c_void_p,
                                           ctypes.POINTER(ctypes.c_float),
                                           ctypes.c_int]
-            lib.df_load_into_memory.argtypes = [ctypes.c_void_p]
+            lib.df_load_into_memory.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.df_shuffle.argtypes = [ctypes.c_void_p]
             lib.df_memory_size.restype = ctypes.c_long
             lib.df_memory_size.argtypes = [ctypes.c_void_p]
@@ -71,7 +83,7 @@ class NativeDataFeed:
     shuffle; load_into_memory()+shuffle() is the global-shuffle mode."""
 
     def __init__(self, ncols: int, batch_size: int, channel_capacity: int = 4096,
-                 shuffle_buffer: int = 0, seed: int = 0):
+                 shuffle_buffer: int = 0, seed: int = 0, num_threads: int = 4):
         self._lib = _load()
         if self._lib is None:
             raise RuntimeError(f"native datafeed unavailable: {_build_err}")
@@ -82,13 +94,14 @@ class NativeDataFeed:
         )
         self._started = False
         self._loaded = False
+        self.num_threads = max(1, int(num_threads))
 
     def set_filelist(self, files):
         for f in files:
             self._lib.df_add_file(self._h, os.fsencode(f))
 
     def load_into_memory(self):
-        self._lib.df_load_into_memory(self._h)
+        self._lib.df_load_into_memory(self._h, self.num_threads)
         self._loaded = True
 
     def shuffle(self):
@@ -102,7 +115,7 @@ class NativeDataFeed:
 
     def __iter__(self):
         if not self._loaded and not self._started:
-            self._lib.df_start(self._h, 4)
+            self._lib.df_start(self._h, self.num_threads)
             self._started = True
         buf = np.empty((self.batch_size, self.ncols), np.float32)
         while True:
@@ -125,9 +138,10 @@ class PythonDataFeed:
     """Pure-Python fallback with the same surface (no reader threads)."""
 
     def __init__(self, ncols, batch_size, channel_capacity=4096,
-                 shuffle_buffer=0, seed=0):
+                 shuffle_buffer=0, seed=0, num_threads=1):
         self.ncols = ncols
         self.batch_size = batch_size
+        self.num_threads = num_threads  # accepted for surface parity
         self.shuffle_buffer = shuffle_buffer
         self.seed = seed
         self.files = []

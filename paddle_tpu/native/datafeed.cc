@@ -163,9 +163,9 @@ struct DataFeed {
     return n;
   }
 
-  void load_into_memory() {
+  void load_into_memory(int nthreads) {
     in_memory = true;
-    start_readers_for_load();
+    start_readers_for_load(nthreads);
     std::vector<float> row;
     while (channel.get(&row)) memory.push_back(std::move(row));
     for (auto& th : readers) th.join();
@@ -173,10 +173,11 @@ struct DataFeed {
     cursor = 0;
   }
 
-  void start_readers_for_load() {
+  void start_readers_for_load(int nthreads) {
     rng.seed(seed);
     channel.reset(channel_capacity);
-    int nthreads = files.size() < 4 ? (int)files.size() : 4;
+    if (nthreads > (int)files.size() && !files.empty())
+      nthreads = (int)files.size();
     if (nthreads < 1) nthreads = 1;
     active_readers = nthreads;
     for (int t = 0; t < nthreads; ++t) {
@@ -231,8 +232,8 @@ int df_next_batch(void* h, float* out, int max_rows) {
   return static_cast<DataFeed*>(h)->next_batch(out, max_rows);
 }
 
-void df_load_into_memory(void* h) {
-  static_cast<DataFeed*>(h)->load_into_memory();
+void df_load_into_memory(void* h, int nthreads) {
+  static_cast<DataFeed*>(h)->load_into_memory(nthreads);
 }
 
 void df_shuffle(void* h) { static_cast<DataFeed*>(h)->shuffle_memory(); }

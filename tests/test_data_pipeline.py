@@ -170,3 +170,63 @@ def test_queue_dataset_streams(tmp_path):
             assert feed["x"].shape == (10, 5)
             n += feed["x"].shape[0]
         assert n == 120
+
+
+def test_loader_abandoned_iteration_releases_worker():
+    """Breaking out of a DataLoader loop must not leak a blocked worker
+    thread (ADVICE round-1: q.put blocked forever on abandoned epochs)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.fluid.reader import DataLoader
+
+    def gen():
+        for i in range(10_000):
+            yield [np.full((2, 2), i, np.float32)]
+
+    loader = DataLoader.from_generator(capacity=2)
+    loader.set_batch_generator(gen)
+    before = threading.active_count()
+    for i, _ in enumerate(loader):
+        if i == 3:
+            break  # abandon mid-epoch with a full queue
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "worker thread leaked"
+
+
+def test_ema_update_idempotent():
+    """Calling ExponentialMovingAverage.update() twice must not corrupt
+    apply()/restore() (ADVICE round-1: duplicated pairs overwrote backups)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 3], append_batch_size=False)
+        y = layers.fc(x, 2)
+        loss = layers.mean(y)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        opt.minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.9)
+        ema.update()
+        ema.update()  # second call must be a no-op for the pair list
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        feed = {"x": np.ones((4, 3), np.float32)}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        scope = fluid.global_scope()
+        pname = [n for n in scope.vars if n.endswith(".w_0")][0]
+        original = np.asarray(scope.find_var(pname)).copy()
+        with ema.apply(exe):
+            pass  # params swapped to EMA inside
+        restored = np.asarray(scope.find_var(pname))
+        np.testing.assert_allclose(restored, original)

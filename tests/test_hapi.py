@@ -154,3 +154,48 @@ def test_dataset_readers_shapes():
     # paddle.batch groups samples (reference python/paddle/batch.py)
     b = next(paddle.batch(mnist.train(), 32)())
     assert len(b) == 32
+
+
+def test_eval_runs_in_test_mode():
+    """eval/test programs flip is_test: dropout must be deterministic and
+    identity-scaled during evaluate/predict (review finding: train-mode
+    graphs were reused for eval)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = np.zeros((64, 1), np.int64)
+
+    def net(inp):
+        h = layers.fc(inp, 16, act="relu")
+        h = layers.dropout(h, dropout_prob=0.5)
+        return layers.fc(h, 2)
+
+    model = Model(net, Input("x", [32, 8]), Input("y", [32, 1], "int64"))
+    model.prepare(
+        fluid.optimizer.SGDOptimizer(learning_rate=0.0),  # frozen params
+        lambda lg, lb: layers.mean(layers.softmax_with_cross_entropy(lg, lb)),
+    )
+    p1 = model.predict((x,), batch_size=32)[0]
+    p2 = model.predict((x,), batch_size=32)[0]
+    np.testing.assert_allclose(p1, p2)  # no dropout randomness in test mode
+    l1 = model.evaluate((x, y), batch_size=32, verbose=0)["loss"]
+    l2 = model.evaluate((x, y), batch_size=32, verbose=0)["loss"]
+    assert l1 == l2
+
+
+def test_fit_accepts_one_shot_batch_iterator():
+    """A generator of prepared batches must survive multi-epoch fit
+    (review finding: epoch 1 crashed on the exhausted iterator)."""
+    rng = np.random.RandomState(1)
+
+    def gen():
+        for _ in range(4):
+            x = rng.randn(8, 4).astype(np.float32)
+            yield [x, (x @ np.ones((4, 1))).astype(np.float32)]
+
+    model = Model(lambda x: layers.fc(x, 1), Input("x", [8, 4]), Input("y", [8, 1]))
+    model.prepare(
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05),
+        lambda p, l: layers.mean(layers.square_error_cost(p, l)),
+    )
+    hist = model.fit(gen(), batch_size=8, epochs=3, verbose=0)
+    assert len(hist["loss"]) == 3 and hist["loss"][-1] < hist["loss"][0]
