@@ -15,42 +15,71 @@ TRAIN_N, TEST_N = 2000, 400
 SEQ_MIN, SEQ_MAX = 16, 64
 
 
-def _real_samples(split):
-    """Parse the reference aclImdb tarball: train|test / pos|neg / *.txt."""
+_scan_cache = {}
+
+
+def _real_samples(split, word_idx=None):
+    """Parse the reference aclImdb tarball: train|test / pos|neg / *.txt.
+    Raw token lists are cached so word_dict()/train()/test() scan the
+    tarball at most once per split."""
     import re
     import tarfile
 
-    wd = word_dict()
+    key = ("samples", split)
+    if key not in _scan_cache:
+        out = []
+        with tarfile.open(CACHE) as tf:
+            for m in tf.getmembers():
+                mm = re.match(rf"aclImdb/{split}/(pos|neg)/.*\.txt$", m.name)
+                if not mm:
+                    continue
+                text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
+                toks = re.findall(r"[a-z']+", text)
+                out.append((toks, 1 if mm.group(1) == "pos" else 0))
+        _scan_cache[key] = out
+    wd = word_idx if word_idx is not None else word_dict()
     unk = len(wd)
-    out = []
-    with tarfile.open(CACHE) as tf:
-        for m in tf.getmembers():
-            mm = re.match(rf"aclImdb/{split}/(pos|neg)/.*\.txt$", m.name)
-            if not mm:
-                continue
-            text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
-            toks = re.findall(r"[a-z']+", text)
-            seq = np.asarray([wd.get(t, unk) for t in toks], np.int64)
-            out.append((seq, 1 if mm.group(1) == "pos" else 0))
-    return out
+    return [
+        (np.asarray([wd.get(t, unk) for t in toks], np.int64), label)
+        for toks, label in _scan_cache[key]
+    ]
 
 
 def word_dict():
     """word -> id (reference imdb.word_dict). Real tarball: the VOCAB most
     frequent training words; synthetic fallback: w0..wN placeholders."""
     if os.path.exists(CACHE):
-        import collections
-        import re
-        import tarfile
+        if "word_dict" not in _scan_cache:
+            import collections
 
-        counts = collections.Counter()
+            counts = collections.Counter()
+            # reuse the cached raw scan of the training split
+            for toks in _raw_train_tokens():
+                counts.update(toks)
+            _scan_cache["word_dict"] = {
+                w: i for i, (w, _) in enumerate(counts.most_common(VOCAB - 1))
+            }
+        return _scan_cache["word_dict"]
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _raw_train_tokens():
+    """Token lists of the training split (cached by _real_samples)."""
+    import re
+    import tarfile
+
+    key = ("samples", "train")
+    if key not in _scan_cache:
+        out = []
         with tarfile.open(CACHE) as tf:
             for m in tf.getmembers():
-                if re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name):
-                    text = tf.extractfile(m).read().decode("utf-8", "ignore")
-                    counts.update(re.findall(r"[a-z']+", text.lower()))
-        return {w: i for i, (w, _) in enumerate(counts.most_common(VOCAB - 1))}
-    return {f"w{i}": i for i in range(VOCAB)}
+                mm = re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name)
+                if not mm:
+                    continue
+                text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
+                out.append((re.findall(r"[a-z']+", text), 1 if mm.group(1) == "pos" else 0))
+        _scan_cache[key] = out
+    return (toks for toks, _ in _scan_cache[key])
 
 
 def _synthetic(n, seed):
@@ -78,11 +107,11 @@ def _reader(samples):
 
 def train(word_idx=None):
     if os.path.exists(CACHE):
-        return _reader(_real_samples("train"))
+        return _reader(_real_samples("train", word_idx))
     return _reader(_synthetic(TRAIN_N, seed=0))
 
 
 def test(word_idx=None):
     if os.path.exists(CACHE):
-        return _reader(_real_samples("test"))
+        return _reader(_real_samples("test", word_idx))
     return _reader(_synthetic(TEST_N, seed=1))

@@ -49,10 +49,9 @@ def worker_num() -> int:
 def worker_endpoints():
     """Launcher-provided endpoints (reference role_maker.get_trainer_endpoints);
     empty on a single host with no launcher env."""
-    import os
+    from ..parallel.env import get_endpoints
 
-    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
-    return eps.split(",") if eps else []
+    return get_endpoints()
 
 
 def barrier_worker():

@@ -7,7 +7,14 @@ jax's own per-op jit cache); training at scale should use the static
 Program path, which compiles whole steps (reference parity: dygraph is
 the development/debug mode there too).
 """
-from . import nn  # noqa: F401
+from . import jit, nn, parallel  # noqa: F401
+from .jit import (  # noqa: F401
+    ProgramTranslator,
+    TracedLayer,
+    declarative,
+    to_static,
+)
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from .base import (  # noqa: F401
     VarBase,
     Tracer,

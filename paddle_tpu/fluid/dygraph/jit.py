@@ -1,0 +1,285 @@
+"""dygraph-to-static: trace eager Layers/functions into static Programs.
+
+Parity surface: reference fluid/dygraph/jit.py (TracedLayer, trace),
+dygraph_to_static/program_translator.py:348 (ProgramTranslator,
+get_program:541), and the @declarative/to_static decorator.
+
+TPU-native design: the reference transpiles Python AST (15 transformer
+files) because its dygraph ops are opaque C++ calls. Here every dygraph
+op already funnels through Tracer.trace_op, so dygraph-to-static is a
+TRACER SWAP: a ProgramTracer records each traced op into a Program
+instead of executing it eagerly — the same mechanism JAX uses for jit.
+Python control flow is resolved at trace time (like jax.jit); the
+static-graph layers.cond/while_loop remain the tool for data-dependent
+control flow, exactly as with jax.lax.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import framework
+from .. import unique_name
+from . import base
+from .base import Tracer, VarBase
+
+
+class ProgramTracer(Tracer):
+    """Tracer that builds a static Program from dygraph op calls."""
+
+    def __init__(self, program: framework.Program, startup: framework.Program):
+        super().__init__()
+        self.program = program
+        self.startup = startup
+        self.param_values: Dict[str, np.ndarray] = {}
+        # live VarBase behind each traced parameter: calls re-seed the
+        # scope from (and write updates back to) the eager tensors, so
+        # parameters are SHARED with dygraph, not frozen at trace time
+        self.param_sources: Dict[str, Any] = {}
+        self._var_map: Dict[int, framework.Variable] = {}
+
+    # -- VarBase -> static Variable ------------------------------------
+    def lift(self, v):
+        if isinstance(v, framework.Variable):
+            return v
+        sv = self._var_map.get(id(v))
+        if sv is None:
+            # leaf VarBase (a Layer parameter or captured constant): a
+            # persistable var whose current value seeds the scope
+            block = self.program.global_block()
+            name = unique_name.generate("traced_param")
+            if v.stop_gradient:
+                sv = block.create_var(
+                    name=name, shape=tuple(v.shape), dtype=np.dtype(str(v.dtype)),
+                    persistable=True,
+                )
+                sv.stop_gradient = True
+            else:
+                sv = framework.Parameter(
+                    block, name, shape=tuple(v.shape),
+                    dtype=np.dtype(str(v.dtype)),
+                )
+                block.vars[name] = sv
+            self.param_values[name] = np.asarray(v.value)
+            self.param_sources[name] = v
+            self._var_map[id(v)] = sv
+        return sv
+
+    def trace_op(self, type, inputs, attrs, out_slots):
+        block = self.program.global_block()
+        in_names: Dict[str, List[str]] = {}
+        for slot, vs in inputs.items():
+            if vs:
+                in_names[slot] = [self.lift(v).name for v in vs]
+        out_names: Dict[str, List[str]] = {}
+        outputs: Dict[str, List[framework.Variable]] = {}
+        for slot in out_slots:
+            n = unique_name.generate(f"traced_{type}_{slot}")
+            block.create_var(name=n)
+            out_names[slot] = [n]
+        block.append_op(type=type, inputs=in_names, outputs=out_names, attrs=dict(attrs))
+        for slot in out_slots:
+            outputs[slot] = [block.var(out_names[slot][0])]
+        return outputs
+
+
+class ConcreteProgram:
+    """The result of one trace: program + endpoints + parameter seeds."""
+
+    def __init__(self, main, startup, feed_vars, fetch_vars, param_values,
+                 param_sources=None):
+        self.main_program = main
+        self.startup_program = startup
+        self.inputs = feed_vars
+        self.outputs = fetch_vars
+        self.parameter_values = param_values
+        # name -> live VarBase (two-way parameter sharing with dygraph)
+        self.parameter_sources = param_sources or {}
+
+
+def _trace(fn, example_inputs) -> Tuple[List[Any], ConcreteProgram]:
+    main, startup = framework.Program(), framework.Program()
+    tracer = ProgramTracer(main, startup)
+    feed_vars = []
+    with framework.program_guard(main, startup):
+        block = main.global_block()
+        args = []
+        for i, a in enumerate(example_inputs):
+            arr = np.asarray(a.value if isinstance(a, VarBase) else a)
+            v = block.create_var(name=f"traced_in_{i}", shape=arr.shape, dtype=arr.dtype)
+            v.stop_gradient = arr.dtype.kind != "f"
+            feed_vars.append(v)
+            args.append(v)
+        old = framework._dygraph_tracer_
+        framework._dygraph_tracer_ = tracer
+        try:
+            outs = fn(*args)
+        finally:
+            framework._dygraph_tracer_ = old
+    outs_list = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    fetch_vars = [tracer.lift(o) for o in outs_list]
+    cp = ConcreteProgram(
+        main, startup, feed_vars, fetch_vars, tracer.param_values,
+        tracer.param_sources,
+    )
+    return outs_list, cp
+
+
+class StaticFunction:
+    """@to_static-wrapped callable (reference StaticFunction /
+    program_translator.get_output:440). Traces once per input signature,
+    then runs the compiled Program through an Executor."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._cache: Dict[tuple, tuple] = {}
+        from ..executor import Executor, Scope
+
+        self._exe = Executor()
+        self._scope = Scope()
+
+    def _sig(self, args):
+        out = []
+        for a in args:
+            arr = np.asarray(a.value if isinstance(a, VarBase) else a)
+            out.append((tuple(arr.shape), str(arr.dtype)))
+        return tuple(out)
+
+    def get_concrete_program(self, *args) -> ConcreteProgram:
+        key = self._sig(args)
+        if key not in self._cache:
+            _, cp = _trace(self._fn, args)
+            self._cache[key] = cp
+        return self._cache[key]
+
+    def __call__(self, *args):
+        from .. import executor as executor_mod
+
+        cp = self.get_concrete_program(*args)
+        with executor_mod.scope_guard(self._scope):
+            scope = executor_mod.global_scope()
+            # parameters are shared with dygraph: push the CURRENT eager
+            # values in, and pull any in-program updates back out after
+            for name, vb in cp.parameter_sources.items():
+                scope.set_var(name, vb.value)
+            for name, val in cp.parameter_values.items():
+                if name not in cp.parameter_sources and scope.find_var(name) is None:
+                    scope.set_var(name, val)
+            feed = {
+                v.name: np.asarray(a.value if isinstance(a, VarBase) else a)
+                for v, a in zip(cp.inputs, args)
+            }
+            outs = self._exe.run(
+                cp.main_program, feed=feed,
+                fetch_list=[v.name for v in cp.outputs],
+            )
+            for name, vb in cp.parameter_sources.items():
+                new = scope.find_var(name)
+                if new is not None:
+                    vb.value = new
+        outs = [VarBase(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def to_static(fn=None):
+    """Decorator (reference @declarative / paddle.jit.to_static)."""
+    if fn is None:
+        return to_static
+    return StaticFunction(fn)
+
+
+declarative = to_static
+
+
+class TracedLayer:
+    """reference fluid/dygraph/jit.py TracedLayer: trace a Layer once,
+    run / save the resulting Program."""
+
+    def __init__(self, cp: ConcreteProgram):
+        self.concrete_program = cp
+        from ..executor import Executor, Scope
+
+        self._exe = Executor()
+        self._scope = Scope()
+
+    @staticmethod
+    def trace(layer, inputs: Sequence) -> Tuple[Any, "TracedLayer"]:
+        outs, cp = _trace(lambda *a: layer(*a), list(inputs))
+        # re-run eagerly for the first return value (reference returns the
+        # dygraph outputs of this call)
+        eager_outs = layer(*inputs)
+        return eager_outs, TracedLayer(cp)
+
+    @property
+    def program(self):
+        return self.concrete_program.main_program
+
+    def _seed_scope(self):
+        from .. import executor as executor_mod
+
+        scope = executor_mod.global_scope()
+        for name, val in self.concrete_program.parameter_values.items():
+            if scope.find_var(name) is None:
+                scope.set_var(name, val)
+
+    def __call__(self, inputs: Sequence):
+        from .. import executor as executor_mod
+
+        cp = self.concrete_program
+        with executor_mod.scope_guard(self._scope):
+            self._seed_scope()
+            feed = {
+                v.name: np.asarray(a.value if isinstance(a, VarBase) else a)
+                for v, a in zip(cp.inputs, inputs)
+            }
+            outs = self._exe.run(
+                cp.main_program, feed=feed,
+                fetch_list=[v.name for v in cp.outputs],
+            )
+        return [VarBase(o) for o in outs]
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from .. import executor as executor_mod
+        from .. import io
+
+        cp = self.concrete_program
+        with executor_mod.scope_guard(self._scope):
+            self._seed_scope()
+            io.save_inference_model(
+                path,
+                [v.name for v in cp.inputs],
+                cp.outputs,
+                self._exe,
+                main_program=cp.main_program,
+            )
+
+
+class ProgramTranslator:
+    """Singleton facade (reference program_translator.py:348)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = True
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        self.enabled = bool(enable_to_static)
+
+    def get_program(self, fn, *args):
+        """Trace fn with args -> (main_program, startup_program, inputs,
+        outputs) (reference get_program:541)."""
+        sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
+        cp = sf.get_concrete_program(*args)
+        return cp.main_program, cp.startup_program, cp.inputs, cp.outputs
+
+    def get_output(self, fn, *args):
+        sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
+        return sf(*args)

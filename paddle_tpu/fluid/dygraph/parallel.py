@@ -1,0 +1,112 @@
+"""Dygraph data parallelism (reference fluid/dygraph/parallel.py:
+DataParallel:225, scale_loss:292, apply_collective_grads:384,
+prepare_context, ParallelEnv).
+
+TPU-native: the reference coalesces gradients and calls NCCL allreduce
+per bucket. Here the collective is one jax psum over the launcher-created
+process group (paddle_tpu.distributed); buckets are unnecessary — XLA
+fuses the flat gradient tree into as few transfers as ICI needs. On a
+single process the wrapper is a transparent no-op, matching the
+reference's nranks==1 fast path.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...parallel.env import get_rank, get_world_size, init_parallel_env
+from .layers import Layer
+
+
+class ParallelEnv:
+    """reference dygraph/parallel.py Env: rank/world from launcher env."""
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_rank()
+
+    @property
+    def dev_id(self) -> int:
+        return 0  # one logical device per process under PJRT
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """Initialize the coordination service (replaces NCCL context init)."""
+    init_parallel_env()
+    return ParallelEnv()
+
+
+def _default_comm(grad):
+    """Mean-allreduce one gradient across the process group."""
+    import jax
+
+    # multi-process jax: global devices span processes; psum over all
+    from ... import distributed as dist
+
+    return dist.all_reduce(grad, op=dist.ReduceOp.SUM) / get_world_size()
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for multi-process data-parallel training.
+
+    forward delegates to the wrapped layer; after loss.backward(), call
+    apply_collective_grads() to mean-allreduce every parameter gradient
+    (reference apply_collective_grads:384). scale_loss divides by nranks
+    so the summed allreduce yields the global mean (reference :292).
+
+    comm: injectable per-gradient collective (tests exercise the
+    averaging path without a multi-process launch).
+    """
+
+    def __init__(self, layers: Layer, strategy=None,
+                 comm: Optional[Callable] = None):
+        super().__init__()
+        self._layers = layers
+        self._comm = comm
+        self._nranks = get_world_size()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix=""):
+        return self._layers.named_parameters(prefix)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def scale_loss(self, loss):
+        if self._nranks <= 1 and self._comm is None:
+            return loss
+        n = self._nranks if self._nranks > 1 else 1
+        return loss * (1.0 / n)
+
+    def apply_collective_grads(self):
+        if self._nranks <= 1 and self._comm is None:
+            return  # single process: nothing to average
+        comm = self._comm or _default_comm
+        for p in self._layers.parameters():
+            if p.grad is None:
+                continue
+            p.grad = comm(p.grad)

@@ -44,11 +44,15 @@ def _load():
         try:
             so = _so_path()
             if not os.path.exists(so):
+                # compile to a temp path + atomic rename: a concurrent
+                # process can never dlopen a partially written binary
+                tmp = f"{so}.tmp.{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
-                     "-o", so, "-lpthread"],
+                     "-o", tmp, "-lpthread"],
                     check=True, capture_output=True, text=True,
                 )
+                os.replace(tmp, so)
             lib = ctypes.CDLL(so)
             lib.df_create.restype = ctypes.c_void_p
             lib.df_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,

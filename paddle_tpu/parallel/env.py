@@ -21,12 +21,19 @@ def get_rank() -> int:
     return 0
 
 
+def get_endpoints() -> list:
+    """Launcher-provided trainer endpoints (single source of truth for
+    PADDLE_TRAINER_ENDPOINTS parsing)."""
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return [e.strip() for e in eps.split(",") if e.strip()] if eps else []
+
+
 def get_world_size() -> int:
     if "PADDLE_TRAINERS_NUM" in os.environ:
         return int(os.environ["PADDLE_TRAINERS_NUM"])
-    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    eps = get_endpoints()
     if eps:
-        return len(eps.split(","))
+        return len(eps)
     if "JAX_NUM_PROCESSES" in os.environ:
         return int(os.environ["JAX_NUM_PROCESSES"])
     return 1
@@ -42,8 +49,8 @@ def init_parallel_env() -> None:
     if world > 1:
         import jax
 
-        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
-        coordinator = eps[0] if eps and eps[0] else None
+        eps = get_endpoints()
+        coordinator = eps[0] if eps else None
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=world,

@@ -1,0 +1,226 @@
+"""dygraph-to-static (trace-based ProgramTranslator) + dygraph
+DataParallel (reference dygraph_to_static/program_translator.py:348,
+dygraph/parallel.py:225; equivalence-test pattern of
+test_imperative_resnet: same model, k steps, params match)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph import DataParallel, TracedLayer, to_static
+from paddle_tpu.fluid.dygraph.base import _trace_op
+
+
+def _mean(v):
+    return _trace_op("reduce_mean", {"X": [v]}, {"reduce_all": True}, ["Out"])[0]
+
+
+class MLP(dygraph.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = dygraph.nn.Linear(4, 8, act="relu")
+        self.fc2 = dygraph.nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+def test_to_static_matches_eager():
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 4).astype(np.float32)
+    with dygraph.guard():
+        net = MLP()
+        eager = net(dygraph.to_variable(x)).numpy()
+
+        traced_fn = to_static(lambda inp: net(inp))
+        static_out = traced_fn(dygraph.to_variable(x)).numpy()
+    np.testing.assert_allclose(eager, static_out, rtol=1e-5, atol=1e-6)
+    # second call hits the signature cache; different shape retraces
+    with dygraph.guard():
+        static2 = traced_fn(dygraph.to_variable(x * 2)).numpy()
+        x2 = rng.randn(3, 4).astype(np.float32)
+        static3 = traced_fn(dygraph.to_variable(x2)).numpy()
+    assert static2.shape == (5, 2) and static3.shape == (3, 2)
+
+
+def test_traced_layer_runs_and_saves(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 4).astype(np.float32)
+    with dygraph.guard():
+        net = MLP()
+        eager_out, traced = TracedLayer.trace(net, [dygraph.to_variable(x)])
+        static_out = traced([dygraph.to_variable(x)])[0].numpy()
+        np.testing.assert_allclose(eager_out.numpy(), static_out, rtol=1e-5, atol=1e-6)
+        assert any(op.type == "mul" for op in traced.program.global_block().ops)
+
+        path = str(tmp_path / "traced_model")
+        traced.save_inference_model(path)
+
+    # load back through the static inference API and compare
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        prog, feeds, fetches = fluid.io.load_inference_model(path, exe)
+        (out,) = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(out), static_out, rtol=1e-5, atol=1e-6)
+
+
+def test_imperative_vs_static_training_equivalence():
+    """Same weights, same data: k eager SGD steps == k static SGD steps
+    on the traced program (reference test_imperative_* pattern)."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 2).astype(np.float32)
+    k, lr = 5, 0.1
+
+    # --- eager training
+    with dygraph.guard():
+        net = MLP()
+        init_state = {n: v.numpy().copy() for n, v in net.named_parameters()}
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=lr, parameter_list=net.parameters()
+        )
+        for _ in range(k):
+            pred = net(dygraph.to_variable(x))
+            diff = pred - dygraph.to_variable(y)
+            loss = _mean(diff * diff)
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+        eager_params = {n: v.numpy() for n, v in net.named_parameters()}
+
+    # --- static training on the traced program (fresh net, same weights)
+    with dygraph.guard():
+        net2 = MLP()
+        net2.set_dict(init_state)
+
+        def loss_fn(inp, tgt):
+            d = net2(inp) - tgt
+            return _mean(d * d)
+
+        sf = to_static(loss_fn)
+        cp = sf.get_concrete_program(
+            dygraph.to_variable(x), dygraph.to_variable(y)
+        )
+    with fluid.program_guard(cp.main_program, cp.startup_program):
+        fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(
+            cp.main_program.global_block().var(cp.outputs[0].name)
+        )
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(cp.startup_program)  # optimizer state (lr var etc.)
+        scope = fluid.global_scope()
+        for name, val in cp.parameter_values.items():
+            scope.set_var(name, val)
+        feed = {cp.inputs[0].name: x, cp.inputs[1].name: y}
+        for _ in range(k):
+            exe.run(cp.main_program, feed=feed, fetch_list=[cp.outputs[0].name])
+        static_params = {
+            name: np.asarray(scope.find_var(name))
+            for name in cp.parameter_values
+        }
+
+    # match params pairwise: traced params are ordered by first use
+    eager_vals = sorted((v.shape, v.sum()) for v in eager_params.values())
+    static_vals = sorted(
+        (v.shape, v.sum())
+        for n, v in static_params.items()
+        if cp.main_program.global_block().var(n).stop_gradient is False
+    )
+    assert len(eager_vals) == len(static_vals)
+    for (se, ve), (ss, vs) in zip(eager_vals, static_vals):
+        assert se == ss
+        np.testing.assert_allclose(ve, vs, rtol=1e-4, atol=1e-5)
+
+
+def test_program_translator_get_program():
+    from paddle_tpu.fluid.dygraph import ProgramTranslator
+
+    pt = ProgramTranslator.get_instance()
+    with dygraph.guard():
+        net = MLP()
+        main, startup, ins, outs = pt.get_program(
+            lambda a: net(a), dygraph.to_variable(np.ones((2, 4), np.float32))
+        )
+    assert len(ins) == 1 and len(outs) == 1
+    assert any(op.type == "mul" for op in main.global_block().ops)
+
+
+def test_data_parallel_single_process_passthrough():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 4).astype(np.float32)
+    with dygraph.guard():
+        net = MLP()
+        dp = DataParallel(net)
+        out = dp(dygraph.to_variable(x))
+        loss = _mean(out * out)
+        scaled = dp.scale_loss(loss)
+        assert float(scaled.numpy()) == pytest.approx(float(loss.numpy()))
+        scaled.backward()
+        g_before = {n: v.gradient.copy() for n, v in dp.named_parameters()}
+        dp.apply_collective_grads()  # no-op single process
+        for n, v in dp.named_parameters():
+            np.testing.assert_array_equal(v.gradient, g_before[n])
+
+
+def test_data_parallel_grad_averaging_with_injected_comm():
+    """Two simulated workers with different data: after apply_collective_
+    grads with an averaging comm, both hold the mean gradient (the real
+    multi-process path runs the same code with psum as comm)."""
+    rng = np.random.RandomState(4)
+    xa = rng.randn(4, 4).astype(np.float32)
+    xb = rng.randn(4, 4).astype(np.float32)
+
+    grads = {}
+
+    def worker(x, comm):
+        with dygraph.guard():
+            net = MLP()
+            net.set_dict(init_state)
+            dp = DataParallel(net, comm=comm)
+            out = dp(dygraph.to_variable(x))
+            _mean(out * out).backward()
+            dp.apply_collective_grads()
+            return {n: np.asarray(v.gradient) for n, v in dp.named_parameters()}
+
+    with dygraph.guard():
+        init_state = {n: v.numpy().copy() for n, v in MLP().named_parameters()}
+
+    # pass 1: record local grads
+    local = {}
+    for key, x in (("a", xa), ("b", xb)):
+        local[key] = worker(x, comm=lambda g: g)
+    expected = {
+        n: (local["a"][n] + local["b"][n]) / 2.0 for n in local["a"]
+    }
+
+    # pass 2: comm that returns the true mean (simulating psum/2)
+    def mean_comm_factory(key):
+        def comm(g, _key=key):
+            name = comm._names.pop(0)
+            return (local["a"][name] + local["b"][name]) / 2.0
+
+        comm._names = list(local["a"].keys())
+        return comm
+
+    out_a = worker(xa, comm=mean_comm_factory("a"))
+    for n in expected:
+        np.testing.assert_allclose(out_a[n], expected[n], rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_shares_live_parameters():
+    """Eager weight updates after tracing must be visible to the traced
+    function, and in-program updates flow back (review finding: params
+    were frozen at trace time)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 4).astype(np.float32)
+    with dygraph.guard():
+        net = MLP()
+        sfn = to_static(lambda inp: net(inp))
+        out1 = sfn(dygraph.to_variable(x)).numpy()
+        # eagerly perturb a weight; the static path must see the change
+        w = net.fc1.weight
+        w.value = w.value + 1.0
+        out2 = sfn(dygraph.to_variable(x)).numpy()
+        eager2 = net(dygraph.to_variable(x)).numpy()
+    assert not np.allclose(out1, out2)
+    np.testing.assert_allclose(out2, eager2, rtol=1e-5, atol=1e-6)
