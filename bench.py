@@ -93,11 +93,20 @@ def main():
         (lv,) = exe.run(m, feed=data, fetch_list=[loss])
     float(np.asarray(lv).reshape(()))
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        (lv,) = exe.run(m, feed=data, fetch_list=[loss], return_numpy=False)
-    lv = float(np.asarray(lv).reshape(()))  # one sync at the end
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    profile_path = os.environ.get("BENCH_PROFILE", "")
+    ctx = (
+        fluid.profiler.profiler(state="All", profile_path=profile_path)
+        if profile_path
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (lv,) = exe.run(m, feed=data, fetch_list=[loss], return_numpy=False)
+        lv = float(np.asarray(lv).reshape(()))  # one sync at the end
+        dt = time.perf_counter() - t0
     assert np.isfinite(lv), f"loss not finite: {lv}"
 
     tokens_per_sec = batch * seq * steps / dt

@@ -18,10 +18,9 @@ SEQ_MIN, SEQ_MAX = 16, 64
 _scan_cache = {}
 
 
-def _real_samples(split, word_idx=None):
-    """Parse the reference aclImdb tarball: train|test / pos|neg / *.txt.
-    Raw token lists are cached so word_dict()/train()/test() scan the
-    tarball at most once per split."""
+def _scan_split(split):
+    """Tokenized (tokens, label) pairs for one tarball split, scanned at
+    most once per process (the single source of the parse/regex logic)."""
     import re
     import tarfile
 
@@ -37,11 +36,16 @@ def _real_samples(split, word_idx=None):
                 toks = re.findall(r"[a-z']+", text)
                 out.append((toks, 1 if mm.group(1) == "pos" else 0))
         _scan_cache[key] = out
+    return _scan_cache[key]
+
+
+def _real_samples(split, word_idx=None):
+    """Encode a tarball split with word_idx (default: word_dict())."""
     wd = word_idx if word_idx is not None else word_dict()
     unk = len(wd)
     return [
         (np.asarray([wd.get(t, unk) for t in toks], np.int64), label)
-        for toks, label in _scan_cache[key]
+        for toks, label in _scan_split(split)
     ]
 
 
@@ -54,7 +58,7 @@ def word_dict():
 
             counts = collections.Counter()
             # reuse the cached raw scan of the training split
-            for toks in _raw_train_tokens():
+            for toks, _ in _scan_split("train"):
                 counts.update(toks)
             _scan_cache["word_dict"] = {
                 w: i for i, (w, _) in enumerate(counts.most_common(VOCAB - 1))
@@ -62,24 +66,6 @@ def word_dict():
         return _scan_cache["word_dict"]
     return {f"w{i}": i for i in range(VOCAB)}
 
-
-def _raw_train_tokens():
-    """Token lists of the training split (cached by _real_samples)."""
-    import re
-    import tarfile
-
-    key = ("samples", "train")
-    if key not in _scan_cache:
-        out = []
-        with tarfile.open(CACHE) as tf:
-            for m in tf.getmembers():
-                mm = re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name)
-                if not mm:
-                    continue
-                text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
-                out.append((re.findall(r"[a-z']+", text), 1 if mm.group(1) == "pos" else 0))
-        _scan_cache[key] = out
-    return (toks for toks, _ in _scan_cache[key])
 
 
 def _synthetic(n, seed):

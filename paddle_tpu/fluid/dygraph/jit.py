@@ -142,8 +142,13 @@ class StaticFunction:
     def _sig(self, args):
         out = []
         for a in args:
-            arr = np.asarray(a.value if isinstance(a, VarBase) else a)
-            out.append((tuple(arr.shape), str(arr.dtype)))
+            v = a.value if isinstance(a, VarBase) else a
+            shape = getattr(v, "shape", None)
+            dtype = getattr(v, "dtype", None)
+            if shape is None or dtype is None:
+                v = np.asarray(v)
+                shape, dtype = v.shape, v.dtype
+            out.append((tuple(shape), str(dtype)))
         return tuple(out)
 
     def get_concrete_program(self, *args) -> ConcreteProgram:
@@ -154,6 +159,10 @@ class StaticFunction:
         return self._cache[key]
 
     def __call__(self, *args):
+        if not ProgramTranslator.get_instance().enabled:
+            # disabled translator: run the original function eagerly
+            # (reference program_translator semantics for debugging)
+            return self._fn(*args)
         from .. import executor as executor_mod
 
         cp = self.get_concrete_program(*args)
@@ -163,9 +172,6 @@ class StaticFunction:
             # values in, and pull any in-program updates back out after
             for name, vb in cp.parameter_sources.items():
                 scope.set_var(name, vb.value)
-            for name, val in cp.parameter_values.items():
-                if name not in cp.parameter_sources and scope.find_var(name) is None:
-                    scope.set_var(name, val)
             feed = {
                 v.name: np.asarray(a.value if isinstance(a, VarBase) else a)
                 for v, a in zip(cp.inputs, args)

@@ -45,13 +45,12 @@ def prepare_context(strategy=None):
 
 
 def _default_comm(grad):
-    """Mean-allreduce one gradient across the process group."""
-    import jax
+    """Sum one gradient across processes, eagerly (outside any mapped
+    computation). scale_loss already divided the loss by nranks, so the
+    summed gradient IS the global mean — no second division."""
+    from jax.experimental import multihost_utils
 
-    # multi-process jax: global devices span processes; psum over all
-    from ... import distributed as dist
-
-    return dist.all_reduce(grad, op=dist.ReduceOp.SUM) / get_world_size()
+    return multihost_utils.process_allgather(grad[None]).sum(axis=0)
 
 
 class DataParallel(Layer):

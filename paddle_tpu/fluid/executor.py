@@ -124,7 +124,12 @@ class Executor:
         key = (id(program), program._version, feed_sig, fetch_names)
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._compile(program, block, sorted(feed_arrays), fetch_names, scope)
+            from .profiler import RecordEvent
+
+            with RecordEvent("Executor::compile"):
+                compiled = self._compile(
+                    program, block, sorted(feed_arrays), fetch_names, scope
+                )
             self._cache[key] = compiled
 
         if scope._rng_key is None:
@@ -158,16 +163,20 @@ class Executor:
                 d[n] = v
             return d
 
+        from .profiler import RecordEvent
+
         donated = _load(compiled.donate_names)
         kept = _load(compiled.keep_names)
-        fetches, new_state, new_key = compiled.fn(
-            feed_arrays, donated, kept, scope._rng_key
-        )
+        with RecordEvent("Executor::run"):
+            fetches, new_state, new_key = compiled.fn(
+                feed_arrays, donated, kept, scope._rng_key
+            )
         scope._rng_key = new_key
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            with RecordEvent("Executor::fetch"):
+                return [np.asarray(f) for f in fetches]
         return list(fetches)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,202 @@
+"""Profiler: host event spans + device (XLA) trace -> chrome timeline.
+
+Parity surface: reference platform/profiler.h:126 (RecordEvent),
+EnableProfiler/DisableProfiler (:208,211), device_tracer.cc:61 (CUPTI
+capture), python profiler.py:131,198,255 (start_profiler, stop_profiler,
+profiler context manager) and tools/timeline.py (chrome trace export).
+
+TPU-native design: host spans are recorded by a Python RecordEvent (the
+executor wraps each run() in one); device-side timing comes from the JAX
+/ XLA profiler (xplane), the TPU analog of CUPTI. stop_profiler writes
+ONE chrome-trace JSON merging both (host pid 0, device pid 1 — open in
+chrome://tracing or Perfetto), prints the reference-style summary table,
+and leaves the raw xplane file beside it for xprof/tensorboard.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[tuple] = []  # (name, tid, start_ns, end_ns)
+_trace_dir: Optional[str] = None
+_device_tracing = False
+
+
+def is_profiler_enabled() -> bool:
+    return _enabled
+
+
+class RecordEvent:
+    """RAII host span (reference platform/profiler.h:126). Usable as a
+    context manager; zero cost when the profiler is off."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = 0
+
+    def __enter__(self):
+        if _enabled:
+            self._start = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self._start:
+            with _lock:
+                _events.append(
+                    (self.name, threading.get_ident(), self._start,
+                     time.perf_counter_ns())
+                )
+        return False
+
+
+def reset_profiler():
+    """reference profiler.py reset_profiler."""
+    with _lock:
+        _events.clear()
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default"):
+    """state: CPU (host spans only) | GPU/All (also start the XLA device
+    trace — 'GPU' kept for API parity, it means 'device')."""
+    global _enabled, _trace_dir, _device_tracing
+    if _enabled:
+        return
+    reset_profiler()
+    _enabled = True
+    _trace_dir = None
+    if state in ("GPU", "All"):
+        import jax
+
+        _trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+        try:
+            jax.profiler.start_trace(_trace_dir)
+            _device_tracing = True
+        except Exception:  # noqa: BLE001 — device tracing is best-effort
+            _device_tracing = False
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: str = "/tmp/profile"):
+    """Stop, print the summary table, write `<profile_path>.json` (chrome
+    trace) and leave the xplane dir (device) beside it."""
+    global _enabled, _device_tracing, _trace_dir
+    if not _enabled:
+        return
+    _enabled = False
+    if _device_tracing:
+        import jax
+
+        jax.profiler.stop_trace()
+        _device_tracing = False
+
+    events = list(_events)
+    _print_summary(events, sorted_key)
+    chrome = _host_chrome_events(events)
+    chrome += _device_chrome_events(_trace_dir)
+    out = profile_path if profile_path.endswith(".json") else profile_path + ".json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({"traceEvents": chrome, "displayTimeUnit": "ms"}, f)
+    if _trace_dir:
+        print(f"[profiler] chrome trace: {out}; raw xplane: {_trace_dir}")
+    else:
+        print(f"[profiler] chrome trace: {out}")
+    _trace_dir = None
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = "total",
+             profile_path: str = "/tmp/profile", tracer_option: str = "Default"):
+    """reference profiler.py:255 context manager."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------------------
+# summary + chrome trace assembly
+# ---------------------------------------------------------------------------
+
+
+def _print_summary(events, sorted_key):
+    agg: Dict[str, List[float]] = {}
+    for name, _tid, s, e in events:
+        agg.setdefault(name, []).append((e - s) / 1e6)
+    rows = []
+    for name, durs in agg.items():
+        rows.append((name, len(durs), sum(durs), sum(durs) / len(durs),
+                     min(durs), max(durs)))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key or "total", 2
+    )
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    if not rows:
+        return
+    print(f"{'Event':<44}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+          f"{'Min(ms)':>10}{'Max(ms)':>10}")
+    for r in rows:
+        print(f"{r[0][:43]:<44}{r[1]:>8}{r[2]:>12.3f}{r[3]:>10.3f}"
+              f"{r[4]:>10.3f}{r[5]:>10.3f}")
+
+
+def _host_chrome_events(events):
+    if not events:
+        return []
+    t0 = min(s for _, _, s, _ in events)
+    out = [{"name": "process_name", "ph": "M", "pid": 0,
+            "args": {"name": "host (python)"}}]
+    for name, tid, s, e in events:
+        out.append({
+            "name": name, "ph": "X", "pid": 0, "tid": tid % 10_000,
+            "ts": (s - t0) / 1e3, "dur": (e - s) / 1e3,
+        })
+    return out
+
+
+def _device_chrome_events(trace_dir):
+    """Parse the xplane protobuf into chrome events (device pid 1+).
+    Best-effort: returns [] when the xplane schema is unavailable."""
+    if not trace_dir:
+        return []
+    import glob
+
+    files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not files:
+        return []
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:  # noqa: BLE001 — schema unavailable: skip merge
+        return []
+    xs = xplane_pb2.XSpace()
+    with open(files[0], "rb") as f:
+        xs.ParseFromString(f.read())
+    out = []
+    pid = 1
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "CPU" not in plane.name.upper():
+            continue
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": f"device: {plane.name}"}})
+        for li, line in enumerate(plane.lines):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": li, "args": {"name": line.name or f"line{li}"}})
+            for ev in line.events:
+                meta = plane.event_metadata[ev.metadata_id]
+                start_ns = line.timestamp_ns + ev.offset_ps / 1e3
+                out.append({
+                    "name": meta.name[:120], "ph": "X", "pid": pid, "tid": li,
+                    "ts": start_ns / 1e3, "dur": ev.duration_ps / 1e6,
+                })
+        pid += 1
+    return out
