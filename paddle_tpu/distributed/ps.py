@@ -1,0 +1,161 @@
+"""Parameter-server embedding: host-resident sharded tables.
+
+Parity surface: reference operators/distributed/large_scale_kv.h,
+distributed_lookup_table op (operators/distributed_ops/), the pserver
+optimizer blocks run by listen_and_serv (distribute_transpiler.py:545),
+and the Downpour-style async update flow (distributed/communicator.h).
+
+This is the one capability XLA does not subsume (SURVEY.md §7): an
+embedding table larger than chip HBM. The table lives in HOST memory,
+row-sharded across `num_shards` shard stores (on one host these are
+in-process shards; a multi-host deployment maps shards to processes via
+the launcher env — the storage/update protocol is identical). The device
+step interacts with it through two callbacks:
+
+  gather  — forward: jax.pure_callback pulls just the looked-up rows to
+            the device ([batch, dim], never the full table)
+  update  — backward: jax.experimental.io_callback pushes the rows'
+            gradients back; the SERVER applies the optimizer (sgd or
+            adagrad per row, like the reference's pserver optimizer
+            blocks), deduplicating repeated ids within a batch
+
+Under async dispatch, step N+1's gather may observe state before step
+N's update lands — the reference's async-SGD (Downpour) semantics;
+fetch-synchronized loops (the default Executor.run) behave like sync PS.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_tables: Dict[str, "ShardedHostTable"] = {}
+_lock = threading.Lock()
+
+
+class ShardedHostTable:
+    """Row-sharded host KV: shard s owns rows with row % num_shards == s
+    (the reference's round-robin block placement, ps_dispatcher.py)."""
+
+    def __init__(
+        self,
+        name: str,
+        shape,
+        dtype: str = "float32",
+        num_shards: int = 4,
+        optimizer: str = "sgd",
+        learning_rate: float = 0.1,
+        initializer_std: Optional[float] = None,
+        seed: int = 0,
+    ):
+        self.name = name
+        self.rows, self.dim = int(shape[0]), int(shape[1])
+        self.dtype = np.dtype(dtype)
+        self.num_shards = int(num_shards)
+        self.optimizer = optimizer
+        self.learning_rate = float(learning_rate)
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported server optimizer {optimizer!r}")
+        rng = np.random.RandomState(seed)
+        std = initializer_std if initializer_std is not None else 1.0 / np.sqrt(self.dim)
+        self._shards: List[np.ndarray] = []
+        self._accum: List[Optional[np.ndarray]] = []
+        self._locks = [threading.Lock() for _ in range(self.num_shards)]
+        for s in range(self.num_shards):
+            n = (self.rows - s + self.num_shards - 1) // self.num_shards
+            self._shards.append(rng.normal(0.0, std, (n, self.dim)).astype(self.dtype))
+            self._accum.append(
+                np.zeros((n, self.dim), np.float32) if optimizer == "adagrad" else None
+            )
+
+    # -- addressing ------------------------------------------------------
+    def _locate(self, ids: np.ndarray):
+        return ids % self.num_shards, ids // self.num_shards
+
+    # -- serving ---------------------------------------------------------
+    def gather(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        shard, local = self._locate(ids)
+        out = np.empty((ids.shape[0], self.dim), self.dtype)
+        for s in range(self.num_shards):
+            m = shard == s
+            if m.any():
+                with self._locks[s]:
+                    out[m] = self._shards[s][local[m]]
+        return out
+
+    def push_gradients(self, ids, grads) -> None:
+        """Apply the server-side optimizer for the touched rows. Repeated
+        ids in one batch are accumulated first (SelectedRows merge-add
+        semantics) so the update matches a dense scatter-add gradient."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.shape[0], self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        shard, local = self._locate(uniq)
+        lr = self.learning_rate
+        for s in range(self.num_shards):
+            m = shard == s
+            if not m.any():
+                continue
+            rows = local[m]
+            g = acc[m]
+            with self._locks[s]:
+                if self.optimizer == "adagrad":
+                    self._accum[s][rows] += g * g
+                    g = g / (np.sqrt(self._accum[s][rows]) + 1e-6)
+                self._shards[s][rows] = (
+                    self._shards[s][rows].astype(np.float32) - lr * g
+                ).astype(self.dtype)
+
+    # -- introspection / checkpoint --------------------------------------
+    def nbytes(self) -> int:
+        return sum(sh.nbytes for sh in self._shards)
+
+    def to_dense(self) -> np.ndarray:
+        """Materialize the full table (tests/checkpoints only — defeats
+        the purpose in a real run)."""
+        out = np.empty((self.rows, self.dim), self.dtype)
+        for s in range(self.num_shards):
+            out[s::self.num_shards] = self._shards[s]
+        return out
+
+    def state_dict(self):
+        return {
+            "shards": self._shards,
+            "accum": self._accum,
+            "optimizer": self.optimizer,
+            "learning_rate": self.learning_rate,
+        }
+
+    def load_state_dict(self, state):
+        self._shards = [np.asarray(s, self.dtype) for s in state["shards"]]
+        self._accum = [
+            None if a is None else np.asarray(a, np.float32) for a in state["accum"]
+        ]
+
+
+def create_table(name, shape, **kw) -> ShardedHostTable:
+    with _lock:
+        if name in _tables:
+            raise ValueError(f"table {name!r} already exists")
+        t = ShardedHostTable(name, shape, **kw)
+        _tables[name] = t
+        return t
+
+
+def get_table(name) -> ShardedHostTable:
+    t = _tables.get(name)
+    if t is None:
+        raise KeyError(
+            f"host embedding table {name!r} not registered; call "
+            f"distributed.ps.create_table first"
+        )
+    return t
+
+
+def drop_table(name) -> None:
+    with _lock:
+        _tables.pop(name, None)

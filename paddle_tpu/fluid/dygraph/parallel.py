@@ -50,7 +50,7 @@ def _default_comm(grad):
     summed gradient IS the global mean — no second division."""
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(grad[None]).sum(axis=0)
+    return multihost_utils.process_allgather(grad[None], tiled=True).sum(axis=0)
 
 
 class DataParallel(Layer):

@@ -23,6 +23,7 @@ import numpy as np
 
 from . import framework
 from .dtypes import convert_dtype
+from .profiler import RecordEvent
 from ..ops import registry
 
 
@@ -124,8 +125,6 @@ class Executor:
         key = (id(program), program._version, feed_sig, fetch_names)
         compiled = self._cache.get(key)
         if compiled is None:
-            from .profiler import RecordEvent
-
             with RecordEvent("Executor::compile"):
                 compiled = self._compile(
                     program, block, sorted(feed_arrays), fetch_names, scope
@@ -162,8 +161,6 @@ class Executor:
                     v = jax.device_put(v, target)
                 d[n] = v
             return d
-
-        from .profiler import RecordEvent
 
         donated = _load(compiled.donate_names)
         kept = _load(compiled.keep_names)

@@ -16,6 +16,7 @@ import numpy as np
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "distributed_embedding",
     "sequence_mask", "sequence_pool", "sequence_first_step",
     "sequence_last_step", "sequence_softmax", "sequence_reverse",
     "sequence_expand", "sequence_expand_as", "sequence_conv",
@@ -338,3 +339,30 @@ def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None):
         attrs={"beam_size": beam_size, "end_id": end_id},
     )
     return ids, sc, parent
+
+
+def distributed_embedding(input, table_name, name=None):
+    """Look up rows of a host-resident PS table (distributed.ps) —
+    reference layers distributed_lookup_table / fleet PS embedding.
+    The table must have been created with distributed.ps.create_table;
+    its optimizer runs server-side, so the program only carries a (1,)
+    zero anchor Parameter that routes autodiff through the op."""
+    from ...distributed import ps
+    from ..initializer import ConstantInitializer
+    from ..param_attr import ParamAttr
+
+    table = ps.get_table(table_name)
+    helper = LayerHelper("distributed_embedding", name=name)
+    anchor = helper.create_parameter(
+        ParamAttr(name=f"{table_name}_anchor",
+                  initializer=ConstantInitializer(0.0)),
+        shape=[1], dtype="float32",
+    )
+    out = helper.create_variable_for_type_inference(str(np.dtype(table.dtype)))
+    helper.append_op(
+        type="distributed_lookup_table",
+        inputs={"Ids": [input], "W": [anchor]},
+        outputs={"Outputs": [out]},
+        attrs={"table_names": [table_name]},
+    )
+    return out

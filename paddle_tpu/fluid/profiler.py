@@ -98,6 +98,9 @@ def stop_profiler(sorted_key: Optional[str] = "total",
 
     events = list(_events)
     _print_summary(events, sorted_key)
+    # one time base for both pids: host spans use perf_counter_ns and the
+    # xplane uses CLOCK_REALTIME-ish ns, so anchor each side to its own
+    # first timestamp — the two tracks then align at t=0
     chrome = _host_chrome_events(events)
     chrome += _device_chrome_events(_trace_dir)
     out = profile_path if profile_path.endswith(".json") else profile_path + ".json"
@@ -182,6 +185,7 @@ def _device_chrome_events(trace_dir):
     with open(files[0], "rb") as f:
         xs.ParseFromString(f.read())
     out = []
+    raw = []
     pid = 1
     for plane in xs.planes:
         if "TPU" not in plane.name and "CPU" not in plane.name.upper():
@@ -194,9 +198,13 @@ def _device_chrome_events(trace_dir):
             for ev in line.events:
                 meta = plane.event_metadata[ev.metadata_id]
                 start_ns = line.timestamp_ns + ev.offset_ps / 1e3
-                out.append({
-                    "name": meta.name[:120], "ph": "X", "pid": pid, "tid": li,
-                    "ts": start_ns / 1e3, "dur": ev.duration_ps / 1e6,
-                })
+                raw.append((meta.name[:120], pid, li, start_ns,
+                            ev.duration_ps / 1e6))
         pid += 1
+    if not raw:
+        return out
+    t0 = min(r[3] for r in raw)
+    for name, p_, tid, start_ns, dur in raw:
+        out.append({"name": name, "ph": "X", "pid": p_, "tid": tid,
+                    "ts": (start_ns - t0) / 1e3, "dur": dur})
     return out

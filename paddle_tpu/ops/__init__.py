@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     math_ops,
     nn_ops,
     optimizer_ops,
+    ps_ops,
     recompute,
     reduce_ops,
     sequence_ops,
