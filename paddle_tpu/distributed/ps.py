@@ -71,6 +71,12 @@ class ShardedHostTable:
 
     # -- addressing ------------------------------------------------------
     def _locate(self, ids: np.ndarray):
+        if ids.size and (ids.min() < 0 or ids.max() >= self.rows):
+            bad = ids[(ids < 0) | (ids >= self.rows)][0]
+            raise IndexError(
+                f"table {self.name!r}: id {int(bad)} out of range "
+                f"[0, {self.rows}) — pad ids must be remapped before lookup"
+            )
         return ids % self.num_shards, ids // self.num_shards
 
     # -- serving ---------------------------------------------------------
@@ -123,18 +129,22 @@ class ShardedHostTable:
         return out
 
     def state_dict(self):
+        # deep copies: a checkpoint must be a snapshot, not an alias of
+        # the live shards (np.asarray with a matching dtype is a no-op)
         return {
-            "shards": self._shards,
-            "accum": self._accum,
+            "shards": [s.copy() for s in self._shards],
+            "accum": [None if a is None else a.copy() for a in self._accum],
             "optimizer": self.optimizer,
             "learning_rate": self.learning_rate,
         }
 
     def load_state_dict(self, state):
-        self._shards = [np.asarray(s, self.dtype) for s in state["shards"]]
+        self._shards = [np.array(s, self.dtype) for s in state["shards"]]
         self._accum = [
-            None if a is None else np.asarray(a, np.float32) for a in state["accum"]
+            None if a is None else np.array(a, np.float32) for a in state["accum"]
         ]
+        self.optimizer = state.get("optimizer", self.optimizer)
+        self.learning_rate = float(state.get("learning_rate", self.learning_rate))
 
 
 def create_table(name, shape, **kw) -> ShardedHostTable:

@@ -17,7 +17,9 @@ from . import (  # noqa: F401
     regularizer,
     unique_name,
 )
-from . import dataset, learning_rate_scheduler, metrics, profiler, reader  # noqa: F401
+from . import compiler, dataset, learning_rate_scheduler, metrics, profiler, reader  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401

@@ -171,10 +171,44 @@ class Executor:
         scope._rng_key = new_key
         for n, v in new_state.items():
             scope.set_var(n, v)
+        from .flags import flag
+
+        if flag("FLAGS_check_nan_inf"):
+            # reference FLAGS_check_nan_inf scans every op output
+            # (operator.cc:1020); with whole-block XLA compilation the
+            # intermediates never materialize, so the per-step contract
+            # here is: every fetch and every updated state var is finite
+            self._check_nan_inf(fetch_names, fetches, new_state)
+        if flag("FLAGS_benchmark"):
+            import jax
+
+            jax.block_until_ready(fetches)
         if return_numpy:
             with RecordEvent("Executor::fetch"):
                 return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _check_nan_inf(fetch_names, fetches, new_state):
+        import jax.numpy as jnp
+
+        def bad(v):
+            try:
+                return not bool(jnp.all(jnp.isfinite(v)))
+            except TypeError:  # non-float (ints, keys)
+                return False
+
+        for name, v in zip(fetch_names, fetches):
+            if bad(v):
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: fetch {name!r} contains NaN/Inf"
+                )
+        for name, v in new_state.items():
+            if bad(v):
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: variable {name!r} contains NaN/Inf "
+                    f"after this step"
+                )
 
     # ------------------------------------------------------------------
     def _prepare_feed(self, block, feed):

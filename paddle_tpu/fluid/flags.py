@@ -1,0 +1,80 @@
+"""Global flags registry (reference platform/flags.cc:33-485 — 27 gflags
+re-exported to Python via global_value_getter_setter.cc and settable with
+FLAGS_* environment variables).
+
+TPU-native notes: flags that tuned the CUDA allocator / cuDNN / NCCL are
+accepted for API parity but inert — PJRT owns memory and XLA owns
+collectives; each such flag documents what subsumes it. Meaningful flags
+are wired where listed.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+# flag -> (default, wired_into | None)
+_DEFS: Dict[str, tuple] = {
+    # --- wired ---
+    "FLAGS_check_nan_inf": (False, "Executor.run scans fetches + updated "
+                                   "state every step and raises naming the "
+                                   "first bad variable"),
+    "FLAGS_benchmark": (False, "Executor.run blocks until the step "
+                               "finishes (sync timing)"),
+    "FLAGS_use_flash_attention": (True, "ops/attention.py pallas gate"),
+    # --- parity, inert on TPU (subsumed) ---
+    "FLAGS_allocator_strategy": ("naive_best_fit", None),  # PJRT allocator
+    "FLAGS_fraction_of_gpu_memory_to_use": (0.92, None),
+    "FLAGS_eager_delete_tensor_gb": (0.0, None),  # XLA buffer liveness
+    "FLAGS_fuse_parameter_memory_size": (-1, None),  # XLA fusion
+    "FLAGS_cudnn_deterministic": (False, None),  # XLA is deterministic
+    "FLAGS_cpu_deterministic": (False, None),
+    "FLAGS_paddle_num_threads": (1, None),  # XLA threadpool
+    "FLAGS_inner_op_parallelism": (0, None),
+    "FLAGS_sync_nccl_allreduce": (True, None),  # ICI collectives
+    "FLAGS_enable_parallel_graph": (False, None),  # GSPMD
+}
+
+_values: Dict[str, Any] = {}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def _init_from_env():
+    for name, (default, _) in _DEFS.items():
+        raw = os.environ.get(name)
+        _values[name] = _coerce(default, raw) if raw is not None else default
+
+
+_init_from_env()
+
+
+def get_flags(flags):
+    """reference fluid.get_flags: str or list -> {flag: value}."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for n in names:
+        if n not in _values:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _values[n]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """reference fluid.set_flags."""
+    for n, v in flags.items():
+        if n not in _values:
+            raise ValueError(f"unknown flag {n!r}")
+        default = _DEFS[n][0]
+        _values[n] = _coerce(default, v) if isinstance(v, str) else type(default)(v)
+
+
+def flag(name: str):
+    return _values[name]

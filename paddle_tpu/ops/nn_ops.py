@@ -594,3 +594,37 @@ def accuracy(ctx, ins, attrs):
         "Correct": [num_correct.reshape((1,))],
         "Total": [total.reshape((1,))],
     }
+
+
+@register("auc", stop_gradient=True, no_vjp_grad=True)
+def auc(ctx, ins, attrs):
+    """Streaming ROC-AUC (reference operators/metrics/auc_op.cc): bucket
+    positive-class scores into num_thresholds bins, accumulate pos/neg
+    counts into the stat buffers, integrate by trapezoid."""
+    pred = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0].reshape(-1)
+    stat_neg = ins["StatNeg"][0].reshape(-1)
+    num_t = int(attrs.get("num_thresholds", 4095))
+    score = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
+    idx = jnp.clip((score * num_t).astype(jnp.int32), 0, num_t)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[idx].add(is_pos)
+    stat_neg = stat_neg.at[idx].add(1 - is_pos)
+    # integrate high->low threshold: x = FPR-ish cum neg, y = cum pos
+    pos_rev = jnp.cumsum(stat_pos[::-1])
+    neg_rev = jnp.cumsum(stat_neg[::-1])
+    tot_pos = pos_rev[-1]
+    tot_neg = neg_rev[-1]
+    x = jnp.concatenate([jnp.zeros(1, neg_rev.dtype), neg_rev])
+    y = jnp.concatenate([jnp.zeros(1, pos_rev.dtype), pos_rev])
+    area = jnp.sum(
+        (x[1:] - x[:-1]).astype(jnp.float32) * (y[1:] + y[:-1]).astype(jnp.float32)
+    ) / 2.0
+    denom = jnp.maximum(tot_pos * tot_neg, 1).astype(jnp.float32)
+    out = jnp.where(tot_pos * tot_neg > 0, area / denom, 0.0)
+    return {
+        "AUC": [out.reshape(1)],
+        "StatPosOut": [stat_pos.reshape(ins["StatPos"][0].shape)],
+        "StatNegOut": [stat_neg.reshape(ins["StatNeg"][0].shape)],
+    }

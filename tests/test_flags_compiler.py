@@ -1,0 +1,88 @@
+"""Flags registry, NaN/Inf auto-check, CompiledProgram, metric classes
+(reference platform/flags.cc, FLAGS_check_nan_inf, compiler.py,
+fluid/metrics.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_flags_get_set_and_env_types():
+    flags = fluid.get_flags(["FLAGS_check_nan_inf", "FLAGS_allocator_strategy"])
+    assert flags["FLAGS_check_nan_inf"] is False
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+    fluid.set_flags({"FLAGS_check_nan_inf": "0"})
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+    with pytest.raises(ValueError, match="unknown flag"):
+        fluid.set_flags({"FLAGS_no_such": 1})
+
+
+def test_check_nan_inf_raises_with_var_name():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        out = layers.log(x)  # log of negatives -> nan
+    exe = fluid.Executor()
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            with pytest.raises(FloatingPointError, match="NaN/Inf"):
+                exe.run(main, feed={"x": np.full((2, 4), -1.0, np.float32)},
+                        fetch_list=[out])
+            # clean inputs pass
+            (v,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                           fetch_list=[out])
+            assert np.isfinite(np.asarray(v)).all()
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_compiled_program_data_parallel_matches_single():
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8, 4], append_batch_size=False)
+            y = layers.data("y", [8, 1], append_batch_size=False)
+            loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xa = rng.rand(8, 4).astype(np.float32)
+    ya = xa.sum(1, keepdims=True).astype(np.float32)
+
+    def run(wrap):
+        main, startup, loss = build()
+        prog = (
+            fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+            if wrap else main
+        )
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            out = []
+            for _ in range(5):
+                (lv,) = exe.run(prog, feed={"x": xa, "y": ya}, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(())))
+        return out
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_metric_classes():
+    from paddle_tpu.fluid.metrics import Auc, Precision, Recall
+
+    preds = np.asarray([0.9, 0.8, 0.3, 0.6])
+    labels = np.asarray([1, 0, 0, 1])
+    p = Precision(); p.update(preds, labels)
+    assert p.eval() == pytest.approx(2 / 3)
+    r = Recall(); r.update(preds, labels)
+    assert r.eval() == pytest.approx(1.0)
+
+    # AUC on a clean separator = 1.0; random-ish ~0.5
+    a = Auc(num_thresholds=255)
+    a.update(np.asarray([0.9, 0.8, 0.1, 0.2]), np.asarray([1, 1, 0, 0]))
+    assert a.eval() == pytest.approx(1.0)

@@ -1574,6 +1574,38 @@ def _mrl():
     )
 
 
+@case("auc")
+def _auc():
+    rng = R(701)
+    n, nt = 50, 64
+    score = f32(rng.rand(n))
+    pred = np.stack([1 - score, score], 1)
+    label = (score + rng.randn(n) * 0.3 > 0.5).astype(np.int64)[:, None]
+    stat = np.zeros((1, nt + 1), np.int64)
+
+    def oracle(ins, a):
+        sc = ins["Predict"][0][:, 1]
+        lb = ins["Label"][0].reshape(-1)
+        sp = np.zeros(nt + 1, np.int64)
+        sn = np.zeros(nt + 1, np.int64)
+        idx = np.clip((sc * nt).astype(np.int64), 0, nt)
+        for i, l in zip(idx, lb):
+            (sp if l > 0 else sn)[i] += 1
+        pos = np.cumsum(sp[::-1]); neg = np.cumsum(sn[::-1])
+        x = np.concatenate([[0], neg]); y = np.concatenate([[0], pos])
+        area = np.sum((x[1:] - x[:-1]) * (y[1:] + y[:-1])) / 2.0
+        auc_v = f32([area / max(pos[-1] * neg[-1], 1)])
+        return {"AUC": [auc_v],
+                "StatPosOut": [sp.reshape(1, -1)],
+                "StatNegOut": [sn.reshape(1, -1)]}
+
+    return OpTest(
+        "auc", {"Predict": pred, "Label": label, "StatPos": stat, "StatNeg": stat},
+        oracle, attrs={"num_thresholds": nt},
+        outputs={"AUC": 1, "StatPosOut": 1, "StatNegOut": 1}, tol=1e-4,
+    )
+
+
 @case("accuracy")
 def _accuracy():
     idx = np.asarray([[0, 1], [2, 3], [1, 0]], np.int64)

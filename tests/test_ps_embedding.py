@@ -142,10 +142,28 @@ def test_checkpoint_roundtrip(table):
     ids = np.asarray([1, 2, 3], np.int64)
     table.push_gradients(ids, np.ones((3, 16), np.float32))
     state = table.state_dict()
+    snapshot = table.to_dense().copy()
+    # a checkpoint is a snapshot: further training must not change it
+    table.push_gradients(ids, np.ones((3, 16), np.float32))
     ps.drop_table("resume_t")
     t2 = ps.create_table("resume_t", shape=(10_000, 16), num_shards=4)
     try:
         t2.load_state_dict(state)
-        np.testing.assert_array_equal(t2.to_dense(), table.to_dense())
+        np.testing.assert_array_equal(t2.to_dense(), snapshot)
+        assert not np.allclose(t2.to_dense()[ids], table.to_dense()[ids])
+        # restored tables are independent of the source
+        t2.push_gradients(ids, np.ones((3, 16), np.float32))
+        assert not np.allclose(t2.to_dense()[ids], snapshot[ids])
+        np.testing.assert_array_equal(
+            table.to_dense()[4:100], snapshot[4:100]
+        )
     finally:
         ps.drop_table("resume_t")
+
+
+def test_out_of_range_ids_raise(table):
+    with pytest.raises(IndexError, match="out of range"):
+        table.gather(np.asarray([-1], np.int64))
+    with pytest.raises(IndexError, match="out of range"):
+        table.push_gradients(np.asarray([10_000], np.int64),
+                             np.ones((1, 16), np.float32))
