@@ -7,7 +7,7 @@ ICI/DCN; hot kernels use Pallas. See SURVEY.md for the design blueprint.
 """
 __version__ = "0.1.0"
 
-from . import dataset, fluid, hapi, ops, reader  # noqa: F401
+from . import dataset, fluid, hapi, inference, ops, reader  # noqa: F401
 from .fluid import (  # noqa: F401
     CPUPlace,
     Executor,

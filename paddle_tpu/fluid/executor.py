@@ -168,17 +168,19 @@ class Executor:
             fetches, new_state, new_key = compiled.fn(
                 feed_arrays, donated, kept, scope._rng_key
             )
-        scope._rng_key = new_key
-        for n, v in new_state.items():
-            scope.set_var(n, v)
         from .flags import flag
 
         if flag("FLAGS_check_nan_inf"):
             # reference FLAGS_check_nan_inf scans every op output
             # (operator.cc:1020); with whole-block XLA compilation the
             # intermediates never materialize, so the per-step contract
-            # here is: every fetch and every updated state var is finite
+            # here is: every fetch and every updated state var is finite.
+            # Checked BEFORE committing to the scope, so a handler can
+            # checkpoint/retry from the last good parameters
             self._check_nan_inf(fetch_names, fetches, new_state)
+        scope._rng_key = new_key
+        for n, v in new_state.items():
+            scope.set_var(n, v)
         if flag("FLAGS_benchmark"):
             import jax
 

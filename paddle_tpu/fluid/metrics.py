@@ -215,6 +215,10 @@ class Auc(MetricBase):
 
     def __init__(self, name=None, curve="ROC", num_thresholds=4095):
         super().__init__(name)
+        if curve != "ROC":
+            raise NotImplementedError(
+                f"Auc curve {curve!r}: only ROC is implemented"
+            )
         self.num_thresholds = num_thresholds
         self.reset()
 

@@ -1,0 +1,203 @@
+"""Inference stack: Config + Predictor + zero-copy tensor handles.
+
+Parity surface: reference paddle/fluid/inference/api/
+(AnalysisPredictor: analysis_predictor.h:82, AnalysisConfig:
+analysis_config.cc, ZeroCopyTensor) and paddle_infer's
+create_predictor / get_input_handle surface.
+
+TPU-native design: "analysis passes" (the reference's IR pass manager,
+TensorRT subgraph capture, MKLDNN placement) are subsumed by XLA — the
+loaded program compiles as one cached XLA computation on first run.
+Zero-copy semantics: input handles hold device arrays; share_external_
+data accepts an existing jax.Array without a host round trip; outputs
+stay on device until copy_to_cpu.
+
+The C API (reference inference/capi/) is the native shim in
+native/capi.cc: a C library embedding this module via the CPython C API.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import fluid
+
+
+class Config:
+    """AnalysisConfig parity."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._memory_optim = True
+        self._glog_info = False
+
+    def set_model(self, model_dir, params_file=None):
+        self._model_dir = model_dir
+        self._params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag  # XLA buffer liveness; accepted
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA owns graph optimization
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass  # feed/fetch glue is host-side here
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass  # device selection is JAX's; accepted for parity
+
+    def disable_gpu(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT subgraphs are a CUDA-stack concept; XLA compiles the "
+            "whole program natively on TPU — no engine delegation exists"
+        )
+
+
+class Tensor:
+    """Zero-copy tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, predictor: "Predictor", name: str, is_input: bool):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+
+    # -- input side ------------------------------------------------------
+    def copy_from_cpu(self, arr):
+        if not self._is_input:
+            raise RuntimeError(f"{self.name!r} is an output handle")
+        import jax
+
+        self._p._feed[self.name] = jax.device_put(np.ascontiguousarray(arr))
+
+    def share_external_data(self, arr):
+        """Adopt an existing (device) array without copying."""
+        if not self._is_input:
+            raise RuntimeError(f"{self.name!r} is an output handle")
+        self._p._feed[self.name] = arr
+
+    def reshape(self, shape):
+        pass  # shapes come from the array in copy_from_cpu
+
+    # -- output side -----------------------------------------------------
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            val = self._p._feed.get(self.name)
+        else:
+            val = self._p._outputs.get(self.name)
+        if val is None:
+            raise RuntimeError(f"tensor {self.name!r} has no value yet")
+        return np.asarray(val)
+
+    def shape(self):
+        return list(np.shape(self.copy_to_cpu()))
+
+
+class Predictor:
+    """AnalysisPredictor parity: load once, run many; first run compiles
+    the whole pruned program via the Executor's XLA path."""
+
+    def __init__(self, config: Config, _clone_from: Optional["Predictor"] = None):
+        self._config = config
+        self._exe = fluid.Executor()
+        if _clone_from is not None:
+            # share the scope (weights) without re-reading from disk —
+            # the reference clone's multi-instance scope sharing
+            self._scope = _clone_from._scope
+            self._program = _clone_from._program
+            self._feed_names = list(_clone_from._feed_names)
+            self._fetch_vars = _clone_from._fetch_vars
+            self._fetch_names = list(_clone_from._fetch_names)
+        else:
+            import os
+
+            dirname = config.model_dir()
+            model_filename = None
+            if config._prog_file:
+                if dirname is None:
+                    dirname = os.path.dirname(config._prog_file) or "."
+                model_filename = os.path.basename(config._prog_file)
+            if dirname is None:
+                raise ValueError(
+                    "Config needs model_dir or prog_file to locate the model"
+                )
+            self._scope = fluid.executor.Scope()
+            with fluid.scope_guard(self._scope):
+                prog, feeds, fetches = fluid.io.load_inference_model(
+                    dirname, self._exe, model_filename=model_filename,
+                    params_filename=config._params_file,
+                )
+            self._program = prog
+            self._feed_names = list(feeds)
+            self._fetch_vars = fetches
+            self._fetch_names = [
+                v.name if hasattr(v, "name") else str(v) for v in fetches
+            ]
+        self._feed: Dict[str, object] = {}
+        self._outputs: Dict[str, object] = {}
+
+    # -- reference surface ----------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name) -> Tensor:
+        if name not in self._feed_names:
+            raise KeyError(f"unknown input {name!r}")
+        return Tensor(self, name, is_input=True)
+
+    def get_output_handle(self, name) -> Tensor:
+        if name not in self._fetch_names:
+            raise KeyError(f"unknown output {name!r}")
+        return Tensor(self, name, is_input=False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """paddle_infer style: either set inputs via handles then run(),
+        or pass a positional list (old PaddlePredictor::Run)."""
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._feed[n] = np.ascontiguousarray(a)
+        missing = [n for n in self._feed_names if n not in self._feed]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        with fluid.scope_guard(self._scope):
+            outs = self._exe.run(
+                self._program, feed=dict(self._feed),
+                fetch_list=self._fetch_names, return_numpy=False,
+            )
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return [np.asarray(o) for o in outs] if inputs is not None else True
+
+    def clone(self) -> "Predictor":
+        """Share weights (scope), separate feed/fetch state — the
+        reference's multi-instance scope sharing (no disk reload)."""
+        return Predictor(self._config, _clone_from=self)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+# legacy fluid.core-style aliases
+AnalysisConfig = Config
+AnalysisPredictor = Predictor
+
+
+def create_paddle_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -22,16 +22,35 @@ _SRC = os.path.join(_HERE, "datafeed.cc")
 _lock = threading.Lock()
 
 
-def _so_path() -> str:
+def _hashed_so_path(src_path: str, stem: str) -> str:
     """Build artifact keyed by a source hash: a stale or foreign-arch
     binary can never be dlopen'd (the .so is not version-controlled)."""
     import hashlib
 
-    with open(_SRC, "rb") as f:
+    with open(src_path, "rb") as f:
         h = hashlib.sha256(f.read()).hexdigest()[:12]
     d = os.path.join(_HERE, "build")
     os.makedirs(d, exist_ok=True)
-    return os.path.join(d, f"libdatafeed-{h}.so")
+    return os.path.join(d, f"{stem}-{h}.so")
+
+
+def _build_so(src_path: str, stem: str, extra_flags=()) -> str:
+    """Compile to a temp path + atomic rename: a concurrent process can
+    never dlopen a partially written binary."""
+    so = _hashed_so_path(src_path, stem)
+    if not os.path.exists(so):
+        tmp = f"{so}.tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             *extra_flags, src_path, "-o", tmp],
+            check=True, capture_output=True, text=True,
+        )
+        os.replace(tmp, so)
+    return so
+
+
+def _so_path() -> str:
+    return _hashed_so_path(_SRC, "libdatafeed")
 _lib = None
 _build_err: str | None = None
 
@@ -42,17 +61,7 @@ def _load():
         if _lib is not None or _build_err is not None:
             return _lib
         try:
-            so = _so_path()
-            if not os.path.exists(so):
-                # compile to a temp path + atomic rename: a concurrent
-                # process can never dlopen a partially written binary
-                tmp = f"{so}.tmp.{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
-                     "-o", tmp, "-lpthread"],
-                    check=True, capture_output=True, text=True,
-                )
-                os.replace(tmp, so)
+            so = _build_so(_SRC, "libdatafeed", ("-lpthread",))
             lib = ctypes.CDLL(so)
             lib.df_create.restype = ctypes.c_void_p
             lib.df_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -207,3 +216,59 @@ def make_datafeed(ncols, batch_size, **kw):
     if native_available():
         return NativeDataFeed(ncols, batch_size, **kw)
     return PythonDataFeed(ncols, batch_size, **kw)
+
+
+# ---------------------------------------------------------------------------
+# C inference API (capi.cc): built like the datafeed, loaded on demand
+# ---------------------------------------------------------------------------
+
+_CAPI_SRC = os.path.join(_HERE, "capi.cc")
+_capi_lib = None
+_capi_err: str | None = None
+
+
+def load_capi():
+    """Build (if needed) and dlopen the C inference API with ctypes
+    signatures attached. In-process use shares the running interpreter;
+    external C/Go clients link libpython themselves."""
+    global _capi_lib, _capi_err
+    with _lock:
+        if _capi_lib is not None or _capi_err is not None:
+            return _capi_lib
+        try:
+            import sysconfig
+
+            inc = sysconfig.get_paths()["include"]
+            so = _build_so(_CAPI_SRC, "libpaddle_tpu_capi", (f"-I{inc}",))
+            lib = ctypes.CDLL(so)
+            lib.PD_PredictorCreate.restype = ctypes.c_void_p
+            lib.PD_PredictorCreate.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+            lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+            lib.PD_GetInputNum.argtypes = [ctypes.c_void_p]
+            lib.PD_GetOutputNum.argtypes = [ctypes.c_void_p]
+            for f in (lib.PD_GetInputName, lib.PD_GetOutputName):
+                f.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                              ctypes.c_char_p, ctypes.c_int]
+            lib.PD_SetInputFloat.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char_p)]
+            lib.PD_PredictorRun.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+            lib.PD_GetOutputFloat.restype = ctypes.c_longlong
+            lib.PD_GetOutputFloat.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_char_p)]
+            _capi_lib = lib
+        except Exception as e:  # noqa: BLE001 — record and report
+            _capi_err = str(e)
+        return _capi_lib
+
+
+def capi_error() -> str | None:
+    return _capi_err

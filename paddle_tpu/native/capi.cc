@@ -1,0 +1,243 @@
+// C inference API (reference paddle/fluid/inference/capi/: PD_* surface).
+//
+// TPU-native twist: the reference's C API wraps its C++ predictor core;
+// here the predictor core IS the Python inference module (whose heavy
+// lifting is XLA), so this shim embeds CPython and drives
+// paddle_tpu.inference through the C API. Intended consumers are the
+// same as the reference's: C/Go/R clients that cannot link Python
+// directly but can dlopen one .so.
+//
+// Thread-safety: every entry point takes the GIL (PyGILState_Ensure),
+// so calls may come from any thread.
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Predictor {
+  PyObject* obj;  // paddle_tpu.inference.Predictor
+};
+
+PyObject* import_attr(const char* mod, const char* attr) {
+  PyObject* m = PyImport_ImportModule(mod);
+  if (!m) return nullptr;
+  PyObject* a = PyObject_GetAttrString(m, attr);
+  Py_DECREF(m);
+  return a;
+}
+
+void set_err(const char** err, const char* msg) {
+  if (err) *err = strdup(msg);
+}
+
+void capture_py_err(const char** err) {
+  if (!PyErr_Occurred()) {
+    set_err(err, "unknown error");
+    return;
+  }
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  set_err(err, s ? PyUnicode_AsUTF8(s) : "unknown error");
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a predictor from a saved inference model directory.
+// Returns nullptr on failure (err, if non-null, receives a malloc'd
+// message the caller frees).
+void* PD_PredictorCreate(const char* model_dir, const char** err) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  void* out = nullptr;
+  PyObject* cfg_cls = import_attr("paddle_tpu.inference", "Config");
+  PyObject* create = import_attr("paddle_tpu.inference", "create_predictor");
+  if (cfg_cls && create) {
+    PyObject* cfg = PyObject_CallFunction(cfg_cls, "s", model_dir);
+    if (cfg) {
+      PyObject* pred = PyObject_CallFunctionObjArgs(create, cfg, nullptr);
+      if (pred) {
+        out = new Predictor{pred};
+      }
+      Py_DECREF(cfg);
+    }
+  }
+  if (!out) capture_py_err(err);
+  Py_XDECREF(cfg_cls);
+  Py_XDECREF(create);
+  PyGILState_Release(g);
+  return out;
+}
+
+void PD_PredictorDestroy(void* h) {
+  if (!h) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_DECREF(static_cast<Predictor*>(h)->obj);
+  delete static_cast<Predictor*>(h);
+  PyGILState_Release(g);
+}
+
+static int name_list_size(void* h, const char* method) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int n = -1;
+  PyObject* names =
+      PyObject_CallMethod(static_cast<Predictor*>(h)->obj, method, nullptr);
+  if (names) {
+    n = static_cast<int>(PyList_Size(names));
+    Py_DECREF(names);
+  } else {
+    PyErr_Clear();
+  }
+  PyGILState_Release(g);
+  return n;
+}
+
+static int name_at(void* h, const char* method, int i, char* buf, int buf_len) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ok = -1;
+  PyObject* names =
+      PyObject_CallMethod(static_cast<Predictor*>(h)->obj, method, nullptr);
+  if (names && i >= 0 && i < PyList_Size(names)) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+    if (s) {
+      std::snprintf(buf, buf_len, "%s", s);
+      ok = 0;
+    }
+  }
+  if (!names) PyErr_Clear();
+  Py_XDECREF(names);
+  PyGILState_Release(g);
+  return ok;
+}
+
+int PD_GetInputNum(void* h) { return name_list_size(h, "get_input_names"); }
+int PD_GetOutputNum(void* h) { return name_list_size(h, "get_output_names"); }
+int PD_GetInputName(void* h, int i, char* buf, int buf_len) {
+  return name_at(h, "get_input_names", i, buf, buf_len);
+}
+int PD_GetOutputName(void* h, int i, char* buf, int buf_len) {
+  return name_at(h, "get_output_names", i, buf, buf_len);
+}
+
+// Set a float32 input by name. shape is int64[ndim].
+int PD_SetInputFloat(void* h, const char* name, const float* data,
+                     const long long* shape, int ndim, const char** err) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  // build a numpy array via the buffer-less path: list-of-shape + frombuffer
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np) {
+    long long total = 1;
+    for (int i = 0; i < ndim; ++i) total *= shape[i];
+    PyObject* mem = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(data)),
+        total * sizeof(float), PyBUF_READ);
+    PyObject* flat =
+        mem ? PyObject_CallMethod(np, "frombuffer", "Os", mem, "float32")
+            : nullptr;
+    PyObject* shp = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+      PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+    PyObject* arr =
+        flat ? PyObject_CallMethod(flat, "reshape", "O", shp) : nullptr;
+    if (arr) {
+      PyObject* handle = PyObject_CallMethod(
+          static_cast<Predictor*>(h)->obj, "get_input_handle", "s", name);
+      if (handle) {
+        PyObject* r =
+            PyObject_CallMethod(handle, "copy_from_cpu", "O", arr);
+        if (r) {
+          rc = 0;
+          Py_DECREF(r);
+        }
+        Py_DECREF(handle);
+      }
+    }
+    Py_XDECREF(arr);
+    Py_XDECREF(shp);
+    Py_XDECREF(flat);
+    Py_XDECREF(mem);
+    Py_DECREF(np);
+  }
+  if (rc != 0) capture_py_err(err);
+  PyGILState_Release(g);
+  return rc;
+}
+
+int PD_PredictorRun(void* h, const char** err) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r =
+      PyObject_CallMethod(static_cast<Predictor*>(h)->obj, "run", nullptr);
+  if (r) {
+    rc = 0;
+    Py_DECREF(r);
+  } else {
+    capture_py_err(err);
+  }
+  PyGILState_Release(g);
+  return rc;
+}
+
+// Copy a float32 output into buf (capacity buf_len floats). Returns the
+// number of elements, fills shape[0..*ndim) (capacity max_ndim).
+long long PD_GetOutputFloat(void* h, const char* name, float* buf,
+                            long long buf_len, long long* shape, int max_ndim,
+                            int* ndim, const char** err) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  long long n = -1;
+  PyObject* handle = PyObject_CallMethod(static_cast<Predictor*>(h)->obj,
+                                         "get_output_handle", "s", name);
+  PyObject* arr =
+      handle ? PyObject_CallMethod(handle, "copy_to_cpu", nullptr) : nullptr;
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (arr && np) {
+    PyObject* c = PyObject_CallMethod(np, "ascontiguousarray", "Os", arr,
+                                      "float32");
+    if (c) {
+      PyObject* shp = PyObject_GetAttrString(c, "shape");
+      int nd = static_cast<int>(PyTuple_Size(shp));
+      long long total = 1;
+      for (int i = 0; i < nd; ++i) {
+        long long d = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+        if (i < max_ndim) shape[i] = d;
+        total *= d;
+      }
+      if (ndim) *ndim = nd;
+      if (buf == nullptr) {
+        // size-query mode: fill shape/ndim, report the element count
+        n = total;
+      } else if (total > buf_len) {
+        set_err(err, "output buffer too small; call with buf=NULL to "
+                     "query the required element count");
+        PyErr_Clear();
+      } else {
+        PyObject* tob = PyObject_CallMethod(c, "tobytes", nullptr);
+        if (tob) {
+          std::memcpy(buf, PyBytes_AsString(tob),
+                      total * sizeof(float));
+          n = total;
+          Py_DECREF(tob);
+        }
+      }
+      Py_DECREF(shp);
+      Py_DECREF(c);
+    }
+  }
+  if (n < 0 && (err == nullptr || *err == nullptr)) capture_py_err(err);
+  Py_XDECREF(np);
+  Py_XDECREF(arr);
+  Py_XDECREF(handle);
+  PyGILState_Release(g);
+  return n;
+}
+
+}  // extern "C"

@@ -51,6 +51,10 @@ FORCE_PALLAS = False
 
 
 def _use_pallas(q):
+    from ..fluid.flags import flag
+
+    if not flag("FLAGS_use_flash_attention"):
+        return False
     dh = q.shape[-1]
     # MXU-friendly head dims only; otherwise XLA fusion is competitive
     shapes_ok = dh in (64, 128, 256) and q.shape[2] % 128 == 0

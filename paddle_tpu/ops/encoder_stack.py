@@ -261,6 +261,10 @@ def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer):
 
 
 def _flash_ok(s, dh):
+    from ..fluid.flags import flag
+
+    if not flag("FLAGS_use_flash_attention"):
+        return False
     if jax.default_backend() not in ("tpu", "axon"):
         from . import attention
 

@@ -1,0 +1,125 @@
+"""Inference stack: Config/Predictor/zero-copy handles + the C API
+(reference analysis_predictor.h:82, inference/capi/)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu import inference
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    """Train a small model and export it."""
+    path = str(tmp_path_factory.mktemp("model") / "infer")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        y = layers.data("y", [4, 1], append_batch_size=False)
+        hidden = layers.fc(x, 16, act="relu")
+        pred = layers.fc(hidden, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xa = rng.rand(4, 8).astype(np.float32)
+        ya = xa.sum(1, keepdims=True).astype(np.float32)
+        for _ in range(20):
+            exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[loss])
+        fluid.io.save_inference_model(path, ["x"], [pred], exe, main_program=main)
+        (expected,) = exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[pred])
+    return path, xa, np.asarray(expected)
+
+
+def test_predictor_handles_roundtrip(saved_model):
+    path, xa, expected = saved_model
+    config = inference.Config(path)
+    pred = inference.create_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    assert len(pred.get_output_names()) == 1
+
+    inp = pred.get_input_handle("x")
+    inp.copy_from_cpu(xa)
+    assert pred.run() is True
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), expected, rtol=1e-5, atol=1e-6)
+    assert out.shape() == [4, 1]
+
+    # positional run (legacy PaddlePredictor::Run)
+    (o2,) = pred.run([xa])
+    np.testing.assert_allclose(o2, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_shares_weights(saved_model):
+    path, xa, expected = saved_model
+    p1 = inference.create_predictor(inference.Config(path))
+    p2 = p1.clone()
+    (o2,) = p2.run([xa])
+    np.testing.assert_allclose(o2, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_share_external_data_device_array(saved_model):
+    import jax
+
+    path, xa, expected = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    dev = jax.device_put(xa)
+    pred.get_input_handle("x").share_external_data(dev)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_tensorrt_raises():
+    with pytest.raises(NotImplementedError, match="XLA"):
+        inference.Config("/tmp/x").enable_tensorrt_engine()
+
+
+def test_c_api_end_to_end(saved_model):
+    from paddle_tpu import native
+
+    lib = native.load_capi()
+    if lib is None:
+        pytest.fail(f"C API failed to build: {native.capi_error()}")
+    path, xa, expected = saved_model
+
+    err = ctypes.c_char_p()
+    h = lib.PD_PredictorCreate(path.encode(), ctypes.byref(err))
+    assert h, err.value
+    try:
+        assert lib.PD_GetInputNum(h) == 1
+        assert lib.PD_GetOutputNum(h) == 1
+        buf = ctypes.create_string_buffer(256)
+        assert lib.PD_GetInputName(h, 0, buf, 256) == 0
+        assert buf.value == b"x"
+        assert lib.PD_GetOutputName(h, 0, buf, 256) == 0
+        out_name = buf.value
+
+        arr = np.ascontiguousarray(xa)
+        shape = (ctypes.c_longlong * 2)(4, 8)
+        rc = lib.PD_SetInputFloat(
+            h, b"x", arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            shape, 2, ctypes.byref(err),
+        )
+        assert rc == 0, err.value
+        assert lib.PD_PredictorRun(h, ctypes.byref(err)) == 0, err.value
+
+        out = (ctypes.c_float * 8)()
+        oshape = (ctypes.c_longlong * 4)()
+        ndim = ctypes.c_int()
+        n = lib.PD_GetOutputFloat(
+            h, out_name, out, 8, oshape, 4, ctypes.byref(ndim),
+            ctypes.byref(err),
+        )
+        assert n == 4, err.value
+        assert ndim.value == 2 and list(oshape[:2]) == [4, 1]
+        np.testing.assert_allclose(
+            np.asarray(out[:4]).reshape(4, 1), expected, rtol=1e-5, atol=1e-5
+        )
+    finally:
+        lib.PD_PredictorDestroy(h)
