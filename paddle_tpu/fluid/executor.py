@@ -122,12 +122,20 @@ class Executor:
         feed_sig = tuple(
             (n, tuple(a.shape), str(a.dtype)) for n, a in sorted(feed_arrays.items())
         )
-        key = (id(program), program._version, feed_sig, fetch_names)
+        from .flags import flag
+
+        # the nan/inf debugging mode disables buffer donation (donated
+        # buffers are destroyed by the step, which would make "recover
+        # the last good parameters after the raise" impossible), so the
+        # compile cache must distinguish the two modes
+        check_nan = flag("FLAGS_check_nan_inf")
+        key = (id(program), program._version, feed_sig, fetch_names, check_nan)
         compiled = self._cache.get(key)
         if compiled is None:
             with RecordEvent("Executor::compile"):
                 compiled = self._compile(
-                    program, block, sorted(feed_arrays), fetch_names, scope
+                    program, block, sorted(feed_arrays), fetch_names, scope,
+                    donate=not check_nan,
                 )
             self._cache[key] = compiled
 
@@ -168,9 +176,7 @@ class Executor:
             fetches, new_state, new_key = compiled.fn(
                 feed_arrays, donated, kept, scope._rng_key
             )
-        from .flags import flag
-
-        if flag("FLAGS_check_nan_inf"):
+        if check_nan:
             # reference FLAGS_check_nan_inf scans every op output
             # (operator.cc:1020); with whole-block XLA compilation the
             # intermediates never materialize, so the per-step contract
@@ -236,7 +242,8 @@ class Executor:
                 out[name] = np.asarray(value)
         return out
 
-    def _compile(self, program, block, feed_names, fetch_names, scope):
+    def _compile(self, program, block, feed_names, fetch_names, scope,
+                 donate=True):
         import jax
 
         ops = list(block.ops)
@@ -316,7 +323,7 @@ class Executor:
             )
             jit_fn = jax.jit(
                 fn,
-                donate_argnums=(1,),
+                donate_argnums=(1,) if donate else (),
                 in_shardings=in_shardings,
                 out_shardings=out_shardings,
             )
@@ -325,7 +332,7 @@ class Executor:
             )
             cb.state_shardings = {n: sh(n) for n in donate_names + keep_names}
             return cb
-        jit_fn = jax.jit(fn, donate_argnums=(1,))
+        jit_fn = jax.jit(fn, donate_argnums=(1,) if donate else ())
         return _CompiledBlock(
             jit_fn, list(feed_names), donate_names, keep_names, state_out, fetch_names
         )

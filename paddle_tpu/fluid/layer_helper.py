@@ -164,3 +164,27 @@ class LayerHelper:
             attrs=act,
         )
         return tmp
+
+
+def emit_op(op_type, ins, attrs=None, out_slots=("Out",), out_dtype=None):
+    """Emit one op in the CURRENT mode: static (appends to the default
+    program via LayerHelper) or dygraph (runs the registered emitter
+    eagerly through the tracer). The shared backend for
+    paddle_tpu.nn.functional and the thin 2.0 tensor wrappers."""
+    from . import framework
+
+    attrs = attrs or {}
+    if framework.in_dygraph_mode():
+        from .dygraph.base import _trace_op
+
+        outs = _trace_op(op_type, ins, attrs, list(out_slots))
+        return outs[0] if len(outs) == 1 else outs
+    helper = LayerHelper(op_type)
+    ref = next(v for vs in ins.values() for v in vs)
+    outs = {
+        s: [helper.create_variable_for_type_inference(out_dtype or ref.dtype)]
+        for s in out_slots
+    }
+    helper.append_op(type=op_type, inputs=ins, outputs=outs, attrs=attrs)
+    flat = [outs[s][0] for s in out_slots]
+    return flat[0] if len(flat) == 1 else flat

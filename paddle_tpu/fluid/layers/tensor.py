@@ -401,3 +401,147 @@ def isfinite_v2(x, name=None):
     helper.append_op(type="isfinite_v2", inputs={"X": [x]}, outputs={"Out": [out]})
     out.stop_gradient = True
     return out
+
+
+# ---------------------------------------------------------------------------
+# thin wrappers for registered ops that the 2.0 tensor namespace re-exports
+# (reference python/paddle/tensor/* emits the same op types)
+# ---------------------------------------------------------------------------
+
+
+def _unary_layer(op_type, x, attrs=None, out_dtype=None, in_slot="X",
+                 out_slot="Out"):
+    from ..layer_helper import emit_op
+
+    return emit_op(op_type, {in_slot: [x]}, attrs, out_slots=(out_slot,),
+                   out_dtype=out_dtype)
+
+
+def tile(x, repeat_times, name=None):
+    return _unary_layer("tile", x, {"repeat_times": list(repeat_times)})
+
+
+def flip(x, axis, name=None):
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return _unary_layer("flip", x, {"axis": axis})
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = [shifts] if isinstance(shifts, int) else list(shifts)
+    if axis is not None:
+        axis = [axis] if isinstance(axis, int) else list(axis)
+    return _unary_layer("roll", x, {"shifts": shifts, "axis": axis or []})
+
+
+def tril(x, diagonal=0, name=None):
+    return _unary_layer("tril_triu", x, {"lower": True, "diagonal": diagonal})
+
+
+def triu(x, diagonal=0, name=None):
+    return _unary_layer("tril_triu", x, {"lower": False, "diagonal": diagonal})
+
+
+def meshgrid(*args, name=None):
+    inputs = list(args[0]) if len(args) == 1 and isinstance(args[0], (list, tuple)) else list(args)
+    helper = LayerHelper("meshgrid")
+    outs = [
+        helper.create_variable_for_type_inference(inputs[0].dtype)
+        for _ in inputs
+    ]
+    helper.append_op(
+        type="meshgrid", inputs={"X": inputs}, outputs={"Out": outs}, attrs={}
+    )
+    return outs
+
+
+def index_select(x, index, axis=0, name=None):
+    helper = LayerHelper("index_select")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="index_select", inputs={"X": [x], "Index": [index]},
+        outputs={"Out": [out]}, attrs={"dim": axis},
+    )
+    return out
+
+
+def take_along_axis(x, indices, axis, name=None):
+    helper = LayerHelper("take_along_axis")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="take_along_axis", inputs={"Input": [x], "Index": [indices]},
+        outputs={"Result": [out]}, attrs={"Axis": axis},
+    )
+    return out
+
+
+def unbind(x, axis=0, name=None):
+    helper = LayerHelper("unbind")
+    n = x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(n)]
+    helper.append_op(
+        type="unbind", inputs={"X": [x]}, outputs={"Out": outs},
+        attrs={"axis": axis},
+    )
+    return outs
+
+
+def _binary_layer(op_type, x, y, attrs=None, x_slot="X", y_slot="Y"):
+    from ..layer_helper import emit_op
+
+    return emit_op(op_type, {x_slot: [x], y_slot: [y]}, attrs)
+
+
+def dot(x, y, name=None):
+    return _binary_layer("dot", x, y)
+
+
+def kron(x, y, name=None):
+    return _binary_layer("kron", x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    helper = LayerHelper("addmm")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="addmm", inputs={"Input": [input], "X": [x], "Y": [y]},
+        outputs={"Out": [out]}, attrs={"Alpha": alpha, "Beta": beta},
+    )
+    return out
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _unary_layer(
+        "trace", x, {"offset": offset, "axis1": axis1, "axis2": axis2},
+        in_slot="Input",
+    )
+
+
+def cholesky(x, upper=False, name=None):
+    return _unary_layer("cholesky", x, {"upper": upper})
+
+
+def inverse(x, name=None):
+    return _unary_layer("inverse", x, in_slot="Input", out_slot="Output")
+
+
+def matrix_power(x, n, name=None):
+    return _unary_layer("matrix_power", x, {"n": n})
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    helper = LayerHelper("allclose")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(
+        type="allclose", inputs={"Input": [x], "Other": [y]},
+        outputs={"Out": [out]},
+        attrs={"rtol": rtol, "atol": atol, "equal_nan": equal_nan},
+    )
+    return out
+
+
+def isnan_v2(x, name=None):
+    return _unary_layer("isnan_v2", x, out_dtype="bool")
+
+
+def isinf_v2(x, name=None):
+    return _unary_layer("isinf_v2", x, out_dtype="bool")

@@ -171,6 +171,11 @@ class Predictor:
         """paddle_infer style: either set inputs via handles then run(),
         or pass a positional list (old PaddlePredictor::Run)."""
         if inputs is not None:
+            if len(inputs) != len(self._feed_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs, model has "
+                    f"{len(self._feed_names)}: {self._feed_names}"
+                )
             for n, a in zip(self._feed_names, inputs):
                 self._feed[n] = np.ascontiguousarray(a)
         missing = [n for n in self._feed_names if n not in self._feed]

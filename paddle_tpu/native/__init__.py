@@ -40,9 +40,13 @@ def _build_so(src_path: str, stem: str, extra_flags=()) -> str:
     so = _hashed_so_path(src_path, stem)
     if not os.path.exists(so):
         tmp = f"{so}.tmp.{os.getpid()}"
+        # -I flags may precede the source; -l libraries must FOLLOW it
+        # (with --as-needed defaults, libs listed first are dropped)
+        incs = [f for f in extra_flags if not f.startswith("-l")]
+        libs = [f for f in extra_flags if f.startswith("-l")]
         subprocess.run(
             ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-             *extra_flags, src_path, "-o", tmp],
+             *incs, src_path, "-o", tmp, *libs],
             check=True, capture_output=True, text=True,
         )
         os.replace(tmp, so)

@@ -56,6 +56,7 @@ extern "C" {
 // Returns nullptr on failure (err, if non-null, receives a malloc'd
 // message the caller frees).
 void* PD_PredictorCreate(const char* model_dir, const char** err) {
+  if (err) *err = nullptr;
   PyGILState_STATE g = PyGILState_Ensure();
   void* out = nullptr;
   PyObject* cfg_cls = import_attr("paddle_tpu.inference", "Config");
@@ -130,6 +131,7 @@ int PD_GetOutputName(void* h, int i, char* buf, int buf_len) {
 // Set a float32 input by name. shape is int64[ndim].
 int PD_SetInputFloat(void* h, const char* name, const float* data,
                      const long long* shape, int ndim, const char** err) {
+  if (err) *err = nullptr;
   PyGILState_STATE g = PyGILState_Ensure();
   int rc = -1;
   // build a numpy array via the buffer-less path: list-of-shape + frombuffer
@@ -146,8 +148,13 @@ int PD_SetInputFloat(void* h, const char* name, const float* data,
     PyObject* shp = PyTuple_New(ndim);
     for (int i = 0; i < ndim; ++i)
       PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
-    PyObject* arr =
+    PyObject* view =
         flat ? PyObject_CallMethod(flat, "reshape", "O", shp) : nullptr;
+    // force a real copy: the view aliases the caller's buffer, which the
+    // caller may free or reuse right after this call returns
+    PyObject* arr =
+        view ? PyObject_CallMethod(np, "array", "O", view) : nullptr;
+    Py_XDECREF(view);
     if (arr) {
       PyObject* handle = PyObject_CallMethod(
           static_cast<Predictor*>(h)->obj, "get_input_handle", "s", name);
@@ -173,6 +180,7 @@ int PD_SetInputFloat(void* h, const char* name, const float* data,
 }
 
 int PD_PredictorRun(void* h, const char** err) {
+  if (err) *err = nullptr;
   PyGILState_STATE g = PyGILState_Ensure();
   int rc = -1;
   PyObject* r =
@@ -192,6 +200,7 @@ int PD_PredictorRun(void* h, const char** err) {
 long long PD_GetOutputFloat(void* h, const char* name, float* buf,
                             long long buf_len, long long* shape, int max_ndim,
                             int* ndim, const char** err) {
+  if (err) *err = nullptr;
   PyGILState_STATE g = PyGILState_Ensure();
   long long n = -1;
   PyObject* handle = PyObject_CallMethod(static_cast<Predictor*>(h)->obj,

@@ -1,0 +1,95 @@
+"""paddle.nn 2.0-preview namespace (reference python/paddle/nn/ ~5.3k:
+layer classes + functional). Layer classes are the dygraph Layers (which
+also reach the static executor via dygraph-to-static); `functional`
+exposes the op-level API for both modes.
+"""
+from __future__ import annotations
+
+from ..fluid.dygraph.layers import Layer  # noqa: F401
+from ..fluid.dygraph.nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from ..fluid.dygraph.parallel import DataParallel  # noqa: F401
+from . import functional  # noqa: F401
+
+
+class Sequential(Layer):
+    """Chain of sublayers (reference paddle.nn.Sequential)."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        self._seq = []
+        for i, l in enumerate(layers):
+            if isinstance(l, tuple):
+                name, l = l
+            else:
+                name = str(i)
+            self.add_sublayer(name, l)
+            self._seq.append(l)
+
+    def forward(self, x):
+        for l in self._seq:
+            x = l(x)
+        return x
+
+    def __len__(self):
+        return len(self._seq)
+
+    def __getitem__(self, i):
+        return self._seq[i]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return functional.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return functional.tanh(x)
+
+
+class GELU(Layer):
+    def forward(self, x):
+        return functional.gelu(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, axis=self._axis)
+
+
+class CrossEntropyLoss(Layer):
+    """softmax_with_cross_entropy + mean (reference nn.CrossEntropyLoss)."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, logits, label):
+        loss = functional.cross_entropy(logits, label, reduction=self._reduction)
+        return loss
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, pred, label):
+        return functional.mse_loss(pred, label, reduction=self._reduction)

@@ -1,0 +1,172 @@
+"""paddle.nn.functional (reference python/paddle/nn/functional/): the
+op-level NN API with 2.0 signatures, usable in BOTH modes — static graph
+(emits ops into the current Program) and dygraph (runs the same
+registered emitters eagerly through the tracer)."""
+from __future__ import annotations
+
+from ..fluid.layer_helper import emit_op as _op
+
+
+def _unary(op_type, **fixed):
+    def fn(x, name=None, **kw):
+        return _op(op_type, {"X": [x]}, {**fixed, **kw})
+
+    fn.__name__ = op_type
+    return fn
+
+
+relu = _unary("relu")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+gelu = _unary("gelu")
+elu = _unary("elu")
+silu = _unary("silu")
+softplus = _unary("softplus")
+mish = _unary("mish")
+hardswish = hard_swish = _unary("hard_swish")
+hardsigmoid = hard_sigmoid = _unary("hard_sigmoid")
+swish = _unary("swish")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _op("leaky_relu", {"X": [x]}, {"alpha": negative_slope})
+
+
+def softmax(x, axis=-1, name=None):
+    return _op("softmax", {"X": [x]}, {"axis": axis})
+
+
+def log_softmax(x, axis=-1, name=None):
+    return _op("log_softmax", {"X": [x]}, {"axis": axis})
+
+
+def dropout(x, p=0.5, training=True, name=None):
+    out = _op(
+        "dropout", {"X": [x]},
+        {"dropout_prob": p, "is_test": not training,
+         "dropout_implementation": "upscale_in_train"},
+        out_slots=("Out", "Mask"),
+    )
+    return out[0]
+
+
+def linear(x, weight, bias=None, name=None):
+    out = _op("matmul", {"X": [x], "Y": [weight]})
+    if bias is not None:
+        out = _op("elementwise_add", {"X": [out], "Y": [bias]}, {"axis": -1})
+    return out
+
+
+def embedding(x, weight, padding_idx=None, name=None):
+    return _op(
+        "lookup_table_v2", {"W": [weight], "Ids": [x]},
+        {"padding_idx": -1 if padding_idx is None else padding_idx},
+    )
+
+
+def one_hot(x, num_classes, name=None):
+    return _op("one_hot_v2", {"X": [x]}, {"depth": num_classes},
+               out_dtype="float32")
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return _op("mean", {"X": [loss]})
+    if reduction == "sum":
+        return _op("reduce_sum", {"X": [loss]},
+                   {"reduce_all": True, "keep_dim": False, "dim": [0]})
+    return loss
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  reduction="mean", name=None):
+    outs = _op(
+        "softmax_with_cross_entropy",
+        {"Logits": [input], "Label": [label]},
+        {"soft_label": soft_label, "ignore_index": ignore_index},
+        out_slots=("Softmax", "Loss"),
+    )
+    return _reduce_loss(outs[1], reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    loss = _op("square_error_cost", {"X": [input], "Y": [label]})
+    return _reduce_loss(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    diff = _op("elementwise_sub", {"X": [input], "Y": [label]}, {"axis": -1})
+    loss = _op("abs", {"X": [diff]})
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean",
+                                     name=None):
+    loss = _op(
+        "sigmoid_cross_entropy_with_logits",
+        {"X": [logit], "Label": [label]}, {},
+    )
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return _op("kldiv_loss", {"X": [input], "Target": [label]},
+               {"reduction": reduction}, out_slots=("Loss",))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _op("norm", {"X": [x]}, {"axis": axis, "epsilon": epsilon},
+               out_slots=("Out", "Norm"))[0]
+
+
+def pad(x, paddings, value=0.0, name=None):
+    return _op("pad", {"X": [x]},
+               {"paddings": list(paddings), "pad_value": value})
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           name=None):
+    s = [stride] * 2 if isinstance(stride, int) else list(stride)
+    p = [padding] * 2 if isinstance(padding, int) else list(padding)
+    d = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+    out = _op(
+        "conv2d", {"Input": [x], "Filter": [weight]},
+        {"strides": s, "paddings": p, "dilations": d, "groups": groups},
+        out_slots=("Output",),
+    )
+    if bias is not None:
+        out = _op("elementwise_add", {"X": [out], "Y": [bias]}, {"axis": 1})
+    return out
+
+
+def _pool2d(x, kernel_size, stride, padding, ptype):
+    ks = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
+    st = stride if stride is not None else kernel_size
+    st = [st] * 2 if isinstance(st, int) else list(st)
+    pd = [padding] * 2 if isinstance(padding, int) else list(padding)
+    return _op(
+        "pool2d", {"X": [x]},
+        {"pooling_type": ptype, "ksize": ks, "strides": st, "paddings": pd},
+    )
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, name=None):
+    return _pool2d(x, kernel_size, stride, padding, "avg")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, name=None):
+    return _pool2d(x, kernel_size, stride, padding, "max")
+
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return _op(
+        "layer_norm", ins,
+        {"epsilon": epsilon, "begin_norm_axis": len(x.shape) - 1},
+        out_slots=("Y", "Mean", "Variance"),
+    )[0]

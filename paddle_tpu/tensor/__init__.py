@@ -1,0 +1,173 @@
+"""paddle.tensor 2.0-preview namespace (reference python/paddle/tensor/:
+creation.py, math.py, manipulation.py, search.py, logic.py, linalg.py,
+stat.py — ~5.7k LoC of re-exports and signature modernization over
+fluid.layers).
+
+Same role here: 2.0-style names/signatures (axis= instead of dim=,
+keepdim= instead of keep_dim=) emitting the same registered ops in
+STATIC-GRAPH mode. For eager code use paddle_tpu.nn.functional (its
+emitter dispatches per mode) or the dygraph VarBase operators.
+"""
+from __future__ import annotations
+
+from ..fluid import layers as L
+from ..fluid.layers import (  # noqa: F401 — direct re-exports
+    cast, concat, gather, gather_nd, scatter, scatter_nd_add, reshape,
+    transpose, squeeze, unsqueeze, stack, unstack, split, expand_as, tile,
+    flip, roll, where, argsort, clip, zeros, ones, zeros_like, ones_like,
+    full_like, linspace, eye, arange, meshgrid, diag, tril, triu, cumsum,
+    index_select, one_hot, topk, matmul, dot, kron, addmm, trace, cholesky,
+    inverse, matrix_power, allclose, equal, not_equal, less_than, less_equal,
+    greater_than, greater_equal, logical_and, logical_or, logical_xor,
+    logical_not, isfinite_v2 as isfinite, isnan_v2 as isnan, isinf_v2 as isinf,
+    abs, exp, log, log2, log10, log1p, sqrt, rsqrt, square, sign, sin, cos,
+    tan, asin, acos, atan, sinh, cosh, erf, floor, ceil, round, reciprocal,
+    tanh, sigmoid, increment, unbind, take_along_axis, flatten,
+)
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    return L.fill_constant(shape, dtype, fill_value)
+
+
+def add(x, y, name=None):
+    return L.elementwise_add(x, y)
+
+
+def subtract(x, y, name=None):
+    return L.elementwise_sub(x, y)
+
+
+def multiply(x, y, name=None):
+    return L.elementwise_mul(x, y)
+
+
+def divide(x, y, name=None):
+    return L.elementwise_div(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return L.elementwise_floordiv(x, y)
+
+
+def remainder(x, y, name=None):
+    return L.elementwise_mod(x, y)
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return L.pow(x, factor=float(y))
+    return L.elementwise_pow(x, y)
+
+
+def maximum(x, y, name=None):
+    return L.elementwise_max(x, y)
+
+
+def minimum(x, y, name=None):
+    return L.elementwise_min(x, y)
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    return [axis] if isinstance(axis, int) else list(axis)
+
+
+def _reduce(fn, x, axis, keepdim):
+    return fn(x, dim=_axes(axis), keep_dim=keepdim)
+
+
+def sum(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(L.reduce_sum, x, axis, keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce(L.reduce_mean, x, axis, keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(L.reduce_max, x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(L.reduce_min, x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, name=None):
+    return _reduce(L.reduce_prod, x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(L.reduce_all, x, axis, keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(L.reduce_any, x, axis, keepdim)
+
+
+def argmax(x, axis=0, keepdim=False, name=None):
+    return L.argmax(x, axis=axis)
+
+
+def argmin(x, axis=0, keepdim=False, name=None):
+    return L.argmin(x, axis=axis)
+
+
+def norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    from ..fluid.layer_helper import LayerHelper
+
+    if axis is None:
+        helper = LayerHelper("frobenius_norm", name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(
+            type="frobenius_norm", inputs={"X": [x]}, outputs={"Out": [out]},
+            attrs={"reduce_all": True, "keep_dim": keepdim},
+        )
+        return out
+    helper = LayerHelper("p_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="p_norm", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"porder": float(p), "axis": int(axis), "keepdim": keepdim},
+    )
+    return out
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("logsumexp", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="logsumexp", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"axis": _axes(axis) or [], "keepdim": keepdim},
+    )
+    return out
+
+
+def bmm(x, y, name=None):
+    return L.matmul(x, y)
+
+
+def t(x, name=None):
+    return L.transpose(x, list(range(len(x.shape)))[::-1])
+
+
+def numel(x, name=None):
+    import numpy as np
+
+    dims = list(x.shape or ())
+    if any(d < 0 for d in dims):
+        raise ValueError(
+            f"numel needs fully static dims, got {tuple(dims)}"
+        )
+    return L.fill_constant([1], "int64", int(np.prod(dims)))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return L.scale(x, scale=scale, bias=bias, bias_after_scale=bias_after_scale,
+                   act=act)

@@ -1,0 +1,123 @@
+"""paddle.tensor / paddle.nn 2.0-preview namespaces (reference
+python/paddle/tensor + python/paddle/nn)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph, layers
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds, fetch_list=fetch)
+    return [np.asarray(o) for o in outs]
+
+
+def test_tensor_namespace_numerics():
+    rng = np.random.RandomState(0)
+    xa = rng.rand(3, 4).astype(np.float32) + 0.5
+    ya = rng.rand(3, 4).astype(np.float32) + 0.5
+
+    def build():
+        x = layers.data("x", [3, 4], append_batch_size=False)
+        y = layers.data("y", [3, 4], append_batch_size=False)
+        return [
+            paddle.add(x, y),
+            paddle.multiply(x, y),
+            paddle.sum(x, axis=1),
+            paddle.mean(x),
+            paddle.max(x, axis=0, keepdim=True),
+            paddle.pow(x, 2),
+            paddle.norm(x, axis=1),
+            paddle.matmul(x, paddle.t(y)),
+            paddle.tril(x),
+            paddle.logsumexp(x, axis=1),
+        ]
+
+    outs = _run(build, {"x": xa, "y": ya})
+    np.testing.assert_allclose(outs[0], xa + ya, rtol=1e-5)
+    np.testing.assert_allclose(outs[1], xa * ya, rtol=1e-5)
+    np.testing.assert_allclose(outs[2], xa.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(outs[3], [xa.mean()], rtol=1e-5)
+    np.testing.assert_allclose(outs[4], xa.max(0, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(outs[5], xa ** 2, rtol=1e-5)
+    np.testing.assert_allclose(outs[6], np.linalg.norm(xa, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(outs[7], xa @ ya.T, rtol=1e-4)
+    np.testing.assert_allclose(outs[8], np.tril(xa), rtol=1e-5)
+    np.testing.assert_allclose(
+        outs[9], np.log(np.exp(xa).sum(1)), rtol=1e-5
+    )
+
+
+def test_tensor_creation_and_manipulation():
+    def build():
+        x = layers.data("x", [2, 6], append_batch_size=False)
+        return [
+            paddle.full([2, 3], 7.0),
+            paddle.reshape(x, [3, 4]),
+            paddle.flip(x, axis=1),
+            paddle.roll(x, shifts=1, axis=1),
+            paddle.concat([x, x], axis=0),
+        ]
+
+    xa = np.arange(12, dtype=np.float32).reshape(2, 6)
+    outs = _run(build, {"x": xa})
+    np.testing.assert_array_equal(outs[0], np.full((2, 3), 7.0, np.float32))
+    np.testing.assert_array_equal(outs[1], xa.reshape(3, 4))
+    np.testing.assert_array_equal(outs[2], xa[:, ::-1])
+    np.testing.assert_array_equal(outs[3], np.roll(xa, 1, 1))
+    np.testing.assert_array_equal(outs[4], np.concatenate([xa, xa], 0))
+
+
+def test_nn_functional_static_training():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(1)
+    xa = rng.randn(16, 8).astype(np.float32)
+    ya = rng.randint(0, 3, (16, 1)).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 8], append_batch_size=False)
+        y = layers.data("y", [16, 1], dtype="int64", append_batch_size=False)
+        h = F.relu(layers.fc(x, 32))
+        h = F.dropout(h, p=0.2, training=True)
+        logits = layers.fc(h, 3)
+        loss = F.cross_entropy(logits, y)
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        losses = [
+            float(np.asarray(exe.run(main, feed={"x": xa, "y": ya},
+                                     fetch_list=[loss])[0]).reshape(()))
+            for _ in range(30)
+        ]
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_nn_sequential_dygraph():
+    rng = np.random.RandomState(2)
+    xa = rng.randn(8, 4).astype(np.float32)
+    with dygraph.guard():
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(4, 16),
+            paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 2),
+        )
+        out = net(dygraph.to_variable(xa))
+        assert out.shape == (8, 2)
+        assert len(net) == 3 and isinstance(net[1], paddle.nn.ReLU)
+        assert len(net.parameters()) == 4
+
+        loss_fn = paddle.nn.MSELoss()
+        tgt = dygraph.to_variable(np.zeros((8, 2), np.float32))
+        loss = loss_fn(out, tgt)
+        loss.backward()
+        assert all(p.grad is not None for p in net.parameters())
