@@ -1,0 +1,237 @@
+"""Quantization passes (reference contrib/slim/quantization/
+quantization_pass.py QuantizationTransformPass +
+post_training_quantization.py PostTrainingQuantization, ~6k LoC).
+
+Two entry points:
+
+  quant_aware(program, startup)      — QAT: rewrite the program so every
+      quantizable op sees quantize-dequantized weights (abs-max of the
+      live value) and activations (EMA abs-max state var); training
+      converges with int8 error in the loop, gradients flow via STE.
+
+  PostTrainingQuantization           — PTQ: run calibration batches
+      through the float program, record per-tensor abs-max for the
+      inputs of quantizable ops, then emit a program with fixed-scale
+      quant-dequant ops (save with save_inference_model as usual).
+
+Simulated-int8 design note: on TPU the MXU executes int8 natively; the
+fake-quant form keeps the program float (XLA fuses the qdq into the
+matmul) and preserves exact reference semantics for scale search.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...fluid import framework, unique_name
+from ...fluid.initializer import ConstantInitializer
+
+QUANTIZABLE_OP_TYPES = ("mul", "matmul", "matmul_v2", "conv2d",
+                        "depthwise_conv2d")
+
+# op type -> (activation input slot, weight input slot)
+_SLOTS = {
+    "mul": ("X", "Y"),
+    "matmul": ("X", "Y"),
+    "matmul_v2": ("X", "Y"),
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+}
+
+
+def _is_param(block, name):
+    v = block._find_var_recursive(name)
+    return isinstance(v, framework.Parameter)
+
+
+def quant_aware(program, startup_program=None, weight_bits=8,
+                activation_bits=8, moving_rate=0.9,
+                quantizable_op_types=QUANTIZABLE_OP_TYPES,
+                for_test=False):
+    """QAT rewrite (reference QuantizationTransformPass.apply). Must run
+    BEFORE append_backward/minimize so grad ops see the quantized graph."""
+    startup = startup_program or framework.default_startup_program()
+    block = program.global_block()
+    quantized: Dict[str, str] = {}  # src var -> its qdq output (reference
+    # QuantizationTransformPass.dequantized_vars: a tensor feeding N
+    # quantizable ops gets ONE qdq op and one scale state)
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type not in quantizable_op_types or op.attr("__quantized__"):
+            i += 1
+            continue
+        op._set_attr("__quantized__", True)
+        act_slot, w_slot = _SLOTS[op.type]
+        inserted = 0
+        for slot, bits, is_weight in (
+            (act_slot, activation_bits, False),
+            (w_slot, weight_bits, True),
+        ):
+            names = op.inputs.get(slot)
+            if not names:
+                continue
+            src = names[0]
+            if src in quantized:
+                op.inputs[slot] = [quantized[src]] + list(names[1:])
+                continue
+            v = block._find_var_recursive(src)
+            if is_weight and (v is None or not _is_param(block, src)):
+                continue  # only quantize real parameters on the weight side
+            q_name = unique_name.generate(f"{src}.quantized")
+            block.create_var(name=q_name, shape=getattr(v, "shape", None),
+                             dtype=getattr(v, "dtype", "float32"))
+            if is_weight:
+                scale_out = unique_name.generate(f"{src}.quant_scale_out")
+                block.create_var(name=scale_out, shape=(1,), dtype="float32")
+                block._insert_op(
+                    i + inserted,
+                    type="fake_quantize_dequantize_abs_max",
+                    inputs={"X": [src]},
+                    outputs={"Out": [q_name], "OutScale": [scale_out]},
+                    attrs={"bit_length": bits},
+                )
+            else:
+                accum = unique_name.generate(f"{src}.quant_accum")
+                state = unique_name.generate(f"{src}.quant_state")
+                scale_out = unique_name.generate(f"{src}.quant_scale")
+                block.create_var(name=scale_out, shape=(1,), dtype="float32")
+                st_block = startup.global_block()
+                for n in (accum, state):
+                    block.create_var(name=n, shape=(1,), dtype="float32",
+                                     persistable=True)
+                    s_init = st_block.create_var(
+                        name=n, shape=(1,), dtype="float32", persistable=True
+                    )
+                    ConstantInitializer(0.0)(s_init, st_block)
+                block._insert_op(
+                    i + inserted,
+                    type="fake_quantize_dequantize_moving_average_abs_max",
+                    inputs={"X": [src], "InAccum": [accum], "InState": [state]},
+                    outputs={"Out": [q_name], "OutAccum": [accum],
+                             "OutState": [state], "OutScale": [scale_out]},
+                    attrs={"bit_length": bits, "moving_rate": moving_rate,
+                           "is_test": for_test},
+                )
+            quantized[src] = q_name
+            op.inputs[slot] = [q_name] + list(names[1:])
+            inserted += 1
+        i += 1 + inserted
+    program._bump_version()
+    return program
+
+
+def convert(program):
+    """Freeze a QAT program for inference (reference
+    QuantizationFreezePass-lite): flip every moving-average qdq op to
+    is_test so scales stop updating. Idempotent."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                op._set_attr("is_test", True)
+    program._bump_version()
+    return program
+
+
+class PostTrainingQuantization:
+    """reference post_training_quantization.PostTrainingQuantization:
+    calibrate activation scales on sample data, then emit a fixed-scale
+    quantized program."""
+
+    def __init__(self, executor, program, feed_names, fetch_vars,
+                 calibration_data, algo="abs_max", weight_bits=8,
+                 activation_bits=8,
+                 quantizable_op_types=QUANTIZABLE_OP_TYPES,
+                 scope=None):
+        if algo != "abs_max":
+            raise NotImplementedError(f"PTQ algo {algo!r}: only abs_max")
+        self._exe = executor
+        # work on a clone: the user's float program must stay intact
+        # (reference PTQ loads its own copy of the model)
+        self._program = program.clone()
+        self._feed_names = list(feed_names)
+        self._fetch_vars = list(fetch_vars)
+        self._data = calibration_data
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._op_types = quantizable_op_types
+        self._scope = scope
+
+    def _collect_targets(self):
+        """(op index, slot, var name, is_weight) for quantizable inputs."""
+        block = self._program.global_block()
+        out = []
+        for idx, op in enumerate(block.ops):
+            if op.type not in self._op_types:
+                continue
+            act_slot, w_slot = _SLOTS[op.type]
+            for slot, is_w in ((act_slot, False), (w_slot, True)):
+                names = op.inputs.get(slot)
+                if names:
+                    out.append((idx, slot, names[0], is_w))
+        return out
+
+    def quantize(self):
+        from ...fluid import executor as executor_mod
+
+        targets = self._collect_targets()
+        act_names = sorted({n for _, _, n, w in targets if not w})
+        scales: Dict[str, float] = {}
+
+        scope = self._scope or executor_mod.global_scope()
+        with executor_mod.scope_guard(scope):
+            # weight scales straight from the scope
+            for _, _, n, is_w in targets:
+                if is_w and n not in scales:
+                    scales[n] = float(np.abs(np.asarray(scope.find_var(n))).max())
+            # activation scales from calibration batches
+            for batch in self._data:
+                vals = self._exe.run(
+                    self._program, feed=batch, fetch_list=act_names,
+                )
+                for n, v in zip(act_names, vals):
+                    m = float(np.abs(np.asarray(v)).max())
+                    scales[n] = max(scales.get(n, 0.0), m)
+
+        # rewrite: fixed-scale qdq before each quantizable input
+        block = self._program.global_block()
+        # walk with explicit index bookkeeping (inserts shift positions)
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self._op_types:
+                i += 1
+                continue
+            act_slot, w_slot = _SLOTS[op.type]
+            inserted = 0
+            for slot, bits in ((act_slot, self._abits), (w_slot, self._wbits)):
+                names = op.inputs.get(slot)
+                if not names or names[0] not in scales:
+                    continue
+                src = names[0]
+                v = block._find_var_recursive(src)
+                q_name = unique_name.generate(f"{src}.ptq")
+                block.create_var(name=q_name, shape=getattr(v, "shape", None),
+                                 dtype=getattr(v, "dtype", "float32"))
+                block._insert_op(
+                    i + inserted,
+                    type="fake_quant_dequant_fixed_scale",
+                    inputs={"X": [src]},
+                    outputs={"Out": [q_name]},
+                    attrs={"bit_length": bits, "scale": scales[src]},
+                )
+                op.inputs[slot] = [q_name] + list(names[1:])
+                inserted += 1
+            i += 1 + inserted
+        self._program._bump_version()
+        self._scales = scales
+        return self._program
+
+    def save_quantized_model(self, save_model_path):
+        from ...fluid import io
+
+        io.save_inference_model(
+            save_model_path, self._feed_names, self._fetch_vars, self._exe,
+            main_program=self._program,
+        )

@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     nn_ops,
     optimizer_ops,
     ps_ops,
+    quant_ops,
     recompute,
     reduce_ops,
     sequence_ops,

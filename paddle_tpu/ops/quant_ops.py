@@ -1,0 +1,94 @@
+"""Fake-quantization ops (reference contrib/slim/quantization +
+operators/fake_quantize_op.cc).
+
+Simulated int8: quantize-dequantize in float with a per-tensor scale, so
+training/calibration see quantization error while the math stays on the
+MXU. Gradients are straight-through (identity on X) — round() has zero
+derivative, so each op registers an explicit grad maker instead of the
+generic vjp path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, set_grad_maker
+
+
+def _qdq(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _ste_grad_maker(op, out_grads, block):
+    """dX = dOut (straight-through estimator)."""
+    og = out_grads.get("Out")
+    if og is None:
+        return [], {}
+    xname = op.input("X")[0]
+    gname = xname + "@GRAD"
+    desc = {
+        "type": "assign",
+        "inputs": {"X": [og[0]]},
+        "outputs": {"Out": [gname]},
+        "attrs": {},
+    }
+    return [desc], {xname: gname}
+
+
+@register("fake_quantize_dequantize_abs_max", no_vjp_grad=True)
+def fake_qdq_abs_max(ctx, ins, attrs):
+    """Per-tensor abs-max scale from the CURRENT value (weights)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_qdq(x, scale, bits)], "OutScale": [scale.reshape(1)]}
+
+
+set_grad_maker("fake_quantize_dequantize_abs_max", _ste_grad_maker)
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max", no_vjp_grad=True)
+def fake_qdq_moving_avg(ctx, ins, attrs):
+    """Activation quantization with the reference's debiased EMA
+    (fake_quantize_op.cc moving-average pair): accum' = rate*accum +
+    absmax, state' = rate*state + 1, scale = accum'/state' — so the
+    step-1 scale is ~absmax regardless of initialization. is_test reads
+    the stored pair without updating."""
+    x = ins["X"][0]
+    accum = ins["InAccum"][0].reshape(())
+    state = ins["InState"][0].reshape(())
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    absmax = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False):
+        new_accum, new_state = accum, state
+    else:
+        new_accum = rate * accum + absmax
+        new_state = rate * state + 1.0
+    # never-updated state (0): fall back to the live absmax
+    scale = jnp.where(new_state > 0, new_accum / jnp.maximum(new_state, 1e-12),
+                      absmax)
+    return {
+        "Out": [_qdq(x, scale, bits)],
+        "OutAccum": [new_accum.reshape(1)],
+        "OutState": [new_state.reshape(1)],
+        "OutScale": [scale.reshape(1)],
+    }
+
+
+set_grad_maker("fake_quantize_dequantize_moving_average_abs_max", _ste_grad_maker)
+
+
+@register("fake_quant_dequant_fixed_scale", no_vjp_grad=True)
+def fake_qdq_fixed(ctx, ins, attrs):
+    """Quant-dequant with a calibration-time scale (the PTQ output form)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.asarray(float(attrs["scale"]), x.dtype)
+    return {"Out": [_qdq(x, scale, bits)]}
+
+
+set_grad_maker("fake_quant_dequant_fixed_scale", _ste_grad_maker)

@@ -2287,6 +2287,62 @@ def _beam_search():
     )
 
 
+# ---- fake quantization -----------------------------------------------------
+
+
+def _np_qdq(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    s = np.maximum(scale, 1e-8)
+    return np.clip(np.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+@case("fake_quantize_dequantize_abs_max")
+def _fqdq_absmax():
+    x = _mix(R(719), 3, 4)
+
+    def oracle(ins, a):
+        s = np.abs(ins["X"][0]).max()
+        return {"Out": [f32(_np_qdq(ins["X"][0], s))], "OutScale": [f32([s])]}
+
+    return OpTest(
+        "fake_quantize_dequantize_abs_max", {"X": x}, oracle,
+        attrs={"bit_length": 8}, outputs={"Out": 1, "OutScale": 1}, tol=1e-5,
+    )
+
+
+@case("fake_quantize_dequantize_moving_average_abs_max")
+def _fqdq_ema():
+    rng = R(727)
+    x = _mix(rng, 3, 4)
+    accum, state = f32([0.7]), f32([1.0])
+
+    def oracle(ins, a):
+        na = 0.9 * ins["InAccum"][0][0] + np.abs(ins["X"][0]).max()
+        ns = 0.9 * ins["InState"][0][0] + 1.0
+        s = na / ns
+        return {"Out": [f32(_np_qdq(ins["X"][0], s))],
+                "OutAccum": [f32([na])], "OutState": [f32([ns])],
+                "OutScale": [f32([s])]}
+
+    return OpTest(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        {"X": x, "InAccum": accum, "InState": state}, oracle,
+        attrs={"bit_length": 8, "moving_rate": 0.9},
+        outputs={"Out": 1, "OutAccum": 1, "OutState": 1, "OutScale": 1},
+        tol=1e-5,
+    )
+
+
+@case("fake_quant_dequant_fixed_scale")
+def _fqdq_fixed():
+    x = _mix(R(733), 3, 4)
+    return OpTest(
+        "fake_quant_dequant_fixed_scale", {"X": x},
+        lambda ins, a: {"Out": [f32(_np_qdq(ins["X"][0], 1.5))]},
+        attrs={"bit_length": 8, "scale": 1.5}, tol=1e-5,
+    )
+
+
 # ---------------------------------------------------------------------------
 # exemptions: ops whose contract is verified elsewhere or is stochastic
 # ---------------------------------------------------------------------------

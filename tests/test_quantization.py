@@ -1,0 +1,138 @@
+"""Quantization: QAT rewrite + PTQ calibration (reference contrib/slim/
+quantization_pass.py + post_training_quantization.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.contrib.slim.quantization import (
+    PostTrainingQuantization,
+    quant_aware,
+)
+from paddle_tpu.fluid import layers
+
+
+def _build(batch=16):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [batch, 8], append_batch_size=False)
+        y = layers.data("y", [batch, 1], append_batch_size=False)
+        h = layers.fc(x, 32, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    return main, startup, x, y, pred, loss
+
+
+def test_qat_trains_with_fake_quant_ops():
+    main, startup, x, y, pred, loss = _build()
+    with fluid.program_guard(main, startup):
+        quant_aware(main, startup)
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fake_quantize_dequantize_abs_max") == 2  # two weights
+    assert types.count("fake_quantize_dequantize_moving_average_abs_max") == 2
+
+    rng = np.random.RandomState(0)
+    xa = rng.rand(16, 8).astype(np.float32)
+    ya = xa.sum(1, keepdims=True).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(50):
+            (lv,) = exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        # EMA accum/state moved off their zero init
+        scope = fluid.global_scope()
+        state_vars = [n for n in scope.vars if "quant_state" in n]
+        assert state_vars
+        assert all(
+            float(np.asarray(scope.find_var(n))[0]) > 0 for n in state_vars
+        )
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_convert_freezes_scales():
+    from paddle_tpu.contrib.slim.quantization import convert
+
+    main, startup, x, y, pred, loss = _build()
+    with fluid.program_guard(main, startup):
+        quant_aware(main, startup)
+    n_ops = len(main.global_block().ops)
+    convert(main)
+    convert(main)  # idempotent: freezing twice adds nothing
+    assert len(main.global_block().ops) == n_ops
+    assert all(
+        op.attr("is_test")
+        for op in main.global_block().ops
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max"
+    )
+
+
+def test_ptq_outputs_close_to_float(tmp_path):
+    main, startup, x, y, pred, loss = _build()
+    rng = np.random.RandomState(1)
+    xa = rng.rand(16, 8).astype(np.float32)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (float_out,) = exe.run(main, feed={"x": xa, "y": np.zeros((16, 1), np.float32)},
+                               fetch_list=[pred])
+        float_out = np.asarray(float_out).copy()
+
+        calib = [{"x": rng.rand(16, 8).astype(np.float32),
+                  "y": np.zeros((16, 1), np.float32)} for _ in range(4)]
+        ptq = PostTrainingQuantization(
+            exe, main, ["x"], [pred], calib,
+        )
+        qprog = ptq.quantize()
+        qtypes = [op.type for op in qprog.global_block().ops]
+        assert qtypes.count("fake_quant_dequant_fixed_scale") == 4
+        # the user's float program is untouched (PTQ clones)
+        assert "fake_quant_dequant_fixed_scale" not in [
+            op.type for op in main.global_block().ops
+        ]
+
+        (q_out,) = exe.run(qprog, feed={"x": xa, "y": np.zeros((16, 1), np.float32)},
+                           fetch_list=[pred])
+        q_out = np.asarray(q_out)
+        # int8 simulation: close but not identical
+        rel = np.abs(q_out - float_out).max() / (np.abs(float_out).max() + 1e-6)
+        assert rel < 0.05, rel
+        assert not np.allclose(q_out, float_out)
+
+        # save + reload the quantized model
+        path = str(tmp_path / "qmodel")
+        ptq.save_quantized_model(path)
+    with fluid.scope_guard(fluid.executor.Scope()):
+        prog, feeds, fetches = fluid.io.load_inference_model(path, exe)
+        (o,) = exe.run(prog, feed={feeds[0]: xa}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(o), q_out, rtol=1e-5, atol=1e-6)
+
+
+def test_ste_gradient_is_identity():
+    """Fake quant grads pass straight through (STE)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        v = block.create_var(name="xin", shape=(3, 4), dtype=np.float32)
+        v.stop_gradient = False
+        block.create_var(name="q"); block.create_var(name="s")
+        block.append_op(
+            type="fake_quantize_dequantize_abs_max",
+            inputs={"X": ["xin"]}, outputs={"Out": ["q"], "OutScale": ["s"]},
+            attrs={"bit_length": 8},
+        )
+        block.create_var(name="l")
+        block.append_op(type="reduce_sum", inputs={"X": ["q"]},
+                        outputs={"Out": ["l"]},
+                        attrs={"reduce_all": True, "keep_dim": False, "dim": [0]})
+        from paddle_tpu.fluid.backward import append_backward
+
+        append_backward(block.var("l"), parameter_list=["xin"])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        xa = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        (g,) = exe.run(main, feed={"xin": xa}, fetch_list=["xin@GRAD"])
+    np.testing.assert_array_equal(np.asarray(g), np.ones((3, 4), np.float32))
