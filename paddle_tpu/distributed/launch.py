@@ -1,0 +1,151 @@
+"""Multi-process launcher: `python -m paddle_tpu.distributed.launch
+[--nproc_per_node N] [--ips a,b] train.py args...`
+
+Parity surface: reference python/paddle/distributed/launch.py:193 +
+utils.py (get_cluster:230, start_local_trainers:340,
+watch_local_trainers:407 — abort the whole job when any child dies).
+
+Env protocol per trainer (identical to the reference, consumed by
+parallel/env.py):
+  PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+  PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT
+
+TPU notes: one process per HOST is the normal topology (all local chips
+belong to one PJRT client); --nproc_per_node exists for CPU fleets and
+tests. Rendezvous is the JAX coordination service bootstrapped from the
+first endpoint (no gen_nccl_id gRPC exchange).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+class Trainer:
+    def __init__(self, rank: int, endpoint: str):
+        self.rank = rank
+        self.endpoint = endpoint
+        self.proc: Optional[subprocess.Popen] = None
+        self.log = None
+
+
+def get_cluster(ips: List[str], nproc_per_node: int, start_port: int):
+    """[(rank, ip:port)] across all nodes (reference utils.get_cluster)."""
+    out = []
+    rank = 0
+    for ip in ips:
+        for i in range(nproc_per_node):
+            out.append(Trainer(rank, f"{ip}:{start_port + i}"))
+            rank += 1
+    return out
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="spawn and watch per-node trainer processes",
+    )
+    p.add_argument("--ips", "--cluster_node_ips", default="127.0.0.1",
+                   help="comma-separated node ips (this script runs on each)")
+    p.add_argument("--node_ip", default=None,
+                   help="this node's ip (default: first of --ips)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
+                         script_args: List[str], log_dir: Optional[str]):
+    """Fork this node's trainers with the env protocol (reference
+    utils.start_local_trainers:340)."""
+    endpoints = ",".join(t.endpoint for t in cluster)
+    local = [t for t in cluster if t.endpoint.split(":")[0] == node_ip]
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for t in local:
+        env = dict(os.environ)
+        env.update(
+            PADDLE_TRAINER_ID=str(t.rank),
+            PADDLE_TRAINERS_NUM=str(len(cluster)),
+            PADDLE_TRAINER_ENDPOINTS=endpoints,
+            PADDLE_CURRENT_ENDPOINT=t.endpoint,
+        )
+        cmd = [sys.executable, "-u", script] + list(script_args)
+        if log_dir:
+            t.log = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
+            t.proc = subprocess.Popen(cmd, env=env, stdout=t.log,
+                                      stderr=subprocess.STDOUT)
+        else:
+            t.proc = subprocess.Popen(cmd, env=env)
+    return local
+
+
+def terminate_local_trainers(trainers: List[Trainer]):
+    for t in trainers:
+        if t.proc and t.proc.poll() is None:
+            t.proc.terminate()
+    deadline = time.time() + 5
+    for t in trainers:
+        if not t.proc:
+            continue
+        while t.proc.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if t.proc.poll() is None:
+            t.proc.kill()
+    for t in trainers:
+        if t.log:
+            t.log.close()
+
+
+def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2) -> int:
+    """Block until all trainers exit. Any nonzero exit aborts the whole
+    local group (reference watch_local_trainers:407: fail fast, recovery
+    is checkpoint+restart). Returns the job's exit code."""
+    try:
+        while True:
+            alive = False
+            for t in trainers:
+                rc = t.proc.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    print(
+                        f"[launch] trainer {t.rank} ({t.endpoint}) exited "
+                        f"with {rc}; aborting the job",
+                        file=sys.stderr,
+                    )
+                    terminate_local_trainers(trainers)
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        terminate_local_trainers(trainers)
+        return 128 + signal.SIGINT
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    ips = [s.strip() for s in args.ips.split(",") if s.strip()]
+    node_ip = args.node_ip or ips[0]
+    cluster = get_cluster(ips, args.nproc_per_node, args.started_port)
+    local = start_local_trainers(
+        cluster, node_ip, args.training_script, args.training_script_args,
+        args.log_dir,
+    )
+    if not local:
+        print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
+        return 2
+    return watch_local_trainers(local)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -4,4 +4,4 @@ from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
-from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from .control_flow import StaticRNN, case, cond, py_func, switch_case, while_loop  # noqa: F401

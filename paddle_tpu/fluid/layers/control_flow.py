@@ -9,6 +9,7 @@ scopes at runtime).
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 from typing import Callable, List, Optional, Sequence
 
 from .. import framework
@@ -206,3 +207,193 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         idx_var = tensor_layers.fill_constant([1], branch_index.dtype, float(idx))
         pairs.append((tensor_layers.equal(branch_index, idx_var), fn))
     return case(pairs, default=default or items[-1][1])
+
+
+class StaticRNN:
+    """Block-based RNN builder (reference layers/control_flow.py
+    StaticRNN over recurrent_op.cc). Usage:
+
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: [B, T, D] -> [B, D]
+            h = rnn.memory(init=h0)          # carried state
+            nh = fluid.layers.fc(concat([x_t, h]), H, act="tanh")
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()                          # [B, T, H]
+
+    The step block compiles to one lax.scan body (op type `recurrent`).
+    """
+
+    def __init__(self, name=None, is_reverse=False):
+        self._prog = None
+        self._block = None
+        self._seq_inputs = []   # (outer var, block var)
+        self._memories = []     # (init outer var, block var)
+        self._updates = {}      # block mem name -> block new-value name
+        self._outputs = []      # block vars
+        self._done = False
+        self._is_reverse = is_reverse
+
+    @_contextlib.contextmanager
+    def step(self):
+        self._prog = framework.default_main_program()
+        self._block = self._prog._create_block()
+        try:
+            yield
+        finally:
+            self._prog._rollback()
+            self._done = True
+
+    def _in_step(self):
+        if self._block is None or self._done:
+            raise RuntimeError("call inside `with rnn.step():`")
+
+    def step_input(self, x):
+        self._in_step()
+        if len(x.shape) < 2:
+            raise ValueError(f"step input needs [B, T, ...], got {x.shape}")
+        if self._seq_inputs and x.shape[1] != self._seq_inputs[0][0].shape[1]:
+            raise ValueError(
+                f"step inputs must share one sequence length: got "
+                f"{x.shape[1]} vs {self._seq_inputs[0][0].shape[1]}"
+            )
+        v = self._block.create_var(
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype
+        )
+        v.stop_gradient = x.stop_gradient
+        self._seq_inputs.append((x, v))
+        return v
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               dtype="float32"):
+        self._in_step()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init or (shape, batch_ref)")
+            from . import tensor as tensor_layers
+
+            # the init value is an OUTER input of the recurrence: build
+            # its fill_constant in the parent block, not the step block
+            self._prog._rollback()
+            try:
+                init = tensor_layers.fill_constant(
+                    [batch_ref.shape[0]] + list(shape), dtype, init_value
+                )
+            finally:
+                self._prog.current_block_idx = self._block.idx
+        v = self._block.create_var(shape=init.shape, dtype=init.dtype)
+        v.stop_gradient = False
+        self._memories.append((init, v))
+        return v
+
+    def update_memory(self, mem, new):
+        self._in_step()
+        self._updates[mem.name] = new.name
+
+    def output(self, *outputs):
+        self._in_step()
+        for o in outputs:
+            self._outputs.append(o)
+
+    step_output = output
+
+    def __call__(self):
+        if not self._done:
+            raise RuntimeError("finish the `with rnn.step():` block first")
+        if not self._outputs:
+            raise ValueError("StaticRNN needs at least one step_output")
+        for init, v in self._memories:
+            if v.name not in self._updates:
+                raise ValueError(f"memory {v.name!r} was never update_memory'd")
+        prog = self._prog
+        parent = prog.current_block()
+        t = self._seq_inputs[0][0].shape[1] if self._seq_inputs else None
+        if t is None:
+            raise ValueError("StaticRNN needs at least one step_input")
+
+        local = {v.name for _, v in self._seq_inputs}
+        local |= {v.name for _, v in self._memories}
+        captured = [
+            n for n in _captured_inputs([self._block]) if n not in local
+        ]
+        out_vars = [
+            parent.create_var(
+                shape=(o.shape[0], t) + tuple(o.shape[1:]), dtype=o.dtype
+            )
+            for o in self._outputs
+        ]
+        state_vars = [
+            parent.create_var(shape=v.shape, dtype=v.dtype)
+            for _, v in self._memories
+        ]
+        inputs = {
+            "StepInputs": [x for x, _ in self._seq_inputs],
+            "Memories": [init for init, _ in self._memories],
+        }
+        if captured:
+            inputs["Captured"] = captured
+        parent.append_op(
+            type="recurrent",
+            inputs=inputs,
+            outputs={"Out": out_vars, "FinalStates": state_vars},
+            attrs={
+                "step_block": self._block,
+                "step_input_names": [v.name for _, v in self._seq_inputs],
+                "memory_in_names": [v.name for _, v in self._memories],
+                "memory_out_names": [
+                    self._updates[v.name] for _, v in self._memories
+                ],
+                "step_output_names": [o.name for o in self._outputs],
+                "captured_names": captured,
+                "is_reverse": self._is_reverse,
+                "__seq_len__": t,
+            },
+            infer=False,
+        )
+        return out_vars[0] if len(out_vars) == 1 else out_vars
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """Run a Python callable as an op (reference layers/nn.py py_func over
+    py_func_op.cc). `out` is a Variable (or list) pre-created with the
+    result shape/dtype (use program.current_block().create_var). When
+    backward_func is given it receives (inputs..., out_grads...) and
+    returns the input gradients; without it the outputs are
+    non-differentiable."""
+    import numpy as np
+
+    xs = _as_var_list(x)
+    outs = _as_var_list(out)
+    skip = {
+        v.name if isinstance(v, Variable) else str(v)
+        for v in _as_var_list(skip_vars_in_backward_input)
+    }
+    skip_idx = [i for i, v in enumerate(xs) if v.name in skip]
+    unknown = skip - {v.name for v in xs}
+    if unknown:
+        raise ValueError(
+            f"skip_vars_in_backward_input names not among inputs: {sorted(unknown)}"
+        )
+    block = framework.default_main_program().current_block()
+    for o in outs:
+        if o.shape is None or o.dtype is None:
+            raise ValueError(f"py_func out {o.name!r} needs static shape+dtype")
+        if backward_func is None:
+            o.stop_gradient = True
+    block.append_op(
+        type="py_func",
+        inputs={"X": xs},
+        outputs={"Out": outs},
+        attrs={
+            "pyfunc_fwd": func,
+            "pyfunc_bwd": backward_func,
+            "pyfunc_skip_idx": skip_idx,
+            "pyfunc_out_meta": [
+                (tuple(o.shape), str(np.dtype(o.dtype))) for o in outs
+            ],
+        },
+        infer=False,
+    )
+    return out

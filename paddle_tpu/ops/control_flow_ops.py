@@ -96,3 +96,139 @@ def select_input(ctx, ins, attrs):
     xs = ins["X"]
     out = jax.lax.switch(jnp.clip(mask, 0, len(xs) - 1), [lambda x=x: x for x in xs])
     return {"Out": [out]}
+
+
+def _recurrent_infer(in_metas, attrs):
+    blk = attrs["step_block"]
+    t = attrs["__seq_len__"]
+    outs = []
+    for n in attrs["step_output_names"]:
+        v = blk._find_var_recursive(n)
+        outs.append(((v.shape[0], t) + tuple(v.shape[1:]), v.dtype))
+    states = []
+    for n in attrs["memory_out_names"]:
+        v = blk._find_var_recursive(n)
+        states.append((v.shape, v.dtype))
+    return {"Out": outs, "FinalStates": states}
+
+
+@register("recurrent", infer_shape=_recurrent_infer)
+def recurrent_op(ctx, ins, attrs):
+    """Block-based RNN (reference recurrent_op.cc / StaticRNN): scan the
+    step sub-block over the time axis. The reference runs the block in a
+    per-step Scope; here the block is SSA-ified into a lax.scan body —
+    memories are the carries, step inputs are the scanned xs — so the
+    whole recurrence compiles to one HLO While and reverse-mode AD works
+    through the generic vjp path (no per-step scopes to differentiate).
+
+    inputs: StepInputs [B,T,...] (sliced per step), Memories (initial
+    carry values), Captured (loop constants).
+    attrs: step_block, step_input_names, memory_in_names,
+    memory_out_names, step_output_names, captured_names, is_reverse."""
+    step_blk = attrs["step_block"]
+    step_in_names = list(attrs["step_input_names"])
+    mem_in = list(attrs["memory_in_names"])
+    mem_out = list(attrs["memory_out_names"])
+    out_names = list(attrs["step_output_names"])
+    captured = dict(zip(attrs["captured_names"], ins.get("Captured", [])))
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xs = [jnp.swapaxes(x, 0, 1) for x in ins.get("StepInputs", [])]  # [T,B,..]
+    if reverse:
+        xs = [jnp.flip(x, 0) for x in xs]
+    mems = tuple(ins.get("Memories", []))
+
+    def body(carry, x_t):
+        env = dict(captured)
+        env.update(zip(mem_in, carry))
+        env.update(zip(step_in_names, x_t))
+        registry.emit_ops(ctx, step_blk.ops, env)
+        new_carry = tuple(env[n] for n in mem_out)
+        outs = tuple(env[n] for n in out_names)
+        return new_carry, outs
+
+    final, stacked = jax.lax.scan(body, mems, tuple(xs))
+    outs = [jnp.swapaxes(o, 0, 1) for o in stacked]  # [B,T,...]
+    if reverse:
+        outs = [jnp.flip(o, 1) for o in outs]
+    return {"Out": outs, "FinalStates": list(final)}
+
+
+@register("py_func")
+def py_func_op(ctx, ins, attrs):
+    """Python-callback op (reference controlflow/py_func_op.cc): run a
+    host Python callable inside the compiled program via
+    jax.pure_callback. The callable is stored in the op attrs (the same
+    way sub-Blocks are). backward_func, when given, defines the vjp —
+    also as a host callback."""
+    if jax.default_backend() == "axon":
+        raise NotImplementedError(
+            "py_func needs host callbacks, which the axon dev tunnel does "
+            "not support; run on a real TPU host or the CPU backend"
+        )
+    import numpy as np
+
+    xs = ins["X"]
+    fwd = attrs["pyfunc_fwd"]
+    bwd = attrs.get("pyfunc_bwd")
+    skip_idx = set(attrs.get("pyfunc_skip_idx", []))
+    out_specs = [
+        jax.ShapeDtypeStruct(tuple(s), jnp.dtype(str(np.dtype(d))))
+        for s, d in attrs["pyfunc_out_meta"]
+    ]
+
+    def host_fwd(*arrs):
+        res = fwd(*arrs)
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=spec.dtype) for r, spec in zip(res, out_specs))
+
+    if bwd is None:
+        outs = jax.pure_callback(host_fwd, tuple(out_specs), *xs)
+        return {"Out": list(outs)}
+
+    @jax.custom_vjp
+    def call(*xs_):
+        return jax.pure_callback(host_fwd, tuple(out_specs), *xs_)
+
+    def call_fwd(*xs_):
+        return call(*xs_), xs_
+
+    def call_bwd(res_xs, gs):
+        # cotangents are produced only for ACTIVE inputs: float dtype and
+        # not listed in skip_vars_in_backward_input; everything else gets
+        # None (ints cannot carry gradients)
+        active = [
+            i for i, x in enumerate(res_xs)
+            if i not in skip_idx and jnp.issubdtype(x.dtype, jnp.floating)
+        ]
+
+        def host_bwd(*arrs):
+            n = len(res_xs)
+            # reference py_func contract: inputs listed in
+            # skip_vars_in_backward_input are omitted from the bwd args
+            fwd_args = [a for i, a in enumerate(arrs[:n]) if i not in skip_idx]
+            grads = bwd(*fwd_args, *arrs[n:])
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            if len(grads) != len(active):
+                raise ValueError(
+                    f"py_func backward returned {len(grads)} gradients; "
+                    f"expected {len(active)} (one per float non-skipped input)"
+                )
+            return tuple(
+                np.asarray(g, dtype=np.dtype(str(res_xs[i].dtype)))
+                for g, i in zip(grads, active)
+            )
+
+        in_specs = tuple(
+            jax.ShapeDtypeStruct(res_xs[i].shape, res_xs[i].dtype)
+            for i in active
+        )
+        dact = jax.pure_callback(host_bwd, in_specs, *res_xs, *gs)
+        out = [None] * len(res_xs)
+        for g, i in zip(dact, active):
+            out[i] = g
+        return tuple(out)
+
+    call.defvjp(call_fwd, call_bwd)
+    outs = call(*xs)
+    return {"Out": list(outs)}

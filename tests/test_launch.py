@@ -1,0 +1,91 @@
+"""Launcher (reference distributed/launch.py + utils.watch_local_trainers):
+spawn with the env protocol, collect, abort-all on child failure."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, nproc=3, extra=()):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node", str(nproc),
+        "--log_dir", str(tmp_path / "logs"),
+        *extra,
+        str(script), str(tmp_path),
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120)
+
+
+def test_launch_env_protocol(tmp_path):
+    r = _run_launch(
+        tmp_path,
+        """
+        import os, sys
+        out = sys.argv[1]
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        with open(os.path.join(out, f"rank{rank}.txt"), "w") as f:
+            f.write("|".join([
+                rank,
+                os.environ["PADDLE_TRAINERS_NUM"],
+                os.environ["PADDLE_TRAINER_ENDPOINTS"],
+                os.environ["PADDLE_CURRENT_ENDPOINT"],
+            ]))
+        """,
+        nproc=3,
+    )
+    assert r.returncode == 0, r.stderr
+    seen = set()
+    for rank in range(3):
+        txt = (tmp_path / f"rank{rank}.txt").read_text().split("|")
+        assert txt[0] == str(rank)
+        assert txt[1] == "3"
+        eps = txt[2].split(",")
+        assert len(eps) == 3 and txt[3] in eps
+        seen.add(txt[3])
+    assert len(seen) == 3  # unique ports
+    # logs captured per worker
+    assert sorted(os.listdir(tmp_path / "logs")) == [
+        "workerlog.0", "workerlog.1", "workerlog.2"
+    ]
+
+
+def test_launch_aborts_all_on_failure(tmp_path):
+    r = _run_launch(
+        tmp_path,
+        """
+        import os, sys, time
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        out = sys.argv[1]
+        if rank == 1:
+            sys.exit(7)  # fail fast
+        # other ranks would run "forever"; the launcher must kill them
+        for _ in range(600):
+            time.sleep(0.1)
+        with open(os.path.join(out, f"survived{rank}"), "w") as f:
+            f.write("should not happen")
+        """,
+        nproc=3,
+    )
+    assert r.returncode == 7, (r.returncode, r.stderr)
+    assert "aborting the job" in r.stderr
+    assert not any(p.name.startswith("survived") for p in tmp_path.iterdir())
+
+
+def test_launch_unknown_node_ip(tmp_path):
+    r = _run_launch(
+        tmp_path,
+        "import sys\n",
+        nproc=1,
+        extra=("--ips", "10.1.1.1,10.1.1.2", "--node_ip", "10.9.9.9"),
+    )
+    assert r.returncode == 2

@@ -2370,6 +2370,8 @@ EXEMPT = {
     # control flow needs sub-block programs: tests/test_control_flow.py
     "cond": "test_control_flow.py",
     "while_loop": "test_control_flow.py",
+    "recurrent": "sub-block scan; test_static_rnn_pyfunc.py (numpy oracle)",
+    "py_func": "host callable in attrs; test_static_rnn_pyfunc.py",
     "select_input": "test_control_flow.py",
     # fused mega-ops have dedicated oracle suites
     "fused_encoder_stack": "test_bert.py (vs per-layer composition)",

@@ -1,0 +1,173 @@
+"""StaticRNN (recurrent op) + py_func (reference recurrent_op.cc,
+py_func_op.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_static_rnn_matches_numpy_and_trains():
+    B, T, D, H = 4, 5, 3, 6
+    rng = np.random.RandomState(0)
+    xa = rng.randn(B, T, D).astype(np.float32) * 0.5
+    h0a = np.zeros((B, H), np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], append_batch_size=False)
+        h0 = layers.data("h0", [B, H], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(init=h0)
+            nh = layers.tanh(
+                layers.elementwise_add(
+                    layers.fc(x_t, H, param_attr=fluid.ParamAttr(name="w_x"),
+                              bias_attr=False),
+                    layers.fc(h, H, param_attr=fluid.ParamAttr(name="w_h"),
+                              bias_attr=False),
+                )
+            )
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+        loss = layers.mean(out)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        scope = fluid.global_scope()
+        wx = np.asarray(scope.find_var("w_x"))
+        wh = np.asarray(scope.find_var("w_h"))
+        (o, lv) = exe.run(main, feed={"x": xa, "h0": h0a},
+                          fetch_list=[out, loss])
+        o = np.asarray(o)
+        # numpy oracle
+        href = h0a
+        expect = np.zeros((B, T, H), np.float32)
+        for t in range(T):
+            href = np.tanh(xa[:, t] @ wx + href @ wh)
+            expect[:, t] = href
+        np.testing.assert_allclose(o, expect, rtol=1e-4, atol=1e-5)
+
+        # trains (grads flow through the scan)
+        losses = [float(np.asarray(lv).reshape(()))]
+        for _ in range(10):
+            (lv,) = exe.run(main, feed={"x": xa, "h0": h0a}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert losses[-1] < losses[0]  # mean(out) decreases under SGD
+
+
+def test_py_func_forward_and_backward():
+    B = 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, 3], append_batch_size=False)
+        block = main.current_block()
+        out = block.create_var(name="pyout", shape=(B, 3), dtype=np.float32)
+        out.stop_gradient = False
+
+        def fwd(a):
+            return np.asarray(a) * 2.0 + 1.0
+
+        def bwd(a, g):
+            return np.asarray(g) * 2.0
+
+        layers.py_func(fwd, x, out, backward_func=bwd)
+        loss = layers.mean(out)
+        from paddle_tpu.fluid.backward import append_backward
+
+        append_backward(loss, parameter_list=[x.name])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        xa = np.random.RandomState(1).randn(B, 3).astype(np.float32)
+        o, g = exe.run(main, feed={"x": xa}, fetch_list=[out, "x@GRAD"])
+    np.testing.assert_allclose(np.asarray(o), xa * 2 + 1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g), np.full((B, 3), 2.0 / (B * 3)),
+                               rtol=1e-5)
+
+
+def test_py_func_without_backward_is_stop_gradient():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 2], append_batch_size=False)
+        block = main.current_block()
+        out = block.create_var(name="po", shape=(2, 2), dtype=np.float32)
+        layers.py_func(lambda a: np.asarray(a) + 1.0, x, out)
+        assert out.stop_gradient
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": np.zeros((2, 2), np.float32)},
+                       fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(o), np.ones((2, 2), np.float32))
+
+
+def test_static_rnn_memory_shape_batch_ref():
+    """memory(shape=, batch_ref=) builds its init in the parent block
+    (review finding: it landed in the step block and always crashed)."""
+    B, T, D, H = 2, 3, 4, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(shape=[H], batch_ref=x_t, init_value=0.0)
+            nh = layers.tanh(layers.fc(layers.concat([x_t, h], axis=1), H))
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": np.ones((B, T, D), np.float32)},
+                       fetch_list=[out])
+    assert np.asarray(o).shape == (B, T, H)
+
+
+def test_static_rnn_mismatched_lengths_fail_fast():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [2, 3, 4], append_batch_size=False)
+        b = layers.data("b", [2, 5, 4], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with pytest.raises(ValueError, match="sequence length"):
+            with rnn.step():
+                rnn.step_input(a)
+                rnn.step_input(b)
+
+
+def test_py_func_skip_vars_in_backward_input():
+    B = 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, 2], append_batch_size=False)
+        idx = layers.data("idx", [B, 2], dtype="int64", append_batch_size=False)
+        out = main.current_block().create_var(name="po2", shape=(B, 2),
+                                              dtype=np.float32)
+        out.stop_gradient = False
+
+        def fwd(a, i):
+            return np.asarray(a) * 3.0
+
+        def bwd(a, g):  # idx skipped per the contract
+            assert a.dtype == np.float32
+            return np.asarray(g) * 3.0
+
+        layers.py_func(fwd, [x, idx], out, backward_func=bwd,
+                       skip_vars_in_backward_input=[idx])
+        loss = layers.mean(out)
+        from paddle_tpu.fluid.backward import append_backward
+
+        append_backward(loss, parameter_list=[x.name])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        xa = np.ones((B, 2), np.float32)
+        ia = np.zeros((B, 2), np.int64)
+        (g,) = exe.run(main, feed={"x": xa, "idx": ia}, fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(np.asarray(g), np.full((B, 2), 0.5), rtol=1e-5)
