@@ -180,6 +180,11 @@ def py_func_op(ctx, ins, attrs):
     def host_fwd(*arrs):
         res = fwd(*arrs)
         res = res if isinstance(res, (list, tuple)) else [res]
+        if len(res) != len(out_specs):
+            raise ValueError(
+                f"py_func forward returned {len(res)} arrays; the op "
+                f"declares {len(out_specs)} outputs"
+            )
         return tuple(np.asarray(r, dtype=spec.dtype) for r, spec in zip(res, out_specs))
 
     if bwd is None:

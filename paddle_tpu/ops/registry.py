@@ -36,6 +36,12 @@ class EmitContext:
         self.mesh = mesh
         # mapping of logical ring_id -> mesh axis name, for collective ops
         self.axis_env = axis_env or {}
+        # (type, fwd input names) -> LIFO of (outs, vjp_fn, fwd_ins):
+        # captured at forward emission, consumed by the generic grad op —
+        # the primal forward is computed ONCE (emitting the backward by
+        # re-tracing would duplicate it; XLA cannot CSE two while loops
+        # whose bodies differ, so a scanned encoder would run twice)
+        self.vjp_cache: Dict[tuple, list] = {}
 
     def rng(self):
         """Split and return a fresh PRNG key (functional rng threading)."""
@@ -79,6 +85,8 @@ class OpSpec:
     no_vjp_grad: bool = False
     # stateless ops whose outputs are never differentiable (compare etc.)
     stop_gradient: bool = False
+    # True for lazily synthesized "<base>_grad" specs (generic vjp)
+    generic_vjp: bool = False
 
 
 _REGISTRY: Dict[str, OpSpec] = {}
@@ -122,7 +130,9 @@ def get(type: str) -> Optional[OpSpec]:
     if type.endswith("_grad"):
         base = _REGISTRY.get(type[: -len("_grad")])
         if base is not None and not base.no_vjp_grad:
-            spec = OpSpec(type=type, emit=_make_generic_grad_emit(base))
+            spec = OpSpec(
+                type=type, emit=_make_generic_grad_emit(base), generic_vjp=True
+            )
             _REGISTRY[type] = spec
             return spec
     return None
@@ -139,8 +149,47 @@ def registered_ops() -> List[str]:
 GRAD = "@GRAD"
 
 
+def _apply_vjp(ins: Ins, outs, vjp_fn, fwd_ins):
+    """Build cotangents from the grad op's "<slot>@GRAD" inputs, run the
+    vjp, and clean the input gradients (zeros for float0/None)."""
+    import jax
+    import jax.numpy as jnp
+
+    cot = {}
+    for slot, vals in outs.items():
+        gs = ins.get(slot + GRAD)
+        cs = []
+        for i, v in enumerate(vals):
+            g = gs[i] if gs is not None and i < len(gs) and gs[i] is not None else None
+            if not jnp.issubdtype(v.dtype, jnp.floating) and not jnp.issubdtype(
+                v.dtype, jnp.complexfloating
+            ):
+                cs.append(np.zeros(v.shape, jax.dtypes.float0))
+            elif g is None:
+                cs.append(jnp.zeros(v.shape, v.dtype))
+            else:
+                cs.append(jnp.asarray(g, v.dtype))
+        cot[slot] = cs
+    (d_ins,) = vjp_fn(cot)
+    result = {}
+    for slot in fwd_ins:
+        gvals = d_ins.get(slot)
+        if gvals is None:
+            continue
+        cleaned = []
+        for g, v in zip(gvals, fwd_ins[slot]):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                cleaned.append(jnp.zeros(jnp.shape(v), jnp.result_type(v)) if v is not None else None)
+            else:
+                cleaned.append(g)
+        result[slot + GRAD] = cleaned
+    return result
+
+
 def _make_generic_grad_emit(base: OpSpec):
-    """Build the emitter for `<base>_grad`.
+    """Build the FALLBACK emitter for `<base>_grad` (used when the primal
+    vjp was not captured — e.g. gradients() called on a block whose
+    forward was emitted in a different trace).
 
     Grad-op convention (established by backward.append_backward):
       inputs : forward inputs under their original slots, plus available
@@ -148,13 +197,11 @@ def _make_generic_grad_emit(base: OpSpec):
       outputs: input grads under "<in_slot>@GRAD"
       attrs  : forward attrs + "__fwd_in_slots__" (list of fwd input slots)
 
-    The emitter re-traces the forward emitter under jax.vjp; XLA CSE folds
-    the duplicated pure forward subgraph with the primal one, so this costs
-    no extra FLOPs at runtime while staying exactly consistent with the
-    forward lowering.
+    The fast path lives in emit_ops: when the forward op of this grad op
+    was emitted in the same trace, its captured (outs, vjp_fn) pair is
+    reused and the forward is NOT re-traced.
     """
     import jax
-    import jax.numpy as jnp
 
     def grad_emit(ctx: EmitContext, ins: Ins, attrs: Attrs):
         fwd_attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
@@ -165,35 +212,7 @@ def _make_generic_grad_emit(base: OpSpec):
             return base.emit(ctx, fi, fwd_attrs)
 
         outs, vjp_fn = jax.vjp(fn, fwd_ins)
-        cot = {}
-        for slot, vals in outs.items():
-            gs = ins.get(slot + GRAD)
-            cs = []
-            for i, v in enumerate(vals):
-                g = gs[i] if gs is not None and i < len(gs) and gs[i] is not None else None
-                if not jnp.issubdtype(v.dtype, jnp.floating) and not jnp.issubdtype(
-                    v.dtype, jnp.complexfloating
-                ):
-                    cs.append(np.zeros(v.shape, jax.dtypes.float0))
-                elif g is None:
-                    cs.append(jnp.zeros(v.shape, v.dtype))
-                else:
-                    cs.append(jnp.asarray(g, v.dtype))
-            cot[slot] = cs
-        (d_ins,) = vjp_fn(cot)
-        result = {}
-        for slot in fwd_ins:
-            gvals = d_ins.get(slot)
-            if gvals is None:
-                continue
-            cleaned = []
-            for g, v in zip(gvals, fwd_ins[slot]):
-                if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
-                    cleaned.append(jnp.zeros(jnp.shape(v), jnp.result_type(v)) if v is not None else None)
-                else:
-                    cleaned.append(g)
-            result[slot + GRAD] = cleaned
-        return result
+        return _apply_vjp(ins, outs, vjp_fn, fwd_ins)
 
     return grad_emit
 
@@ -204,9 +223,49 @@ def _make_generic_grad_emit(base: OpSpec):
 # ---------------------------------------------------------------------------
 
 
+def _attrs_sig(attrs):
+    """Stable signature of forward attrs. The grad desc carries a shallow
+    COPY of the forward attrs (backward.py: dict(op.attrs)), so contained
+    objects (Blocks, callables) are identical and repr() is consistent
+    between the pair."""
+    return tuple(sorted(
+        (k, repr(v)) for k, v in attrs.items() if not k.startswith("__")
+    ))
+
+
+def _fwd_key_from_fwd(op):
+    # attrs are part of the key: two same-type ops over the same inputs
+    # but different attrs (e.g. scale by 2 vs 3) must not share a vjp
+    return (op.type, tuple(sorted(
+        (s, tuple(ns)) for s, ns in op.inputs.items() if ns
+    )), _attrs_sig(op.attrs))
+
+
+def _fwd_key_from_grad(op):
+    slots = op.attrs.get("__fwd_in_slots__", ())
+    return (op.type[: -len("_grad")], tuple(sorted(
+        (s, tuple(op.inputs.get(s, ()))) for s in slots if op.inputs.get(s)
+    )), _attrs_sig(op.attrs))
+
+
 def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
     """Trace a list of framework Operators into JAX values. `env` maps var
-    name -> value and is mutated in place (op outputs land there)."""
+    name -> value and is mutated in place (op outputs land there).
+
+    Primal reuse: forward ops whose generic grad op appears later in the
+    list are emitted under jax.vjp ONCE; the grad op consumes the stored
+    vjp instead of re-tracing the forward (a re-traced scanned encoder
+    would otherwise run twice — XLA cannot CSE differing while loops)."""
+    import jax
+
+    wanted: Dict[tuple, int] = {}
+    for op in ops:
+        if op.type.endswith("_grad"):
+            spec = get(op.type)
+            if spec is not None and spec.generic_vjp:
+                k = _fwd_key_from_grad(op)
+                wanted[k] = wanted.get(k, 0) + 1
+
     for op in ops:
         spec = get(op.type)
         if spec is None:
@@ -223,7 +282,30 @@ def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
                 vals.append(env[n])
             if vals:
                 ins[slot] = vals
-        outs = spec.emit(ctx, ins, op.attrs)
+
+        outs = None
+        if spec.generic_vjp:
+            cached = ctx.vjp_cache.get(_fwd_key_from_grad(op))
+            if cached:
+                f_outs, vjp_fn, fwd_ins = cached.pop()
+                outs = _apply_vjp(ins, f_outs, vjp_fn, fwd_ins)
+        elif (
+            not spec.no_vjp_grad
+            and not spec.stop_gradient
+            and spec.grad_maker is None
+            and wanted.get(_fwd_key_from_fwd(op), 0) > 0
+        ):
+            key = _fwd_key_from_fwd(op)
+            attrs = op.attrs
+
+            def fn(fi, _spec=spec, _attrs=attrs):
+                return _spec.emit(ctx, fi, _attrs)
+
+            outs, vjp_fn = jax.vjp(fn, ins)
+            ctx.vjp_cache.setdefault(key, []).append((outs, vjp_fn, ins))
+            wanted[key] -= 1
+        if outs is None:
+            outs = spec.emit(ctx, ins, op.attrs)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:

@@ -254,3 +254,31 @@ def test_matmul_1d():
     (r,) = exe.run(feed={"a": v, "b": m}, fetch_list=[out])
     assert r.shape == (3,), r.shape
     np.testing.assert_allclose(r, v @ m)
+
+
+def test_same_input_different_attrs_grads_not_confused():
+    """Two same-type ops over the same input with different attrs, where
+    only one gets a grad op (review repro: the vjp cache returned the
+    wrong op's gradient when keyed without attrs)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.backward import append_backward
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 2], append_batch_size=False)
+        y1 = layers.scale(x, scale=2.0)
+        y1.stop_gradient = True  # consumer that never needs grad
+        y2 = layers.scale(x, scale=3.0)
+        loss = layers.mean(y2)
+        append_backward(loss, parameter_list=[x.name])
+        _ = layers.mean(y1)  # keep y1 alive in the program
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                       fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(np.asarray(g), np.full((2, 2), 3.0 / 4.0),
+                               rtol=1e-6)
