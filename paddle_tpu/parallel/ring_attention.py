@@ -12,6 +12,12 @@ previous block (XLA pipelines the ppermute against the einsum).
 The loop is a `lax.scan`, so reverse-mode AD works end-to-end: the
 backward pass rotates cotangents with the transposed permutation that JAX
 derives for ppermute — no custom VJP needed.
+
+Planned (not yet wired): computing each local block with the Pallas
+flash kernel and merging partials by log-sum-exp. It needs a kernel
+core whose custom VJP returns (o, lse) with a d_lse rule; the current
+jnp block math is itself online-softmax and XLA fuses it well, so the
+kernel handoff is an optimization, not a correctness gap.
 """
 from __future__ import annotations
 

@@ -199,3 +199,31 @@ def test_fit_accepts_one_shot_batch_iterator():
     )
     hist = model.fit(gen(), batch_size=8, epochs=3, verbose=0)
     assert len(hist["loss"]) == 3 and hist["loss"][-1] < hist["loss"][0]
+
+
+def test_model_image_classification_cifar():
+    """book/test_image_classification.py shape: small CNN on cifar10 via
+    hapi (synthetic fallback data is prototype-separable)."""
+    from paddle_tpu.dataset import cifar
+
+    samples = list(cifar.train10()())[:1024]
+    x = np.stack([s[0] for s in samples]).astype(np.float32)
+    y = np.asarray([s[1] for s in samples], np.int64)[:, None]
+
+    def net(img):
+        im = layers.reshape(img, [-1, 3, 32, 32])
+        c = layers.conv2d(im, 16, 3, act="relu")
+        p = layers.pool2d(c, 2, pool_stride=2)
+        c2 = layers.conv2d(p, 32, 3, act="relu")
+        p2 = layers.pool2d(c2, 2, pool_stride=2)
+        return layers.fc(p2, 10)
+
+    model = Model(net, Input("img", [64, 3072]), Input("label", [64, 1], "int64"))
+    model.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-3),
+        lambda lg, lb: layers.mean(layers.softmax_with_cross_entropy(lg, lb)),
+        metrics=Accuracy(),
+    )
+    model.fit((x, y), batch_size=64, epochs=4, verbose=0)
+    logs = model.evaluate((x, y), batch_size=64, verbose=0)
+    assert logs["acc"] > 0.9, logs
