@@ -1,5 +1,6 @@
 """fluid.layers namespace. Parity: python/paddle/fluid/layers/__init__.py."""
-from . import control_flow, nn, ops, sequence, tensor  # noqa: F401
+from . import control_flow, detection, nn, ops, sequence, tensor  # noqa: F401
+from .detection import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403

@@ -1,0 +1,69 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py —
+the subset whose ops are implemented: iou_similarity, box_coder,
+prior_box, yolo_box, roi_align)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper, emit_op
+
+__all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
+           "roi_align"]
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return emit_op("iou_similarity", {"X": [x], "Y": [y]},
+                   {"box_normalized": box_normalized})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            attrs["variance"] = [float(v) for v in prior_box_var]
+        else:
+            ins["PriorBoxVar"] = [prior_box_var]
+    return emit_op("box_coder", ins, attrs, out_slots=("OutputBox",))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    outs = emit_op(
+        "prior_box", {"Input": [input], "Image": [image]},
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios), "variances": list(variance),
+         "flip": flip, "clip": clip, "step_w": steps[0], "step_h": steps[1],
+         "offset": offset},
+        out_slots=("Boxes", "Variances"),
+    )
+    return outs[0], outs[1]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
+             downsample_ratio=32, clip_bbox=True, name=None):
+    outs = emit_op(
+        "yolo_box", {"X": [x], "ImgSize": [img_size]},
+        {"anchors": list(anchors), "class_num": class_num,
+         "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio,
+         "clip_bbox": clip_bbox},
+        out_slots=("Boxes", "Scores"),
+    )
+    return outs[0], outs[1]
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              batch_index=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_index is not None:
+        ins["BatchIndex"] = [batch_index]
+    elif rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    return emit_op(
+        "roi_align", ins,
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio},
+    )

@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     compare_ops,
     control_flow_ops,
     creation,
+    detection_ops,
     encoder_stack,
     manipulation,
     math_ops,

@@ -1,0 +1,262 @@
+"""Detection/vision ops (reference paddle/fluid/operators/detection/,
+17k LoC — this is the high-traffic subset: iou_similarity_op.cc,
+box_coder_op.cc, prior_box_op.cc, yolo_box_op.cc, roi_align_op.cc).
+
+All dense/static-shape: ragged per-image ROI lists (LoD in the
+reference) ride as a flat ROI tensor plus a per-ROI batch index, the
+same padded-representation answer used by the sequence ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _iou(x, y, off=0.0):
+    """x [N,4], y [M,4] (xmin,ymin,xmax,ymax) -> [N,M]. off=1 for pixel
+    (non-normalized) boxes, where a 1x1 box has area 1 (reference
+    box_normalized=False semantics)."""
+    area_x = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    area_y = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("iou_similarity")
+def iou_similarity(ctx, ins, attrs):
+    off = 0.0 if attrs.get("box_normalized", True) else 1.0
+    return {"Out": [_iou(ins["X"][0], ins["Y"][0], off)]}
+
+
+@register("box_coder")
+def box_coder(ctx, ins, attrs):
+    """Encode/decode boxes against priors (reference box_coder_op.cc).
+    encode_center_size: target corner boxes -> (dx,dy,dw,dh) deltas;
+    decode_center_size: deltas -> corner boxes. Decode axis semantics
+    follow the reference: axis=0 broadcasts priors over target rows
+    (tb [N,M,4], priors along dim 1); axis=1 broadcasts along dim 0."""
+    prior = ins["PriorBox"][0]  # [M, 4] corner form
+    tb = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    axis = int(attrs.get("axis", 0))
+    box_normalized = bool(attrs.get("box_normalized", True))
+    if ins.get("PriorBoxVar"):
+        var = ins["PriorBoxVar"][0]
+    else:
+        v = attrs.get("variance") or [1.0, 1.0, 1.0, 1.0]
+        var = jnp.asarray(v, prior.dtype)[None, :]
+
+    off = 0.0 if box_normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+
+    if code_type == "encode_center_size":
+        # tb [N,4] corner boxes vs each prior -> [N, M, 4]
+        tw = tb[:, 2] - tb[:, 0] + off
+        th = tb[:, 3] - tb[:, 1] + off
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1) / var[None, :, :]
+        return {"OutputBox": [out]}
+    if code_type == "decode_center_size":
+        # tb [N, M, 4] deltas; priors broadcast along dim (1 - axis)...
+        # axis=0: priors align with tb dim 1; axis=1: with tb dim 0
+        if axis == 0:
+            exp = lambda a: a[None, :]
+            vexp = var[None, :, :]
+        else:
+            exp = lambda a: a[:, None]
+            vexp = var[:, None, :]
+        d = tb * vexp
+        cx = d[..., 0] * exp(pw) + exp(pcx)
+        cy = d[..., 1] * exp(ph) + exp(pcy)
+        w = jnp.exp(d[..., 2]) * exp(pw)
+        h = jnp.exp(d[..., 3]) * exp(ph)
+        out = jnp.stack(
+            [cx - w * 0.5, cy - h * 0.5, cx + w * 0.5 - off, cy + h * 0.5 - off],
+            axis=-1,
+        )
+        return {"OutputBox": [out]}
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+@register("prior_box", stop_gradient=True, no_vjp_grad=True)
+def prior_box(ctx, ins, attrs):
+    """SSD prior boxes over a feature map (reference prior_box_op.cc)."""
+    feat = ins["Input"][0]   # [N, C, H, W]
+    image = ins["Image"][0]  # [N, C, IH, IW]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or iw / w
+    step_h = float(attrs.get("step_h", 0.0)) or ih / h
+    offset = float(attrs.get("offset", 0.5))
+
+    boxes = []
+    for si, ms in enumerate(min_sizes):
+        # reference order per min_size: min, ratios != 1, then ITS max
+        boxes.append((ms, ms))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if si < len(max_sizes):
+            m = float(np.sqrt(ms * max_sizes[si]))
+            boxes.append((m, m))
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([
+            (cxg - bw / 2.0) / iw, (cyg - bh / 2.0) / ih,
+            (cxg + bw / 2.0) / iw, (cyg + bh / 2.0) / ih,
+        ], axis=-1))
+    prior = jnp.stack(out, axis=2)  # [H, W, P, 4]
+    if attrs.get("clip", False):
+        prior = jnp.clip(prior, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), prior.shape
+    )
+    return {"Boxes": [prior], "Variances": [var]}
+
+
+@register("yolo_box", stop_gradient=True, no_vjp_grad=True)
+def yolo_box(ctx, ins, attrs):
+    """Decode YOLOv3 head output to boxes+scores (reference
+    yolo_box_op.cc). X [N, P*(5+C), H, W], ImgSize [N, 2] (h, w)."""
+    x = ins["X"][0]
+    img = ins["ImgSize"][0].astype(jnp.float32)
+    anchors = [int(a) for a in attrs["anchors"]]
+    cls = int(attrs["class_num"])
+    conf_thresh = float(attrs.get("conf_thresh", 0.005))
+    ds = int(attrs.get("downsample_ratio", 32))
+    n, _, h, w = x.shape
+    p = len(anchors) // 2
+    x = x.reshape(n, p, 5 + cls, h, w)
+
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    sig = jax.nn.sigmoid
+    bx = (sig(x[:, :, 0]) + gx[None, None, None, :]) / w   # [N,P,H,W]
+    by = (sig(x[:, :, 1]) + gy[None, None, :, None]) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    bw = jnp.exp(x[:, :, 2]) * aw / (ds * w)
+    bh = jnp.exp(x[:, :, 3]) * ah / (ds * h)
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+
+    img_h = img[:, 0][:, None, None, None]
+    img_w = img[:, 1][:, None, None, None]
+    x0 = (bx - bw / 2.0) * img_w
+    y0 = (by - bh / 2.0) * img_h
+    x1 = (bx + bw / 2.0) * img_w
+    y1 = (by + bh / 2.0) * img_h
+    if attrs.get("clip_bbox", True):
+        x0 = jnp.clip(x0, 0.0, img_w - 1.0)
+        y0 = jnp.clip(y0, 0.0, img_h - 1.0)
+        x1 = jnp.clip(x1, 0.0, img_w - 1.0)
+        y1 = jnp.clip(y1, 0.0, img_h - 1.0)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)  # [N,P,H,W,4]
+    # confidence gate (reference zeroes below-threshold entries)
+    keep = (conf > conf_thresh)[..., None]
+    boxes = jnp.where(keep, boxes, 0.0).reshape(n, p * h * w, 4)
+    scores = jnp.where(keep, probs.transpose(0, 1, 3, 4, 2), 0.0).reshape(
+        n, p * h * w, cls
+    )
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register("roi_align")
+def roi_align(ctx, ins, attrs):
+    """ROI Align (reference roi_align_op.cc): average of bilinear samples
+    on a pooled grid. ROIs [R,4] in input-image coordinates with a per-ROI
+    batch index (RoisNum [N] counts in the reference's LoD style, or a
+    flat BatchIndex [R])."""
+    x = ins["X"][0]          # [N, C, H, W]
+    rois = ins["ROIs"][0]    # [R, 4]
+    r = rois.shape[0]
+    if ins.get("BatchIndex"):
+        bidx = ins["BatchIndex"][0].astype(jnp.int32)
+    elif ins.get("RoisNum"):
+        counts = ins["RoisNum"][0].astype(jnp.int32)
+        bidx = jnp.repeat(
+            jnp.arange(counts.shape[0], dtype=jnp.int32), counts,
+            total_repeat_length=r,
+        )
+    else:
+        bidx = jnp.zeros((r,), jnp.int32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2  # adaptive in the reference; fixed 2 covers common cfgs
+    n, c, h, w = x.shape
+
+    r0 = rois * scale  # [R,4] in feature coords
+    rw = jnp.maximum(r0[:, 2] - r0[:, 0], 1.0)
+    rh = jnp.maximum(r0[:, 3] - r0[:, 1], 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    # sample grid: [R, ph, ratio] y coords and [R, pw, ratio] x coords
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    sy = jnp.arange(ratio, dtype=jnp.float32)
+    ys = (r0[:, 1, None, None] + (iy[None, :, None] +
+          (sy[None, None, :] + 0.5) / ratio) * bin_h[:, None, None])
+    xs = (r0[:, 0, None, None] + (ix[None, :, None] +
+          (sy[None, None, :] + 0.5) / ratio) * bin_w[:, None, None])
+
+    def bilinear(img, yy, xx):
+        """img [C,H,W]; yy,xx [...]: bilinear samples -> [C, ...]."""
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        ly = yy - y0
+        lx = xx - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                v10 * ly * (1 - lx) + v11 * ly * lx)
+
+    def one_roi(roi_ys, roi_xs, b):
+        img = x[b]  # [C,H,W]
+        yy = roi_ys[:, None, :, None]          # [ph,1,ratio,1]
+        xx = roi_xs[None, :, None, :]          # [1,pw,1,ratio]
+        yy = jnp.broadcast_to(yy, (ph, pw, ratio, ratio))
+        xx = jnp.broadcast_to(xx, (ph, pw, ratio, ratio))
+        vals = bilinear(img, yy, xx)           # [C,ph,pw,ratio,ratio]
+        return jnp.mean(vals, axis=(-1, -2))   # [C,ph,pw]
+
+    out = jax.vmap(one_roi)(ys, xs, bidx)      # [R,C,ph,pw]
+    return {"Out": [out]}

@@ -2287,6 +2287,190 @@ def _beam_search():
     )
 
 
+# ---- detection ops ---------------------------------------------------------
+
+
+def _np_iou(x, y):
+    ax = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    ay = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    out = np.zeros((x.shape[0], y.shape[0]), np.float32)
+    for i in range(x.shape[0]):
+        for j in range(y.shape[0]):
+            iw = min(x[i, 2], y[j, 2]) - max(x[i, 0], y[j, 0])
+            ih = min(x[i, 3], y[j, 3]) - max(x[i, 1], y[j, 1])
+            inter = max(iw, 0) * max(ih, 0)
+            u = ax[i] + ay[j] - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def _boxes(rng, n):
+    xy = rng.rand(n, 2).astype(np.float32)
+    wh = rng.rand(n, 2).astype(np.float32) * 0.5 + 0.05
+    return np.concatenate([xy, xy + wh], 1)
+
+
+@case("iou_similarity")
+def _iou_sim():
+    rng = R(741)
+    return OpTest(
+        "iou_similarity", {"X": _boxes(rng, 5), "Y": _boxes(rng, 3)},
+        lambda ins, a: {"Out": [_np_iou(ins["X"][0], ins["Y"][0])]},
+        tol=1e-5,
+    )
+
+
+@case("box_coder")
+def _box_coder_roundtrip():
+    rng = R(743)
+    prior = _boxes(rng, 4)
+    target = _boxes(rng, 3)
+    var = np.asarray([0.1, 0.1, 0.2, 0.2], np.float32)
+
+    def oracle(ins, a):
+        p, t = ins["PriorBox"][0], ins["TargetBox"][0]
+        pw = p[:, 2] - p[:, 0]; ph = p[:, 3] - p[:, 1]
+        pcx = p[:, 0] + pw / 2; pcy = p[:, 1] + ph / 2
+        tw = t[:, 2] - t[:, 0]; th = t[:, 3] - t[:, 1]
+        tcx = t[:, 0] + tw / 2; tcy = t[:, 1] + th / 2
+        out = np.zeros((t.shape[0], p.shape[0], 4), np.float32)
+        for i in range(t.shape[0]):
+            for j in range(p.shape[0]):
+                out[i, j] = [
+                    (tcx[i] - pcx[j]) / pw[j] / var[0],
+                    (tcy[i] - pcy[j]) / ph[j] / var[1],
+                    np.log(tw[i] / pw[j]) / var[2],
+                    np.log(th[i] / ph[j]) / var[3],
+                ]
+        return {"OutputBox": [out]}
+
+    return OpTest(
+        "box_coder", {"PriorBox": prior, "TargetBox": target},
+        oracle, attrs={"code_type": "encode_center_size",
+                       "box_normalized": True,
+                       "variance": [0.1, 0.1, 0.2, 0.2]},
+        outputs={"OutputBox": 1}, tol=1e-4,
+    )
+
+
+@case("prior_box")
+def _prior_box():
+    rng = R(747)
+    feat = f32(rng.rand(1, 8, 2, 3))
+    img = f32(rng.rand(1, 3, 64, 96))
+
+    def oracle(ins, a):
+        h, w, ih, iw = 2, 3, 64, 96
+        step_h, step_w = ih / h, iw / w
+        shapes = [(20.0, 20.0), (20.0 * np.sqrt(2.0), 20.0 / np.sqrt(2.0)),
+                  (np.sqrt(20.0 * 40.0), np.sqrt(20.0 * 40.0))]
+        boxes = np.zeros((h, w, 3, 4), np.float32)
+        for yy in range(h):
+            for xx in range(w):
+                cx = (xx + 0.5) * step_w
+                cy = (yy + 0.5) * step_h
+                for k, (bw, bh) in enumerate(shapes):
+                    boxes[yy, xx, k] = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                                        (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+        var = np.broadcast_to(
+            np.asarray([0.1, 0.1, 0.2, 0.2], np.float32), boxes.shape
+        ).copy()
+        return {"Boxes": [boxes], "Variances": [var]}
+
+    return OpTest(
+        "prior_box", {"Input": feat, "Image": img}, oracle,
+        attrs={"min_sizes": [20.0], "max_sizes": [40.0],
+               "aspect_ratios": [2.0], "flip": False,
+               "variances": [0.1, 0.1, 0.2, 0.2]},
+        outputs={"Boxes": 1, "Variances": 1}, tol=1e-4,
+    )
+
+
+@case("yolo_box")
+def _yolo_box():
+    rng = R(751)
+    n, p_, cls, h, w = 1, 2, 3, 2, 2
+    x = f32(rng.randn(n, p_ * (5 + cls), h, w) * 0.5)
+    img = np.asarray([[64, 96]], np.int32)
+
+    def oracle(ins, a):
+        sig = lambda z: 1 / (1 + np.exp(-z))
+        xx = ins["X"][0].reshape(n, p_, 5 + cls, h, w)
+        anchors = [10, 14, 23, 27]
+        boxes = np.zeros((n, p_, h, w, 4), np.float32)
+        scores = np.zeros((n, p_, h, w, cls), np.float32)
+        for pi in range(p_):
+            for yy in range(h):
+                for xc in range(w):
+                    t = xx[0, pi, :, yy, xc]
+                    bx = (sig(t[0]) + xc) / w
+                    by = (sig(t[1]) + yy) / h
+                    bw = np.exp(t[2]) * anchors[2 * pi] / (32.0 * w)
+                    bh = np.exp(t[3]) * anchors[2 * pi + 1] / (32.0 * h)
+                    conf = sig(t[4])
+                    b = [np.clip((bx - bw / 2) * 96, 0, 95),
+                         np.clip((by - bh / 2) * 64, 0, 63),
+                         np.clip((bx + bw / 2) * 96, 0, 95),
+                         np.clip((by + bh / 2) * 64, 0, 63)]
+                    if conf > 0.5:
+                        boxes[0, pi, yy, xc] = b
+                        scores[0, pi, yy, xc] = sig(t[5:]) * conf
+        return {"Boxes": [boxes.reshape(n, -1, 4)],
+                "Scores": [scores.reshape(n, -1, cls)]}
+
+    return OpTest(
+        "yolo_box", {"X": x, "ImgSize": img}, oracle,
+        attrs={"anchors": [10, 14, 23, 27], "class_num": cls,
+               "conf_thresh": 0.5, "downsample_ratio": 32},
+        outputs={"Boxes": 1, "Scores": 1}, tol=1e-4,
+    )
+
+
+@case("roi_align")
+def _roi_align():
+    rng = R(757)
+    x = f32(rng.rand(2, 3, 8, 8))
+    rois = f32([[0.0, 0.0, 4.0, 4.0], [2.0, 2.0, 6.0, 6.0]])
+    bidx = np.asarray([0, 1], np.int32)
+
+    def oracle(ins, a):
+        xx, rr = ins["X"][0], ins["ROIs"][0]
+        ph = pw = 2
+        ratio = 2
+        out = np.zeros((2, 3, ph, pw), np.float32)
+
+        def bil(img, yy, xx_):
+            yy = np.clip(yy, 0, 7); xx_ = np.clip(xx_, 0, 7)
+            y0, x0 = int(np.floor(yy)), int(np.floor(xx_))
+            y1, x1 = min(y0 + 1, 7), min(x0 + 1, 7)
+            ly, lx = yy - y0, xx_ - x0
+            return (img[:, y0, x0] * (1 - ly) * (1 - lx) +
+                    img[:, y0, x1] * (1 - ly) * lx +
+                    img[:, y1, x0] * ly * (1 - lx) +
+                    img[:, y1, x1] * ly * lx)
+
+        for ri, (roi, b) in enumerate(zip(rr, [0, 1])):
+            rw = max(roi[2] - roi[0], 1.0); rh = max(roi[3] - roi[1], 1.0)
+            bw, bh = rw / pw, rh / ph
+            for i in range(ph):
+                for j in range(pw):
+                    acc = np.zeros(3, np.float32)
+                    for si in range(ratio):
+                        for sj in range(ratio):
+                            yy = roi[1] + (i + (si + 0.5) / ratio) * bh
+                            xx_ = roi[0] + (j + (sj + 0.5) / ratio) * bw
+                            acc += bil(xx[b], yy, xx_)
+                    out[ri, :, i, j] = acc / (ratio * ratio)
+        return {"Out": [out]}
+
+    return OpTest(
+        "roi_align", {"X": x, "ROIs": rois, "BatchIndex": bidx}, oracle,
+        attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0,
+               "sampling_ratio": 2},
+        grad=("X",), tol=1e-4, grad_tol=2e-2,
+    )
+
+
 # ---- fake quantization -----------------------------------------------------
 
 
