@@ -11,6 +11,7 @@ from . import jit, nn, parallel  # noqa: F401
 from .jit import (  # noqa: F401
     ProgramTranslator,
     TracedLayer,
+    TranslatedLayer,
     declarative,
     to_static,
 )

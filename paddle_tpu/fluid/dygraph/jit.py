@@ -289,3 +289,76 @@ class ProgramTranslator:
     def get_output(self, fn, *args):
         sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
         return sf(*args)
+
+
+class TranslatedLayer:
+    """A saved inference model callable from dygraph (reference
+    fluid/dygraph/io.py TranslatedLayer, returned by jit.load)."""
+
+    def __init__(self, dirname, model_filename=None, params_filename=None,
+                 decrypt_key=None):
+        from .. import executor as executor_mod
+        from .. import io
+        from ..executor import Executor, Scope
+
+        self._exe = Executor()
+        self._scope = Scope()
+        with executor_mod.scope_guard(self._scope):
+            prog, feeds, fetches = io.load_inference_model(
+                dirname, self._exe, model_filename=model_filename,
+                params_filename=params_filename, decrypt_key=decrypt_key,
+            )
+        self._program = prog
+        self._feed_names = list(feeds)
+        self._fetch_names = [v.name for v in fetches]
+
+    def __call__(self, *inputs):
+        from .. import executor as executor_mod
+
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                f"expected {len(self._feed_names)} inputs "
+                f"({self._feed_names}), got {len(inputs)}"
+            )
+        feed = {
+            n: np.asarray(a.value if isinstance(a, VarBase) else a)
+            for n, a in zip(self._feed_names, inputs)
+        }
+        with executor_mod.scope_guard(self._scope):
+            outs = self._exe.run(
+                self._program, feed=feed, fetch_list=self._fetch_names
+            )
+        outs = [VarBase(o, stop_gradient=True) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise NotImplementedError(
+            "TranslatedLayer is inference-only (the saved model is the "
+            "pruned forward graph); retrain from the original Layer"
+        )
+
+
+def load(dirname, model_filename=None, params_filename=None,
+         decrypt_key=None):
+    """jit.load (reference fluid/dygraph/jit.py load / io.py
+    TranslatedLayer): load a saved inference model as a callable."""
+    return TranslatedLayer(dirname, model_filename, params_filename,
+                           decrypt_key=decrypt_key)
+
+
+def save(layer, path, input_spec=None):
+    """jit.save: trace (if needed) and export (reference jit.save).
+    `layer` is a TracedLayer (already traced) or a dygraph Layer plus
+    input_spec example inputs."""
+    if isinstance(layer, TracedLayer):
+        layer.save_inference_model(path)
+        return
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec examples for a raw Layer")
+    # trace directly: TracedLayer.trace would also run a redundant eager
+    # forward just to return outputs that save discards
+    _, cp = _trace(lambda *a: layer(*a), list(input_spec))
+    TracedLayer(cp).save_inference_model(path)

@@ -175,9 +175,13 @@ class BatchNorm(Layer):
             ["Y", "MeanOut", "VarianceOut"],
         )
         y, mean_out, var_out = outs[0], outs[1], outs[2]
-        # running stats update (buffers are plain values, not graph state)
-        self._mean.value = mean_out.value
-        self._variance.value = var_out.value
+        # running stats update (buffers are plain values, not graph
+        # state); under the ProgramTracer the outputs are static
+        # Variables — the traced program carries the stats through the
+        # batch_norm op itself, so no eager assignment happens
+        if isinstance(mean_out, VarBase):
+            self._mean.value = mean_out.value
+            self._variance.value = var_out.value
         return y
 
 

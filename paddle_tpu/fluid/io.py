@@ -32,7 +32,8 @@ def _param_names(program) -> List[str]:
     return [p.name for p in program.all_parameters()]
 
 
-def _save_arrays(dirname: str, names: List[str], scope, filename: Optional[str] = None):
+def _save_arrays(dirname: str, names: List[str], scope,
+                 filename: Optional[str] = None, encrypt_key=None):
     os.makedirs(dirname, exist_ok=True)
     arrays = {}
     for n in names:
@@ -40,18 +41,46 @@ def _save_arrays(dirname: str, names: List[str], scope, filename: Optional[str] 
         if v is None:
             raise RuntimeError(f"variable {n!r} not found in scope; nothing to save")
         arrays[n] = np.asarray(v)
+
+    def _write(path, dump):
+        import io as _io
+
+        buf = _io.BytesIO()
+        dump(buf)
+        blob = buf.getvalue()
+        if encrypt_key is not None:
+            from . import crypto
+
+            blob = crypto.encrypt_bytes(blob, encrypt_key)
+        with open(path, "wb") as f:
+            f.write(blob)
+
     if filename is not None:
-        np.savez(os.path.join(dirname, filename), **arrays)
+        _write(os.path.join(dirname, filename),
+               lambda b: np.savez(b, **arrays))
     else:
         for n, a in arrays.items():
-            np.save(os.path.join(dirname, n.replace("/", "__slash__") + ".npy"), a)
+            _write(os.path.join(dirname, n.replace("/", "__slash__") + ".npy"),
+                   lambda b, _a=a: np.save(b, _a))
 
 
-def _load_arrays(dirname: str, names: List[str], scope, filename: Optional[str] = None):
+def _load_arrays(dirname: str, names: List[str], scope,
+                 filename: Optional[str] = None, decrypt_key=None):
+    import io as _io
+
     import jax.numpy as jnp
 
+    def _read(path):
+        with open(path, "rb") as f:
+            blob = f.read()
+        if decrypt_key is not None:
+            from . import crypto
+
+            blob = crypto.decrypt_bytes(blob, decrypt_key)
+        return _io.BytesIO(blob)
+
     if filename is not None:
-        with np.load(os.path.join(dirname, filename)) as z:
+        with np.load(_read(os.path.join(dirname, filename))) as z:
             found = {n: z[n] for n in names if n in z.files}
             missing = [n for n in names if n not in z.files]
     else:
@@ -59,7 +88,7 @@ def _load_arrays(dirname: str, names: List[str], scope, filename: Optional[str] 
         for n in names:
             p = os.path.join(dirname, n.replace("/", "__slash__") + ".npy")
             if os.path.exists(p):
-                found[n] = np.load(p)
+                found[n] = np.load(_read(p))
             else:
                 missing.append(n)
     if missing:
@@ -201,36 +230,59 @@ def save_inference_model(
     main_program=None,
     model_filename=None,
     params_filename=None,
+    encrypt_key=None,
 ):
-    """reference io.py:1164 — prune to the inference subgraph + save params."""
+    """reference io.py:1164 — prune to the inference subgraph + save params.
+    encrypt_key: AES-encrypt the serialized program (reference
+    framework/io/crypto cipher applied at save time)."""
     program = main_program or framework.default_main_program()
     pruned = _prune_for_inference(program, feeded_var_names, target_vars)
     os.makedirs(dirname, exist_ok=True)
     model_filename = model_filename or "__model__"
+    blob = _serialize_program(pruned)
+    if encrypt_key is not None:
+        from . import crypto
+
+        blob = crypto.encrypt_bytes(blob, encrypt_key)
     with open(os.path.join(dirname, model_filename), "wb") as f:
-        f.write(_serialize_program(pruned))
+        f.write(blob)
     fetch_names = [
         v.name if isinstance(v, framework.Variable) else str(v) for v in target_vars
     ]
     with open(os.path.join(dirname, "__meta__.json"), "w") as f:
         json.dump({"feed_names": list(feeded_var_names), "fetch_names": fetch_names}, f)
-    # save only params reachable in the pruned graph
+    # save every persistable reachable in the pruned graph — Parameters
+    # AND buffers (BatchNorm running stats, traced constants); a
+    # Parameters-only filter would silently drop buffers and make the
+    # model unloadable
     used = {n for op in pruned.global_block().ops for n in op.input_names()}
-    pnames = [n for n in _param_names(program) if n in used]
-    _save_arrays(dirname, pnames, global_scope(), params_filename)
+    pnames = [
+        v.name for v in pruned.list_vars() if v.persistable and v.name in used
+    ]
+    _save_arrays(dirname, pnames, global_scope(), params_filename,
+                 encrypt_key=encrypt_key)
     return fetch_names
 
 
-def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, decrypt_key=None):
     """reference io.py:1374 — returns (program, feed_names, fetch_vars)."""
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "rb") as f:
-        program = _deserialize_program(f.read())
+        blob = f.read()
+    if decrypt_key is not None:
+        from . import crypto
+
+        blob = crypto.decrypt_bytes(blob, decrypt_key)
+    program = _deserialize_program(blob)
     with open(os.path.join(dirname, "__meta__.json")) as f:
         meta = json.load(f)
     used = {n for op in program.global_block().ops for n in op.input_names()}
-    pnames = [p.name for p in program.all_parameters() if p.name in used]
-    _load_arrays(dirname, pnames, global_scope(), params_filename)
+    pnames = [
+        v.name for v in program.list_vars() if v.persistable and v.name in used
+    ]
+    _load_arrays(dirname, pnames, global_scope(), params_filename,
+                 decrypt_key=decrypt_key)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
 
