@@ -68,7 +68,7 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", 32))
     seq = int(os.environ.get("BENCH_SEQ", 512))
     max_preds = 76
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
 
     main_p = fluid.Program()

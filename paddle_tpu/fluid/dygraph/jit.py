@@ -25,6 +25,14 @@ from . import base
 from .base import Tracer, VarBase
 
 
+# out slot -> in slot pairs the op updates IN PLACE (the reference op
+# descs alias these; the traced program must write back to the same var
+# so persistable state advances and syncs to the eager buffers)
+_INPLACE_SLOTS = {
+    "batch_norm": {"MeanOut": "Mean", "VarianceOut": "Variance"},
+}
+
+
 class ProgramTracer(Tracer):
     """Tracer that builds a static Program from dygraph op calls."""
 
@@ -72,9 +80,17 @@ class ProgramTracer(Tracer):
         for slot, vs in inputs.items():
             if vs:
                 in_names[slot] = [self.lift(v).name for v in vs]
+        inplace = _INPLACE_SLOTS.get(type, {})
         out_names: Dict[str, List[str]] = {}
         outputs: Dict[str, List[framework.Variable]] = {}
         for slot in out_slots:
+            src_slot = inplace.get(slot)
+            if src_slot and in_names.get(src_slot):
+                # write back onto the input var: running state advances
+                # inside the program and syncs to the eager buffer via
+                # parameter_sources
+                out_names[slot] = [in_names[src_slot][0]]
+                continue
             n = unique_name.generate(f"traced_{type}_{slot}")
             block.create_var(name=n)
             out_names[slot] = [n]
@@ -245,7 +261,8 @@ class TracedLayer:
             )
         return [VarBase(o) for o in outs]
 
-    def save_inference_model(self, path, feed=None, fetch=None):
+    def save_inference_model(self, path, feed=None, fetch=None,
+                             encrypt_key=None):
         from .. import executor as executor_mod
         from .. import io
 
@@ -258,6 +275,7 @@ class TracedLayer:
                 cp.outputs,
                 self._exe,
                 main_program=cp.main_program,
+                encrypt_key=encrypt_key,
             )
 
 
@@ -349,16 +367,17 @@ def load(dirname, model_filename=None, params_filename=None,
                            decrypt_key=decrypt_key)
 
 
-def save(layer, path, input_spec=None):
+def save(layer, path, input_spec=None, encrypt_key=None):
     """jit.save: trace (if needed) and export (reference jit.save).
     `layer` is a TracedLayer (already traced) or a dygraph Layer plus
-    input_spec example inputs."""
+    input_spec example inputs. encrypt_key pairs with
+    jit.load(..., decrypt_key=...)."""
     if isinstance(layer, TracedLayer):
-        layer.save_inference_model(path)
+        layer.save_inference_model(path, encrypt_key=encrypt_key)
         return
     if input_spec is None:
         raise ValueError("jit.save needs input_spec examples for a raw Layer")
     # trace directly: TracedLayer.trace would also run a redundant eager
     # forward just to return outputs that save discards
     _, cp = _trace(lambda *a: layer(*a), list(input_spec))
-    TracedLayer(cp).save_inference_model(path)
+    TracedLayer(cp).save_inference_model(path, encrypt_key=encrypt_key)

@@ -157,7 +157,7 @@ class BatchNorm(Layer):
         self._variance = self.register_buffer("_variance", VarBase(np.ones(num_channels, dtype), persistable=True))
 
     def forward(self, x):
-        outs = framework_trace = _trace_op(
+        outs = _trace_op(
             "batch_norm",
             {
                 "X": [x],

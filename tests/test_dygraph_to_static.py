@@ -224,3 +224,22 @@ def test_to_static_shares_live_parameters():
         eager2 = net(dygraph.to_variable(x)).numpy()
     assert not np.allclose(out1, out2)
     np.testing.assert_allclose(out2, eager2, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_batchnorm_updates_running_stats():
+    """Training-mode BatchNorm traced by to_static must advance its
+    running statistics and sync them back to the eager buffers (review
+    finding: the traced program wrote stats to fresh vars)."""
+    rng = np.random.RandomState(7)
+    x = (rng.randn(16, 3) * 2 + 5).astype(np.float32)
+    with dygraph.guard():
+        bn = dygraph.nn.BatchNorm(3)
+        bn.train()
+        sfn = to_static(lambda v: bn(v))
+        before = bn._mean.numpy().copy()
+        for _ in range(5):
+            sfn(dygraph.to_variable(x))
+        after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+    # stats moved toward the batch mean (~5)
+    assert (after > 1.0).all(), after

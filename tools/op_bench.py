@@ -1,0 +1,90 @@
+"""Op micro-benchmark harness (reference operators/benchmark/op_tester.cc:
+config-driven single-op timing).
+
+Usage:
+    python tools/op_bench.py matmul --shape X=1024x1024 --shape Y=1024x1024
+    python tools/op_bench.py softmax --shape X=4096x4096 --repeat 50
+    python tools/op_bench.py conv2d --shape Input=8x64x56x56 \
+        --shape Filter=128x64x3x3 --attr strides=1,1 --out Output
+
+Builds a one-op Program, runs it through the real Executor (whole-block
+XLA), and reports steady-state latency after a compile warmup.
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def _parse_shape(s):
+    name, dims = s.split("=")
+    return name, tuple(int(d) for d in dims.lower().split("x"))
+
+
+def _parse_attr(s):
+    k, v = s.split("=", 1)
+    try:
+        vals = [float(x) if "." in x else int(x) for x in v.split(",")]
+        return k, vals if len(vals) > 1 else vals[0]
+    except ValueError:
+        return k, v
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("op_type")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="slot=AxBxC (float32 random input)")
+    ap.add_argument("--attr", action="append", default=[])
+    ap.add_argument("--out", default="Out", help="output slot name")
+    ap.add_argument("--repeat", type=int, default=100)
+    args = ap.parse_args()
+
+    import paddle_tpu.fluid as fluid
+
+    shapes = dict(_parse_shape(s) for s in args.shape)
+    attrs = dict(_parse_attr(a) for a in args.attr)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        block = main_p.global_block()
+        rng = np.random.RandomState(0)
+        feed = {}
+        ins = {}
+        for slot, shape in shapes.items():
+            n = f"in_{slot}"
+            block.create_var(name=n, shape=shape, dtype=np.float32)
+            feed[n] = rng.rand(*shape).astype(np.float32)
+            ins[slot] = [n]
+        block.create_var(name="out")
+        block.append_op(type=args.op_type, inputs=ins,
+                        outputs={args.out: ["out"]}, attrs=attrs)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    import jax
+
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    (o,) = exe.run(main_p, feed=feed, fetch_list=["out"])  # compile
+    np.asarray(o)
+    t0 = time.perf_counter()
+    for _ in range(args.repeat):
+        (o,) = exe.run(main_p, feed=feed, fetch_list=["out"],
+                       return_numpy=False)
+    np.asarray(o)
+    dt = (time.perf_counter() - t0) / args.repeat
+    print(json.dumps({
+        "op": args.op_type,
+        "shapes": {k: list(v) for k, v in shapes.items()},
+        "attrs": {k: v for k, v in attrs.items()},
+        "latency_us": round(dt * 1e6, 2),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()
