@@ -207,8 +207,9 @@ def _reject_unsupported(strategy):
         raise NotImplementedError(
             "strategy.localsgd: GSPMD keeps parameters replicated, so "
             "per-worker divergent weights (transpiler/collective.py:270) "
-            "need the manual-SPMD executor mode, which is not implemented "
-            "yet — use gradient_merge for fewer optimizer steps instead"
+            "cannot exist in the static executor; use "
+            "fluid.dygraph.parallel.LocalSGD on the eager multi-process "
+            "path, or gradient_merge for fewer optimizer steps"
         )
     if strategy.elastic:
         raise NotImplementedError(

@@ -15,7 +15,7 @@ from .jit import (  # noqa: F401
     declarative,
     to_static,
 )
-from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .parallel import DataParallel, LocalSGD, ParallelEnv, prepare_context  # noqa: F401
 from .base import (  # noqa: F401
     VarBase,
     Tracer,

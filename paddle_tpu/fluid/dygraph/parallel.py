@@ -109,3 +109,55 @@ class DataParallel(Layer):
             if p.grad is None:
                 continue
             p.grad = comm(p.grad)
+
+
+class LocalSGD:
+    """LocalSGD for multi-process dygraph training (reference
+    transpiler/collective.py:270 LocalSGD transpile: train k steps on
+    LOCAL gradients, then average parameters across workers).
+
+    This lives on the dygraph path because it is the one place per-worker
+    divergent parameters exist: the GSPMD static executor keeps params
+    replicated by construction (fleet raises for strategy.localsgd and
+    points here).
+
+        dp = DataParallel(net)            # no per-step grad allreduce
+        lsgd = LocalSGD(dp, k_steps=4)
+        for batch in data:
+            loss = ...; loss.backward()
+            opt.minimize(loss); net.clear_gradients()
+            lsgd.step()                   # averages params every k steps
+
+    comm: injectable per-tensor mean (tests); defaults to the
+    process_allgather mean across workers.
+    """
+
+    def __init__(self, layers: Layer, k_steps: int = 1,
+                 comm: Optional[Callable] = None):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._layers = layers
+        self._k = int(k_steps)
+        self._comm = comm
+        self._step = 0
+
+    def _average(self, value):
+        if self._comm is not None:
+            return self._comm(value)
+        if get_world_size() <= 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(
+            value[None], tiled=True
+        ).mean(axis=0)
+
+    def step(self) -> bool:
+        """Call once per optimizer step; averages parameters on every
+        k-th call. Returns True when a sync happened."""
+        self._step += 1
+        if self._step % self._k != 0:
+            return False
+        for p in self._layers.parameters():
+            p.value = self._average(p.value)
+        return True

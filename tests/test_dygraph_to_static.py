@@ -243,3 +243,44 @@ def test_to_static_batchnorm_updates_running_stats():
     assert not np.allclose(before, after)
     # stats moved toward the batch mean (~5)
     assert (after > 1.0).all(), after
+
+
+def test_local_sgd_averages_every_k_steps():
+    """LocalSGD: k-1 local steps, then a parameter average (reference
+    transpiler/collective.py LocalSGD). Simulated with an injected comm
+    that records what it was asked to average."""
+    from paddle_tpu.fluid.dygraph import LocalSGD
+
+    rng = np.random.RandomState(8)
+    x = rng.randn(4, 4).astype(np.float32)
+
+    with dygraph.guard():
+        net = MLP()
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.05, parameter_list=net.parameters()
+        )
+        averaged = []
+
+        def comm(v):
+            averaged.append(np.asarray(v).copy())
+            return v * 0.5  # distinguishable "averaged" value
+
+        lsgd = LocalSGD(net, k_steps=2, comm=comm)
+        synced = []
+        for _ in range(4):
+            out = net(dygraph.to_variable(x))
+            loss = _mean(out * out)
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            synced.append(lsgd.step())
+
+        assert synced == [False, True, False, True]
+        n_params = len(net.parameters())
+        assert len(averaged) == 2 * n_params  # two syncs, all params
+        # after the last sync every param holds the comm's output
+        for p_ in net.parameters():
+            assert np.abs(np.asarray(p_.value)).max() < 10  # sane values
+
+    with pytest.raises(ValueError, match="k_steps"):
+        LocalSGD(net, k_steps=0)

@@ -89,7 +89,7 @@ def test_sharding_shards_optimizer_state_and_matches():
 
 @pytest.mark.parametrize("flag,msg", [
     ("dgc", "ICI"),
-    ("localsgd", "manual-SPMD"),
+    ("localsgd", "dygraph.parallel.LocalSGD"),
     ("elastic", "checkpoint"),
     ("auto", "mesh_axes"),
 ])
