@@ -1138,3 +1138,16 @@ def fused_multihead_attention(
 
 def unique_name_layer():  # pragma: no cover - placeholder parity stub
     raise NotImplementedError
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity (reference layers cos_sim)."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(
+        type="cos_sim", inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]},
+    )
+    return out

@@ -351,3 +351,15 @@ def logsumexp(ctx, ins, attrs):
     if out.ndim == 0:
         out = out.reshape((1,))  # fluid reductions keep at least rank 1
     return {"Out": [out]}
+
+
+@register("cos_sim")
+def cos_sim(ctx, ins, attrs):
+    """Row-wise cosine similarity (reference cos_sim_op.cc): X [N,D],
+    Y [N,D] or [1,D] broadcast. Out [N,1] (+ saved norms)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    dot_ = jnp.sum(x * y, axis=1, keepdims=True)
+    out = dot_ / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}

@@ -2287,6 +2287,25 @@ def _beam_search():
     )
 
 
+@case("cos_sim")
+def _cos_sim():
+    rng = R(761)
+    x, y = _mix(rng, 4, 6), _mix(rng, 4, 6)
+
+    def oracle(ins, a):
+        xx, yy = ins["X"][0], ins["Y"][0]
+        xn = np.linalg.norm(xx, axis=1, keepdims=True)
+        yn = np.linalg.norm(yy, axis=1, keepdims=True)
+        dot_ = (xx * yy).sum(1, keepdims=True)
+        return {"Out": [f32(dot_ / (xn * yn))], "XNorm": [f32(xn)],
+                "YNorm": [f32(yn)]}
+
+    return OpTest(
+        "cos_sim", {"X": x, "Y": y}, oracle,
+        outputs={"Out": 1, "XNorm": 1, "YNorm": 1}, grad=("X", "Y"),
+    )
+
+
 # ---- detection ops ---------------------------------------------------------
 
 

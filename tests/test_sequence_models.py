@@ -226,3 +226,82 @@ def test_beam_search_decode_loop():
             cur, cur_sc = np.asarray(i), np.asarray(s_)
             toks.append(cur[0, 0])
         assert toks == [2, 3, 4, 5], toks
+
+
+def test_word2vec_trains():
+    """book/test_word2vec.py shape: N-gram context -> next word via
+    shared embeddings; loss memorizes a tiny corpus."""
+    rng = np.random.RandomState(4)
+    V, E, B = 40, 16, 32
+    # synthetic corpus with strong 3-gram structure
+    corpus = rng.randint(0, V, 300)
+    ctxs, tgts = [], []
+    for i in range(len(corpus) - 3):
+        ctxs.append(corpus[i:i + 3])
+        tgts.append(corpus[(i * 7) % V])  # deterministic mapping to learn
+    ctx = np.asarray(ctxs[:B * 4], np.int64)
+    tgt = np.asarray(tgts[:B * 4], np.int64)[:, None]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = layers.data("ctx", [B, 3], dtype="int64", append_batch_size=False)
+        y = layers.data("y", [B, 1], dtype="int64", append_batch_size=False)
+        emb = layers.embedding(w, size=[V, E],
+                               param_attr=fluid.ParamAttr(name="shared_emb"))
+        flat = layers.reshape(emb, [B, 3 * E])
+        hidden = layers.fc(flat, 64, act="relu")
+        logits = layers.fc(hidden, V)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        losses = []
+        for epoch in range(25):
+            for s in range(0, len(ctx) - B + 1, B):
+                (lv,) = exe.run(main, feed={"ctx": ctx[s:s + B], "y": tgt[s:s + B]},
+                                fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_recommender_system_trains():
+    """book/test_recommender_system.py shape: user+item towers, cosine
+    similarity scaled to a rating prediction."""
+    rng = np.random.RandomState(5)
+    B, NU, NI, E = 32, 50, 60, 16
+    users = rng.randint(0, NU, (B * 4,)).astype(np.int64)
+    items = rng.randint(0, NI, (B * 4,)).astype(np.int64)
+    # learnable synthetic ratings from latent structure
+    u_lat = rng.randn(NU, 4); i_lat = rng.randn(NI, 4)
+    ratings = np.clip(
+        ((u_lat[users] * i_lat[items]).sum(1, keepdims=True) + 2.5), 0, 5
+    ).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        u = layers.data("u", [B], dtype="int64", append_batch_size=False)
+        it = layers.data("i", [B], dtype="int64", append_batch_size=False)
+        r = layers.data("r", [B, 1], append_batch_size=False)
+        ue = layers.fc(layers.embedding(u, size=[NU, E]), 32, act="relu")
+        ie = layers.fc(layers.embedding(it, size=[NI, E]), 32, act="relu")
+        sim = layers.cos_sim(ue, ie)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.mean(layers.square_error_cost(pred, r))
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        losses = []
+        for epoch in range(30):
+            for s in range(0, len(users) - B + 1, B):
+                (lv,) = exe.run(
+                    main,
+                    feed={"u": users[s:s + B], "i": items[s:s + B],
+                          "r": ratings[s:s + B]},
+                    fetch_list=[loss],
+                )
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
