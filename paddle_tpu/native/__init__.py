@@ -234,7 +234,8 @@ _capi_err: str | None = None
 def load_capi():
     """Build (if needed) and dlopen the C inference API with ctypes
     signatures attached. In-process use shares the running interpreter;
-    external C/Go clients link libpython themselves."""
+    for standalone C/Go clients the shim links libpython itself and
+    self-initializes the embedded interpreter on first use."""
     global _capi_lib, _capi_err
     with _lock:
         if _capi_lib is not None or _capi_err is not None:
@@ -243,7 +244,23 @@ def load_capi():
             import sysconfig
 
             inc = sysconfig.get_paths()["include"]
-            so = _build_so(_CAPI_SRC, "libpaddle_tpu_capi", (f"-I{inc}",))
+            # link libpython so STANDALONE (non-Python) consumers can
+            # dlopen the shim; a static-Python build (no shared
+            # libpython) falls back to the symbol-resolving in-process
+            # form, which needs no linking
+            libdir = sysconfig.get_config_var("LIBDIR") or ""
+            pyver = sysconfig.get_config_var("LDVERSION") or ""
+            libs = []
+            if libdir:
+                libs.append(f"-L{libdir}")
+            if pyver:
+                libs.append(f"-lpython{pyver}")
+            try:
+                so = _build_so(_CAPI_SRC, "libpaddle_tpu_capi",
+                               (f"-I{inc}", *libs))
+            except subprocess.CalledProcessError:
+                so = _build_so(_CAPI_SRC, "libpaddle_tpu_capi_inproc",
+                               (f"-I{inc}",))
             lib = ctypes.CDLL(so)
             lib.PD_PredictorCreate.restype = ctypes.c_void_p
             lib.PD_PredictorCreate.argtypes = [

@@ -12,6 +12,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -55,8 +56,24 @@ extern "C" {
 // Create a predictor from a saved inference model directory.
 // Returns nullptr on failure (err, if non-null, receives a malloc'd
 // message the caller frees).
+namespace {
+void ensure_interpreter() {
+  // standalone C/Go consumer: bring up the embedded interpreter once
+  // (PYTHONPATH must reach paddle_tpu); a Python host process skips
+  // this. call_once guards concurrent PD_PredictorCreate callers.
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();  // release the GIL for PyGILState_Ensure
+    }
+  });
+}
+}  // namespace
+
 void* PD_PredictorCreate(const char* model_dir, const char** err) {
   if (err) *err = nullptr;
+  ensure_interpreter();
   PyGILState_STATE g = PyGILState_Ensure();
   void* out = nullptr;
   PyObject* cfg_cls = import_attr("paddle_tpu.inference", "Config");

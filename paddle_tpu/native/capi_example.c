@@ -1,0 +1,72 @@
+/* Minimal C client of the paddle_tpu inference C API (reference
+ * inference/capi/ consumer pattern; the Go/R clients in go/paddle wrap
+ * the same surface).
+ *
+ * Build:  gcc capi_example.c -o demo -ldl
+ * Run:    PYTHONPATH=/path/to/repo ./demo libpaddle_tpu_capi.so model_dir
+ *
+ * The shim links libpython and self-initializes the embedded
+ * interpreter on the first PD_PredictorCreate — the client needs no
+ * Python headers or libraries at all.
+ */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* (*create_fn)(const char*, const char**);
+typedef int (*run_fn)(void*, const char**);
+typedef int (*set_fn)(void*, const char*, const float*, const long long*,
+                      int, const char**);
+typedef long long (*get_fn)(void*, const char*, float*, long long,
+                            long long*, int, int*, const char**);
+typedef int (*name_fn)(void*, int, char*, int);
+typedef void (*destroy_fn)(void*);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <libpaddle_tpu_capi.so> <model_dir>\n",
+            argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) { fprintf(stderr, "dlopen: %s\n", dlerror()); return 1; }
+  create_fn create = (create_fn)dlsym(lib, "PD_PredictorCreate");
+  set_fn set_input = (set_fn)dlsym(lib, "PD_SetInputFloat");
+  run_fn run = (run_fn)dlsym(lib, "PD_PredictorRun");
+  get_fn get_out = (get_fn)dlsym(lib, "PD_GetOutputFloat");
+  name_fn in_name = (name_fn)dlsym(lib, "PD_GetInputName");
+  name_fn out_name = (name_fn)dlsym(lib, "PD_GetOutputName");
+  destroy_fn destroy = (destroy_fn)dlsym(lib, "PD_PredictorDestroy");
+
+  const char* err = NULL;
+  void* pred = create(argv[2], &err);
+  if (!pred) { fprintf(stderr, "create: %s\n", err); return 1; }
+
+  char iname[256], oname[256];
+  in_name(pred, 0, iname, sizeof iname);
+  out_name(pred, 0, oname, sizeof oname);
+
+  float input[4 * 8];
+  for (int i = 0; i < 4 * 8; ++i) input[i] = 1.0f;
+  long long shape[2] = {4, 8};
+  if (set_input(pred, iname, input, shape, 2, &err) != 0 ||
+      run(pred, &err) != 0) {
+    fprintf(stderr, "run: %s\n", err);
+    return 1;
+  }
+  long long oshape[4];
+  int ndim = 0;
+  /* size-query mode first (buf=NULL), then fetch */
+  long long total = get_out(pred, oname, NULL, 0, oshape, 4, &ndim, &err);
+  if (total <= 0) { fprintf(stderr, "size query: %s\n", err); return 1; }
+  float* buf = (float*)malloc(sizeof(float) * (size_t)total);
+  if (!buf) { fprintf(stderr, "oom\n"); return 1; }
+  if (get_out(pred, oname, buf, total, oshape, 4, &ndim, &err) != total) {
+    fprintf(stderr, "fetch: %s\n", err);
+    return 1;
+  }
+  printf("output %s: %lld elems, first=%f\n", oname, total, buf[0]);
+  free(buf);
+  destroy(pred);
+  return 0;
+}

@@ -6,6 +6,8 @@ import os
 import numpy as np
 import pytest
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers
 from paddle_tpu import inference
@@ -123,3 +125,36 @@ def test_c_api_end_to_end(saved_model):
         )
     finally:
         lib.PD_PredictorDestroy(h)
+
+
+def test_c_api_standalone_binary(saved_model, tmp_path):
+    """A NON-Python process consumes the C API: compile capi_example.c,
+    dlopen the shim (which self-initializes the embedded interpreter),
+    load the model, run inference (the reference's Go/R client story)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    from paddle_tpu import native
+
+    lib = native.load_capi()
+    if lib is None:
+        pytest.fail(f"C API failed to build: {native.capi_error()}")
+    so = native._hashed_so_path(native._CAPI_SRC, "libpaddle_tpu_capi")
+    path, xa, expected = saved_model
+
+    src = os.path.join(os.path.dirname(native.__file__), "capi_example.c")
+    demo = str(tmp_path / "demo")
+    # the shim links libpython itself: the client builds with -ldl only
+    r = subprocess.run(["gcc", src, "-o", demo, "-ldl"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    r = subprocess.run([demo, so, path], capture_output=True, text=True,
+                       env=env, timeout=180)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "4 elems" in r.stdout  # [4,1] output of the saved model

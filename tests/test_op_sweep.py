@@ -2372,6 +2372,35 @@ def _box_coder_roundtrip():
     )
 
 
+@case("box_coder")
+def _box_coder_decode_axis1():
+    rng = R(769)
+    prior = _boxes(rng, 3)      # aligns with tb dim 0 (axis=1)
+    deltas = f32(rng.randn(3, 2, 4) * 0.1)
+
+    def oracle(ins, a):
+        p, t = ins["PriorBox"][0], ins["TargetBox"][0]
+        pw = p[:, 2] - p[:, 0]; ph = p[:, 3] - p[:, 1]
+        pcx = p[:, 0] + pw / 2; pcy = p[:, 1] + ph / 2
+        out = np.zeros_like(t)
+        for i in range(t.shape[0]):
+            for j in range(t.shape[1]):
+                d = t[i, j]
+                cx = d[0] * pw[i] + pcx[i]
+                cy = d[1] * ph[i] + pcy[i]
+                w = np.exp(d[2]) * pw[i]
+                h = np.exp(d[3]) * ph[i]
+                out[i, j] = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+        return {"OutputBox": [f32(out)]}
+
+    return OpTest(
+        "box_coder", {"PriorBox": prior, "TargetBox": deltas},
+        oracle, attrs={"code_type": "decode_center_size",
+                       "box_normalized": True, "axis": 1},
+        outputs={"OutputBox": 1}, tol=1e-4,
+    )
+
+
 @case("prior_box")
 def _prior_box():
     rng = R(747)
