@@ -65,7 +65,8 @@ def main():
 
     cfg = BertConfig.base()
     cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
-    batch = int(os.environ.get("BENCH_BATCH", 32))
+    cfg.remat_ffn = os.environ.get("BENCH_REMAT_FFN", "1") == "1"
+    batch = int(os.environ.get("BENCH_BATCH", 48))
     seq = int(os.environ.get("BENCH_SEQ", 512))
     max_preds = 76
     steps = int(os.environ.get("BENCH_STEPS", 30))

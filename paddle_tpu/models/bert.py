@@ -43,6 +43,9 @@ class BertConfig:
     type_vocab_size: int = 2
     initializer_range: float = 0.02
     use_flash_attention: bool = True
+    # recompute the FFN inter activation in backward (memory for FLOPs):
+    # unlocks larger global batches on HBM-bound configs
+    remat_ffn: bool = False
     # scan over stacked layer params (fused_encoder_stack op): O(1)-in-depth
     # compile time; param names become encoder_stack.* instead of per-layer
     fuse_stack: bool = False
@@ -241,6 +244,7 @@ def _encoder_stack(cfg: BertConfig, hidden, attn_bias, is_test: bool):
             "attn_dropout_prob": cfg.attention_probs_dropout_prob,
             "is_test": is_test,
             "use_flash_attention": cfg.use_flash_attention,
+            "remat_ffn": cfg.remat_ffn,
             "rng_salt": _rng_salt_counter[0],
         },
     )

@@ -153,9 +153,18 @@ def fused_encoder_stack(ctx, ins, attrs):
             attn_out = dropout(attn_out, dropout_prob, k2)
             hid = ln(hid + attn_out, p["Ln1S"], p["Ln1B"])
 
-            inter = act(jnp.einsum("bsh,hf->bsf", hid, p["FfnW1"]) + p["FfnB1"])
-            ffn_out = jnp.einsum("bsf,fh->bsh", inter, p["FfnW2"]) + p["FfnB2"]
-            ffn_out = dropout(ffn_out, dropout_prob, k3)
+            def ffn(h_, w1, b1, w2, b2, key3):
+                inter = act(jnp.einsum("bsh,hf->bsf", h_, w1) + b1)
+                out_ = jnp.einsum("bsf,fh->bsh", inter, w2) + b2
+                return dropout(out_, dropout_prob, key3)
+
+            if attrs.get("remat_ffn", False):
+                # recompute `inter` ([B,S,F], the largest activation) in
+                # the backward instead of saving it: ~1/3 extra fwd FLOPs
+                # for this block buys ~F/H x memory off the residuals,
+                # unlocking larger batches
+                ffn = jax.checkpoint(ffn)
+            ffn_out = ffn(hid, p["FfnW1"], p["FfnB1"], p["FfnW2"], p["FfnB2"], k3)
             hid = ln(hid + ffn_out, p["Ln2S"], p["Ln2B"])
             return (hid, idx + 1), None
 

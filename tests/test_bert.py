@@ -72,3 +72,41 @@ def test_bert_flash_and_reference_agree():
         results[use_flash] = float(lv)
     attention.FORCE_PALLAS = False
     np.testing.assert_allclose(results[False], results[True], rtol=1e-4)
+
+
+def test_remat_ffn_is_numerically_identity():
+    """jax.checkpoint on the FFN must not change the math: same seeds,
+    same loss trajectory with and without remat_ffn."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.bert import (
+        BertConfig,
+        build_bert_pretrain_program,
+        random_pretrain_batch,
+    )
+
+    def run(remat):
+        cfg = dataclasses.replace(BertConfig.tiny(), fuse_stack=True,
+                                  remat_ffn=remat)
+        main, startup = fluid.Program(), fluid.Program()
+        m, st, _, loss = build_bert_pretrain_program(
+            cfg, 4, 64, 8, main_program=main, startup_program=startup
+        )
+        with fluid.program_guard(m, st):
+            fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(st)
+            feed = random_pretrain_batch(cfg, 4, 64, 8, seed=0)
+            out = []
+            for _ in range(4):
+                (lv,) = exe.run(m, feed=feed, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(())))
+        return out
+
+    # checkpoint boundaries change XLA fusion and therefore fp summation
+    # order; ~1e-4 drift is rounding, not semantics (masks/seeds identical)
+    np.testing.assert_allclose(run(True), run(False), rtol=5e-4, atol=5e-4)
