@@ -51,16 +51,9 @@ FORCE_PALLAS = False
 
 
 def _use_pallas(q):
-    from ..fluid.flags import flag
+    from .pallas.flash_attention import flash_shapes_ok
 
-    if not flag("FLAGS_use_flash_attention"):
-        return False
-    dh = q.shape[-1]
-    # MXU-friendly head dims only; otherwise XLA fusion is competitive
-    shapes_ok = dh in (64, 128, 256) and q.shape[2] % 128 == 0
-    if FORCE_PALLAS:
-        return shapes_ok
-    return shapes_ok and jax.default_backend() in ("tpu", "axon")
+    return flash_shapes_ok(q.shape[2], q.shape[-1])
 
 
 @register("fused_multihead_attention")

@@ -270,13 +270,6 @@ def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer):
 
 
 def _flash_ok(s, dh):
-    from ..fluid.flags import flag
+    from .pallas.flash_attention import flash_shapes_ok
 
-    if not flag("FLAGS_use_flash_attention"):
-        return False
-    if jax.default_backend() not in ("tpu", "axon"):
-        from . import attention
-
-        if not attention.FORCE_PALLAS:
-            return False
-    return dh in (64, 128, 256) and s % 128 == 0
+    return flash_shapes_ok(s, dh)

@@ -417,7 +417,7 @@ def _make_bwd_dkv_kernel(*, sm_scale, num_heads, causal, dropout_prob,
 
 
 def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
-               bias_mode, bias_dims, want_dbias):
+               bias_mode, bias_dims, want_dbias, g_lse=None):
     q, k, v, bias, mask, seed, o, lse = res
     bh, s, d = q.shape
     bq = bk = _pick_block(s)
@@ -425,6 +425,10 @@ def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
     use_prng = dropout_prob > 0.0 and mask is None
     has_mask = mask is not None and dropout_prob > 0.0
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)  # [BH,S]
+    if g_lse is not None:
+        # lse cotangent: d lse_i/d s_ij = P_ij, so ds gains +P*g_lse —
+        # algebraically identical to subtracting g_lse from delta
+        delta = delta - g_lse.astype(jnp.float32)
     delta = delta.reshape(bh, nq, 1, bq)
 
     qspec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
@@ -745,3 +749,87 @@ def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
     return shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=qspec, check_vma=False,
     )(q, k, v, bias, mask, seed)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_flash_core_lse(*, sm_scale, num_heads, causal, dropout_prob,
+                         bias_mode, bias_dims, want_dbias=False):
+    """Like _make_flash_core but returns (o, lse [BH, S]) with a VJP that
+    accepts cotangents for BOTH outputs (g_lse folds into delta). Built
+    for ring attention, which merges per-block partials by lse."""
+    statics = dict(
+        sm_scale=sm_scale, num_heads=num_heads, causal=causal,
+        dropout_prob=dropout_prob, bias_mode=bias_mode, bias_dims=bias_dims,
+    )
+
+    @jax.custom_vjp
+    def core(q, k, v, bias, mask, seed):
+        o, lse4 = _flash_fwd(q, k, v, bias, mask, seed, **statics)
+        return o, lse4.reshape(q.shape[0], q.shape[1])
+
+    def core_fwd(q, k, v, bias, mask, seed):
+        o, lse4 = _flash_fwd(q, k, v, bias, mask, seed, **statics)
+        return (o, lse4.reshape(q.shape[0], q.shape[1])), (
+            q, k, v, bias, mask, seed, o, lse4,
+        )
+
+    def core_bwd(res, gs):
+        g_o, g_lse = gs
+        dq, dk, dv, dbias = _flash_bwd(
+            res, g_o, want_dbias=want_dbias and bias_mode is not None,
+            g_lse=g_lse, **statics
+        )
+        if res[3] is not None and dbias is None:
+            dbias = jnp.zeros_like(res[3])
+        elif dbias is not None:
+            dbias = dbias.astype(res[3].dtype)
+        return (dq, dk, dv, dbias, None, None)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def flash_block_with_lse(q, k, v, key_bias=None, sm_scale=None,
+                         bias_requires_grad=True):
+    """One attention block for ring attention: q/k/v [B, nh, S, D] local
+    shards, key_bias [B, S] additive per-key bias (rotating with K).
+    Returns (out [B, nh, S, D], lse [B, nh, S]) for log-sum-exp merging
+    across ring steps. No dropout/causal here — the ring caller falls
+    back to the jnp path for those. Bias gradients are computed by
+    default, matching the jnp ring block math."""
+    b, nh, s, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    bias3 = None
+    bias_mode = None
+    bias_dims = None
+    if key_bias is not None:
+        bias3 = key_bias.reshape(b, 1, s).astype(jnp.float32)
+        bias_mode, bias_dims = "key", (b, 1)
+    core = _make_flash_core_lse(
+        sm_scale=float(sm_scale), num_heads=nh, causal=False,
+        dropout_prob=0.0, bias_mode=bias_mode, bias_dims=bias_dims,
+        want_dbias=bias_requires_grad,
+    )
+    o, lse = core(
+        q.reshape(b * nh, s, d), k.reshape(b * nh, s, d),
+        v.reshape(b * nh, s, d), bias3, None, None,
+    )
+    return o.reshape(b, nh, s, d), lse.reshape(b, nh, s)
+
+
+def flash_shapes_ok(s, d) -> bool:
+    """THE shape/backend/flag gate for every flash dispatch site (the
+    attention op, the encoder stack, and the ring path all call this)."""
+    from ...fluid.flags import flag
+    from ..attention import FORCE_PALLAS
+
+    if not flag("FLAGS_use_flash_attention"):
+        return False
+    shapes_ok = d in (64, 128, 256) and s % MIN_BLOCK == 0
+    if FORCE_PALLAS:
+        return shapes_ok
+    return shapes_ok and not _interpret()
+
+
+flash_block_ok = flash_shapes_ok  # ring-path alias

@@ -13,11 +13,11 @@ The loop is a `lax.scan`, so reverse-mode AD works end-to-end: the
 backward pass rotates cotangents with the transposed permutation that JAX
 derives for ppermute — no custom VJP needed.
 
-Planned (not yet wired): computing each local block with the Pallas
-flash kernel and merging partials by log-sum-exp. It needs a kernel
-core whose custom VJP returns (o, lse) with a d_lse rule; the current
-jnp block math is itself online-softmax and XLA fuses it well, so the
-kernel handoff is an optimization, not a correctness gap.
+When shapes permit (S_local % 128 == 0, D in {64,128,256}, no causal
+mask, no dropout), each local block runs the Pallas flash kernel via
+`flash_block_with_lse` — an (o, lse)-returning custom-VJP core — and the
+ring merges partials by log-sum-exp; otherwise the jnp online-softmax
+block math below runs (itself well fused by XLA).
 """
 from __future__ import annotations
 
@@ -50,6 +50,11 @@ def ring_attention(q, k, v, axis_name: str, bias=None, sm_scale=None,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     use_dropout = dropout_prob > 0.0 and dropout_key is not None
+
+    from ..ops.pallas.flash_attention import flash_block_ok
+
+    if not causal and not use_dropout and flash_block_ok(s_loc, d):
+        return _ring_flash(q, k, v, axis_name, bias, sm_scale, n)
 
     qf = q.astype(jnp.float32) * sm_scale
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -100,6 +105,43 @@ def ring_attention(q, k, v, axis_name: str, bias=None, sm_scale=None,
     )
     out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
+
+
+def _ring_flash(q, k, v, axis_name, bias, sm_scale, n):
+    """Ring schedule where each block is the Pallas flash kernel: merge
+    per-block (o, lse) partials by log-sum-exp. AD flows through the
+    kernel's custom VJP (the lse cotangent folds into delta)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.pallas.flash_attention import flash_block_with_lse
+
+    b, nh, s_loc, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        kb, vb, bb, m, l, acc = carry
+        o_b, lse_b = flash_block_with_lse(q, kb, vb, bb, sm_scale)
+        lse_b = lse_b[..., None]  # [B, nh, S, 1]
+        m_new = jnp.maximum(m, lse_b)
+        scale_old = jnp.exp(m - m_new)
+        scale_new = jnp.exp(lse_b - m_new)
+        acc = acc * scale_old + o_b.astype(jnp.float32) * scale_new
+        l = l * scale_old + scale_new
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        if bb is not None:
+            bb = lax.ppermute(bb, axis_name, perm)
+        return (kb, vb, bb, m_new, l, acc), None
+
+    m0 = jnp.full((b, nh, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, nh, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, nh, s_loc, d), jnp.float32)
+    (k, v, bias, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, bias, m0, l0, acc0), jnp.arange(n)
+    )
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def ring_attention_global(q, k, v, mesh, axis: str = "sp", bias=None,
