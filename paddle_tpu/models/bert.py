@@ -46,6 +46,7 @@ class BertConfig:
     # recompute the FFN inter activation in backward (memory for FLOPs):
     # unlocks larger global batches on HBM-bound configs
     remat_ffn: bool = False
+    remat_layer: bool = False  # save only per-layer hidden (more FLOPs)
     # scan over stacked layer params (fused_encoder_stack op): O(1)-in-depth
     # compile time; param names become encoder_stack.* instead of per-layer
     fuse_stack: bool = False
@@ -245,6 +246,7 @@ def _encoder_stack(cfg: BertConfig, hidden, attn_bias, is_test: bool):
             "is_test": is_test,
             "use_flash_attention": cfg.use_flash_attention,
             "remat_ffn": cfg.remat_ffn,
+            "remat_layer": getattr(cfg, "remat_layer", False),
             "rng_salt": _rng_salt_counter[0],
         },
     )

@@ -170,6 +170,13 @@ def fused_encoder_stack(ctx, ins, attrs):
 
         return layer
 
+    if attrs.get("remat_layer", False) and not _use_gpipe(ctx, attrs):
+        # full-layer remat: save only the carried hidden per layer
+        _layer = make_layer(bias)
+        layer_ck = jax.checkpoint(lambda c, p: _layer(c, p))
+        (out, _), _ = jax.lax.scan(layer_ck, (hidden, jnp.int32(0)), stacked)
+        return {"Out": [out]}
+
     if _use_gpipe(ctx, attrs):
         if ring:
             raise NotImplementedError(

@@ -88,9 +88,9 @@ def test_remat_ffn_is_numerically_identity():
         random_pretrain_batch,
     )
 
-    def run(remat):
+    def run(remat, remat_layer=False):
         cfg = dataclasses.replace(BertConfig.tiny(), fuse_stack=True,
-                                  remat_ffn=remat)
+                                  remat_ffn=remat, remat_layer=remat_layer)
         main, startup = fluid.Program(), fluid.Program()
         m, st, _, loss = build_bert_pretrain_program(
             cfg, 4, 64, 8, main_program=main, startup_program=startup
@@ -109,4 +109,7 @@ def test_remat_ffn_is_numerically_identity():
 
     # checkpoint boundaries change XLA fusion and therefore fp summation
     # order; ~1e-4 drift is rounding, not semantics (masks/seeds identical)
-    np.testing.assert_allclose(run(True), run(False), rtol=5e-4, atol=5e-4)
+    base = run(False)
+    np.testing.assert_allclose(run(True), base, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(run(False, remat_layer=True), base,
+                               rtol=5e-4, atol=5e-4)
