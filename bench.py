@@ -51,6 +51,63 @@ def _bert_step_flops(cfg, batch, seq):
     return per_token * batch * seq
 
 
+def bench_resnet50():
+    """Secondary tracked config (BASELINE.md): ResNet-50 images/sec/chip."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import mixed_precision as mixed_prec
+    from paddle_tpu.models.resnet import (
+        ResNetConfig,
+        build_resnet_train_program,
+        resnet_step_flops,
+    )
+
+    cfg = ResNetConfig.resnet50()
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    size = int(os.environ.get("BENCH_IMAGE", 224))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    m, st, feeds, loss = build_resnet_train_program(cfg, batch, size, main_p, startup)
+    with fluid.program_guard(m, st):
+        opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1, momentum=0.9)
+        if use_amp:
+            opt = mixed_prec.decorate(opt, use_bf16=True)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(st)
+    rng = np.random.RandomState(0)
+    data = {
+        "image": jax.device_put(rng.rand(batch, 3, size, size).astype(np.float32)),
+        "label": jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int64)),
+    }
+    for _ in range(2):
+        (lv,) = exe.run(m, feed=data, fetch_list=[loss])
+    float(np.asarray(lv).reshape(()))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (lv,) = exe.run(m, feed=data, fetch_list=[loss], return_numpy=False)
+    lv = float(np.asarray(lv).reshape(()))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lv), f"loss not finite: {lv}"
+    imgs_per_sec = batch * steps / dt
+    mfu = resnet_step_flops(cfg, batch, size) * steps / dt / _peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "mfu": round(mfu, 4),
+        "batch": batch,
+        "image_size": size,
+        "steps": steps,
+        "amp_bf16": use_amp,
+    }))
+
+
 def main():
     import jax
     import numpy as np
@@ -62,6 +119,9 @@ def main():
         build_bert_pretrain_program,
         random_pretrain_batch,
     )
+
+    if os.environ.get("BENCH_MODEL", "bert") == "resnet50":
+        return bench_resnet50()
 
     cfg = BertConfig.base()
     cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time

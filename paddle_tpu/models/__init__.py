@@ -9,3 +9,4 @@ Pallas attention give the fused kernels directly.
 """
 from . import bert  # noqa: F401
 from .bert import BertConfig, build_bert_pretrain_program  # noqa: F401
+from . import resnet  # noqa: F401

@@ -1,0 +1,136 @@
+"""ResNet family (reference pattern: tests/unittests/seresnext_net.py and
+the fluid image_classification models; BASELINE.md tracks ResNet-50
+images/sec/chip).
+
+TPU notes: NCHW layout (the layers default); batch_norm stays fp32 under
+AMP (black-listed) while convs hit the MXU in bf16; the whole train step
+compiles to one XLA program like every other model here.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..fluid import ParamAttr
+from ..fluid import layers
+
+
+@dataclass
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    # bottleneck block counts per stage (depth 50 default)
+    blocks: List[int] = field(default_factory=lambda: [3, 4, 6, 3])
+    base_filters: int = 64
+
+    @staticmethod
+    def resnet50(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(50, num_classes, [3, 4, 6, 3])
+
+    @staticmethod
+    def resnet18(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(18, num_classes, [2, 2, 2, 2])
+
+    @staticmethod
+    def tiny(num_classes: int = 10) -> "ResNetConfig":
+        """For tests: 2 stages, 1 block each, 8 base filters."""
+        return ResNetConfig(8, num_classes, [1, 1], base_filters=8)
+
+
+def _conv_bn(x, filters, ksize, stride=1, act=None, name=""):
+    conv = layers.conv2d(
+        x, filters, ksize, stride=stride, padding=(ksize - 1) // 2,
+        param_attr=ParamAttr(name=f"{name}.w"), bias_attr=False,
+    )
+    return layers.batch_norm(conv, act=act, param_attr=ParamAttr(name=f"{name}.bn_s"),
+                             bias_attr=ParamAttr(name=f"{name}.bn_b"))
+
+
+def _bottleneck(x, filters, stride, name):
+    """1x1 -> 3x3 -> 1x1 (x4) with projection shortcut when needed."""
+    out = _conv_bn(x, filters, 1, act="relu", name=f"{name}.c1")
+    out = _conv_bn(out, filters, 3, stride=stride, act="relu", name=f"{name}.c2")
+    out = _conv_bn(out, filters * 4, 1, name=f"{name}.c3")
+    if stride != 1 or x.shape[1] != filters * 4:
+        short = _conv_bn(x, filters * 4, 1, stride=stride, name=f"{name}.proj")
+    else:
+        short = x
+    return layers.relu(layers.elementwise_add(out, short))
+
+
+def _basic_block(x, filters, stride, name):
+    """3x3 -> 3x3 (resnet18/34)."""
+    out = _conv_bn(x, filters, 3, stride=stride, act="relu", name=f"{name}.c1")
+    out = _conv_bn(out, filters, 3, name=f"{name}.c2")
+    if stride != 1 or x.shape[1] != filters:
+        short = _conv_bn(x, filters, 1, stride=stride, name=f"{name}.proj")
+    else:
+        short = x
+    return layers.relu(layers.elementwise_add(out, short))
+
+
+def resnet(cfg: ResNetConfig, images):
+    """images [N, 3, H, W] -> logits [N, num_classes]."""
+    bottleneck = cfg.depth >= 50
+    x = _conv_bn(images, cfg.base_filters, 7, stride=2, act="relu", name="stem")
+    x = layers.pool2d(x, 3, pool_type="max", pool_stride=2, pool_padding=1)
+    filters = cfg.base_filters
+    for stage, n_blocks in enumerate(cfg.blocks):
+        for b in range(n_blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            block = _bottleneck if bottleneck else _basic_block
+            x = block(x, filters, stride, name=f"s{stage}.b{b}")
+        filters *= 2
+    x = layers.pool2d(x, 1, pool_type="avg", global_pooling=True)
+    return layers.fc(x, cfg.num_classes, param_attr=ParamAttr(name="head.w"))
+
+
+def build_resnet_train_program(cfg, batch, image_size, main_program,
+                               startup_program):
+    """Classification train program; returns (main, startup, feeds, loss)."""
+    from ..fluid import framework
+
+    with framework.program_guard(main_program, startup_program):
+        img = layers.data("image", [batch, 3, image_size, image_size],
+                          append_batch_size=False)
+        label = layers.data("label", [batch, 1], dtype="int64",
+                            append_batch_size=False)
+        logits = resnet(cfg, img)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    return main_program, startup_program, ["image", "label"], loss
+
+
+def resnet_step_flops(cfg: ResNetConfig, batch: int, image_size: int) -> float:
+    """fwd+bwd FLOPs (3x fwd conv/fc MACs x2) — standard accounting."""
+    import numpy as np
+
+    flops = 0.0
+    h = image_size
+    # stem
+    h = h // 2
+    flops += 2 * (7 * 7 * 3) * cfg.base_filters * h * h
+    h = h // 2  # maxpool
+    cin = cfg.base_filters
+    filters = cfg.base_filters
+    bottleneck = cfg.depth >= 50
+    for stage, n_blocks in enumerate(cfg.blocks):
+        for b in range(n_blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            h_out = h // stride
+            if bottleneck:
+                flops += 2 * cin * filters * h * h                     # 1x1
+                flops += 2 * 9 * filters * filters * h_out * h_out     # 3x3
+                flops += 2 * filters * filters * 4 * h_out * h_out     # 1x1
+                if stride != 1 or cin != filters * 4:
+                    flops += 2 * cin * filters * 4 * h_out * h_out
+                cin = filters * 4
+            else:
+                flops += 2 * 9 * cin * filters * h_out * h_out
+                flops += 2 * 9 * filters * filters * h_out * h_out
+                if stride != 1 or cin != filters:
+                    flops += 2 * cin * filters * h_out * h_out
+                cin = filters
+            h = h_out
+        filters *= 2
+    flops += 2 * cin * cfg.num_classes
+    return 3.0 * flops * batch  # fwd(1x) + bwd(2x)
