@@ -51,8 +51,41 @@ def _bert_step_flops(cfg, batch, seq):
     return per_token * batch * seq
 
 
+def _timed_run(exe, program, data, loss, steps):
+    """Shared measurement protocol: 2-step compile warmup + sync, async
+    step loop, one trailing sync; BENCH_PROFILE wraps the timed loop.
+    Returns (dt_seconds, final_loss)."""
+    import contextlib
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    for _ in range(2):
+        (lv,) = exe.run(program, feed=data, fetch_list=[loss])
+    float(np.asarray(lv).reshape(()))
+
+    profile_path = os.environ.get("BENCH_PROFILE", "")
+    ctx = (
+        fluid.profiler.profiler(state="All", profile_path=profile_path)
+        if profile_path
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (lv,) = exe.run(program, feed=data, fetch_list=[loss],
+                            return_numpy=False)
+        lv = float(np.asarray(lv).reshape(()))  # one sync at the end
+        dt = time.perf_counter() - t0
+    assert np.isfinite(lv), f"loss not finite: {lv}"
+    return dt, lv
+
+
 def bench_resnet50():
-    """Secondary tracked config (BASELINE.md): ResNet-50 images/sec/chip."""
+    """Secondary tracked config (BASELINE.md): ResNet-50 images/sec/chip.
+    BASELINE.md sets no ResNet target ("TBD"), so vs_baseline reports
+    raw MFU rather than a ratio against an invented bar."""
     import jax
     import numpy as np
 
@@ -84,22 +117,14 @@ def bench_resnet50():
         "image": jax.device_put(rng.rand(batch, 3, size, size).astype(np.float32)),
         "label": jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int64)),
     }
-    for _ in range(2):
-        (lv,) = exe.run(m, feed=data, fetch_list=[loss])
-    float(np.asarray(lv).reshape(()))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        (lv,) = exe.run(m, feed=data, fetch_list=[loss], return_numpy=False)
-    lv = float(np.asarray(lv).reshape(()))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(lv), f"loss not finite: {lv}"
+    dt, _ = _timed_run(exe, m, data, loss, steps)
     imgs_per_sec = batch * steps / dt
     mfu = resnet_step_flops(cfg, batch, size) * steps / dt / _peak_flops_per_chip()
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "images/s/chip",
-        "vs_baseline": round(mfu / 0.35, 4),
+        "vs_baseline": None,  # BASELINE.md sets no ResNet target ("TBD")
         "mfu": round(mfu, 4),
         "batch": batch,
         "image_size": size,
@@ -150,26 +175,7 @@ def main():
     # device-resident feed: upload once, reuse every step
     data = {k: jax.device_put(np.asarray(v)) for k, v in data.items()}
 
-    # warmup (compile)
-    for _ in range(2):
-        (lv,) = exe.run(m, feed=data, fetch_list=[loss])
-    float(np.asarray(lv).reshape(()))
-
-    import contextlib
-
-    profile_path = os.environ.get("BENCH_PROFILE", "")
-    ctx = (
-        fluid.profiler.profiler(state="All", profile_path=profile_path)
-        if profile_path
-        else contextlib.nullcontext()
-    )
-    with ctx:
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            (lv,) = exe.run(m, feed=data, fetch_list=[loss], return_numpy=False)
-        lv = float(np.asarray(lv).reshape(()))  # one sync at the end
-        dt = time.perf_counter() - t0
-    assert np.isfinite(lv), f"loss not finite: {lv}"
+    dt, _ = _timed_run(exe, m, data, loss, steps)
 
     tokens_per_sec = batch * seq * steps / dt
     mfu = _bert_step_flops(cfg, batch, seq) * steps / dt / _peak_flops_per_chip()

@@ -107,36 +107,41 @@ class Recall(MetricBase):
 
 
 class Auc(MetricBase):
-    """Histogram-bucketed ROC AUC (reference metrics.py Auc / operators/metrics/auc_op)."""
+    """Streaming ROC-AUC over (prob, label) batches, histogram-bucketed
+    (reference metrics.Auc; operators/metrics/auc_op.cc)."""
 
     def __init__(self, name=None, curve="ROC", num_thresholds=4095):
         super().__init__(name)
-        self._num_thresholds = num_thresholds
-        self.stat_pos = np.zeros(num_thresholds + 1, dtype=np.int64)
-        self.stat_neg = np.zeros(num_thresholds + 1, dtype=np.int64)
+        if curve != "ROC":
+            raise NotImplementedError(
+                f"Auc curve {curve!r}: only ROC is implemented"
+            )
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
 
     def update(self, preds, labels):
         preds = np.asarray(preds)
-        if preds.ndim == 2 and preds.shape[1] == 2:
-            preds = preds[:, 1]
-        preds = preds.reshape(-1)
+        score = preds[:, -1] if preds.ndim == 2 else preds.reshape(-1)
         labels = np.asarray(labels).reshape(-1)
-        idx = np.clip(
-            (preds * self._num_thresholds).astype(np.int64), 0, self._num_thresholds
-        )
-        np.add.at(self.stat_pos, idx[labels == 1], 1)
-        np.add.at(self.stat_neg, idx[labels == 0], 1)
+        idx = np.clip((score * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx[labels > 0], 1)
+        np.add.at(self._stat_neg, idx[labels <= 0], 1)
 
     def eval(self):
-        tot_pos = tot_neg = 0.0
-        auc = 0.0
-        for i in range(self._num_thresholds, -1, -1):
-            new_pos = tot_pos + self.stat_pos[i]
-            new_neg = tot_neg + self.stat_neg[i]
-            auc += (new_neg - tot_neg) * (new_pos + tot_pos) / 2.0
-            tot_pos, tot_neg = new_pos, new_neg
-        denom = tot_pos * tot_neg
-        return float(auc / denom) if denom else 0.0
+        pos = np.cumsum(self._stat_pos[::-1])
+        neg = np.cumsum(self._stat_neg[::-1])
+        tot_pos, tot_neg = pos[-1], neg[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        x = np.concatenate([[0], neg])
+        y = np.concatenate([[0], pos])
+        area = np.sum((x[1:] - x[:-1]) * (y[1:] + y[:-1])) / 2.0
+        return float(area / (tot_pos * tot_neg))
 
 
 class EditDistance(MetricBase):
@@ -159,95 +164,3 @@ class EditDistance(MetricBase):
             self.total_distance / self.seq_num,
             self.instance_error / self.seq_num,
         )
-
-
-class Precision(MetricBase):
-    """Binary precision (reference metrics.Precision)."""
-
-    def __init__(self, name=None):
-        super().__init__(name)
-        self.tp = 0
-        self.fp = 0
-
-    def reset(self):
-        self.tp = 0
-        self.fp = 0
-
-    def update(self, preds, labels):
-        import numpy as np
-
-        preds = (np.asarray(preds).reshape(-1) > 0.5).astype(np.int64)
-        labels = np.asarray(labels).reshape(-1).astype(np.int64)
-        self.tp += int(((preds == 1) & (labels == 1)).sum())
-        self.fp += int(((preds == 1) & (labels == 0)).sum())
-
-    def eval(self):
-        return self.tp / max(self.tp + self.fp, 1)
-
-
-class Recall(MetricBase):
-    """Binary recall (reference metrics.Recall)."""
-
-    def __init__(self, name=None):
-        super().__init__(name)
-        self.tp = 0
-        self.fn = 0
-
-    def reset(self):
-        self.tp = 0
-        self.fn = 0
-
-    def update(self, preds, labels):
-        import numpy as np
-
-        preds = (np.asarray(preds).reshape(-1) > 0.5).astype(np.int64)
-        labels = np.asarray(labels).reshape(-1).astype(np.int64)
-        self.tp += int(((preds == 1) & (labels == 1)).sum())
-        self.fn += int(((preds == 0) & (labels == 1)).sum())
-
-    def eval(self):
-        return self.tp / max(self.tp + self.fn, 1)
-
-
-class Auc(MetricBase):
-    """Streaming ROC-AUC over (prob, label) batches (reference
-    metrics.Auc; bucketed like operators/metrics/auc_op.cc)."""
-
-    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
-        super().__init__(name)
-        if curve != "ROC":
-            raise NotImplementedError(
-                f"Auc curve {curve!r}: only ROC is implemented"
-            )
-        self.num_thresholds = num_thresholds
-        self.reset()
-
-    def reset(self):
-        import numpy as np
-
-        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
-        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
-
-    def update(self, preds, labels):
-        import numpy as np
-
-        preds = np.asarray(preds)
-        score = preds[:, -1] if preds.ndim == 2 else preds.reshape(-1)
-        labels = np.asarray(labels).reshape(-1)
-        idx = np.clip((score * self.num_thresholds).astype(np.int64), 0,
-                      self.num_thresholds)
-        np.add.at(self._stat_pos, idx[labels > 0], 1)
-        np.add.at(self._stat_neg, idx[labels <= 0], 1)
-
-    def eval(self):
-        import numpy as np
-
-        pos = np.cumsum(self._stat_pos[::-1])
-        neg = np.cumsum(self._stat_neg[::-1])
-        tot_pos, tot_neg = pos[-1], neg[-1]
-        if tot_pos == 0 or tot_neg == 0:
-            return 0.0
-        x = np.concatenate([[0], neg])
-        y = np.concatenate([[0], pos])
-        area = np.sum((x[1:] - x[:-1]) * (y[1:] + y[:-1])) / 2.0
-        return float(area / (tot_pos * tot_neg))

@@ -102,8 +102,6 @@ def build_resnet_train_program(cfg, batch, image_size, main_program,
 
 def resnet_step_flops(cfg: ResNetConfig, batch: int, image_size: int) -> float:
     """fwd+bwd FLOPs (3x fwd conv/fc MACs x2) — standard accounting."""
-    import numpy as np
-
     flops = 0.0
     h = image_size
     # stem
