@@ -178,6 +178,12 @@ class DistributedOptimizer:
             _parallel.shard_program_sequence_parallel(program, mesh, axis="sp")
         if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
             apply_tensor_parallel_rules(program, strategy.tensor_parallel_rules)
+        if (
+            strategy.expert_parallel
+            and "ep" in mesh.axis_names
+            and mesh.shape["ep"] > 1
+        ):
+            apply_expert_parallel(program, mesh)
         if pp_active:
             _shard_pipeline_params(program)
         program._mesh = mesh
@@ -280,6 +286,31 @@ def _shard_pipeline_params(program):
                         set_var_sharding(
                             v, ("pp",) + (None,) * (len(v.shape) - 1)
                         )
+
+
+def apply_expert_parallel(program, mesh, axis: str = "ep"):
+    """Shard every moe_ffn op's expert-indexed parameters (W1/B1/W2/B2,
+    dim 0 = expert) over `axis`. Tokens stay dp-sharded and the router
+    (GateW) replicated; XLA's SPMD partitioner then places each expert's
+    FFN on its own ep shard and inserts the dispatch/combine all-to-alls
+    around the expert einsums (ops/moe_ops.py) — expert parallelism as a
+    sharding annotation, consistent with how dp/tp/sp are expressed."""
+    ep = mesh.shape[axis]
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type != "moe_ffn":
+                continue
+            for slot in ("W1", "B1", "W2", "B2"):
+                for n in op.inputs.get(slot, []):
+                    v = block._find_var_recursive(n)
+                    if v is None or not v.shape:
+                        continue
+                    if v.shape[0] % ep != 0:
+                        raise ValueError(
+                            f"moe_ffn param {n}: num_experts {v.shape[0]} "
+                            f"not divisible by ep axis size {ep}"
+                        )
+                    set_var_sharding(v, (axis,) + (None,) * (len(v.shape) - 1))
 
 
 def apply_tensor_parallel_rules(program, rules):

@@ -54,6 +54,9 @@ class DistributedStrategy:
         # [(param-name regex, PartitionSpec tuple)]
         self.tensor_parallel_rules: List[Tuple[str, tuple]] = []
         self.sequence_parallel: bool = False
+        # shard moe_ffn expert weights over the "ep" mesh axis (GSPMD
+        # inserts the dispatch/combine all-to-alls); see ops/moe_ops.py
+        self.expert_parallel: bool = False
 
     def __repr__(self):
         on = [

@@ -50,6 +50,14 @@ class BertConfig:
     # scan over stacked layer params (fused_encoder_stack op): O(1)-in-depth
     # compile time; param names become encoder_stack.* instead of per-layer
     fuse_stack: bool = False
+    # Mixture-of-Experts FFN (ops/moe_ops.py): >0 replaces every dense FFN
+    # with a moe_ffn of that many experts; shard over "ep" via
+    # DistributedStrategy.expert_parallel. Incompatible with fuse_stack
+    # (per-layer routers can't be scanned over stacked params yet).
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def base() -> "BertConfig":
@@ -135,8 +143,20 @@ def encoder_layer(cfg: BertConfig, hidden, attn_bias, name: str, is_test: bool):
         bias_attr=ParamAttr(name=f"{name}_post_att_ln_bias"),
     )
 
-    inter = _fc3(attn_out, cfg.intermediate_size, f"{name}_ffn_fc_0", act=cfg.hidden_act)
-    ffn_out = _fc3(inter, h, f"{name}_ffn_fc_1")
+    if cfg.moe_num_experts > 0:
+        ffn_out, _aux = layers.moe_ffn(
+            attn_out,
+            num_experts=cfg.moe_num_experts,
+            expert_hidden=cfg.intermediate_size,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            act=cfg.hidden_act,
+            param_attr=ParamAttr(initializer=_winit(cfg).initializer),
+            name=f"{name}_moe",
+        )
+    else:
+        inter = _fc3(attn_out, cfg.intermediate_size, f"{name}_ffn_fc_0", act=cfg.hidden_act)
+        ffn_out = _fc3(inter, h, f"{name}_ffn_fc_1")
     if not is_test and cfg.hidden_dropout_prob > 0:
         ffn_out = layers.dropout(
             ffn_out, cfg.hidden_dropout_prob, dropout_implementation="upscale_in_train"
@@ -191,6 +211,11 @@ def bert_encoder(
     attn_bias = layers.unsqueeze(layers.unsqueeze(attn_bias, [1]), [1])  # [B,1,1,S]
 
     if cfg.fuse_stack:
+        if cfg.moe_num_experts > 0:
+            raise ValueError(
+                "fuse_stack + moe_num_experts: the scanned stack cannot hold "
+                "per-layer MoE routers yet; set fuse_stack=False for MoE"
+            )
         return _encoder_stack(cfg, emb, attn_bias, is_test)
     hidden = emb
     for i in range(cfg.num_hidden_layers):
@@ -352,6 +377,22 @@ def build_bert_pretrain_program(
             layers.softmax_with_cross_entropy(nsp_logits, nsp_labels)
         )
         loss = layers.elementwise_add(mlm_loss, nsp_loss)
+
+        # ---- MoE load-balancing auxiliary losses (if any moe_ffn ops) ----
+        aux_names = [
+            n
+            for op in main.global_block().ops
+            if op.type == "moe_ffn"
+            for n in op.outputs.get("AuxLoss", [])
+        ]
+        if aux_names:
+            aux_vars = [main.global_block().var(n) for n in aux_names]
+            aux_total = aux_vars[0]
+            for a in aux_vars[1:]:
+                aux_total = layers.elementwise_add(aux_total, a)
+            loss = layers.elementwise_add(
+                loss, layers.scale(aux_total, scale=cfg.moe_aux_weight)
+            )
 
     feed_names = [
         "input_ids",

@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     encoder_stack,
     manipulation,
     math_ops,
+    moe_ops,
     nn_ops,
     optimizer_ops,
     ps_ops,

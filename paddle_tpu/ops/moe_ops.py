@@ -1,0 +1,132 @@
+"""Mixture-of-Experts FFN op with expert parallelism.
+
+New TPU-era capability (the 2020 reference predates MoE): a fused
+`moe_ffn` op — top-k router + capacity-bounded dispatch + per-expert FFN —
+expressed entirely as dense einsums over a one-hot dispatch tensor
+(Switch-Transformer / GShard formulation). That formulation is the
+TPU-idiomatic one: every FLOP-carrying contraction is a large static-shape
+einsum the MXU can tile, and when the expert dimension of W1/W2 is sharded
+over an "ep" mesh axis (fleet.apply_expert_parallel) while tokens are
+sharded over "dp", XLA's SPMD partitioner inserts the all-to-all pair
+around the expert computation automatically — no hand-written dispatch
+collective, mirroring how the rest of this framework gets its collectives
+from GSPMD rather than a transpiler pass.
+
+Exposed through the same surfaces as every other capability:
+  fluid.layers.moe_ffn(...)            (layer DSL)
+  DistributedStrategy.expert_parallel  (fleet strategy -> "ep" axis)
+
+Semantics:
+  X      [B, S, H]   tokens
+  GateW  [H, E]      router weights
+  W1     [E, H, F]   expert up-projection
+  B1     [E, F]
+  W2     [E, F, H]   expert down-projection
+  B2     [E, H]
+  ->
+  Out     [B, S, H]  combined expert outputs (tokens over capacity get 0
+                     from the expert path; callers keep the residual)
+  AuxLoss []         Switch load-balancing loss, E * sum_e f_e * P_e
+                     (1.0 when perfectly balanced)
+
+Routing runs in float32 regardless of compute dtype (softmax/cumsum are
+balance-critical); the expert einsums run in the input dtype so AMP
+applies to the FLOP-heavy path only.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int, capacity_factor: float) -> int:
+    """Static per-expert capacity: ceil(top_k * T / E * factor)."""
+    return max(1, int(math.ceil(top_k * num_tokens / num_experts * capacity_factor)))
+
+
+def _activation(name: str):
+    return {
+        "gelu": jax.nn.gelu,
+        "relu": jax.nn.relu,
+        "silu": jax.nn.silu,
+        "swish": jax.nn.silu,
+        "tanh": jnp.tanh,
+    }[name]
+
+
+@register("moe_ffn")
+def moe_ffn(ctx, ins, attrs):
+    x = ins["X"][0]
+    gate_w = ins["GateW"][0]
+    w1, b1 = ins["W1"][0], ins["B1"][0]
+    w2, b2 = ins["W2"][0], ins["B2"][0]
+
+    top_k = int(attrs.get("top_k", 2))
+    capacity_factor = float(attrs.get("capacity_factor", 1.25))
+    act = _activation(str(attrs.get("activation", "gelu")))
+
+    b, s, h = x.shape
+    e = w1.shape[0]
+    t = b * s
+    cap = moe_capacity(t, e, top_k, capacity_factor)
+
+    x2 = x.reshape(t, h)
+
+    # ---- router (float32) ------------------------------------------------
+    logits = jnp.einsum(
+        "th,he->te", x2.astype(jnp.float32), gate_w.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+
+    # top-k selection, slot by slot; later slots see earlier picks masked
+    remaining = probs
+    slot_idx, slot_gate = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # [T]
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        slot_idx.append(oh)
+        slot_gate.append(jnp.sum(remaining * oh, axis=-1))  # [T]
+        remaining = remaining * (1.0 - oh)
+    # top-1 (Switch) keeps the RAW router prob as the gate — normalizing
+    # would make it identically 1.0 and sever the task-loss gradient into
+    # GateW; top-k>1 normalizes selected gates to sum to 1 (GShard combine),
+    # which preserves the gradient through the relative weighting
+    if top_k > 1:
+        denom = sum(slot_gate)
+        slot_gate = [g / jnp.maximum(denom, 1e-9) for g in slot_gate]
+
+    # ---- capacity-bounded dispatch/combine tensors -----------------------
+    # slot 0 claims positions first; slot 1 queues behind it (GShard order)
+    counts = jnp.zeros((e,), jnp.float32)
+    dispatch = jnp.zeros((t, e, cap), jnp.float32)
+    combine = jnp.zeros((t, e, cap), jnp.float32)
+    for oh, gate in zip(slot_idx, slot_gate):
+        pos = jnp.cumsum(oh, axis=0) - oh + counts[None, :]  # [T, E]
+        keep = oh * (pos < cap)  # [T, E]
+        pos_oh = jax.nn.one_hot(jnp.sum(pos * oh, axis=-1).astype(jnp.int32),
+                                cap, dtype=jnp.float32)  # [T, C]
+        d = keep[:, :, None] * pos_oh[:, None, :]  # [T, E, C]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        counts = counts + jnp.sum(oh, axis=0)
+
+    # ---- expert computation (input dtype: the AMP-able FLOPs) ------------
+    disp = dispatch.astype(x.dtype)
+    expert_in = jnp.einsum("tec,th->ech", disp, x2)  # [E, C, H]
+    h1 = jnp.einsum("ech,ehf->ecf", expert_in, w1) + b1[:, None, :]
+    h1 = act(h1)
+    eout = jnp.einsum("ecf,efh->ech", h1, w2) + b2[:, None, :]
+    out2 = jnp.einsum("tec,ech->th", combine.astype(x.dtype), eout)
+
+    # ---- Switch load-balancing auxiliary loss ----------------------------
+    # f_e: fraction of tokens whose FIRST choice is e; P_e: mean router prob
+    frac = jnp.mean(slot_idx[0], axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+
+    return {"Out": [out2.reshape(b, s, h)], "AuxLoss": [aux.astype(jnp.float32)]}

@@ -2606,6 +2606,7 @@ EXEMPT = {
     "py_func": "host callable in attrs; test_static_rnn_pyfunc.py",
     "select_input": "test_control_flow.py",
     # fused mega-ops have dedicated oracle suites
+    "moe_ffn": "test_moe.py (numpy routing oracle, capacity, ep parity)",
     "fused_encoder_stack": "test_bert.py (vs per-layer composition)",
     "fused_multihead_attention": "test_flash_attention.py + test_bert.py",
     "recompute_segment": "test_meta_optimizers.py (recompute)",
