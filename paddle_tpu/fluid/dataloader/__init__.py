@@ -1,0 +1,258 @@
+"""Map-style datasets + multiprocess batch loading.
+
+Parity surface: /root/reference/python/paddle/fluid/dataloader/
+(dataset.py, batch_sampler.py, dataloader_iter.py) behind
+fluid.reader.DataLoader(dataset, ..., num_workers=N) (reader.py:112).
+
+TPU-native design: the reference workers serialize LoDTensors into
+shared-memory files consumed by a C++ blocking queue inside the program.
+Here the executor feeds numpy dicts directly, so workers are plain
+fork()ed processes that pull index-batches from an index queue, build
+batches with the collate fn, and send them back over a multiprocessing
+queue; the parent restores submission order so `num_workers=N` is
+bit-identical to `num_workers=0`. Heavy per-sample decode (image aug,
+tokenization) overlaps with the device step without fighting the GIL.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import traceback
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Dataset:
+    """Map-style dataset (reference dataloader/dataset.py): subclasses
+    implement __getitem__ and __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError("Dataset subclasses must implement __getitem__")
+
+    def __len__(self):
+        raise NotImplementedError("Dataset subclasses must implement __len__")
+
+
+class IterableDataset(Dataset):
+    """Stream-style dataset: subclasses implement __iter__. Only
+    num_workers=0 is supported (a stream cannot be index-sharded without
+    consuming it); use GeneratorLoader.use_multiprocess for off-process
+    streaming."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset has no __getitem__; iterate it")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    """Wrap equal-length arrays; sample i is a tuple of row i of each."""
+
+    def __init__(self, *arrays):
+        if not arrays:
+            raise ValueError("TensorDataset needs at least one array")
+        self.arrays = [np.asarray(a) for a in arrays]
+        n = len(self.arrays[0])
+        if any(len(a) != n for a in self.arrays):
+            raise ValueError("TensorDataset arrays must have equal length")
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+
+class BatchSampler:
+    """Yield lists of sample indices (reference dataloader/batch_sampler.py).
+
+    Either wrap a dataset (batch_size/shuffle/drop_last) or a custom
+    `sampler` iterable of indices.
+    """
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False, seed: Optional[int] = None):
+        if (dataset is None) == (sampler is None):
+            raise ValueError("BatchSampler: pass exactly one of dataset / sampler")
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.dataset = dataset
+        self.sampler = sampler
+        self.shuffle = shuffle
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self._seed = seed
+        self._epoch = 0
+
+    def _indices(self):
+        if self.sampler is not None:
+            return list(self.sampler)
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            seed = self._seed if self._seed is not None else self._epoch
+            np.random.RandomState(seed).shuffle(idx)
+            self._epoch += 1
+        return idx.tolist()
+
+    def __iter__(self):
+        batch = []
+        for i in self._indices():
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(samples: Sequence[Any]):
+    """Stack each field of the sample tuples along axis 0."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return [np.stack([np.asarray(s[i]) for s in samples])
+                for i in range(len(first))]
+    return [np.stack([np.asarray(s) for s in samples])]
+
+
+_WORKER_END = None  # index-queue sentinel
+
+
+def _worker_loop(dataset, index_q, result_q, collate_fn, worker_init_fn, wid):
+    """Child process body: pull (batch_no, indices), push (batch_no, arrays)."""
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        while True:
+            item = index_q.get()
+            if item is _WORKER_END:
+                return
+            bno, indices = item
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                result_q.put((bno, [np.asarray(a) for a in batch]))
+            except Exception:  # noqa: BLE001 — shipped to parent
+                result_q.put(("error", f"worker {wid}:\n{traceback.format_exc()}"))
+                return
+    except KeyboardInterrupt:
+        pass
+
+
+class _MultiprocessIter:
+    """Order-preserving fan-out over fork()ed workers.
+
+    Keeps at most `prefetch` index-batches outstanding per worker; results
+    arrive in completion order and are buffered until their turn, so the
+    output sequence is identical to single-process iteration.
+    """
+
+    def __init__(self, dataset, batches, collate_fn, num_workers,
+                 worker_init_fn, timeout, prefetch=2, mp_context=None):
+        import multiprocessing as mp
+
+        # fork (default) inherits closures/datasets without pickling, the
+        # same trade-off as the reference's and torch's Linux loaders; it
+        # is unsafe if a forked child allocates while a backend thread
+        # holds the malloc lock — pass multiprocessing_context="spawn" to
+        # DataLoader for picklable datasets if children ever deadlock
+        if mp_context is None or isinstance(mp_context, str):
+            ctx = mp.get_context(mp_context or "fork")
+        else:
+            ctx = mp_context
+        self._batches = batches
+        self._timeout = timeout if timeout and timeout > 0 else None
+        self._index_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(dataset, self._index_q, self._result_q, collate_fn,
+                      worker_init_fn, w),
+                daemon=True,
+            )
+            for w in range(num_workers)
+        ]
+        for w in self._workers:
+            w.start()
+        self._send = enumerate(batches)
+        self._pending = {}
+        self._next = 0
+        self._ends_sent = False
+        for _ in range(prefetch * num_workers):
+            self._submit_one()
+
+    def _submit_one(self):
+        nxt = next(self._send, None)
+        if nxt is not None:
+            self._index_q.put(nxt)
+        elif not self._ends_sent:
+            for _ in self._workers:
+                self._index_q.put(_WORKER_END)
+            self._ends_sent = True
+
+    def _get_result(self):
+        deadline_each = 1.0
+        waited = 0.0
+        while True:
+            try:
+                return self._result_q.get(timeout=deadline_each)
+            except _queue.Empty:
+                waited += deadline_each
+                # a worker that exited nonzero (OOM-kill, segfault) took
+                # its in-flight batch with it; waiting on the survivors
+                # would deadlock — the batch can never arrive
+                crashed = [
+                    w for w in self._workers
+                    if not w.is_alive() and w.exitcode not in (0, None)
+                ]
+                if crashed:
+                    codes = [w.exitcode for w in crashed]
+                    raise RuntimeError(
+                        f"DataLoader: {len(crashed)} worker(s) died with "
+                        f"exit code(s) {codes} (OOM-killed or crashed?)"
+                    ) from None
+                if not any(w.is_alive() for w in self._workers):
+                    raise RuntimeError(
+                        "DataLoader: all workers exited without delivering "
+                        "a batch (check worker stderr)"
+                    ) from None
+                if self._timeout is not None and waited >= self._timeout:
+                    raise RuntimeError(
+                        f"DataLoader: timed out after {waited:.0f}s waiting "
+                        f"for a worker batch"
+                    ) from None
+
+    def __iter__(self):
+        try:
+            while self._next < len(self._batches):
+                while self._next not in self._pending:
+                    tag, payload = self._get_result()
+                    if tag == "error":
+                        raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+                    self._pending[tag] = payload
+                    self._submit_one()
+                yield self._pending.pop(self._next)
+                self._next += 1
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        for w in self._workers:
+            if w.is_alive():
+                w.terminate()
+        for w in self._workers:
+            w.join(timeout=5)
+        for q in (self._index_q, self._result_q):
+            q.cancel_join_thread()
+            q.close()

@@ -30,7 +30,7 @@ class GeneratorLoader:
     whole-block XLA executor does not need)."""
 
     def __init__(self, feed_list=None, capacity=64, iterable=True,
-                 return_list=False, drop_last=True):
+                 return_list=False, drop_last=True, use_multiprocess=False):
         self._feed_list = list(feed_list or [])
         self._names = [
             v.name if isinstance(v, framework.Variable) else str(v)
@@ -40,6 +40,10 @@ class GeneratorLoader:
         self._iterable = iterable
         self._return_list = return_list
         self._drop_last = drop_last
+        # run the generator in a fork()ed child instead of a thread — the
+        # reference DygraphGeneratorLoader's use_multiprocess (reader.py:660):
+        # heavy Python preprocessing stops sharing the GIL with the trainer
+        self._use_multiprocess = use_multiprocess
         self._batch_reader: Optional[Callable] = None
 
     # -- generator flavors (reference from_generator API) ----------------
@@ -78,6 +82,9 @@ class GeneratorLoader:
                 "DataLoader: call set_sample_generator / "
                 "set_sample_list_generator / set_batch_generator first"
             )
+        if self._use_multiprocess:
+            yield from self._iter_multiprocess()
+            return
         q: queue.Queue = queue.Queue(maxsize=self._capacity)
         err: List[BaseException] = []
         stop = threading.Event()
@@ -121,6 +128,92 @@ class GeneratorLoader:
         finally:
             stop.set()
 
+    def _iter_multiprocess(self):
+        """One fork()ed producer streaming batches over an mp queue."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(maxsize=self._capacity)
+        reader = self._batch_reader
+
+        def producer():
+            try:
+                for batch in reader():
+                    q.put([np.asarray(a) for a in batch])
+                q.put(None)
+            except Exception as e:  # noqa: BLE001 — shipped to parent
+                q.put(("__error__", repr(e)))
+            except KeyboardInterrupt:
+                pass
+
+        p = ctx.Process(target=producer, daemon=True)
+        p.start()
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=1.0)
+                except queue.Empty:
+                    if not p.is_alive():
+                        raise RuntimeError(
+                            "DataLoader: generator worker process died"
+                        ) from None
+                    continue
+                if item is None:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
+                    raise RuntimeError(f"DataLoader worker failed: {item[1]}")
+                arrays = [np.asarray(a) for a in item]
+                if self._return_list or not self._names:
+                    yield arrays
+                else:
+                    yield dict(zip(self._names, arrays))
+        finally:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=5)
+            q.cancel_join_thread()
+            q.close()
+
+
+def _buffered_gen(gen, capacity=2):
+    """Background-thread prefetch (double buffering) with abandon-safe
+    shutdown: a stop flag checked by the timed put releases the worker
+    when the consumer breaks early."""
+    q: queue.Queue = queue.Queue(maxsize=capacity)
+    err: List[BaseException] = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in gen:
+                if not _put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+            err.append(e)
+        finally:
+            _put(_END)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+
 
 def _stack_samples(samples):
     ncol = len(samples[0])
@@ -128,7 +221,88 @@ def _stack_samples(samples):
 
 
 class DataLoader:
-    """Reference reader.py:112."""
+    """Reference reader.py:112: map-style Dataset + BatchSampler +
+    multiprocess workers (fluid/dataloader/), plus the from_generator /
+    from_dataset constructors.
+
+    num_workers=0 loads inline; num_workers=N forks N worker processes
+    that collate index-batches in parallel — submission order is restored,
+    so N>0 yields the identical batch sequence (dataloader/__init__.py).
+    """
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=False,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, use_shared_memory=False, timeout=0,
+                 worker_init_fn=None, multiprocessing_context=None):
+        from .dataloader import BatchSampler, IterableDataset, default_collate_fn
+
+        self._dataset = dataset
+        self._names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in (feed_list or [])
+        ]
+        self._return_list = return_list or not self._names
+        self._iterable_ds = isinstance(dataset, IterableDataset)
+        if self._iterable_ds:
+            if num_workers > 0:
+                raise ValueError(
+                    "IterableDataset cannot be index-sharded across workers; "
+                    "use num_workers=0 (or GeneratorLoader for off-process "
+                    "streaming)"
+                )
+            if batch_sampler is not None:
+                raise ValueError("IterableDataset does not take a batch_sampler")
+            self._batch_size, self._drop_last = int(batch_size), drop_last
+            self._batch_sampler = None
+        else:
+            self._batch_sampler = batch_sampler or BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+        self._collate = collate_fn or default_collate_fn
+        self._num_workers = int(num_workers)
+        self._use_buffer = use_buffer_reader
+        self._timeout = timeout
+        self._worker_init_fn = worker_init_fn
+        self._mp_context = multiprocessing_context
+
+    def __len__(self):
+        if self._batch_sampler is None:
+            raise TypeError("len() of an IterableDataset loader")
+        return len(self._batch_sampler)
+
+    def _raw_batches(self):
+        if self._iterable_ds:
+            buf = []
+            for sample in self._dataset:
+                buf.append(sample)
+                if len(buf) == self._batch_size:
+                    yield self._collate(buf)
+                    buf = []
+            if buf and not self._drop_last:
+                yield self._collate(buf)
+            return
+        batches = list(self._batch_sampler)
+        if self._num_workers > 0:
+            from .dataloader import _MultiprocessIter
+
+            yield from _MultiprocessIter(
+                self._dataset, batches, self._collate, self._num_workers,
+                self._worker_init_fn, self._timeout,
+                mp_context=self._mp_context,
+            )
+        else:
+            for idx in batches:
+                yield self._collate([self._dataset[i] for i in idx])
+
+    def __iter__(self):
+        gen = self._raw_batches()
+        if self._use_buffer and self._num_workers == 0:
+            gen = _buffered_gen(gen, capacity=2)
+        for arrays in gen:
+            arrays = [np.asarray(a) for a in arrays]
+            yield arrays if self._return_list else dict(zip(self._names, arrays))
 
     @staticmethod
     def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
@@ -137,6 +311,7 @@ class DataLoader:
         return GeneratorLoader(
             feed_list=feed_list, capacity=capacity, iterable=iterable,
             return_list=return_list, drop_last=drop_last,
+            use_multiprocess=use_multiprocess,
         )
 
     @staticmethod

@@ -1,0 +1,23 @@
+"""paddle.io 2.0-preview namespace: datasets + multiprocess DataLoader.
+
+Parity: the reference exposes Dataset/BatchSampler/DataLoader as
+`paddle.io` (python/paddle/io/__init__.py re-exporting
+fluid/dataloader/ + fluid/reader.py:112).
+"""
+from ..fluid.dataloader import (  # noqa: F401
+    BatchSampler,
+    Dataset,
+    IterableDataset,
+    TensorDataset,
+    default_collate_fn,
+)
+from ..fluid.reader import DataLoader  # noqa: F401
+
+__all__ = [
+    "Dataset",
+    "IterableDataset",
+    "TensorDataset",
+    "BatchSampler",
+    "DataLoader",
+    "default_collate_fn",
+]

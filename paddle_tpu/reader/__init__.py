@@ -80,23 +80,15 @@ def compose(*readers, check_alignment=True):
 
 
 def buffered(reader, size):
-    """Background-thread prefetch of up to `size` samples."""
+    """Background-thread prefetch of up to `size` samples. Abandon-safe
+    (worker released when the consumer breaks early) and error-faithful
+    (reader exceptions re-raise on the consumer) via the shared
+    fluid.reader._buffered_gen implementation."""
 
     def buffered_reader():
-        q: Queue = Queue(maxsize=size)
-        end = object()
+        from ..fluid.reader import _buffered_gen
 
-        def worker():
-            for d in reader():
-                q.put(d)
-            q.put(end)
-
-        Thread(target=worker, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is end:
-                return
-            yield item
+        yield from _buffered_gen(reader(), capacity=size)
 
     return buffered_reader
 
