@@ -1,0 +1,111 @@
+"""Trainer liveness via file heartbeats.
+
+Parity surface: the reference's PS-side HeartBeatMonitor
+(/root/reference/paddle/fluid/operators/distributed/heart_beat_monitor.h:54)
+marks a trainer TIMEOUT when no UPDATE arrives within a window, and its
+launcher aborts the job on any child failure (distributed/utils.py:407) —
+detection only on hard exit, nothing for hangs.
+
+TPU-native design: no parameter server exists to observe traffic, so
+liveness is its own tiny channel — each trainer stamps a per-rank
+heartbeat file (shared filesystem for multi-host) from a daemon thread,
+and the launcher treats a stale stamp as a hang, which XLA collectives
+otherwise turn into a silent whole-job stall (one lost participant blocks
+every psum). Detection feeds the launcher's elastic restart
+(launch.py --elastic_retries): kill the group, respawn, resume from
+checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+ENV_DIR = "PADDLE_HEARTBEAT_DIR"
+
+
+def _stamp_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"heartbeat.{rank}")
+
+
+class HeartBeatWorker:
+    """Daemon thread stamping this trainer's heartbeat file."""
+
+    def __init__(self, directory: str, rank: int, interval: float = 1.0):
+        self.path = _stamp_path(directory, rank)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+
+    def _beat(self):
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(repr(time.time()))
+        os.replace(tmp, self.path)  # atomic: monitor never reads a torn file
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._beat()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self._beat()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def start_heartbeat(interval: float = 1.0) -> Optional[HeartBeatWorker]:
+    """Trainer-side entry: start stamping if the launcher enabled
+    heartbeats (PADDLE_HEARTBEAT_DIR set); no-op otherwise. Called by
+    parallel.env.init_parallel_env so launched trainers get liveness
+    reporting without code changes."""
+    directory = os.environ.get(ENV_DIR)
+    if not directory:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    return HeartBeatWorker(directory, rank, interval).start()
+
+
+class HeartBeatMonitor:
+    """Launcher-side: which ranks have not stamped within `timeout`?
+
+    A rank is only considered once it stamps AFTER this monitor was
+    created: startup (imports, first XLA compile) can legitimately exceed
+    the window, and a leftover stamp from a previous job in a reused
+    shared directory must not kill the new group before it boots.
+    """
+
+    def __init__(self, directory: str, ranks: List[int], timeout: float):
+        self.directory = directory
+        self.ranks = list(ranks)
+        self.timeout = timeout
+        self._t0 = time.time()
+
+    def stale_ranks(self, now: Optional[float] = None,
+                    ranks: Optional[List[int]] = None) -> List[int]:
+        """`ranks` narrows the check (the launcher passes only ranks whose
+        process is still running — a trainer that already exited cleanly
+        stops stamping and must not read as hung)."""
+        now = time.time() if now is None else now
+        stale = []
+        for r in self.ranks if ranks is None else ranks:
+            try:
+                mtime = os.path.getmtime(_stamp_path(self.directory, r))
+            except OSError:
+                continue  # not started stamping yet
+            if mtime < self._t0:
+                continue  # stale leftover from a previous job/attempt
+            if now - mtime > self.timeout:
+                stale.append(r)
+        return stale

@@ -57,13 +57,29 @@ def _parse_args(argv):
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--log_dir", default=None)
+    p.add_argument(
+        "--elastic_retries", type=int, default=0,
+        help="restart the local trainer group up to N times after a "
+        "failure (trainers resume from their own checkpoints; "
+        "PADDLE_ELASTIC_RESTART carries the attempt number). 0 = "
+        "reference behavior: fail fast (utils.py:407)",
+    )
+    p.add_argument(
+        "--heartbeat_timeout", type=float, default=0.0,
+        help="treat a trainer as hung when its heartbeat file "
+        "(distributed/heartbeat.py; stamped by init_parallel_env) goes "
+        "stale for this many seconds — catches collective deadlocks that "
+        "never exit. 0 = off",
+    )
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
 def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
-                         script_args: List[str], log_dir: Optional[str]):
+                         script_args: List[str], log_dir: Optional[str],
+                         restart_count: int = 0,
+                         heartbeat_dir: Optional[str] = None):
     """Fork this node's trainers with the env protocol (reference
     utils.start_local_trainers:340)."""
     endpoints = ",".join(t.endpoint for t in cluster)
@@ -77,10 +93,14 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
             PADDLE_TRAINERS_NUM=str(len(cluster)),
             PADDLE_TRAINER_ENDPOINTS=endpoints,
             PADDLE_CURRENT_ENDPOINT=t.endpoint,
+            PADDLE_ELASTIC_RESTART=str(restart_count),
         )
+        if heartbeat_dir:
+            env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
         cmd = [sys.executable, "-u", script] + list(script_args)
         if log_dir:
-            t.log = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
+            mode = "a" if restart_count else "w"
+            t.log = open(os.path.join(log_dir, f"workerlog.{t.rank}"), mode)
             t.proc = subprocess.Popen(cmd, env=env, stdout=t.log,
                                       stderr=subprocess.STDOUT)
         else:
@@ -105,10 +125,13 @@ def terminate_local_trainers(trainers: List[Trainer]):
             t.log.close()
 
 
-def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2) -> int:
-    """Block until all trainers exit. Any nonzero exit aborts the whole
-    local group (reference watch_local_trainers:407: fail fast, recovery
-    is checkpoint+restart). Returns the job's exit code."""
+def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
+                         monitor=None) -> int:
+    """Block until all trainers exit. Any nonzero exit — or a stale
+    heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
+    aborts the whole local group (reference watch_local_trainers:407:
+    fail fast; heartbeat parity: heart_beat_monitor.h:54). Returns the
+    job's exit code."""
     try:
         while True:
             alive = False
@@ -126,6 +149,18 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2) -> int:
                     return rc
             if not alive:
                 return 0
+            if monitor is not None:
+                running = [t.rank for t in trainers if t.proc.poll() is None]
+                stale = monitor.stale_ranks(ranks=running)
+                if stale:
+                    print(
+                        f"[launch] trainer rank(s) {stale} stopped "
+                        f"heartbeating for >{monitor.timeout}s (hang?); "
+                        f"aborting the group",
+                        file=sys.stderr,
+                    )
+                    terminate_local_trainers(trainers)
+                    return 124  # timeout-style exit code
             time.sleep(poll_interval)
     except KeyboardInterrupt:
         terminate_local_trainers(trainers)
@@ -137,14 +172,64 @@ def launch(argv=None) -> int:
     ips = [s.strip() for s in args.ips.split(",") if s.strip()]
     node_ip = args.node_ip or ips[0]
     cluster = get_cluster(ips, args.nproc_per_node, args.started_port)
-    local = start_local_trainers(
-        cluster, node_ip, args.training_script, args.training_script_args,
-        args.log_dir,
-    )
-    if not local:
-        print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
-        return 2
-    return watch_local_trainers(local)
+
+    heartbeat_dir = None
+    own_heartbeat_dir = False
+    if args.heartbeat_timeout > 0:
+        heartbeat_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
+        if not heartbeat_dir:
+            import tempfile
+
+            heartbeat_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
+            own_heartbeat_dir = True
+
+    try:
+        return _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir)
+    finally:
+        if own_heartbeat_dir:
+            import shutil
+
+            shutil.rmtree(heartbeat_dir, ignore_errors=True)
+
+
+def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir) -> int:
+    attempt = 0
+    while True:
+        local = start_local_trainers(
+            cluster, node_ip, args.training_script, args.training_script_args,
+            args.log_dir, restart_count=attempt, heartbeat_dir=heartbeat_dir,
+        )
+        if not local:
+            print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
+            return 2
+        monitor = None
+        if heartbeat_dir:
+            from .heartbeat import HeartBeatMonitor
+
+            # created AFTER spawn: a fresh monitor ignores stamps older
+            # than itself, so leftovers from a previous attempt/job in a
+            # reused shared dir never read as hangs
+            monitor = HeartBeatMonitor(
+                heartbeat_dir, [t.rank for t in local], args.heartbeat_timeout
+            )
+        rc = watch_local_trainers(local, monitor=monitor)
+        if rc == 0 or attempt >= args.elastic_retries or rc == 128 + signal.SIGINT:
+            return rc
+        attempt += 1
+        print(
+            f"[launch] elastic restart {attempt}/{args.elastic_retries} "
+            f"after exit code {rc} (trainers resume from checkpoint)",
+            file=sys.stderr,
+        )
+        if heartbeat_dir:
+            # drop stale stamps so the new group starts with a clean slate
+            from .heartbeat import _stamp_path
+
+            for t in local:
+                try:
+                    os.remove(_stamp_path(heartbeat_dir, t.rank))
+                except OSError:
+                    pass
 
 
 if __name__ == "__main__":

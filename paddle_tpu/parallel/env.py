@@ -45,6 +45,11 @@ def init_parallel_env() -> None:
     global _initialized
     if _initialized:
         return
+    # liveness stamping for the launcher's hang detection / elastic
+    # restart (no-op unless the launcher set PADDLE_HEARTBEAT_DIR)
+    from ..distributed.heartbeat import start_heartbeat
+
+    start_heartbeat()
     world = get_world_size()
     if world > 1:
         import jax

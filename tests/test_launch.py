@@ -89,3 +89,115 @@ def test_launch_unknown_node_ip(tmp_path):
         extra=("--ips", "10.1.1.1,10.1.1.2", "--node_ip", "10.9.9.9"),
     )
     assert r.returncode == 2
+
+
+def test_launch_elastic_restart_recovers(tmp_path):
+    """Rank 0 crashes on the first attempt, succeeds after the elastic
+    restart (PADDLE_ELASTIC_RESTART carries the attempt number) — the
+    automated form of the reference's checkpoint+restart recovery story."""
+    r = _run_launch(
+        tmp_path,
+        """
+        import os, sys
+        out = sys.argv[1]
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        attempt = int(os.environ["PADDLE_ELASTIC_RESTART"])
+        with open(os.path.join(out, f"attempts.{rank}.{attempt}"), "w"):
+            pass
+        if rank == "0" and attempt == 0:
+            sys.exit(3)  # simulated crash before the first checkpoint
+        """,
+        nproc=2,
+        extra=("--elastic_retries", "2"),
+    )
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "attempts.0.0").exists()
+    assert (tmp_path / "attempts.0.1").exists()  # restarted group ran
+    assert "elastic restart 1/2" in r.stderr
+
+
+def test_launch_elastic_exhausted_fails(tmp_path):
+    r = _run_launch(
+        tmp_path,
+        """
+        import sys
+        sys.exit(7)
+        """,
+        nproc=2,
+        extra=("--elastic_retries", "1"),
+    )
+    assert r.returncode == 7
+    assert "elastic restart 1/1" in r.stderr
+
+
+def test_launch_heartbeat_detects_hang(tmp_path):
+    """A trainer that stops heartbeating (hung collective analog) is
+    detected and the group is torn down with exit code 124 — capability
+    the reference lacks (its launcher only sees hard exits)."""
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import os, sys, time
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.distributed.heartbeat import start_heartbeat
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        hb = start_heartbeat(interval=0.2)
+        assert hb is not None
+        if rank == "1":
+            hb.stop()   # rank 1 "hangs": alive but no heartbeats
+            time.sleep(60)
+        else:
+            time.sleep(60)  # healthy ranks keep beating while they work
+        """
+    ))
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node", "2", "--heartbeat_timeout", "2.0",
+        str(script),
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO, REPO=REPO,
+               PADDLE_HEARTBEAT_DIR=str(hb_dir))
+    t0 = time.time()
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 124, (r.returncode, r.stderr)
+    assert "stopped heartbeating" in r.stderr
+    assert time.time() - t0 < 45  # detected the hang, did not wait out sleeps
+
+
+def test_launch_heartbeat_ignores_clean_exit_and_stale_leftovers(tmp_path):
+    """A rank that exits 0 stops stamping but must not read as hung; a
+    leftover stamp from a previous job in a reused dir must not kill the
+    new group (monitor only trusts stamps newer than itself)."""
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    # leftover stamp from a "previous job", hours old
+    stale = hb_dir / "heartbeat.0"
+    stale.write_text("0.0")
+    os.utime(stale, (1, 1))
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import os, sys, time
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.distributed.heartbeat import start_heartbeat
+        start_heartbeat(interval=0.2)
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        if rank == "0":
+            time.sleep(1)   # finishes early, exits 0, stops stamping
+        else:
+            time.sleep(8)   # keeps working well past rank 0's staleness
+        """
+    ))
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node", "2", "--heartbeat_timeout", "2.0",
+        str(script),
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO, REPO=REPO,
+               PADDLE_HEARTBEAT_DIR=str(hb_dir))
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 0, (r.returncode, r.stderr)
