@@ -1,0 +1,276 @@
+"""Breadth layers: activations, selection, randomness, metrics, misc.
+
+Parity surface: reference python/paddle/fluid/layers/nn.py + tensor.py
+entries — selu, brelu, soft_relu, stanh, multiplex, rank, size, sum,
+scatter_nd, unique, unique_with_counts, is_empty, hash, shard_index,
+sampling_id, gaussian_random(+batch_size_like), uniform_random(+bsl),
+mean_iou, bilinear_tensor_product, add_position_encoding, fsp_matrix,
+auc, chunk_eval, autoincreased_step_counter, get_tensor_from_selected_rows,
+merge_selected_rows.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from . import nn as _nn
+from . import tensor as _tensor
+
+
+def _simple(op_type, x, attrs=None, out_slot="Out", in_slot="X", name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type=op_type, inputs={in_slot: [x]},
+                     outputs={out_slot: [out]}, attrs=attrs or {})
+    return out
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _simple("selu", x, {"scale": scale, "alpha": alpha}, name=name)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _simple("brelu", x, {"t_min": t_min, "t_max": t_max}, name=name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _simple("soft_relu", x, {"threshold": threshold}, name=name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _simple("stanh", x, {"scale_a": scale_a, "scale_b": scale_b},
+                   name=name)
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def rank(input):
+    """Static rank as a constant tensor (reference rank)."""
+    return _tensor.fill_constant([1], "int32", len(input.shape))
+
+
+def size(input):
+    """Static element count as a constant tensor (reference size)."""
+    return _tensor.fill_constant([1], "int64", int(np.prod(input.shape)))
+
+
+def sum(x):
+    """Elementwise sum of a tensor list (reference sum op layer)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper = LayerHelper("sum")
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": list(xs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """scatter_nd_add onto zeros (the reference defines it exactly so)."""
+    zeros = _tensor.fill_constant(list(shape), updates.dtype, 0.0)
+    return _nn.scatter_nd_add(zeros, index, updates)
+
+
+def unique(x, dtype="int32"):
+    """Static-shape unique: Out is x-sized (unique prefix then padding),
+    plus Index (inverse map) and a scalar count — slice host-side with
+    the count (XLA cannot return data-dependent shapes)."""
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    cnt = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "UniqueCount": [cnt]})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    cnt = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "Count": [count], "UniqueCount": [cnt]})
+    return out, index, count
+
+
+def is_empty(x, cond=None):
+    """Static emptiness as a constant bool (shapes are static on TPU)."""
+    val = int(np.prod(x.shape)) == 0
+    out = _tensor.fill_constant([1], "bool", val)
+    if cond is not None:
+        _tensor.assign(out, output=cond)
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="hash", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"mod_by": int(hash_size),
+                            "num_hash": int(num_hash)})
+    return out
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    helper = LayerHelper("shard_index")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="shard_index", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"index_num": index_num, "nshards": nshards,
+                            "shard_id": shard_id,
+                            "ignore_value": ignore_value})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    from .nn import _rng_salt_counter
+
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference("int64")
+    _rng_salt_counter[0] += 1
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"rng_salt": _rng_salt_counter[0] + seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="gaussian_random", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": float(mean),
+                            "std": float(std), "seed": seed, "dtype": dtype})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="uniform_random", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "min": float(min),
+                            "max": float(max), "seed": seed, "dtype": dtype})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return gaussian_random(shape, mean, std, seed, dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return uniform_random(shape, dtype, min, max, seed)
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mean_iou", inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": int(num_classes)},
+    )
+    return miou, wrong, correct
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = x.dtype
+    w = helper.create_parameter(
+        helper.param_attr, shape=[size, x.shape[-1], y.shape[-1]], dtype=dtype
+    )
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, shape=[1, size],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """x*alpha + beta*sinusoid position encoding (reference
+    add_position_encoding_op.cc) — emitted as a constant table + ops."""
+    b, t, d = input.shape
+    half = d // 2
+    pos = np.arange(t, dtype=np.float32)[:, None]
+    inv = 1.0 / np.power(10000.0, np.arange(half, dtype=np.float32) / half)
+    table = np.zeros((t, d), np.float32)
+    table[:, :half] = np.sin(pos * inv[None, :])
+    table[:, half:2 * half] = np.cos(pos * inv[None, :])
+    enc = _tensor.assign(table)
+    enc3 = _nn.reshape(enc, [1, t, d])
+    return _nn.elementwise_add(
+        _nn.scale(input, scale=float(alpha)),
+        _nn.scale(_nn.expand_as(enc3, input), scale=float(beta)),
+    )
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation (reference
+    fsp_op.cc): [N, Cx, Cy] = x·y^T over flattened H*W, normalized."""
+    n, cx = x.shape[0], x.shape[1]
+    cy = y.shape[1]
+    hw = int(np.prod(x.shape[2:]))
+    xf = _nn.reshape(x, [n, cx, hw])
+    yf = _nn.reshape(y, [n, cy, hw])
+    prod = _nn.matmul(xf, _nn.transpose(yf, [0, 2, 1]))
+    return _nn.scale(prod, scale=1.0 / hw)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int32 step counter incremented every execution
+    (reference layers/nn.py autoincreased_step_counter; int32 is exact to
+    2^31 steps — see fluid/optimizer.py note on x64)."""
+    from ..framework import default_main_program
+    from ..optimizer import _create_persistable_var
+
+    name = counter_name or "@STEP_COUNTER@"
+    mb = default_main_program().global_block()
+    if name in mb.vars:
+        counter = mb.var(name)
+    else:
+        counter = _create_persistable_var(name, (1,), "int32",
+                                          float(begin - 1))
+    helper = LayerHelper("increment")
+    helper.append_op(type="increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]}, attrs={"step": float(step)})
+    return counter
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows do not exist on TPU (sparse grads are dense
+    scatter-adds, framework.py:33); identity for API compatibility."""
+    return _tensor.assign(x)
+
+
+def merge_selected_rows(x, name=None):
+    """See get_tensor_from_selected_rows: identity on the dense analog."""
+    return _tensor.assign(x)

@@ -1,0 +1,461 @@
+"""Vision / image layers.
+
+Parity surface: reference python/paddle/fluid/layers/nn.py — conv3d,
+conv3d_transpose, pool3d, adaptive_pool3d, image_resize(+short),
+resize_{bilinear,nearest,linear,trilinear}, grid_sampler, affine_grid,
+affine_channel, pixel_shuffle, shuffle_channel, space_to_depth,
+temporal_shift, lrn, unfold, im2sequence, roi_pool, spectral_norm,
+data_norm, crop(_tensor), pad_constant_like, random_crop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable
+from ..initializer import ConstantInitializer, NormalInitializer
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def _triple(v):
+    return [v, v, v] if isinstance(v, int) else list(v)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    """NCDHW 3D convolution (reference layers/nn.py conv3d)."""
+    helper = LayerHelper("conv3d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    fs = _triple(filter_size)
+    filter_shape = [num_filters, num_channels // groups] + fs
+    std = (2.0 / (fs[0] * fs[1] * fs[2] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std),
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+               "dilations": _triple(dilation), "groups": groups},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv3d_transpose: need filter_size or output_size")
+        # reference conv3d_transpose: k = out - (in-1)*stride + 2*pad
+        outs = _triple(output_size) if not isinstance(output_size, int) else _triple(output_size)
+        st, pd = _triple(stride), _triple(padding)
+        filter_size = [
+            outs[i] - (input.shape[2 + i] - 1) * st[i] + 2 * pd[i]
+            for i in range(3)
+        ]
+        if any(k <= 0 for k in filter_size):
+            raise ValueError(
+                f"conv3d_transpose: derived non-positive filter_size "
+                f"{filter_size} from output_size {outs}"
+            )
+    fs = _triple(filter_size)
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[num_channels, num_filters // (groups or 1)] + fs,
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+               "dilations": _triple(dilation), "groups": groups or 1},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _triple(pool_size),
+               "strides": _triple(pool_stride),
+               "paddings": _triple(pool_padding),
+               "global_pooling": global_pooling, "exclusive": exclusive},
+    )
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    if require_index:
+        raise NotImplementedError("adaptive_pool3d require_index")
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _triple(pool_size),
+               "adaptive": True},
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# resize family
+# ---------------------------------------------------------------------------
+
+_INTERP_OPS = {
+    "BILINEAR": ("bilinear_interp", 2),
+    "NEAREST": ("nearest_interp", 2),
+    "TRILINEAR": ("trilinear_interp", 3),
+    "LINEAR": ("linear_interp", 1),
+}
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    """Resize spatial dims (reference layers/nn.py image_resize). out_shape
+    must be static ints on TPU (XLA static shapes); `scale` computes one."""
+    resample = resample.upper()
+    if resample not in _INTERP_OPS:
+        raise ValueError(f"image_resize: unknown resample {resample}")
+    op_type, ndim = _INTERP_OPS[resample]
+    spatial = list(input.shape[2:])
+    if out_shape is None:
+        if scale is None:
+            raise ValueError("image_resize: need out_shape or scale")
+        out_shape = [int(d * scale) for d in spatial]
+    out_shape = [int(v) for v in out_shape]
+    if len(out_shape) != ndim:
+        raise ValueError(f"{resample} expects {ndim}-D out_shape")
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
+    if ndim == 1:
+        attrs["out_w"] = out_shape[0]
+    elif ndim == 2:
+        attrs["out_h"], attrs["out_w"] = out_shape
+    else:
+        attrs["out_d"], attrs["out_h"], attrs["out_w"] = out_shape
+    helper.append_op(type=op_type, inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    return image_resize(input, out_shape, scale, name, "LINEAR",
+                        actual_shape, align_corners, align_mode)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    out = [int(round(h * out_short_len / short)),
+           int(round(w * out_short_len / short))]
+    return image_resize(input, out_shape=out, resample=resample)
+
+
+# ---------------------------------------------------------------------------
+# sampling / geometric
+# ---------------------------------------------------------------------------
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    if isinstance(out_shape, Variable):
+        raise NotImplementedError("affine_grid: out_shape must be static ints")
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    helper.append_op(
+        type="affine_grid", inputs={"Theta": [theta]},
+        outputs={"Output": [out]},
+        attrs={"output_shape": [int(v) for v in out_shape]},
+    )
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler", inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    """Per-channel scale+bias (reference affine_channel_op.cc) — a pure
+    composition: reshape scale/bias onto the channel dim."""
+    from . import nn as _nn
+
+    ch_dim = 1 if data_layout == "NCHW" else len(x.shape) - 1
+    shape = [1] * len(x.shape)
+    shape[ch_dim] = x.shape[ch_dim]
+    helper = LayerHelper("affine_channel", name=name, act=act)
+    out = x
+    if scale is not None:
+        out = _nn.elementwise_mul(out, _nn.reshape(scale, shape))
+    if bias is not None:
+        out = _nn.elementwise_add(out, _nn.reshape(bias, shape))
+    return helper.append_activation(out)
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pixel_shuffle", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"upscale_factor": int(upscale_factor)})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": int(group)})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"blocksize": int(blocksize)})
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="temporal_shift", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"seg_num": int(seg_num),
+                            "shift_ratio": float(shift_ratio)})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    helper = LayerHelper("unfold", name=name)
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = [kernel_sizes, kernel_sizes]
+    if isinstance(strides, int):
+        strides = [strides, strides]
+    if isinstance(paddings, int):
+        paddings = [paddings] * 4
+    if isinstance(dilations, int):
+        dilations = [dilations, dilations]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"kernel_sizes": kernel_sizes, "strides": strides,
+                            "paddings": paddings, "dilations": dilations})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": filter_size, "strides": stride,
+                            "paddings": padding})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, batch_ids=None, name=None):
+    """rois [R, 4]; batch_ids [R] gives each ROI's image index (dense
+    replacement for the reference's LoD batching; default all image 0).
+    rois_num (per-IMAGE counts, a 2.x convenience) would need a
+    data-dependent expansion to per-ROI ids — pass batch_ids instead."""
+    if rois_num is not None:
+        raise NotImplementedError(
+            "roi_pool: per-image rois_num needs dynamic expansion; pass "
+            "per-ROI batch_ids (shape [R]) instead"
+        )
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_ids is not None:
+        inputs["BatchId"] = [batch_ids]
+    helper.append_op(type="roi_pool", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized weight (reference layers/nn.py spectral_norm):
+    U/V power-iteration vectors are persistable state parameters."""
+    helper = LayerHelper("spectral_norm", name=name)
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w = int(np.prod([s for i, s in enumerate(weight.shape) if i != dim]))
+    u = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.u", trainable=False), shape=[h],
+        dtype=dtype, default_initializer=NormalInitializer(0.0, 1.0),
+    )
+    v = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.v", trainable=False), shape=[w],
+        dtype=dtype, default_initializer=NormalInitializer(0.0, 1.0),
+    )
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="spectral_norm", inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"dim": int(dim), "power_iters": int(power_iters),
+               "eps": float(eps)},
+    )
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999):
+    """Accumulator-based normalization (reference layers/nn.py data_norm)."""
+    helper = LayerHelper("data_norm", name=name, act=act)
+    dtype = input.dtype
+    d = input.shape[-1]
+    bsize = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.batch_size", trainable=False),
+        shape=[d], dtype=dtype,
+        default_initializer=ConstantInitializer(1e4))
+    bsum = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.batch_sum", trainable=False),
+        shape=[d], dtype=dtype, default_initializer=ConstantInitializer(0.0))
+    bsq = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.batch_square_sum", trainable=False),
+        shape=[d], dtype=dtype, default_initializer=ConstantInitializer(1e4))
+    for p in (bsize, bsum, bsq):
+        p.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    means = helper.create_variable_for_type_inference(dtype)
+    scales = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [bsize], "BatchSum": [bsum],
+                "BatchSquareSum": [bsq]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+# ---------------------------------------------------------------------------
+# crop / pad
+# ---------------------------------------------------------------------------
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Static crop (reference crop_tensor): slice `shape` starting at
+    `offsets` (default 0s). Dynamic shape/offsets tensors unsupported (XLA
+    static shapes)."""
+    from . import nn as _nn
+
+    if shape is None:
+        raise ValueError("crop_tensor: shape required")
+    offsets = offsets or [0] * len(x.shape)
+    if isinstance(shape, Variable) or isinstance(offsets, Variable):
+        raise NotImplementedError("crop_tensor: static ints only on TPU")
+    axes = list(range(len(x.shape)))
+    starts = [int(o) for o in offsets]
+    ends = [int(o) + int(s) for o, s in zip(offsets, shape)]
+    return _nn.slice(x, axes=axes, starts=starts, ends=ends)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    if isinstance(shape, Variable):
+        shape = shape.shape
+    return crop_tensor(x, shape=shape, offsets=offsets, name=name)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with pad_value (reference
+    pad_constant_like_op.cc)."""
+    from . import nn as _nn
+
+    paddings = []
+    for xs, ys in zip(x.shape, y.shape):
+        paddings += [0, int(xs) - int(ys)]
+    return _nn.pad(y, paddings, pad_value=pad_value)
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop via uniform offsets (reference random_crop_op):
+    batch-uniform offsets (one crop position per graph build)."""
+    import numpy as _np
+
+    rng = _np.random.RandomState(seed)
+    offsets = [0] * (len(x.shape) - len(shape)) + [
+        int(rng.randint(0, int(xs) - int(s) + 1))
+        for xs, s in zip(x.shape[len(x.shape) - len(shape):], shape)
+    ]
+    full_shape = list(x.shape[: len(x.shape) - len(shape)]) + list(shape)
+    return crop_tensor(x, shape=full_shape, offsets=offsets)

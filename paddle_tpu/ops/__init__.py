@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     encoder_stack,
     manipulation,
     math_ops,
+    misc_ops,
     moe_ops,
     nn_ops,
     optimizer_ops,
@@ -22,5 +23,6 @@ from . import (  # noqa: F401
     recompute,
     reduce_ops,
     sequence_ops,
+    vision_ops,
 )
 from .registry import EmitContext, OpSpec, get, register, registered_ops  # noqa: F401

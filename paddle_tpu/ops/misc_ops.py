@@ -1,0 +1,236 @@
+"""Breadth ops: activations, selection, uniqueness, hashing, metrics.
+
+Parity surface: reference operators/ selu_op.cc, activation_op.cc
+(brelu/soft_relu/stanh), multiplex_op.cc, unique_with_counts_op.cc (+
+unique_op.cc), sampling_id_op.cc, hash_op.cc, mean_iou_op.cc,
+data_norm_op.cc, row_conv_op.cc, im2sequence_op.cc, shuffle_channel_op.cc,
+space_to_depth_op.cc, bilinear_tensor_product_op.cc, spectral_norm_op.cc.
+
+Static-shape notes: `unique`/`unique_with_counts` return SAME-SIZE outputs
+(the unique prefix followed by padding) plus a scalar count — XLA cannot
+produce data-dependent shapes; callers slice with the count host-side.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("selu")
+def selu(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = float(attrs.get("scale", 1.0507009873554805))
+    alpha = float(attrs.get("alpha", 1.6732632423543772))
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
+
+
+@register("brelu")
+def brelu(ctx, ins, attrs):
+    x = ins["X"][0]
+    t_min = float(attrs.get("t_min", 0.0))
+    t_max = float(attrs.get("t_max", 24.0))
+    return {"Out": [jnp.clip(x, t_min, t_max)]}
+
+
+@register("soft_relu")
+def soft_relu(ctx, ins, attrs):
+    x = ins["X"][0]
+    t = float(attrs.get("threshold", 40.0))
+    return {"Out": [jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))]}
+
+
+@register("stanh")
+def stanh(ctx, ins, attrs):
+    x = ins["X"][0]
+    a = float(attrs.get("scale_a", 0.67))
+    b = float(attrs.get("scale_b", 1.7159))
+    return {"Out": [b * jnp.tanh(a * x)]}
+
+
+@register("multiplex")
+def multiplex(ctx, ins, attrs):
+    """Ids [B,1] selects which of the N stacked X tensors supplies row b
+    (reference multiplex_op.cc)."""
+    ids = ins["Ids"][0].astype(jnp.int32).reshape(-1)
+    xs = jnp.stack(ins["X"], axis=0)  # [N, B, ...]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": [xs[ids, rows]]}
+
+
+@register("unique_with_counts", stop_gradient=True, no_vjp_grad=True)
+def unique_with_counts(ctx, ins, attrs):
+    """1-D unique with static output sizes: Out is [N] (unique prefix,
+    padded with the last unique value), Index [N] maps x -> position in
+    Out, Count [N] (0 beyond the unique prefix), UniqueCount [] scalar."""
+    x = ins["X"][0].reshape(-1)
+    uniq, idx, counts = jnp.unique(
+        x, return_inverse=True, return_counts=True, size=x.shape[0],
+        fill_value=None,
+    )
+    n_unique = (counts > 0).sum()
+    return {
+        "Out": [uniq],
+        "Index": [idx.astype(jnp.int32).reshape(-1)],
+        "Count": [counts.astype(jnp.int32)],
+        "UniqueCount": [n_unique.astype(jnp.int32)],
+    }
+
+
+@register("unique", stop_gradient=True, no_vjp_grad=True)
+def unique(ctx, ins, attrs):
+    r = unique_with_counts(ctx, ins, attrs)
+    return {"Out": r["Out"], "Index": r["Index"], "UniqueCount": r["UniqueCount"]}
+
+
+@register("sampling_id", stop_gradient=True, no_vjp_grad=True)
+def sampling_id(ctx, ins, attrs):
+    """Sample one class id per row from probabilities X [B, C]
+    (reference sampling_id_op.cc)."""
+    x = ins["X"][0]
+    key = ctx.salted_rng(int(attrs.get("rng_salt", 0))) if attrs.get(
+        "rng_salt") is not None else ctx.rng()
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-30)), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register("hash", stop_gradient=True, no_vjp_grad=True)
+def hash_op(ctx, ins, attrs):
+    """Deterministic integer hashing of int ids into [0, mod_by) with
+    num_hash independent hash functions (reference hash_op.cc uses xxhash;
+    here a Knuth multiplicative mix — different values, same contract:
+    deterministic, well-spread)."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    outs = []
+    for i in range(num_hash):
+        h = (x + jnp.uint32(i * 0x9E3779B9)) * jnp.uint32(2654435761)
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    # reference emits [rows, num_hash, 1] for [rows, 1] input
+    return {"Out": [jnp.stack(outs, axis=1).reshape(x.shape[0], num_hash, -1)]}
+
+
+@register("mean_iou", stop_gradient=True, no_vjp_grad=True)
+def mean_iou(ctx, ins, attrs):
+    """Mean intersection-over-union over classes (reference mean_iou_op.cc).
+    Predictions/Labels int [*]; num_classes static."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    nc = int(attrs["num_classes"])
+    p1 = jax.nn.one_hot(pred, nc, dtype=jnp.float32)
+    l1 = jax.nn.one_hot(label, nc, dtype=jnp.float32)
+    inter = (p1 * l1).sum(0)
+    union = p1.sum(0) + l1.sum(0) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    return {
+        "OutMeanIou": [miou.astype(jnp.float32)],
+        "OutWrong": [(l1.sum(0) - inter).astype(jnp.int32)],
+        "OutCorrect": [inter.astype(jnp.int32)],
+    }
+
+
+@register("data_norm")
+def data_norm(ctx, ins, attrs):
+    """Normalization from accumulated batch statistics (reference
+    data_norm_op.cc, CTR models): scale/shift derived from running
+    size/sum/squared-sum accumulators rather than per-batch stats."""
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    eps = float(attrs.get("epsilon", 1e-4))
+    means = bsum / bsize
+    # reference data_norm_op.cc:302: scale = sqrt(size / square_sum) —
+    # no mean^2 subtraction (the accumulators are mean-removed upstream)
+    scales = jnp.sqrt(bsize / jnp.maximum(bsq, eps))
+    out = (x - means) * scales
+    return {"Y": [out], "Means": [means], "Scales": [scales]}
+
+
+@register("row_conv")
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference row_conv_op.cc): X [B,T,D],
+    Filter [future_context+1, D]; out[t] = sum_k f[k] * x[t+k]."""
+    x, f = ins["X"][0], ins["Filter"][0]
+    ctx_len = f.shape[0]
+    padded = jnp.pad(x, [(0, 0), (0, ctx_len - 1), (0, 0)])
+    out = sum(
+        padded[:, k : k + x.shape[1]] * f[k][None, None, :]
+        for k in range(ctx_len)
+    )
+    return {"Out": [out]}
+
+
+@register("im2sequence", stop_gradient=False)
+def im2sequence(ctx, ins, attrs):
+    """Slide a window over [N,C,H,W] and lay patches out as a sequence
+    [N, L, C*kh*kw] (reference im2sequence_op.cc; dense analog of its
+    LoD output)."""
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in attrs["kernels"]]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    pads = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(pads[0], pads[2]), (pads[1], pads[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, Ho, Wo]
+    n, ckk, ho, wo = patches.shape
+    return {"Out": [patches.reshape(n, ckk, ho * wo).transpose(0, 2, 1)]}
+
+
+@register("shuffle_channel")
+def shuffle_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+    return {"Out": [out]}
+
+
+@register("space_to_depth")
+def space_to_depth(ctx, ins, attrs):
+    x = ins["X"][0]
+    bs = int(attrs.get("blocksize", 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [out.reshape(n, c * bs * bs, h // bs, w // bs)]}
+
+
+@register("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    """out[b,k] = x[b] @ W[k] @ y[b] + bias[k] (reference
+    bilinear_tensor_product_op.cc)."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register("spectral_norm")
+def spectral_norm(ctx, ins, attrs):
+    """Weight / sigma_max via power iteration with carried U/V vectors
+    (reference spectral_norm_op.cc)."""
+    w, u, v = ins["Weight"][0], ins["U"][0], ins["V"][0]
+    dim = int(attrs.get("dim", 0))
+    iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+    u = u.reshape(-1)
+    v = v.reshape(-1)
+    for _ in range(iters):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return {"Out": [w / sigma]}

@@ -1,0 +1,495 @@
+"""Breadth layers (layers/vision.py, loss.py, misc.py) through the real
+Program/Executor path, with numpy oracles for the ops exempted from the
+op sweep (the reference's per-op test contract, op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build, feeds=None, n_fetch=1):
+    """Build layers under a fresh program, run once, return numpy fetches."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    if not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds or {}, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+
+def test_conv3d_and_pool3d_shapes_and_training():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 8, 8, 8).astype(np.float32)
+
+    def build():
+        x = fluid.data("x", [2, 3, 8, 8, 8], "float32")
+        h = layers.conv3d(x, 4, 3, padding=1, act="relu")
+        p = layers.pool3d(h, 2, "max", 2)
+        a = layers.adaptive_pool3d(p, [1, 1, 1], "avg")
+        return h, p, a
+
+    h, p, a = _run(build, {"x": xv})
+    assert h.shape == (2, 4, 8, 8, 8)
+    assert p.shape == (2, 4, 4, 4, 4)
+    assert a.shape == (2, 4, 1, 1, 1)
+    np.testing.assert_allclose(a.ravel(), p.mean(axis=(2, 3, 4)).ravel(),
+                               rtol=1e-5)
+
+
+def test_conv3d_transpose_identity_oracle():
+    """1x1x1 kernel, stride 1: transposed conv == pointwise matmul with
+    the [Cin, Cout] kernel."""
+    rng = np.random.RandomState(1)
+    xv = rng.randn(1, 3, 4, 4, 4).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1, 3, 4, 4, 4], "float32")
+        y = layers.conv3d_transpose(x, 2, filter_size=1, bias_attr=False)
+        wname = [p.name for p in main.all_parameters()][0]
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        got, w = exe.run(main, feed={"x": xv}, fetch_list=[y, wname])
+    w = np.asarray(w).reshape(3, 2)
+    want = np.einsum("bcdhw,ck->bkdhw", xv, w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_resize_nearest_and_bilinear_oracles():
+    xv = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build():
+        x = fluid.data("x", [1, 1, 4, 4], "float32")
+        up = layers.resize_nearest(x, [8, 8], align_corners=False)
+        bi = layers.resize_bilinear(x, [7, 7], align_corners=True)
+        tri = layers.resize_trilinear(
+            layers.reshape(x, [1, 1, 1, 4, 4]), [1, 4, 4], align_corners=True)
+        li = layers.resize_linear(
+            layers.reshape(x, [1, 4, 4]), [8], align_corners=False)
+        short = layers.image_resize_short(x, 2)
+        return up, bi, tri, li, short
+
+    up, bi, tri, li, short = _run(build, {"x": xv})
+    np.testing.assert_array_equal(up[0, 0], np.repeat(np.repeat(
+        xv[0, 0], 2, 0), 2, 1))
+    # align_corners bilinear keeps the exact corner pixels
+    for (i, j), (si, sj) in zip([(0, 0), (0, 6), (6, 0), (6, 6)],
+                                [(0, 0), (0, 3), (3, 0), (3, 3)]):
+        np.testing.assert_allclose(bi[0, 0, i, j], xv[0, 0, si, sj], rtol=1e-6)
+    np.testing.assert_allclose(tri.reshape(4, 4), xv[0, 0], rtol=1e-5)
+    assert li.shape == (1, 4, 8)
+    assert short.shape == (1, 1, 2, 2)
+
+
+def test_affine_grid_and_grid_sampler_identity():
+    """Identity theta -> identity grid -> sampler reproduces the input."""
+    rng = np.random.RandomState(3)
+    xv = rng.randn(2, 3, 5, 5).astype(np.float32)
+    theta_v = np.tile(np.asarray([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1))
+
+    def build():
+        x = fluid.data("x", [2, 3, 5, 5], "float32")
+        theta = fluid.data("theta", [2, 2, 3], "float32")
+        grid = layers.affine_grid(theta, [2, 3, 5, 5])
+        return layers.grid_sampler(x, grid)
+
+    (out,) = _run(build, {"x": xv, "theta": theta_v})
+    np.testing.assert_allclose(out, xv, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_oracle():
+    xv = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.asarray([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+
+    def build():
+        x = fluid.data("x", [1, 1, 4, 4], "float32")
+        r = fluid.data("rois", [2, 4], "float32")
+        return layers.roi_pool(x, r, 1, 1, 1.0)
+
+    (out,) = _run(build, {"x": xv, "rois": rois})
+    # max over each 2x2 box
+    np.testing.assert_allclose(out.reshape(2), [5.0, 15.0])
+
+
+def test_spectral_norm_matches_svd():
+    rng = np.random.RandomState(4)
+    wv = rng.randn(6, 4).astype(np.float32)
+
+    def build():
+        w = fluid.data("w", [6, 4], "float32")
+        return layers.spectral_norm(w, power_iters=50)
+
+    (out,) = _run(build, {"w": wv})
+    sigma = np.linalg.svd(wv, compute_uv=False)[0]
+    np.testing.assert_allclose(out, wv / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_data_norm_statistics_oracle():
+    rng = np.random.RandomState(5)
+    xv = rng.randn(8, 4).astype(np.float32)
+
+    def build():
+        x = fluid.data("x", [8, 4], "float32")
+        return layers.data_norm(x)
+
+    (out,) = _run(build, {"x": xv})
+    # fresh accumulators: size=1e4, sum=0, sqsum=1e4 -> mean 0, scale ~ sqrt(1e4/1e4)=1
+    np.testing.assert_allclose(out, xv, rtol=1e-4)
+
+
+def test_crop_pad_and_misc_reshapes():
+    rng = np.random.RandomState(6)
+    xv = rng.randn(2, 4, 4, 4).astype(np.float32)
+
+    def build():
+        x = fluid.data("x", [2, 4, 4, 4], "float32")
+        c = layers.crop_tensor(x, shape=[2, 4, 2, 2], offsets=[0, 0, 1, 1])
+        y = layers.crop_tensor(x, shape=[2, 2, 4, 4])
+        p = layers.pad_constant_like(x, y, pad_value=0.0)
+        ps = layers.pixel_shuffle(x, 2)
+        sd = layers.space_to_depth(x, 2)
+        sc = layers.shuffle_channel(x, 2)
+        rc = layers.random_crop(x, [2, 2], seed=1)
+        return c, p, ps, sd, sc, rc
+
+    c, p, ps, sd, sc, rc = _run(build, {"x": xv})
+    np.testing.assert_array_equal(c, xv[:, :, 1:3, 1:3])
+    assert p.shape == xv.shape and np.all(p[:, 2:] == 0)
+    np.testing.assert_array_equal(p[:, :2], xv[:, :2])
+    assert ps.shape == (2, 1, 8, 8)
+    assert sd.shape == (2, 16, 2, 2)
+    assert sc.shape == xv.shape
+    assert rc.shape == (2, 4, 2, 2)
+
+
+def test_lrn_unfold_temporal_affine_channel():
+    rng = np.random.RandomState(7)
+    xv = rng.randn(2, 8, 4, 4).astype(np.float32)
+    sv = rng.rand(8).astype(np.float32) + 0.5
+    bv = rng.randn(8).astype(np.float32)
+
+    def build():
+        x = fluid.data("x", [2, 8, 4, 4], "float32")
+        s = fluid.data("s", [8], "float32")
+        b = fluid.data("b", [8], "float32")
+        l = layers.lrn(x)
+        u = layers.unfold(x, [2, 2])
+        t = layers.temporal_shift(x, seg_num=2)
+        ac = layers.affine_channel(x, scale=s, bias=b)
+        i2s = layers.im2sequence(x, [2, 2])
+        return l, u, t, ac, i2s
+
+    l, u, t, ac, i2s = _run(build, {"x": xv, "s": sv, "b": bv})
+    assert l.shape == xv.shape
+    assert u.shape == (2, 8 * 4, 9)
+    assert t.shape == xv.shape
+    np.testing.assert_allclose(
+        ac, xv * sv[None, :, None, None] + bv[None, :, None, None], rtol=1e-5)
+    assert i2s.shape == (2, 9, 32)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+def test_unique_with_counts_contract():
+    xv = np.asarray([3, 1, 3, 2, 1, 3, 9], np.int32)
+
+    def build():
+        x = fluid.data("x", [7], "int32")
+        out, idx, cnt = layers.unique_with_counts(x)
+        return out, idx, cnt
+
+    out, idx, cnt = _run(build, {"x": xv})
+    n_unique = (cnt > 0).sum()
+    assert n_unique == 4
+    uniq = out[:n_unique]
+    np.testing.assert_array_equal(np.sort(uniq), [1, 2, 3, 9])
+    # inverse map reconstructs x
+    np.testing.assert_array_equal(out[idx], xv)
+    # counts agree
+    for v, c in zip(uniq, cnt[:n_unique]):
+        assert c == (xv == v).sum()
+
+
+def test_hash_deterministic_in_range():
+    xv = np.arange(64, dtype=np.int64).reshape(64, 1)
+
+    def build():
+        x = fluid.data("x", [64, 1], "int64")
+        return layers.hash(x, hash_size=1000, num_hash=2)
+
+    (h1,) = _run(build, {"x": xv})
+    (h2,) = _run(build, {"x": xv})
+    np.testing.assert_array_equal(h1, h2)
+    assert h1.shape == (64, 2, 1)
+    assert h1.min() >= 0 and h1.max() < 1000
+    # spread: 64 ids into 1000 buckets should rarely all collide
+    assert len(np.unique(h1[:, 0, 0])) > 32
+
+
+def test_sampling_id_distribution():
+    probs = np.tile(np.asarray([[0.05, 0.05, 0.9]], np.float32), (512, 1))
+
+    def build():
+        x = fluid.data("x", [512, 3], "float32")
+        return layers.sampling_id(x)
+
+    (ids,) = _run(build, {"x": probs})
+    frac = (ids == 2).mean()
+    assert 0.8 < frac < 0.98, frac
+
+
+def test_selection_and_scalars():
+    rng = np.random.RandomState(8)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    ids = np.asarray([[1], [0], [1]], np.int32)
+
+    def build():
+        x1 = fluid.data("a", [3, 4], "float32")
+        x2 = fluid.data("b", [3, 4], "float32")
+        i = fluid.data("ids", [3, 1], "int32")
+        m = layers.multiplex([x1, x2], i)
+        r = layers.rank(x1)
+        s = layers.size(x1)
+        sm = layers.sum([x1, x2])
+        e = layers.is_empty(x1)
+        return m, r, s, sm, e
+
+    m, r, s, sm, e = _run(build, {"a": a, "b": b, "ids": ids})
+    np.testing.assert_array_equal(m[0], b[0])
+    np.testing.assert_array_equal(m[1], a[1])
+    assert r[0] == 2 and s[0] == 12
+    np.testing.assert_allclose(sm, a + b, rtol=1e-6)
+    assert not e[0]
+
+
+def test_scatter_nd_and_random_layers():
+    def build():
+        idx = fluid.data("idx", [3, 1], "int32")
+        upd = fluid.data("upd", [3, 4], "float32")
+        sn = layers.scatter_nd(idx, upd, [5, 4])
+        g = layers.gaussian_random([64, 64], mean=1.0, std=2.0)
+        u = layers.uniform_random([64, 64], min=0.0, max=2.0)
+        gb = layers.gaussian_random_batch_size_like(upd, [7, 3])
+        ub = layers.uniform_random_batch_size_like(upd, [7, 3])
+        return sn, g, u, gb, ub
+
+    idx = np.asarray([[0], [2], [0]], np.int32)
+    upd = np.ones((3, 4), np.float32)
+    sn, g, u, gb, ub = _run(build, {"idx": idx, "upd": upd})
+    np.testing.assert_allclose(sn[0], 2 * np.ones(4))  # two adds at row 0
+    np.testing.assert_allclose(sn[2], np.ones(4))
+    assert abs(g.mean() - 1.0) < 0.2 and abs(g.std() - 2.0) < 0.2
+    assert u.min() >= 0 and u.max() <= 2 and abs(u.mean() - 1.0) < 0.1
+    assert gb.shape == (3, 3) and ub.shape == (3, 3)
+
+
+def test_step_counter_and_position_encoding():
+    def build():
+        x = fluid.data("x", [2, 4, 8], "float32")
+        ctr = layers.autoincreased_step_counter()
+        pe = layers.add_position_encoding(x, alpha=1.0, beta=1.0)
+        return ctr, pe
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ctr, pe = (lambda: build())()
+    scope = fluid.executor.Scope()
+    xv = np.zeros((2, 4, 8), np.float32)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for want in (1, 2, 3):
+            c, p = exe.run(main, feed={"x": xv}, fetch_list=[ctr, pe])
+            assert int(np.asarray(c)[0]) == want
+    # beta * sin/cos table on zero input
+    half = 4
+    pos = np.arange(4, dtype=np.float32)[:, None]
+    inv = 1.0 / np.power(10000.0, np.arange(half, dtype=np.float32) / half)
+    np.testing.assert_allclose(np.asarray(p)[0, :, :half], np.sin(pos * inv),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fsp_and_bilinear_product():
+    rng = np.random.RandomState(9)
+    xv = rng.randn(2, 3, 4, 4).astype(np.float32)
+    yv = rng.randn(2, 5, 4, 4).astype(np.float32)
+
+    def build():
+        x = fluid.data("x", [2, 3, 4, 4], "float32")
+        y = fluid.data("y", [2, 5, 4, 4], "float32")
+        f = layers.fsp_matrix(x, y)
+        bt = layers.bilinear_tensor_product(
+            layers.reshape(x, [2, 48]), layers.reshape(y, [2, 80]), 6)
+        return f, bt
+
+    f, bt = _run(build, {"x": xv, "y": yv})
+    want = np.einsum("nchw,nkhw->nck", xv, yv) / 16.0
+    np.testing.assert_allclose(f, want, rtol=1e-4, atol=1e-5)
+    assert bt.shape == (2, 6)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+def test_loss_layers_against_numpy():
+    rng = np.random.RandomState(10)
+    pred = rng.rand(4, 3).astype(np.float32)
+    lab = np.asarray([[0], [2], [1], [2]], np.int64)
+    left = rng.rand(4, 1).astype(np.float32)
+    right = rng.rand(4, 1).astype(np.float32)
+    blab = (rng.rand(4, 1) > 0.5).astype(np.float32)
+
+    def build():
+        p = fluid.data("p", [4, 3], "float32")
+        l = fluid.data("l", [4, 1], "int64")
+        lf = fluid.data("lf", [4, 1], "float32")
+        rt = fluid.data("rt", [4, 1], "float32")
+        bl = fluid.data("bl", [4, 1], "float32")
+        mse = layers.mse_loss(p, layers.cast(layers.expand_as(bl, p), "float32"))
+        dice = layers.dice_loss(layers.softmax(p), l)
+        bpr = layers.bpr_loss(p, l)
+        rl = layers.rank_loss(bl, lf, rt)
+        ts = layers.teacher_student_sigmoid_loss(lf, bl)
+        return mse, dice, bpr, rl, ts
+
+    mse, dice, bpr, rl, ts = _run(
+        build, {"p": pred, "l": lab, "lf": left, "rt": right, "bl": blab})
+    tgt = np.broadcast_to(blab, pred.shape)
+    np.testing.assert_allclose(mse, ((pred - tgt) ** 2).mean(), rtol=1e-5)
+    assert 0 <= dice <= 1
+    # bpr oracle: per-row [N, 1] (reference bpr_loss_op.cc output shape)
+    sm = pred
+    pos = np.take_along_axis(sm, lab, axis=1)
+    d = pos - sm
+    logsig = -np.log1p(np.exp(-d))
+    mask = 1.0 - np.eye(3)[lab.reshape(-1)]
+    want_bpr = -((logsig * mask).sum(-1, keepdims=True) / 2.0)
+    assert bpr.shape == (4, 1)
+    np.testing.assert_allclose(bpr, want_bpr, rtol=1e-4)
+    o = left - right
+    np.testing.assert_allclose(rl, (np.log1p(np.exp(o)) - blab * o).mean(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        ts, np.log1p(np.exp(left)) - left * blab, rtol=1e-4)
+
+
+def test_focal_npair_center_sampled_softmax_run_and_train():
+    rng = np.random.RandomState(11)
+    feats = rng.randn(6, 8).astype(np.float32)
+    lab6 = np.asarray([[1], [0], [2], [1], [0], [2]], np.int64)
+
+    def build():
+        x = fluid.data("x", [6, 8], "float32")
+        l = fluid.data("l", [6, 1], "int64")
+        logits = layers.fc(x, 5)
+        fg = layers.fill_constant([1], "int32", 4)
+        focal = layers.reduce_sum(layers.sigmoid_focal_loss(logits, l, fg))
+        cl = layers.reduce_mean(layers.center_loss(x, l, 3, alpha=0.1))
+        npl = layers.npair_loss(x, layers.scale(x, scale=1.1),
+                                layers.reshape(l, [6]))
+        ssce = layers.reduce_mean(
+            layers.sampled_softmax_with_cross_entropy(logits, l, num_samples=3))
+        total = layers.sum([focal, cl, npl, ssce])
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(total)
+        return total
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        total = build()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = []
+        for _ in range(15):
+            (v,) = exe.run(main, feed={"x": feats, "l": lab6},
+                           fetch_list=[total])
+            vals.append(float(np.asarray(v).reshape(())))
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0], (vals[0], vals[-1])
+
+
+def test_resize_reference_coordinate_maps():
+    """Nearest + align_corners and bilinear align_mode=1 must follow the
+    reference interpolate_op.h maps, not jax.image half-pixel."""
+    xv = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+
+    def build():
+        x = fluid.data("x", [1, 1, 1, 4], "float32")
+        n_ac = layers.resize_nearest(x, [1, 6], align_corners=True)
+        n_nac = layers.resize_nearest(x, [1, 6], align_corners=False)
+        b_m1 = layers.resize_bilinear(x, [1, 6], align_corners=False,
+                                      align_mode=1)
+        return n_ac, n_nac, b_m1
+
+    n_ac, n_nac, b_m1 = _run(build, {"x": xv})
+    # reference: int(l*(in-1)/(out-1) + 0.5) = [0,1,1,2,2,3]
+    np.testing.assert_array_equal(n_ac.ravel(), [0, 1, 1, 2, 2, 3])
+    # reference: int(l*in/out) = [0,0,1,2,2,3]
+    np.testing.assert_array_equal(n_nac.ravel(), [0, 0, 1, 2, 2, 3])
+    # align_mode=1: src = l*in/out -> [0, 2/3, 4/3, 2, 8/3, 10/3], with the
+    # reference's edge clamp (hi = min(lo+1, in-1)) flattening src=10/3 to 3
+    np.testing.assert_allclose(
+        b_m1.ravel(), [0, 2 / 3, 4 / 3, 2, 8 / 3, 3.0], rtol=1e-5)
+
+
+def test_center_loss_alpha_scales_center_updates():
+    """Centers must move at rate alpha * lr while the loss value stays
+    0.5*||x-c||^2 (reference center_loss_op.cc in-kernel update)."""
+    feats = np.ones((2, 3), np.float32)
+    lab = np.zeros((2, 1), np.int64)
+
+    def run_alpha(alpha):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [2, 3], "float32")
+            l = fluid.data("l", [2, 1], "int64")
+            loss = layers.reduce_mean(
+                layers.center_loss(x, l, 2, alpha=alpha))
+            fluid.optimizer.SGDOptimizer(1.0).minimize(loss)
+            cname = [p.name for p in main.all_parameters()][0]
+        scope = fluid.executor.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            lv, cv = exe.run(main, feed={"x": feats, "l": lab},
+                             fetch_list=[loss, cname])
+        return float(np.asarray(lv).reshape(())), np.asarray(cv)
+
+    l1, c1 = run_alpha(0.1)
+    l2, c2 = run_alpha(0.2)
+    # same loss value regardless of alpha: 0.5 * ||1 - 0||^2 * 3 = 1.5
+    np.testing.assert_allclose([l1, l2], [1.5, 1.5], rtol=1e-5)
+    # center row 0 moved toward x=1 at rate alpha (grad = alpha*(c-x)*scale)
+    assert c1[0].mean() > 0 and c2[0].mean() > 0
+    np.testing.assert_allclose(c2[0], 2 * c1[0], rtol=1e-4)
+    np.testing.assert_allclose(c1[1], 0.0, atol=1e-7)  # untouched class
+
+
+def test_conv3d_transpose_output_size_derivation():
+    def build():
+        x = fluid.data("x", [1, 2, 4, 4, 4], "float32")
+        return layers.conv3d_transpose(x, 3, output_size=[8, 8, 8],
+                                       stride=1, bias_attr=False)
+
+    (out,) = _run(build, {"x": np.zeros((1, 2, 4, 4, 4), np.float32)})
+    assert out.shape == (1, 3, 8, 8, 8)  # k = 8 - 3*1 + 0 = 5

@@ -2575,6 +2575,228 @@ def _fqdq_fixed():
     )
 
 
+# ---- breadth ops (vision_ops.py / misc_ops.py) ----------------------------
+
+unary("selu", lambda x, a: np.where(
+    x > 0, x, 1.6732632423543772 * (np.exp(x) - 1.0)) * 1.0507009873554805)
+unary("brelu", lambda x, a: np.clip(x, 1.0, 3.0),
+      attrs={"t_min": 1.0, "t_max": 3.0}, inp=_pos, grad=False)
+unary("soft_relu", lambda x, a: np.log1p(np.exp(np.clip(x, -40.0, 40.0))))
+unary("stanh", lambda x, a: 1.7159 * np.tanh(0.67 * x))
+
+
+@case("multiplex")
+def _multiplex():
+    rng = R(61)
+    xs = [_mix(rng, 4, 3), _mix(rng, 4, 3), _mix(rng, 4, 3)]
+    ids = np.asarray([[2], [0], [1], [0]], np.int32)
+
+    def oracle(ins, a):
+        stacked = np.stack(ins["X"])
+        sel = ins["Ids"][0].reshape(-1)
+        return {"Out": [stacked[sel, np.arange(4)]]}
+
+    return OpTest("multiplex", {"X": xs, "Ids": ids}, oracle, grad=("X",))
+
+
+@case("mean_iou")
+def _mean_iou():
+    pred = np.asarray([0, 1, 1, 2, 2, 2], np.int32)
+    lab = np.asarray([0, 1, 2, 2, 2, 1], np.int32)
+
+    def oracle(ins, a):
+        nc = 3
+        inter = np.zeros(nc)
+        union = np.zeros(nc)
+        for c in range(nc):
+            p, l = pred == c, lab == c
+            inter[c] = (p & l).sum()
+            union[c] = (p | l).sum()
+        iou = np.where(union > 0, inter / np.maximum(union, 1), 0)
+        return {"OutMeanIou": [np.float32(iou[union > 0].mean())]}
+
+    return OpTest(
+        "mean_iou", {"Predictions": pred, "Labels": lab}, oracle,
+        attrs={"num_classes": 3},
+        outputs={"OutMeanIou": 1, "OutWrong": 1, "OutCorrect": 1},
+    )
+
+
+@case("pixel_shuffle")
+def _pixel_shuffle():
+    rng = R(62)
+    x = _mix(rng, 2, 8, 3, 3)
+
+    def oracle(ins, a):
+        n, c, h, w = ins["X"][0].shape
+        r, oc = 2, c // 4
+        t = ins["X"][0].reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3)
+        return {"Out": [t.reshape(n, oc, h * r, w * r)]}
+
+    return OpTest("pixel_shuffle", {"X": x}, oracle,
+                  attrs={"upscale_factor": 2}, grad=("X",))
+
+
+@case("space_to_depth")
+def _space_to_depth():
+    rng = R(63)
+    x = _mix(rng, 2, 3, 4, 4)
+
+    def oracle(ins, a):
+        n, c, h, w = ins["X"][0].shape
+        bs = 2
+        t = ins["X"][0].reshape(n, c, h // bs, bs, w // bs, bs)
+        t = t.transpose(0, 3, 5, 1, 2, 4)
+        return {"Out": [t.reshape(n, c * bs * bs, h // bs, w // bs)]}
+
+    return OpTest("space_to_depth", {"X": x}, oracle,
+                  attrs={"blocksize": 2}, grad=("X",))
+
+
+@case("shuffle_channel")
+def _shuffle_channel():
+    rng = R(64)
+    x = _mix(rng, 2, 6, 2, 2)
+
+    def oracle(ins, a):
+        n, c, h, w = ins["X"][0].shape
+        g = 3
+        return {"Out": [ins["X"][0].reshape(n, g, c // g, h, w)
+                        .swapaxes(1, 2).reshape(n, c, h, w)]}
+
+    return OpTest("shuffle_channel", {"X": x}, oracle,
+                  attrs={"group": 3}, grad=("X",))
+
+
+@case("temporal_shift")
+def _temporal_shift():
+    rng = R(65)
+    x = _mix(rng, 4, 8, 2, 2)  # N*T with T=2
+
+    def oracle(ins, a):
+        t = 2
+        nt, c, h, w = ins["X"][0].shape
+        x5 = ins["X"][0].reshape(nt // t, t, c, h, w)
+        c1, c2 = c // 4, c // 2
+        out = np.zeros_like(x5)
+        out[:, :-1, :c1] = x5[:, 1:, :c1]
+        out[:, 1:, c1:c2] = x5[:, :-1, c1:c2]
+        out[:, :, c2:] = x5[:, :, c2:]
+        return {"Out": [out.reshape(nt, c, h, w)]}
+
+    return OpTest("temporal_shift", {"X": x}, oracle,
+                  attrs={"seg_num": 2, "shift_ratio": 0.25}, grad=("X",))
+
+
+@case("row_conv")
+def _row_conv():
+    rng = R(66)
+    x = _mix(rng, 2, 5, 3)
+    f = _mix(rng, 3, 3)
+
+    def oracle(ins, a):
+        xx, ff = ins["X"][0], ins["Filter"][0]
+        pad = np.pad(xx, [(0, 0), (0, ff.shape[0] - 1), (0, 0)])
+        out = np.zeros_like(xx)
+        for k in range(ff.shape[0]):
+            out += pad[:, k : k + xx.shape[1]] * ff[k][None, None, :]
+        return {"Out": [out]}
+
+    return OpTest("row_conv", {"X": x, "Filter": f}, oracle,
+                  grad=("X", "Filter"))
+
+
+@case("bilinear_tensor_product")
+def _bilinear_tensor_product():
+    rng = R(67)
+    x, y = _mix(rng, 3, 4), _mix(rng, 3, 5)
+    w = _mix(rng, 2, 4, 5)
+    b = _mix(rng, 1, 2)
+
+    def oracle(ins, a):
+        out = np.einsum("bi,kij,bj->bk", ins["X"][0], ins["Weight"][0],
+                        ins["Y"][0]) + ins["Bias"][0]
+        return {"Out": [out.astype(np.float32)]}
+
+    return OpTest(
+        "bilinear_tensor_product",
+        {"X": x, "Y": y, "Weight": w, "Bias": b}, oracle,
+        grad=("X", "Y", "Weight"),
+    )
+
+
+@case("lrn")
+def _lrn():
+    rng = R(68)
+    x = _mix(rng, 2, 6, 3, 3)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        n, k, alpha, beta = 5, 1.0, 1e-4, 0.75
+        sq = xx * xx
+        half = n // 2
+        padded = np.pad(sq, [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)])
+        win = sum(padded[:, i : i + xx.shape[1]] for i in range(n))
+        return {"Out": [(xx / (k + alpha * win) ** beta).astype(np.float32)]}
+
+    return OpTest("lrn", {"X": x}, oracle,
+                  outputs={"Out": 1, "MidOut": 1}, grad=("X",))
+
+
+@case("pool3d")
+def _pool3d():
+    rng = R(69)
+    x = _mix(rng, 1, 2, 4, 4, 4)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        n, c, d, h, w = xx.shape
+        out = xx.reshape(n, c, d // 2, 2, h // 2, 2, w // 2, 2).max(
+            axis=(3, 5, 7))
+        return {"Out": [out]}
+
+    return OpTest("pool3d", {"X": x}, oracle,
+                  attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+                         "strides": [2, 2, 2]}, grad=("X",))
+
+
+@case("unfold")
+def _unfold():
+    rng = R(70)
+    x = _mix(rng, 1, 2, 4, 4)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        n, c, h, w = xx.shape
+        cols = []
+        for i in range(h - 1):
+            for j in range(w - 1):
+                cols.append(xx[:, :, i : i + 2, j : j + 2].reshape(n, -1))
+        return {"Y": [np.stack(cols, axis=-1)]}
+
+    return OpTest("unfold", {"X": x}, oracle,
+                  attrs={"kernel_sizes": [2, 2]},
+                  outputs={"Y": 1}, grad=("X",))
+
+
+@case("im2sequence")
+def _im2sequence():
+    rng = R(71)
+    x = _mix(rng, 1, 2, 3, 3)
+
+    def oracle(ins, a):
+        xx = ins["X"][0]
+        n, c, h, w = xx.shape
+        rows = []
+        for i in range(h - 1):
+            for j in range(w - 1):
+                rows.append(xx[:, :, i : i + 2, j : j + 2].reshape(n, -1))
+        return {"Out": [np.stack(rows, axis=1)]}
+
+    return OpTest("im2sequence", {"X": x}, oracle,
+                  attrs={"kernels": [2, 2]}, grad=("X",))
+
+
 # ---------------------------------------------------------------------------
 # exemptions: ops whose contract is verified elsewhere or is stochastic
 # ---------------------------------------------------------------------------
@@ -2618,6 +2840,22 @@ EXEMPT = {
     # host parameter-server bridge: needs the global table registry and
     # host-side optimizer state; covered end to end in test_ps_embedding.py
     "distributed_lookup_table": "test_ps_embedding.py",
+    # vision/misc breadth ops: numpy-oracle + semantics tests through the
+    # executor live in tests/test_layers_breadth.py
+    "conv3d_transpose": "test_layers_breadth.py (adjoint + identity oracle)",
+    "bilinear_interp": "test_layers_breadth.py (corner/align oracle)",
+    "nearest_interp": "test_layers_breadth.py (integer-upscale oracle)",
+    "trilinear_interp": "test_layers_breadth.py",
+    "linear_interp": "test_layers_breadth.py",
+    "affine_grid": "test_layers_breadth.py (identity-theta oracle)",
+    "grid_sampler": "test_layers_breadth.py (identity-grid oracle)",
+    "roi_pool": "test_layers_breadth.py (hand-computed ROI oracle)",
+    "spectral_norm": "test_layers_breadth.py (sigma_max vs numpy svd)",
+    "data_norm": "test_layers_breadth.py (accumulator-stat oracle)",
+    "unique": "test_layers_breadth.py (static-shape padding contract)",
+    "unique_with_counts": "test_layers_breadth.py",
+    "hash": "test_layers_breadth.py (determinism/range/spread)",
+    "sampling_id": "test_layers_breadth.py (distribution check)",
     # stochastic draws: distribution checked in test_random_ops below
     "uniform_random": "test_random_ops",
     "gaussian_random": "test_random_ops",
