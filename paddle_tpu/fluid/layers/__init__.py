@@ -1,11 +1,31 @@
 """fluid.layers namespace. Parity: python/paddle/fluid/layers/__init__.py."""
-from . import control_flow, detection, loss, misc, nn, ops, sequence, tensor, vision  # noqa: F401
+from . import control_flow, detection, distributions, loss, misc, nn, ops, rnn, sequence, tensor, vision  # noqa: F401
 from .detection import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .distributions import *  # noqa: F401,F403
 from .misc import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
-from .control_flow import StaticRNN, case, cond, py_func, switch_case, while_loop  # noqa: F401
+from .control_flow import (  # noqa: F401
+    Assert,
+    DynamicRNN,
+    IfElse,
+    Print,
+    StaticRNN,
+    Switch,
+    While,
+    array_length,
+    array_read,
+    array_write,
+    case,
+    cond,
+    create_array,
+    py_func,
+    switch_case,
+    tensor_array_to_tensor,
+    while_loop,
+)

@@ -397,3 +397,346 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
         infer=False,
     )
     return out
+
+
+class While:
+    """Block-style while loop (reference layers/control_flow.py While over
+    while_op.cc). Usage:
+
+        i = layers.fill_constant([1], "int64", 0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            ... ops that assign new values to outer vars ...
+            layers.assign(layers.less_than(i, limit), cond)
+
+    TPU-native lowering: the reference mutates outer-scope vars in a
+    per-iteration Scope; here every outer var WRITTEN inside the block
+    (including `cond`) becomes a lax.while_loop carry — the same
+    SSA-ification the functional layers.while_loop uses, reusing its op.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        # is_test kept for reference-API parity; the lowering is identical
+        self._cond = cond
+        self._prog = None
+        self._body = None
+
+    @_contextlib.contextmanager
+    def block(self):
+        self._prog = framework.default_main_program()
+        parent = self._prog.current_block()
+        self._body = self._prog._create_block()
+        try:
+            yield
+        finally:
+            self._prog._rollback()
+        body = self._body
+
+        # loop carries: outer vars written inside the body (cond included)
+        written, seen = [], set()
+        for op in body.ops:
+            for names in op.outputs.values():
+                for n in names:
+                    if n in seen:
+                        continue
+                    seen.add(n)
+                    if n in body.vars:
+                        continue  # block-local temp
+                    if parent._find_var_recursive(n) is not None:
+                        written.append(n)
+        if self._cond.name not in written:
+            raise ValueError(
+                "While: the loop must update its cond var inside the block "
+                "(layers.assign(new_cond, cond)), or it would never exit"
+            )
+        loop_vars = [parent._find_var_recursive(n) for n in written]
+        cond_block = self._prog._create_block()
+        self._prog._rollback()
+        captured = [
+            n for n in _captured_inputs([body]) if n not in set(written)
+        ]
+        inputs = {"LoopVars": loop_vars}
+        if captured:
+            inputs["Input"] = captured
+        parent.append_op(
+            type="while_loop",
+            inputs=inputs,
+            outputs={"Out": loop_vars},  # rebind the same outer vars
+            attrs={
+                "cond_block": cond_block,  # empty: cond is itself a carry
+                "body_block": body,
+                "loop_var_names": written,
+                "cond_out_name": self._cond.name,
+                "body_out_names": written,
+                "captured_names": captured,
+            },
+            infer=False,
+        )
+
+
+class IfElse:
+    """Per-row conditional (reference layers/control_flow.py IfElse):
+    cond is a [N, 1] bool mask; true/false bodies transform the rows.
+
+    TPU-native semantics: instead of physically splitting rows into two
+    scopes (reference conditional_block pairs), BOTH branches compute on
+    the full batch and rows are merged with where(cond) — dense compute,
+    no dynamic shapes, identical results for the row-wise functions the
+    API contracts."""
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._true_out = []
+        self._false_out = []
+        self._in_true = None
+
+    @_contextlib.contextmanager
+    def true_block(self):
+        self._in_true = True
+        try:
+            yield
+        finally:
+            self._in_true = None
+
+    @_contextlib.contextmanager
+    def false_block(self):
+        self._in_true = False
+        try:
+            yield
+        finally:
+            self._in_true = None
+
+    def input(self, x):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.input() must be called inside a block")
+        return x  # both branches see the full rows (dense lowering)
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.output() must be called inside a block")
+        (self._true_out if self._in_true else self._false_out).extend(outs)
+
+    def __call__(self):
+        from . import nn as _nn
+        from . import tensor as _tensor
+
+        if len(self._true_out) != len(self._false_out):
+            raise ValueError(
+                f"IfElse: true block produced {len(self._true_out)} outputs, "
+                f"false block {len(self._false_out)} — they must match"
+            )
+        merged = []
+        for t, f in zip(self._true_out, self._false_out):
+            mask = _tensor.cast(self._cond, t.dtype)
+            shape = [1] * len(t.shape)
+            shape[0] = t.shape[0]
+            mask = _nn.reshape(mask, shape)
+            merged.append(
+                _nn.elementwise_add(
+                    _nn.elementwise_mul(t, mask),
+                    _nn.elementwise_mul(
+                        f, _nn.scale(mask, scale=-1.0, bias=1.0)),
+                )
+            )
+        return merged
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays: build-time Python lists (static graph, static indices)
+# ---------------------------------------------------------------------------
+
+
+class TensorArray(list):
+    """Build-time array of Variables (reference LoDTensorArray). On TPU
+    every shape/index is static, so the array is a Python list resolved
+    at graph build; use layers.while_loop carries for loop-dependent
+    state instead of dynamic array writes."""
+
+
+def create_array(dtype):
+    return TensorArray()
+
+
+def _static_index(i):
+    import numpy as np
+
+    if isinstance(i, (int, np.integer)):
+        return int(i)
+    # a var is a usable build-time constant only when its SOLE writer in
+    # the program is one fill_constant op — a counter that is later
+    # incremented/assigned must be rejected, not folded to its init value
+    if isinstance(i, framework.Variable):
+        writers = [
+            op
+            for block in i.block.program.blocks
+            for op in block.ops
+            if any(i.name in names for names in op.outputs.values())
+        ]
+        if len(writers) == 1 and writers[0].type == "fill_constant":
+            return int(writers[0].attr("value"))
+    raise NotImplementedError(
+        "array index must be a Python int or an unmodified fill_constant "
+        "var (static graph indices are build-time on TPU); inside loops "
+        "carry state through layers.while_loop instead"
+    )
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array(x.dtype)
+    idx = _static_index(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    v = array[_static_index(i)]
+    if v is None:
+        raise ValueError("array_read of an unwritten slot")
+    return v
+
+
+def array_length(array):
+    from . import tensor as _tensor
+
+    return _tensor.fill_constant([1], "int64", len(array))
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    if not input:
+        raise ValueError("tensor_array_to_tensor: empty array")
+    vals = [v for v in input if v is not None]
+    if use_stack:
+        out = _nn.stack(vals, axis=axis)
+    else:
+        out = _tensor.concat(vals, axis=axis)
+    sizes = _tensor.assign(
+        __import__("numpy").asarray(
+            [v.shape[axis] if not use_stack else 1 for v in vals], "int32")
+    )
+    return out, sizes
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Runtime tensor printing (reference print_op.cc) via jax.debug.print
+    inside the compiled step."""
+    helper = LayerHelper("print", name=None)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"message": message or "", "first_n": first_n,
+               "summarize": summarize, "var_name": input.name},
+    )
+    return out
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """Runtime assertion (reference assert_op.cc): aborts the step when
+    cond is False, printing `data` tensors."""
+    helper = LayerHelper("assert", name=name)
+    inputs = {"Cond": [cond]}
+    if data:
+        inputs["Data"] = list(data)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="assert", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"summarize": summarize})
+    return out
+
+
+class DynamicRNN:
+    """Variable-length RNN over the padded+mask representation (reference
+    layers/control_flow.py DynamicRNN over LoD): same step API as
+    StaticRNN plus automatic length masking — memories freeze once a
+    row's sequence ends, reproducing the reference's shrink-by-LoD
+    behavior without ragged tensors.
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, length=lens)   # x: [B, T, D]
+            h = drnn.memory(shape=[H], batch_ref=x)
+            nh = layers.fc(layers.concat([x_t, h], 1), H, act="tanh")
+            drnn.update_memory(h, nh)               # masked update
+            drnn.output(nh)
+        out = drnn()                                # [B, T, H]
+    """
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name=name)
+        self._mask_step = None  # [B, 1] validity for the current step
+        self._length = None
+
+    @_contextlib.contextmanager
+    def block(self):
+        with self._rnn.step():
+            yield
+
+    def step_input(self, x, length=None):
+        v = self._rnn.step_input(x)
+        if length is not None and self._mask_step is None:
+            from . import sequence as _seq
+            from . import tensor as _tensor
+
+            self._length = length
+            # [B, T, 1] mask built in the parent block, scanned per step
+            prog = self._rnn._prog
+            prog._rollback()
+            try:
+                mask = _seq.sequence_mask(length, maxlen=x.shape[1],
+                                          dtype="float32")
+                from . import nn as _nn
+
+                mask3 = _nn.reshape(mask, [x.shape[0], x.shape[1], 1])
+            finally:
+                prog.current_block_idx = self._rnn._block.idx
+            self._mask_step = self._rnn.step_input(mask3)
+        return v
+
+    def static_input(self, x):
+        return x  # captured automatically by the step block
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               dtype="float32", need_reorder=False):
+        return self._rnn.memory(init=init, shape=shape, batch_ref=batch_ref,
+                                init_value=init_value, dtype=dtype)
+
+    def update_memory(self, mem, new):
+        if self._mask_step is not None:
+            from . import nn as _nn
+
+            m = self._mask_step
+            if len(new.shape) > len(m.shape):
+                m = _nn.reshape(
+                    m, list(m.shape) + [1] * (len(new.shape) - len(m.shape)))
+            new = _nn.elementwise_add(
+                _nn.elementwise_mul(new, m),
+                _nn.elementwise_mul(mem, _nn.scale(m, scale=-1.0, bias=1.0)),
+            )
+        self._rnn.update_memory(mem, new)
+
+    def output(self, *outputs):
+        # past-length steps emit zeros — the repo's padded+mask convention
+        # (padding lives at the tail and is masked out; sequence_ops.py)
+        if self._mask_step is not None:
+            from . import nn as _nn
+
+            masked = []
+            for o in outputs:
+                m = self._mask_step
+                if len(o.shape) > len(m.shape):
+                    m = _nn.reshape(
+                        m, list(m.shape) + [1] * (len(o.shape) - len(m.shape)))
+                masked.append(_nn.elementwise_mul(o, m))
+            outputs = masked
+        self._rnn.output(*outputs)
+
+    def __call__(self):
+        return self._rnn()

@@ -1,0 +1,180 @@
+"""Probability distributions (reference layers/distributions.py):
+Normal, Uniform, Categorical, MultivariateNormalDiag — sample /
+log_prob / entropy / kl_divergence as op compositions.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import misc as _misc
+from . import nn as _nn
+from . import ops as _ops
+from . import tensor as _tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _to_var(v, like=None):
+    from ..framework import Variable
+
+    if isinstance(v, Variable):
+        return v
+    arr = np.asarray(v, np.float32)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    return _tensor.assign(arr)
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distributions.Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = _misc.uniform_random(list(shape) + list(self.low.shape),
+                                 min=0.0, max=1.0, seed=seed)
+        span = _nn.elementwise_sub(self.high, self.low)
+        return _nn.elementwise_add(_nn.elementwise_mul(u, span), self.low)
+
+    def log_prob(self, value):
+        span = _nn.elementwise_sub(self.high, self.low)
+        lb = _tensor.cast(_tensor.less_than(self.low, value), "float32")
+        ub = _tensor.cast(_tensor.less_than(value, self.high), "float32")
+        inside = _nn.elementwise_mul(lb, ub)
+        return _ops.log(
+            _nn.elementwise_div(
+                _nn.elementwise_add(
+                    inside,
+                    _tensor.fill_constant([1], "float32", 1e-30)),
+                span))
+
+    def entropy(self):
+        return _ops.log(_nn.elementwise_sub(self.high, self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distributions.Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = _misc.gaussian_random(list(shape) + list(self.loc.shape),
+                                  mean=0.0, std=1.0, seed=seed)
+        return _nn.elementwise_add(
+            _nn.elementwise_mul(z, self.scale), self.loc)
+
+    def log_prob(self, value):
+        var = _ops.square(self.scale)
+        d = _nn.elementwise_sub(value, self.loc)
+        return _nn.elementwise_sub(
+            _nn.elementwise_sub(
+                _nn.scale(_nn.elementwise_div(_ops.square(d), var), -0.5),
+                _ops.log(self.scale)),
+            _tensor.fill_constant([1], "float32",
+                                  0.5 * math.log(2.0 * math.pi)))
+
+    def entropy(self):
+        return _nn.elementwise_add(
+            _ops.log(self.scale),
+            _tensor.fill_constant([1], "float32",
+                                  0.5 + 0.5 * math.log(2.0 * math.pi)))
+
+    def kl_divergence(self, other):
+        var_ratio = _ops.square(
+            _nn.elementwise_div(self.scale, other.scale))
+        t1 = _ops.square(
+            _nn.elementwise_div(
+                _nn.elementwise_sub(self.loc, other.loc), other.scale))
+        return _nn.scale(
+            _nn.elementwise_sub(
+                _nn.elementwise_add(var_ratio, t1),
+                _nn.scale(_ops.log(var_ratio), bias=1.0)),
+            0.5)
+
+
+class Categorical(Distribution):
+    """Categorical over logits (reference distributions.Categorical)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _probs(self):
+        return _nn.softmax(self.logits)
+
+    def sample(self, shape=None, seed=0):
+        from .misc import sampling_id
+
+        return sampling_id(self._probs(), seed=seed)
+
+    def log_prob(self, value):
+        logp = _nn.log_softmax(self.logits)
+        depth = self.logits.shape[-1]
+        oh = _nn.one_hot(value, depth)
+        return _nn.reduce_sum(_nn.elementwise_mul(logp, oh), dim=[-1])
+
+    def entropy(self):
+        p = self._probs()
+        logp = _nn.log_softmax(self.logits)
+        return _nn.scale(
+            _nn.reduce_sum(_nn.elementwise_mul(p, logp), dim=[-1]), -1.0)
+
+    def kl_divergence(self, other):
+        p = self._probs()
+        diff = _nn.elementwise_sub(
+            _nn.log_softmax(self.logits), _nn.log_softmax(other.logits))
+        return _nn.reduce_sum(_nn.elementwise_mul(p, diff), dim=[-1])
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) (reference distributions.MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc          # [.., D]
+        self.scale = scale      # [.., D] diag entries
+
+    def sample(self, shape=None, seed=0):
+        z = _misc.gaussian_random(list(self.loc.shape), 0.0, 1.0, seed=seed)
+        return _nn.elementwise_add(
+            _nn.elementwise_mul(z, self.scale), self.loc)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = _nn.reduce_sum(_ops.log(self.scale), dim=[-1])
+        return _nn.scale(
+            logdet, bias=0.5 * d * (1.0 + math.log(2.0 * math.pi)))
+
+    def kl_divergence(self, other):
+        var1 = _ops.square(self.scale)
+        var2 = _ops.square(other.scale)
+        d = _nn.elementwise_sub(self.loc, other.loc)
+        tr = _nn.reduce_sum(_nn.elementwise_div(var1, var2), dim=[-1])
+        quad = _nn.reduce_sum(
+            _nn.elementwise_div(_ops.square(d), var2), dim=[-1])
+        logdet = _nn.reduce_sum(
+            _nn.elementwise_sub(_ops.log(var2), _ops.log(var1)), dim=[-1])
+        k = self.loc.shape[-1]
+        return _nn.scale(
+            _nn.elementwise_add(_nn.elementwise_add(tr, quad),
+                                _nn.scale(logdet, 1.0, bias=-float(k))),
+            0.5)

@@ -274,3 +274,359 @@ def get_tensor_from_selected_rows(x, name=None):
 def merge_selected_rows(x, name=None):
     """See get_tensor_from_selected_rows: identity on the dense analog."""
     return _tensor.assign(x)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming ROC-AUC layer (reference layers/metric_op.py auc over
+    metrics/auc_op.cc): persistable stat buffers accumulate across runs.
+    Returns (auc_value, [batch stat update outs])."""
+    from ..optimizer import _create_persistable_var
+
+    nt = int(num_thresholds)
+    stat_pos = _create_persistable_var(
+        f"auc_stat_pos_{unique_suffix()}", (nt + 1,), "float32", 0.0)
+    stat_neg = _create_persistable_var(
+        f"auc_stat_neg_{unique_suffix()}", (nt + 1,), "float32", 0.0)
+    helper = LayerHelper("auc")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": [input], "Label": [label],
+                "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        outputs={"AUC": [out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"num_thresholds": nt, "curve": curve},
+    )
+    return out, [stat_pos, stat_neg]
+
+
+_suffix_counter = [0]
+
+
+def unique_suffix():
+    _suffix_counter[0] += 1
+    return _suffix_counter[0]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunking precision/recall/F1 (reference chunk_eval_op.cc, IOB/IOE/
+    IOBES/plain schemes). Host-side metric: the chunk extraction runs as a
+    py_func callback (eval-only op; no gradient), the TPU analog of the
+    reference's CPU-only kernel."""
+    import numpy as np
+
+    from .control_flow import py_func
+
+    scheme = chunk_scheme.lower()
+    tag_counts = {"iob": 2, "ioe": 2, "iobes": 4, "plain": 1}
+    if scheme not in tag_counts:
+        raise ValueError(f"chunk_eval: unknown scheme {chunk_scheme}")
+    n_tags = tag_counts[scheme]
+    excluded = set(excluded_chunk_types or [])
+
+    def _extract(seq, lens):
+        chunks = set()
+        for b in range(seq.shape[0]):
+            ln = int(lens[b]) if lens is not None else seq.shape[1]
+            start = None
+            ctype = None
+            for t in range(ln):
+                tag = int(seq[b, t])
+                # tags in [0, n_tags*num_chunk_types) encode (type, kind);
+                # anything else (the O / outside tag included) is outside
+                if tag < 0 or tag >= n_tags * num_chunk_types:
+                    inside = False
+                    tag_kind, tag_type = None, None
+                else:
+                    tag_kind = tag % n_tags if scheme != "plain" else 0
+                    tag_type = tag // n_tags if scheme != "plain" else tag
+                    inside = True
+                # simple IOB-style chunk detection (B=0, I=1 within type)
+                if scheme == "plain":
+                    if inside and tag_type not in excluded:
+                        chunks.add((b, t, t, tag_type))
+                    continue
+                is_begin = inside and tag_kind == 0
+                is_inside = inside and tag_kind != 0
+                if is_begin:
+                    if start is not None:
+                        chunks.add((b, start, t - 1, ctype))
+                    start, ctype = t, tag_type
+                elif not is_inside and start is not None:
+                    chunks.add((b, start, t - 1, ctype))
+                    start, ctype = None, None
+                elif is_inside and (start is None or tag_type != ctype):
+                    start, ctype = t, tag_type
+            if start is not None:
+                chunks.add((b, start, ln - 1, ctype))
+        return {c for c in chunks if c[3] not in excluded}
+
+    def _chunk_stats(inf, lab, lens=None):
+        inf_chunks = _extract(inf, lens)
+        lab_chunks = _extract(lab, lens)
+        correct = len(inf_chunks & lab_chunks)
+        p = correct / len(inf_chunks) if inf_chunks else 0.0
+        r = correct / len(lab_chunks) if lab_chunks else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        # int32: x64 is disabled in JAX, so 64-bit callback results are
+        # rejected; counts are far below 2^31
+        return (np.float32([p]), np.float32([r]), np.float32([f1]),
+                np.int32([len(inf_chunks)]), np.int32([len(lab_chunks)]),
+                np.int32([correct]))
+
+    helper = LayerHelper("chunk_eval")
+    outs = [helper.create_variable_for_type_inference(dt)
+            for dt in ("float32", "float32", "float32",
+                       "int32", "int32", "int32")]
+    for v, shape in zip(outs, [(1,)] * 6):
+        v.shape = shape
+    xs = [input, label] + ([seq_length] if seq_length is not None else [])
+    py_func(
+        (lambda i, l, s=None: _chunk_stats(i, l, s)), x=xs, out=outs)
+    return tuple(outs)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference layers/nn.py nce over
+    nce_op.cc). Per-row cost [N, 1]: -log sigmoid(s_pos)
+    - sum_k log sigmoid(-s_negk); negatives drawn per run via the
+    uniform_random op (runtime sampling like the reference's sampler)."""
+    if sampler != "uniform" or custom_dist is not None:
+        raise NotImplementedError("nce: only the uniform sampler")
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    num_neg = int(num_neg_samples or 10)
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[num_total_classes],
+                                dtype=input.dtype, is_bias=True)
+    lbl = _nn.reshape(label, [input.shape[0]])
+    w_pos = _nn.gather(w, lbl)                      # [N, D]
+    b_pos = _nn.reshape(_nn.gather(_nn.reshape(b, [num_total_classes, 1]),
+                                   lbl), [input.shape[0], 1])
+    s_pos = _nn.elementwise_add(
+        _nn.reduce_sum(_nn.elementwise_mul(input, w_pos), dim=[-1],
+                       keep_dim=True), b_pos)
+    # negatives: one shared sample set per step (reference uniform sampler)
+    neg_f = uniform_random([num_neg], min=0.0, max=float(num_total_classes),
+                           seed=seed)
+    neg_ids = _tensor.cast(_nn.elementwise_min(
+        neg_f, _tensor.fill_constant([num_neg], "float32",
+                                     num_total_classes - 1 + 0.5)), "int64")
+    w_neg = _nn.gather(w, neg_ids)                  # [K, D]
+    b_neg = _nn.reshape(_nn.gather(_nn.reshape(b, [num_total_classes, 1]),
+                                   neg_ids), [1, num_neg])
+    s_neg = _nn.elementwise_add(
+        _nn.matmul(input, w_neg, transpose_y=True), b_neg)  # [N, K]
+    from . import ops as _ops
+
+    cost = _nn.elementwise_add(
+        _ops.softplus(_nn.scale(s_pos, -1.0)),       # -log sigmoid(s_pos)
+        _nn.reduce_sum(_ops.softplus(s_neg), dim=[-1], keep_dim=True),
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid (reference layers/nn.py hsigmoid over
+    hierarchical_sigmoid_op.cc): a complete binary tree over classes
+    (default) or custom per-class paths. Cost [N, 1]."""
+    import numpy as np
+
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError("hsigmoid: default complete tree only")
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    n_inner = max(num_classes - 1, 1)
+    w = helper.create_parameter(helper.param_attr, shape=[n_inner, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[n_inner],
+                                dtype=input.dtype, is_bias=True)
+    # static complete-binary-tree paths: internal node ids 0..C-2; leaf c
+    # corresponds to heap index C-1+c; path walks to the root
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    table = np.zeros((num_classes, depth), np.int64)
+    code = np.zeros((num_classes, depth), np.float32)
+    valid = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = n_inner + c  # heap leaf
+        d = 0
+        while node > 0 and d < depth:
+            parent = (node - 1) // 2
+            table[c, d] = parent
+            code[c, d] = 1.0 if node == 2 * parent + 2 else 0.0  # right=1
+            valid[c, d] = 1.0
+            node = parent
+            d += 1
+    lbl = _nn.reshape(label, [input.shape[0]])
+    t_var = _tensor.assign(table)
+    c_var = _tensor.assign(code)
+    v_var = _tensor.assign(valid)
+    rows_t = _nn.gather(t_var, lbl)      # [N, depth] inner-node ids
+    rows_c = _nn.gather(c_var, lbl)      # [N, depth] 0/1 codes
+    rows_v = _nn.gather(v_var, lbl)      # [N, depth] path mask
+    w_path = _nn.gather(w, _nn.reshape(rows_t, [-1]))  # [N*depth, D]
+    w_path = _nn.reshape(w_path, [input.shape[0], depth, dim])
+    b_path = _nn.reshape(
+        _nn.gather(_nn.reshape(b, [n_inner, 1]), _nn.reshape(rows_t, [-1])),
+        [input.shape[0], depth])
+    logits = _nn.elementwise_add(
+        _nn.reduce_sum(
+            _nn.elementwise_mul(w_path, _nn.unsqueeze(input, [1])), dim=[-1]),
+        b_path)  # [N, depth]
+    from . import ops as _ops
+
+    # BCE per node: -log sigmoid(z) if code 1 (right) else -log sigmoid(-z)
+    per_node = _nn.elementwise_add(
+        _nn.elementwise_mul(rows_c, _ops.softplus(_nn.scale(logits, -1.0))),
+        _nn.elementwise_mul(
+            _nn.scale(rows_c, -1.0, bias=1.0), _ops.softplus(logits)),
+    )
+    cost = _nn.reduce_sum(_nn.elementwise_mul(per_node, rows_v),
+                          dim=[-1], keep_dim=True)
+    return cost
+
+
+def inplace_abn(input, act=None, **bn_kwargs):
+    """Activated batch norm (reference inplace_abn_op.cc): batch_norm +
+    activation; "in-place" memory aliasing is XLA's job here."""
+    out = _nn.batch_norm(input, **bn_kwargs)
+    if act:
+        helper = LayerHelper("inplace_abn", act=act)
+        out = helper.append_activation(out)
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus mask (reference similarity_focus_op.cc): for each
+    selected channel index, mark each (row, col) whose value is that
+    row/col's maximum across the channel slice."""
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 (NCHW) only")
+    from . import tensor as _t
+
+    n, c, h, wd = input.shape
+    masks = []
+    for idx in indexes:
+        ch = _nn.reshape(
+            _nn.slice(input, axes=[1], starts=[idx], ends=[idx + 1]),
+            [n, h, wd])
+        row_max = _nn.reduce_max(ch, dim=[2], keep_dim=True)
+        col_max = _nn.reduce_max(ch, dim=[1], keep_dim=True)
+        m = _nn.elementwise_max(
+            _t.cast(_t.equal(ch, _nn.expand_as(row_max, ch)), input.dtype),
+            _t.cast(_t.equal(ch, _nn.expand_as(col_max, ch)), input.dtype),
+        )
+        masks.append(m)
+    mask = masks[0]
+    for m in masks[1:]:
+        mask = _nn.elementwise_max(mask, m)
+    return _nn.expand_as(_nn.unsqueeze(mask, [1]), input)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CTR continuous-value feature handling (reference cvm_op.cc):
+    use_cvm keeps the 2 leading show/click columns (log-transformed by
+    the feed), otherwise drops them."""
+    d = input.shape[-1]
+    if use_cvm:
+        return input
+    return _nn.slice(input, axes=[len(input.shape) - 1], starts=[2], ends=[d])
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
+    """Reference filter_by_instag_op.cc filters rows by tag membership —
+    a data-dependent output size, which XLA cannot express; mask rows to
+    zero instead (dense analog) and return the mask as "LoD"."""
+    from . import tensor as _t
+
+    raise NotImplementedError(
+        "filter_by_instag: data-dependent row filtering is not expressible "
+        "with static shapes; apply a 0/1 mask to rows instead"
+    )
+
+
+class _PyReaderHandle:
+    """In-program reader shim (reference layers/io.py py_reader): holds
+    the created data Variables and a GeneratorLoader; `read_file` yields
+    the Variables, iteration yields feed dicts for Executor.run."""
+
+    def __init__(self, vars_, loader):
+        self.vars = vars_
+        self.loader = loader
+
+    def decorate_paddle_reader(self, reader, places=None):
+        self.loader.set_sample_list_generator(reader, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self.loader.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        self.loader.set_batch_generator(reader, places)
+
+    decorate_tensor_provider = decorate_batch_generator
+
+    def __iter__(self):
+        return iter(self.loader)
+
+    def start(self):  # legacy non-iterable protocol: no-op (iterable only)
+        return None
+
+    def reset(self):
+        return None
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Reference layers/io.py py_reader: creates the feed Variables and a
+    prefetching loader; the read ops of the reference are unnecessary —
+    Executor.run feeds explicitly (whole-block XLA design)."""
+    from ..reader import GeneratorLoader
+
+    vars_ = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        vars_.append(_tensor.data(f"{name or 'py_reader'}_{i}", list(shape),
+                                  dtype=dtype, append_batch_size=False))
+    loader = GeneratorLoader(feed_list=vars_, capacity=capacity)
+    return _PyReaderHandle(vars_, loader)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..reader import GeneratorLoader
+
+    return _PyReaderHandle(
+        list(feed_list), GeneratorLoader(feed_list=feed_list, capacity=capacity))
+
+
+def read_file(reader):
+    """Unpack a py_reader handle into its data Variables."""
+    if isinstance(reader, _PyReaderHandle):
+        return reader.vars if len(reader.vars) > 1 else reader.vars[0]
+    raise TypeError("read_file expects the handle returned by py_reader")
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch is the executor's job under XLA (async dispatch +
+    donated buffers); pass-through for API parity."""
+    return reader
+
+
+def load(out, file_path, load_as_fp16=False):
+    """Load a parameter value from a save_params/save_persistables .npy
+    file into `out` at build time (reference load_op.cc semantics,
+    host-side; format matches fluid.io's np.save writer)."""
+    arr = np.load(file_path, allow_pickle=False)
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    _tensor.assign(np.asarray(arr), output=out)
+    return out

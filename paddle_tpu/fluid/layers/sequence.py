@@ -22,7 +22,10 @@ __all__ = [
     "sequence_expand", "sequence_expand_as", "sequence_conv",
     "sequence_pad", "sequence_unpad", "dynamic_lstm", "dynamic_gru",
     "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
-    "beam_search",
+    "beam_search", "sequence_concat", "sequence_enumerate",
+    "sequence_slice", "sequence_scatter", "sequence_reshape",
+    "gather_tree", "lod_reset", "lod_append", "im2sequence_alias",
+    "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -366,3 +369,103 @@ def distributed_embedding(input, table_name, name=None):
         attrs={"table_names": [table_name]},
     )
     return out
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Ragged time-axis concat on padded rows (reference
+    sequence_concat_op.cc). `lengths`: optional list of [B] per-input
+    valid lengths. Returns the packed [B, sum(Ti), ...] tensor (valid
+    prefixes back-to-back; output lengths = sum of inputs')."""
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    ins = {"X": list(input)}
+    if lengths is not None:
+        from . import tensor as _tensor
+
+        ins["Length"] = [_tensor.concat([l for l in lengths], axis=0)]
+    helper.append_op(type="sequence_concat", inputs=ins,
+                     outputs={"Out": [out], "Length": [out_len]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = _seq_inputs(input, length)
+    helper.append_op(type="sequence_enumerate", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"win_size": int(win_size),
+                            "pad_value": int(pad_value)})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row subsequence: row b keeps input[b, offset_b:offset_b+length_b]
+    left-aligned (padded+mask analog of sequence_slice_op.cc)."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out], "OutLength": [out_len]},
+    )
+    return out
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_scatter", inputs=ins,
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": int(new_dim)})
+    return out
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree_op.cc):
+    ids/parents [T, B, W] -> full id paths [T, B, W]."""
+    helper = LayerHelper("gather_tree")
+    out = helper.create_variable_for_type_inference(ids.dtype)
+    helper.append_op(type="gather_tree",
+                     inputs={"Ids": [ids], "Parents": [parents]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """LoD is explicit on TPU: sequence lengths travel as a separate
+    `length` argument to each sequence_* layer rather than as tensor
+    metadata (SURVEY.md §7 LoD answer), so resetting LoD is a no-op on
+    the data — pass the new lengths to the next sequence op instead."""
+    return x
+
+
+def lod_append(x, level):
+    """See lod_reset: lengths are explicit arguments on TPU."""
+    return x
+
+
+def im2sequence_alias(*a, **k):  # pragma: no cover — vision.py owns it
+    from .vision import im2sequence
+
+    return im2sequence(*a, **k)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder batch rows by a rank index (dense analog of the
+    reference's rank-table reorder): rank_table is an int [B] index."""
+    from . import nn as _nn
+
+    return _nn.gather(x, rank_table)

@@ -237,3 +237,53 @@ def py_func_op(ctx, ins, attrs):
     call.defvjp(call_fwd, call_bwd)
     outs = call(*xs)
     return {"Out": list(outs)}
+
+
+@register("print", no_vjp_grad=True,
+          infer_shape=lambda m, a: {"Out": [m["In"][0]]})
+def print_op(ctx, ins, attrs):
+    """Runtime tensor printing (reference print_op.cc) via a host
+    callback; honors first_n (stop after N prints) and summarize
+    (np.array2string threshold). Out aliases In so the print stays
+    ordered relative to consumers."""
+    x = ins["In"][0]
+    msg = str(attrs.get("message") or "")
+    name = str(attrs.get("var_name", ""))
+    first_n = int(attrs.get("first_n", -1))
+    summarize = int(attrs.get("summarize", 20))
+    state = {"n": 0}  # one closure per compiled program (trace-time)
+
+    def _emit(val):
+        import numpy as np
+
+        if 0 <= first_n <= state["n"]:
+            return
+        state["n"] += 1
+        body = np.array2string(
+            np.asarray(val), threshold=summarize if summarize > 0 else 1000)
+        print(f"{msg}{name} = {body}", flush=True)
+
+    jax.debug.callback(_emit, x, ordered=False)
+    return {"Out": [x]}
+
+
+@register("assert", no_vjp_grad=True, stop_gradient=True,
+          infer_shape=lambda m, a: {"Out": [((1,), "bool")]})
+def assert_op(ctx, ins, attrs):
+    """Runtime assertion (reference assert_op.cc): host callback raises
+    when the condition is false, aborting the step."""
+    cond = _scalar_pred(ins["Cond"][0])
+    data = [jnp.asarray(d) for d in ins.get("Data", [])]
+
+    def _check(c, *vals):
+        import numpy as np
+
+        if not bool(np.asarray(c)):
+            raise AssertionError(
+                "layers.Assert failed"
+                + ("; data: " + ", ".join(repr(np.asarray(v)) for v in vals)
+                   if vals else "")
+            )
+
+    jax.debug.callback(_check, cond, *data, ordered=False)
+    return {"Out": [cond.reshape(1)]}

@@ -597,3 +597,124 @@ def beam_search(ctx, ins, attrs):
         "selected_scores": [top_scores.reshape(bw, 1)],
         "parent_idx": [parent_flat],
     }
+
+
+@register("sequence_concat")
+def sequence_concat(ctx, ins, attrs):
+    """Ragged concat along time on padded rows (reference
+    sequence_concat_op.cc): inputs X (list of [B, Ti, ...]) with optional
+    per-input Length ([k*B] stacked or absent = full). Valid prefixes are
+    packed back-to-back per row; output time = sum(Ti)."""
+    xs = ins["X"]
+    b = xs[0].shape[0]
+    t_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    if ins.get("Length"):
+        lens = jnp.split(ins["Length"][0].reshape(len(xs), b), len(xs))
+        lens = [l.reshape(b) for l in lens]
+    else:
+        lens = [jnp.full((b,), x.shape[1], jnp.int32) for x in xs]
+    out = jnp.zeros((b, t_out) + tuple(feat), xs[0].dtype)
+    offset = jnp.zeros((b,), jnp.int32)
+    for x, ln in zip(xs, lens):
+        ln = ln.astype(jnp.int32)
+        t = x.shape[1]
+        steps = jnp.arange(t, dtype=jnp.int32)
+        valid = (steps[None, :] < ln[:, None])  # [B, T]
+        tgt = jnp.clip(offset[:, None] + steps[None, :], 0, t_out - 1)
+        bidx = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None], tgt.shape)
+        idx = jnp.stack([bidx, tgt], axis=-1).reshape(-1, 2)
+        upd = (x * valid.reshape(valid.shape + (1,) * len(feat)).astype(x.dtype)
+               ).reshape((b * t,) + tuple(feat))
+        out = out.at[idx[:, 0], idx[:, 1]].add(upd)
+        offset = offset + ln
+    return {"Out": [out], "Length": [offset]}
+
+
+@register("sequence_enumerate", stop_gradient=True, no_vjp_grad=True)
+def sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of ids (reference sequence_enumerate_op.cc):
+    X [B, T] int -> Out [B, T, win]; positions past the row's length (or
+    window overruns) read pad_value."""
+    x = ins["X"][0]
+    win = int(attrs["win_size"])
+    pad = int(attrs.get("pad_value", 0))
+    b, t = x.shape[:2]
+    if ins.get("Length"):
+        ln = ins["Length"][0].astype(jnp.int32)
+    else:
+        ln = jnp.full((b,), t, jnp.int32)
+    steps = jnp.arange(t, dtype=jnp.int32)
+    cols = []
+    for k in range(win):
+        idx = jnp.clip(steps + k, 0, t - 1)
+        v = x[:, idx]
+        ok = ((steps + k)[None, :] < ln[:, None])
+        cols.append(jnp.where(ok, v, pad))
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register("sequence_slice")
+def sequence_slice(ctx, ins, attrs):
+    """Per-row subsequence (reference sequence_slice_op.cc): X [B, T, ...],
+    Offset [B] or [B,1], Length [B] or [B,1] -> Out [B, T, ...] with row b
+    holding X[b, off_b : off_b+len_b] left-aligned, rest zero."""
+    x = ins["X"][0]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    b, t = x.shape[:2]
+    steps = jnp.arange(t, dtype=jnp.int32)
+    src = jnp.clip(off[:, None] + steps[None, :], 0, t - 1)  # [B, T]
+    picked = jnp.take_along_axis(
+        x, src.reshape((b, t) + (1,) * (x.ndim - 2)), axis=1
+    ) if x.ndim > 2 else jnp.take_along_axis(x, src, axis=1)
+    valid = (steps[None, :] < ln[:, None]).reshape(
+        (b, t) + (1,) * (x.ndim - 2)).astype(x.dtype)
+    return {"Out": [picked * valid], "OutLength": [ln]}
+
+
+@register("sequence_scatter")
+def sequence_scatter(ctx, ins, attrs):
+    """Scatter per-row updates into X at per-row column ids (reference
+    sequence_scatter_op.cc on the padded layout): X [B, D], Ids [B, S],
+    Updates [B, S] (+ optional Length [B] masking trailing id slots)."""
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    b, s = ids.shape[:2]
+    if ins.get("Length"):
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+        valid = (jnp.arange(s, dtype=jnp.int32)[None, :] < ln[:, None])
+        upd = upd * valid.astype(upd.dtype)
+    bidx = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None], ids.shape)
+    return {"Out": [x.at[bidx.reshape(-1), ids.reshape(-1)].add(upd.reshape(-1))]}
+
+
+@register("sequence_reshape")
+def sequence_reshape(ctx, ins, attrs):
+    """Re-chunk the time axis to a new feature width (reference
+    sequence_reshape_op.cc): [B, T, D] -> [B, T*D/new_dim, new_dim]."""
+    x = ins["X"][0]
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    if (t * d) % new_dim:
+        raise ValueError(
+            f"sequence_reshape: T*D={t*d} not divisible by new_dim={new_dim}")
+    return {"Out": [x.reshape(b, (t * d) // new_dim, new_dim)]}
+
+
+@register("gather_tree", stop_gradient=True, no_vjp_grad=True)
+def gather_tree(ctx, ins, attrs):
+    """Beam-search backtrace (reference gather_tree_op.cc): Ids and
+    Parents [T, B, W]; walk parents from the last step back, emitting the
+    full id path per final beam."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0].astype(jnp.int32)
+    t = ids.shape[0]
+    # last step emits its own ids in final beam order; then walk back:
+    # beam[b, w] = which beam slot the path through w occupied at time ti
+    outs = [ids[t - 1]]
+    beam = parents[t - 1]
+    for ti in range(t - 2, -1, -1):
+        outs.append(jnp.take_along_axis(ids[ti], beam, axis=-1))
+        beam = jnp.take_along_axis(parents[ti], beam, axis=-1)
+    return {"Out": [jnp.stack(outs[::-1], axis=0)]}

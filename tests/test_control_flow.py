@@ -104,3 +104,196 @@ def test_lr_schedulers_values():
         64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 10 ** -1.5) for s in range(3)
     ]
     np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# block-style While, IfElse, arrays, DynamicRNN, Print/Assert
+# ---------------------------------------------------------------------------
+
+
+def test_while_block_style():
+    """Reference While usage: mutate outer vars in the block, update cond."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int32", 0)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        limit = layers.fill_constant([1], "int32", 5)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.increment(i, value=1, in_place=False), i)
+            layers.assign(
+                layers.elementwise_add(acc, layers.cast(i, "float32")), acc)
+            layers.assign(layers.less_than(i, limit), cond)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        iv, av = exe.run(main, feed={}, fetch_list=[i, acc])
+    assert int(np.asarray(iv)[0]) == 5
+    assert float(np.asarray(av)[0]) == 1 + 2 + 3 + 4 + 5
+
+
+def test_while_requires_cond_update():
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int32", 0)
+        cond = layers.less_than(i, layers.fill_constant([1], "int32", 3))
+        w = layers.While(cond)
+        with pytest.raises(ValueError, match="cond"):
+            with w.block():
+                layers.assign(layers.increment(i, value=1, in_place=False), i)
+
+
+def test_ifelse_rowwise_merge():
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        zero = layers.fill_constant([4, 1], "float32", 0.0)
+        row_sum = layers.reduce_sum(x, dim=[1], keep_dim=True)
+        cond = layers.less_than(row_sum, zero)  # [4,1] bool
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(x), scale=-1.0))
+        with ie.false_block():
+            ie.output(ie.input(x))
+        (out,) = ie()
+    xv = np.asarray([[1, 2, 3], [-1, -2, -3], [2, -1, 0], [-5, 1, 1]],
+                    np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    want = xv.copy()
+    want[xv.sum(1) < 0] *= -1  # negative-sum rows flipped
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_arrays_and_tensor_array_to_tensor():
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 3], "float32")
+        arr = layers.create_array("float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        layers.array_write(x, i0, arr)
+        layers.array_write(layers.scale(x, 2.0), i1, arr)
+        ln = layers.array_length(arr)
+        back = layers.array_read(arr, i1)
+        cat, _sizes = layers.tensor_array_to_tensor(arr, axis=0)
+        stk, _ = layers.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+    xv = np.ones((2, 3), np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        lnv, bv, cv, sv = exe.run(
+            main, feed={"x": xv}, fetch_list=[ln, back, cat, stk])
+    assert int(np.asarray(lnv)[0]) == 2
+    np.testing.assert_allclose(np.asarray(bv), 2 * xv)
+    assert np.asarray(cv).shape == (4, 3)
+    assert np.asarray(sv).shape == (2, 2, 3)
+
+
+def test_dynamic_rnn_masks_by_length():
+    """Rows freeze once their sequence ends: output past the row length is
+    the frozen memory, exactly like the reference's LoD-shrunk batch."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4, 3], "float32")
+        lens = fluid.data("lens", [2], "int32")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, length=lens)
+            h = drnn.memory(shape=[3], batch_ref=x)
+            nh = layers.elementwise_add(h, x_t)  # running sum
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()
+    xv = np.ones((2, 4, 3), np.float32)
+    lv = np.asarray([2, 4], np.int32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": xv, "lens": lv}, fetch_list=[out])
+    got = np.asarray(got)
+    # row 0 (len 2): sums 1,2 then zero-padded; row 1 (len 4): 1,2,3,4
+    np.testing.assert_allclose(got[0, :, 0], [1, 2, 0, 0])
+    np.testing.assert_allclose(got[1, :, 0], [1, 2, 3, 4])
+
+
+def test_print_passthrough_and_assert(capfd):
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 2], "float32")
+        y = layers.Print(x, message="dbg: ")
+        ok = layers.reduce_all(
+            layers.cast(layers.less_than(
+                x, layers.fill_constant([2, 2], "float32", 100.0)), "bool"))
+        layers.Assert(ok, data=[x])
+        out = layers.scale(y, 2.0)
+    xv = np.ones((2, 2), np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), 2 * xv)
+
+    # failing assert raises
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.data("x", [2, 2], "float32")
+        bad = layers.fill_constant([1], "bool", False)
+        layers.Assert(bad, data=[x2])
+        out2 = layers.scale(x2, 3.0)
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe2 = fluid.Executor()
+        exe2.run(startup2)
+        with pytest.raises(Exception):
+            exe2.run(main2, feed={"x": xv}, fetch_list=[out2])
+
+
+def test_array_index_rejects_loop_counters():
+    """A fill_constant later reassigned must NOT fold to its init value."""
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 2], "float32")
+        i = layers.fill_constant([1], "int64", 0)
+        layers.assign(layers.increment(i, value=1, in_place=False), i)
+        arr = layers.create_array("float32")
+        with pytest.raises(NotImplementedError, match="unmodified"):
+            layers.array_write(x, i, arr)

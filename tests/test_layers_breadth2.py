@@ -1,0 +1,191 @@
+"""Distributions, NCE/hsigmoid, auc/chunk_eval, py_reader shims
+(layers/distributions.py, misc.py additions, rnn.py lives in
+test_rnn_api.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build, feeds=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    if not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds or {}, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def test_normal_distribution_numerics():
+    def build():
+        n1 = layers.Normal(0.0, 1.0)
+        n2 = layers.Normal(1.0, 2.0)
+        x = layers.fill_constant([1], "float32", 0.5)
+        return (n1.log_prob(x), n1.entropy(), n1.kl_divergence(n2),
+                n1.sample([512]))
+
+    lp, ent, kl, samp = _run(build)
+    np.testing.assert_allclose(
+        lp, -0.5 * 0.25 - 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    # closed form KL(N(0,1) || N(1,2))
+    want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
+    assert abs(samp.mean()) < 0.2 and abs(samp.std() - 1.0) < 0.2
+
+
+def test_uniform_and_categorical():
+    def build():
+        u = layers.Uniform(0.0, 2.0)
+        logits = layers.assign(np.asarray([[0.0, 0.0, np.log(2.0)]], "f4"))
+        c = layers.Categorical(logits)
+        c2 = layers.Categorical(layers.assign(np.zeros((1, 3), "f4")))
+        lbl = layers.assign(np.asarray([2], "i4"))
+        return (u.sample([256]), u.entropy(), c.entropy(),
+                c.kl_divergence(c2), c.log_prob(lbl))
+
+    us, ue, ce, ckl, clp = _run(build)
+    assert us.min() >= 0 and us.max() < 2 and abs(us.mean() - 1.0) < 0.15
+    np.testing.assert_allclose(ue, np.log(2.0), rtol=1e-5)
+    p = np.asarray([0.25, 0.25, 0.5])
+    np.testing.assert_allclose(ce, -(p * np.log(p)).sum(), rtol=1e-4)
+    want_kl = (p * (np.log(p) - np.log(1 / 3))).sum()
+    np.testing.assert_allclose(ckl.ravel(), [want_kl], rtol=1e-4)
+    np.testing.assert_allclose(clp.ravel(), [np.log(0.5)], rtol=1e-4)
+
+
+def test_mvn_diag_entropy_kl():
+    def build():
+        loc = layers.assign(np.zeros((1, 2), "f4"))
+        scale = layers.assign(np.ones((1, 2), "f4"))
+        loc2 = layers.assign(np.ones((1, 2), "f4"))
+        scale2 = layers.assign(2 * np.ones((1, 2), "f4"))
+        m1 = layers.MultivariateNormalDiag(loc, scale)
+        m2 = layers.MultivariateNormalDiag(loc2, scale2)
+        return m1.entropy(), m1.kl_divergence(m2)
+
+    ent, kl = _run(build)
+    np.testing.assert_allclose(ent.ravel(),
+                               [1.0 + np.log(2 * np.pi)], rtol=1e-5)
+    # KL for diag normals, per dim: log(2) + (1+1)/(2*4) - 0.5, x2 dims
+    want = 2 * (np.log(2.0) + 2 / 8 - 0.5)
+    np.testing.assert_allclose(kl.ravel(), [want], rtol=1e-4)
+
+
+def test_nce_and_hsigmoid_train():
+    b, d, c = 8, 16, 10
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [b, d], "float32")
+        y = fluid.data("y", [b, 1], "int64")
+        nce_cost = layers.reduce_mean(layers.nce(x, y, c, num_neg_samples=4))
+        hs_cost = layers.reduce_mean(layers.hsigmoid(x, y, c))
+        total = layers.elementwise_add(nce_cost, hs_cost)
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(total)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(b, d).astype("f4"),
+            "y": rng.randint(0, c, (b, 1)).astype("i8")}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        vals = [float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[total])[0]).reshape(()))
+            for _ in range(20)]
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0], (vals[0], vals[-1])
+
+
+def test_auc_layer_accumulates():
+    b = 64
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.data("p", [b, 2], "float32")
+        l = fluid.data("l", [b, 1], "int64")
+        auc_v, _stats = layers.auc(p, l, num_thresholds=255)
+    rng = np.random.RandomState(0)
+    # perfectly separable scores -> auc ~ 1
+    lab = (rng.rand(b, 1) > 0.5).astype("i8")
+    score = np.where(lab == 1, 0.9, 0.1) + rng.rand(b, 1) * 0.05
+    probs = np.concatenate([1 - score, score], axis=1).astype("f4")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (a1,) = exe.run(main, feed={"p": probs, "l": lab}, fetch_list=[auc_v])
+        (a2,) = exe.run(main, feed={"p": probs, "l": lab}, fetch_list=[auc_v])
+    assert float(np.asarray(a1)) > 0.99
+    assert float(np.asarray(a2)) > 0.99  # stats persist across runs
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types: tag = type*2 + kind (B=0, I=1); outside = 99
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.data("inf", [1, 6], "int64")
+        lab = fluid.data("lab", [1, 6], "int64")
+        p, r, f1, n_inf, n_lab, n_cor = layers.chunk_eval(
+            inf, lab, "IOB", num_chunk_types=2)
+    # label: chunks [0-1 type0], [3-4 type1]; inference gets the first only
+    lab_v = np.asarray([[0, 1, 99, 2, 3, 99]], "i8")
+    inf_v = np.asarray([[0, 1, 99, 99, 99, 99]], "i8")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        pv, rv, fv, ni, nl, nc = exe.run(
+            main, feed={"inf": inf_v, "lab": lab_v},
+            fetch_list=[p, r, f1, n_inf, n_lab, n_cor])
+    assert int(np.asarray(ni)) == 1 and int(np.asarray(nl)) == 2
+    assert int(np.asarray(nc)) == 1
+    np.testing.assert_allclose(float(np.asarray(pv)), 1.0)
+    np.testing.assert_allclose(float(np.asarray(rv)), 0.5)
+
+
+def test_py_reader_shim_feeds_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(
+            capacity=8, shapes=[[4, 3], [4, 1]], dtypes=["float32", "float32"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, 1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def gen():
+        for _ in range(5):
+            yield [rng.rand(4, 3).astype("f4"), rng.rand(4, 1).astype("f4")]
+
+    reader.decorate_batch_generator(gen)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        n = 0
+        for feed in reader:
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            n += 1
+        assert n == 5
+        assert np.isfinite(float(np.asarray(lv).reshape(())))
+
+
+def test_chunk_eval_all_outside_reports_zero_chunks():
+    """All-O sequences must yield 0 chunks, not a phantom full-row chunk."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.data("inf", [1, 4], "int64")
+        lab = fluid.data("lab", [1, 4], "int64")
+        p, r, f1, ni, nl, nc = layers.chunk_eval(
+            inf, lab, "IOB", num_chunk_types=1)
+    o = np.asarray([[2, 2, 2, 2]], "i8")  # O tag = n_tags*num_types = 2
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        pv, ni_v, nl_v = exe.run(main, feed={"inf": o, "lab": o},
+                                 fetch_list=[p, ni, nl])
+    assert int(np.asarray(ni_v)) == 0 and int(np.asarray(nl_v)) == 0
+    assert float(np.asarray(pv)) == 0.0

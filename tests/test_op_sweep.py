@@ -2797,6 +2797,110 @@ def _im2sequence():
                   attrs={"kernels": [2, 2]}, grad=("X",))
 
 
+@case("sequence_enumerate")
+def _sequence_enumerate():
+    x = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+    ln = np.asarray([3, 4], np.int32)
+
+    def oracle(ins, a):
+        out = np.zeros((2, 4, 2), np.int32)
+        for b in range(2):
+            for t in range(4):
+                for k in range(2):
+                    out[b, t, k] = x[b, t + k] if t + k < ln[b] else 0
+        return {"Out": [out]}
+
+    return OpTest("sequence_enumerate", {"X": x, "Length": ln}, oracle,
+                  attrs={"win_size": 2, "pad_value": 0})
+
+
+@case("sequence_slice")
+def _sequence_slice():
+    rng = R(72)
+    x = _mix(rng, 2, 5, 3)
+    off = np.asarray([1, 2], np.int32)
+    ln = np.asarray([3, 2], np.int32)
+
+    def oracle(ins, a):
+        out = np.zeros_like(x)
+        for b in range(2):
+            out[b, : ln[b]] = x[b, off[b] : off[b] + ln[b]]
+        return {"Out": [out]}
+
+    return OpTest("sequence_slice", {"X": x, "Offset": off, "Length": ln},
+                  oracle, outputs={"Out": 1, "OutLength": 1}, grad=("X",))
+
+
+@case("sequence_reshape")
+def _sequence_reshape():
+    rng = R(73)
+    x = _mix(rng, 2, 4, 6)
+
+    def oracle(ins, a):
+        return {"Out": [ins["X"][0].reshape(2, 8, 3)]}
+
+    return OpTest("sequence_reshape", {"X": x}, oracle,
+                  attrs={"new_dim": 3}, grad=("X",))
+
+
+@case("sequence_scatter")
+def _sequence_scatter():
+    rng = R(74)
+    x = _mix(rng, 2, 6)
+    ids = np.asarray([[0, 2, 2], [5, 1, 0]], np.int32)
+    upd = _mix(rng, 2, 3)
+    ln = np.asarray([3, 2], np.int32)
+
+    def oracle(ins, a):
+        out = x.copy()
+        for b in range(2):
+            for s in range(3):
+                if s < ln[b]:
+                    out[b, ids[b, s]] += upd[b, s]
+        return {"Out": [out]}
+
+    return OpTest("sequence_scatter",
+                  {"X": x, "Ids": ids, "Updates": upd, "Length": ln},
+                  oracle, grad=("X",))
+
+
+@case("sequence_concat")
+def _sequence_concat():
+    rng = R(75)
+    a_ = _mix(rng, 2, 3, 2)
+    b_ = _mix(rng, 2, 2, 2)
+    lens = np.asarray([[2, 3], [1, 2]], np.int32)  # stacked [k, B] -> flat
+
+    def oracle(ins, at):
+        out = np.zeros((2, 5, 2), np.float32)
+        newlen = np.zeros(2, np.int32)
+        for b in range(2):
+            pos = 0
+            for x, ln in ((a_, lens[0]), (b_, lens[1])):
+                out[b, pos : pos + ln[b]] = x[b, : ln[b]]
+                pos += ln[b]
+            newlen[b] = pos
+        return {"Out": [out], "Length": [newlen]}
+
+    return OpTest("sequence_concat",
+                  {"X": [a_, b_], "Length": lens.reshape(-1)},
+                  oracle, outputs={"Out": 1, "Length": 1}, grad=("X",))
+
+
+@case("gather_tree")
+def _gather_tree():
+    # T=3, B=1, W=2 hand-traced beam backtrace
+    ids = np.asarray([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.asarray([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+
+    def oracle(ins, a):
+        # final beams: w0 traces parent 1 at t2 -> ids path [1,4,5];
+        # w1 traces parent 0 -> [1,3,6]
+        return {"Out": [np.asarray([[[1, 1]], [[4, 3]], [[5, 6]]], np.int64)]}
+
+    return OpTest("gather_tree", {"Ids": ids, "Parents": parents}, oracle)
+
+
 # ---------------------------------------------------------------------------
 # exemptions: ops whose contract is verified elsewhere or is stochastic
 # ---------------------------------------------------------------------------
@@ -2821,6 +2925,9 @@ EXEMPT = {
     "c_sync_comm_stream": "no-op under XLA; test_fleet.py",
     "c_wait_comm": "no-op under XLA; test_fleet.py",
     "c_wait_compute": "no-op under XLA; test_fleet.py",
+    # side-effect ops (host print/assert callbacks): test_control_flow.py
+    "print": "test_control_flow.py (passthrough + host print)",
+    "assert": "test_control_flow.py (raises on false cond)",
     # control flow needs sub-block programs: tests/test_control_flow.py
     "cond": "test_control_flow.py",
     "while_loop": "test_control_flow.py",

@@ -1,0 +1,206 @@
+"""RNN cell / rnn() / decoder API (layers/rnn.py) through the executor.
+
+Reference contract: python/paddle/fluid/layers/rnn.py (RNNCell, rnn,
+BasicDecoder + helpers, BeamSearchDecoder, dynamic_decode)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_lstm_cell_rnn_matches_manual_unroll():
+    """rnn(LSTMCell) must equal calling the cell step by step (same
+    weights: both paths go through the same named parameters)."""
+    b, t, d, h = 2, 4, 3, 5
+    rng = np.random.RandomState(0)
+    xv = rng.randn(b, t, d).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [b, t, d], "float32")
+        cell = layers.LSTMCell(h, name="cell0")
+        out, _ = layers.rnn(cell, x)
+        # manual unroll with the SAME cell (shared params by name)
+        hs = layers.fill_constant([b, h], "float32", 0.0)
+        cs = layers.fill_constant([b, h], "float32", 0.0)
+        outs = []
+        for ti in range(t):
+            x_t = layers.reshape(
+                layers.slice(x, axes=[1], starts=[ti], ends=[ti + 1]), [b, d])
+            o, (hs, cs) = cell.call(x_t, [hs, cs])
+            outs.append(o)
+        manual = layers.stack(outs, axis=1)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        got, want = exe.run(main, feed={"x": xv}, fetch_list=[out, manual])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_sequence_length_masks_and_trains():
+    b, t, d, h = 4, 6, 8, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [b, t, d], "float32")
+        y = fluid.data("y", [b, h], "float32")
+        lens = fluid.data("lens", [b], "int32")
+        out, _ = layers.rnn(layers.GRUCell(h, name="g0"), x,
+                            sequence_length=lens)
+        last = layers.sequence_pool(out, "sum")
+        loss = layers.mse_loss(last, y)
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+    rng = np.random.RandomState(1)
+    feed = {
+        "x": rng.randn(b, t, d).astype("f4"),
+        "y": rng.randn(b, h).astype("f4"),
+        "lens": np.asarray([2, 4, 6, 3], "i4"),
+    }
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        losses = [
+            float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]))
+            for _ in range(25)
+        ]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_basic_decoder_greedy_finishes_on_end_token():
+    """An output layer hard-wired to emit the end token must finish every
+    row at step 1 (lengths == 1, ids == end)."""
+    b, h, v, end = 3, 4, 6, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        start = layers.fill_constant([b], "int64", 0)
+
+        def embed(ids):
+            return layers.cast(
+                layers.one_hot(ids, h), "float32")
+
+        bias = np.zeros(v, np.float32)
+        bias[end] = 100.0  # forces argmax = end token
+
+        def output_fn(cell_out):
+            logits = layers.fc(cell_out, v, bias_attr=False)
+            return layers.elementwise_add(
+                logits, layers.assign(bias))
+
+        cell = layers.LSTMCell(h, name="dec0")
+        helper = layers.GreedyEmbeddingHelper(embed, start, end)
+        decoder = layers.BasicDecoder(cell, helper, output_fn=output_fn)
+        inits = cell.get_initial_states(batch_ref=embed(start))
+        (outs, ids), _, lengths = layers.dynamic_decode(
+            decoder, inits=inits, max_step_num=5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        ov, iv, lv = exe.run(main, feed={}, fetch_list=[outs, ids, lengths])
+    iv, lv = np.asarray(iv), np.asarray(lv)
+    assert iv.shape == (b, 5)
+    np.testing.assert_array_equal(iv[:, 0], [end] * b)
+    np.testing.assert_array_equal(lv, [1] * b)  # finished after one step
+    assert np.all(iv[:, 1:] == 0)  # frozen rows emit masked zeros
+
+
+def test_training_helper_teacher_forcing_shapes():
+    b, t, d, h, v = 2, 4, 3, 5, 7
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gt = fluid.data("gt", [b, t, d], "float32")
+        cell = layers.GRUCell(h, name="tf0")
+        helper = layers.TrainingHelper(gt)
+        decoder = layers.BasicDecoder(
+            cell, helper, output_fn=lambda o: layers.fc(o, v, bias_attr=False))
+        inits = cell.get_initial_states(batch_ref=layers.reshape(
+            layers.slice(gt, axes=[1], starts=[0], ends=[1]), [b, d]))
+        (outs, ids), _, _ = layers.dynamic_decode(
+            decoder, inits=inits, max_step_num=t)
+    rng = np.random.RandomState(2)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        ov, iv = exe.run(main, feed={"gt": rng.randn(b, t, d).astype("f4")},
+                         fetch_list=[outs, ids])
+    assert np.asarray(ov).shape == (b, t, v)
+    assert np.asarray(iv).shape == (b, t)
+    assert np.isfinite(np.asarray(ov)).all()
+
+
+def test_beam_search_decoder_produces_valid_beams():
+    b, h, v, w, end = 2, 4, 8, 3, 7
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        def embed(ids):
+            return layers.cast(layers.one_hot(ids, h), "float32")
+
+        def output_fn(cell_out):
+            return layers.fc(cell_out, v, bias_attr=False)
+
+        cell = layers.LSTMCell(h, name="bs0")
+        init = [layers.fill_constant([b, h], "float32", 0.0),
+                layers.fill_constant([b, h], "float32", 0.0)]
+        decoder = layers.BeamSearchDecoder(
+            cell, start_token=0, end_token=end, beam_size=w,
+            embedding_fn=embed, output_fn=output_fn, vocab_size=v)
+        (outs, ids), _, lengths = layers.dynamic_decode(
+            decoder, inits=init, max_step_num=4)
+        # outs: [B*W, T, 2] (token, parent) -> gather_tree wants [T, B, W]
+        tok = layers.transpose(
+            layers.reshape(
+                layers.slice(outs, axes=[2], starts=[0], ends=[1]),
+                [b * w, 4]),
+            [1, 0])
+        tok = layers.reshape(tok, [4, b, w])
+        par = layers.reshape(
+            layers.transpose(
+                layers.reshape(
+                    layers.slice(outs, axes=[2], starts=[1], ends=[2]),
+                    [b * w, 4]),
+                [1, 0]),
+            [4, b, w])
+        full = layers.gather_tree(layers.cast(tok, "int64"),
+                                  layers.cast(par, "int64"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        fv, lv = exe.run(main, feed={}, fetch_list=[full, lengths])
+    fv = np.asarray(fv)
+    assert fv.shape == (4, b, w)
+    assert fv.min() >= 0 and fv.max() < v
+
+
+def test_multilayer_lstm_and_lstmp():
+    b, t, d, h, p = 2, 5, 4, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [b, t, d], "float32")
+        h0 = layers.fill_constant([2, b, h], "float32", 0.0)
+        c0 = layers.fill_constant([2, b, h], "float32", 0.0)
+        out, last_h, last_c = layers.lstm(x, h0, c0, t, h, num_layers=2)
+        # dynamic_lstm(p) takes the pre-projected [B, T, 4H] tensor
+        # (reference layers/nn.py dynamic_lstm:466 contract)
+        pre = layers.fc(x, 4 * h, num_flatten_dims=2, bias_attr=False)
+        proj, cell_seq = layers.dynamic_lstmp(pre, 4 * h, p, name="lstmp0")
+        hu, cu = layers.lstm_unit(
+            layers.reshape(layers.slice(x, [1], [0], [1]), [b, d]),
+            layers.fill_constant([b, h], "float32", 0.0),
+            layers.fill_constant([b, h], "float32", 0.0))
+        gu, _, _ = layers.gru_unit(
+            layers.reshape(layers.slice(x, [1], [0], [1]), [b, d]),
+            layers.fill_constant([b, h], "float32", 0.0), 3 * h)
+    rng = np.random.RandomState(3)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        o, lh, pj, huv, guv = exe.run(
+            main, feed={"x": rng.randn(b, t, d).astype("f4")},
+            fetch_list=[out, last_h, proj, hu, gu])
+    assert np.asarray(o).shape == (b, t, h)
+    assert np.asarray(lh).shape == (2, b, h)
+    assert np.asarray(pj).shape == (b, t, p)
+    assert np.asarray(huv).shape == (b, h)
+    assert np.asarray(guv).shape == (b, h)
+    for a in (o, lh, pj, huv, guv):
+        assert np.isfinite(np.asarray(a)).all()
