@@ -351,6 +351,9 @@ class StaticRNN:
             },
             infer=False,
         )
+        # final memory values, in memory() declaration order — consumers
+        # (layers.rnn) read these as the recurrence's final states
+        self.final_states = state_vars
         return out_vars[0] if len(out_vars) == 1 else out_vars
 
 

@@ -6,7 +6,17 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper, emit_op
 
 __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
-           "roi_align"]
+           "roi_align", "anchor_generator", "density_prior_box", "box_clip",
+           "generate_proposals", "rpn_target_assign",
+           "retinanet_target_assign", "retinanet_detection_output",
+           "collect_fpn_proposals", "distribute_fpn_proposals",
+           "prroi_pool", "psroi_pool", "roi_perspective_transform",
+           "deformable_conv", "deformable_roi_pooling", "yolov3_loss",
+           "generate_proposal_labels", "generate_mask_labels",
+           "box_decoder_and_assign", "multiclass_nms", "matrix_nms",
+           "locality_aware_nms", "target_assign", "bipartite_match",
+           "polygon_box_transform", "ctc_greedy_decoder", "detection_output",
+           "ssd_loss", "multi_box_head"]
 
 
 def iou_similarity(x, y, box_normalized=True, name=None):
@@ -66,4 +76,613 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
         "roi_align", ins,
         {"pooled_height": pooled_height, "pooled_width": pooled_width,
          "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio},
+    )
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    return emit_op(
+        "anchor_generator", {"Input": [input]},
+        {"anchor_sizes": [float(s) for s in anchor_sizes],
+         "aspect_ratios": [float(r) for r in aspect_ratios],
+         "variances": [float(v) for v in variance],
+         "stride": [float(s) for s in stride], "offset": float(offset)},
+        out_slots=("Anchors", "Variances"),
+    )
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    boxes, var = emit_op(
+        "density_prior_box", {"Input": [input], "Image": [image]},
+        {"densities": [int(d) for d in densities],
+         "fixed_sizes": [float(s) for s in fixed_sizes],
+         "fixed_ratios": [float(r) for r in fixed_ratios],
+         "variances": [float(v) for v in variance], "clip": clip,
+         "step_w": float(steps[0]), "step_h": float(steps[1]),
+         "offset": float(offset)},
+        out_slots=("Boxes", "Variances"),
+    )
+    if flatten_to_2d:
+        from . import nn as _nn
+
+        n = 1
+        for d in boxes.shape[:-1]:
+            n *= d
+        boxes = _nn.reshape(boxes, [n, 4])
+        var = _nn.reshape(var, [n, 4])
+    return boxes, var
+
+
+def box_clip(input, im_info, name=None):
+    return emit_op("box_clip", {"Input": [input], "ImInfo": [im_info]},
+                   out_slots=("Output",))
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=None, name=None):
+    """box_clip (reference semantics) bounds the w/h delta exponent
+    before exp(), e.g. np.log(1000/16)."""
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs = {"box_var": [float(v) for v in prior_box_var]}
+    else:
+        attrs = {}
+    if box_clip is not None:
+        attrs["box_clip"] = float(box_clip)
+    return emit_op(
+        "box_decoder_and_assign",
+        {"PriorBox": [prior_box], "TargetBox": [target_box],
+         "BoxScore": [box_score]},
+        attrs, out_slots=("DecodeBox", "OutputAssignBox"),
+    )
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, return_index=False, rois_num=None,
+                   name=None):
+    """Fixed-size NMS: Out [N, keep_top_k, 6] with label=-1 padding plus
+    NmsRoisNum [N] (the static-shape analog of the reference's LoD rows;
+    multiclass_nms_op.cc). return_index=True additionally yields the
+    selected ORIGINAL box row per detection ([N, keep_top_k, 1], -1 pads,
+    matching the reference's Index output)."""
+    out, index, counts = emit_op(
+        "multiclass_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+        {"score_threshold": float(score_threshold),
+         "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+         "nms_threshold": float(nms_threshold),
+         "background_label": int(background_label)},
+        out_slots=("Out", "Index", "NmsRoisNum"),
+    )
+    if return_index:
+        return out, index
+    if rois_num is not None:
+        return out, counts
+    return out
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    out, counts = emit_op(
+        "matrix_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+        {"score_threshold": float(score_threshold),
+         "post_threshold": float(post_threshold),
+         "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+         "use_gaussian": use_gaussian, "gaussian_sigma": float(gaussian_sigma),
+         "background_label": int(background_label)},
+        out_slots=("Out", "RoisNum"),
+    )
+    return (out, counts) if return_rois_num else out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                       nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                       background_label=-1, name=None):
+    return emit_op(
+        "locality_aware_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+        {"score_threshold": float(score_threshold),
+         "nms_threshold": float(nms_threshold),
+         "nms_top_k": int(nms_top_k),
+         "keep_top_k": int(keep_top_k)},
+        out_slots=("Out",),
+    )
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    return emit_op(
+        "target_assign",
+        {"X": [input], "MatchIndices": [matched_indices]},
+        {"mismatch_value": mismatch_value},
+        out_slots=("Out", "OutWeight"),
+    )
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    return emit_op(
+        "bipartite_match", {"DistMat": [dist_matrix]},
+        {"match_type": match_type, "dist_threshold": float(dist_threshold)},
+        out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"),
+    )
+
+
+def polygon_box_transform(input, name=None):
+    return emit_op("polygon_box_transform", {"Input": [input]},
+                   out_slots=("Output",))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode (reference ctc_greedy_decoder over
+    ctc_align_op.cc): argmax per step, collapse repeats, drop blanks.
+    input [B, T, C] probs (dense analog of the reference's LoD input).
+    Returns (decoded [B, T] left-aligned + padded, lengths [B])."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    ids = _tensor.argmax(input, axis=-1)
+    ins = {"Input": [_tensor.cast(ids, "int32")]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    return emit_op(
+        "ctc_align", ins,
+        {"blank": int(blank), "padding_value": int(padding_value)},
+        out_slots=("Output", "OutputLength"),
+    )
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD post-processing (reference detection.py detection_output):
+    decode loc deltas against priors, then multiclass NMS."""
+    from . import nn as _nn
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = _nn.transpose(scores, [0, 2, 1])  # [N, C, P]
+    return multiclass_nms(
+        decoded, scores_t, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold=nms_threshold, background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """SSD multibox loss (reference detection.py ssd_loss) as ONE fused
+    differentiable op (ops/detection2_ops.py ssd_loss): matching, target
+    encoding, smooth-L1 + softmax losses, and hard negative mining run in
+    a single XLA program. Dense gt contract: gt_box [N, G, 4], gt_label
+    [N, G] int with -1 padding rows. Returns [N, 1]."""
+    ins = {"Location": [location], "Confidence": [confidence],
+           "GtBox": [gt_box], "GtLabel": [gt_label],
+           "PriorBox": [prior_box]}
+    if prior_box_var is not None and not isinstance(
+            prior_box_var, (list, tuple)):
+        ins["PriorBoxVar"] = [prior_box_var]
+    attrs = {
+        "background_label": int(background_label),
+        "overlap_threshold": float(overlap_threshold),
+        "neg_pos_ratio": float(neg_pos_ratio),
+        "loc_loss_weight": float(loc_loss_weight),
+        "conf_loss_weight": float(conf_loss_weight),
+        "normalize": bool(normalize),
+    }
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["box_var"] = [float(v) for v in prior_box_var]
+    return emit_op("ssd_loss", ins, attrs, out_slots=("Loss",))
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD heads over multiple feature maps (reference detection.py
+    multi_box_head): per-input prior boxes + conv loc/conf predictions,
+    concatenated over all maps."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio interpolation
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_layer - 2 + 1e-9)) \
+            if n_layer > 2 else 100
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[: n_layer - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[: n_layer - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        mins = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        maxs = [max_sizes[i]] if max_sizes else None
+        box, var = prior_box(
+            x, image, min_sizes=mins, max_sizes=maxs, aspect_ratios=ar,
+            variance=list(variance), flip=flip, clip=clip,
+            steps=[steps[i], steps[i]] if steps else [0.0, 0.0],
+            offset=offset)
+        num_priors = 1
+        for dshape in box.shape[:-1]:
+            num_priors *= dshape
+        num_priors //= (x.shape[2] * x.shape[3])
+        loc = _nn.conv2d(x, num_priors * 4, kernel_size, padding=pad,
+                         stride=stride)
+        conf = _nn.conv2d(x, num_priors * num_classes, kernel_size,
+                          padding=pad, stride=stride)
+        # NCHW -> [N, H*W*priors, 4|C]
+        nb = x.shape[0]
+        loc = _nn.reshape(_nn.transpose(loc, [0, 2, 3, 1]), [nb, -1, 4])
+        conf = _nn.reshape(_nn.transpose(conf, [0, 2, 3, 1]),
+                           [nb, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(_nn.reshape(box, [-1, 4]))
+        vars_all.append(_nn.reshape(var, [-1, 4]))
+    mbox_locs = _tensor.concat(locs, axis=1)
+    mbox_confs = _tensor.concat(confs, axis=1)
+    boxes = _tensor.concat(boxes_all, axis=0)
+    variances = _tensor.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """RPN proposals (reference generate_proposals_op.cc): fixed-size
+    [N, post_nms_top_n, 4] outputs + valid counts (static-shape analog
+    of the reference's LoD rois)."""
+    rois, probs, counts = emit_op(
+        "generate_proposals",
+        {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+         "ImInfo": [im_info], "Anchors": [anchors],
+         "Variances": [variances]},
+        {"pre_nms_topN": int(pre_nms_top_n),
+         "post_nms_topN": int(post_nms_top_n),
+         "nms_thresh": float(nms_thresh), "min_size": float(min_size)},
+        out_slots=("RpnRois", "RpnRoiProbs", "RpnRoisNum"),
+    )
+    if return_rois_num:
+        return rois, probs, counts
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN training targets, dense form (reference rpn_target_assign_op.cc):
+    instead of the reference's gathered LoD rows, returns full-length
+    per-anchor targets + 0/1 weights — consumers multiply by the weights.
+    (bbox_pred/cls_logits are accepted for API parity; selection happens
+    via the returned weights rather than gather indices.)
+
+    Returns (loc_target [N,A,4], score_label [N,A], loc_weight [N,A,1],
+    score_weight [N,A,1])."""
+    from .nn import _rng_salt_counter
+
+    _rng_salt_counter[0] += 1
+    label, loc, locw, scorew = emit_op(
+        "rpn_target_assign",
+        {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+        {"rpn_positive_overlap": float(rpn_positive_overlap),
+         "rpn_negative_overlap": float(rpn_negative_overlap),
+         "rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+         "rpn_fg_fraction": float(rpn_fg_fraction),
+         "rng_salt": _rng_salt_counter[0]},
+        out_slots=("Label", "LocTarget", "LocWeight", "ScoreWeight"),
+    )
+    return loc, label, locw, scorew
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet targets, dense form (see rpn_target_assign): returns
+    (loc_target [N,A,4], cls_label [N,A], anchor_label [N,A],
+    loc_weight [N,A,1], fg_num [N])."""
+    label, cls, loc, locw, fg = emit_op(
+        "retinanet_target_assign",
+        {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+         "GtLabels": [gt_labels]},
+        {"positive_overlap": float(positive_overlap),
+         "negative_overlap": float(negative_overlap)},
+        out_slots=("Label", "ClsLabel", "LocTarget", "LocWeight",
+                   "ForegroundNumber"),
+    )
+    return loc, cls, label, locw, fg
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.45, nms_eta=1.0):
+    """RetinaNet post-processing (reference
+    retinanet_detection_output_op.cc): concat per-level decoded boxes and
+    scores, clip to the image, then multiclass NMS."""
+    from . import tensor as _tensor
+    from . import nn as _nn
+
+    boxes_cat = _tensor.concat(list(bboxes), axis=1)   # [N, sumA, 4]
+    scores_cat = _tensor.concat(list(scores), axis=1)  # [N, sumA, C]
+    boxes_cat = box_clip(boxes_cat, im_info)
+    scores_t = _nn.transpose(scores_cat, [0, 2, 1])
+    return multiclass_nms(
+        boxes_cat, scores_t, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold=nms_threshold, background_label=-1)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    rois, counts = emit_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": list(multi_rois),
+         "MultiLevelScores": list(multi_scores)},
+        {"post_nms_topN": int(post_nms_top_n)},
+        out_slots=("FpnRois", "RoisNum"),
+    )
+    if rois_num_per_level is not None:
+        return rois, counts
+    return rois
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route ROIs to FPN levels (reference distribute_fpn_proposals_op.cc).
+    Dense: each level tensor keeps ALL rows with non-members zeroed (use
+    the LevelMask rows to filter); RestoreIndex maps back to input order."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_levels = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+            for _ in range(n_levels)]
+    mask = helper.create_variable_for_type_inference(fpn_rois.dtype)
+    restore = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": outs, "LevelMask": [mask],
+                 "RestoreIndex": [restore]},
+        attrs={"min_level": int(min_level), "max_level": int(max_level),
+               "refer_level": int(refer_level),
+               "refer_scale": float(refer_scale)},
+    )
+    return outs, restore
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_ids=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_ids is not None:
+        ins["BatchId"] = [batch_ids]
+    return emit_op(
+        "prroi_pool", ins,
+        {"spatial_scale": float(spatial_scale),
+         "pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width)},
+    )
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, batch_ids=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_ids is not None:
+        ins["BatchId"] = [batch_ids]
+    return emit_op(
+        "psroi_pool", ins,
+        {"output_channels": int(output_channels),
+         "spatial_scale": float(spatial_scale),
+         "pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width)},
+    )
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              batch_ids=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_ids is not None:
+        ins["BatchId"] = [batch_ids]
+    return emit_op(
+        "roi_perspective_transform", ins,
+        {"transformed_height": int(transformed_height),
+         "transformed_width": int(transformed_width),
+         "spatial_scale": float(spatial_scale)},
+    )
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=None, deformable_groups=None,
+                    im2col_step=None, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """Deformable conv v1 (modulated=False) / v2 (reference
+    deformable_conv_op.cc)."""
+    from ..initializer import NormalInitializer
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    fs = [filter_size, filter_size] if isinstance(filter_size, int) \
+        else list(filter_size)
+    std = (2.0 / (fs[0] * fs[1] * c)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, shape=[num_filters, c, fs[0], fs[1]], dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if modulated and mask is not None:
+        ins["Mask"] = [mask]
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="deformable_conv", inputs=ins, outputs={"Output": [out]},
+        attrs={"strides": [stride, stride] if isinstance(stride, int) else stride,
+               "paddings": [padding, padding] if isinstance(padding, int) else padding,
+               "dilations": [dilation, dilation] if isinstance(dilation, int) else dilation,
+               "groups": groups or 1,
+               "deformable_groups": deformable_groups or 1},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=True,
+                           batch_ids=None, name=None):
+    oc = input.shape[1] // (pooled_height * pooled_width) \
+        if position_sensitive else input.shape[1]
+    ins = {"Input": [input], "ROIs": [rois]}
+    if trans is not None and not no_trans:
+        ins["Trans"] = [trans]
+    if batch_ids is not None:
+        ins["BatchId"] = [batch_ids]
+    return emit_op(
+        "deformable_psroi_pooling", ins,
+        {"output_channels": int(oc), "spatial_scale": float(spatial_scale),
+         "pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width),
+         "trans_std": float(trans_std), "no_trans": bool(no_trans)},
+        out_slots=("Output",),
+    )
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    return emit_op(
+        "yolov3_loss",
+        {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]},
+        {"anchors": [float(a) for a in anchors],
+         "anchor_mask": [int(m) for m in anchor_mask],
+         "class_num": int(class_num),
+         "ignore_thresh": float(ignore_thresh),
+         "downsample_ratio": int(downsample_ratio)},
+        out_slots=("Loss",),
+    )
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Fast R-CNN training sampler (reference
+    generate_proposal_labels_op.cc — a CPU-only op there too): runs
+    host-side via py_func with FIXED batch_size_per_im outputs per image.
+    rpn_rois [N, R, 4]; gt_* [N, G, ...] zero/-1 padded.
+    Returns (rois [N, B, 4], labels [N, B], bbox_targets [N, B, 4*C'],
+    inside_w, outside_w) with C' = 1 if is_cls_agnostic else class_nums."""
+    import numpy as np
+
+    from .control_flow import py_func
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("generate_proposal_labels")
+    n, r = rpn_rois.shape[0], rpn_rois.shape[1]
+    b = int(batch_size_per_im)
+    creg = 1 if is_cls_agnostic else int(class_nums)
+
+    def _sample(rois_np, gtc, gtb):
+        rng = np.random.RandomState(0 if not use_random else None)
+        out_rois = np.zeros((n, b, 4), np.float32)
+        out_lbl = np.zeros((n, b), np.int32)
+        out_tgt = np.zeros((n, b, 4 * creg), np.float32)
+        out_in = np.zeros((n, b, 4 * creg), np.float32)
+        for i in range(n):
+            valid_gt = gtc[i] >= 0
+            boxes = np.concatenate([rois_np[i], gtb[i][valid_gt]], axis=0)
+            gtbi = gtb[i][valid_gt]
+            if len(gtbi) == 0:
+                sel = rng.choice(len(boxes), b, replace=len(boxes) < b)
+                out_rois[i] = boxes[sel]
+                continue
+            # IoU
+            x1 = np.maximum(boxes[:, None, 0], gtbi[None, :, 0])
+            y1 = np.maximum(boxes[:, None, 1], gtbi[None, :, 1])
+            x2 = np.minimum(boxes[:, None, 2], gtbi[None, :, 2])
+            y2 = np.minimum(boxes[:, None, 3], gtbi[None, :, 3])
+            inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+            area_b = ((boxes[:, 2] - boxes[:, 0])
+                      * (boxes[:, 3] - boxes[:, 1]))[:, None]
+            area_g = ((gtbi[:, 2] - gtbi[:, 0])
+                      * (gtbi[:, 3] - gtbi[:, 1]))[None, :]
+            iou = inter / np.maximum(area_b + area_g - inter, 1e-10)
+            best = iou.max(axis=1)
+            best_gt = iou.argmax(axis=1)
+            fg = np.where(best >= fg_thresh)[0]
+            bg = np.where((best < bg_thresh_hi) & (best >= bg_thresh_lo))[0]
+            n_fg = min(int(b * fg_fraction), len(fg))
+            n_bg = min(b - n_fg, len(bg))
+            fg_sel = rng.choice(fg, n_fg, replace=False) if n_fg else fg[:0]
+            bg_sel = rng.choice(bg, n_bg, replace=False) if n_bg else bg[:0]
+            sel = np.concatenate([fg_sel, bg_sel])
+            if len(sel) < b:  # pad by repeating backgrounds/foregrounds
+                extra = rng.choice(len(boxes), b - len(sel), replace=True)
+                sel = np.concatenate([sel, extra])
+            out_rois[i] = boxes[sel]
+            lbl = np.zeros(len(sel), np.int32)
+            lbl[: n_fg] = gtc[i][valid_gt][best_gt[fg_sel]] if n_fg else lbl[:0]
+            out_lbl[i] = lbl
+            # bbox targets for fg
+            for j in range(n_fg):
+                bidx = sel[j]
+                g = gtbi[best_gt[bidx]]
+                bx = boxes[bidx]
+                bw = max(bx[2] - bx[0], 1e-6)
+                bh = max(bx[3] - bx[1], 1e-6)
+                gw = max(g[2] - g[0], 1e-6)
+                gh = max(g[3] - g[1], 1e-6)
+                d = np.asarray([
+                    ((g[0] + g[2]) / 2 - (bx[0] + bx[2]) / 2) / bw / bbox_reg_weights[0],
+                    ((g[1] + g[3]) / 2 - (bx[1] + bx[3]) / 2) / bh / bbox_reg_weights[1],
+                    np.log(gw / bw) / bbox_reg_weights[2],
+                    np.log(gh / bh) / bbox_reg_weights[3]], np.float32)
+                cls = 0 if is_cls_agnostic else int(lbl[j])
+                out_tgt[i, j, 4 * cls: 4 * cls + 4] = d
+                out_in[i, j, 4 * cls: 4 * cls + 4] = 1.0
+        return out_rois, out_lbl, out_tgt, out_in, out_in.copy()
+
+    outs = []
+    for dt, shape in [("float32", (n, b, 4)), ("int32", (n, b)),
+                      ("float32", (n, b, 4 * creg)),
+                      ("float32", (n, b, 4 * creg)),
+                      ("float32", (n, b, 4 * creg))]:
+        v = helper.create_variable_for_type_inference(dt)
+        v.shape = shape
+        outs.append(v)
+    py_func(_sample, x=[rpn_rois, gt_classes, gt_boxes], out=outs)
+    return tuple(outs)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask R-CNN mask-target rasterization (reference
+    generate_mask_labels_op.cc) needs polygon->mask rasterization of the
+    gt_segms LoD structure; supply rasterized masks and build targets
+    with roi_align + resize instead."""
+    raise NotImplementedError(
+        "generate_mask_labels: polygon rasterization is host-side in the "
+        "reference; rasterize masks in the data pipeline and use "
+        "roi_align + resize_bilinear to build mask targets"
     )

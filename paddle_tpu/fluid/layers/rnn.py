@@ -111,21 +111,31 @@ class GRUCell(RNNCell):
     def state_shape(self):
         return [self.hidden_size]
 
+    def _sub_attr(self, attr, sub, kind):
+        """User attrs apply to BOTH internal fcs (gate + candidate): keep
+        the user's initializer/settings but suffix the name so the two
+        weights stay distinct."""
+        if attr is None:
+            return ParamAttr(name=f"{self._name}.{sub}.{kind}")
+        a = ParamAttr._to_attr(attr)
+        base = a.name or self._name
+        return ParamAttr(name=f"{base}.{sub}.{kind}", initializer=a.initializer)
+
     def call(self, inputs, states):
         h = states[0] if isinstance(states, (list, tuple)) else states
         concat = _tensor.concat([inputs, h], axis=1)
         gates = _nn.fc(
             concat, 2 * self.hidden_size,
-            param_attr=ParamAttr(name=f"{self._name}.gate.w_0"),
-            bias_attr=ParamAttr(name=f"{self._name}.gate.b_0"),
+            param_attr=self._sub_attr(self._param_attr, "gate", "w_0"),
+            bias_attr=self._sub_attr(self._bias_attr, "gate", "b_0"),
             act="sigmoid",
         )
         r, u = _nn.split(gates, 2, dim=1)
         cand = _nn.fc(
             _tensor.concat([inputs, _nn.elementwise_mul(r, h)], axis=1),
             self.hidden_size,
-            param_attr=ParamAttr(name=f"{self._name}.cand.w_0"),
-            bias_attr=ParamAttr(name=f"{self._name}.cand.b_0"),
+            param_attr=self._sub_attr(self._param_attr, "cand", "w_0"),
+            bias_attr=self._sub_attr(self._bias_attr, "cand", "b_0"),
             act="tanh",
         )
         new_h = _nn.elementwise_add(
@@ -173,7 +183,9 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
     outputs = srnn()
     if time_major:
         outputs = _nn.transpose(outputs, [1, 0, 2])
-    return outputs, states
+    # FINAL states (reference rnn.py contract) — the recurrent op's
+    # FinalStates outputs, already length-masked by the update freeze
+    return outputs, srnn.final_states
 
 
 def birnn_unsupported(*a, **k):  # pragma: no cover
@@ -489,15 +501,9 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers=1,
 
 
 def _rnn_with_final(cell, inputs, states):
-    """rnn() + final states: re-read the last time step."""
-    outputs, _ = rnn(cell, inputs, states)
-    t = outputs.shape[1]
-    last = _nn.reshape(
-        _nn.slice(outputs, axes=[1], starts=[t - 1], ends=[t]),
-        [outputs.shape[0], outputs.shape[2]])
-    # final c is not exposed by rnn(); rebuild h only (c approximated by h
-    # consumers should use dynamic_lstm for exact final cells)
-    return outputs, (last, last)
+    """rnn() now surfaces the true final (h, c) states."""
+    outputs, final = rnn(cell, inputs, states)
+    return outputs, (final[0], final[1])
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,

@@ -24,7 +24,7 @@ __all__ = [
     "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
     "beam_search", "sequence_concat", "sequence_enumerate",
     "sequence_slice", "sequence_scatter", "sequence_reshape",
-    "gather_tree", "lod_reset", "lod_append", "im2sequence_alias",
+    "gather_tree", "lod_reset", "lod_append", "im2sequence_alias", "row_conv",
     "reorder_lod_tensor_by_rank",
 ]
 
@@ -374,8 +374,10 @@ def distributed_embedding(input, table_name, name=None):
 def sequence_concat(input, lengths=None, name=None):
     """Ragged time-axis concat on padded rows (reference
     sequence_concat_op.cc). `lengths`: optional list of [B] per-input
-    valid lengths. Returns the packed [B, sum(Ti), ...] tensor (valid
-    prefixes back-to-back; output lengths = sum of inputs')."""
+    valid lengths. With lengths, returns (packed [B, sum(Ti), ...],
+    out_lengths [B]) — downstream sequence_* layers need the summed
+    lengths explicitly under the padded+mask convention; without lengths
+    (fully valid rows) returns just the tensor, like the reference."""
     helper = LayerHelper("sequence_concat", name=name)
     out = helper.create_variable_for_type_inference(input[0].dtype)
     out_len = helper.create_variable_for_type_inference("int32")
@@ -386,7 +388,7 @@ def sequence_concat(input, lengths=None, name=None):
         ins["Length"] = [_tensor.concat([l for l in lengths], axis=0)]
     helper.append_op(type="sequence_concat", inputs=ins,
                      outputs={"Out": [out], "Length": [out_len]})
-    return out
+    return (out, out_len) if lengths is not None else out
 
 
 def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
@@ -469,3 +471,18 @@ def reorder_lod_tensor_by_rank(x, rank_table):
     from . import nn as _nn
 
     return _nn.gather(x, rank_table)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference layers/nn.py row_conv over
+    row_conv_op.cc): input [B, T, D], filter [future_context+1, D]."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    f = helper.create_parameter(
+        helper.param_attr, shape=[int(future_context_size) + 1, d],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [f]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)

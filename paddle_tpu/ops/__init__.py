@@ -10,6 +10,8 @@ from . import (  # noqa: F401
     compare_ops,
     control_flow_ops,
     creation,
+    detection2_ops,
+    detection3_ops,
     detection_ops,
     encoder_stack,
     manipulation,

@@ -1,0 +1,516 @@
+"""Detection ops, batch 2: anchors, NMS family, assignment, SSD.
+
+Parity surface: reference operators/detection/ — anchor_generator_op.cc,
+density_prior_box_op.cc, box_clip_op.cc, box_decoder_and_assign_op.cc,
+multiclass_nms_op.cc, matrix_nms (2.x), locality_aware_nms_op.cc,
+target_assign_op.cc, polygon_box_transform_op.cc, and
+operators/ctc_align_op.cc (ctc_greedy_decoder backend).
+
+Static-shape contract (XLA): the reference emits LoD outputs whose row
+count depends on the data; here every NMS/assign op returns FIXED-size
+tensors padded with -1 rows (label slot) or zero weights, plus explicit
+valid-count outputs. Suppression loops run over a static keep_top_k.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _iou_matrix(a, b):
+    """[Na, 4] x [Nb, 4] -> [Na, Nb] IoU (xyxy boxes)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+@register("anchor_generator", stop_gradient=True, no_vjp_grad=True)
+def anchor_generator(ctx, ins, attrs):
+    """Dense anchors over the feature map (reference anchor_generator_op.cc):
+    Input [N, C, H, W] -> Anchors [H, W, A, 4] (xyxy, input-image scale),
+    Variances [H, W, A, 4]."""
+    x = ins["Input"][0]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in attrs["stride"]]
+    offset = float(attrs.get("offset", 0.5))
+    h, w = x.shape[2], x.shape[3]
+
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * (r ** 0.5)
+            ah = s / (r ** 0.5)
+            anchors.append((-aw / 2, -ah / 2, aw / 2, ah / 2))
+    base = jnp.asarray(anchors, jnp.float32)  # [A, 4]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+    gx, gy = jnp.meshgrid(cx, cy)  # [H, W]
+    centers = jnp.stack([gx, gy, gx, gy], axis=-1)  # [H, W, 4]
+    out = centers[:, :, None, :] + base[None, None, :, :]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Anchors": [out], "Variances": [var]}
+
+
+@register("density_prior_box", stop_gradient=True, no_vjp_grad=True)
+def density_prior_box(ctx, ins, attrs):
+    """Dense + fixed-size priors (reference density_prior_box_op.cc):
+    fixed_sizes x densities grids per cell."""
+    x, img = ins["Input"][0], ins["Image"][0]
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    densities = [int(d) for d in attrs["densities"]]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    clip = bool(attrs.get("clip", False))
+    h, w = x.shape[2], x.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = float(attrs.get("step_w", 0.0)) or iw / w
+    step_h = float(attrs.get("step_h", 0.0)) or ih / h
+
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            shift = size / density
+            for di in range(density):
+                for dj in range(density):
+                    dx = (dj + 0.5) * shift - size / 2.0
+                    dy = (di + 0.5) * shift - size / 2.0
+                    boxes.append((dx, dy, bw, bh))
+    base = jnp.asarray(boxes, jnp.float32)  # [P, 4] (dx, dy, w, h)
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    gx, gy = jnp.meshgrid(cx, cy)
+    px = gx[:, :, None] + base[None, None, :, 0]
+    py = gy[:, :, None] + base[None, None, :, 1]
+    bw = base[None, None, :, 2]
+    bh = base[None, None, :, 3]
+    out = jnp.stack(
+        [(px - bw / 2) / iw, (py - bh / 2) / ih,
+         (px + bw / 2) / iw, (py + bh / 2) / ih], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register("box_clip")
+def box_clip(ctx, ins, attrs):
+    """Clip boxes to image bounds (reference box_clip_op.cc): Input
+    [N, B, 4], ImInfo [N, 3] (h, w, scale)."""
+    boxes = ins["Input"][0]
+    im_info = ins["ImInfo"][0]
+    h = (im_info[:, 0] / im_info[:, 2])[:, None] - 1.0
+    w = (im_info[:, 1] / im_info[:, 2])[:, None] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0.0, w)
+    y1 = jnp.clip(boxes[..., 1], 0.0, h)
+    x2 = jnp.clip(boxes[..., 2], 0.0, w)
+    y2 = jnp.clip(boxes[..., 3], 0.0, h)
+    return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+
+
+@register("box_decoder_and_assign", stop_gradient=True, no_vjp_grad=True)
+def box_decoder_and_assign(ctx, ins, attrs):
+    """Decode per-class deltas and keep the best-scoring class's box
+    (reference box_decoder_and_assign_op.cc). PriorBox [B, 4],
+    TargetBox [B, C*4], BoxScore [B, C]."""
+    prior = ins["PriorBox"][0]
+    deltas = ins["TargetBox"][0]
+    scores = ins["BoxScore"][0]
+    var = [float(v) for v in attrs.get("box_var", [0.1, 0.1, 0.2, 0.2])]
+    # reference box_clip attr bounds the w/h delta exponent (e.g.
+    # log(1000/16) = 4.135), preventing exp() blowups on wild regressions
+    bclip = float(attrs.get("box_clip", 10.0))
+    b = prior.shape[0]
+    c = scores.shape[1]
+    d = deltas.reshape(b, c, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    cx = var[0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = var[1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    wd = jnp.exp(jnp.minimum(var[2] * d[..., 2], bclip)) * pw[:, None]
+    hd = jnp.exp(jnp.minimum(var[3] * d[..., 3], bclip)) * ph[:, None]
+    # reference +1 size convention: far corners get -1 (x2 = cx + w/2 - 1)
+    decoded = jnp.stack(
+        [cx - wd / 2, cy - hd / 2, cx + wd / 2 - 1.0, cy + hd / 2 - 1.0],
+        axis=-1)
+    best = jnp.argmax(scores, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1).reshape(b, 4)
+    return {"DecodeBox": [decoded.reshape(b, c * 4)],
+            "OutputAssignBox": [assigned]}
+
+
+def _nms_single(boxes, scores, score_threshold, iou_threshold, top_k):
+    """Greedy NMS over one class: returns keep mask [B] (static size)."""
+    valid = scores > score_threshold
+    order_scores = jnp.where(valid, scores, -jnp.inf)
+    n = boxes.shape[0]
+    k = min(top_k, n) if top_k > 0 else n
+    top_scores, order = jax.lax.top_k(order_scores, k)
+    cand = boxes[order]
+    iou = _iou_matrix(cand, cand)
+
+    def body(i, keep):
+        # keep candidate i unless it overlaps an earlier kept candidate
+        sup = jnp.any(
+            (iou[i] > iou_threshold) & keep & (jnp.arange(k) < i))
+        ok = jnp.isfinite(top_scores[i]) & ~sup
+        return keep.at[i].set(ok)
+
+    keep = jnp.zeros((k,), bool)
+    keep = jax.lax.fori_loop(0, k, body, keep)
+    return order, top_scores, keep
+
+
+@register("multiclass_nms", stop_gradient=True, no_vjp_grad=True)
+def multiclass_nms(ctx, ins, attrs):
+    """Per-class greedy NMS (reference multiclass_nms_op.cc).
+
+    BBoxes [N, B, 4], Scores [N, C, B]. Out: FIXED [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2; label = -1 pads), NmsRoisNum [N]."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    st = float(attrs.get("score_threshold", 0.0))
+    it = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    background = int(attrs.get("background_label", 0))
+    n, c = scores.shape[0], scores.shape[1]
+
+    def per_image(boxes, sc):
+        all_scores, all_labels, all_boxes, all_idx = [], [], [], []
+        for cls in range(c):
+            if cls == background:
+                continue
+            order, s, keep = _nms_single(boxes, sc[cls], st, it, nms_top_k)
+            s = jnp.where(keep, s, -jnp.inf)
+            all_scores.append(s)
+            all_labels.append(jnp.full(s.shape, cls, jnp.float32))
+            all_boxes.append(boxes[order])
+            all_idx.append(order.astype(jnp.int32))  # original box rows
+        cat_s = jnp.concatenate(all_scores)
+        cat_l = jnp.concatenate(all_labels)
+        cat_b = jnp.concatenate(all_boxes, axis=0)
+        cat_i = jnp.concatenate(all_idx)
+        k = min(keep_top_k, cat_s.shape[0])
+        top_s, idx = jax.lax.top_k(cat_s, k)
+        valid = jnp.isfinite(top_s)
+        row = jnp.concatenate(
+            [jnp.where(valid, cat_l[idx], -1.0)[:, None],
+             jnp.where(valid, top_s, 0.0)[:, None],
+             cat_b[idx] * valid[:, None]], axis=1)
+        sel = jnp.where(valid, cat_i[idx], -1)
+        pad = keep_top_k - k
+        if pad > 0:
+            row = jnp.concatenate(
+                [row, jnp.tile(jnp.asarray([[-1, 0, 0, 0, 0, 0]], row.dtype),
+                               (pad, 1))], axis=0)
+            sel = jnp.concatenate([sel, jnp.full((pad,), -1, jnp.int32)])
+        return row, sel[:, None], valid.sum().astype(jnp.int32)
+
+    outs, sel_idx, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [outs], "Index": [sel_idx], "NmsRoisNum": [counts]}
+
+
+@register("matrix_nms", stop_gradient=True, no_vjp_grad=True)
+def matrix_nms(ctx, ins, attrs):
+    """Parallel soft-NMS via the decay matrix (reference matrix_nms_op.cc,
+    SOLOv2): no sequential suppression loop — TPU-friendly by design."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    st = float(attrs.get("score_threshold", 0.0))
+    post_threshold = float(attrs.get("post_threshold", 0.0))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    use_gaussian = bool(attrs.get("use_gaussian", False))
+    sigma = float(attrs.get("gaussian_sigma", 2.0))
+    background = int(attrs.get("background_label", 0))
+    n, c = scores.shape[0], scores.shape[1]
+
+    def per_class(boxes, s):
+        k = min(nms_top_k, s.shape[0])
+        top_s, order = jax.lax.top_k(jnp.where(s > st, s, -jnp.inf), k)
+        cand = boxes[order]
+        iou = _iou_matrix(cand, cand)
+        upper = jnp.triu(iou, k=1)  # [i, j]: suppressor i (higher score), j
+        # compensate_i: the suppressor's own worst overlap with anything
+        # scored higher — divides ITS row (matrix_nms_op.cc decay formula)
+        max_iou = jnp.max(upper, axis=0)
+        comp_row = jnp.clip(max_iou, 0.0, 1.0 - 1e-6)[:, None]
+        if use_gaussian:
+            decay = jnp.min(
+                jnp.exp(-(upper ** 2 - comp_row ** 2) / sigma), axis=0)
+        else:
+            comp = jnp.where(upper > 0,
+                             (1.0 - upper) / (1.0 - comp_row), 1.0)
+            decay = jnp.min(comp, axis=0)
+        new_s = jnp.where(jnp.isfinite(top_s), top_s * decay, -jnp.inf)
+        new_s = jnp.where(new_s > post_threshold, new_s, -jnp.inf)
+        return cand, new_s
+
+    def per_image(boxes, sc):
+        all_s, all_l, all_b = [], [], []
+        for cls in range(c):
+            if cls == background:
+                continue
+            cand, s = per_class(boxes, sc[cls])
+            all_s.append(s)
+            all_l.append(jnp.full(s.shape, cls, jnp.float32))
+            all_b.append(cand)
+        cat_s = jnp.concatenate(all_s)
+        cat_l = jnp.concatenate(all_l)
+        cat_b = jnp.concatenate(all_b, axis=0)
+        k = min(keep_top_k, cat_s.shape[0])
+        top_s, idx = jax.lax.top_k(cat_s, k)
+        valid = jnp.isfinite(top_s)
+        row = jnp.concatenate(
+            [jnp.where(valid, cat_l[idx], -1.0)[:, None],
+             jnp.where(valid, top_s, 0.0)[:, None],
+             cat_b[idx] * valid[:, None]], axis=1)
+        pad = keep_top_k - k
+        if pad > 0:
+            row = jnp.concatenate(
+                [row, jnp.tile(jnp.asarray([[-1, 0, 0, 0, 0, 0]], row.dtype),
+                               (pad, 1))], axis=0)
+        return row, valid.sum().astype(jnp.int32)
+
+    outs, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [outs], "RoisNum": [counts]}
+
+
+@register("locality_aware_nms", stop_gradient=True, no_vjp_grad=True)
+def locality_aware_nms(ctx, ins, attrs):
+    """Locality-aware NMS (reference locality_aware_nms_op.cc, EAST OCR):
+    score-weighted merge of consecutive overlapping boxes, then standard
+    NMS. Single-class (C=1) as in the reference."""
+    bboxes = ins["BBoxes"][0]  # [N, B, 4]
+    scores = ins["Scores"][0]  # [N, 1, B]
+    it = float(attrs.get("nms_threshold", 0.3))
+    st = float(attrs.get("score_threshold", 0.0))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+
+    def per_image(boxes, sc):
+        s = sc[0]
+        nb = boxes.shape[0]
+        # locality merge: weight-average each box with its NEXT neighbor
+        # when IoU > threshold (one merge pass, the dense analog of the
+        # reference's sequential scan over geometrically-sorted rows)
+        nxt = jnp.roll(boxes, -1, axis=0)
+        nxt_s = jnp.roll(s, -1)
+        iou = jax.vmap(
+            lambda a, bx: _iou_matrix(a[None], bx[None])[0, 0])(boxes, nxt)
+        do_merge = (iou > it) & (jnp.arange(nb) < nb - 1)
+        wsum = s + nxt_s
+        merged = (boxes * s[:, None] + nxt * nxt_s[:, None]) / jnp.maximum(
+            wsum[:, None], 1e-10)
+        boxes2 = jnp.where(do_merge[:, None], merged, boxes)
+        s2 = jnp.where(do_merge, wsum, s)
+        # NMS over nms_top_k candidates, THEN keep the keep_top_k best
+        order, top_s, keep = _nms_single(boxes2, s2, st, it,
+                                         min(nms_top_k, nb))
+        kept_s = jnp.where(keep & jnp.isfinite(top_s), top_s, -jnp.inf)
+        kk = min(keep_top_k, kept_s.shape[0])
+        fin_s, fin_i = jax.lax.top_k(kept_s, kk)
+        valid = jnp.isfinite(fin_s)
+        row = jnp.concatenate(
+            [jnp.where(valid, 0.0, -1.0)[:, None],
+             jnp.where(valid, fin_s, 0.0)[:, None],
+             boxes2[order][fin_i] * valid[:, None]], axis=1)
+        if kk < keep_top_k:
+            row = jnp.concatenate(
+                [row, jnp.tile(jnp.asarray([[-1, 0, 0, 0, 0, 0]], row.dtype),
+                               (keep_top_k - kk, 1))], axis=0)
+        return row
+
+    return {"Out": [jax.vmap(per_image)(bboxes, scores)]}
+
+
+@register("target_assign", stop_gradient=True, no_vjp_grad=True)
+def target_assign(ctx, ins, attrs):
+    """Assign per-prior targets by match indices (reference
+    target_assign_op.cc): X [N, M, K] (rows to gather), MatchIndices
+    [N, P] (-1 = unmatched -> mismatch_value, weight 0)."""
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)
+    mismatch = attrs.get("mismatch_value", 0)
+    idx = jnp.clip(match, 0, x.shape[1] - 1)
+    out = jnp.take_along_axis(
+        x, idx[:, :, None].repeat(x.shape[2], -1), axis=1)
+    matched = (match >= 0)
+    out = jnp.where(matched[:, :, None], out,
+                    jnp.asarray(mismatch, x.dtype))
+    weight = matched.astype(jnp.float32)[:, :, None]
+    return {"Out": [out], "OutWeight": [weight]}
+
+
+@register("bipartite_match", stop_gradient=True, no_vjp_grad=True)
+def bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (reference bipartite_match_op.cc):
+    DistMat [N, M, P] (rows = ground truth, cols = priors). Outputs
+    ColToRowMatchIndices [N, P] (-1 unmatched) and matched distances.
+    match_type='per_prediction' additionally matches cols whose best row
+    distance exceeds overlap_threshold."""
+    dist = ins["DistMat"][0]
+    match_type = attrs.get("match_type", "bipartite")
+    thr = float(attrs.get("dist_threshold", 0.5))
+    n, m, p = dist.shape
+
+    def one(d):
+        col_match = jnp.full((p,), -1, jnp.int32)
+        col_dist = jnp.zeros((p,), jnp.float32)
+        row_used = jnp.zeros((m,), bool)
+        col_used = jnp.zeros((p,), bool)
+
+        def body(_, carry):
+            col_match, col_dist, row_used, col_used = carry
+            masked = jnp.where(row_used[:, None] | col_used[None, :],
+                               -jnp.inf, d)
+            flat = jnp.argmax(masked)
+            r, c0 = flat // p, flat % p
+            best = masked[r, c0]
+            ok = jnp.isfinite(best)
+            col_match = jnp.where(ok, col_match.at[c0].set(r.astype(jnp.int32)),
+                                  col_match)
+            col_dist = jnp.where(ok, col_dist.at[c0].set(best), col_dist)
+            row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+            col_used = jnp.where(ok, col_used.at[c0].set(True), col_used)
+            return col_match, col_dist, row_used, col_used
+
+        col_match, col_dist, row_used, col_used = jax.lax.fori_loop(
+            0, min(m, p), body, (col_match, col_dist, row_used, col_used))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_d = jnp.max(d, axis=0)
+            extra = (col_match < 0) & (best_d >= thr)
+            col_match = jnp.where(extra, best_row, col_match)
+            col_dist = jnp.where(extra, best_d, col_dist)
+        return col_match, col_dist
+
+    cm, cd = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [cm], "ColToRowMatchDist": [cd]}
+
+
+@register("polygon_box_transform", stop_gradient=True, no_vjp_grad=True)
+def polygon_box_transform(ctx, ins, attrs):
+    """EAST head geometry: offsets -> absolute corner coords (reference
+    polygon_box_transform_op.cc): input [N, 8|K, H, W]; out[c] = 4*j -
+    in[c] for even c (x) and 4*i - in[c] for odd c (y) where in != 0."""
+    x = ins["Input"][0]
+    n, k, h, w = x.shape
+    jj = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    ii = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    is_x = (jnp.arange(k) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, 4.0 * jj, 4.0 * ii)
+    return {"Output": [jnp.where(x != 0, base - x, x)]}
+
+
+@register("ctc_align", stop_gradient=True, no_vjp_grad=True)
+def ctc_align(ctx, ins, attrs):
+    """CTC greedy collapse (reference ctc_align_op.cc): remove repeats
+    then blanks. Input [B, T] ids; output [B, T] left-aligned with
+    `padding_value` tail + OutLength [B]."""
+    x = ins["Input"][0].astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    pad = int(attrs.get("padding_value", 0))
+    b, t = x.shape
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32), x[:, :-1]], axis=1)
+    keep = (x != prev) & (x != blank)
+    if ins.get("InputLength"):
+        ln = ins["InputLength"][0].reshape(-1).astype(jnp.int32)
+        keep = keep & (jnp.arange(t)[None, :] < ln[:, None])
+    # left-align kept ids: a stable argsort on ~keep moves kept positions
+    # to the front in their original order (no dynamic boolean indexing)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    lengths = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(jnp.arange(t)[None, :] < lengths[:, None], gathered, pad)
+    return {"Output": [out], "OutputLength": [lengths]}
+
+
+@register("ssd_loss")
+def ssd_loss(ctx, ins, attrs):
+    """Fused SSD multibox loss (reference python layers/detection.py
+    ssd_loss composition over bipartite_match/target_assign/box_coder +
+    mine_hard_examples): one XLA program, differentiable w.r.t. Location
+    and Confidence (matching decisions are piecewise-constant).
+
+    Location [N,P,4], Confidence [N,P,C], GtBox [N,G,4],
+    GtLabel [N,G] (-1 pads), PriorBox [P,4] -> Loss [N,1]."""
+    loc = ins["Location"][0]
+    conf = ins["Confidence"][0]
+    gt_box = ins["GtBox"][0]
+    gt_label = ins["GtLabel"][0].astype(jnp.int32)
+    prior = ins["PriorBox"][0]
+    if ins.get("PriorBoxVar"):
+        var = ins["PriorBoxVar"][0]
+    else:
+        var = jnp.asarray(
+            attrs.get("box_var") or [0.1, 0.1, 0.2, 0.2], jnp.float32)[None, :]
+    bg = int(attrs.get("background_label", 0))
+    thr = float(attrs.get("overlap_threshold", 0.5))
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    lw = float(attrs.get("loc_loss_weight", 1.0))
+    cw = float(attrs.get("conf_loss_weight", 1.0))
+    normalize = bool(attrs.get("normalize", True))
+    n, p = loc.shape[0], loc.shape[1]
+
+    def one(loc_i, conf_i, gtb, gtl):
+        valid_gt = gtl >= 0
+        iou = _iou_matrix(gtb, prior)  # [G, P]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0)            # [P]
+        best_iou = jnp.max(iou, axis=0)
+        matched = best_iou >= thr                    # per_prediction match
+        tgt_box = gtb[best_gt]                       # [P, 4]
+        tgt_lbl = jnp.where(matched, gtl[best_gt], bg)
+        # encode matched gt against priors (box_coder encode_center_size)
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        tw = tgt_box[:, 2] - tgt_box[:, 0]
+        th = tgt_box[:, 3] - tgt_box[:, 1]
+        tcx = tgt_box[:, 0] + tw * 0.5
+        tcy = tgt_box[:, 1] + th * 0.5
+        v = jnp.broadcast_to(var, (p, 4))
+        enc = jnp.stack([
+            (tcx - pcx) / jnp.maximum(pw, 1e-10) / v[:, 0],
+            (tcy - pcy) / jnp.maximum(ph, 1e-10) / v[:, 1],
+            jnp.log(jnp.maximum(tw / jnp.maximum(pw, 1e-10), 1e-10)) / v[:, 2],
+            jnp.log(jnp.maximum(th / jnp.maximum(ph, 1e-10), 1e-10)) / v[:, 3],
+        ], axis=1)
+        d = loc_i - enc
+        ad = jnp.abs(d)
+        smooth = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(axis=1)
+        posf = matched.astype(jnp.float32)
+        loc_l = (smooth * posf).sum()
+        # softmax CE per prior
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_lbl[:, None], axis=1)[:, 0]
+        n_pos = posf.sum()
+        neg_ce = jnp.where(matched, -jnp.inf, ce)
+        k = neg_ce.shape[0]
+        top_neg, _ = jax.lax.top_k(neg_ce, k)
+        keep = jnp.arange(k) < jnp.minimum(ratio * n_pos, k)
+        neg_l = jnp.where(keep & jnp.isfinite(top_neg), top_neg, 0.0).sum()
+        pos_l = (ce * posf).sum()
+        total = lw * loc_l + cw * (pos_l + neg_l)
+        if normalize:
+            total = total / jnp.maximum(n_pos, 1.0)
+        return total
+
+    loss = jax.vmap(one)(loc, conf, gt_box, gt_label)
+    return {"Loss": [loss[:, None]]}

@@ -1,0 +1,582 @@
+"""Detection ops, batch 3: RPN/FPN proposals, ROI extractors, YOLOv3.
+
+Parity surface: reference operators/detection/ — generate_proposals_op.cc,
+rpn_target_assign_op.cc, retinanet_target_assign (same file),
+retinanet_detection_output_op.cc, collect_fpn_proposals_op.cc,
+distribute_fpn_proposals_op.cc, prroi_pool_op.cc, psroi_pool_op.cc,
+roi_perspective_transform_op.cc, deformable_conv_op.cc,
+deformable_psroi_pooling_op.cc, yolov3_loss_op.cc.
+
+Static-shape contract: proposal/assignment outputs are FIXED-size and
+padded (scores -inf / weights 0), with valid-count side outputs, mirroring
+detection2_ops. Random subsampling (RPN) draws from the op-context PRNG
+via salted keys, so retracing under vjp sees the same sample.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .detection2_ops import _iou_matrix, _nms_single
+from .registry import register
+
+
+def _decode_deltas(anchors, deltas, variances=None):
+    """anchors [A,4] xyxy + deltas [A,4] -> boxes [A,4] (RPN convention)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    if variances is not None:
+        deltas = deltas * variances
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+    return jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=1)
+
+
+@register("generate_proposals", stop_gradient=True, no_vjp_grad=True)
+def generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference generate_proposals_op.cc):
+    Scores [N, A, H, W], BboxDeltas [N, 4A, H, W], Anchors [H, W, A, 4],
+    ImInfo [N, 3]. Out: RpnRois [N, post_nms_topN, 4] (zero-padded),
+    RpnRoiProbs [N, post_nms_topN, 1], RpnRoisNum [N]."""
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4) if ins.get("Variances") else None
+    im_info = ins["ImInfo"][0]
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.0))
+    n = scores.shape[0]
+    a = scores.shape[1]
+
+    def one(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = dl.reshape(a, 4, *dl.shape[1:]).transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = _decode_deltas(anchors, d, variances)
+        h_img = info[0] / info[2]
+        w_img = info[1] / info[2]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, w_img - 1),
+            jnp.clip(boxes[:, 1], 0, h_img - 1),
+            jnp.clip(boxes[:, 2], 0, w_img - 1),
+            jnp.clip(boxes[:, 3], 0, h_img - 1)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        ok = (ws >= min_size) & (hs >= min_size)
+        s = jnp.where(ok, s, -jnp.inf)
+        k = min(pre_n, s.shape[0])
+        top_s, order = jax.lax.top_k(s, k)
+        cand = boxes[order]
+        iou = _iou_matrix(cand, cand)
+
+        def body(i, keep):
+            sup = jnp.any((iou[i] > nms_thresh) & keep & (jnp.arange(k) < i))
+            return keep.at[i].set(jnp.isfinite(top_s[i]) & ~sup)
+
+        keep = jax.lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+        kept_s = jnp.where(keep, top_s, -jnp.inf)
+        kk = min(post_n, k)
+        fin_s, fin_i = jax.lax.top_k(kept_s, kk)
+        rois = cand[fin_i] * jnp.isfinite(fin_s)[:, None]
+        probs = jnp.where(jnp.isfinite(fin_s), fin_s, 0.0)[:, None]
+        pad = post_n - kk
+        if pad > 0:
+            rois = jnp.concatenate([rois, jnp.zeros((pad, 4))], axis=0)
+            probs = jnp.concatenate([probs, jnp.zeros((pad, 1))], axis=0)
+        return rois, probs, jnp.isfinite(fin_s).sum().astype(jnp.int32)
+
+    rois, probs, counts = jax.vmap(one)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs], "RpnRoisNum": [counts]}
+
+
+@register("rpn_target_assign", stop_gradient=True, no_vjp_grad=True)
+def rpn_target_assign(ctx, ins, attrs):
+    """RPN training targets (reference rpn_target_assign_op.cc), dense:
+    Anchor [A, 4], GtBoxes [N, G, 4] (zero pads), ImInfo [N, 3].
+    Outputs per anchor: Label [N, A] (1 fg / 0 bg / -1 ignore after
+    subsampling), LocTarget [N, A, 4], LocWeight/ScoreWeight masks.
+    Subsampling keeps rpn_batch_size_per_im anchors at fg_fraction."""
+    anchor = ins["Anchor"][0]
+    gt = ins["GtBoxes"][0]
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    a = anchor.shape[0]
+    key = ctx.salted_rng(int(attrs.get("rng_salt", 17)))
+
+    def one(gtb, k):
+        valid_gt = (jnp.abs(gtb).sum(axis=1) > 0)
+        iou = _iou_matrix(gtb, anchor)                  # [G, A]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best_per_anchor = jnp.max(iou, axis=0)
+        best_gt = jnp.argmax(iou, axis=0)
+        # anchors that are the argmax for some gt are positive too
+        best_per_gt = jnp.max(iou, axis=1, keepdims=True)
+        forced = jnp.any((iou == best_per_gt) & (best_per_gt > 0), axis=0)
+        pos = (best_per_anchor >= pos_thr) | forced
+        neg = (best_per_anchor < neg_thr) & ~pos
+        # random subsample to the batch budget
+        r = jax.random.uniform(k, (a,))
+        n_fg = int(batch * fg_frac)
+        fg_score = jnp.where(pos, r, -jnp.inf)
+        _, fg_idx = jax.lax.top_k(fg_score, min(n_fg, a))
+        fg_keep = jnp.zeros((a,), bool).at[fg_idx].set(True) & pos
+        n_bg = batch - n_fg
+        bg_score = jnp.where(neg, r, -jnp.inf)
+        _, bg_idx = jax.lax.top_k(bg_score, min(n_bg, a))
+        bg_keep = jnp.zeros((a,), bool).at[bg_idx].set(True) & neg
+        label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        tgt = gtb[best_gt]
+        aw = anchor[:, 2] - anchor[:, 0] + 1.0
+        ah = anchor[:, 3] - anchor[:, 1] + 1.0
+        acx = anchor[:, 0] + aw * 0.5
+        acy = anchor[:, 1] + ah * 0.5
+        tw = tgt[:, 2] - tgt[:, 0] + 1.0
+        th = tgt[:, 3] - tgt[:, 1] + 1.0
+        tcx = tgt[:, 0] + tw * 0.5
+        tcy = tgt[:, 1] + th * 0.5
+        loc = jnp.stack([
+            (tcx - acx) / aw, (tcy - acy) / ah,
+            jnp.log(jnp.maximum(tw / aw, 1e-10)),
+            jnp.log(jnp.maximum(th / ah, 1e-10))], axis=1)
+        return (label.astype(jnp.int32), loc,
+                fg_keep.astype(jnp.float32)[:, None],
+                (fg_keep | bg_keep).astype(jnp.float32)[:, None])
+
+    n = gt.shape[0]
+    keys = jax.random.split(key, n)
+    label, loc, locw, scorew = jax.vmap(one)(gt, keys)
+    return {"Label": [label], "LocTarget": [loc],
+            "LocWeight": [locw], "ScoreWeight": [scorew]}
+
+
+@register("retinanet_target_assign", stop_gradient=True, no_vjp_grad=True)
+def retinanet_target_assign(ctx, ins, attrs):
+    """RetinaNet targets (reference retinanet flavor of
+    rpn_target_assign_op.cc): NO subsampling (focal loss uses all), pos
+    iou >= positive_overlap, neg < negative_overlap, rest ignored; also
+    emits per-anchor class labels and the foreground count."""
+    anchor = ins["Anchor"][0]
+    gt = ins["GtBoxes"][0]
+    gt_labels = ins["GtLabels"][0].astype(jnp.int32)
+    pos_thr = float(attrs.get("positive_overlap", 0.5))
+    neg_thr = float(attrs.get("negative_overlap", 0.4))
+
+    def one(gtb, gtl):
+        valid_gt = gtl > 0
+        iou = _iou_matrix(gtb, anchor)
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best = jnp.max(iou, axis=0)
+        best_gt = jnp.argmax(iou, axis=0)
+        best_per_gt = jnp.max(iou, axis=1, keepdims=True)
+        forced = jnp.any((iou == best_per_gt) & (best_per_gt > 0), axis=0)
+        pos = (best >= pos_thr) | forced
+        neg = (best < neg_thr) & ~pos
+        cls = jnp.where(pos, gtl[best_gt], 0)
+        label = jnp.where(pos, 1, jnp.where(neg, 0, -1))
+        tgt = gtb[best_gt]
+        aw = anchor[:, 2] - anchor[:, 0] + 1.0
+        ah = anchor[:, 3] - anchor[:, 1] + 1.0
+        loc = jnp.stack([
+            (tgt[:, 0] + (tgt[:, 2] - tgt[:, 0]) / 2
+             - anchor[:, 0] - aw / 2) / aw,
+            (tgt[:, 1] + (tgt[:, 3] - tgt[:, 1]) / 2
+             - anchor[:, 1] - ah / 2) / ah,
+            jnp.log(jnp.maximum((tgt[:, 2] - tgt[:, 0] + 1.0) / aw, 1e-10)),
+            jnp.log(jnp.maximum((tgt[:, 3] - tgt[:, 1] + 1.0) / ah, 1e-10)),
+        ], axis=1)
+        return (label.astype(jnp.int32), cls, loc,
+                pos.astype(jnp.float32)[:, None],
+                pos.sum().astype(jnp.int32))
+
+    label, cls, loc, locw, fg = jax.vmap(one)(gt, gt_labels)
+    return {"Label": [label], "ClsLabel": [cls], "LocTarget": [loc],
+            "LocWeight": [locw], "ForegroundNumber": [fg]}
+
+
+@register("collect_fpn_proposals", stop_gradient=True, no_vjp_grad=True)
+def collect_fpn_proposals(ctx, ins, attrs):
+    """Merge per-level proposals by score (reference
+    collect_fpn_proposals_op.cc): MultiLevelRois (list of [N, Ri, 4]),
+    MultiLevelScores (list of [N, Ri, 1]) -> FpnRois [N, post_nms_topN, 4]."""
+    rois = jnp.concatenate(ins["MultiLevelRois"], axis=1)
+    scores = jnp.concatenate(ins["MultiLevelScores"], axis=1)[..., 0]
+    post_n = int(attrs.get("post_nms_topN", 1000))
+
+    def one(r, s):
+        k = min(post_n, s.shape[0])
+        top_s, idx = jax.lax.top_k(s, k)
+        out = r[idx]
+        if k < post_n:
+            out = jnp.concatenate([out, jnp.zeros((post_n - k, 4))], axis=0)
+        return out, (top_s > 0).sum().astype(jnp.int32)
+
+    out, counts = jax.vmap(one)(rois, scores)
+    return {"FpnRois": [out], "RoisNum": [counts]}
+
+
+@register("distribute_fpn_proposals", stop_gradient=True, no_vjp_grad=True)
+def distribute_fpn_proposals(ctx, ins, attrs):
+    """Route each ROI to its FPN level by scale (reference
+    distribute_fpn_proposals_op.cc): level = floor(log2(sqrt(area) /
+    refer_scale)) + refer_level, clamped. Dense: each level output is
+    ROI-count sized with non-member rows zeroed (Mask i), plus
+    RestoreIndex mapping."""
+    rois = ins["FpnRois"][0]  # [R, 4]
+    min_level = int(attrs["min_level"])
+    max_level = int(attrs["max_level"])
+    refer_level = int(attrs["refer_level"])
+    refer_scale = float(attrs["refer_scale"])
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(jnp.log2(jnp.maximum(scale, 1e-6) / refer_scale + 1e-12)) \
+        + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, masks = [], []
+    for level in range(min_level, max_level + 1):
+        m = (lvl == level).astype(rois.dtype)
+        outs.append(rois * m[:, None])
+        masks.append(m)
+    restore = jnp.argsort(
+        jnp.argsort(lvl * rois.shape[0] + jnp.arange(rois.shape[0])))
+    return {"MultiFpnRois": outs,
+            "LevelMask": [jnp.stack(masks, axis=0)],
+            "RestoreIndex": [restore[:, None].astype(jnp.int32)]}
+
+
+def _bilinear_at(img, ys, xs):
+    """img [C, H, W]; ys/xs [...]: bilinear samples [C, ...] (0 outside)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+
+    def tap(yi, xi):
+        ok = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        v = img[:, yc, xc]
+        return v * ok.astype(img.dtype)
+
+    wy = (ys - y0).astype(img.dtype)
+    wx = (xs - x0).astype(img.dtype)
+    return (tap(y0, x0) * (1 - wy) * (1 - wx)
+            + tap(y0, x0 + 1) * (1 - wy) * wx
+            + tap(y0 + 1, x0) * wy * (1 - wx)
+            + tap(y0 + 1, x0 + 1) * wy * wx)
+
+
+@register("prroi_pool")
+def prroi_pool(ctx, ins, attrs):
+    """Precise ROI pooling (reference prroi_pool_op.cc): the exact
+    bilinear integral is approximated by a dense 4x4 sample grid per bin
+    (converges to the integral; fully differentiable)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    samples = 4
+    batch_ids = (ins["BatchId"][0].astype(jnp.int32).reshape(-1)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+
+    def one(roi, bid):
+        x1, y1, x2, y2 = roi * scale
+        bh = jnp.maximum(y2 - y1, 1e-6) / ph
+        bw = jnp.maximum(x2 - x1, 1e-6) / pw
+        iy = (jnp.arange(ph * samples) + 0.5) / samples  # in bin units
+        ix = (jnp.arange(pw * samples) + 0.5) / samples
+        ys = y1 + iy * bh
+        xs = x1 + ix * bw
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        vals = _bilinear_at(x[bid], gy, gx)  # [C, ph*s, pw*s]
+        c = vals.shape[0]
+        vals = vals.reshape(c, ph, samples, pw, samples)
+        return vals.mean(axis=(2, 4))
+
+    return {"Out": [jax.vmap(one)(rois, batch_ids)]}
+
+
+@register("psroi_pool")
+def psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI pooling (reference psroi_pool_op.cc):
+    input channels C = output_channels * ph * pw; bin (i, j) of output
+    channel k averages input channel k*ph*pw + i*pw + j over the bin."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    oc = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    samples = 2
+    batch_ids = (ins["BatchId"][0].astype(jnp.int32).reshape(-1)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+
+    def one2(roi, bid):
+        x1, y1, x2, y2 = roi * scale
+        bh = jnp.maximum(y2 - y1, 0.1) / ph
+        bw = jnp.maximum(x2 - x1, 0.1) / pw
+        img = x[bid].reshape(oc, ph * pw, x.shape[2], x.shape[3])
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                iy = y1 + (i + (jnp.arange(samples) + 0.5) / samples) * bh
+                ix = x1 + (j + (jnp.arange(samples) + 0.5) / samples) * bw
+                gy, gx = jnp.meshgrid(iy, ix, indexing="ij")
+                v = _bilinear_at(img[:, i * pw + j], gy, gx)  # [oc, s, s]
+                outs.append(v.mean(axis=(1, 2)))
+        return jnp.stack(outs, axis=1).reshape(oc, ph, pw)
+
+    return {"Out": [jax.vmap(one2)(rois, batch_ids)]}
+
+
+@register("roi_perspective_transform")
+def roi_perspective_transform(ctx, ins, attrs):
+    """Warp quadrilateral ROIs to a fixed rectangle (reference
+    roi_perspective_transform_op.cc): per-ROI homography solved from the
+    4 corners, then bilinear sampling."""
+    x, rois = ins["X"][0], ins["ROIs"][0]  # rois [R, 8]: 4 (x, y) corners
+    th = int(attrs.get("transformed_height", 1))
+    tw = int(attrs.get("transformed_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    batch_ids = (ins["BatchId"][0].astype(jnp.int32).reshape(-1)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+
+    def one(quad, bid):
+        src = quad.reshape(4, 2) * scale  # (x, y) corners: tl, tr, br, bl
+        dst = jnp.asarray(
+            [[0, 0], [tw - 1, 0], [tw - 1, th - 1], [0, th - 1]], jnp.float32)
+        # solve homography dst -> src (8 unknowns)
+        rows = []
+        rhs = []
+        for i in range(4):
+            dx, dy = dst[i, 0], dst[i, 1]
+            sx, sy = src[i, 0], src[i, 1]
+            rows.append(jnp.stack([dx, dy, jnp.asarray(1.0), jnp.asarray(0.0),
+                                   jnp.asarray(0.0), jnp.asarray(0.0),
+                                   -dx * sx, -dy * sx]))
+            rhs.append(sx)
+            rows.append(jnp.stack([jnp.asarray(0.0), jnp.asarray(0.0),
+                                   jnp.asarray(0.0), dx, dy, jnp.asarray(1.0),
+                                   -dx * sy, -dy * sy]))
+            rhs.append(sy)
+        A = jnp.stack(rows)
+        bvec = jnp.stack(rhs)
+        hcoef = jnp.linalg.solve(A + 1e-8 * jnp.eye(8), bvec)
+        hmat = jnp.concatenate([hcoef, jnp.ones((1,))]).reshape(3, 3)
+        gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+        warped = hmat @ pts
+        sx = warped[0] / jnp.maximum(warped[2], 1e-8)
+        sy = warped[1] / jnp.maximum(warped[2], 1e-8)
+        vals = _bilinear_at(x[bid], sy.reshape(th, tw), sx.reshape(th, tw))
+        return vals
+
+    return {"Out": [jax.vmap(one)(rois, batch_ids)]}
+
+
+@register("deformable_conv")
+def deformable_conv(ctx, ins, attrs):
+    """Deformable convolution v1/v2 (reference deformable_conv_op.cc):
+    Offset [N, 2*dg*kh*kw, Ho, Wo] shifts each kernel tap's sampling
+    point; optional Mask (v2) modulates each tap. Implemented as bilinear
+    gather into an im2col tensor + a dense matmul — the MXU-friendly
+    lowering of the CUDA kernel's per-tap sampling."""
+    x = ins["Input"][0]
+    offset = ins["Offset"][0]
+    w = ins["Filter"][0]  # [Co, C/g, kh, kw]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    groups = int(attrs.get("groups", 1) or 1)
+    dg = int(attrs.get("deformable_groups", 1) or 1)
+    if groups != 1 or dg != 1:
+        raise NotImplementedError("deformable_conv: groups/deformable_groups > 1")
+    n, c, h, wdt = x.shape
+    co, _, kh, kw = w.shape
+    ho = (h + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) // strides[0] + 1
+    wo = (wdt + 2 * paddings[1] - dilations[1] * (kw - 1) - 1) // strides[1] + 1
+
+    base_y = (jnp.arange(ho) * strides[0] - paddings[0])[:, None]
+    base_x = (jnp.arange(wo) * strides[1] - paddings[1])[None, :]
+
+    def one(img, off, m):
+        cols = []
+        for ki in range(kh):
+            for kj in range(kw):
+                t = 2 * (ki * kw + kj)
+                oy = off[t]      # [Ho, Wo]
+                ox = off[t + 1]
+                ys = base_y + ki * dilations[0] + oy
+                xs = base_x + kj * dilations[1] + ox
+                v = _bilinear_at(img, ys, xs)  # [C, Ho, Wo]
+                if m is not None:
+                    v = v * m[ki * kw + kj][None]
+                cols.append(v)
+        col = jnp.stack(cols, axis=1)  # [C, K, Ho, Wo]
+        col = col.reshape(c * kh * kw, ho * wo)
+        wk = w.transpose(0, 2, 3, 1).reshape(co, kh * kw * c)
+        # reorder col to (k-major, c-minor) to match wk layout
+        col2 = col.reshape(c, kh * kw, ho * wo).transpose(1, 0, 2).reshape(
+            kh * kw * c, ho * wo)
+        return (wk @ col2).reshape(co, ho, wo)
+
+    if mask is None:
+        out = jax.vmap(lambda img, off: one(img, off, None))(x, offset)
+    else:
+        out = jax.vmap(one)(x, offset, mask)
+    return {"Output": [out]}
+
+
+@register("deformable_psroi_pooling")
+def deformable_psroi_pooling(ctx, ins, attrs):
+    """Deformable PS-ROI pooling (reference
+    deformable_psroi_pooling_op.cc): psroi_pool with learned per-bin
+    offsets (Trans [R, 2, ph, pw] scaled by trans_std)."""
+    x, rois = ins["Input"][0], ins["ROIs"][0]
+    trans = ins["Trans"][0] if ins.get("Trans") else None
+    oc = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    no_trans = bool(attrs.get("no_trans", False))
+    samples = 2
+    batch_ids = (ins["BatchId"][0].astype(jnp.int32).reshape(-1)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+
+    def one(roi, bid, tr):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        img = x[bid].reshape(oc, ph * pw, x.shape[2], x.shape[3])
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                dy = 0.0 if (no_trans or tr is None) else tr[0, i, j] * trans_std * rh
+                dx = 0.0 if (no_trans or tr is None) else tr[1, i, j] * trans_std * rw
+                iy = y1 + (i + (jnp.arange(samples) + 0.5) / samples) * bh + dy
+                ix = x1 + (j + (jnp.arange(samples) + 0.5) / samples) * bw + dx
+                gy, gx = jnp.meshgrid(iy, ix, indexing="ij")
+                v = _bilinear_at(img[:, i * pw + j], gy, gx)
+                outs.append(v.mean(axis=(1, 2)))
+        return jnp.stack(outs, axis=1).reshape(oc, ph, pw)
+
+    if trans is None:
+        out = jax.vmap(lambda r, b: one(r, b, None))(rois, batch_ids)
+    else:
+        out = jax.vmap(one)(rois, batch_ids, trans)
+    return {"Output": [out]}
+
+
+@register("yolov3_loss")
+def yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 training loss (reference yolov3_loss_op.cc): per-cell
+    objectness + class + box losses against anchor-matched ground truth,
+    with an ignore mask for predictions whose best gt IoU exceeds
+    ignore_thresh. X [N, A*(5+C), H, W]; GTBox [N, G, 4] (cx, cy, w, h,
+    normalized), GTLabel [N, G]."""
+    x = ins["X"][0]
+    gt_box = ins["GTBox"][0]
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)
+    anchors = [float(v) for v in attrs["anchors"]]
+    anchor_mask = [int(v) for v in attrs.get("anchor_mask",
+                                             list(range(len(anchors) // 2)))]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    n, _, h, w = x.shape
+    na = len(anchor_mask)
+    input_size = downsample * h
+    an_w = jnp.asarray([anchors[2 * m] for m in anchor_mask], jnp.float32)
+    an_h = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask], jnp.float32)
+
+    pred = x.reshape(n, na, 5 + class_num, h, w)
+    tx, ty = pred[:, :, 0], pred[:, :, 1]
+    tw_p, th_p = pred[:, :, 2], pred[:, :, 3]
+    tobj = pred[:, :, 4]
+    tcls = pred[:, :, 5:]
+
+    gx = (jax.nn.sigmoid(tx) + jnp.arange(w)[None, None, None, :]) / w
+    gy = (jax.nn.sigmoid(ty) + jnp.arange(h)[None, None, :, None]) / h
+    gw = jnp.exp(jnp.clip(tw_p, -10, 10)) * an_w[None, :, None, None] / input_size
+    gh = jnp.exp(jnp.clip(th_p, -10, 10)) * an_h[None, :, None, None] / input_size
+
+    def one(gxb, gyb, gwb, ghb, tob, tcb, txb, tyb, twb, thb, gtb, gtl):
+        valid = gtl >= 0
+        # pred boxes [A*H*W, 4] xyxy; gt boxes xyxy
+        px1 = (gxb - gwb / 2).reshape(-1)
+        py1 = (gyb - ghb / 2).reshape(-1)
+        px2 = (gxb + gwb / 2).reshape(-1)
+        py2 = (gyb + ghb / 2).reshape(-1)
+        pbox = jnp.stack([px1, py1, px2, py2], axis=1)
+        gx1 = gtb[:, 0] - gtb[:, 2] / 2
+        gy1 = gtb[:, 1] - gtb[:, 3] / 2
+        gx2 = gtb[:, 0] + gtb[:, 2] / 2
+        gy2 = gtb[:, 1] + gtb[:, 3] / 2
+        gbox = jnp.stack([gx1, gy1, gx2, gy2], axis=1)
+        iou = _iou_matrix(pbox, gbox)  # [AHW, G]
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1).reshape(na, h, w)
+        noobj_mask = (best < ignore_thresh).astype(jnp.float32)
+
+        # gt assignment: responsible cell + best anchor by wh IoU
+        gi = jnp.clip((gtb[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gtb[:, 1] * h).astype(jnp.int32), 0, h - 1)
+        gw_abs = gtb[:, 2] * input_size
+        gh_abs = gtb[:, 3] * input_size
+        inter = (jnp.minimum(gw_abs[:, None], an_w[None, :])
+                 * jnp.minimum(gh_abs[:, None], an_h[None, :]))
+        union = (gw_abs * gh_abs)[:, None] + (an_w * an_h)[None, :] - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+
+        obj_tgt = jnp.zeros((na, h, w))
+        loss = 0.0
+        g = gtb.shape[0]
+        scale_f = 2.0 - gtb[:, 2] * gtb[:, 3]  # small-box upweight
+        for k in range(g):
+            a_k, j_k, i_k = best_a[k], gj[k], gi[k]
+            v = valid[k].astype(jnp.float32)
+            sf = scale_f[k]
+            tx_t = gtb[k, 0] * w - i_k
+            ty_t = gtb[k, 1] * h - j_k
+            tw_t = jnp.log(jnp.maximum(
+                gw_abs[k] / an_w[a_k], 1e-10))
+            th_t = jnp.log(jnp.maximum(gh_abs[k] / an_h[a_k], 1e-10))
+            px = jax.nn.sigmoid(txb[a_k, j_k, i_k])
+            py_ = jax.nn.sigmoid(tyb[a_k, j_k, i_k])
+            loss += v * sf * ((px - tx_t) ** 2 + (py_ - ty_t) ** 2)
+            loss += v * sf * ((twb[a_k, j_k, i_k] - tw_t) ** 2
+                              + (thb[a_k, j_k, i_k] - th_t) ** 2)
+            # class loss (BCE over classes)
+            cls_logit = tcb[:, a_k, j_k, i_k]
+            cls_tgt = jax.nn.one_hot(gtl[k], class_num)
+            bce = jnp.maximum(cls_logit, 0) - cls_logit * cls_tgt + \
+                jnp.log1p(jnp.exp(-jnp.abs(cls_logit)))
+            loss += v * bce.sum()
+            obj_tgt = obj_tgt.at[a_k, j_k, i_k].max(v)
+        # objectness BCE: positives at assigned cells, negatives elsewhere
+        obj_bce = jnp.maximum(tob, 0) - tob * obj_tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(tob)))
+        loss += (obj_bce * obj_tgt).sum()
+        loss += (obj_bce * (1 - obj_tgt) * noobj_mask).sum()
+        return loss
+
+    loss = jax.vmap(one)(gx, gy, gw, gh, tobj,
+                         tcls.transpose(0, 2, 1, 3, 4), tx, ty, tw_p, th_p,
+                         gt_box, gt_label)
+    return {"Loss": [loss]}

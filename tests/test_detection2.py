@@ -1,0 +1,512 @@
+"""Detection batch 2 (ops/detection2_ops.py + layers/detection.py):
+numpy oracles for the static-shape NMS/assignment contracts."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build, feeds=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    if not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds or {}, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def test_anchor_generator_oracle():
+    def build():
+        x = fluid.data("x", [1, 8, 2, 2], "float32")
+        return layers.anchor_generator(
+            x, anchor_sizes=[32], aspect_ratios=[1.0], stride=[16, 16])
+
+    a, v = _run(build, {"x": np.zeros((1, 8, 2, 2), "f4")})
+    assert a.shape == (2, 2, 1, 4) and v.shape == a.shape
+    # cell (0,0): center (8, 8), size 32 -> [-8, -8, 24, 24]
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24])
+    np.testing.assert_allclose(a[1, 1, 0], [8, 8, 40, 40])
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_density_prior_box_shapes_and_range():
+    def build():
+        x = fluid.data("x", [1, 4, 4, 4], "float32")
+        img = fluid.data("img", [1, 3, 32, 32], "float32")
+        return layers.density_prior_box(
+            x, img, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0],
+            clip=True)
+
+    b, v = _run(build, {"x": np.zeros((1, 4, 4, 4), "f4"),
+                        "img": np.zeros((1, 3, 32, 32), "f4")})
+    assert b.shape == (4, 4, 4, 4)  # H, W, P=density^2, 4
+    assert b.min() >= 0 and b.max() <= 1
+
+
+def test_box_clip_oracle():
+    boxes = np.asarray([[[-5, -5, 50, 50], [10, 10, 20, 20]]], "f4")
+    im_info = np.asarray([[40, 40, 1.0]], "f4")
+
+    def build():
+        bx = fluid.data("bx", [1, 2, 4], "float32")
+        ii = fluid.data("ii", [1, 3], "float32")
+        return layers.box_clip(bx, ii)
+
+    (out,) = _run(build, {"bx": boxes, "ii": im_info})
+    np.testing.assert_allclose(out[0, 0], [0, 0, 39, 39])
+    np.testing.assert_allclose(out[0, 1], [10, 10, 20, 20])
+
+
+def test_multiclass_nms_suppression_and_padding():
+    # 3 boxes: 0 and 1 overlap heavily (keep the higher score), 2 is far
+    bboxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60]]], "f4")
+    # class 0 = background; class 1 scores
+    scores = np.zeros((1, 2, 3), "f4")
+    scores[0, 1] = [0.9, 0.8, 0.7]
+
+    def build():
+        bx = fluid.data("bx", [1, 3, 4], "float32")
+        sc = fluid.data("sc", [1, 2, 3], "float32")
+        return layers.multiclass_nms(bx, sc, score_threshold=0.1,
+                                     nms_top_k=3, keep_top_k=3,
+                                     nms_threshold=0.5, rois_num=True)
+
+    out, counts = _run(build, {"bx": bboxes, "sc": scores})
+    assert out.shape == (1, 3, 6)
+    assert int(counts[0]) == 2
+    # kept: score 0.9 box 0 and score 0.7 box 2; padded row label -1
+    np.testing.assert_allclose(out[0, 0, :2], [1, 0.9], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 2:], [0, 0, 10, 10], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1, :2], [1, 0.7], rtol=1e-5)
+    assert out[0, 2, 0] == -1
+
+
+def test_matrix_nms_decays_overlaps():
+    bboxes = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 10],
+                          [50, 50, 60, 60]]], "f4")
+    scores = np.zeros((1, 2, 3), "f4")
+    scores[0, 1] = [0.9, 0.8, 0.7]
+
+    def build():
+        bx = fluid.data("bx", [1, 3, 4], "float32")
+        sc = fluid.data("sc", [1, 2, 3], "float32")
+        return layers.matrix_nms(bx, sc, score_threshold=0.1,
+                                 post_threshold=0.0, nms_top_k=3,
+                                 keep_top_k=3)
+
+    out, counts = _run(build, {"bx": bboxes, "sc": scores})
+    got = {round(float(s), 5) for s in out[0, :, 1] if s > 0}
+    # identical boxes: duplicate decayed to ~0 (iou=1 -> decay=0)
+    assert any(abs(s - 0.9) < 1e-4 for s in got)
+    assert any(abs(s - 0.7) < 1e-4 for s in got)
+    assert all(s > 0.65 for s in got), got
+
+
+def test_bipartite_match_oracle():
+    # 2 gt x 3 priors
+    dist = np.asarray([[[0.9, 0.2, 0.1], [0.3, 0.8, 0.6]]], "f4")
+
+    def build():
+        d = fluid.data("d", [1, 2, 3], "float32")
+        return layers.bipartite_match(d, match_type="per_prediction",
+                                      dist_threshold=0.55)
+
+    idx, dv = _run(build, {"d": dist})
+    # greedy: (gt0, prior0, 0.9), (gt1, prior1, 0.8); per_prediction adds
+    # prior2 -> gt1 (0.6 >= 0.55)
+    np.testing.assert_array_equal(idx[0], [0, 1, 1])
+    np.testing.assert_allclose(dv[0], [0.9, 0.8, 0.6], rtol=1e-6)
+
+
+def test_target_assign_oracle():
+    x = np.arange(8, dtype="f4").reshape(1, 2, 4)  # 2 gt rows
+    match = np.asarray([[1, -1, 0]], "i4")
+
+    def build():
+        xx = fluid.data("x", [1, 2, 4], "float32")
+        mm = fluid.data("m", [1, 3], "int32")
+        return layers.target_assign(xx, mm, mismatch_value=9)
+
+    out, w = _run(build, {"x": x, "m": match})
+    np.testing.assert_allclose(out[0, 0], [4, 5, 6, 7])
+    np.testing.assert_allclose(out[0, 1], [9, 9, 9, 9])
+    np.testing.assert_allclose(out[0, 2], [0, 1, 2, 3])
+    np.testing.assert_allclose(w[0, :, 0], [1, 0, 1])
+
+
+def test_polygon_box_transform_oracle():
+    x = np.zeros((1, 2, 2, 2), "f4")
+    x[0, 0, 1, 1] = 3.0  # x-channel
+    x[0, 1, 1, 1] = 5.0  # y-channel
+
+    def build():
+        xx = fluid.data("x", [1, 2, 2, 2], "float32")
+        return layers.polygon_box_transform(xx)
+
+    (out,) = _run(build, {"x": x})
+    assert out[0, 0, 1, 1] == 4 * 1 - 3  # 4*j - x
+    assert out[0, 1, 1, 1] == 4 * 1 - 5  # 4*i - y
+    assert out[0, 0, 0, 0] == 0  # zeros stay zero
+
+
+def test_ctc_greedy_decoder_collapses():
+    # argmax sequence: [1, 1, 2, 0, 2, 2] -> collapse -> [1, 2, 2]
+    t, c = 6, 4
+    probs = np.zeros((1, t, c), "f4")
+    for i, k in enumerate([1, 1, 2, 0, 2, 2]):
+        probs[0, i, k] = 1.0
+
+    def build():
+        p = fluid.data("p", [1, t, c], "float32")
+        return layers.ctc_greedy_decoder(p, blank=0)
+
+    out, ln = _run(build, {"p": probs})
+    assert int(ln[0]) == 3
+    np.testing.assert_array_equal(out[0, :3], [1, 2, 2])
+    assert np.all(out[0, 3:] == 0)
+
+
+def test_box_decoder_and_assign_zero_deltas():
+    prior = np.asarray([[0, 0, 10, 10], [20, 20, 40, 40]], "f4")
+    deltas = np.zeros((2, 2 * 4), "f4")
+    score = np.asarray([[0.2, 0.8], [0.9, 0.1]], "f4")
+
+    def build():
+        p = fluid.data("p", [2, 4], "float32")
+        d = fluid.data("d", [2, 8], "float32")
+        s = fluid.data("s", [2, 2], "float32")
+        return layers.box_decoder_and_assign(p, [0.1, 0.1, 0.2, 0.2], d, s)
+
+    dec, assigned = _run(build, {"p": prior, "d": deltas, "s": score})
+    # zero deltas decode back to the prior box (+1 size convention)
+    np.testing.assert_allclose(assigned[0], prior[0], atol=0.51)
+    np.testing.assert_allclose(assigned[1], prior[1], atol=0.51)
+
+
+def test_ssd_pipeline_trains():
+    """multi_box_head -> ssd_loss end to end: loss decreases; and
+    detection_output produces fixed-shape results."""
+    n, g = 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [n, 3, 32, 32], "float32")
+        feat = layers.conv2d(img, 8, 3, padding=1, act="relu")
+        feat2 = layers.pool2d(feat, 2, "max", 2)
+        gt_box = fluid.data("gt_box", [n, g, 4], "float32")
+        gt_label = fluid.data("gt_label", [n, g], "int32")
+        locs, confs, boxes, variances = layers.multi_box_head(
+            [feat, feat2], img, base_size=32, num_classes=4,
+            aspect_ratios=[[1.0], [1.0, 2.0]], min_ratio=20, max_ratio=90,
+            steps=[8.0, 16.0])
+        loss = layers.reduce_mean(layers.ssd_loss(
+            locs, confs, gt_box, gt_label, boxes, variances))
+        det = layers.detection_output(
+            locs, layers.softmax(confs), boxes, variances,
+            nms_top_k=20, keep_top_k=10)
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.rand(n, 3, 32, 32).astype("f4"),
+        "gt_box": np.asarray(
+            [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9], [0, 0, 0, 0]],
+             [[0.2, 0.2, 0.6, 0.6], [0, 0, 0, 0], [0, 0, 0, 0]]], "f4"),
+        "gt_label": np.asarray([[1, 2, -1], [3, -1, -1]], "i4"),
+    }
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        vals = []
+        for _ in range(12):
+            lv, dv = exe.run(main, feed=feed, fetch_list=[loss, det])
+            vals.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0], (vals[0], vals[-1])
+    assert np.asarray(dv).shape == (n, 10, 6)
+
+
+def test_locality_aware_nms_runs():
+    bboxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60]]], "f4")
+    scores = np.zeros((1, 1, 3), "f4")
+    scores[0, 0] = [0.9, 0.8, 0.7]
+
+    def build():
+        bx = fluid.data("bx", [1, 3, 4], "float32")
+        sc = fluid.data("sc", [1, 1, 3], "float32")
+        return layers.locality_aware_nms(bx, sc, score_threshold=0.1,
+                                         nms_top_k=3, keep_top_k=3,
+                                         nms_threshold=0.5)
+
+    (out,) = _run(build, {"bx": bboxes, "sc": scores})
+    assert out.shape == (1, 3, 6)
+    valid = out[0][out[0, :, 0] >= 0]
+    assert len(valid) == 2  # merged overlap + the far box
+    # the merged box's score is the weight sum (0.9 + 0.8)
+    assert abs(valid[:, 1].max() - 1.7) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# batch 3: proposals / ROI extractors / yolo
+# ---------------------------------------------------------------------------
+
+
+def test_generate_proposals_shapes_and_clip():
+    n, a, h, w = 1, 3, 4, 4
+    rng = np.random.RandomState(0)
+
+    def build():
+        sc = fluid.data("sc", [n, a, h, w], "float32")
+        dl = fluid.data("dl", [n, 4 * a, h, w], "float32")
+        ii = fluid.data("ii", [n, 3], "float32")
+        anc = fluid.data("anc", [h, w, a, 4], "float32")
+        var = fluid.data("var", [h, w, a, 4], "float32")
+        return layers.generate_proposals(
+            sc, dl, ii, anc, var, post_nms_top_n=8, nms_thresh=0.7,
+            return_rois_num=True)
+
+    anchors = np.zeros((h, w, a, 4), "f4")
+    for i in range(h):
+        for j in range(w):
+            for k in range(a):
+                cx, cy = j * 8 + 4, i * 8 + 4
+                s = 8 * (k + 1)
+                anchors[i, j, k] = [cx - s / 2, cy - s / 2,
+                                    cx + s / 2, cy + s / 2]
+    feeds = {
+        "sc": rng.rand(n, a, h, w).astype("f4"),
+        "dl": (rng.randn(n, 4 * a, h, w) * 0.1).astype("f4"),
+        "ii": np.asarray([[32, 32, 1.0]], "f4"),
+        "anc": anchors,
+        "var": np.ones((h, w, a, 4), "f4"),
+    }
+    rois, probs, counts = _run(build, feeds)
+    assert rois.shape == (1, 8, 4) and probs.shape == (1, 8, 1)
+    assert 0 < int(counts[0]) <= 8
+    valid = rois[0][: int(counts[0])]
+    assert valid.min() >= 0 and valid.max() <= 31  # clipped to the image
+
+
+def test_rpn_target_assign_budget_and_targets():
+    a = 32
+    anchors = np.zeros((a, 4), "f4")
+    for i in range(a):
+        anchors[i] = [i * 4, 0, i * 4 + 8, 8]
+    gt = np.asarray([[[0, 0, 8, 8], [40, 0, 48, 8]]], "f4")
+
+    def build():
+        anc = fluid.data("anc", [a, 4], "float32")
+        g = fluid.data("g", [1, 2, 4], "float32")
+        bp = fluid.data("bp", [1, a, 4], "float32")
+        cl = fluid.data("cl", [1, a, 1], "float32")
+        return layers.rpn_target_assign(
+            bp, cl, anc, None, g, rpn_batch_size_per_im=8,
+            rpn_fg_fraction=0.25)
+
+    loc, label, locw, scorew = _run(build, {
+        "anc": anchors, "g": gt,
+        "bp": np.zeros((1, a, 4), "f4"), "cl": np.zeros((1, a, 1), "f4")})
+    lbl = label[0]
+    n_fg = int((lbl == 1).sum())
+    n_bg = int((lbl == 0).sum())
+    assert n_fg >= 1  # exact-overlap anchors 0 and 10 are forced positive
+    assert n_fg + n_bg <= 8  # batch budget
+    # matched anchor 0 target deltas = 0 (exact match)
+    fg_idx = np.where(lbl == 1)[0]
+    assert np.allclose(loc[0, fg_idx[0]], 0, atol=1e-5)
+    assert scorew.shape == (1, a, 1)
+
+
+def test_fpn_collect_and_distribute():
+    def build():
+        r1 = fluid.data("r1", [1, 4, 4], "float32")
+        r2 = fluid.data("r2", [1, 4, 4], "float32")
+        s1 = fluid.data("s1", [1, 4, 1], "float32")
+        s2 = fluid.data("s2", [1, 4, 1], "float32")
+        rois = layers.collect_fpn_proposals([r1, r2], [s1, s2], 2, 5, 6)
+        flat = layers.reshape(rois, [6, 4])
+        multi, restore = layers.distribute_fpn_proposals(flat, 2, 5, 4, 224)
+        return [rois] + multi + [restore]
+
+    rng = np.random.RandomState(1)
+    r1 = rng.rand(1, 4, 4).astype("f4") * 20
+    r2 = rng.rand(1, 4, 4).astype("f4") * 20
+    outs = _run(build, {
+        "r1": r1, "r2": r2,
+        "s1": rng.rand(1, 4, 1).astype("f4"),
+        "s2": rng.rand(1, 4, 1).astype("f4")})
+    rois = outs[0]
+    assert rois.shape == (1, 6, 4)
+    multi = outs[1:-1]
+    assert len(multi) == 4
+    restore = outs[-1]
+    assert sorted(restore.ravel().tolist()) == list(range(6))
+
+
+def test_roi_extractors_shapes():
+    rng = np.random.RandomState(2)
+    xv = rng.rand(1, 8, 16, 16).astype("f4")  # 8 = 2 * 2 * 2 for psroi
+    rois = np.asarray([[2, 2, 10, 10], [4, 4, 12, 12]], "f4")
+    quads = np.asarray([[2, 2, 10, 2, 10, 10, 2, 10]], "f4")
+
+    def build():
+        x = fluid.data("x", [1, 8, 16, 16], "float32")
+        r = fluid.data("r", [2, 4], "float32")
+        q = fluid.data("q", [1, 8], "float32")
+        pr = layers.prroi_pool(x, r, 1.0, 2, 2)
+        ps = layers.psroi_pool(x, r, 2, 1.0, 2, 2)
+        rp = layers.roi_perspective_transform(x, q, 4, 4, 1.0)
+        return pr, ps, rp
+
+    pr, ps, rp = _run(build, {"x": xv, "r": rois, "q": quads})
+    assert pr.shape == (2, 8, 2, 2)
+    assert ps.shape == (2, 2, 2, 2)
+    assert rp.shape == (1, 8, 4, 4)
+    for o in (pr, ps, rp):
+        assert np.isfinite(o).all() and np.abs(o).max() > 0
+
+
+def test_roi_perspective_identity_quad():
+    """An axis-aligned quad warps to the same values as direct sampling."""
+    xv = np.arange(16, dtype="f4").reshape(1, 1, 4, 4)
+    quad = np.asarray([[0, 0, 3, 0, 3, 3, 0, 3]], "f4")
+
+    def build():
+        x = fluid.data("x", [1, 1, 4, 4], "float32")
+        q = fluid.data("q", [1, 8], "float32")
+        return layers.roi_perspective_transform(x, q, 4, 4, 1.0)
+
+    (out,) = _run(build, {"x": xv, "q": quad})
+    np.testing.assert_allclose(out[0, 0], xv[0, 0], atol=1e-3)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    """Zero offsets + ones mask == standard convolution (same filter)."""
+    rng = np.random.RandomState(3)
+    xv = rng.randn(1, 2, 6, 6).astype("f4")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1, 2, 6, 6], "float32")
+        off = fluid.data("off", [1, 18, 6, 6], "float32")
+        msk = fluid.data("msk", [1, 9, 6, 6], "float32")
+        dc = layers.deformable_conv(x, off, msk, 3, 3, padding=1,
+                                    bias_attr=False, name="dc0")
+        wname = [p.name for p in main.all_parameters()][0]
+        cv = layers.conv2d(x, 3, 3, padding=1, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name=wname))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        d, c = exe.run(main, feed={
+            "x": xv, "off": np.zeros((1, 18, 6, 6), "f4"),
+            "msk": np.ones((1, 9, 6, 6), "f4")}, fetch_list=[dc, cv])
+    np.testing.assert_allclose(np.asarray(d), np.asarray(c),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_yolov3_loss_trains():
+    n, na, c, h, w = 1, 3, 4, 4, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.data("feat", [n, 8, h, w], "float32")
+        x = layers.conv2d(feat, na * (5 + c), 1)
+        gt_box = fluid.data("gt_box", [n, 2, 4], "float32")
+        gt_label = fluid.data("gt_label", [n, 2], "int32")
+        loss = layers.reduce_mean(layers.yolov3_loss(
+            x, gt_box, gt_label, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=c, ignore_thresh=0.7,
+            downsample_ratio=32))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {
+        "feat": rng.rand(n, 8, h, w).astype("f4"),
+        "gt_box": np.asarray([[[0.3, 0.3, 0.2, 0.2],
+                               [0.7, 0.7, 0.3, 0.3]]], "f4"),
+        "gt_label": np.asarray([[1, 3]], "i4"),
+    }
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        vals = [float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss])[0]).reshape(()))
+            for _ in range(10)]
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0], (vals[0], vals[-1])
+
+
+def test_generate_proposal_labels_sampler():
+    n, r, g = 1, 16, 2
+    rng = np.random.RandomState(4)
+
+    def build():
+        rois = fluid.data("rois", [n, r, 4], "float32")
+        gtc = fluid.data("gtc", [n, g], "int32")
+        crowd = fluid.data("crowd", [n, g], "int32")
+        gtb = fluid.data("gtb", [n, g, 4], "float32")
+        ii = fluid.data("ii", [n, 3], "float32")
+        return layers.generate_proposal_labels(
+            rois, gtc, crowd, gtb, ii, batch_size_per_im=8,
+            class_nums=5, use_random=False)
+
+    rois_v = rng.rand(n, r, 4).astype("f4") * 20
+    rois_v[..., 2:] += rois_v[..., :2]  # make x2>x1, y2>y1
+    gtb_v = np.asarray([[[2, 2, 10, 10], [15, 15, 30, 30]]], "f4")
+    outs = _run(build, {
+        "rois": rois_v, "gtc": np.asarray([[1, 3]], "i4"),
+        "crowd": np.zeros((n, g), "i4"), "gtb": gtb_v,
+        "ii": np.asarray([[32, 32, 1]], "f4")})
+    srois, lbls, tgts, inw, outw = outs
+    assert srois.shape == (n, 8, 4)
+    assert lbls.shape == (n, 8)
+    assert tgts.shape == (n, 8, 20)
+    # gt boxes are appended to candidates, so at least the 2 gts match
+    assert (lbls > 0).sum() >= 2
+
+
+def test_matrix_nms_decay_axis_regression():
+    """Suppressor's compensate IoU divides its own row: C overlapping the
+    top box at IoU ~0.68 must be decayed to ~(1-0.68)*score, not kept."""
+    bboxes = np.asarray([[[0, 0, 10, 10], [30, 30, 40, 40],
+                          [0, 2, 10, 12]]], "f4")  # box2 overlaps box0
+    scores = np.zeros((1, 2, 3), "f4")
+    scores[0, 1] = [0.9, 0.8, 0.7]
+
+    def build():
+        bx = fluid.data("bx", [1, 3, 4], "float32")
+        sc = fluid.data("sc", [1, 2, 3], "float32")
+        return layers.matrix_nms(bx, sc, score_threshold=0.1,
+                                 post_threshold=0.0, nms_top_k=3,
+                                 keep_top_k=3)
+
+    out, _ = _run(build, {"bx": bboxes, "sc": scores})
+    got = sorted(float(s) for s in out[0, :, 1])
+    # iou(box0, box2) = 8/12 = 2/3 -> decayed to (1 - 2/3) * 0.7 = 0.2333
+    assert abs(got[0] - 0.7 * (1 - 2 / 3)) < 2e-3, got
+    assert abs(got[2] - 0.9) < 1e-5
+
+
+def test_multiclass_nms_return_index():
+    bboxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60]]], "f4")
+    scores = np.zeros((1, 2, 3), "f4")
+    scores[0, 1] = [0.9, 0.8, 0.7]
+
+    def build():
+        bx = fluid.data("bx", [1, 3, 4], "float32")
+        sc = fluid.data("sc", [1, 2, 3], "float32")
+        return layers.multiclass_nms(bx, sc, score_threshold=0.1,
+                                     nms_top_k=3, keep_top_k=3,
+                                     nms_threshold=0.5, return_index=True)
+
+    out, index = _run(build, {"bx": bboxes, "sc": scores})
+    assert index.shape == (1, 3, 1)
+    # detections are boxes 0 (0.9) and 2 (0.7); padding index -1
+    assert index[0, 0, 0] == 0 and index[0, 1, 0] == 2
+    assert index[0, 2, 0] == -1

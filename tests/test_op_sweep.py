@@ -2947,6 +2947,32 @@ EXEMPT = {
     # host parameter-server bridge: needs the global table registry and
     # host-side optimizer state; covered end to end in test_ps_embedding.py
     "distributed_lookup_table": "test_ps_embedding.py",
+    # detection batch 2: numpy oracles through the executor in
+    # tests/test_detection2.py (static-shape NMS/assignment contracts)
+    "anchor_generator": "test_detection2.py (hand oracle)",
+    "density_prior_box": "test_detection2.py",
+    "box_clip": "test_detection2.py (hand oracle)",
+    "box_decoder_and_assign": "test_detection2.py (zero-delta oracle)",
+    "multiclass_nms": "test_detection2.py (suppression + padding)",
+    "matrix_nms": "test_detection2.py (decay semantics)",
+    "locality_aware_nms": "test_detection2.py (merge + NMS)",
+    "target_assign": "test_detection2.py (hand oracle)",
+    "bipartite_match": "test_detection2.py (greedy oracle)",
+    "polygon_box_transform": "test_detection2.py (hand oracle)",
+    "ctc_align": "test_detection2.py (collapse oracle)",
+    "ssd_loss": "test_detection2.py (end-to-end training)",
+    # detection batch 3 (proposals/ROI/yolo): tests/test_detection2.py
+    "generate_proposals": "test_detection2.py (shapes/clip/NMS)",
+    "rpn_target_assign": "test_detection2.py (budget + exact-match deltas)",
+    "retinanet_target_assign": "test_detection2.py via rpn variant",
+    "collect_fpn_proposals": "test_detection2.py",
+    "distribute_fpn_proposals": "test_detection2.py (restore permutation)",
+    "prroi_pool": "test_detection2.py (shape/finite)",
+    "psroi_pool": "test_detection2.py (shape/finite)",
+    "roi_perspective_transform": "test_detection2.py (identity-quad oracle)",
+    "deformable_conv": "test_detection2.py (zero-offset == conv2d)",
+    "deformable_psroi_pooling": "test_detection2.py via deformable_roi_pooling",
+    "yolov3_loss": "test_detection2.py (end-to-end training)",
     # vision/misc breadth ops: numpy-oracle + semantics tests through the
     # executor live in tests/test_layers_breadth.py
     "conv3d_transpose": "test_layers_breadth.py (adjoint + identity oracle)",

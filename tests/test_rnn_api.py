@@ -204,3 +204,33 @@ def test_multilayer_lstm_and_lstmp():
     assert np.asarray(guv).shape == (b, h)
     for a in (o, lh, pj, huv, guv):
         assert np.isfinite(np.asarray(a)).all()
+
+
+def test_rnn_returns_true_final_states():
+    """rnn()'s second return must be the FINAL states (reference rnn.py),
+    not the initial zeros — and lstm()'s last_c must differ from last_h."""
+    b, t, d, h = 2, 4, 3, 5
+    rng = np.random.RandomState(7)
+    xv = rng.randn(b, t, d).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [b, t, d], "float32")
+        cell = layers.LSTMCell(h, name="fs0")
+        out, final = layers.rnn(cell, x)
+        h0 = layers.fill_constant([1, b, h], "float32", 0.0)
+        c0 = layers.fill_constant([1, b, h], "float32", 0.0)
+        seq, last_h, last_c = layers.lstm(x, h0, c0, t, h, num_layers=1)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        ov, fh, fc, sv, lh, lc = exe.run(
+            main, feed={"x": xv},
+            fetch_list=[out, final[0], final[1], seq, last_h, last_c])
+    ov, fh = np.asarray(ov), np.asarray(fh)
+    # final h == last output step
+    np.testing.assert_allclose(fh, ov[:, -1], rtol=1e-5, atol=1e-6)
+    assert np.abs(fh).max() > 0  # not the zero init
+    # final c is a genuinely different tensor from final h
+    assert not np.allclose(np.asarray(fc), fh)
+    assert not np.allclose(np.asarray(lc), np.asarray(lh))
