@@ -10,4 +10,20 @@ linear task for uci_housing, a keyword task for imdb). The synthetic
 sets are learnable, so end-to-end examples and tests behave like the
 real pipelines.
 """
-from . import cifar, imdb, mnist, uci_housing  # noqa: F401
+from . import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    image,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)

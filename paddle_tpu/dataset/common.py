@@ -1,0 +1,32 @@
+"""Dataset cache helpers (reference python/paddle/dataset/common.py).
+This environment has no network egress: download() only RETURNS a
+pre-populated cache path and raises otherwise (the loaders' synthetic
+fallbacks cover the missing-cache case)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (
+            not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        f"dataset file {filename} not cached and this environment has no "
+        f"network egress; place the file there manually or rely on the "
+        f"loader's synthetic fallback"
+    )

@@ -1,0 +1,84 @@
+"""CoNLL-2005 semantic role labeling (reference
+python/paddle/dataset/conll05.py: test() yielding the 8-slot SRL sample
+(word, ctx_n2..ctx_p2, verb, mark, label ids) + get_dict/get_embedding).
+Synthetic fallback: template sentences where the label is a fixed
+function of (word-class, distance-to-predicate) — learnable by the
+label_semantic_roles book model."""
+from __future__ import annotations
+
+import numpy as np
+
+WORD_VOCAB, LABEL_N = 800, 9  # labels: O + 4 * (B-, I-) roles
+TEST_N = 1500
+
+
+def word_dict():
+    return {f"w{i:03d}": i for i in range(WORD_VOCAB)}
+
+
+def verb_dict():
+    return {f"v{i:02d}": i for i in range(40)}
+
+
+def label_dict():
+    labels = ["O"]
+    for r in range((LABEL_N - 1) // 2):
+        labels += [f"B-A{r}", f"I-A{r}"]
+    return {l: i for i, l in enumerate(labels)}
+
+
+def get_dict():
+    return word_dict(), verb_dict(), label_dict()
+
+
+def get_embedding():
+    """Deterministic pretrained-style embedding table [WORD_VOCAB, 32]."""
+    rng = np.random.RandomState(3)
+    return rng.randn(WORD_VOCAB, 32).astype(np.float32) * 0.1
+
+
+def _samples(n, seed):
+    rng = np.random.RandomState(seed)
+    n_roles = (LABEL_N - 1) // 2
+    for _ in range(n):
+        length = rng.randint(5, 15)
+        words = rng.randint(0, WORD_VOCAB, size=length)
+        pred_pos = rng.randint(0, length)
+        verb = int(words[pred_pos]) % 40
+        # deterministic labeling: role = word class; B at segment starts
+        labels = np.zeros(length, np.int64)
+        role = (words % n_roles).astype(np.int64)
+        for i in range(length):
+            if i == pred_pos:
+                labels[i] = 0
+            elif i == 0 or role[i] != role[i - 1]:
+                labels[i] = 1 + 2 * role[i]
+            else:
+                labels[i] = 2 + 2 * role[i]
+        ctx = {}
+        for off in (-2, -1, 0, 1, 2):
+            pos = min(max(pred_pos + off, 0), length - 1)
+            ctx[off] = int(words[pos])
+        mark = (np.arange(length) == pred_pos).astype(np.int64)
+        yield (
+            words.tolist(),
+            [ctx[-2]] * length, [ctx[-1]] * length, [ctx[0]] * length,
+            [ctx[1]] * length,
+            [verb] * length, mark.tolist(), labels.tolist(),
+        )
+
+
+def test():
+    def reader():
+        yield from _samples(TEST_N, 1)
+
+    return reader
+
+
+# the reference exposes only test() publicly for conll05; keep a train()
+# convenience for the book model
+def train():
+    def reader():
+        yield from _samples(4 * TEST_N, 0)
+
+    return reader

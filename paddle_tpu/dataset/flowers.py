@@ -1,0 +1,53 @@
+"""Oxford-102 flowers (reference python/paddle/dataset/flowers.py:
+train()/test()/valid() yielding (image CHW float32, label)). Synthetic
+fallback: 102 color-texture class prototypes + noise at 3x64x64 (the
+reference yields variable-size jpegs; a fixed small size keeps shapes
+static for TPU examples)."""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES, SIZE = 102, 64
+TRAIN_N, TEST_N, VALID_N = 2040, 612, 510
+
+
+def _protos():
+    rng = np.random.RandomState(6)
+    base = rng.rand(N_CLASSES, 3, 8, 8).astype(np.float32)
+    return base.repeat(SIZE // 8, axis=2).repeat(SIZE // 8, axis=3)
+
+
+def _samples(n, seed):
+    rng = np.random.RandomState(seed)
+    protos = _protos()
+    for _ in range(n):
+        y = rng.randint(0, N_CLASSES)
+        img = protos[y] + 0.15 * rng.randn(3, SIZE, SIZE).astype(np.float32)
+        yield np.clip(img, 0.0, 1.0), int(y)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    def reader():
+        while True:
+            yield from _samples(TRAIN_N, 0)
+            if not cycle:
+                return
+
+    return reader
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    def reader():
+        while True:
+            yield from _samples(TEST_N, 1)
+            if not cycle:
+                return
+
+    return reader
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    def reader():
+        yield from _samples(VALID_N, 2)
+
+    return reader

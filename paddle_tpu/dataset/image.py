@@ -1,0 +1,63 @@
+"""Image helpers (reference python/paddle/dataset/image.py — cv2 based).
+numpy-only equivalents: nearest-neighbor resize, center/random crop,
+flip, simple_transform; enough for the dataset readers and examples
+without an OpenCV dependency."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def resize_short(im, size):
+    """Resize (HWC) so the short side == size (nearest neighbor)."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    ys = (np.arange(nh) * h / nh).astype(int)
+    xs = (np.arange(nw) * w / nw).astype(int)
+    return im[ys][:, xs]
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    y0 = max((h - size) // 2, 0)
+    x0 = max((w - size) // 2, 0)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    y0 = rng.randint(0, max(h - size, 0) + 1)
+    x0 = rng.randint(0, max(w - size, 0) + 1)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize short side -> crop (random+flip when training) -> CHW."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2):
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        im -= np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    return im
+
+
+def load_image(path, is_color=True):
+    """Load .npy images (no cv2/PIL in this environment)."""
+    return np.load(path)

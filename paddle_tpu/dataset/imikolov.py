@@ -1,0 +1,85 @@
+"""PTB language-model n-grams (reference python/paddle/dataset/imikolov.py:
+build_dict() + train(word_idx, n)/test(word_idx, n) yielding n-gram id
+tuples). Synthetic fallback: a deterministic order-2 Markov corpus over
+1000 words — predictable structure the word2vec book model can learn."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser(
+    "~/.cache/paddle/dataset/imikolov/simple-examples.tgz")
+VOCAB = 1000
+TRAIN_SENT, TEST_SENT = 2000, 400
+
+
+def _markov_corpus(n_sent, seed):
+    """Sentences from a sparse, fixed transition table (learnable)."""
+    rng = np.random.RandomState(42)
+    # each word has 4 plausible successors — fixed for every call
+    nxt = rng.randint(0, VOCAB, size=(VOCAB, 4))
+    gen = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n_sent):
+        length = gen.randint(5, 20)
+        w = gen.randint(0, VOCAB)
+        sent = [w]
+        for _ in range(length - 1):
+            w = nxt[w, gen.randint(0, 4)]
+            sent.append(w)
+        sents.append(sent)
+    return sents
+
+
+def _real_sentences(split):
+    import tarfile
+
+    name = f"./simple-examples/data/ptb.{split}.txt"
+    with tarfile.open(CACHE) as tf:
+        f = tf.extractfile(name)
+        return [line.decode().split() for line in f.read().splitlines()]
+
+
+def build_dict(min_word_freq=50):
+    """word -> id; synthetic mode uses "w0001"-style tokens."""
+    if os.path.exists(CACHE):
+        from collections import Counter
+
+        c = Counter()
+        for sent in _real_sentences("train"):
+            c.update(sent)
+        words = [w for w, f in c.items() if f >= min_word_freq and w != "<unk>"]
+        word_idx = {w: i for i, w in enumerate(sorted(words))}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+    return {f"w{i:04d}": i for i in range(VOCAB)}
+
+
+def _ngram_reader(sentences, word_idx, n):
+    unk = word_idx.get("<unk>", len(word_idx))
+
+    def to_id(w):
+        if isinstance(w, (int, np.integer)):
+            return int(w)
+        return word_idx.get(w, unk)
+
+    def reader():
+        for sent in sentences:
+            ids = [to_id(w) for w in sent]
+            for i in range(len(ids) - n + 1):
+                yield tuple(ids[i : i + n])
+
+    return reader
+
+
+def train(word_idx, n):
+    if os.path.exists(CACHE):
+        return _ngram_reader(_real_sentences("train"), word_idx, n)
+    return _ngram_reader(_markov_corpus(TRAIN_SENT, 0), word_idx, n)
+
+
+def test(word_idx, n):
+    if os.path.exists(CACHE):
+        return _ngram_reader(_real_sentences("valid"), word_idx, n)
+    return _ngram_reader(_markov_corpus(TEST_SENT, 1), word_idx, n)

@@ -1,0 +1,89 @@
+"""MovieLens-1M recommender data (reference
+python/paddle/dataset/movielens.py: train()/test() yielding
+[user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+rating]). Synthetic fallback: latent-factor users x movies with ratings
+= clipped dot product — the recommender book model can fit it."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/movielens/ml-1m.zip")
+N_USERS, N_MOVIES = 400, 300
+N_AGE, N_JOB, N_CATEGORY, TITLE_VOCAB, TITLE_LEN = 7, 21, 18, 500, 4
+TRAIN_N, TEST_N = 6000, 1200
+
+
+def max_user_id():
+    return N_USERS
+
+
+def max_movie_id():
+    return N_MOVIES
+
+
+def max_job_id():
+    return N_JOB - 1
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def movie_categories():
+    return [f"genre_{i}" for i in range(N_CATEGORY)]
+
+
+def get_movie_title_dict():
+    return {f"t{i:03d}": i for i in range(TITLE_VOCAB)}
+
+
+def _movie_meta():
+    rng = np.random.RandomState(7)
+    cats = [rng.choice(N_CATEGORY, size=rng.randint(1, 4), replace=False)
+            for _ in range(N_MOVIES + 1)]
+    titles = rng.randint(0, TITLE_VOCAB, size=(N_MOVIES + 1, TITLE_LEN))
+    return cats, titles
+
+
+def _latents():
+    rng = np.random.RandomState(11)
+    u = rng.randn(N_USERS + 1, 8) * 0.7
+    m = rng.randn(N_MOVIES + 1, 8) * 0.7
+    return u, m
+
+
+def _samples(n, seed):
+    rng = np.random.RandomState(seed)
+    u_lat, m_lat = _latents()
+    cats, titles = _movie_meta()
+    meta_rng = np.random.RandomState(13)
+    genders = meta_rng.randint(0, 2, N_USERS + 1)
+    ages = meta_rng.randint(0, N_AGE, N_USERS + 1)
+    jobs = meta_rng.randint(0, N_JOB, N_USERS + 1)
+    for _ in range(n):
+        uid = rng.randint(1, N_USERS + 1)
+        mid = rng.randint(1, N_MOVIES + 1)
+        score = float(np.clip(
+            3.0 + (u_lat[uid] * m_lat[mid]).sum() + 0.3 * rng.randn(),
+            1.0, 5.0))
+        yield [
+            uid, int(genders[uid]), int(ages[uid]), int(jobs[uid]),
+            mid, list(int(c) for c in cats[mid]),
+            list(int(t) for t in titles[mid]), score,
+        ]
+
+
+def train():
+    def reader():
+        yield from _samples(TRAIN_N, 0)
+
+    return reader
+
+
+def test():
+    def reader():
+        yield from _samples(TEST_N, 1)
+
+    return reader

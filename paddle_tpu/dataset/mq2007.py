@@ -1,0 +1,49 @@
+"""MQ2007 learning-to-rank (reference python/paddle/dataset/mq2007.py:
+train/test with format "pointwise"/"pairwise"/"listwise"). Synthetic
+fallback: 46-dim query-doc features whose first dims correlate with the
+relevance label, grouped by query."""
+from __future__ import annotations
+
+import numpy as np
+
+N_FEAT = 46
+N_QUERY_TRAIN, N_QUERY_TEST, DOCS_PER_QUERY = 120, 30, 8
+
+
+def _queries(n_query, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_query):
+        rel = rng.randint(0, 3, DOCS_PER_QUERY)
+        feats = rng.randn(DOCS_PER_QUERY, N_FEAT).astype(np.float32) * 0.3
+        feats[:, 0] += rel  # relevance signal
+        feats[:, 1] += 0.5 * rel
+        yield rel, feats
+
+
+def _reader(n_query, seed, format):
+    def pointwise():
+        for rel, feats in _queries(n_query, seed):
+            for r, f in zip(rel, feats):
+                yield float(r), f
+
+    def pairwise():
+        for rel, feats in _queries(n_query, seed):
+            for i in range(DOCS_PER_QUERY):
+                for j in range(DOCS_PER_QUERY):
+                    if rel[i] > rel[j]:
+                        yield 1.0, feats[i], feats[j]
+
+    def listwise():
+        for rel, feats in _queries(n_query, seed):
+            yield rel.astype(np.float32), feats
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train(format="pairwise"):
+    return _reader(N_QUERY_TRAIN, 0, format)
+
+
+def test(format="pairwise"):
+    return _reader(N_QUERY_TEST, 1, format)

@@ -1,0 +1,21 @@
+"""Movie-review sentiment (reference python/paddle/dataset/sentiment.py —
+NLTK movie_reviews based; same reader contract as imdb). Delegates to the
+imdb loader's vocabulary/synthetic machinery."""
+from __future__ import annotations
+
+from . import imdb as _imdb
+
+NUM_TRAINING_INSTANCES = _imdb.TRAIN_N
+NUM_TOTAL_INSTANCES = _imdb.TRAIN_N + _imdb.TEST_N
+
+
+def get_word_dict():
+    return sorted(_imdb.word_dict().items(), key=lambda kv: kv[1])
+
+
+def train():
+    return _imdb.train(_imdb.word_dict())
+
+
+def test():
+    return _imdb.test(_imdb.word_dict())

@@ -1,0 +1,46 @@
+"""VOC2012 segmentation (reference python/paddle/dataset/voc2012.py:
+train()/test()/val() yielding (image CHW float32, label mask HW int32)).
+Synthetic fallback: images containing colored rectangles whose class is
+recoverable from the color — a learnable toy segmentation task."""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES, SIZE = 21, 64
+TRAIN_N, TEST_N, VAL_N = 600, 120, 120
+
+
+def _samples(n, seed):
+    rng = np.random.RandomState(seed)
+    class_colors = np.random.RandomState(9).rand(N_CLASSES, 3).astype(np.float32)
+    for _ in range(n):
+        img = 0.05 * rng.rand(3, SIZE, SIZE).astype(np.float32)
+        mask = np.zeros((SIZE, SIZE), np.int32)
+        for _obj in range(rng.randint(1, 4)):
+            cls = rng.randint(1, N_CLASSES)
+            x0, y0 = rng.randint(0, SIZE - 16, size=2)
+            w, h = rng.randint(8, 16, size=2)
+            img[:, y0:y0 + h, x0:x0 + w] = class_colors[cls][:, None, None]
+            mask[y0:y0 + h, x0:x0 + w] = cls
+        yield img, mask
+
+
+def train():
+    def reader():
+        yield from _samples(TRAIN_N, 0)
+
+    return reader
+
+
+def test():
+    def reader():
+        yield from _samples(TEST_N, 1)
+
+    return reader
+
+
+def val():
+    def reader():
+        yield from _samples(VAL_N, 2)
+
+    return reader

@@ -1,0 +1,20 @@
+"""WMT14 Fr-En pairs (reference python/paddle/dataset/wmt14.py — same
+reader contract as wmt16: (src_ids, trg_ids, trg_ids_next)). The
+synthetic task is shared with wmt16 (fixed bijection + reversal)."""
+from __future__ import annotations
+
+from . import wmt16 as _w
+
+
+def train(dict_size):
+    return _w.train(dict_size, dict_size, "fr")
+
+
+def test(dict_size):
+    return _w.test(dict_size, dict_size, "fr")
+
+
+def get_dict(dict_size, reverse=False):
+    src = _w.get_dict("fr", dict_size, reverse)
+    trg = _w.get_dict("en", dict_size, reverse)
+    return src, trg

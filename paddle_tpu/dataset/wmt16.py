@@ -1,0 +1,74 @@
+"""WMT16 En-De translation pairs (reference
+python/paddle/dataset/wmt16.py: train/test/validation readers yielding
+(src_ids, trg_ids, trg_ids_next) and get_dict()). Synthetic fallback:
+source sentences over a small vocab with the target defined by a FIXED
+bijective word map + reversal — a learnable toy translation task for the
+machine-translation book model and Transformer configs."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/wmt16/wmt16.tar.gz")
+TRAIN_N, TEST_N, VALID_N = 4000, 600, 600
+
+
+def _special():
+    return {"<s>": 0, "<e>": 1, "<unk>": 2}
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """id table for "en"/"de"; synthetic tokens are f"{lang}{i}"."""
+    dict_size = max(dict_size, 8)
+    d = dict(_special())
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _word_map(dict_size):
+    """Fixed bijection src id -> trg id (ids >= 3)."""
+    rng = np.random.RandomState(5)
+    ids = np.arange(3, dict_size)
+    perm = rng.permutation(ids)
+    m = np.arange(dict_size)
+    m[3:] = perm
+    return m
+
+
+def _samples(n, seed, src_dict_size, trg_dict_size):
+    src_size = max(src_dict_size, 8)
+    trg_size = max(trg_dict_size, 8)
+    wmap = _word_map(min(src_size, trg_size))
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        length = rng.randint(3, 12)
+        src = rng.randint(3, min(src_size, len(wmap)), size=length)
+        trg = wmap[src][::-1]  # bijective map + reversal
+        trg_in = np.concatenate([[0], trg])       # <s> prefix
+        trg_next = np.concatenate([trg, [1]])     # <e> suffix
+        yield (src.tolist(), trg_in.tolist(), trg_next.tolist())
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    def reader():
+        yield from _samples(TRAIN_N, 0, src_dict_size, trg_dict_size)
+
+    return reader
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    def reader():
+        yield from _samples(TEST_N, 1, src_dict_size, trg_dict_size)
+
+    return reader
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    def reader():
+        yield from _samples(VALID_N, 2, src_dict_size, trg_dict_size)
+
+    return reader

@@ -27,11 +27,27 @@ from .base import (  # noqa: F401
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .nn import (  # noqa: F401
+    NCE,
     BatchNorm,
+    BilinearTensorProduct,
     Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
     Dropout,
     Embedding,
+    GroupNorm,
+    GRUUnit,
+    InstanceNorm,
+    LayerList,
     LayerNorm,
     Linear,
+    ParameterList,
     Pool2D,
+    PRelu,
+    RowConv,
+    Sequential,
+    SequenceConv,
+    SpectralNorm,
+    TreeConv,
 )

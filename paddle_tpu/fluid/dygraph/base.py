@@ -112,6 +112,27 @@ class VarBase:
     def __neg__(self):
         return _trace_op("scale", {"X": [self]}, {"scale": -1.0}, ["Out"])[0]
 
+    # -- reduction/reshape sugar (reference varbase_patch_methods.py) ----
+    def mean(self, axis=None, keepdim=False):
+        attrs = {"reduce_all": axis is None, "keep_dim": keepdim}
+        if axis is not None:
+            attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+        return _trace_op("reduce_mean", {"X": [self]}, attrs, ["Out"])[0]
+
+    def sum(self, axis=None, keepdim=False):
+        attrs = {"reduce_all": axis is None, "keep_dim": keepdim}
+        if axis is not None:
+            attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+        return _trace_op("reduce_sum", {"X": [self]}, attrs, ["Out"])[0]
+
+    def reshape(self, shape):
+        return _trace_op("reshape", {"X": [self]},
+                         {"shape": list(shape)}, ["Out"])[0]
+
+    def transpose(self, perm):
+        return _trace_op("transpose", {"X": [self]},
+                         {"axis": list(perm)}, ["Out"])[0]
+
 
 class Tracer:
     """Eager executor + tape recorder (reference imperative/tracer.cc:45)."""

@@ -202,3 +202,457 @@ class Dropout(Layer):
             },
             ["Out"],
         )[0]
+
+
+def _ntuple(v, n):
+    """int-or-list spatial attr -> list of n ints (shared by the convs)."""
+    return [v] * n if isinstance(v, int) else list(v)
+
+
+def _conv_attrs(stride, padding, dilation, groups, n):
+    return {
+        "strides": _ntuple(stride, n),
+        "paddings": _ntuple(padding, n),
+        "dilations": _ntuple(dilation, n),
+        "groups": groups or 1,
+    }
+
+
+def _bias_act(out, bias, act, axis=1):
+    """Shared conv epilogue: channel bias + activation."""
+    if bias is not None:
+        out = _trace_op("elementwise_add", {"X": [out], "Y": [bias]},
+                        {"axis": axis}, ["Out"])[0]
+    if act:
+        out = _trace_op(act, {"X": [out]}, {}, ["Out"])[0]
+    return out
+
+
+class Conv3D(Layer):
+    """Reference dygraph/nn.py Conv3D over the conv3d op."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = _conv_attrs(stride, padding, dilation, groups, 3)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // (groups or 1)]
+            + _ntuple(filter_size, 3),
+            attr=ParamAttr._to_attr(param_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+        self._act = act
+
+    def forward(self, x):
+        out = _trace_op("conv3d", {"Input": [x], "Filter": [self.weight]},
+                        self._attrs, ["Output"])[0]
+        return _bias_act(out, self.bias, self._act)
+
+
+class _ConvTransposeBase(Layer):
+    """Shared machinery for Conv2DTranspose / Conv3DTranspose: output_size
+    resolves to the op's output_padding (extra = requested - formula)."""
+
+    _ndim = 2
+    _op = "conv2d_transpose"
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        n = self._ndim
+        self._attrs = _conv_attrs(stride, padding, dilation, groups, n)
+        self._fs = _ntuple(filter_size, n)
+        self._output_size = (None if output_size is None
+                             else _ntuple(output_size, n))
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // (groups or 1)] + self._fs,
+            attr=ParamAttr._to_attr(param_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+        self._act = act
+
+    def forward(self, x):
+        attrs = dict(self._attrs)
+        if self._output_size is not None:
+            st, pd, dl = attrs["strides"], attrs["paddings"], attrs["dilations"]
+            extra = []
+            for i in range(self._ndim):
+                formula = ((x.shape[2 + i] - 1) * st[i] - 2 * pd[i]
+                           + dl[i] * (self._fs[i] - 1) + 1)
+                e = self._output_size[i] - formula
+                if e < 0 or e >= st[i]:
+                    raise ValueError(
+                        f"{type(self).__name__}: output_size "
+                        f"{self._output_size[i]} unreachable from input "
+                        f"{x.shape[2 + i]} (formula gives {formula}, "
+                        f"stride {st[i]})")
+                extra.append(e)
+            attrs["output_padding"] = extra
+        out = _trace_op(self._op, {"Input": [x], "Filter": [self.weight]},
+                        attrs, ["Output"])[0]
+        return _bias_act(out, self.bias, self._act)
+
+
+class Conv2DTranspose(_ConvTransposeBase):
+    _ndim = 2
+    _op = "conv2d_transpose"
+
+
+class Conv3DTranspose(_ConvTransposeBase):
+    _ndim = 3
+    _op = "conv3d_transpose"
+
+
+class InstanceNorm(Layer):
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._eps = epsilon
+        self.scale = self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(param_attr),
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x):
+        return _trace_op(
+            "instance_norm",
+            {"X": [x], "Scale": [self.scale], "Bias": [self.bias]},
+            {"epsilon": self._eps}, ["Y"])[0]
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self.scale = self.create_parameter(
+            [channels], attr=ParamAttr._to_attr(param_attr),
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter(
+            [channels], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _trace_op(
+            "group_norm",
+            {"X": [x], "Scale": [self.scale], "Bias": [self.bias]},
+            self._attrs, ["Y"])[0]
+        if self._act:
+            out = _trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        h = weight_shape[dim]
+        w = int(np.prod([s for i, s in enumerate(weight_shape) if i != dim]))
+        self.weight_u = self.create_parameter([h])
+        self.weight_v = self.create_parameter([w])
+
+    def forward(self, weight):
+        return _trace_op(
+            "spectral_norm",
+            {"Weight": [weight], "U": [self.weight_u], "V": [self.weight_v]},
+            self._attrs, ["Out"])[0]
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            if channel is None:
+                raise ValueError("PRelu(mode='channel') needs `channel`")
+            shape = [channel]
+        elif mode == "element":
+            if input_shape is None:
+                raise ValueError("PRelu(mode='element') needs `input_shape`")
+            shape = list(input_shape)[1:]
+        else:
+            raise ValueError(f"PRelu: unknown mode {mode!r}")
+        self.weight = self.create_parameter(
+            shape, attr=ParamAttr._to_attr(param_attr),
+            default_initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        return _trace_op("prelu", {"X": [x], "Alpha": [self.weight]},
+                         {"mode": self._mode}, ["Out"])[0]
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim],
+            attr=ParamAttr._to_attr(param_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [1, output_dim], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = _trace_op("bilinear_tensor_product", ins, {}, ["Out"])[0]
+        if self._act:
+            out = _trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class GRUUnit(Layer):
+    """Single GRU step (reference dygraph/nn.py GRUUnit / gru_unit_op.cc):
+    input is the pre-projected [B, 3H] tensor (x @ W_x + b_x handled by
+    the caller's fc), hidden [B, H]. The [H, 3H] weight splits into
+    gate weights W_uz [H, 2H] and candidate weight W_c [H, H]:
+      u, r = gate_act(x_ur + h @ W_uz);  c = act(x_c + (r*h) @ W_c)
+      origin_mode=False (default): h' = (1-u)*h + u*c
+      origin_mode=True:            h' = u*h + (1-u)*c
+    """
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        h = size // 3
+        self._h = h
+        self._origin_mode = origin_mode
+        self.weight = self.create_parameter(
+            [h, 3 * h], attr=ParamAttr._to_attr(param_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [1, 3 * h], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+        self._act = activation
+        self._gate_act = gate_activation
+
+    def _slice(self, x, lo, hi):
+        return _trace_op("slice", {"Input": [x]},
+                         {"axes": [1], "starts": [lo], "ends": [hi]},
+                         ["Out"])[0]
+
+    def forward(self, input, hidden):
+        h = self._h
+        if self.bias is not None:
+            input = _trace_op("elementwise_add",
+                              {"X": [input], "Y": [self.bias]}, {},
+                              ["Out"])[0]
+        w_uz = self._slice(self.weight, 0, 2 * h)      # [H, 2H]
+        w_c = self._slice(self.weight, 2 * h, 3 * h)   # [H, H]
+        h_uz = _trace_op("matmul", {"X": [hidden], "Y": [w_uz]}, {},
+                         ["Out"])[0]
+        gates = _trace_op(self._gate_act, {"X": [_trace_op(
+            "elementwise_add",
+            {"X": [self._slice(input, 0, 2 * h)], "Y": [h_uz]}, {},
+            ["Out"])[0]]}, {}, ["Out"])[0]
+        u = self._slice(gates, 0, h)
+        r = self._slice(gates, h, 2 * h)
+        rh = _trace_op("elementwise_mul", {"X": [r], "Y": [hidden]}, {},
+                       ["Out"])[0]
+        rh_c = _trace_op("matmul", {"X": [rh], "Y": [w_c]}, {}, ["Out"])[0]
+        c = _trace_op(self._act, {"X": [_trace_op(
+            "elementwise_add",
+            {"X": [self._slice(input, 2 * h, 3 * h)], "Y": [rh_c]}, {},
+            ["Out"])[0]]}, {}, ["Out"])[0]
+        one_minus_u = _trace_op("scale", {"X": [u]},
+                                {"scale": -1.0, "bias": 1.0}, ["Out"])[0]
+        if self._origin_mode:
+            keep, take = u, one_minus_u
+        else:
+            keep, take = one_minus_u, u
+        new_h = _trace_op("elementwise_add", {"X": [_trace_op(
+            "elementwise_mul", {"X": [keep], "Y": [hidden]}, {}, ["Out"])[0]],
+            "Y": [_trace_op("elementwise_mul", {"X": [take], "Y": [c]}, {},
+                            ["Out"])[0]]}, {}, ["Out"])[0]
+        return new_h, None, new_h
+
+
+class NCE(Layer):
+    """Dygraph NCE head (reference dygraph/nn.py NCE) over the same
+    composition the static layers.nce uses."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", seed=0, is_sparse=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=ParamAttr._to_attr(param_attr))
+        self.bias = self.create_parameter(
+            [num_total_classes], attr=ParamAttr._to_attr(bias_attr),
+            is_bias=True)
+        self._c = num_total_classes
+        self._k = num_neg_samples
+        self._seed = seed
+
+    def forward(self, input, label):
+        b = input.shape[0]
+        lbl = _trace_op("reshape", {"X": [label]}, {"shape": [b]}, ["Out"])[0]
+        w_pos = _trace_op("gather", {"X": [self.weight], "Index": [lbl]},
+                          {}, ["Out"])[0]
+        b2 = _trace_op("reshape", {"X": [self.bias]},
+                       {"shape": [self._c, 1]}, ["Out"])[0]
+        b_pos = _trace_op("reshape", {"X": [_trace_op(
+            "gather", {"X": [b2], "Index": [lbl]}, {}, ["Out"])[0]]},
+            {"shape": [b, 1]}, ["Out"])[0]
+        s_pos = _trace_op("elementwise_add", {"X": [_trace_op(
+            "reduce_sum", {"X": [_trace_op(
+                "elementwise_mul", {"X": [input], "Y": [w_pos]}, {},
+                ["Out"])[0]]},
+            {"dim": [-1], "keep_dim": True}, ["Out"])[0]], "Y": [b_pos]},
+            {}, ["Out"])[0]
+        # uniform in [0, C): int cast covers every class 0..C-1
+        neg = _trace_op("uniform_random", {},
+                        {"shape": [self._k], "min": 0.0,
+                         "max": float(self._c), "dtype": "float32",
+                         "seed": self._seed}, ["Out"])[0]
+        neg_ids = _trace_op("cast", {"X": [neg]}, {"out_dtype": "int64"},
+                            ["Out"])[0]
+        w_neg = _trace_op("gather", {"X": [self.weight], "Index": [neg_ids]},
+                          {}, ["Out"])[0]
+        b_neg = _trace_op("reshape", {"X": [_trace_op(
+            "gather", {"X": [b2], "Index": [neg_ids]}, {}, ["Out"])[0]]},
+            {"shape": [1, self._k]}, ["Out"])[0]
+        s_neg = _trace_op("elementwise_add", {"X": [_trace_op(
+            "matmul", {"X": [input], "Y": [w_neg]},
+            {"transpose_Y": True}, ["Out"])[0]], "Y": [b_neg]}, {},
+            ["Out"])[0]
+        pos_term = _trace_op("softplus", {"X": [_trace_op(
+            "scale", {"X": [s_pos]}, {"scale": -1.0}, ["Out"])[0]]}, {},
+            ["Out"])[0]
+        neg_term = _trace_op("reduce_sum", {"X": [_trace_op(
+            "softplus", {"X": [s_neg]}, {}, ["Out"])[0]]},
+            {"dim": [-1], "keep_dim": True}, ["Out"])[0]
+        return _trace_op("elementwise_add",
+                         {"X": [pos_term], "Y": [neg_term]}, {}, ["Out"])[0]
+
+
+class SequenceConv(Layer):
+    def __init__(self, name_scope=None, num_filters=1, filter_size=3,
+                 filter_stride=1, padding=True, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32", input_dim=None):
+        super().__init__(dtype=dtype)
+        if input_dim is None:
+            raise ValueError("SequenceConv needs input_dim on TPU "
+                             "(static parameter shapes)")
+        self._attrs = {"contextLength": filter_size, "contextStride": filter_stride,
+                       "contextStart": -(filter_size // 2)}
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters],
+            attr=ParamAttr._to_attr(param_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+        self._act = act
+
+    def forward(self, x):
+        out = _trace_op("sequence_conv",
+                        {"X": [x], "Filter": [self.weight]},
+                        self._attrs, ["Out"])[0]
+        return _bias_act(out, self.bias, self._act, axis=-1)
+
+
+class RowConv(Layer):
+    def __init__(self, name_scope=None, future_context_size=2,
+                 param_attr=None, act=None, dtype="float32", input_dim=None):
+        super().__init__(dtype=dtype)
+        if input_dim is None:
+            raise ValueError("RowConv needs input_dim on TPU")
+        self.weight = self.create_parameter(
+            [future_context_size + 1, input_dim],
+            attr=ParamAttr._to_attr(param_attr))
+        self._act = act
+
+    def forward(self, x):
+        out = _trace_op("row_conv", {"X": [x], "Filter": [self.weight]},
+                        {}, ["Out"])[0]
+        if self._act:
+            out = _trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
+
+
+class TreeConv(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "TreeConv (tree_conv_op.cc) operates on ragged tree adjacency "
+            "structures; no dense lowering is provided"
+        )
+
+
+class Sequential(Layer):
+    """Ordered container (reference dygraph/container.py Sequential)."""
+
+    def __init__(self, *layers_):
+        super().__init__()
+        self._seq = []
+        for i, item in enumerate(layers_):
+            if isinstance(item, (list, tuple)):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            setattr(self, f"_seq_{name}", layer)  # registers as sublayer
+            self._seq.append(layer)
+
+    def forward(self, x):
+        for layer in self._seq:
+            x = layer(x)
+        return x
+
+    def __getitem__(self, i):
+        return self._seq[i]
+
+    def __len__(self):
+        return len(self._seq)
+
+
+class LayerList(Layer):
+    """Indexable list of sublayers (reference container.py LayerList)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        self._list = []
+        for layer in sublayers or []:
+            self.append(layer)
+
+    def append(self, layer):
+        setattr(self, f"_ll_{len(self._list)}", layer)
+        self._list.append(layer)
+        return self
+
+    def __getitem__(self, i):
+        return self._list[i]
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def __len__(self):
+        return len(self._list)
+
+
+class ParameterList(Layer):
+    """Indexable list of parameters (reference container.py)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._plist = []
+        for p in parameters or []:
+            self.append(p)
+
+    def append(self, p):
+        self._plist.append(p)
+        self._parameters[f"_pl_{len(self._plist) - 1}"] = p
+        return self
+
+    def __getitem__(self, i):
+        return self._plist[i]
+
+    def __iter__(self):
+        return iter(self._plist)
+
+    def __len__(self):
+        return len(self._plist)

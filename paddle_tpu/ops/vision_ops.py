@@ -78,6 +78,11 @@ def conv3d_transpose(ctx, ins, attrs):
         lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
     )
+    if attrs.get("output_padding"):
+        op_ = attrs["output_padding"]
+        if any(op_):
+            out = jnp.pad(out, [(0, 0), (0, 0), (0, op_[0]), (0, op_[1]),
+                                (0, op_[2])])
     return {"Output": [out]}
 
 

@@ -1,0 +1,111 @@
+"""New dataset loaders (paddle_tpu/dataset/): reader contracts, shapes,
+determinism, learnable structure."""
+import itertools
+
+import numpy as np
+
+from paddle_tpu import dataset
+
+
+def test_imikolov_ngrams():
+    wd = dataset.imikolov.build_dict()
+    assert len(wd) == 1000
+    grams = list(itertools.islice(dataset.imikolov.train(wd, 5)(), 50))
+    assert all(len(g) == 5 for g in grams)
+    assert all(0 <= w < len(wd) + 1 for g in grams for w in g)
+    again = list(itertools.islice(dataset.imikolov.train(wd, 5)(), 50))
+    assert grams == again  # deterministic
+
+
+def test_movielens_schema():
+    s = next(iter(dataset.movielens.train()()))
+    uid, gender, age, job, mid, cats, titles, rating = s
+    assert 1 <= uid <= dataset.movielens.max_user_id()
+    assert gender in (0, 1)
+    assert 0 <= job <= dataset.movielens.max_job_id()
+    assert 1 <= mid <= dataset.movielens.max_movie_id()
+    assert all(isinstance(c, int) for c in cats)
+    assert len(titles) == 4
+    assert 1.0 <= rating <= 5.0
+
+
+def test_wmt16_translation_is_learnable_mapping():
+    r = dataset.wmt16.train(50, 50)
+    src, trg_in, trg_next = next(iter(r()))
+    assert trg_in[0] == 0 and trg_next[-1] == 1  # <s> ... <e>
+    assert len(trg_in) == len(src) + 1
+    # the mapping is a fixed bijection: same src word -> same trg word
+    pairs = {}
+    for src, trg_in, _ in itertools.islice(r(), 200):
+        for s_w, t_w in zip(src, trg_in[1:][::-1]):
+            pairs.setdefault(s_w, set()).add(t_w)
+    assert all(len(v) == 1 for v in pairs.values())
+    d = dataset.wmt16.get_dict("en", 50)
+    assert d["<s>"] == 0 and d["<e>"] == 1
+
+
+def test_wmt14_wraps_wmt16():
+    src, trg_in, trg_next = next(iter(dataset.wmt14.train(40)()))
+    assert trg_in[0] == 0
+    sd, td = dataset.wmt14.get_dict(40)
+    assert "<unk>" in sd and "<unk>" in td
+
+
+def test_conll05_srl_schema():
+    wd, vd, ld = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape == (len(wd), 32)
+    sample = next(iter(dataset.conll05.test()()))
+    assert len(sample) == 8
+    words, c2, c1, c0, p1, verb, mark, labels = sample
+    n = len(words)
+    assert all(len(x) == n for x in (c2, c1, c0, p1, verb, mark, labels))
+    assert sum(mark) == 1  # exactly one predicate
+    assert all(0 <= l < len(ld) for l in labels)
+
+
+def test_mq2007_formats():
+    r, f = next(iter(dataset.mq2007.train("pointwise")()))
+    assert f.shape == (46,)
+    one, fa, fb = next(iter(dataset.mq2007.train("pairwise")()))
+    assert one == 1.0 and fa.shape == fb.shape == (46,)
+    rel, feats = next(iter(dataset.mq2007.train("listwise")()))
+    assert feats.shape == (8, 46) and rel.shape == (8,)
+
+
+def test_flowers_and_voc():
+    img, lbl = next(iter(dataset.flowers.train()()))
+    assert img.shape == (3, 64, 64) and 0 <= lbl < 102
+    assert img.min() >= 0 and img.max() <= 1
+    im2, mask = next(iter(dataset.voc2012.train()()))
+    assert im2.shape == (3, 64, 64) and mask.shape == (64, 64)
+    assert mask.max() < 21
+    # mask color corresponds to class: same-class pixels share the image color
+    cls = mask.max()
+    ys, xs = np.where(mask == cls)
+    colors = im2[:, ys, xs]
+    assert np.allclose(colors.std(axis=1), 0, atol=1e-5)
+
+
+def test_sentiment_delegates_to_imdb():
+    seq, lbl = next(iter(dataset.sentiment.train()()))
+    assert lbl in (0, 1) and len(seq) > 0
+    wd = dataset.sentiment.get_word_dict()
+    assert isinstance(wd, list) and isinstance(wd[0], tuple)
+
+
+def test_image_utils():
+    im = np.arange(8 * 12 * 3, dtype=np.float32).reshape(8, 12, 3)
+    short = dataset.image.resize_short(im, 4)
+    assert min(short.shape[:2]) == 4
+    crop = dataset.image.center_crop(short, 4)
+    assert crop.shape[:2] == (4, 4)
+    chw = dataset.image.simple_transform(im, 6, 4, is_train=False)
+    assert chw.shape == (3, 4, 4)
+
+
+def test_common_download_contract():
+    import pytest
+
+    with pytest.raises(RuntimeError, match="egress"):
+        dataset.common.download("http://x/y.tgz", "nope", "")
