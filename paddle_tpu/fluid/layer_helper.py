@@ -180,9 +180,11 @@ def emit_op(op_type, ins, attrs=None, out_slots=("Out",), out_dtype=None):
         outs = _trace_op(op_type, ins, attrs, list(out_slots))
         return outs[0] if len(outs) == 1 else outs
     helper = LayerHelper(op_type)
-    ref = next(v for vs in ins.values() for v in vs)
+    # creation-style ops (randperm etc.) have no inputs: out_dtype rules
+    ref = next((v for vs in ins.values() for v in vs), None)
+    dtype = out_dtype or (ref.dtype if ref is not None else "float32")
     outs = {
-        s: [helper.create_variable_for_type_inference(out_dtype or ref.dtype)]
+        s: [helper.create_variable_for_type_inference(dtype)]
         for s in out_slots
     }
     helper.append_op(type=op_type, inputs=ins, outputs=outs, attrs=attrs)

@@ -77,8 +77,13 @@ class Softmax(Layer):
 class CrossEntropyLoss(Layer):
     """softmax_with_cross_entropy + mean (reference nn.CrossEntropyLoss)."""
 
-    def __init__(self, reduction="mean"):
+    def __init__(self, weight=None, reduction="mean", ignore_index=-100):
         super().__init__()
+        if weight is not None:
+            raise NotImplementedError(
+                "CrossEntropyLoss: per-class weight not supported; "
+                "multiply per-sample losses by gathered weights instead"
+            )
         self._reduction = reduction
 
     def forward(self, logits, label):
@@ -93,3 +98,212 @@ class MSELoss(Layer):
 
     def forward(self, pred, label):
         return functional.mse_loss(pred, label, reduction=self._reduction)
+
+
+# ---------------------------------------------------------------------------
+# 2.0-preview breadth (reference python/paddle/nn/__init__.py): the 1.8
+# preview re-exports the functional surface from fluid.layers at the nn
+# top level, plus class layers, initializer aliases, and clip classes.
+# ---------------------------------------------------------------------------
+
+from ..fluid.layers import (  # noqa: F401,E402
+    adaptive_pool2d, adaptive_pool3d, add_position_encoding, affine_channel,
+    affine_grid, anchor_generator, assign, beam_search, beam_search_decode,
+    bipartite_match, box_clip, box_coder, box_decoder_and_assign, bpr_loss,
+    brelu, case, center_loss, clip, clip_by_norm, collect_fpn_proposals,
+    cond, continuous_value_model, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose, cosine_decay, cross_entropy, data,
+    deformable_roi_pooling, density_prior_box, detection_output, dice_loss,
+    distribute_fpn_proposals, dropout, edit_distance, elu, erf,
+    exponential_decay, filter_by_instag, fsp_matrix, gather_tree, gelu,
+    generate_mask_labels, generate_proposal_labels, generate_proposals,
+    grid_sampler, hard_shrink, hard_sigmoid, hard_swish, hash, hsigmoid,
+    huber_loss, image_resize, image_resize_short, inverse_time_decay,
+    iou_similarity, kldiv_loss, l2_normalize, label_smooth, leaky_relu,
+    linear_lr_warmup, log_loss, log_softmax, logsigmoid, lrn,
+    margin_rank_loss, maxout, mse_loss, multiclass_nms, natural_exp_decay,
+    noam_decay, npair_loss, one_hot, pad, pad2d, pad_constant_like,
+    piecewise_decay, pixel_shuffle, polygon_box_transform, polynomial_decay,
+    pool2d, pool3d, prior_box, prroi_pool, psroi_pool, random_crop,
+    rank_loss, relu, relu6, resize_bilinear, resize_nearest,
+    resize_trilinear, retinanet_detection_output, retinanet_target_assign,
+    roi_align, roi_perspective_transform, roi_pool, row_conv,
+    rpn_target_assign, sampled_softmax_with_cross_entropy, selu,
+    shuffle_channel, sigmoid, sigmoid_cross_entropy_with_logits,
+    sigmoid_focal_loss, similarity_focus, smooth_l1, soft_relu, softmax,
+    softmax_with_cross_entropy, softplus, softsign, space_to_depth,
+    square_error_cost, ssd_loss, swish, switch_case, target_assign,
+    teacher_student_sigmoid_loss, temporal_shift, thresholded_relu, unfold,
+    warpctc, while_loop, yolo_box, yolov3_loss,
+)
+from ..fluid.layers import soft_shrink as softshrink  # noqa: F401,E402
+from ..fluid.clip import (  # noqa: F401,E402
+    GradientClipByGlobalNorm,
+    GradientClipByNorm,
+    GradientClipByValue,
+)
+from ..fluid.dygraph.nn import (  # noqa: F401,E402
+    BilinearTensorProduct,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+    GroupNorm,
+    InstanceNorm,
+    RowConv,
+    SpectralNorm,
+)
+from ..fluid.initializer import (  # noqa: F401,E402
+    ConstantInitializer as Constant,
+    MSRAInitializer as MSRA,
+    NormalInitializer as Normal,
+    TruncatedNormalInitializer as TruncatedNormal,
+    UniformInitializer as Uniform,
+    XavierInitializer as Xavier,
+)
+
+Bilinear = BilinearTensorProduct
+interpolate = image_resize
+
+
+def tanh_shrink(x, name=None):
+    """x - tanh(x) (reference ops.py tanh_shrink)."""
+    from ..fluid.layer_helper import emit_op
+
+    return emit_op("tanh_shrink", {"X": [x]})
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal embed (reference nn/functional/extension.py)."""
+    if offset != 0 or (dim1, dim2) != (-2, -1):
+        raise NotImplementedError("diag_embed: main-diagonal form only")
+    from ..fluid.layer_helper import emit_op
+
+    return emit_op("diag_embed", {"X": [input]})
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha=0.01):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return functional.leaky_relu(x, negative_slope=self._alpha)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.log_softmax(x, axis=self._axis)
+
+
+class HSigmoid(Layer):
+    """2.0-preview HSigmoid layer over the hsigmoid composition
+    (static-graph mode: the composition builds program ops)."""
+
+    def __init__(self, feature_size, num_classes, param_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("HSigmoid: default tree only")
+        self._num_classes = num_classes
+
+    def forward(self, input, label):
+        from ..fluid.layers import hsigmoid as _h
+
+        return _h(input, label, self._num_classes)
+
+
+class Pad2D(Layer):
+    def __init__(self, paddings=0, mode="constant", pad_value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        if data_format != "NCHW":
+            raise NotImplementedError("Pad2D: NCHW only")
+        p = [paddings] * 4 if isinstance(paddings, int) else list(paddings)
+        self._attrs = {"paddings": p, "mode": mode, "pad_value": pad_value}
+
+    def forward(self, x):
+        from ..fluid.layer_helper import emit_op
+
+        return emit_op("pad2d", {"X": [x]}, dict(self._attrs))
+
+
+class UpSample(Layer):
+    def __init__(self, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, align_mode=1, data_format="NCHW"):
+        super().__init__()
+        if data_format != "NCHW":
+            raise NotImplementedError("UpSample: NCHW only")
+        if out_shape is None and scale is None:
+            raise ValueError("UpSample: need out_shape or scale")
+        self._args = (out_shape, scale, resample, align_corners, align_mode)
+
+    def forward(self, x):
+        out_shape, scale, resample, ac, am = self._args
+        op = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+              "TRILINEAR": "trilinear_interp"}[resample.upper()]
+        spatial = list(x.shape[2:])
+        if out_shape is None:
+            out_shape = [int(d * scale) for d in spatial]
+        from ..fluid.layer_helper import emit_op
+
+        attrs = {"align_corners": ac, "align_mode": am}
+        if len(out_shape) == 2:
+            attrs["out_h"], attrs["out_w"] = out_shape
+        else:
+            attrs["out_d"], attrs["out_h"], attrs["out_w"] = out_shape
+        return emit_op(op, {"X": [x]}, attrs)
+
+
+class BCELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid.layer_helper import emit_op
+        from .functional import _reduce_loss
+
+        loss = emit_op("bce_loss", {"X": [input], "Label": [label]})
+        return _reduce_loss(loss, self._reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return functional.l1_loss(input, label, reduction=self._reduction)
+
+
+class NLLLoss(Layer):
+    """Negative log likelihood over LOG-probability inputs; label [N] or
+    [N, 1] int."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid.layer_helper import emit_op
+        from .functional import _reduce_loss
+
+        depth = input.shape[-1]
+        if len(label.shape) == len(input.shape):
+            # [N, 1] -> [N]: one_hot on the trailing singleton would
+            # broadcast to [N, N, C] and silently average cross terms
+            label = emit_op("reshape", {"X": [label]},
+                            {"shape": list(label.shape[:-1])})
+        oh = emit_op("one_hot_v2", {"X": [label]}, {"depth": depth})
+        picked = emit_op(
+            "reduce_sum",
+            {"X": [emit_op("elementwise_mul",
+                           {"X": [input], "Y": [oh]})]},
+            {"dim": [-1], "keep_dim": False})
+        return _reduce_loss(
+            emit_op("scale", {"X": [picked]}, {"scale": -1.0}),
+            self._reduction)

@@ -234,3 +234,67 @@ def spectral_norm(ctx, ins, attrs):
         u = u / (jnp.linalg.norm(u) + eps)
     sigma = u @ mat @ v
     return {"Out": [w / sigma]}
+
+
+@register("histogram", stop_gradient=True, no_vjp_grad=True)
+def histogram(ctx, ins, attrs):
+    """Fixed-bin histogram (reference histogram_op.cc): min==max==0 uses
+    the data's own range."""
+    x = ins["X"][0].reshape(-1).astype(jnp.float32)
+    bins = int(attrs.get("bins", 100))
+    lo = float(attrs.get("min", 0))
+    hi = float(attrs.get("max", 0))
+    if lo == 0.0 and hi == 0.0:
+        lo_v, hi_v = jnp.min(x), jnp.max(x)
+    else:
+        lo_v, hi_v = jnp.float32(lo), jnp.float32(hi)
+    span = jnp.maximum(hi_v - lo_v, 1e-30)
+    idx = jnp.clip(((x - lo_v) / span * bins).astype(jnp.int32), 0, bins - 1)
+    inside = (x >= lo_v) & (x <= hi_v)
+    out = jnp.zeros((bins,), jnp.int32).at[idx].add(inside.astype(jnp.int32))
+    return {"Out": [out]}
+
+
+@register("nonzero_static", stop_gradient=True, no_vjp_grad=True)
+def nonzero_static(ctx, ins, attrs):
+    """Static-shape nonzero: [numel, ndim] indices with the valid rows
+    first (original order) and -1 padding, plus a scalar count."""
+    x = ins["X"][0]
+    flat = (x != 0).reshape(-1)
+    numel = flat.shape[0]
+    order = jnp.argsort(~flat, stable=True)  # nonzero positions first
+    count = flat.sum().astype(jnp.int32)
+    pos = jnp.where(jnp.arange(numel) < count, order, -1)
+    idx = []
+    rem = pos
+    for dim in reversed(x.shape):
+        idx.append(jnp.where(pos >= 0, rem % dim, -1))
+        rem = rem // dim
+    out = jnp.stack(idx[::-1], axis=1).astype(jnp.int32)
+    return {"Out": [out], "Count": [count]}
+
+
+@register("randperm", stop_gradient=True, no_vjp_grad=True)
+def randperm(ctx, ins, attrs):
+    """Random permutation of [0, n) (reference randperm_op.cc)."""
+    from ..fluid.dtypes import convert_dtype
+
+    n = int(attrs["n"])
+    key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+    perm = jax.random.permutation(key, n)
+    return {"Out": [perm.astype(convert_dtype(attrs.get("dtype", "int64")))]}
+
+
+@register("tanh_shrink")
+def tanh_shrink(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x - jnp.tanh(x)]}
+
+
+@register("diag_embed")
+def diag_embed(ctx, ins, attrs):
+    """[..., N] -> [..., N, N] with the input on the main diagonal
+    (reference diag_embed_op.cc, main-diagonal case)."""
+    x = ins["X"][0]
+    n = x.shape[-1]
+    return {"Out": [x[..., None] * jnp.eye(n, dtype=x.dtype)]}

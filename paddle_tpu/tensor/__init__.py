@@ -171,3 +171,182 @@ def numel(x, name=None):
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     return L.scale(x, scale=scale, bias=bias, bias_after_scale=bias_after_scale,
                    act=act)
+
+
+# ---------------------------------------------------------------------------
+# 2.0 breadth: aliases + new tensor ops (reference python/paddle/tensor/)
+# ---------------------------------------------------------------------------
+
+from ..fluid.layers import (  # noqa: F401,E402 — 1.x names kept in 2.0
+    elementwise_add, elementwise_sub, elementwise_mul, elementwise_div,
+    elementwise_floordiv, elementwise_mod, elementwise_pow,
+    elementwise_max, elementwise_min, reduce_all, reduce_any, reduce_max,
+    reduce_min, reduce_mean, reduce_prod, reduce_sum, fill_constant,
+    multiplex, rank, is_empty, crop_tensor, expand, assign, mul,
+    create_tensor, has_inf, has_nan, beam_search, beam_search_decode,
+    gaussian_random, uniform_random,
+)
+from ..fluid.layers.misc import load  # noqa: F401,E402
+
+
+def clamp(x, min=None, max=None, name=None):
+    """2.0 alias of clip."""
+    lo = -3.4e38 if min is None else min
+    hi = 3.4e38 if max is None else max
+    return L.clip(x, lo, hi)
+
+
+def mm(input, mat2, name=None):
+    return L.matmul(input, mat2)
+
+
+def div(x, y, name=None):
+    return L.elementwise_div(x, y)
+
+
+def elementwise_sum(inputs, name=None):
+    return L.sum(inputs)
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """input + value * tensor1 * tensor2 (reference tensor/math.py)."""
+    return L.elementwise_add(
+        input, L.scale(L.elementwise_mul(tensor1, tensor2), scale=value))
+
+
+def cross(x, y, axis=None, name=None):
+    """3-D cross product along `axis` (default: first dim of size 3)."""
+    shape = x.shape
+    if axis is None:
+        axis = next(i for i, s in enumerate(shape) if s == 3)
+
+    def comp(i):
+        return L.squeeze(L.slice(x, [axis], [i], [i + 1]), [axis]), \
+            L.squeeze(L.slice(y, [axis], [i], [i + 1]), [axis])
+
+    (x0, y0), (x1, y1), (x2, y2) = comp(0), comp(1), comp(2)
+    c0 = L.elementwise_sub(L.elementwise_mul(x1, y2), L.elementwise_mul(x2, y1))
+    c1 = L.elementwise_sub(L.elementwise_mul(x2, y0), L.elementwise_mul(x0, y2))
+    c2 = L.elementwise_sub(L.elementwise_mul(x0, y1), L.elementwise_mul(x1, y0))
+    return L.stack([c0, c1, c2], axis=axis)
+
+
+def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) (reference tensor/linalg.py dist)."""
+    d = L.elementwise_sub(x, y)
+    if p == 0:
+        nz = L.cast(L.not_equal(d, L.zeros_like(d)), "float32")
+        return L.reduce_sum(nz)
+    if p == float("inf"):
+        return L.reduce_max(L.abs(d))
+    if p == float("-inf"):
+        return L.reduce_min(L.abs(d))
+    powd = L.elementwise_pow(
+        L.abs(d), L.fill_constant([1], "float32", float(p)))
+    return L.elementwise_pow(
+        L.reduce_sum(powd), L.fill_constant([1], "float32", 1.0 / p))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    """Histogram with static bins (reference tensor/linalg.py histogram);
+    min == max == 0 uses the data range like the reference."""
+    from ..fluid.layer_helper import emit_op
+
+    return emit_op("histogram", {"X": [input]},
+                   {"bins": int(bins), "min": float(min), "max": float(max)},
+                   out_dtype="int32")
+
+
+def index_sample(x, index):
+    """Per-row gather: out[i, j] = x[i, index[i, j]] (reference
+    tensor/search.py index_sample)."""
+    return L.take_along_axis(x, index, axis=1)
+
+
+def nonzero(x, as_tuple=False):
+    """Indices of non-zero elements. STATIC-shape contract: returns
+    ([numel, ndim] padded with -1 rows, count) — XLA cannot emit
+    data-dependent shapes; slice host-side with the count."""
+    from ..fluid.layer_helper import emit_op
+
+    out, count = emit_op("nonzero_static", {"X": [x]}, {},
+                         out_slots=("Out", "Count"), out_dtype="int32")
+    if as_tuple:
+        raise NotImplementedError("nonzero(as_tuple=True): use the padded "
+                                  "[numel, ndim] form on TPU")
+    return out, count
+
+
+def equal_all(x, y, name=None):
+    return L.reduce_all(L.cast(L.equal(x, y), "bool"))
+
+
+def rand(shape, dtype="float32", name=None):
+    return L.uniform_random(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype="float32", name=None):
+    return L.gaussian_random(shape, mean=0.0, std=1.0, dtype=dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    u = L.uniform_random(list(shape), "float32", min=float(low),
+                         max=float(high))
+    # floor, not trunc: int cast truncates toward zero, which doubles the
+    # mass at 0 and starves `low` whenever low < 0
+    return L.cast(L.floor(u), dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    from ..fluid.layer_helper import emit_op
+    from ..fluid.layers.nn import _rng_salt_counter
+
+    _rng_salt_counter[0] += 1
+    return emit_op("randperm", {}, {"n": int(n), "dtype": dtype,
+                                    "rng_salt": _rng_salt_counter[0]},
+                   out_dtype=dtype)
+
+
+from ..fluid.layers import (  # noqa: F401,E402
+    scatter_nd, shard_index, slice, strided_slice, stanh, unique,
+    unique_with_counts, shape, reverse, sum as sums,
+)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    """Returns (sorted values, indices) like the reference tensor.sort."""
+    sorted_x, idx = L.argsort(x, axis=axis, descending=descending)
+    return sorted_x, idx
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return L.sqrt(var(x, axis=axis, unbiased=unbiased, keepdim=keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    import numpy as _np
+
+    dims = (list(range(len(x.shape))) if axis is None
+            else [axis] if isinstance(axis, int) else list(axis))
+    n = int(_np.prod([x.shape[d] for d in dims]))
+    mean = L.reduce_mean(x, dim=dims, keep_dim=True)
+    sq = L.square(L.elementwise_sub(x, mean))
+    out = L.reduce_mean(sq, dim=dims, keep_dim=keepdim)
+    if unbiased and n > 1:
+        out = L.scale(out, scale=n / (n - 1))
+    return out
+
+
+def shuffle(x, name=None):
+    """Random row permutation (reference paddle.shuffle)."""
+    perm = randperm(x.shape[0])
+    return L.gather(x, perm)
+
+
+def save(x, path):
+    """Persist one tensor to an .npy file (reference tensor save op)."""
+    import numpy as _np
+
+    _np.save(path, _np.asarray(x))

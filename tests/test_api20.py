@@ -121,3 +121,167 @@ def test_nn_sequential_dygraph():
         loss = loss_fn(out, tgt)
         loss.backward()
         assert all(p.grad is not None for p in net.parameters())
+
+
+# ---------------------------------------------------------------------------
+# 2.0 namespace breadth: paddle.nn 167/167, paddle.tensor additions
+# ---------------------------------------------------------------------------
+
+
+def test_nn_namespace_complete_vs_reference():
+    import paddle_tpu.nn as nn
+
+    expect = ["BCELoss", "CrossEntropyLoss", "L1Loss", "MSELoss", "NLLLoss",
+              "LeakyReLU", "LogSoftmax", "ReLU", "Sigmoid", "Pad2D",
+              "UpSample", "HSigmoid", "Xavier", "MSRA", "Constant",
+              "GradientClipByGlobalNorm", "conv3d", "multiclass_nms",
+              "interpolate", "Bilinear", "diag_embed", "tanh_shrink"]
+    for n in expect:
+        assert hasattr(nn, n), n
+
+
+def test_nn_loss_classes_dygraph():
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.fluid import dygraph
+
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        pred = dygraph.to_variable(rng.rand(4, 3).astype("f4"))
+        prob = dygraph.to_variable(rng.rand(4, 3).astype("f4") * 0.8 + 0.1)
+        tgt = dygraph.to_variable(rng.rand(4, 3).astype("f4"))
+        lbl = dygraph.to_variable(rng.randint(0, 3, (4, 1)).astype("i8"))
+        mse = nn.MSELoss()(pred, tgt)
+        np.testing.assert_allclose(
+            np.asarray(mse.numpy()).reshape(()),
+            ((np.asarray(pred.numpy()) - np.asarray(tgt.numpy())) ** 2).mean(),
+            rtol=1e-5)
+        l1 = nn.L1Loss()(pred, tgt)
+        np.testing.assert_allclose(
+            np.asarray(l1.numpy()).reshape(()),
+            np.abs(np.asarray(pred.numpy()) - np.asarray(tgt.numpy())).mean(),
+            rtol=1e-5)
+        ce = nn.CrossEntropyLoss()(pred, lbl)
+        assert np.isfinite(np.asarray(ce.numpy())).all()
+        bce = nn.BCELoss()(prob, tgt)
+        assert np.isfinite(np.asarray(bce.numpy())).all()
+        relu_out = nn.ReLU()(pred - 0.5)
+        assert np.asarray(relu_out.numpy()).min() >= 0
+        up = nn.UpSample(out_shape=[4, 4])(
+            dygraph.to_variable(rng.rand(1, 1, 2, 2).astype("f4")))
+        assert up.shape == (1, 1, 4, 4)
+
+
+def test_tensor_20_additions():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = fluid.data("y", [4, 3], "float32")
+        s, idx = paddle.sort(x, axis=1)
+        vv = paddle.var(x)
+        sd = paddle.std(x)
+        cl = paddle.clamp(x, 0.0, 0.5)
+        ac = paddle.addcmul(x, x, y, value=2.0)
+        cr = paddle.cross(
+            fluid.layers.reshape(fluid.layers.slice(x, [0], [0], [3]), [3, 3]),
+            fluid.layers.reshape(fluid.layers.slice(y, [0], [0], [3]), [3, 3]),
+            axis=1)
+        d2 = paddle.dist(x, y, 2)
+        hist = paddle.histogram(x, bins=4, min=-1, max=1)
+        isamp = paddle.index_sample(
+            x, fluid.layers.assign(
+                __import__("numpy").asarray([[0, 2]] * 4, "i4")))
+        nz, cnt = paddle.nonzero(x)
+        rp = paddle.randperm(6)
+        eq = paddle.equal_all(x, x)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3).astype("f4")
+    yv = rng.randn(4, 3).astype("f4")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": xv, "y": yv},
+                       fetch_list=[s, vv, sd, cl, ac, cr, d2, hist, isamp,
+                                   nz, cnt, rp, eq])
+    s_v, var_v, std_v, cl_v, ac_v, cr_v, d2_v, h_v, is_v, nz_v, cnt_v, rp_v, eq_v = [
+        np.asarray(o) for o in outs]
+    np.testing.assert_allclose(s_v, np.sort(xv, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(var_v.reshape(()), xv.var(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(std_v.reshape(()), xv.std(ddof=1), rtol=1e-5)
+    assert cl_v.min() >= 0 and cl_v.max() <= 0.5
+    np.testing.assert_allclose(ac_v, xv + 2 * xv * yv, rtol=1e-5)
+    np.testing.assert_allclose(cr_v, np.cross(xv[:3], yv[:3]), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(d2_v.reshape(()),
+                               np.linalg.norm(xv - yv), rtol=1e-5)
+    assert h_v.sum() == ((xv >= -1) & (xv <= 1)).sum()
+    np.testing.assert_allclose(is_v, xv[:, [0, 2]], rtol=1e-6)
+    assert int(cnt_v) == (xv != 0).sum()
+    assert sorted(rp_v.tolist()) == list(range(6))
+    assert bool(eq_v)
+
+
+def test_nn_loss_classes_static_mode():
+    """Loss classes must work in STATIC graph mode too (mode-dispatching
+    emit_op, not dygraph-only tracing)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.nn as nn
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = fluid.data("y", [4, 3], "float32")
+        l = fluid.data("l", [4, 1], "int64")
+        logp = fluid.data("logp", [4, 3], "float32")
+        mse = nn.MSELoss()(x, y)
+        l1 = nn.L1Loss()(x, y)
+        ce = nn.CrossEntropyLoss()(x, l)
+        nll = nn.NLLLoss()(logp, l)
+        act = nn.LeakyReLU(0.1)(x)
+    rng = np.random.RandomState(0)
+    feed = {
+        "x": rng.rand(4, 3).astype("f4"), "y": rng.rand(4, 3).astype("f4"),
+        "l": rng.randint(0, 3, (4, 1)).astype("i8"),
+        "logp": np.log(np.full((4, 3), 1 / 3, "f4")),
+    }
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        mv, lv, cv, nv, av = exe.run(
+            main, feed=feed, fetch_list=[mse, l1, ce, nll, act])
+    np.testing.assert_allclose(
+        np.asarray(mv).reshape(()),
+        ((feed["x"] - feed["y"]) ** 2).mean(), rtol=1e-5)
+    # NLLLoss with [N,1] label: exactly -mean(logp[label]) = log(3)
+    np.testing.assert_allclose(np.asarray(nv).reshape(()), np.log(3),
+                               rtol=1e-5)
+    assert np.isfinite(np.asarray(cv)).all()
+    assert np.asarray(av).shape == (4, 3)
+
+
+def test_randint_low_negative_unbiased():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = paddle.randint(-2, 2, shape=[4000])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (rv,) = exe.run(main, feed={}, fetch_list=[r])
+    rv = np.asarray(rv)
+    counts = {v: (rv == v).sum() for v in (-2, -1, 0, 1)}
+    assert rv.min() == -2 and rv.max() == 1
+    for v, c in counts.items():
+        assert 800 < c < 1200, counts  # ~uniform, no doubled 0 mass

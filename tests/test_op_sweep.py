@@ -2901,6 +2901,49 @@ def _gather_tree():
     return OpTest("gather_tree", {"Ids": ids, "Parents": parents}, oracle)
 
 
+unary("tanh_shrink", lambda x, a: x - np.tanh(x))
+
+
+@case("diag_embed")
+def _diag_embed():
+    rng = R(77)
+    x = _mix(rng, 2, 4)
+
+    def oracle(ins, a):
+        out = np.zeros((2, 4, 4), np.float32)
+        for b in range(2):
+            np.fill_diagonal(out[b], ins["X"][0][b])
+        return {"Out": [out]}
+
+    return OpTest("diag_embed", {"X": x}, oracle, grad=("X",))
+
+
+@case("histogram")
+def _histogram():
+    x = np.asarray([0.1, 0.2, 0.55, 0.9, 0.95, 2.0], np.float32)
+
+    def oracle(ins, a):
+        return {"Out": [np.histogram(x, bins=4, range=(0, 1))[0]
+                        .astype(np.int32)]}
+
+    return OpTest("histogram", {"X": x}, oracle,
+                  attrs={"bins": 4, "min": 0.0, "max": 1.0})
+
+
+@case("nonzero_static")
+def _nonzero_static():
+    x = np.asarray([[0, 3, 0], [2, 0, 1]], np.float32)
+
+    def oracle(ins, a):
+        idx = np.argwhere(x != 0).astype(np.int32)
+        pad = np.full((x.size - len(idx), 2), -1, np.int32)
+        return {"Out": [np.concatenate([idx, pad])],
+                "Count": [np.int32(len(idx))]}
+
+    return OpTest("nonzero_static", {"X": x}, oracle,
+                  outputs={"Out": 1, "Count": 1})
+
+
 # ---------------------------------------------------------------------------
 # exemptions: ops whose contract is verified elsewhere or is stochastic
 # ---------------------------------------------------------------------------
@@ -2989,6 +3032,7 @@ EXEMPT = {
     "unique_with_counts": "test_layers_breadth.py",
     "hash": "test_layers_breadth.py (determinism/range/spread)",
     "sampling_id": "test_layers_breadth.py (distribution check)",
+    "randperm": "test_api20.py (permutation property; stochastic)",
     # stochastic draws: distribution checked in test_random_ops below
     "uniform_random": "test_random_ops",
     "gaussian_random": "test_random_ops",
