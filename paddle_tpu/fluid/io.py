@@ -321,3 +321,40 @@ def load(program, model_path: str, executor=None):
     restored = ckptr.restore(path + ".ckpt")
     for n, a in restored.items():
         scope.set_var(n.replace("__slash__", "/"), jax.numpy.asarray(a))
+
+
+# ---------------------------------------------------------------------------
+# train-model export/import: the C++ training-driver story (reference
+# fluid/train/demo — train a saved program WITHOUT Python on the driver
+# side; here the C API embeds CPython and drives this loader)
+# ---------------------------------------------------------------------------
+
+
+def save_train_model(executor, dirname, feed_names, loss, main_program=None,
+                     startup_program=None):
+    """Serialize the FULL training program (forward+backward+optimizer),
+    its startup program, the feed/loss names, and current persistables."""
+    main_program = main_program or framework.default_main_program()
+    startup_program = startup_program or framework.default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__train_model__"), "wb") as f:
+        pickle.dump({
+            "version": 1,
+            "main": _serialize_program(main_program),
+            "startup": _serialize_program(startup_program),
+            "feed_names": list(feed_names),
+            "loss_name": loss if isinstance(loss, str) else loss.name,
+        }, f)
+    save_persistables(executor, dirname, main_program=main_program)
+
+
+def load_train_model(executor, dirname):
+    """Returns (main_program, startup_program, feed_names, loss_name);
+    runs the startup program and restores saved persistables."""
+    with open(os.path.join(dirname, "__train_model__"), "rb") as f:
+        meta = pickle.load(f)
+    main = _deserialize_program(meta["main"])
+    startup = _deserialize_program(meta["startup"])
+    executor.run(startup)
+    load_persistables(executor, dirname, main_program=main)
+    return main, startup, meta["feed_names"], meta["loss_name"]

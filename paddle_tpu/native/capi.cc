@@ -266,4 +266,112 @@ long long PD_GetOutputFloat(void* h, const char* name, float* buf,
   return n;
 }
 
+// ---------------------------------------------------------------------------
+// training API (reference fluid/train/demo: drive training without a
+// Python script) — PD_Trainer* over native/train_host.py CTrainer
+// ---------------------------------------------------------------------------
+
+void* PD_TrainerCreate(const char* model_dir, const char** err) {
+  if (err) *err = nullptr;
+  ensure_interpreter();
+  PyGILState_STATE g = PyGILState_Ensure();
+  void* out = nullptr;
+  PyObject* cls = import_attr("paddle_tpu.native.train_host", "CTrainer");
+  if (cls) {
+    PyObject* tr = PyObject_CallFunction(cls, "s", model_dir);
+    if (tr) out = new Predictor{tr};
+    Py_DECREF(cls);
+  }
+  if (!out) capture_py_err(err);
+  PyGILState_Release(g);
+  return out;
+}
+
+void PD_TrainerDestroy(void* h) { PD_PredictorDestroy(h); }
+
+namespace {
+// shared zero-copy feed path for the trainer: memoryview -> np.frombuffer
+// -> reshape -> copy (same pattern as PD_SetInputFloat above)
+int trainer_set_input(void* h, const char* name, const void* data,
+                      size_t elem_size, const char* np_dtype,
+                      const long long* shape, int ndim, const char** err) {
+  if (err) *err = nullptr;
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np) {
+    long long total = 1;
+    for (int i = 0; i < ndim; ++i) total *= shape[i];
+    PyObject* mem = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<void*>(data)),
+        total * elem_size, PyBUF_READ);
+    PyObject* flat =
+        mem ? PyObject_CallMethod(np, "frombuffer", "Os", mem, np_dtype)
+            : nullptr;
+    PyObject* shp = PyList_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+      PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+    if (flat) {
+      PyObject* r = PyObject_CallMethod(
+          static_cast<Predictor*>(h)->obj, "set_input", "sOOs", name, flat,
+          shp, np_dtype);
+      if (r) {
+        rc = 0;
+        Py_DECREF(r);
+      }
+    }
+    Py_XDECREF(shp);
+    Py_XDECREF(flat);
+    Py_XDECREF(mem);
+    Py_DECREF(np);
+  }
+  if (rc != 0) capture_py_err(err);
+  PyGILState_Release(g);
+  return rc;
+}
+}  // namespace
+
+int PD_TrainerSetInputFloat(void* h, const char* name, const float* data,
+                            const long long* shape, int ndim,
+                            const char** err) {
+  return trainer_set_input(h, name, data, sizeof(float), "float32", shape,
+                           ndim, err);
+}
+
+int PD_TrainerSetInputInt(void* h, const char* name, const long long* data,
+                          const long long* shape, int ndim,
+                          const char** err) {
+  return trainer_set_input(h, name, data, sizeof(long long), "int64", shape,
+                           ndim, err);
+}
+
+// Runs one train step; returns 0 and writes the loss, or -1 on error.
+int PD_TrainerRunStep(void* h, double* loss_out, const char** err) {
+  if (err) *err = nullptr;
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ok = -1;
+  PyObject* r = PyObject_CallMethod(static_cast<Predictor*>(h)->obj,
+                                    "run_step", nullptr);
+  if (r) {
+    if (loss_out) *loss_out = PyFloat_AsDouble(r);
+    ok = PyErr_Occurred() ? -1 : 0;
+    Py_DECREF(r);
+  }
+  if (ok != 0) capture_py_err(err);
+  PyGILState_Release(g);
+  return ok;
+}
+
+int PD_TrainerSave(void* h, const char* dirname, const char** err) {
+  if (err) *err = nullptr;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(static_cast<Predictor*>(h)->obj, "save",
+                                    "s", dirname);
+  int ok = r ? 0 : -1;
+  if (!r) capture_py_err(err);
+  Py_XDECREF(r);
+  PyGILState_Release(g);
+  return ok;
+}
+
 }  // extern "C"

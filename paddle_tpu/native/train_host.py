@@ -1,0 +1,51 @@
+"""Python host object behind the C training API (capi.cc PD_Trainer*).
+
+Reference story: fluid/train/demo drives training from C++ without a
+Python script. Here the C side embeds CPython and calls this class: it
+loads a fluid.io.save_train_model directory, accepts named feeds, runs
+whole-block-compiled train steps, and reports the loss.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class CTrainer:
+    def __init__(self, model_dir: str):
+        import paddle_tpu.fluid as fluid
+
+        self._fluid = fluid
+        self._exe = fluid.Executor()
+        self._scope = fluid.executor.Scope()
+        with fluid.scope_guard(self._scope):
+            (self._main, self._startup, self._feed_names,
+             self._loss_name) = fluid.io.load_train_model(self._exe, model_dir)
+        self._feed = {}
+
+    def get_feed_names(self):
+        return list(self._feed_names)
+
+    def get_loss_name(self):
+        return self._loss_name
+
+    def set_input(self, name, flat_values, shape, dtype="float32"):
+        # copy=True: the C caller may hand a memoryview aliasing its own
+        # buffer, which it is free to reuse right after this call returns
+        self._feed[name] = np.array(
+            flat_values, dtype=dtype, copy=True).reshape(
+                [int(s) for s in shape])
+
+    def run_step(self) -> float:
+        missing = [n for n in self._feed_names if n not in self._feed]
+        if missing:
+            raise ValueError(f"CTrainer: missing feeds {missing}")
+        with self._fluid.scope_guard(self._scope):
+            (loss,) = self._exe.run(self._main, feed=self._feed,
+                                    fetch_list=[self._loss_name])
+        return float(np.asarray(loss).reshape(()))
+
+    def save(self, dirname):
+        with self._fluid.scope_guard(self._scope):
+            self._fluid.io.save_train_model(
+                self._exe, dirname, self._feed_names, self._loss_name,
+                main_program=self._main, startup_program=self._startup)
