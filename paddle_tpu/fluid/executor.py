@@ -129,7 +129,7 @@ class Executor:
         # the last good parameters after the raise" impossible), so the
         # compile cache must distinguish the two modes
         check_nan = flag("FLAGS_check_nan_inf")
-        key = (id(program), program._version, feed_sig, fetch_names, check_nan)
+        key = (program._serial, program._version, feed_sig, fetch_names, check_nan)
         compiled = self._cache.get(key)
         if compiled is None:
             with RecordEvent("Executor::compile"):

@@ -15,6 +15,7 @@ TPU-native design notes (vs the reference):
 from __future__ import annotations
 
 import contextlib
+import itertools
 import copy
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -310,6 +311,9 @@ class Block:
         return "\n".join(lines)
 
 
+_program_serial_counter = itertools.count()
+
+
 class Program:
     """A list of blocks; block 0 is global. Reference: framework.py:3857."""
 
@@ -318,6 +322,10 @@ class Program:
         self.current_block_idx = 0
         self.random_seed = 0
         self._version = 0
+        # monotonic identity for the executor compile cache: id() can be
+        # REUSED by CPython after a Program is GC'd, aliasing a stale
+        # cache entry when feed/fetch signatures happen to match
+        self._serial = next(_program_serial_counter)
         # set by AMP / fleet passes; consumed by the Executor
         self._amp_enabled = False
         self._mesh = None  # paddle_tpu.parallel mesh attached by fleet
@@ -367,6 +375,7 @@ class Program:
         p.current_block_idx = 0
         p.random_seed = self.random_seed
         p._version = 0
+        p._serial = next(_program_serial_counter)  # own compile-cache identity
         p._amp_enabled = self._amp_enabled
         p._mesh = self._mesh
         for b in self.blocks:

@@ -1,0 +1,128 @@
+"""Map-style Dataset classes over the built-in loaders (reference
+python/paddle/incubate/hapi/datasets/: MNIST, Cifar, Imdb, UCIHousing,
+Flowers, VOC2012...). Usable directly with paddle.io.DataLoader
+(multiprocess workers) and hapi Model.fit."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.dataloader import Dataset
+
+__all__ = ["MNIST", "Cifar10", "Imdb", "UCIHousing", "Flowers", "VOC2012",
+           "Movielens", "WMT16", "Conll05st"]
+
+
+class _ReaderDataset(Dataset):
+    """Materialize a reader-creator's samples once (the synthetic/cached
+    sets are small); index them map-style."""
+
+    def __init__(self, reader):
+        self._samples = list(reader())
+
+    def __getitem__(self, i):
+        return self._samples[i]
+
+    def __len__(self):
+        return len(self._samples)
+
+
+def _check_mode(mode, allowed):
+    if mode not in allowed:
+        raise ValueError(f"mode must be one of {allowed}, got {mode!r}")
+
+
+class _ImageDataset(_ReaderDataset):
+    """Shared (image, label) dataset with an optional transform."""
+
+    def __init__(self, reader, transform=None):
+        super().__init__(reader)
+        self._transform = transform
+
+    def __getitem__(self, i):
+        img, lbl = self._samples[i]
+        if self._transform is not None:
+            img = self._transform(img)
+        return img, np.asarray([lbl], np.int64)
+
+
+class MNIST(_ImageDataset):
+    def __init__(self, mode="train", transform=None):
+        from ..dataset import mnist
+
+        _check_mode(mode, ("train", "test"))
+        super().__init__(mnist.train() if mode == "train" else mnist.test(),
+                         transform)
+
+
+class Cifar10(_ImageDataset):
+    def __init__(self, mode="train", transform=None):
+        from ..dataset import cifar
+
+        _check_mode(mode, ("train", "test"))
+        super().__init__(
+            cifar.train10() if mode == "train" else cifar.test10(), transform)
+
+
+class Imdb(_ReaderDataset):
+    def __init__(self, mode="train"):
+        from ..dataset import imdb
+
+        _check_mode(mode, ("train", "test"))
+        wd = imdb.word_dict()
+        super().__init__(imdb.train(wd) if mode == "train" else imdb.test(wd))
+        self.word_idx = wd
+
+
+class UCIHousing(_ReaderDataset):
+    def __init__(self, mode="train"):
+        from ..dataset import uci_housing
+
+        _check_mode(mode, ("train", "test"))
+        super().__init__(
+            uci_housing.train() if mode == "train" else uci_housing.test())
+
+
+class Flowers(_ImageDataset):
+    def __init__(self, mode="train", transform=None):
+        from ..dataset import flowers
+
+        _check_mode(mode, ("train", "test", "valid"))
+        r = {"train": flowers.train, "test": flowers.test,
+             "valid": flowers.valid}[mode]
+        super().__init__(r(), transform)
+
+
+class VOC2012(_ReaderDataset):
+    def __init__(self, mode="train"):
+        from ..dataset import voc2012
+
+        r = {"train": voc2012.train, "test": voc2012.test,
+             "val": voc2012.val}[mode]
+        super().__init__(r())
+
+
+class Movielens(_ReaderDataset):
+    def __init__(self, mode="train"):
+        from ..dataset import movielens
+
+        _check_mode(mode, ("train", "test"))
+        super().__init__(
+            movielens.train() if mode == "train" else movielens.test())
+
+
+class WMT16(_ReaderDataset):
+    def __init__(self, mode="train", src_dict_size=10000, trg_dict_size=10000):
+        from ..dataset import wmt16
+
+        r = {"train": wmt16.train, "test": wmt16.test,
+             "val": wmt16.validation}[mode]
+        super().__init__(r(src_dict_size, trg_dict_size))
+
+
+class Conll05st(_ReaderDataset):
+    def __init__(self, mode="test"):
+        from ..dataset import conll05
+
+        _check_mode(mode, ("train", "test"))
+        super().__init__(
+            conll05.test() if mode == "test" else conll05.train())

@@ -227,3 +227,41 @@ def test_model_image_classification_cifar():
     model.fit((x, y), batch_size=64, epochs=4, verbose=0)
     logs = model.evaluate((x, y), batch_size=64, verbose=0)
     assert logs["acc"] > 0.9, logs
+
+
+def test_hapi_datasets_with_dataloader():
+    """hapi map-style datasets feed paddle.io.DataLoader workers."""
+    import numpy as np
+
+    from paddle_tpu.hapi import datasets
+    from paddle_tpu.io import DataLoader
+
+    ds = datasets.MNIST(mode="test")
+    assert len(ds) > 100
+    img, lbl = ds[0]
+    assert img.shape == (784,) and lbl.shape == (1,)
+    loader = DataLoader(ds, batch_size=32, return_list=True, num_workers=2)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (32, 784) and yb.shape == (32, 1)
+
+    uci = datasets.UCIHousing(mode="test")
+    f, t = uci[0]
+    assert np.asarray(f).shape[-1] == 13
+
+    wmt = datasets.WMT16(mode="test", src_dict_size=40, trg_dict_size=40)
+    src, trg_in, trg_next = wmt[0]
+    assert trg_in[0] == 0
+
+
+def test_hapi_datasets_reject_bad_mode_and_clone_serial():
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.hapi import datasets
+
+    with pytest.raises(ValueError, match="mode"):
+        datasets.MNIST(mode="valid")
+    # cloned programs get their own compile-cache identity
+    p = fluid.Program()
+    c = p.clone(for_test=True)
+    assert hasattr(c, "_serial") and c._serial != p._serial

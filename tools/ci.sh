@@ -23,7 +23,7 @@ import __graft_entry__ as g
 
 fn, args = g.entry()
 out = fn(*args)
-print("entry() compiled and ran:", {k: v.shape for k, v in out.items()})
+print("entry() compiled and ran:", [getattr(v, "shape", None) for v in out])
 PY
 
 echo "== sdist build =="
