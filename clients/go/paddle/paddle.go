@@ -1,0 +1,163 @@
+// Package paddle: Go inference client over the paddle_tpu C API
+// (reference go/paddle/{config,predictor,tensor}.go over inference/capi/).
+//
+// Build: requires cgo and the shim path at runtime:
+//
+//	CGO_LDFLAGS="-ldl" go build ./...
+//	p, err := paddle.NewPredictor(shimPath, modelDir)
+//
+// NOTE: no Go toolchain exists in the development image, so this file is
+// compile-checked only by consumers; it mirrors native/capi_example.c,
+// which IS tested (tests/test_inference.py, tests/test_capi_train.py).
+package paddle
+
+/*
+#cgo LDFLAGS: -ldl
+#include <dlfcn.h>
+#include <stdlib.h>
+
+typedef void* (*pd_create_fn)(const char*, const char**);
+typedef void (*pd_destroy_fn)(void*);
+typedef int (*pd_set_in_fn)(void*, const char*, const float*, const long long*, int, const char**);
+typedef int (*pd_run_fn)(void*, const char**);
+typedef long long (*pd_get_out_fn)(void*, const char*, float*, long long, long long*, int, int*, const char**);
+
+static void* pd_create(void* f, const char* dir, const char** err) {
+    return ((pd_create_fn)f)(dir, err);
+}
+static void pd_destroy(void* f, void* h) { ((pd_destroy_fn)f)(h); }
+static int pd_set_in(void* f, void* h, const char* n, const float* d,
+                     const long long* s, int nd, const char** err) {
+    return ((pd_set_in_fn)f)(h, n, d, s, nd, err);
+}
+static int pd_run(void* f, void* h, const char** err) {
+    return ((pd_run_fn)f)(h, err);
+}
+static long long pd_get_out(void* f, void* h, const char* n, float* buf,
+                            long long cap, long long* shape, int max_ndim,
+                            int* ndim, const char** err) {
+    return ((pd_get_out_fn)f)(h, n, buf, cap, shape, max_ndim, ndim, err);
+}
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Predictor wraps a PD_Predictor handle from the dlopen'd C shim.
+type Predictor struct {
+	lib     unsafe.Pointer
+	handle  unsafe.Pointer
+	destroy unsafe.Pointer
+	setIn   unsafe.Pointer
+	run     unsafe.Pointer
+	getOut  unsafe.Pointer
+}
+
+func sym(lib unsafe.Pointer, name string) (unsafe.Pointer, error) {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	p := C.dlsym(lib, cn)
+	if p == nil {
+		return nil, errors.New("missing symbol " + name)
+	}
+	return p, nil
+}
+
+func cerr(err *C.char) error {
+	if err == nil {
+		return errors.New("unknown C API error")
+	}
+	return errors.New(C.GoString(err))
+}
+
+// NewPredictor dlopens the shim and loads a saved inference model.
+func NewPredictor(shimPath, modelDir string) (*Predictor, error) {
+	cs := C.CString(shimPath)
+	defer C.free(unsafe.Pointer(cs))
+	lib := C.dlopen(cs, C.RTLD_NOW|C.RTLD_GLOBAL)
+	if lib == nil {
+		return nil, errors.New("dlopen failed: " + C.GoString(C.dlerror()))
+	}
+	create, err := sym(lib, "PD_PredictorCreate")
+	if err != nil {
+		return nil, err
+	}
+	p := &Predictor{lib: lib}
+	if p.destroy, err = sym(lib, "PD_PredictorDestroy"); err != nil {
+		return nil, err
+	}
+	if p.setIn, err = sym(lib, "PD_SetInputFloat"); err != nil {
+		return nil, err
+	}
+	if p.run, err = sym(lib, "PD_PredictorRun"); err != nil {
+		return nil, err
+	}
+	if p.getOut, err = sym(lib, "PD_GetOutputFloat"); err != nil {
+		return nil, err
+	}
+	cd := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cd))
+	var msg *C.char
+	h := C.pd_create(create, cd, (**C.char)(unsafe.Pointer(&msg)))
+	if h == nil {
+		return nil, cerr(msg)
+	}
+	p.handle = h
+	return p, nil
+}
+
+// SetInputFloat feeds a float32 tensor by name.
+func (p *Predictor) SetInputFloat(name string, data []float32, shape []int64) error {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	var msg *C.char
+	rc := C.pd_set_in(p.setIn, p.handle, cn,
+		(*C.float)(unsafe.Pointer(&data[0])),
+		(*C.longlong)(unsafe.Pointer(&shape[0])), C.int(len(shape)),
+		(**C.char)(unsafe.Pointer(&msg)))
+	if rc != 0 {
+		return cerr(msg)
+	}
+	return nil
+}
+
+// Run executes the loaded model.
+func (p *Predictor) Run() error {
+	var msg *C.char
+	if C.pd_run(p.run, p.handle, (**C.char)(unsafe.Pointer(&msg))) != 0 {
+		return cerr(msg)
+	}
+	return nil
+}
+
+// GetOutputFloat copies a named float32 output into buf, returning the
+// element count and shape.
+func (p *Predictor) GetOutputFloat(name string, buf []float32) (int64, []int64, error) {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	var msg *C.char
+	var shape [8]C.longlong
+	var ndim C.int
+	n := C.pd_get_out(p.getOut, p.handle, cn,
+		(*C.float)(unsafe.Pointer(&buf[0])), C.longlong(len(buf)),
+		&shape[0], 8, &ndim, (**C.char)(unsafe.Pointer(&msg)))
+	if n < 0 {
+		return 0, nil, cerr(msg)
+	}
+	dims := make([]int64, int(ndim))
+	for i := range dims {
+		dims[i] = int64(shape[i])
+	}
+	return int64(n), dims, nil
+}
+
+// Destroy releases the predictor.
+func (p *Predictor) Destroy() {
+	if p.handle != nil {
+		C.pd_destroy(p.destroy, p.handle)
+		p.handle = nil
+	}
+}

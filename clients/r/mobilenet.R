@@ -1,0 +1,25 @@
+# R inference client example (reference r/example/mobilenet.r): drives
+# the paddle_tpu C API's scripting entry PD_RunOnce through dyn.load/.C.
+# PD_RunOnce takes int32 shapes precisely so base-R .C can call it
+# (R has no int64); the same entry is exercised by
+# tests/test_inference.py::test_pd_run_once_scripting_entry via ctypes.
+#
+#   Rscript mobilenet.R <shim.so> <model_dir> <input_name> <output_name>
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 4) {
+  stop("usage: Rscript mobilenet.R <shim.so> <model_dir> <input> <output>")
+}
+dyn.load(args[[1]])
+
+x <- runif(4 * 8)
+res <- .C("PD_RunOnce",
+          as.character(args[[2]]),        # model_dir
+          as.character(args[[3]]),        # input name
+          as.single(x),                   # data
+          as.integer(c(4L, 8L)),          # shape (int32)
+          as.integer(2L),                 # ndim
+          as.character(args[[4]]),        # output name
+          out = single(64),               # output buffer
+          as.double(64),                  # capacity (long long via double)
+          character(1))                   # err (opaque)
+cat("output head:", head(res$out), "\n")

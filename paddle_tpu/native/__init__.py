@@ -285,6 +285,13 @@ def load_capi():
                 ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int),
                 ctypes.POINTER(ctypes.c_char_p)]
+            lib.PD_RunOnce.restype = ctypes.c_longlong
+            lib.PD_RunOnce.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+                ctypes.c_longlong, ctypes.POINTER(ctypes.c_char_p)]
             _capi_lib = lib
         except Exception as e:  # noqa: BLE001 — record and report
             _capi_err = str(e)

@@ -374,4 +374,32 @@ int PD_TrainerSave(void* h, const char* dirname, const char** err) {
   return ok;
 }
 
+// One-shot scripting entry (R/other .C-style FFIs that cannot hold
+// opaque handles): load model, feed one float tensor, run, copy one
+// float output. Returns the output element count, or -1 on error.
+long long PD_RunOnce(const char* model_dir, const char* input_name,
+                     const float* data, const int* shape, int ndim,
+                     const char* output_name, float* out, long long out_cap,
+                     const char** err) {
+  if (err) *err = nullptr;
+  if (ndim < 0 || ndim > 16) {
+    set_err(err, "PD_RunOnce: ndim must be in [0, 16]");
+    return -1;
+  }
+  void* h = PD_PredictorCreate(model_dir, err);
+  if (!h) return -1;
+  long long shape64[16];
+  for (int i = 0; i < ndim; ++i) shape64[i] = shape[i];
+  long long n = -1;
+  if (PD_SetInputFloat(h, input_name, data, shape64, ndim, err) == 0 &&
+      PD_PredictorRun(h, err) == 0) {
+    long long out_shape[8];
+    int out_ndim = 0;
+    n = PD_GetOutputFloat(h, output_name, out, out_cap, out_shape, 8,
+                          &out_ndim, err);
+  }
+  PD_PredictorDestroy(h);
+  return n;
+}
+
 }  // extern "C"

@@ -158,3 +158,38 @@ def test_c_api_standalone_binary(saved_model, tmp_path):
                        env=env, timeout=180)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "4 elems" in r.stdout  # [4,1] output of the saved model
+
+
+def test_pd_run_once_scripting_entry(saved_model):
+    """PD_RunOnce: the handle-free one-shot entry for .C-style FFI
+    clients (clients/r/mobilenet.R)."""
+    import ctypes
+
+    import numpy as np
+
+    from paddle_tpu import native
+
+    lib = native.load_capi()
+    assert lib is not None, native.capi_error()
+    path, xa, expected = saved_model
+
+    err = ctypes.c_char_p()  # argtypes declared centrally in load_capi()
+    # discover the exported output name through the predictor API
+    h = lib.PD_PredictorCreate(path.encode(), ctypes.byref(err))
+    assert h, err.value
+    buf = ctypes.create_string_buffer(256)
+    assert lib.PD_GetOutputName(ctypes.c_void_p(h), 0, buf, 256) == 0
+    out_name = buf.value
+    lib.PD_PredictorDestroy(ctypes.c_void_p(h))
+
+    xa = np.ascontiguousarray(xa, dtype=np.float32)
+    shape = (ctypes.c_int * xa.ndim)(*xa.shape)  # int32: R-friendly entry
+    out = (ctypes.c_float * 64)()
+    n = lib.PD_RunOnce(
+        path.encode(), b"x",
+        xa.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape, xa.ndim,
+        out_name, out, 64, ctypes.byref(err))
+    assert n == expected.size, (n, err.value)
+    np.testing.assert_allclose(
+        np.asarray(out[: int(n)]).reshape(expected.shape), expected,
+        rtol=1e-4)
