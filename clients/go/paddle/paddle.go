@@ -70,7 +70,9 @@ func cerr(err *C.char) error {
 	if err == nil {
 		return errors.New("unknown C API error")
 	}
-	return errors.New(C.GoString(err))
+	msg := C.GoString(err)
+	C.free(unsafe.Pointer(err)) // set_err strdup()s; the caller frees
+	return errors.New(msg)
 }
 
 // NewPredictor dlopens the shim and loads a saved inference model.
@@ -81,29 +83,33 @@ func NewPredictor(shimPath, modelDir string) (*Predictor, error) {
 	if lib == nil {
 		return nil, errors.New("dlopen failed: " + C.GoString(C.dlerror()))
 	}
+	fail := func(e error) (*Predictor, error) {
+		C.dlclose(lib)
+		return nil, e
+	}
 	create, err := sym(lib, "PD_PredictorCreate")
 	if err != nil {
-		return nil, err
+		return fail(err)
 	}
 	p := &Predictor{lib: lib}
 	if p.destroy, err = sym(lib, "PD_PredictorDestroy"); err != nil {
-		return nil, err
+		return fail(err)
 	}
 	if p.setIn, err = sym(lib, "PD_SetInputFloat"); err != nil {
-		return nil, err
+		return fail(err)
 	}
 	if p.run, err = sym(lib, "PD_PredictorRun"); err != nil {
-		return nil, err
+		return fail(err)
 	}
 	if p.getOut, err = sym(lib, "PD_GetOutputFloat"); err != nil {
-		return nil, err
+		return fail(err)
 	}
 	cd := C.CString(modelDir)
 	defer C.free(unsafe.Pointer(cd))
 	var msg *C.char
 	h := C.pd_create(create, cd, (**C.char)(unsafe.Pointer(&msg)))
 	if h == nil {
-		return nil, cerr(msg)
+		return fail(cerr(msg))
 	}
 	p.handle = h
 	return p, nil
@@ -111,6 +117,9 @@ func NewPredictor(shimPath, modelDir string) (*Predictor, error) {
 
 // SetInputFloat feeds a float32 tensor by name.
 func (p *Predictor) SetInputFloat(name string, data []float32, shape []int64) error {
+	if len(data) == 0 || len(shape) == 0 {
+		return errors.New("SetInputFloat: empty data or shape")
+	}
 	cn := C.CString(name)
 	defer C.free(unsafe.Pointer(cn))
 	var msg *C.char
@@ -141,8 +150,12 @@ func (p *Predictor) GetOutputFloat(name string, buf []float32) (int64, []int64, 
 	var msg *C.char
 	var shape [8]C.longlong
 	var ndim C.int
+	var bufPtr *C.float // nil buf = size-query mode (C API allows NULL)
+	if len(buf) > 0 {
+		bufPtr = (*C.float)(unsafe.Pointer(&buf[0]))
+	}
 	n := C.pd_get_out(p.getOut, p.handle, cn,
-		(*C.float)(unsafe.Pointer(&buf[0])), C.longlong(len(buf)),
+		bufPtr, C.longlong(len(buf)),
 		&shape[0], 8, &ndim, (**C.char)(unsafe.Pointer(&msg)))
 	if n < 0 {
 		return 0, nil, cerr(msg)
@@ -154,10 +167,14 @@ func (p *Predictor) GetOutputFloat(name string, buf []float32) (int64, []int64, 
 	return int64(n), dims, nil
 }
 
-// Destroy releases the predictor.
+// Destroy releases the predictor and the dlopen'd shim.
 func (p *Predictor) Destroy() {
 	if p.handle != nil {
 		C.pd_destroy(p.destroy, p.handle)
 		p.handle = nil
+	}
+	if p.lib != nil {
+		C.dlclose(p.lib)
+		p.lib = nil
 	}
 }

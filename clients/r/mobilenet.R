@@ -1,8 +1,7 @@
 # R inference client example (reference r/example/mobilenet.r): drives
-# the paddle_tpu C API's scripting entry PD_RunOnce through dyn.load/.C.
-# PD_RunOnce takes int32 shapes precisely so base-R .C can call it
-# (R has no int64); the same entry is exercised by
-# tests/test_inference.py::test_pd_run_once_scripting_entry via ctypes.
+# the paddle_tpu C API through dyn.load/.C. PD_RunOnceR follows R's .C
+# convention exactly (every argument a pointer, void return); it is the
+# .C-shaped face of PD_RunOnce, which tests/test_inference.py validates.
 #
 #   Rscript mobilenet.R <shim.so> <model_dir> <input_name> <output_name>
 args <- commandArgs(trailingOnly = TRUE)
@@ -12,14 +11,15 @@ if (length(args) < 4) {
 dyn.load(args[[1]])
 
 x <- runif(4 * 8)
-res <- .C("PD_RunOnce",
-          as.character(args[[2]]),        # model_dir
-          as.character(args[[3]]),        # input name
-          as.single(x),                   # data
-          as.integer(c(4L, 8L)),          # shape (int32)
-          as.integer(2L),                 # ndim
-          as.character(args[[4]]),        # output name
-          out = single(64),               # output buffer
-          as.double(64),                  # capacity (long long via double)
-          character(1))                   # err (opaque)
-cat("output head:", head(res$out), "\n")
+res <- .C("PD_RunOnceR",
+          model_dir = as.character(args[[2]]),
+          input = as.character(args[[3]]),
+          data = as.single(x),
+          shape = as.integer(c(4L, 8L)),
+          ndim = as.integer(2L),
+          output = as.character(args[[4]]),
+          out = single(64),
+          cap = as.double(64),
+          n = double(1))
+if (res$n < 0) stop("inference failed (see stderr)")
+cat("got", res$n, "elems; head:", head(res$out), "\n")

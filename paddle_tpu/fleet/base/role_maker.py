@@ -31,7 +31,11 @@ class RoleMakerBase:
 
 
 class PaddleCloudRoleMaker(RoleMakerBase):
-    """Reads PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS env."""
+    """Reads the PaddleCloud env protocol: PADDLE_TRAINER_ID /
+    PADDLE_TRAINER_ENDPOINTS for workers, and the server role via
+    TRAINING_ROLE=PSERVER + PADDLE_PORT/PADDLE_PSERVERS (the reference's
+    parameter-server convention; here a server process runs the host
+    embedding service, distributed/ps.py)."""
 
     def __init__(self, is_collective: bool = True):
         self.is_collective = is_collective
@@ -43,14 +47,42 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
         return len(eps.split(",")) if eps else 1
 
+    def is_worker(self) -> bool:
+        return os.environ.get("TRAINING_ROLE", "TRAINER").upper() == "TRAINER"
+
+    def is_server(self) -> bool:
+        return os.environ.get("TRAINING_ROLE", "").upper() == "PSERVER"
+
+    def server_index(self) -> int:
+        return int(os.environ.get("PADDLE_PSERVER_ID", 0))
+
+    def server_num(self) -> int:
+        return len(self.get_pserver_endpoints())
+
+    def get_pserver_endpoints(self):
+        eps = os.environ.get("PADDLE_PSERVERS", "")
+        return [e.strip() for e in eps.split(",") if e.strip()]
+
 
 class UserDefinedRoleMaker(RoleMakerBase):
-    def __init__(self, current_id: int = 0, worker_num: int = 1, role=None, worker_endpoints=None):
+    def __init__(self, current_id: int = 0, worker_num: int = 1, role=None,
+                 worker_endpoints=None, server_endpoints=None):
         self._id = current_id
         self._num = worker_num
+        self._role = role
+        self._server_eps = list(server_endpoints or [])
 
     def worker_index(self) -> int:
         return self._id
 
     def worker_num(self) -> int:
         return self._num
+
+    def is_server(self) -> bool:
+        return str(self._role).upper() in ("SERVER", "PSERVER", "ROLE.SERVER")
+
+    def is_worker(self) -> bool:
+        return not self.is_server()
+
+    def get_pserver_endpoints(self):
+        return list(self._server_eps)

@@ -630,3 +630,27 @@ def load(out, file_path, load_as_fp16=False):
         arr = arr.astype(np.float16)
     _tensor.assign(np.asarray(arr), output=out)
     return out
+
+
+def precision_recall(input, label, num_classes, weights=None):
+    """Streaming multi-class precision/recall/F1 (the op behind the
+    reference's fluid.metrics machinery, precision_recall_op.cc):
+    input [N, 1] predicted class ids. Returns (batch_metrics [6],
+    accum_metrics [6]) with persistable [C, 4] TP/FP/TN/FN states."""
+    from ..optimizer import _create_persistable_var
+
+    states = _create_persistable_var(
+        f"precision_recall_states_{unique_suffix()}",
+        (int(num_classes), 4), "float32", 0.0)
+    helper = LayerHelper("precision_recall")
+    batch = helper.create_variable_for_type_inference("float32")
+    accum = helper.create_variable_for_type_inference("float32")
+    ins = {"Indices": [input], "Labels": [label], "StatesInfo": [states]}
+    if weights is not None:
+        ins["Weights"] = [weights]
+    helper.append_op(
+        type="precision_recall", inputs=ins,
+        outputs={"BatchMetrics": [batch], "AccumMetrics": [accum],
+                 "AccumStatesInfo": [states]},
+    )
+    return batch, accum

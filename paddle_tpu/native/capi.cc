@@ -11,6 +11,8 @@
 // so calls may come from any thread.
 #include <Python.h>
 
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -400,6 +402,24 @@ long long PD_RunOnce(const char* model_dir, const char* input_name,
   }
   PD_PredictorDestroy(h);
   return n;
+}
+
+// R .C calling convention: EVERY argument is a pointer (character ->
+// char**, integer -> int*, double -> double*) and the routine returns
+// void. n_out receives the element count, or -1 on error (err message
+// printed to stderr — .C has no good string-out channel).
+void PD_RunOnceR(char** model_dir, char** input_name, float* data,
+                 int* shape, int* ndim, char** output_name, float* out,
+                 double* out_cap, double* n_out) {
+  const char* err = nullptr;
+  long long n = PD_RunOnce(model_dir[0], input_name[0], data, shape,
+                           *ndim, output_name[0], out,
+                           (long long)(*out_cap), &err);
+  if (n < 0 && err) {
+    fprintf(stderr, "PD_RunOnceR: %s\n", err);
+    free((void*)err);
+  }
+  *n_out = (double)n;
 }
 
 }  // extern "C"

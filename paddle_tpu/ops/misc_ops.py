@@ -298,3 +298,44 @@ def diag_embed(ctx, ins, attrs):
     x = ins["X"][0]
     n = x.shape[-1]
     return {"Out": [x[..., None] * jnp.eye(n, dtype=x.dtype)]}
+
+
+@register("precision_recall", stop_gradient=True, no_vjp_grad=True)
+def precision_recall(ctx, ins, attrs):
+    """Streaming multi-class precision/recall/F1 (reference
+    operators/metrics/precision_recall_op.cc): Indices [N,1] predicted
+    class, Labels [N,1], optional Weights [N,1]; StatesInfo [C,4] carries
+    (TP, FP, TN, FN) per class across batches. Outputs BatchMetrics and
+    AccumMetrics as [6]: macro-P, macro-R, macro-F1, micro-P, micro-R,
+    micro-F1."""
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    lbl = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    w = (ins["Weights"][0].reshape(-1).astype(jnp.float32)
+         if ins.get("Weights") else jnp.ones(idx.shape, jnp.float32))
+    states = ins["StatesInfo"][0].astype(jnp.float32)  # [C, 4]
+    c = states.shape[0]
+    pred1 = jax.nn.one_hot(idx, c, dtype=jnp.float32) * w[:, None]
+    lab1 = jax.nn.one_hot(lbl, c, dtype=jnp.float32) * w[:, None]
+    tp = (pred1 * (idx == lbl)[:, None].astype(jnp.float32)).sum(0)
+    fp = pred1.sum(0) - tp
+    fn = lab1.sum(0) - tp
+    tn = w.sum() - tp - fp - fn
+
+    def metrics(tp_, fp_, fn_):
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-10), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-10), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-10), 0.0)
+        return prec, rec, f1
+
+    def six(tp_, fp_, fn_):
+        p, r, f = metrics(tp_, fp_, fn_)
+        mp, mr, mf = p.mean(), r.mean(), f.mean()
+        up, ur, uf = metrics(tp_.sum(), fp_.sum(), fn_.sum())
+        return jnp.stack([mp, mr, mf, up, ur, uf])
+
+    batch = six(tp, fp, fn)
+    new_states = states + jnp.stack([tp, fp, tn, fn], axis=1)
+    accum = six(new_states[:, 0], new_states[:, 1], new_states[:, 3])
+    return {"BatchMetrics": [batch], "AccumMetrics": [accum],
+            "AccumStatesInfo": [new_states]}

@@ -193,3 +193,45 @@ def test_pd_run_once_scripting_entry(saved_model):
     np.testing.assert_allclose(
         np.asarray(out[: int(n)]).reshape(expected.shape), expected,
         rtol=1e-4)
+
+
+def test_pd_run_once_r_convention(saved_model):
+    """PD_RunOnceR: the .C-shaped wrapper (all pointer args, void return)
+    that clients/r/mobilenet.R drives."""
+    import ctypes
+
+    import numpy as np
+
+    from paddle_tpu import native
+
+    lib = native.load_capi()
+    assert lib is not None, native.capi_error()
+    path, xa, expected = saved_model
+
+    err = ctypes.c_char_p()
+    h = lib.PD_PredictorCreate(path.encode(), ctypes.byref(err))
+    assert h, err.value
+    buf = ctypes.create_string_buffer(256)
+    assert lib.PD_GetOutputName(ctypes.c_void_p(h), 0, buf, 256) == 0
+    out_name = buf.value
+    lib.PD_PredictorDestroy(ctypes.c_void_p(h))
+
+    lib.PD_RunOnceR.restype = None
+    xa = np.ascontiguousarray(xa, dtype=np.float32)
+    model_p = ctypes.c_char_p(path.encode())
+    in_p = ctypes.c_char_p(b"x")
+    out_p = ctypes.c_char_p(out_name)
+    shape = (ctypes.c_int * 2)(*xa.shape)
+    ndim = ctypes.c_int(2)
+    out = (ctypes.c_float * 64)()
+    cap = ctypes.c_double(64)
+    n = ctypes.c_double(0)
+    lib.PD_RunOnceR(
+        ctypes.byref(model_p), ctypes.byref(in_p),
+        xa.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        shape, ctypes.byref(ndim), ctypes.byref(out_p), out,
+        ctypes.byref(cap), ctypes.byref(n))
+    assert int(n.value) == expected.size
+    np.testing.assert_allclose(
+        np.asarray(out[: int(n.value)]).reshape(expected.shape), expected,
+        rtol=1e-4)

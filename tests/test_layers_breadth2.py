@@ -189,3 +189,47 @@ def test_chunk_eval_all_outside_reports_zero_chunks():
                                  fetch_list=[p, ni, nl])
     assert int(np.asarray(ni_v)) == 0 and int(np.asarray(nl_v)) == 0
     assert float(np.asarray(pv)) == 0.0
+
+
+def test_precision_recall_streaming():
+    """Streaming precision/recall op vs a numpy oracle, two batches."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.data("pred", [6, 1], "int64")
+        lab = fluid.data("lab", [6, 1], "int64")
+        batch_m, accum_m = layers.precision_recall(pred, lab, num_classes=3)
+    p1 = np.asarray([[0], [1], [1], [2], [0], [2]], "i8")
+    l1 = np.asarray([[0], [1], [2], [2], [1], [2]], "i8")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        b1, a1 = exe.run(main, feed={"pred": p1, "lab": l1},
+                         fetch_list=[batch_m, accum_m])
+        b2, a2 = exe.run(main, feed={"pred": p1, "lab": l1},
+                         fetch_list=[batch_m, accum_m])
+    b1, a1, a2 = np.asarray(b1), np.asarray(a1), np.asarray(a2)
+    # micro-P == micro-R == accuracy = 4/6 here
+    np.testing.assert_allclose(b1[3], 4 / 6, rtol=1e-5)
+    np.testing.assert_allclose(b1[4], 4 / 6, rtol=1e-5)
+    # identical second batch: accumulated micro metrics unchanged
+    np.testing.assert_allclose(a2, a1, rtol=1e-5)
+    assert (b1 >= 0).all() and (b1 <= 1).all()
+
+
+def test_role_maker_server_role(monkeypatch):
+    from paddle_tpu.fleet.base.role_maker import (
+        PaddleCloudRoleMaker,
+        UserDefinedRoleMaker,
+    )
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS", "h1:6000,h2:6000")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_server() and not rm.is_worker()
+    assert rm.server_num() == 2
+    assert rm.get_pserver_endpoints() == ["h1:6000", "h2:6000"]
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    assert PaddleCloudRoleMaker().is_worker()
+
+    u = UserDefinedRoleMaker(role="server", server_endpoints=["a:1"])
+    assert u.is_server() and u.get_pserver_endpoints() == ["a:1"]

@@ -3033,6 +3033,7 @@ EXEMPT = {
     "hash": "test_layers_breadth.py (determinism/range/spread)",
     "sampling_id": "test_layers_breadth.py (distribution check)",
     "randperm": "test_api20.py (permutation property; stochastic)",
+    "precision_recall": "test_layers_breadth2.py (streaming states)",
     # stochastic draws: distribution checked in test_random_ops below
     "uniform_random": "test_random_ops",
     "gaussian_random": "test_random_ops",
