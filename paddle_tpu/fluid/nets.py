@@ -1,0 +1,89 @@
+"""Composite network blocks (reference python/paddle/fluid/nets.py):
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention — pure layer compositions; XLA fuses them."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "sequence_conv_pool", "glu",
+           "scaled_dot_product_attention", "img_conv_group"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    conv_out = layers.conv2d(
+        input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """Stacked conv(+bn+dropout) layers followed by one pool (VGG block)."""
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+
+    n = len(conv_num_filter)
+
+    def per_layer(v, n_=n):
+        if isinstance(v, (list, tuple)):
+            if len(v) != n_:
+                raise ValueError(
+                    f"img_conv_group: per-layer list {list(v)} must have "
+                    f"len(conv_num_filter) == {n_} entries")
+            return list(v)
+        return [v] * n_
+    paddings = per_layer(conv_padding)
+    filter_sizes = per_layer(conv_filter_size)
+    with_bn = per_layer(conv_with_batchnorm)
+    drop_rates = per_layer(conv_batchnorm_drop_rate)
+    attrs = per_layer(param_attr) if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * n
+
+    tmp = input
+    for i in range(n):
+        tmp = layers.conv2d(
+            tmp, num_filters=conv_num_filter[i],
+            filter_size=filter_sizes[i], padding=paddings[i],
+            param_attr=attrs[i],
+            act=None if with_bn[i] else conv_act)
+        if with_bn[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if drop_rates[i] > 0:
+                tmp = layers.dropout(tmp, dropout_prob=drop_rates[i])
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       length=None):
+    conv_out = layers.sequence_conv(
+        input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(conv_out, pool_type=pool_type, length=length)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in two along dim, a * sigmoid(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled-dot-product attention over [B, S, H] tensors —
+    delegates to the fused op (Pallas flash attention on TPU)."""
+    return layers.fused_multihead_attention(
+        queries, keys, values, num_heads=num_heads,
+        dropout_prob=dropout_rate, is_test=dropout_rate == 0.0)

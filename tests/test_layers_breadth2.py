@@ -233,3 +233,53 @@ def test_role_maker_server_role(monkeypatch):
 
     u = UserDefinedRoleMaker(role="server", server_endpoints=["a:1"])
     assert u.is_server() and u.get_pserver_endpoints() == ["a:1"]
+
+
+def test_fluid_nets_compositions():
+    """fluid.nets (reference nets.py): conv-pool blocks, glu, attention."""
+    rng = np.random.RandomState(0)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [2, 3, 16, 16], "float32")
+        seq = fluid.data("seq", [2, 6, 8], "float32")
+        q = fluid.data("q", [2, 6, 8], "float32")
+        cp = fluid.nets.simple_img_conv_pool(
+            img, num_filters=4, filter_size=3, pool_size=2, pool_stride=2,
+            conv_padding=1, act="relu")
+        grp = fluid.nets.img_conv_group(
+            img, conv_num_filter=[4, 4], pool_size=2, pool_stride=2,
+            conv_act="relu", conv_with_batchnorm=True)
+        scp = fluid.nets.sequence_conv_pool(seq, num_filters=5, filter_size=3)
+        g = fluid.nets.glu(seq, dim=-1)
+        att = fluid.nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+    feed = {
+        "img": rng.rand(2, 3, 16, 16).astype("f4"),
+        "seq": rng.rand(2, 6, 8).astype("f4"),
+        "q": rng.rand(2, 6, 8).astype("f4"),
+    }
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        cpv, grpv, scpv, gv, attv = exe.run(
+            main, feed=feed, fetch_list=[cp, grp, scp, g, att])
+    assert np.asarray(cpv).shape == (2, 4, 8, 8)
+    assert np.asarray(grpv).shape == (2, 4, 8, 8)
+    assert np.asarray(scpv).shape == (2, 5)
+    assert np.asarray(gv).shape == (2, 6, 4)
+    assert np.asarray(attv).shape == (2, 6, 8)
+    # glu oracle
+    a, b = feed["seq"][..., :4], feed["seq"][..., 4:]
+    np.testing.assert_allclose(np.asarray(gv), a / (1 + np.exp(-b)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_img_conv_group_validates_list_lengths():
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img2", [1, 3, 8, 8], "float32")
+        with pytest.raises(ValueError, match="conv_num_filter"):
+            fluid.nets.img_conv_group(img, conv_num_filter=[4, 4, 4],
+                                      pool_size=2, conv_padding=[1, 1])
