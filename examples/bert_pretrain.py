@@ -1,0 +1,44 @@
+"""BERT pretraining with the full TPU stack: bf16 AMP, flash attention,
+scan-fused encoder, optional GSPMD mesh via fleet.
+
+    python examples/bert_pretrain.py            # tiny config, quick
+    BERT=base python examples/bert_pretrain.py  # the bench config
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fleet as fleet
+from paddle_tpu.contrib import mixed_precision as mixed_prec
+from paddle_tpu.models.bert import (
+    BertConfig, build_bert_pretrain_program, random_pretrain_batch,
+)
+
+
+def main():
+    if os.environ.get("BERT") == "base":
+        cfg, batch, seq, mp = BertConfig.base(), 48, 512, 76
+        cfg.fuse_stack = True
+        cfg.remat_ffn = True
+    else:
+        cfg, batch, seq, mp = BertConfig.tiny(), 8, 64, 8
+    m, st, _, loss = build_bert_pretrain_program(cfg, batch, seq, mp)
+    with fluid.program_guard(m, st):
+        strategy = fleet.DistributedStrategy()
+        strategy.mesh_axes = {"dp": -1}   # all local devices
+        strategy.amp = True               # bf16
+        fleet.init()
+        opt = fleet.distributed_optimizer(
+            fluid.optimizer.AdamOptimizer(1e-4), strategy)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(st)
+    for step in range(10):
+        feed = random_pretrain_batch(cfg, batch, seq, mp, seed=step)
+        (lv,) = exe.run(m, feed=feed, fetch_list=[loss])
+        print(f"step {step}: loss {float(np.asarray(lv).reshape(())):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Static-graph MNIST LeNet (the reference book model
+test_recognize_digits.py): fluid.nets conv-pool blocks + Adam.
+
+    python examples/mnist_static.py [epochs]
+"""
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+from paddle_tpu.fluid import layers
+
+
+def build(batch):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [batch, 1, 28, 28], "float32")
+        label = fluid.data("label", [batch, 1], "int64")
+        c1 = fluid.nets.simple_img_conv_pool(img, 6, 5, 2, 2, act="relu")
+        c2 = fluid.nets.simple_img_conv_pool(c1, 16, 5, 2, 2, act="relu")
+        logits = layers.fc(layers.reshape(c2, [batch, -1]), 10)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    return main, startup, loss, acc
+
+
+def main(epochs=1, batch=64):
+    main_p, startup, loss, acc = build(batch)
+    exe = fluid.Executor()
+    exe.run(startup)
+    reader = paddle.batch(dataset.mnist.train(), batch, drop_last=True)
+    for epoch in range(epochs):
+        losses, accs = [], []
+        for feed_batch in reader():
+            imgs = np.stack([b[0] for b in feed_batch]).reshape(batch, 1, 28, 28)
+            lbls = np.asarray([[b[1]] for b in feed_batch], "int64")
+            lv, av = exe.run(main_p, feed={"img": imgs, "label": lbls},
+                             fetch_list=[loss, acc])
+            losses.append(float(np.asarray(lv).reshape(())))
+            accs.append(float(np.asarray(av).reshape(-1)[0]))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
+              f"acc {np.mean(accs[-50:]):.3f}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1)

@@ -1,0 +1,55 @@
+"""Sequence model on the wmt16 synthetic translation task: GRU encoder
+(layers.rnn) + per-position projection. For the decoder-side API
+(BasicDecoder / GreedyEmbeddingHelper / dynamic_decode) see
+tests/test_rnn_api.py.
+
+    python examples/seq2seq_nmt.py
+"""
+import itertools
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+from paddle_tpu.fluid import layers
+
+VOCAB, MAXLEN, BATCH, HID = 40, 12, 32, 64
+
+
+def pack(pairs):
+    src = np.full((len(pairs), MAXLEN), 2, "int64")
+    trg = np.full((len(pairs), MAXLEN), 2, "int64")
+    for i, (s, t_in, t_next) in enumerate(pairs):
+        src[i, : len(s)] = s[:MAXLEN]
+        trg[i, : len(t_next)] = t_next[:MAXLEN]
+    return src, trg
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        s_v = fluid.data("src", [BATCH, MAXLEN], "int64")
+        t_v = fluid.data("trg", [BATCH, MAXLEN, 1], "int64")
+        emb = layers.embedding(s_v, size=[VOCAB, HID])
+        enc, final = layers.rnn(layers.GRUCell(HID, name="enc"), emb)
+        logits = layers.fc(enc, VOCAB, num_flatten_dims=2)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, t_v))
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    reader = dataset.wmt16.train(VOCAB, VOCAB)
+    data = list(itertools.islice(reader(), 512))
+    for epoch in range(3):
+        np.random.RandomState(epoch).shuffle(data)
+        losses = []
+        for i in range(0, len(data) - BATCH, BATCH):
+            src, trg = pack(data[i : i + BATCH])
+            (lv,) = exe.run(main_p, feed={"src": src, "trg": trg[..., None]},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+
+if __name__ == "__main__":
+    main()
