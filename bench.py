@@ -133,6 +133,59 @@ def bench_resnet50():
     }))
 
 
+def bench_transformer():
+    """Transformer-base NMT WMT14 (the BASELINE.md configs-to-measure
+    row; dist_transformer.py recipe) tokens/sec/chip. BASELINE.md's
+    metric table sets no Transformer target, so vs_baseline is null and
+    achieved utilization is reported in the separate "mfu" key."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import mixed_precision as mixed_prec
+    from paddle_tpu.models.transformer import (
+        TransformerConfig,
+        build_transformer_nmt_program,
+        random_nmt_batch,
+        transformer_step_flops,
+    )
+
+    cfg = TransformerConfig.base()
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    src_len = int(os.environ.get("BENCH_SRC", 256))
+    trg_len = int(os.environ.get("BENCH_TRG", 256))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    m, st, feeds, loss = build_transformer_nmt_program(
+        cfg, batch, src_len, trg_len)
+    with fluid.program_guard(m, st):
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        if use_amp:
+            opt = mixed_prec.decorate(opt, use_bf16=True)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(st)
+    data = {k: jax.device_put(np.asarray(v))
+            for k, v in random_nmt_batch(cfg, batch, src_len, trg_len).items()}
+    dt, _ = _timed_run(exe, m, data, loss, steps)
+    tokens_per_sec = batch * (src_len + trg_len) * steps / dt
+    mfu = (transformer_step_flops(cfg, batch, src_len, trg_len) * steps / dt
+           / _peak_flops_per_chip())
+    print(json.dumps({
+        "metric": "transformer_base_nmt_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,  # BASELINE.md sets no Transformer target
+        "mfu": round(mfu, 4),
+        "batch": batch,
+        "src_len": src_len,
+        "trg_len": trg_len,
+        "steps": steps,
+        "amp_bf16": use_amp,
+    }))
+
+
 def main():
     import jax
     import numpy as np
@@ -145,8 +198,11 @@ def main():
         random_pretrain_batch,
     )
 
-    if os.environ.get("BENCH_MODEL", "bert") == "resnet50":
+    model = os.environ.get("BENCH_MODEL", "bert")
+    if model == "resnet50":
         return bench_resnet50()
+    if model == "transformer":
+        return bench_transformer()
 
     cfg = BertConfig.base()
     cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time

@@ -1110,12 +1110,14 @@ _rng_salt_counter = [0]
 
 
 def fused_multihead_attention(
-    q, k, v, attn_bias=None, num_heads=1, dropout_prob=0.0, is_test=False, name=None
+    q, k, v, attn_bias=None, num_heads=1, dropout_prob=0.0, is_test=False,
+    causal=False, name=None
 ):
     """Fused scaled-dot-product attention over head-interleaved [B,S,H]
     tensors (TPU: Pallas flash attention; see ops/attention.py). The
     reference gets this via graph fusion passes (multihead_matmul_fuse_pass);
-    here it is a first-class op."""
+    here it is a first-class op. causal=True masks future positions
+    inside the kernel (block-level skipping of upper-triangular work)."""
     helper = LayerHelper("fused_multihead_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     _rng_salt_counter[0] += 1
@@ -1130,6 +1132,7 @@ def fused_multihead_attention(
             "num_heads": num_heads,
             "dropout_prob": dropout_prob,
             "is_test": is_test,
+            "causal": bool(causal),
             "rng_salt": _rng_salt_counter[0],
         },
     )

@@ -10,3 +10,5 @@ Pallas attention give the fused kernels directly.
 from . import bert  # noqa: F401
 from .bert import BertConfig, build_bert_pretrain_program  # noqa: F401
 from . import resnet  # noqa: F401
+from . import transformer  # noqa: F401
+from .transformer import TransformerConfig, build_transformer_nmt_program  # noqa: F401

@@ -1,0 +1,245 @@
+"""Transformer-base NMT (encoder-decoder) — the BASELINE.md config
+mirroring /root/reference/python/paddle/fluid/tests/unittests/
+dist_transformer.py (Transformer-base: 6+6 layers, d_model 512, 8 heads,
+d_ff 2048, shared target embedding/projection, label smoothing).
+
+TPU-first notes:
+- self-attention (encoder and causal decoder) runs the fused op — the
+  Pallas flash kernel, with in-kernel causal masking on the decoder side;
+- cross-attention (trg queries over src keys) has different q/kv lengths,
+  outside the flash kernel's square tiling, so it composes jnp-style ops
+  that XLA fuses;
+- sinusoid position encodings are build-time constants
+  (layers.add_position_encoding);
+- static [B, S] shapes; padding handled with additive -1e4 biases.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from ..fluid import layers
+from ..fluid.framework import Program, program_guard
+from ..fluid.initializer import ConstantInitializer, NormalInitializer
+from ..fluid.param_attr import ParamAttr
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    src_vocab_size: int = 30000
+    trg_vocab_size: int = 30000
+    d_model: int = 512
+    num_heads: int = 8
+    d_inner: int = 2048
+    n_encoder_layers: int = 6
+    n_decoder_layers: int = 6
+    dropout: float = 0.1
+    label_smooth_eps: float = 0.1
+
+    @staticmethod
+    def base() -> "TransformerConfig":
+        return TransformerConfig()
+
+    @staticmethod
+    def tiny() -> "TransformerConfig":
+        return TransformerConfig(
+            src_vocab_size=64, trg_vocab_size=64, d_model=32, num_heads=4,
+            d_inner=64, n_encoder_layers=2, n_decoder_layers=2)
+
+
+def _fc3(x, size, pname, act=None):
+    return layers.fc(
+        x, size, num_flatten_dims=2,
+        param_attr=ParamAttr(name=f"{pname}.w_0",
+                             initializer=NormalInitializer(0.0, 0.02)),
+        bias_attr=ParamAttr(name=f"{pname}.b_0",
+                            initializer=ConstantInitializer(0.0)),
+        act=act)
+
+
+def _ln(x, name):
+    return layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{name}_scale"),
+        bias_attr=ParamAttr(name=f"{name}_bias"))
+
+
+def _cross_attention(cfg, q3, kv, kv_bias, name, is_test):
+    """Cross-attention with different q/kv lengths: jnp-composed ops
+    (XLA-fused); kv_bias is the source padding bias [B, 1, 1, S_src]."""
+    b, sq, h = q3.shape
+    sk = kv.shape[1]
+    nh = cfg.num_heads
+    dh = h // nh
+    q3 = _fc3(q3, h, f"{name}_query_fc")  # learned W_Q (dist_transformer
+    k3 = _fc3(kv, h, f"{name}_key_fc")    # __compute_qkv projects q too)
+    v3 = _fc3(kv, h, f"{name}_value_fc")
+
+    def split(x, s):
+        return layers.transpose(layers.reshape(x, [b, s, nh, dh]), [0, 2, 1, 3])
+
+    q = split(q3, sq)
+    k = split(k3, sk)
+    v = split(v3, sk)
+    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh))
+    scores = layers.elementwise_add(scores, kv_bias)
+    probs = layers.softmax(scores, axis=-1)
+    if not is_test and cfg.dropout > 0:
+        probs = layers.dropout(probs, cfg.dropout,
+                               dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)
+    return layers.reshape(layers.transpose(ctx, [0, 2, 1, 3]), [b, sq, h])
+
+
+def _self_attn_block(cfg, hidden, bias, name, is_test, causal):
+    h = hidden.shape[-1]
+    q = _fc3(hidden, h, f"{name}_q_fc")
+    k = _fc3(hidden, h, f"{name}_k_fc")
+    v = _fc3(hidden, h, f"{name}_v_fc")
+    ctx = layers.fused_multihead_attention(
+        q, k, v, bias, num_heads=cfg.num_heads, dropout_prob=cfg.dropout,
+        is_test=is_test, causal=causal)
+    out = _fc3(ctx, h, f"{name}_out_fc")
+    if not is_test and cfg.dropout > 0:
+        out = layers.dropout(out, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return _ln(layers.elementwise_add(hidden, out), f"{name}_post_ln")
+
+
+def _ffn_block(cfg, hidden, name, is_test):
+    h = hidden.shape[-1]
+    inter = _fc3(hidden, cfg.d_inner, f"{name}_ffn_fc0", act="relu")
+    out = _fc3(inter, h, f"{name}_ffn_fc1")
+    if not is_test and cfg.dropout > 0:
+        out = layers.dropout(out, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return _ln(layers.elementwise_add(hidden, out), f"{name}_ffn_ln")
+
+
+def _embed(cfg, ids, vocab, emb_name, is_test):
+    emb = layers.embedding(
+        ids, size=[vocab, cfg.d_model],
+        param_attr=ParamAttr(name=emb_name,
+                             initializer=NormalInitializer(0.0, 0.02)))
+    emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
+    emb = layers.add_position_encoding(emb, alpha=1.0, beta=1.0)
+    if not is_test and cfg.dropout > 0:
+        emb = layers.dropout(emb, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def _pad_bias(mask):
+    """[B, S] 1/0 mask -> additive [B, 1, 1, S] bias."""
+    bias = layers.scale(layers.cast(mask, "float32"), scale=1e4, bias=-1e4)
+    return layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])
+
+
+def transformer_encoder(cfg, src_ids, src_mask, is_test=False):
+    hidden = _embed(cfg, src_ids, cfg.src_vocab_size, "src_embedding", is_test)
+    bias = _pad_bias(src_mask)
+    for i in range(cfg.n_encoder_layers):
+        hidden = _self_attn_block(cfg, hidden, bias, f"enc_{i}", is_test,
+                                  causal=False)
+        hidden = _ffn_block(cfg, hidden, f"enc_{i}", is_test)
+    return hidden, bias
+
+
+def transformer_decoder(cfg, trg_ids, enc_out, src_bias, is_test=False):
+    hidden = _embed(cfg, trg_ids, cfg.trg_vocab_size, "trg_embedding", is_test)
+    for i in range(cfg.n_decoder_layers):
+        hidden = _self_attn_block(cfg, hidden, None, f"dec_{i}", is_test,
+                                  causal=True)
+        cross = _cross_attention(cfg, hidden, enc_out, src_bias,
+                                 f"dec_{i}_cross", is_test)
+        cross_out = _fc3(cross, cfg.d_model, f"dec_{i}_cross_out_fc")
+        if not is_test and cfg.dropout > 0:
+            # residual-path dropout, like every other sublayer
+            cross_out = layers.dropout(
+                cross_out, cfg.dropout,
+                dropout_implementation="upscale_in_train")
+        hidden = _ln(layers.elementwise_add(hidden, cross_out),
+                     f"dec_{i}_cross_ln")
+        hidden = _ffn_block(cfg, hidden, f"dec_{i}", is_test)
+    return hidden
+
+
+def build_transformer_nmt_program(
+    cfg: TransformerConfig, batch: int, src_len: int, trg_len: int,
+    is_test: bool = False,
+    main_program: Optional[Program] = None,
+    startup_program: Optional[Program] = None,
+):
+    """Feeds: src_ids/trg_ids [B, S] int32, src_mask [B, S_src] float32,
+    labels [B, S_trg, 1] int32, label_weights [B, S_trg, 1] float32.
+    Returns (main, startup, feed_names, loss)."""
+    main = main_program or Program()
+    startup = startup_program or Program()
+    with program_guard(main, startup):
+        src_ids = layers.data("src_ids", [batch, src_len], dtype="int32",
+                              append_batch_size=False)
+        trg_ids = layers.data("trg_ids", [batch, trg_len], dtype="int32",
+                              append_batch_size=False)
+        src_mask = layers.data("src_mask", [batch, src_len], dtype="float32",
+                               append_batch_size=False)
+        labels = layers.data("labels", [batch, trg_len, 1], dtype="int32",
+                             append_batch_size=False)
+        label_weights = layers.data(
+            "label_weights", [batch, trg_len, 1], dtype="float32",
+            append_batch_size=False)
+
+        enc_out, src_bias = transformer_encoder(cfg, src_ids, src_mask, is_test)
+        dec_out = transformer_decoder(cfg, trg_ids, enc_out, src_bias, is_test)
+        # shared target embedding as the output projection (weight tying)
+        trg_emb = main.global_block().var("trg_embedding")
+        flat = layers.reshape(dec_out, [batch * trg_len, cfg.d_model])
+        logits = layers.matmul(flat, trg_emb, transpose_y=True)
+        logits = layers.reshape(logits, [batch, trg_len, cfg.trg_vocab_size])
+
+        smooth = layers.label_smooth(
+            layers.one_hot(layers.reshape(labels, [batch, trg_len]),
+                           cfg.trg_vocab_size),
+            epsilon=cfg.label_smooth_eps)
+        ce = layers.softmax_with_cross_entropy(logits, smooth, soft_label=True)
+        ce = layers.elementwise_mul(ce, label_weights)
+        denom = layers.elementwise_add(
+            layers.reduce_sum(label_weights),
+            layers.fill_constant([1], "float32", 1e-6))
+        loss = layers.elementwise_div(layers.reduce_sum(ce), denom)
+    feeds = ["src_ids", "trg_ids", "src_mask", "labels", "label_weights"]
+    return main, startup, feeds, loss
+
+
+def transformer_step_flops(cfg: TransformerConfig, batch, src_len, trg_len):
+    """fwd+bwd matmul FLOPs per step (6N per active-token parameter) +
+    attention score/context terms. Cross-attention K/V projections run
+    over SRC tokens; q/out projections run over TRG tokens."""
+    h, f = cfg.d_model, cfg.d_inner
+    ld = cfg.n_decoder_layers
+    # per src token: encoder qkv+out+ffn, plus decoder cross K/V proj
+    enc_tok = (6 * cfg.n_encoder_layers * (4 * h * h + 2 * h * f)
+               + 12 * cfg.n_encoder_layers * src_len * h
+               + 6 * ld * (2 * h * h))
+    # per trg token: decoder self qkv+out, cross q+out, ffn, vocab proj,
+    # self-attn over trg_len + cross-attn over src_len
+    dec_tok = (6 * ld * (4 * h * h + 2 * h * h + 2 * h * f)
+               + 6 * cfg.trg_vocab_size * h
+               + 12 * ld * (trg_len + src_len) * h)
+    return batch * (src_len * enc_tok + trg_len * dec_tok)
+
+
+def random_nmt_batch(cfg: TransformerConfig, batch, src_len, trg_len, seed=0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {
+        "src_ids": rng.randint(0, cfg.src_vocab_size,
+                               (batch, src_len)).astype(np.int32),
+        "trg_ids": rng.randint(0, cfg.trg_vocab_size,
+                               (batch, trg_len)).astype(np.int32),
+        "src_mask": np.ones((batch, src_len), np.float32),
+        "labels": rng.randint(0, cfg.trg_vocab_size,
+                              (batch, trg_len, 1)).astype(np.int32),
+        "label_weights": np.ones((batch, trg_len, 1), np.float32),
+    }
