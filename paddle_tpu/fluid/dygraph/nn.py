@@ -145,9 +145,10 @@ class LayerNorm(Layer):
 
 
 class BatchNorm(Layer):
-    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32", data_layout="NCHW"):
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32", data_layout="NCHW"):
         super().__init__(dtype=dtype)
         self._momentum, self._epsilon, self._layout = momentum, epsilon, data_layout
+        self._act = act
         self.weight = self.create_parameter(
             [num_channels], attr=ParamAttr._to_attr(param_attr),
             default_initializer=ConstantInitializer(1.0),
@@ -182,6 +183,8 @@ class BatchNorm(Layer):
         if isinstance(mean_out, VarBase):
             self._mean.value = mean_out.value
             self._variance.value = var_out.value
+        if self._act:
+            y = _trace_op(self._act, {"X": [y]}, {}, ["Out"])[0]
         return y
 
 

@@ -21,7 +21,7 @@ from ..fluid import layers
 from . import callbacks as callbacks_mod
 from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger  # noqa: F401
 from .metrics import Accuracy, Metric  # noqa: F401
-from . import datasets  # noqa: F401
+from . import datasets, vision  # noqa: F401
 
 __all__ = [
     "Input", "Model", "Callback", "ProgBarLogger", "ModelCheckpoint",

@@ -1,0 +1,172 @@
+"""hapi vision models (reference python/paddle/incubate/hapi/vision/
+models/: lenet.py, vgg.py, resnet.py): dygraph Layer classes usable
+directly or through hapi Model(...).fit. Static-graph users should use
+paddle_tpu.models.resnet (builder-style, bench-grade)."""
+from __future__ import annotations
+
+from ..fluid.dygraph import (
+    BatchNorm, Conv2D, Layer, Linear, Pool2D, Sequential,
+)
+from ..fluid.dygraph.base import _trace_op
+
+
+def _relu(x):
+    return _trace_op("relu", {"X": [x]}, {}, ["Out"])[0]
+
+
+__all__ = ["LeNet", "VGG", "vgg16", "ResNet", "resnet18", "resnet50"]
+
+
+class LeNet(Layer):
+    """Reference hapi/vision/models/lenet.py: 2 conv-pool + 3 fc."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, padding=1, act="relu"),
+            Pool2D(2, "max", 2),
+            Conv2D(6, 16, 5, act="relu"),
+            Pool2D(2, "max", 2),
+        )
+        self.fc = Sequential(
+            Linear(400, 120, act="relu"),
+            Linear(120, 84, act="relu"),
+            Linear(84, num_classes),
+        )
+
+    def forward(self, x):
+        h = self.features(x)
+        return self.fc(h.reshape([x.shape[0], -1]))
+
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """Reference hapi/vision/models/vgg.py (batch-norm variant)."""
+
+    def __init__(self, depth=16, num_classes=1000, input_size=224):
+        super().__init__()
+        if depth not in _VGG_CFGS:
+            raise ValueError(f"VGG depth must be one of {list(_VGG_CFGS)}")
+        blocks = []
+        c_in = 3
+        for v in _VGG_CFGS[depth]:
+            if v == "M":
+                blocks.append(Pool2D(2, "max", 2))
+            else:
+                blocks.append(Conv2D(c_in, v, 3, padding=1))
+                blocks.append(BatchNorm(v, act="relu"))
+                c_in = v
+        self.features = Sequential(*blocks)
+        spatial = input_size // 32
+        self.classifier = Sequential(
+            Linear(512 * spatial * spatial, 4096, act="relu"),
+            Linear(4096, 4096, act="relu"),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        h = self.features(x)
+        return self.classifier(h.reshape([x.shape[0], -1]))
+
+
+def vgg16(num_classes=1000, **kwargs):
+    return VGG(16, num_classes, **kwargs)
+
+
+class _ConvBN(Layer):
+    def __init__(self, c_in, c_out, k, stride=1, act=None):
+        super().__init__()
+        self.conv = Conv2D(c_in, c_out, k, stride=stride,
+                           padding=(k - 1) // 2, bias_attr=False)
+        self.bn = BatchNorm(c_out, act=act)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class _BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, c_in, c_out, stride=1):
+        super().__init__()
+        self.conv1 = _ConvBN(c_in, c_out, 3, stride, act="relu")
+        self.conv2 = _ConvBN(c_out, c_out, 3)
+        self.short = (None if stride == 1 and c_in == c_out
+                      else _ConvBN(c_in, c_out, 1, stride))
+
+    def forward(self, x):
+        h = self.conv2(self.conv1(x))
+        s = x if self.short is None else self.short(x)
+        return _relu(h + s)
+
+
+class _Bottleneck(Layer):
+    expansion = 4
+
+    def __init__(self, c_in, c_mid, stride=1):
+        super().__init__()
+        c_out = c_mid * 4
+        self.conv1 = _ConvBN(c_in, c_mid, 1, act="relu")
+        self.conv2 = _ConvBN(c_mid, c_mid, 3, stride, act="relu")
+        self.conv3 = _ConvBN(c_mid, c_out, 1)
+        self.short = (None if stride == 1 and c_in == c_out
+                      else _ConvBN(c_in, c_out, 1, stride))
+
+    def forward(self, x):
+        h = self.conv3(self.conv2(self.conv1(x)))
+        s = x if self.short is None else self.short(x)
+        return _relu(h + s)
+
+
+_RESNET_CFGS = {
+    18: (_BasicBlock, [2, 2, 2, 2]),
+    34: (_BasicBlock, [3, 4, 6, 3]),
+    50: (_Bottleneck, [3, 4, 6, 3]),
+    101: (_Bottleneck, [3, 4, 23, 3]),
+    152: (_Bottleneck, [3, 8, 36, 3]),
+}
+
+
+class ResNet(Layer):
+    """Reference hapi/vision/models/resnet.py."""
+
+    def __init__(self, depth=50, num_classes=1000):
+        super().__init__()
+        if depth not in _RESNET_CFGS:
+            raise ValueError(f"ResNet depth must be one of {list(_RESNET_CFGS)}")
+        block, counts = _RESNET_CFGS[depth]
+        self.stem = _ConvBN(3, 64, 7, 2, act="relu")
+        self.pool = Pool2D(3, "max", 2, pool_padding=1)
+        stages = []
+        c_in = 64
+        for i, (c_mid, n) in enumerate(zip([64, 128, 256, 512], counts)):
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                stages.append(block(c_in, c_mid, stride))
+                c_in = c_mid * block.expansion
+        self.stages = Sequential(*stages)
+        self.out_pool = Pool2D(global_pooling=True, pool_type="avg")
+        self.fc = Linear(c_in, num_classes)
+
+    def forward(self, x):
+        h = self.stages(self.pool(self.stem(x)))
+        h = self.out_pool(h)
+        return self.fc(h.reshape([x.shape[0], -1]))
+
+
+def resnet18(num_classes=1000):
+    return ResNet(18, num_classes)
+
+
+def resnet50(num_classes=1000):
+    return ResNet(50, num_classes)

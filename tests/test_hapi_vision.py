@@ -1,0 +1,63 @@
+"""hapi vision model classes (hapi/vision.py: LeNet, VGG, ResNet)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.hapi import vision
+import paddle_tpu.fluid as fluid
+
+
+def test_lenet_trains_on_mnist_batch():
+    from paddle_tpu.hapi.datasets import MNIST
+
+    ds = MNIST(mode="test")
+    imgs = np.stack([ds[i][0] for i in range(32)]).reshape(32, 1, 28, 28)
+    lbls = np.stack([ds[i][1] for i in range(32)])
+    with dygraph.guard():
+        net = vision.LeNet()
+        opt = fluid.optimizer.AdamOptimizer(
+            2e-3, parameter_list=net.parameters())
+        losses = []
+        for _ in range(15):
+            x = dygraph.to_variable(imgs.astype("float32"))
+            y = dygraph.to_variable(lbls.astype("int64"))
+            logits = net(x)
+            from paddle_tpu.fluid.dygraph.base import _trace_op
+
+            loss = _trace_op("softmax_with_cross_entropy",
+                             {"Logits": [logits], "Label": [y]},
+                             {"soft_label": False}, ["Loss"])[0].mean()
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(np.asarray(loss.numpy()).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_resnet18_and_vgg_forward_shapes():
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        x = dygraph.to_variable(rng.rand(2, 3, 64, 64).astype("f4"))
+        r18 = vision.resnet18(num_classes=7)
+        out = r18(x)
+        assert out.shape == (2, 7)
+        vgg = vision.VGG(11, num_classes=5, input_size=64)
+        out2 = vgg(x)
+        assert out2.shape == (2, 5)
+        assert np.isfinite(np.asarray(out.numpy())).all()
+        assert np.isfinite(np.asarray(out2.numpy())).all()
+
+
+def test_resnet50_bottleneck_builds():
+    rng = np.random.RandomState(1)
+    with dygraph.guard():
+        x = dygraph.to_variable(rng.rand(1, 3, 64, 64).astype("f4"))
+        out = vision.resnet50(num_classes=3)(x)
+        assert out.shape == (1, 3)
+
+
+def test_bad_depths_raise():
+    with pytest.raises(ValueError):
+        vision.ResNet(27)
+    with pytest.raises(ValueError):
+        vision.VGG(12)
