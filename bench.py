@@ -210,6 +210,8 @@ def main():
     cfg.remat_layer = os.environ.get("BENCH_REMAT_LAYER", "0") == "1"
     batch = int(os.environ.get("BENCH_BATCH", 48))
     seq = int(os.environ.get("BENCH_SEQ", 512))
+    # long-context runs: the position table must cover the sequence
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings, seq)
     max_preds = 76
     steps = int(os.environ.get("BENCH_STEPS", 30))
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
