@@ -83,13 +83,23 @@ class HeartBeatMonitor:
     A rank is only considered once it stamps AFTER this monitor was
     created: startup (imports, first XLA compile) can legitimately exceed
     the window, and a leftover stamp from a previous job in a reused
-    shared directory must not kill the new group before it boots.
+    shared directory must not kill the new group before it boots. But a
+    rank that NEVER produces a fresh stamp is still flagged once the
+    `startup_grace` window (default 30x the heartbeat timeout) runs out —
+    otherwise the exact hang class the feature targets (deadlock during
+    import or first compile) would go undetected forever.
     """
 
-    def __init__(self, directory: str, ranks: List[int], timeout: float):
+    def __init__(self, directory: str, ranks: List[int], timeout: float,
+                 startup_grace: Optional[float] = None):
         self.directory = directory
         self.ranks = list(ranks)
         self.timeout = timeout
+        self.startup_grace = (
+            startup_grace if startup_grace is not None
+            else float(os.environ.get("PADDLE_HEARTBEAT_STARTUP_GRACE",
+                                      30 * timeout))
+        )
         self._t0 = time.time()
 
     def stale_ranks(self, now: Optional[float] = None,
@@ -103,9 +113,13 @@ class HeartBeatMonitor:
             try:
                 mtime = os.path.getmtime(_stamp_path(self.directory, r))
             except OSError:
-                continue  # not started stamping yet
-            if mtime < self._t0:
-                continue  # stale leftover from a previous job/attempt
+                mtime = None  # no stamp file yet
+            if mtime is None or mtime < self._t0:
+                # never stamped under THIS monitor: flag only after the
+                # (long) startup grace window
+                if now - self._t0 > self.startup_grace:
+                    stale.append(r)
+                continue
             if now - mtime > self.timeout:
                 stale.append(r)
         return stale

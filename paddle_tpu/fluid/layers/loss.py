@@ -18,6 +18,12 @@ from . import nn as _nn
 from . import ops as _ops
 from . import tensor as _tensor
 
+__all__ = [
+    "mse_loss", "dice_loss", "bpr_loss", "center_loss",
+    "rank_loss", "margin_rank_loss", "npair_loss", "sigmoid_focal_loss",
+    "teacher_student_sigmoid_loss", "sampled_softmax_with_cross_entropy",
+]
+
 
 def mse_loss(input, label):
     """mean((input - label)^2) (reference mse_loss)."""

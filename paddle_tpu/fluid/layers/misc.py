@@ -17,6 +17,19 @@ from ..layer_helper import LayerHelper
 from . import nn as _nn
 from . import tensor as _tensor
 
+__all__ = [
+    "selu", "brelu", "soft_relu", "stanh",
+    "multiplex", "rank", "size", "sum",
+    "scatter_nd", "unique", "unique_with_counts", "is_empty",
+    "hash", "shard_index", "sampling_id", "gaussian_random",
+    "uniform_random", "gaussian_random_batch_size_like", "uniform_random_batch_size_like", "mean_iou",
+    "bilinear_tensor_product", "add_position_encoding", "fsp_matrix", "autoincreased_step_counter",
+    "get_tensor_from_selected_rows", "merge_selected_rows", "auc", "chunk_eval",
+    "nce", "hsigmoid", "inplace_abn", "similarity_focus",
+    "continuous_value_model", "filter_by_instag", "py_reader", "create_py_reader_by_data",
+    "read_file", "double_buffer", "load", "precision_recall",
+]
+
 
 def _simple(op_type, x, attrs=None, out_slot="Out", in_slot="X", name=None):
     helper = LayerHelper(op_type, name=name)

@@ -342,6 +342,16 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
             "dynamic_decode on TPU needs a static max_step_num (fixed-shape "
             "decode loop; finished rows are masked, not skipped)")
     inputs, states, finished = decoder.initialize(inits)
+    # finished rows pad their sample ids with the decoder's end token
+    # (reference semantics) rather than 0 — id 0 is a real vocab token in
+    # this repo's datasets (wmt16 <s> == 0), so zero-padding would
+    # misparse for consumers that ignore the returned lengths.
+    pad_id = 0
+    helper_end = getattr(getattr(decoder, "helper", None), "_end", None)
+    if helper_end is not None:
+        pad_id = int(helper_end)
+    elif getattr(decoder, "end", None) is not None:
+        pad_id = int(decoder.end)
     step_outputs, step_ids = [], []
     length_acc = None
     for t in range(int(max_step_num)):
@@ -351,11 +361,14 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
         alive = _nn.scale(finished, scale=-1.0, bias=1.0)  # [B]
         am = _nn.reshape(alive, [out.shape[0], 1])
         out = _nn.elementwise_mul(out, am)
+        alive_ids = (
+            _nn.reshape(alive, [ids.shape[0]] + [1] * (len(ids.shape) - 1))
+            if len(ids.shape) > 1 else alive)
         ids = _tensor.cast(
-            _nn.elementwise_mul(
-                _tensor.cast(ids, "float32"),
-                _nn.reshape(alive, [ids.shape[0]] + [1] * (len(ids.shape) - 1))
-                if len(ids.shape) > 1 else alive),
+            _nn.elementwise_add(
+                _nn.elementwise_mul(_tensor.cast(ids, "float32"), alive_ids),
+                _nn.scale(alive_ids, scale=-float(pad_id), bias=float(pad_id)),
+            ),
             "int64")
         step_outputs.append(out)
         step_ids.append(ids)
@@ -469,10 +482,55 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
              activation="tanh", gate_activation="sigmoid",
              origin_mode=False, name=None):
-    cell = GRUCell(size // 3 if size % 3 == 0 and size != hidden.shape[-1]
-                   else hidden.shape[-1], name=name or "gru_unit")
-    new_h, _ = cell.call(input, [hidden])
-    return new_h, None, new_h
+    """One GRU step over a PRE-PROJECTED input (reference rnn.py:2724
+    gru_unit + operators/gru_unit_op.h): `input` is [N, 3D] = x already
+    passed through a size-3D fc; the op owns only the recurrent weight
+    [D, 3D] (W_uh | W_rh in the first [D, 2D], W_ch last) and an optional
+    [1, 3D] bias. Returns (hidden [N, D], reset_hidden_pre [N, D],
+    gate [N, 3D] = the activated (u | r | c) slots)."""
+    acts = {"identity": lambda v: v, "sigmoid": _ops.sigmoid,
+            "tanh": _ops.tanh, "relu": _ops.relu}
+    act_c = acts[activation]
+    act_g = acts[gate_activation]
+    d = size // 3
+    helper = LayerHelper(name or "gru_unit")
+    weight = helper.create_parameter(param_attr, shape=[d, 3 * d],
+                                     dtype="float32")
+    g_in = input
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[1, 3 * d],
+                                       dtype="float32", is_bias=True)
+        g_in = _nn.elementwise_add(g_in, bias)
+    x_ur = _nn.slice(g_in, axes=[1], starts=[0], ends=[2 * d])
+    x_c = _nn.slice(g_in, axes=[1], starts=[2 * d], ends=[3 * d])
+    # the reference op partitions the FLAT weight buffer (gru_unit_op.h
+    # GEMM with ldb=2D): W_uh|W_rh = first 2*D*D elements as [D, 2D],
+    # W_ch = the last D*D as [D, D] — NOT column slices of [D, 3D]
+    w_flat = _nn.reshape(weight, [3 * d * d])
+    w_ur = _nn.reshape(
+        _nn.slice(w_flat, axes=[0], starts=[0], ends=[2 * d * d]), [d, 2 * d])
+    w_c = _nn.reshape(
+        _nn.slice(w_flat, axes=[0], starts=[2 * d * d], ends=[3 * d * d]),
+        [d, d])
+    ur = act_g(_nn.elementwise_add(x_ur, _nn.matmul(hidden, w_ur)))
+    u = _nn.slice(ur, axes=[1], starts=[0], ends=[d])
+    r = _nn.slice(ur, axes=[1], starts=[d], ends=[2 * d])
+    reset_hidden_pre = _nn.elementwise_mul(r, hidden)
+    c = act_c(_nn.elementwise_add(x_c, _nn.matmul(reset_hidden_pre, w_c)))
+    if origin_mode:
+        # h = u*h_prev + (1-u)*c  (Cho et al. 2014)
+        new_h = _nn.elementwise_add(
+            _nn.elementwise_mul(u, hidden),
+            _nn.elementwise_mul(_nn.scale(u, scale=-1.0, bias=1.0), c),
+        )
+    else:
+        # h = (1-u)*h_prev + u*c  (Chung et al. 2014)
+        new_h = _nn.elementwise_add(
+            _nn.elementwise_mul(_nn.scale(u, scale=-1.0, bias=1.0), hidden),
+            _nn.elementwise_mul(u, c),
+        )
+    gate = _tensor.concat([u, r, c], axis=1)
+    return new_h, reset_hidden_pre, gate
 
 
 def lstm(input, init_h, init_c, max_len, hidden_size, num_layers=1,

@@ -16,6 +16,16 @@ from ..initializer import ConstantInitializer, NormalInitializer
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
+__all__ = [
+    "conv3d", "conv3d_transpose", "pool3d", "adaptive_pool3d",
+    "image_resize", "resize_bilinear", "resize_nearest", "resize_trilinear",
+    "resize_linear", "image_resize_short", "affine_grid", "grid_sampler",
+    "affine_channel", "pixel_shuffle", "shuffle_channel", "space_to_depth",
+    "temporal_shift", "lrn", "unfold", "im2sequence",
+    "roi_pool", "spectral_norm", "data_norm", "crop_tensor",
+    "crop", "pad_constant_like", "random_crop",
+]
+
 
 def _triple(v):
     return [v, v, v] if isinstance(v, int) else list(v)

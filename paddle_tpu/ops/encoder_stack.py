@@ -92,8 +92,14 @@ def fused_encoder_stack(ctx, ins, attrs):
     def dropout(x, prob, key):
         if is_test or prob <= 0.0:
             return x
-        keep = jax.random.bernoulli(key, 1.0 - prob, x.shape)
-        return jnp.where(keep, x / (1.0 - prob), 0.0)
+        # uint8 random bits: 4x less generator traffic than bernoulli's
+        # 32-bit uniforms (profiled ~10ms/step on BERT-base with f32
+        # masks). The threshold is quantized to 1/256, so rescale by the
+        # EFFECTIVE keep probability to stay unbiased.
+        thresh = max(1, min(255, round((1.0 - prob) * 256)))
+        keep_eff = thresh / 256.0
+        bits = jax.random.bits(key, x.shape, dtype=jnp.uint8)
+        return jnp.where(bits < jnp.uint8(thresh), x / keep_eff, 0.0)
 
     def make_layer(bias_arr, mb_salt=None, manual=False):
         """Layer body closed over a (possibly microbatch-sliced) attention

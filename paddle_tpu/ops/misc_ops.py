@@ -61,9 +61,11 @@ def multiplex(ctx, ins, attrs):
 
 @register("unique_with_counts", stop_gradient=True, no_vjp_grad=True)
 def unique_with_counts(ctx, ins, attrs):
-    """1-D unique with static output sizes: Out is [N] (unique prefix,
-    padded with the last unique value), Index [N] maps x -> position in
-    Out, Count [N] (0 beyond the unique prefix), UniqueCount [] scalar."""
+    """1-D unique with static output sizes: Out is [N] (unique prefix;
+    jnp.unique(size=..., fill_value=None) pads the tail by REPEATING THE
+    SMALLEST unique value), Index [N] maps x -> position in Out, Count
+    [N] (0 beyond the unique prefix — use it or UniqueCount to find the
+    real prefix length), UniqueCount [] scalar."""
     x = ins["X"][0].reshape(-1)
     uniq, idx, counts = jnp.unique(
         x, return_inverse=True, return_counts=True, size=x.shape[0],

@@ -7,10 +7,27 @@ kernel: scores never materialize in HBM (O(S) memory instead of O(S^2)),
 and the backward pass recomputes probabilities blockwise from the saved
 log-sum-exp, the standard flash-attention-2 scheme.
 
-Capabilities (round 2 — all TPU-lowering-legal layouts):
+Round-3 kernel layout (profiled on v5e):
+  - head-group batching: each grid cell owns G (bh) rows and loops over
+    them in-kernel, so DMA blocks are G x bigger and the lse/delta
+    tensors tile cleanly;
+  - lse and delta ride as [BH, S] f32 with (G, bq) blocks — the round-2
+    [BH, NQ, 1, BQ] layout forced T(1,128) sub-tile writes that cost
+    ~0.37 ms/layer (70% of the bare kernel!) in the fwd alone, and the
+    same penalty again on the bwd reads;
+  - the per-key additive bias (BERT padding mask) is pre-broadcast to
+    [BH, S] outside the kernel — JAX autodiff turns the broadcast into
+    the head/batch sum for dbias, so the kernels lose all bias
+    row-mapping arithmetic ([B,nh,S,S]-style full bias keeps the row-map
+    path at G=1; it is the rare configuration).
+
+Capabilities:
   - additive bias: per-key [B,1,1,S] (BERT padding mask, cheap correct
     dbias) or full [B,nh,S,S] / [B,1,S,S] / [1,1,S,S]
-  - causal masking with block-level skipping (lower-triangular work only)
+  - causal masking with block-level skipping (lower-triangular work
+    only), including a runtime (q_offset, k_offset) pair so ring
+    attention can causal-mask blocks whose global positions are shifted
+    relative to the local shard
   - attention-probs dropout folded into the kernel: on TPU the mask is
     regenerated from the hardware PRNG (pltpu.prng_*) per (bh, q-block,
     k-block) in both forward and backward — zero HBM traffic for masks.
@@ -23,11 +40,7 @@ Capabilities (round 2 — all TPU-lowering-legal layouts):
     dp, heads on tp (megatron split); dropout seeds are decorrelated per
     shard and per-key dbias is psum'd over tp.
 
-Layout rules honored (Mosaic requires the last two block dims divisible
-by (8, 128) or equal to the array dims): lse/delta ride as
-[BH, NQ, 1, BQ]; the per-key bias as [B, 1, S].
-
-Block sizes are 128 to match the MXU; S must be a multiple of 128.
+Block sizes cap at 512 to match VMEM; S must be a multiple of 128.
 """
 from __future__ import annotations
 
@@ -40,17 +53,53 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 MIN_BLOCK = 128
+NEG_INF = -1e30
+# Scoped-VMEM headroom for the group-size estimate. Calibrated on v5e:
+# the dq kernel at (G=3, s=4096, bq=512) — estimate 9.9MB — actually
+# allocates 16.98M scoped and OOMs the 16M limit, while (G=2, s=4096,
+# estimate 7.7MB) fits; 9.5MB rejects the former and keeps the latter.
+_VMEM_BUDGET = 9 * 1024 * 1024 + 512 * 1024
 
 
 def _pick_block(s):
-    """Largest block that tiles s, capped at 512: at BERT-scale sequence
-    lengths the whole score tile fits VMEM and bigger dots keep the MXU
-    busy (128-blocks are latency-bound: profiled 4x slower at S=512)."""
+    """Largest block that tiles s, capped at 512: the whole score tile
+    fits VMEM and bigger dots keep the MXU busy (128-blocks are
+    latency-bound: profiled 4x slower at S=512)."""
     for cand in (512, 256, 128):
         if s % cand == 0:
             return cand
     raise ValueError(f"seq {s} not a multiple of {MIN_BLOCK}")
-NEG_INF = -1e30
+
+
+def _pick_group(bh, s, bq, d, full_bias):
+    """Head-group size G: how many bh rows one grid cell owns. Bounded by
+    a VMEM estimate (k/v resident per cell, double-buffered) and by
+    divisibility of bh. full-bias mode pins G=1 (its row-map indexing is
+    per-bh)."""
+    if full_bias:
+        return 1
+    import os
+
+    forced = int(os.environ.get("PADDLE_FLASH_GROUP", "0"))
+    if forced > 0 and bh % forced == 0:
+        return forced
+    for g in (8, 6, 4, 3, 2, 1):
+        if bh % g:
+            continue
+        kv = 2 * g * s * d * 2 * 2       # k+v, bf16, double-buffered
+        qo = 2 * g * bq * d * 2 * 2      # q+o blocks
+        sc = 3 * bq * min(s, 512) * 4    # per-head f32 score temporaries
+        if kv + qo + sc <= _VMEM_BUDGET:
+            return g
+    return 1
+
+
+# lse, delta, the pre-broadcast key bias and its gradient all ride as
+# [BH, 1, S] with (G, 1, block) blocks: the trailing (1, block) dims
+# satisfy Mosaic's tiling rule for ANY head-group size G (a plain
+# (G, block) block would need G % 8 == 0), and the rows are written/read
+# lane-major, which pairs with the MXU transpose trick below.
+
 
 # mixing constants for the per-(bh, qi, ki) dropout seed (fwd and bwd must
 # regenerate the exact same mask for a block pair); wrapped to signed i32
@@ -65,28 +114,73 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _block_seed(seed_ref, b, qi, ki):
-    base = seed_ref[0]
-    return (
-        base
-        + b * jnp.int32(_SEED_BH)
-        + qi * jnp.int32(_SEED_QI)
-        + ki * jnp.int32(_SEED_KI)
-    )
-
-
-def _dropout_keep(seed_ref, b, qi, ki, keep_prob, bq, bk):
+def _dropout_keep(seed_ref, bh, qi, ki, keep_prob, bq, bk):
     """[bq, bk] keep mask from the TPU hardware PRNG.
 
     Compare in int32 throughout: Mosaic's u32 compare/shift lowerings are
     signed, so mask the sign bit off the bitcast bits and compare 23-bit
     values — well-defined signed arithmetic with ~8e6 resolution."""
-    pltpu.prng_seed(_block_seed(seed_ref, b, qi, ki))
-    bits = pltpu.bitcast(
-        pltpu.prng_random_bits((bq, bk)), jnp.int32
+    pltpu.prng_seed(
+        seed_ref[0]
+        + bh * jnp.int32(_SEED_BH)
+        + qi * jnp.int32(_SEED_QI)
+        + ki * jnp.int32(_SEED_KI)
     )
+    bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.int32)
     thresh = jnp.int32(int(keep_prob * float(1 << 23)))
     return (bits & jnp.int32(0x7FFFFF)) < thresh
+
+
+def _identity(n):
+    """[n, n] f32 identity for MXU-side layout transposes (built once per
+    grid cell, outside the head loop)."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    return (r == c).astype(jnp.float32)
+
+
+def _to_lanes(x_sparse, ident):
+    """(n, 1) sublane-major -> (1, n) lane-major via an MXU matmul.
+
+    The VPU relayout Mosaic emits for a plain reshape walks 1-lane-wide
+    vregs and costs ~0.7us per call (profiled: it was 40% of the whole
+    fwd kernel); the [1,n]x[n,n] identity matmul is noise on the MXU."""
+    return jax.lax.dot_general(
+        x_sparse, ident, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _to_sublanes(x_lane, ident):
+    """(1, n) lane-major -> (n, 1) sublane-major via an MXU matmul."""
+    return jax.lax.dot_general(
+        ident, x_lane, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _causal_mask(s, qglob, kglob, bq, bk):
+    qpos = qglob + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = kglob + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _hi_blocks(causal, qi, bq, bk, nk, q_off, k_off):
+    """Number of k blocks a causal q block must visit. q_off/k_off are
+    global offsets (ring attention); both 0 locally."""
+    if not causal:
+        return nk
+    # last visible kpos = q_off + (qi+1)*bq - 1 - k_off
+    last = q_off + (qi + 1) * bq - k_off
+    return jnp.clip((last + bk - 1) // bk, 0, nk)
+
+
+def _lo_blocks(causal, ki, bq, bk, nq, q_off, k_off):
+    """First q block that sees causal k block ki (dkv loop lower bound)."""
+    if not causal:
+        return 0
+    first = k_off + ki * bk - q_off  # lowest qpos that can see this block
+    return jnp.clip(first // bq, 0, nq)
 
 
 # ---------------------------------------------------------------------------
@@ -94,138 +188,164 @@ def _dropout_keep(seed_ref, b, qi, ki, keep_prob, bq, bk):
 # ---------------------------------------------------------------------------
 
 
-def _make_fwd_kernel(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
-                     use_prng, has_mask, bq, bk):
-    """bias_mode: None | 'key' ([B,1,S] input) | 'full' ([G,S,S] input)."""
+def _make_fwd_kernel(*, sm_scale, causal, dropout_prob, bias_mode, use_prng,
+                     has_mask, has_offsets, G, bq, bk, num_heads, bias_dims):
+    """bias_mode: None | 'key' ([BH,S] pre-broadcast) | 'full' ([R,S,S])."""
 
     def kernel(*refs):
         it = iter(refs)
-        q_ref = next(it)          # [1, BQ, D]
-        k_ref = next(it)          # [1, S, D]
-        v_ref = next(it)          # [1, S, D]
+        q_ref = next(it)          # [G, BQ, D]
+        k_ref = next(it)          # [G, S, D]
+        v_ref = next(it)          # [G, S, D]
         bias_ref = next(it) if bias_mode else None
-        mask_ref = next(it) if has_mask else None     # [1, BQ, S] uint8
+        mask_ref = next(it) if has_mask else None     # [G, BQ, S] uint8
         seed_ref = next(it) if use_prng else None     # [1] int32 (SMEM)
-        o_ref = next(it)          # [1, BQ, D]
-        lse_ref = next(it)        # [1, 1, 1, BQ]
+        off_ref = next(it) if has_offsets else None   # [2] int32 (SMEM)
+        o_ref = next(it)          # [G, BQ, D]
+        lse_ref = next(it)        # [G, 1, BQ]
 
-        b = pl.program_id(0)
+        gi = pl.program_id(0)
         qi = pl.program_id(1)
-        # keep the input dtype (bf16 under AMP) for the MXU dots — f32
-        # inputs would force multi-pass f32 matmuls; accumulate in f32
-        q = q_ref[0]
         seq_len = k_ref.shape[1]
-        d = q.shape[-1]
+        nk = seq_len // bk
+        d = q_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
+        q_off = off_ref[0] if has_offsets else 0
+        k_off = off_ref[1] if has_offsets else 0
+        ident = _identity(bq)
 
-        def body(i, carry):
-            m, l, acc = carry
-            k = k_ref[0, pl.ds(i * bk, bk), :]
-            v = v_ref[0, pl.ds(i * bk, bk), :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            ) * sm_scale  # [BQ, BK]
-            if bias_mode == "key":
-                s = s + bias_ref[0, 0, pl.ds(i * bk, bk)][None, :]
-            elif bias_mode == "full":
-                s = s + bias_ref[0, :, pl.ds(i * bk, bk)].astype(jnp.float32)
-            if causal:
-                qpos = qi * bq + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
-                )
-                kpos = i * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m - m_new)
-            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            # numerator-only dropout: l accumulates undropped p, acc the
-            # masked p/(keep_prob) — exactly post-softmax dropout
-            p_num = p
-            if dropout_prob > 0.0:
-                if use_prng:
-                    keep = _dropout_keep(seed_ref, b, qi, i, keep_prob, bq, bk)
-                else:
-                    keep = mask_ref[0, :, pl.ds(i * bk, bk)] != 0
-                p_num = jnp.where(keep, p / keep_prob, 0.0)
-            acc = acc * alpha + jax.lax.dot_general(
-                p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l, acc
+        def head(g, _):
+            bh = gi * G + g
+            # keep the input dtype (bf16 under AMP) for the MXU dots — f32
+            # inputs would force multi-pass f32 matmuls; accumulate in f32
+            q = q_ref[g]
 
-        m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((bq, 1), jnp.float32)
-        acc0 = jnp.zeros((bq, d), jnp.float32)
-        hi = (qi + 1) if causal else (seq_len // bk)
-        m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-        l_safe = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
+            def body(i, carry):
+                m, l, acc = carry
+                k = k_ref[g, pl.ds(i * bk, bk), :]
+                v = v_ref[g, pl.ds(i * bk, bk), :]
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * sm_scale  # [BQ, BK]
+                if bias_mode == "key":
+                    s = s + bias_ref[g, 0, pl.ds(i * bk, bk)][None, :]
+                elif bias_mode == "full":
+                    s = s + bias_ref[0, :, pl.ds(i * bk, bk)].astype(jnp.float32)
+                if causal:
+                    s = _causal_mask(
+                        s, q_off + qi * bq, k_off + i * bk, bq, bk
+                    )
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m - m_new)
+                l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                # numerator-only dropout: l accumulates undropped p, acc
+                # the masked p/keep_prob — exactly post-softmax dropout
+                p_num = p
+                if dropout_prob > 0.0:
+                    if use_prng:
+                        keep = _dropout_keep(
+                            seed_ref, bh, qi, i, keep_prob, bq, bk
+                        )
+                    else:
+                        keep = mask_ref[g, :, pl.ds(i * bk, bk)] != 0
+                    p_num = jnp.where(keep, p / keep_prob, 0.0)
+                acc = acc * alpha + jax.lax.dot_general(
+                    p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return m_new, l, acc
+
+            m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+            l0 = jnp.zeros((bq, 1), jnp.float32)
+            acc0 = jnp.zeros((bq, d), jnp.float32)
+            hi = _hi_blocks(causal, qi, bq, bk, nk, q_off, k_off)
+            m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+            l_safe = jnp.maximum(l, 1e-30)
+            o_ref[g] = (acc / l_safe).astype(o_ref.dtype)
+            lse_ref[g, 0] = _to_lanes(m + jnp.log(l_safe), ident)[0]
+            return 0
+
+        jax.lax.fori_loop(0, G, head, 0)
 
     return kernel
 
 
-def _flash_fwd(q, k, v, bias, mask, seed, *, sm_scale, num_heads, causal,
-               dropout_prob, bias_mode, bias_dims):
+def _fwd_specs(bh, s, d, G, bq, bias_mode, bias_dims, num_heads, has_mask,
+               use_prng, has_offsets):
+    in_specs = [
+        pl.BlockSpec((G, bq, d), lambda g, i: (g, i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((G, s, d), lambda g, i: (g, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((G, s, d), lambda g, i: (g, 0, 0), memory_space=pltpu.VMEM),
+    ]
+    if bias_mode == "key":
+        in_specs.append(
+            pl.BlockSpec((G, 1, s), lambda g, i: (g, 0, 0),
+                         memory_space=pltpu.VMEM)
+        )
+    elif bias_mode == "full":
+        dv_, md_ = _bias_row_map(bias_dims, num_heads)
+        in_specs.append(
+            pl.BlockSpec(
+                (1, bq, s),
+                lambda g, i, dv=dv_, md=md_: ((g // dv) % md, i, 0),
+                memory_space=pltpu.VMEM,
+            )
+        )
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((G, bq, s), lambda g, i: (g, i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+    if use_prng:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    return in_specs
+
+
+def _flash_fwd(q, k, v, bias, mask, seed, offsets, *, sm_scale, num_heads,
+               causal, dropout_prob, bias_mode, bias_dims):
     bh, s, d = q.shape
     bq = bk = _pick_block(s)
     nq = s // bq
+    G = _pick_group(bh, s, bq, d, bias_mode == "full")
     use_prng = dropout_prob > 0.0 and mask is None
     has_mask = mask is not None and dropout_prob > 0.0
-    grid = (bh, nq)
-    in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-    ]
+    has_offsets = offsets is not None
+    in_specs = _fwd_specs(bh, s, d, G, bq, bias_mode, bias_dims, num_heads,
+                          has_mask, use_prng, has_offsets)
     args = [q, k, v]
     if bias_mode:
-        dv_, md_ = _bias_row_map(bias_dims, num_heads)
-        if bias_mode == "key":
-            in_specs.append(
-                pl.BlockSpec(
-                    (1, 1, s),
-                    lambda b, i, dv=dv_, md=md_: ((b // dv) % md, 0, 0),
-                    memory_space=pltpu.VMEM,
-                )
-            )
-        else:
-            in_specs.append(
-                pl.BlockSpec(
-                    (1, bq, s),
-                    lambda b, i, dv=dv_, md=md_: ((b // dv) % md, i, 0),
-                    memory_space=pltpu.VMEM,
-                )
-            )
         args.append(bias)
     if has_mask:
-        in_specs.append(
-            pl.BlockSpec((1, bq, s), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM)
-        )
         args.append(mask)
     if use_prng:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if has_offsets:
+        args.append(offsets)
     kernel = _make_fwd_kernel(
-        sm_scale=sm_scale, num_heads=num_heads, causal=causal,
-        dropout_prob=dropout_prob, bias_mode=bias_mode, use_prng=use_prng,
-        has_mask=has_mask, bq=bq, bk=bk,
+        sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
+        bias_mode=bias_mode, use_prng=use_prng, has_mask=has_mask,
+        has_offsets=has_offsets, G=G, bq=bq, bk=bk, num_heads=num_heads,
+        bias_dims=bias_dims,
     )
+    lse_spec = pl.BlockSpec(
+        (G, 1, bq), lambda g, i: (g, 0, i), memory_space=pltpu.VMEM
+    )
+    lse_shape = jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)
     o, lse = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(bh // G, nq),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, 1, bq), lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G, bq, d), lambda g, i: (g, i, 0), memory_space=pltpu.VMEM),
+            lse_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, nq, 1, bq), jnp.float32),
+            lse_shape,
         ],
         interpret=_interpret(),
     )(*args)
@@ -237,245 +357,488 @@ def _flash_fwd(q, k, v, bias, mask, seed, *, sm_scale, num_heads, causal,
 # ---------------------------------------------------------------------------
 
 
-def _make_bwd_dq_kernel(*, sm_scale, num_heads, causal, dropout_prob,
-                        bias_mode, use_prng, has_mask, bq, bk):
+def _make_bwd_dq_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
+                        use_prng, has_mask, has_offsets, G, bq, bk,
+                        num_heads, bias_dims):
     def kernel(*refs):
         it = iter(refs)
-        q_ref = next(it)          # [1, BQ, D]
-        k_ref = next(it)          # [1, S, D]
-        v_ref = next(it)          # [1, S, D]
+        q_ref = next(it)          # [G, BQ, D]
+        k_ref = next(it)          # [G, S, D]
+        v_ref = next(it)          # [G, S, D]
         bias_ref = next(it) if bias_mode else None
         mask_ref = next(it) if has_mask else None
         seed_ref = next(it) if use_prng else None
-        do_ref = next(it)         # [1, BQ, D]
-        lse_ref = next(it)        # [1, 1, 1, BQ]
-        delta_ref = next(it)      # [1, 1, 1, BQ]
-        dq_ref = next(it)         # [1, BQ, D]
+        off_ref = next(it) if has_offsets else None
+        do_ref = next(it)         # [G, BQ, D]
+        lse_ref = next(it)        # [G, 1, BQ]
+        delta_ref = next(it)      # [G, 1, BQ]
+        dq_ref = next(it)         # [G, BQ, D]
 
-        b = pl.program_id(0)
+        gi = pl.program_id(0)
         qi = pl.program_id(1)
-        q = q_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0, 0, 0][:, None]
-        delta = delta_ref[0, 0, 0][:, None]
         seq_len = k_ref.shape[1]
-        d = q.shape[-1]
+        nk = seq_len // bk
+        d = q_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
+        q_off = off_ref[0] if has_offsets else 0
+        k_off = off_ref[1] if has_offsets else 0
+        ident = _identity(bq)
 
-        def body(i, dq):
-            k = k_ref[0, pl.ds(i * bk, bk), :]
-            v = v_ref[0, pl.ds(i * bk, bk), :]
-            s = (
-                jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        def head(g, _):
+            bh = gi * G + g
+            q = q_ref[g]
+            do = do_ref[g]
+            lse = _to_sublanes(lse_ref[g], ident)
+            delta = _to_sublanes(delta_ref[g], ident)
+
+            def body(i, dq):
+                k = k_ref[g, pl.ds(i * bk, bk), :]
+                v = v_ref[g, pl.ds(i * bk, bk), :]
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * sm_scale
+                if bias_mode:  # split path serves full-bias only
+                    s = s + bias_ref[0, :, pl.ds(i * bk, bk)].astype(jnp.float32)
+                if causal:
+                    s = _causal_mask(
+                        s, q_off + qi * bq, k_off + i * bk, bq, bk
+                    )
+                p = jnp.exp(s - lse)  # normalized probs P [BQ, BK]
+                dp = jax.lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
                 )
-                * sm_scale
-            )
-            if bias_mode == "key":
-                s = s + bias_ref[0, 0, pl.ds(i * bk, bk)][None, :]
-            elif bias_mode == "full":
-                s = s + bias_ref[0, :, pl.ds(i * bk, bk)].astype(jnp.float32)
-            if causal:
-                qpos = qi * bq + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
-                )
-                kpos = i * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            p = jnp.exp(s - lse)  # normalized probs P [BQ, BK]
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            if dropout_prob > 0.0:
-                if use_prng:
-                    keep = _dropout_keep(seed_ref, b, qi, i, keep_prob, bq, bk)
+                if dropout_prob > 0.0:
+                    if use_prng:
+                        keep = _dropout_keep(
+                            seed_ref, bh, qi, i, keep_prob, bq, bk
+                        )
+                    else:
+                        keep = mask_ref[g, :, pl.ds(i * bk, bk)] != 0
+                    c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                    ds = p * (c * dp - delta) * sm_scale
                 else:
-                    keep = mask_ref[0, :, pl.ds(i * bk, bk)] != 0
-                c = jnp.where(keep, 1.0 / keep_prob, 0.0)
-                ds = p * (c * dp - delta) * sm_scale
-            else:
-                ds = p * (dp - delta) * sm_scale
-            return dq + jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+                    ds = p * (dp - delta) * sm_scale
+                return dq + jax.lax.dot_general(
+                    ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
 
-        hi = (qi + 1) if causal else (seq_len // bk)
-        dq = jax.lax.fori_loop(
-            0, hi, body, jnp.zeros((bq, d), jnp.float32)
-        )
-        dq_ref[0] = dq.astype(dq_ref.dtype)
+            hi = _hi_blocks(causal, qi, bq, bk, nk, q_off, k_off)
+            dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+            dq_ref[g] = dq.astype(dq_ref.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, G, head, 0)
 
     return kernel
 
 
-def _make_bwd_dkv_kernel(*, sm_scale, num_heads, causal, dropout_prob,
-                         bias_mode, use_prng, has_mask, want_dbias, bq, bk):
-    """Grid (BH, NK); loops over q blocks. Also accumulates dbias:
-    per-key mode -> row-sums into [1,1,1,BK]; full mode -> writes the
-    [S, BK] column of ds (pre-scale) when want_dbias."""
+def _make_bwd_dkv_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
+                         use_prng, has_mask, has_offsets, want_dbias, G,
+                         bq, bk, num_heads, bias_dims):
+    """Split-path dk/dv kernel — serves ONLY the full-bias configuration
+    (every other bias mode takes the fused backward). Grid (BH//G, NK);
+    loops over q blocks; writes the [S, BK] column of ds (pre-scale) as
+    dbias when want_dbias."""
 
     def kernel(*refs):
         it = iter(refs)
-        q_ref = next(it)          # [1, S, D]
-        k_ref = next(it)          # [1, BK, D]
-        v_ref = next(it)          # [1, BK, D]
+        q_ref = next(it)          # [G, S, D]
+        k_ref = next(it)          # [G, BK, D]
+        v_ref = next(it)          # [G, BK, D]
         bias_ref = next(it) if bias_mode else None
-        mask_ref = next(it) if has_mask else None    # [1, S, BK]
+        mask_ref = next(it) if has_mask else None    # [G, S, BK]
         seed_ref = next(it) if use_prng else None
-        do_ref = next(it)         # [1, S, D]
-        lse_ref = next(it)        # [1, NQ, 1, BQ]
-        delta_ref = next(it)      # [1, NQ, 1, BQ]
-        dk_ref = next(it)         # [1, BK, D]
-        dv_ref = next(it)         # [1, BK, D]
-        dbias_key_ref = None
-        dbias_full_ref = None
-        if want_dbias and bias_mode == "key":
-            dbias_key_ref = next(it)   # [1, 1, 1, BK]
-        elif want_dbias and bias_mode == "full":
-            dbias_full_ref = next(it)  # [1, S, BK]
+        off_ref = next(it) if has_offsets else None
+        do_ref = next(it)         # [G, S, D]
+        lse_ref = next(it)        # [G, 1, S]
+        delta_ref = next(it)      # [G, 1, S]
+        dk_ref = next(it)         # [G, BK, D]
+        dv_ref = next(it)         # [G, BK, D]
+        dbias_full_ref = next(it) if want_dbias else None  # [1, S, BK]
 
-        b = pl.program_id(0)
+        gi = pl.program_id(0)
         ki = pl.program_id(1)
-        k = k_ref[0]  # [BK, D]
-        v = v_ref[0]
         seq_len = q_ref.shape[1]
-        d = k.shape[-1]
+        nq = seq_len // bq
+        d = k_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
-        if bias_mode == "key":
-            b_block = bias_ref[0, 0, pl.ds(ki * bk, bk)]
+        q_off = off_ref[0] if has_offsets else 0
+        k_off = off_ref[1] if has_offsets else 0
+        ident = _identity(bq)
         if dbias_full_ref is not None:
             dbias_full_ref[0] = jnp.zeros_like(dbias_full_ref[0])
 
-        def body(i, carry):
-            dk, dv, dbsum = carry
-            q = q_ref[0, pl.ds(i * bq, bq), :]
-            do = do_ref[0, pl.ds(i * bq, bq), :]
-            lse = lse_ref[0, i, 0][:, None]
-            delta = delta_ref[0, i, 0][:, None]
-            s = (
-                jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-                )
-                * sm_scale
-            )
-            if bias_mode == "key":
-                s = s + b_block[None, :]
-            elif bias_mode == "full":
-                s = s + bias_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-            if causal:
-                qpos = i * bq + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
-                )
-                kpos = ki * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            p = jnp.exp(s - lse)  # [BQ, BK]
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            if dropout_prob > 0.0:
-                if use_prng:
-                    keep = _dropout_keep(seed_ref, b, i, ki, keep_prob, bq, bk)
-                else:
-                    keep = mask_ref[0, pl.ds(i * bq, bq), :] != 0
-                c = jnp.where(keep, 1.0 / keep_prob, 0.0)
-                p_num = p * c
-            else:
-                p_num = p
-            dv = dv + jax.lax.dot_general(
-                p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds_nos = p * ((dp * (c if dropout_prob > 0.0 else 1.0)) - delta)
-            ds = ds_nos * sm_scale  # [BQ, BK]
-            dk = dk + jax.lax.dot_general(
-                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            if dbias_full_ref is not None:
-                dbias_full_ref[0, pl.ds(i * bq, bq), :] = ds_nos.astype(
-                    dbias_full_ref.dtype
-                )
-            if dbias_key_ref is not None:
-                dbsum = dbsum + jnp.sum(ds_nos, axis=0)
-            return dk, dv, dbsum
+        def head(g, _):
+            bh = gi * G + g
+            k = k_ref[g]  # [BK, D]
+            v = v_ref[g]
 
-        dk0 = jnp.zeros((bk, d), jnp.float32)
-        dv0 = jnp.zeros((bk, d), jnp.float32)
-        db0 = jnp.zeros((bk,), jnp.float32)
-        lo = ki if causal else 0
-        dk, dv, dbsum = jax.lax.fori_loop(lo, seq_len // bq, body, (dk0, dv0, db0))
-        dk_ref[0] = dk.astype(dk_ref.dtype)
-        dv_ref[0] = dv.astype(dv_ref.dtype)
-        if dbias_key_ref is not None:
-            dbias_key_ref[0, 0, 0] = dbsum
+            def body(i, carry):
+                dk, dv, dbsum = carry
+                q = q_ref[g, pl.ds(i * bq, bq), :]
+                do = do_ref[g, pl.ds(i * bq, bq), :]
+                lse = _to_sublanes(
+                    lse_ref[g, :, pl.ds(i * bq, bq)], ident
+                )
+                delta = _to_sublanes(
+                    delta_ref[g, :, pl.ds(i * bq, bq)], ident
+                )
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * sm_scale
+                if bias_mode:  # split path serves full-bias only
+                    s = s + bias_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+                if causal:
+                    s = _causal_mask(
+                        s, q_off + i * bq, k_off + ki * bk, bq, bk
+                    )
+                p = jnp.exp(s - lse)  # [BQ, BK]
+                dp = jax.lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if dropout_prob > 0.0:
+                    if use_prng:
+                        keep = _dropout_keep(
+                            seed_ref, bh, i, ki, keep_prob, bq, bk
+                        )
+                    else:
+                        keep = mask_ref[g, pl.ds(i * bq, bq), :] != 0
+                    c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                    p_num = p * c
+                else:
+                    c = 1.0
+                    p_num = p
+                dv = dv + jax.lax.dot_general(
+                    p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                ds_nos = p * (dp * c - delta)
+                ds = ds_nos * sm_scale  # [BQ, BK]
+                dk = dk + jax.lax.dot_general(
+                    ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if dbias_full_ref is not None:
+                    dbias_full_ref[0, pl.ds(i * bq, bq), :] = ds_nos.astype(
+                        dbias_full_ref.dtype
+                    )
+                return dk, dv, dbsum
+
+            dk0 = jnp.zeros((bk, d), jnp.float32)
+            dv0 = jnp.zeros((bk, d), jnp.float32)
+            db0 = jnp.zeros((bk,), jnp.float32)
+            lo = _lo_blocks(causal, ki, bq, bk, nq, q_off, k_off)
+            dk, dv, _ = jax.lax.fori_loop(lo, nq, body, (dk0, dv0, db0))
+            dk_ref[g] = dk.astype(dk_ref.dtype)
+            dv_ref[g] = dv.astype(dv_ref.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, G, head, 0)
 
     return kernel
 
 
-def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
-               bias_mode, bias_dims, want_dbias, g_lse=None):
-    q, k, v, bias, mask, seed, o, lse = res
+def _make_bwd_fused_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
+                           use_prng, has_mask, has_offsets, want_dbias, G,
+                           bq, bk, num_heads, bias_dims):
+    """Single-pass backward: grid (BH//G, NK) with NK innermost. Computes
+    dk/dv for this k block AND accumulates dq across the NK sweep into an
+    f32 output block whose index map is constant in ki (Pallas keeps the
+    revisited block resident in VMEM; it is zeroed at ki==0 and written
+    back once the sweep ends). Versus the two-kernel scheme this shares
+    the score/probability recompute (7 matmul passes instead of 9) and
+    reads q/do/k/v once instead of twice. key-bias and no-bias only —
+    full-bias keeps the split path (its row-map runs at G=1)."""
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref = next(it)          # [G, S, D]
+        k_ref = next(it)          # [G, BK, D]
+        v_ref = next(it)          # [G, BK, D]
+        bias_ref = next(it) if bias_mode else None
+        mask_ref = next(it) if has_mask else None    # [G, S, BK]
+        seed_ref = next(it) if use_prng else None
+        off_ref = next(it) if has_offsets else None
+        do_ref = next(it)         # [G, S, D]
+        lse_ref = next(it)        # [G, 1, S]
+        delta_ref = next(it)      # [G, 1, S]
+        dq_ref = next(it)         # [G, S, D] f32, revisited across ki
+        dk_ref = next(it)         # [G, BK, D]
+        dv_ref = next(it)         # [G, BK, D]
+        dbias_key_ref = next(it) if (want_dbias and bias_mode == "key") else None
+
+        gi = pl.program_id(0)
+        ki = pl.program_id(1)
+        nk = pl.num_programs(1)
+        seq_len = q_ref.shape[1]
+        nq = seq_len // bq
+        d = k_ref.shape[-1]
+        keep_prob = 1.0 - dropout_prob
+        q_off = off_ref[0] if has_offsets else 0
+        k_off = off_ref[1] if has_offsets else 0
+        ident = _identity(bq)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_ref[...] = jnp.zeros_like(dq_ref)
+
+        def head(g, _):
+            bh = gi * G + g
+            k = k_ref[g]  # [BK, D]
+            v = v_ref[g]
+            if bias_mode == "key":
+                b_block = bias_ref[g, 0, pl.ds(ki * bk, bk)]
+
+            def body(i, carry):
+                dk, dv, dbsum = carry
+                q = q_ref[g, pl.ds(i * bq, bq), :]
+                do = do_ref[g, pl.ds(i * bq, bq), :]
+                lse = _to_sublanes(
+                    lse_ref[g, :, pl.ds(i * bq, bq)], ident
+                )
+                delta = _to_sublanes(
+                    delta_ref[g, :, pl.ds(i * bq, bq)], ident
+                )
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * sm_scale
+                if bias_mode == "key":
+                    s = s + b_block[None, :]
+                if causal:
+                    s = _causal_mask(
+                        s, q_off + i * bq, k_off + ki * bk, bq, bk
+                    )
+                p = jnp.exp(s - lse)  # [BQ, BK]
+                dp = jax.lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if dropout_prob > 0.0:
+                    if use_prng:
+                        keep = _dropout_keep(
+                            seed_ref, bh, i, ki, keep_prob, bq, bk
+                        )
+                    else:
+                        keep = mask_ref[g, pl.ds(i * bq, bq), :] != 0
+                    c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                    p_num = p * c
+                else:
+                    c = 1.0
+                    p_num = p
+                dv = dv + jax.lax.dot_general(
+                    p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                ds_nos = p * (dp * c - delta)
+                ds = (ds_nos * sm_scale).astype(q.dtype)  # [BQ, BK]
+                dk = dk + jax.lax.dot_general(
+                    ds, q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                dq_ref[g, pl.ds(i * bq, bq), :] += jax.lax.dot_general(
+                    ds, k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if dbias_key_ref is not None:
+                    dbsum = dbsum + jnp.sum(ds_nos, axis=0)
+                return dk, dv, dbsum
+
+            dk0 = jnp.zeros((bk, d), jnp.float32)
+            dv0 = jnp.zeros((bk, d), jnp.float32)
+            db0 = jnp.zeros((bk,), jnp.float32)
+            lo = _lo_blocks(causal, ki, bq, bk, nq, q_off, k_off)
+            dk, dv, dbsum = jax.lax.fori_loop(lo, nq, body, (dk0, dv0, db0))
+            dk_ref[g] = dk.astype(dk_ref.dtype)
+            dv_ref[g] = dv.astype(dv_ref.dtype)
+            if dbias_key_ref is not None:
+                dbias_key_ref[g, 0] = dbsum
+            return 0
+
+        jax.lax.fori_loop(0, G, head, 0)
+
+    return kernel
+
+
+def _bwd_fused(q, k, v, bias, mask, seed, offsets, g, lse, delta, *,
+               sm_scale, num_heads, causal, dropout_prob, bias_mode,
+               bias_dims, want_dbias, G, bq, bk):
+    """Launch the single-pass backward. Returns (dq, dk, dv, dbias)."""
     bh, s, d = q.shape
-    bq = bk = _pick_block(s)
-    nq, nk = s // bq, s // bk
+    nk = s // bk
     use_prng = dropout_prob > 0.0 and mask is None
     has_mask = mask is not None and dropout_prob > 0.0
-    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)  # [BH,S]
-    if g_lse is not None:
-        # lse cotangent: d lse_i/d s_ij = P_ij, so ds gains +P*g_lse —
-        # algebraically identical to subtracting g_lse from delta
-        delta = delta - g_lse.astype(jnp.float32)
-    delta = delta.reshape(bh, nq, 1, bq)
+    has_offsets = offsets is not None
 
-    qspec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
-    fullspec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
-    rowspec = pl.BlockSpec((1, 1, 1, bq), lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM)
-    fullrow = pl.BlockSpec((1, nq, 1, bq), lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((G, bk, d), lambda g_, i: (g_, i, 0), memory_space=pltpu.VMEM)
+    fullspec = pl.BlockSpec((G, s, d), lambda g_, i: (g_, 0, 0), memory_space=pltpu.VMEM)
+    fullrow = pl.BlockSpec((G, 1, s), lambda g_, i: (g_, 0, 0), memory_space=pltpu.VMEM)
 
-    dv_, md_ = _bias_row_map(bias_dims, num_heads) if bias_mode else (1, 1)
-
-    def bias_specs(block_rows, rows_idx):
-        if bias_mode == "key":
-            return pl.BlockSpec(
-                (1, 1, s),
-                lambda b, i, dv=dv_, md=md_: ((b // dv) % md, 0, 0),
-                memory_space=pltpu.VMEM,
-            )
-        return pl.BlockSpec(
-            (1, block_rows, s) if rows_idx else (1, s, bk),
-            (lambda b, i, dv=dv_, md=md_: ((b // dv) % md, i, 0))
-            if rows_idx
-            else (lambda b, i, dv=dv_, md=md_: ((b // dv) % md, 0, i)),
-            memory_space=pltpu.VMEM,
-        )
-
-    # ---- dq: grid over q blocks
     args = [q, k, v]
-    in_specs = [qspec, fullspec, fullspec]
-    if bias_mode:
-        in_specs.append(bias_specs(bq, True))
+    in_specs = [fullspec, kspec, kspec]
+    if bias_mode == "key":
+        in_specs.append(
+            pl.BlockSpec((G, 1, s), lambda g_, i: (g_, 0, 0),
+                         memory_space=pltpu.VMEM)
+        )
         args.append(bias)
     if has_mask:
         in_specs.append(
-            pl.BlockSpec((1, bq, s), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec((G, s, bk), lambda g_, i: (g_, 0, i), memory_space=pltpu.VMEM)
         )
         args.append(mask)
     if use_prng:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if has_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(offsets)
+    in_specs += [fullspec, fullrow, fullrow]
+    args += [g, lse, delta]
+
+    out_specs = [
+        pl.BlockSpec((G, s, d), lambda g_, i: (g_, 0, 0), memory_space=pltpu.VMEM),
+        kspec,
+        kspec,
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((bh, s, d), jnp.float32),  # dq accumulator
+        jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+    ]
+    if want_dbias and bias_mode == "key":
+        out_specs.append(
+            pl.BlockSpec((G, 1, bk), lambda g_, i: (g_, 0, i),
+                         memory_space=pltpu.VMEM)
+        )
+        out_shapes.append(jax.ShapeDtypeStruct((bh, 1, s), jnp.float32))
+
+    outs = pl.pallas_call(
+        _make_bwd_fused_kernel(
+            sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
+            bias_mode=bias_mode, use_prng=use_prng, has_mask=has_mask,
+            has_offsets=has_offsets,
+            want_dbias=want_dbias and bias_mode == "key",
+            G=G, bq=bq, bk=bk, num_heads=num_heads, bias_dims=bias_dims,
+        ),
+        grid=(bh // G, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(*args)
+    dq = outs[0].astype(q.dtype)
+    dk, dv = outs[1], outs[2]
+    dbias = outs[3] if (want_dbias and bias_mode == "key") else None
+    return dq, dk, dv, dbias
+
+
+def _pick_group_bwd(bh, s, bq, d, full_bias):
+    """Group size for the fused backward. Footprint model calibrated on
+    v5e against Mosaic's scoped-vmem report (G=8/s=512 allocates 16.97M):
+    full-length tensors (q, do double-buffered bf16; dq f32 revisited)
+    cost ~16 B/elem, the four block tensors (k, v, dk, dv) ~16 B/elem of
+    their bk-sized blocks, plus ~7MB of fixed score temporaries and the
+    identity; keep the total under 14M of the 16M scoped limit."""
+    if full_bias:
+        return 1
+    import os
+
+    forced = int(os.environ.get("PADDLE_FLASH_GROUP_BWD", "0"))
+    if forced > 0 and bh % forced == 0:
+        return forced
+    for g in (8, 6, 4, 3, 2, 1):
+        if bh % g:
+            continue
+        fulls = 16 * g * s * d
+        blocks = 16 * g * min(s, bq) * d
+        if fulls + blocks + 7 * 1024 * 1024 <= 14 * 1024 * 1024:
+            return g
+    return 1
+
+
+def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
+               bias_mode, bias_dims, want_dbias, g_lse=None):
+    q, k, v, bias, mask, seed, offsets, o, lse = res
+    bh, s, d = q.shape
+    bq = bk = _pick_block(s)
+    nq, nk = s // bq, s // bk
+    use_prng = dropout_prob > 0.0 and mask is None
+    has_mask = mask is not None and dropout_prob > 0.0
+    has_offsets = offsets is not None
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)  # [BH,S]
+    if g_lse is not None:
+        # lse cotangent: d lse_i/d s_ij = P_ij, so ds gains +P*g_lse —
+        # algebraically identical to subtracting g_lse from delta
+        delta = delta - g_lse.astype(jnp.float32)
+    delta = delta.reshape(bh, 1, s)
+
+    if bias_mode != "full":
+        Gb = _pick_group_bwd(bh, s, bq, d, False)
+        return _bwd_fused(
+            q, k, v, bias, mask, seed, offsets, g, lse, delta,
+            sm_scale=sm_scale, num_heads=num_heads, causal=causal,
+            dropout_prob=dropout_prob, bias_mode=bias_mode,
+            bias_dims=bias_dims, want_dbias=want_dbias, G=Gb, bq=bq, bk=bk,
+        )
+
+    # ---- full-bias split path (the rare [B|1, nh|1, S, S] bias): its
+    # per-bh row-map indexing pins G=1
+    G = 1
+    qspec = pl.BlockSpec((G, bq, d), lambda g_, i: (g_, i, 0), memory_space=pltpu.VMEM)
+    fullspec = pl.BlockSpec((G, s, d), lambda g_, i: (g_, 0, 0), memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec(
+        (G, 1, bq), lambda g_, i: (g_, 0, i), memory_space=pltpu.VMEM
+    )
+    fullrow = pl.BlockSpec(
+        (G, 1, s), lambda g_, i: (g_, 0, 0), memory_space=pltpu.VMEM
+    )
+
+    dv_, md_ = _bias_row_map(bias_dims, num_heads)
+
+    def bias_spec(rows_idx):
+        return pl.BlockSpec(
+            (1, bq, s) if rows_idx else (1, s, bk),
+            (lambda g_, i, dv=dv_, md=md_: ((g_ // dv) % md, i, 0))
+            if rows_idx
+            else (lambda g_, i, dv=dv_, md=md_: ((g_ // dv) % md, 0, i)),
+            memory_space=pltpu.VMEM,
+        )
+
+    statics = dict(
+        sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
+        bias_mode=bias_mode, use_prng=use_prng, has_mask=has_mask,
+        has_offsets=has_offsets, G=G, bq=bq, bk=bk, num_heads=num_heads,
+        bias_dims=bias_dims,
+    )
+
+    # ---- dq: grid over q blocks
+    args = [q, k, v]
+    in_specs = [qspec, fullspec, fullspec]
+    if bias_mode:
+        in_specs.append(bias_spec(True))
+        args.append(bias)
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((G, bq, s), lambda g_, i: (g_, i, 0), memory_space=pltpu.VMEM)
+        )
+        args.append(mask)
+    if use_prng:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    if has_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(offsets)
     in_specs += [qspec, rowspec, rowspec]
     args += [g, lse, delta]
     dq = pl.pallas_call(
-        _make_bwd_dq_kernel(
-            sm_scale=sm_scale, num_heads=num_heads, causal=causal,
-            dropout_prob=dropout_prob, bias_mode=bias_mode, use_prng=use_prng,
-            has_mask=has_mask, bq=bq, bk=bk,
-        ),
-        grid=(bh, nq),
+        _make_bwd_dq_kernel(**statics),
+        grid=(bh // G, nq),
         in_specs=in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -483,22 +846,24 @@ def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
     )(*args)
 
     # ---- dk/dv (+dbias): grid over k blocks
-    kspec = pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
-    fullq = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((G, bk, d), lambda g_, i: (g_, i, 0), memory_space=pltpu.VMEM)
     args2 = [q, k, v]
-    in_specs2 = [fullq, kspec, kspec]
+    in_specs2 = [fullspec, kspec, kspec]
     if bias_mode:
-        in_specs2.append(bias_specs(s, False))
+        in_specs2.append(bias_spec(False))
         args2.append(bias)
     if has_mask:
         in_specs2.append(
-            pl.BlockSpec((1, s, bk), lambda b, i: (b, 0, i), memory_space=pltpu.VMEM)
+            pl.BlockSpec((G, s, bk), lambda g_, i: (g_, 0, i), memory_space=pltpu.VMEM)
         )
         args2.append(mask)
     if use_prng:
         in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args2.append(seed)
-    in_specs2 += [fullq, fullrow, fullrow]
+    if has_offsets:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(offsets)
+    in_specs2 += [fullspec, fullrow, fullrow]
     args2 += [g, lse, delta]
 
     out_specs2 = [kspec, kspec]
@@ -506,25 +871,15 @@ def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
         jax.ShapeDtypeStruct((bh, s, d), k.dtype),
         jax.ShapeDtypeStruct((bh, s, d), v.dtype),
     ]
-    if want_dbias and bias_mode == "key":
+    if want_dbias:
         out_specs2.append(
-            pl.BlockSpec((1, 1, 1, bk), lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM)
-        )
-        out_shapes2.append(jax.ShapeDtypeStruct((bh, nk, 1, bk), jnp.float32))
-    elif want_dbias and bias_mode == "full":
-        out_specs2.append(
-            pl.BlockSpec((1, s, bk), lambda b, i: (b, 0, i), memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, s, bk), lambda g_, i: (g_, 0, i), memory_space=pltpu.VMEM)
         )
         out_shapes2.append(jax.ShapeDtypeStruct((bh, s, s), jnp.float32))
 
     outs = pl.pallas_call(
-        _make_bwd_dkv_kernel(
-            sm_scale=sm_scale, num_heads=num_heads, causal=causal,
-            dropout_prob=dropout_prob, bias_mode=bias_mode, use_prng=use_prng,
-            has_mask=has_mask, want_dbias=want_dbias and bias_mode is not None,
-            bq=bq, bk=bk,
-        ),
-        grid=(bh, nk),
+        _make_bwd_dkv_kernel(want_dbias=want_dbias, **statics),
+        grid=(bh // G, nk),
         in_specs=in_specs2,
         out_specs=out_specs2,
         out_shape=out_shapes2,
@@ -532,27 +887,17 @@ def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,
     )(*args2)
     dk, dv = outs[0], outs[1]
 
-    # reduce the raw dbias to bias3's shape ([G,1,S] key / [G,S,S] full);
-    # JAX autodiff maps it back to the user's 4-D bias through the
-    # reshape/astype that produced bias3
+    # reduce dbias grid cells that shared one broadcast row
     dbias = None
-    if want_dbias and bias_mode is not None:
+    if want_dbias:
         bb, bn = bias_dims
         batch = bh // num_heads
-        if bias_mode == "key":
-            # [BH, NK, 1, BK] -> [BH, S]; queries were summed in-kernel
-            db = outs[2].reshape(batch, num_heads, s)
-        else:
-            db = outs[2].reshape(batch, num_heads, s, s)
-        # sum grid cells that shared one bias row (broadcast transpose)
+        db = outs[2].reshape(batch, num_heads, s, s)
         if bn == 1 and num_heads > 1:
             db = db.sum(axis=1, keepdims=True)
         if bb == 1 and batch > 1:
             db = db.sum(axis=0, keepdims=True)
-        if bias_mode == "key":
-            dbias = db.reshape(bb, 1, s)
-        else:
-            dbias = db.reshape(bb * bn, s, s)
+        dbias = db.reshape(bb * bn, s, s)
     return dq, dk, dv, dbias
 
 
@@ -572,13 +917,13 @@ def _make_flash_core(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
     )
 
     @jax.custom_vjp
-    def core(q, k, v, bias, mask, seed):
-        o, _ = _flash_fwd(q, k, v, bias, mask, seed, **statics)
+    def core(q, k, v, bias, mask, seed, offsets):
+        o, _ = _flash_fwd(q, k, v, bias, mask, seed, offsets, **statics)
         return o
 
-    def core_fwd(q, k, v, bias, mask, seed):
-        o, lse = _flash_fwd(q, k, v, bias, mask, seed, **statics)
-        return o, (q, k, v, bias, mask, seed, o, lse)
+    def core_fwd(q, k, v, bias, mask, seed, offsets):
+        o, lse = _flash_fwd(q, k, v, bias, mask, seed, offsets, **statics)
+        return o, (q, k, v, bias, mask, seed, offsets, o, lse)
 
     def core_bwd(res, g):
         dq, dk, dv, dbias = _flash_bwd(
@@ -589,7 +934,7 @@ def _make_flash_core(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
             dbias = jnp.zeros_like(res[3])
         elif dbias is not None:
             dbias = dbias.astype(res[3].dtype)
-        return (dq, dk, dv, dbias, None, None)
+        return (dq, dk, dv, dbias, None, None, None)
 
     core.defvjp(core_fwd, core_bwd)
     return core
@@ -601,13 +946,13 @@ def _make_flash_core(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
 
 
 def _classify_bias(bias, b, nh, s):
-    """Returns (bias_3d, bias_mode, (bb, bn)). bias_3d is a plain traced
-    reshape of the user bias, so dbias (returned in bias_3d's shape) flows
-    back to the user shape through ordinary autodiff.
+    """Returns (bias_kernel, bias_mode, (bb, bn)).
 
-    Grid cell bh = b_idx * nh + h_idx maps to bias row
-    (bh // div) % mod with div = nh if bn == 1 else 1 and mod = bb * bn —
-    covering all four broadcast patterns ([B|1, nh|1, ...])."""
+    'key' mode pre-broadcasts the user's [B|1,1,1,S] padding mask to
+    [B*nh, S] f32 with plain traced ops — dbias (returned in that shape)
+    flows back to the user shape through ordinary autodiff (the
+    broadcast transposes to a sum over heads/batch). 'full' mode keeps
+    [R, S, S] rows with in-kernel row mapping (R = bb*bn)."""
     if bias is None:
         return None, None, None
     if bias.ndim != 4:
@@ -620,9 +965,10 @@ def _classify_bias(bias, b, nh, s):
     if bk != s:
         raise ValueError(f"bias key dim {bk} != seq {s}")
     if bn == 1 and bq == 1:
-        # per-key padding mask [B|1, 1, 1, S] -> [G, 1, S]
-        b3 = bias.reshape(bb, 1, s).astype(jnp.float32)
-        return b3, "key", (bb, 1)
+        bkey = jnp.broadcast_to(
+            bias.astype(jnp.float32).reshape(bb, 1, s), (b, nh, s)
+        ).reshape(b * nh, 1, s)
+        return bkey, "key", (bb, 1)
     if bq != s:
         raise ValueError(f"bias query dim {bq} != seq {s}")
     b3 = bias.reshape(bb * bn, s, s)
@@ -630,7 +976,7 @@ def _classify_bias(bias, b, nh, s):
 
 
 def _bias_row_map(bias_dims, num_heads):
-    """(div, mod) such that bias row = (bh // div) % mod."""
+    """(div, mod) such that full-bias row = (bh // div) % mod."""
     bb, bn = bias_dims
     return (num_heads if bn == 1 else 1), bb * bn
 
@@ -639,7 +985,7 @@ def _flash_local(q, k, v, bias, mask, seed, *, sm_scale, causal, dropout_prob,
                  bias_requires_grad):
     """[B, nh, S, D] local (per-shard) flash attention."""
     b, nh, s, d = q.shape
-    bias3, bias_mode, bias_dims = _classify_bias(bias, b, nh, s)
+    biask, bias_mode, bias_dims = _classify_bias(bias, b, nh, s)
     mask3 = mask.reshape(b * nh, s, s) if mask is not None else None
     qf = q.reshape(b * nh, s, d)
     kf = k.reshape(b * nh, s, d)
@@ -649,7 +995,7 @@ def _flash_local(q, k, v, bias, mask, seed, *, sm_scale, causal, dropout_prob,
         dropout_prob=dropout_prob, bias_mode=bias_mode, bias_dims=bias_dims,
         want_dbias=bias_requires_grad and bias_mode is not None,
     )
-    o = core(qf, kf, vf, bias3, mask3, seed)
+    o = core(qf, kf, vf, biask, mask3, seed, None)
     return o.reshape(b, nh, s, d)
 
 
@@ -763,14 +1109,14 @@ def _make_flash_core_lse(*, sm_scale, num_heads, causal, dropout_prob,
     )
 
     @jax.custom_vjp
-    def core(q, k, v, bias, mask, seed):
-        o, lse4 = _flash_fwd(q, k, v, bias, mask, seed, **statics)
-        return o, lse4.reshape(q.shape[0], q.shape[1])
+    def core(q, k, v, bias, mask, seed, offsets):
+        o, lse = _flash_fwd(q, k, v, bias, mask, seed, offsets, **statics)
+        return o, lse.reshape(q.shape[0], q.shape[1])
 
-    def core_fwd(q, k, v, bias, mask, seed):
-        o, lse4 = _flash_fwd(q, k, v, bias, mask, seed, **statics)
-        return (o, lse4.reshape(q.shape[0], q.shape[1])), (
-            q, k, v, bias, mask, seed, o, lse4,
+    def core_fwd(q, k, v, bias, mask, seed, offsets):
+        o, lse = _flash_fwd(q, k, v, bias, mask, seed, offsets, **statics)
+        return (o, lse.reshape(q.shape[0], q.shape[1])), (
+            q, k, v, bias, mask, seed, offsets, o, lse,
         )
 
     def core_bwd(res, gs):
@@ -783,37 +1129,63 @@ def _make_flash_core_lse(*, sm_scale, num_heads, causal, dropout_prob,
             dbias = jnp.zeros_like(res[3])
         elif dbias is not None:
             dbias = dbias.astype(res[3].dtype)
-        return (dq, dk, dv, dbias, None, None)
+        return (dq, dk, dv, dbias, None, None, None)
 
     core.defvjp(core_fwd, core_bwd)
     return core
 
 
 def flash_block_with_lse(q, k, v, key_bias=None, sm_scale=None,
-                         bias_requires_grad=True):
+                         bias_requires_grad=True, causal=False,
+                         q_offset=None, k_offset=None,
+                         dropout_prob=0.0, dropout_seed=None,
+                         dropout_mask=None):
     """One attention block for ring attention: q/k/v [B, nh, S, D] local
     shards, key_bias [B, S] additive per-key bias (rotating with K).
     Returns (out [B, nh, S, D], lse [B, nh, S]) for log-sum-exp merging
-    across ring steps. No dropout/causal here — the ring caller falls
-    back to the jnp path for those. Bias gradients are computed by
-    default, matching the jnp ring block math."""
+    across ring steps.
+
+    causal + (q_offset, k_offset): global positions of this shard's q
+    rows / the visiting k block, as int32 scalars (traced values are
+    fine — they ride in SMEM), so the ring's shifted blocks mask
+    correctly. dropout: `dropout_seed` int32 scalar (the ring caller
+    folds its step index in); in interpret mode pass `dropout_mask`
+    [B, nh, S, S] uint8 instead. Bias gradients are computed by default,
+    matching the jnp ring block math."""
     b, nh, s, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    bias3 = None
+    biask = None
     bias_mode = None
     bias_dims = None
     if key_bias is not None:
-        bias3 = key_bias.reshape(b, 1, s).astype(jnp.float32)
+        biask = jnp.broadcast_to(
+            key_bias.astype(jnp.float32).reshape(b, 1, s), (b, nh, s)
+        ).reshape(b * nh, 1, s)
         bias_mode, bias_dims = "key", (b, 1)
+    offsets = None
+    if causal and (q_offset is not None or k_offset is not None):
+        offsets = jnp.stack([
+            jnp.asarray(q_offset if q_offset is not None else 0, jnp.int32),
+            jnp.asarray(k_offset if k_offset is not None else 0, jnp.int32),
+        ])
+    seed = None
+    mask3 = None
+    if dropout_prob > 0.0:
+        if dropout_mask is not None:
+            mask3 = dropout_mask.reshape(b * nh, s, s)
+        elif dropout_seed is not None:
+            seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+        else:
+            raise ValueError("dropout needs dropout_seed or dropout_mask")
     core = _make_flash_core_lse(
-        sm_scale=float(sm_scale), num_heads=nh, causal=False,
-        dropout_prob=0.0, bias_mode=bias_mode, bias_dims=bias_dims,
+        sm_scale=float(sm_scale), num_heads=nh, causal=causal,
+        dropout_prob=dropout_prob, bias_mode=bias_mode, bias_dims=bias_dims,
         want_dbias=bias_requires_grad,
     )
     o, lse = core(
         q.reshape(b * nh, s, d), k.reshape(b * nh, s, d),
-        v.reshape(b * nh, s, d), bias3, None, None,
+        v.reshape(b * nh, s, d), biask, mask3, seed, offsets,
     )
     return o.reshape(b, nh, s, d), lse.reshape(b, nh, s)
 

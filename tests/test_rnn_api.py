@@ -101,7 +101,9 @@ def test_basic_decoder_greedy_finishes_on_end_token():
     assert iv.shape == (b, 5)
     np.testing.assert_array_equal(iv[:, 0], [end] * b)
     np.testing.assert_array_equal(lv, [1] * b)  # finished after one step
-    assert np.all(iv[:, 1:] == 0)  # frozen rows emit masked zeros
+    # frozen rows pad with the decoder's end token (reference padding
+    # semantics), NOT 0 — id 0 can be a real vocab token
+    assert np.all(iv[:, 1:] == end)
 
 
 def test_training_helper_teacher_forcing_shapes():
@@ -187,22 +189,29 @@ def test_multilayer_lstm_and_lstmp():
             layers.reshape(layers.slice(x, [1], [0], [1]), [b, d]),
             layers.fill_constant([b, h], "float32", 0.0),
             layers.fill_constant([b, h], "float32", 0.0))
-        gu, _, _ = layers.gru_unit(
-            layers.reshape(layers.slice(x, [1], [0], [1]), [b, d]),
+        # reference contract: input is the pre-projected [N, 3H] tensor
+        # (a size-3H fc runs before gru_unit; rnn.py:2767-2770)
+        gu, gu_reset, gu_gate = layers.gru_unit(
+            layers.fc(layers.reshape(layers.slice(x, [1], [0], [1]), [b, d]),
+                      3 * h),
             layers.fill_constant([b, h], "float32", 0.0), 3 * h)
     rng = np.random.RandomState(3)
     exe = fluid.Executor()
     with fluid.scope_guard(fluid.executor.Scope()):
         exe.run(startup)
-        o, lh, pj, huv, guv = exe.run(
+        o, lh, pj, huv, guv, grv, ggv = exe.run(
             main, feed={"x": rng.randn(b, t, d).astype("f4")},
-            fetch_list=[out, last_h, proj, hu, gu])
+            fetch_list=[out, last_h, proj, hu, gu, gu_reset, gu_gate])
     assert np.asarray(o).shape == (b, t, h)
     assert np.asarray(lh).shape == (2, b, h)
     assert np.asarray(pj).shape == (b, t, p)
     assert np.asarray(huv).shape == (b, h)
     assert np.asarray(guv).shape == (b, h)
-    for a in (o, lh, pj, huv, guv):
+    # gru_unit returns REAL middle/gate outputs: reset_hidden_pre [N, D]
+    # (r ⊙ h_prev) and the activated gate concat [N, 3D]
+    assert np.asarray(grv).shape == (b, h)
+    assert np.asarray(ggv).shape == (b, 3 * h)
+    for a in (o, lh, pj, huv, guv, grv, ggv):
         assert np.isfinite(np.asarray(a)).all()
 
 
