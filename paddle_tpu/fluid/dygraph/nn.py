@@ -447,8 +447,20 @@ class GRUUnit(Layer):
             input = _trace_op("elementwise_add",
                               {"X": [input], "Y": [self.bias]}, {},
                               ["Out"])[0]
-        w_uz = self._slice(self.weight, 0, 2 * h)      # [H, 2H]
-        w_c = self._slice(self.weight, 2 * h, 3 * h)   # [H, H]
+        # reference gru_unit_op.h partitions the FLAT weight buffer (GEMM
+        # ldb=2D): W_uh|W_rh = the first 2*H*H elements as [H, 2H], W_ch =
+        # the last H*H as [H, H] — same layout as layers.gru_unit, so
+        # checkpoints are interchangeable between the two APIs
+        w_flat = _trace_op("reshape2", {"X": [self.weight]},
+                           {"shape": [3 * h * h]}, ["Out", "XShape"])[0]
+        w_uz = _trace_op("reshape2", {"X": [_trace_op(
+            "slice", {"Input": [w_flat]},
+            {"axes": [0], "starts": [0], "ends": [2 * h * h]}, ["Out"])[0]]},
+            {"shape": [h, 2 * h]}, ["Out", "XShape"])[0]     # [H, 2H]
+        w_c = _trace_op("reshape2", {"X": [_trace_op(
+            "slice", {"Input": [w_flat]},
+            {"axes": [0], "starts": [2 * h * h], "ends": [3 * h * h]},
+            ["Out"])[0]]}, {"shape": [h, h]}, ["Out", "XShape"])[0]  # [H, H]
         h_uz = _trace_op("matmul", {"X": [hidden], "Y": [w_uz]}, {},
                          ["Out"])[0]
         gates = _trace_op(self._gate_act, {"X": [_trace_op(
@@ -474,7 +486,8 @@ class GRUUnit(Layer):
             "elementwise_mul", {"X": [keep], "Y": [hidden]}, {}, ["Out"])[0]],
             "Y": [_trace_op("elementwise_mul", {"X": [take], "Y": [c]}, {},
                             ["Out"])[0]]}, {}, ["Out"])[0]
-        return new_h, None, new_h
+        gate = _trace_op("concat", {"X": [u, r, c]}, {"axis": 1}, ["Out"])[0]
+        return new_h, rh, gate
 
 
 class NCE(Layer):

@@ -172,6 +172,27 @@ class Executor:
 
         donated = _load(compiled.donate_names)
         kept = _load(compiled.keep_names)
+        if getattr(compiled, "repl_sharding", None) is not None:
+            import jax
+
+            if jax.process_count() > 1:
+                # multi-process jit rejects host numpy for sharded params:
+                # build global jax.Arrays from the (identical-per-process)
+                # full batch; each process materializes only its shards
+                feed_arrays = {
+                    n: (
+                        a if isinstance(a, jax.Array)
+                        else jax.make_array_from_callback(
+                            np.shape(a), compiled.feed_shardings[n],
+                            lambda idx, a=a: np.asarray(a)[idx],
+                        )
+                    )
+                    for n, a in feed_arrays.items()
+                }
+                if getattr(scope._rng_key, "sharding", None) != compiled.repl_sharding:
+                    scope._rng_key = jax.device_put(
+                        scope._rng_key, compiled.repl_sharding
+                    )
         with RecordEvent("Executor::run"):
             fetches, new_state, new_key = compiled.fn(
                 feed_arrays, donated, kept, scope._rng_key
@@ -331,6 +352,8 @@ class Executor:
                 jit_fn, list(feed_names), donate_names, keep_names, state_out, fetch_names
             )
             cb.state_shardings = {n: sh(n) for n in donate_names + keep_names}
+            cb.feed_shardings = {n: sh(n) for n in feed_names}
+            cb.repl_sharding = repl
             return cb
         jit_fn = jax.jit(fn, donate_argnums=(1,) if donate else ())
         return _CompiledBlock(

@@ -71,6 +71,21 @@ def _pick_block(s):
     raise ValueError(f"seq {s} not a multiple of {MIN_BLOCK}")
 
 
+def _scan_groups(bh, env_var, fits):
+    """Shared group-size scan: honor a (divisibility-checked) env
+    override, else take the largest divisor of bh whose footprint
+    estimate fits."""
+    import os
+
+    forced = int(os.environ.get(env_var, "0"))
+    if forced > 0 and bh % forced == 0:
+        return forced
+    for g in (8, 6, 4, 3, 2, 1):
+        if bh % g == 0 and fits(g):
+            return g
+    return 1
+
+
 def _pick_group(bh, s, bq, d, full_bias):
     """Head-group size G: how many bh rows one grid cell owns. Bounded by
     a VMEM estimate (k/v resident per cell, double-buffered) and by
@@ -78,20 +93,14 @@ def _pick_group(bh, s, bq, d, full_bias):
     per-bh)."""
     if full_bias:
         return 1
-    import os
 
-    forced = int(os.environ.get("PADDLE_FLASH_GROUP", "0"))
-    if forced > 0 and bh % forced == 0:
-        return forced
-    for g in (8, 6, 4, 3, 2, 1):
-        if bh % g:
-            continue
+    def fits(g):
         kv = 2 * g * s * d * 2 * 2       # k+v, bf16, double-buffered
         qo = 2 * g * bq * d * 2 * 2      # q+o blocks
         sc = 3 * bq * min(s, 512) * 4    # per-head f32 score temporaries
-        if kv + qo + sc <= _VMEM_BUDGET:
-            return g
-    return 1
+        return kv + qo + sc <= _VMEM_BUDGET
+
+    return _scan_groups(bh, "PADDLE_FLASH_GROUP", fits)
 
 
 # lse, delta, the pre-broadcast key bias and its gradient all ride as
@@ -747,19 +756,13 @@ def _pick_group_bwd(bh, s, bq, d, full_bias):
     identity; keep the total under 14M of the 16M scoped limit."""
     if full_bias:
         return 1
-    import os
 
-    forced = int(os.environ.get("PADDLE_FLASH_GROUP_BWD", "0"))
-    if forced > 0 and bh % forced == 0:
-        return forced
-    for g in (8, 6, 4, 3, 2, 1):
-        if bh % g:
-            continue
+    def fits(g):
         fulls = 16 * g * s * d
         blocks = 16 * g * min(s, bq) * d
-        if fulls + blocks + 7 * 1024 * 1024 <= 14 * 1024 * 1024:
-            return g
-    return 1
+        return fulls + blocks + 7 * 1024 * 1024 <= 14 * 1024 * 1024
+
+    return _scan_groups(bh, "PADDLE_FLASH_GROUP_BWD", fits)
 
 
 def _flash_bwd(res, g, *, sm_scale, num_heads, causal, dropout_prob,

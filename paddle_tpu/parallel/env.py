@@ -54,6 +54,15 @@ def init_parallel_env() -> None:
     if world > 1:
         import jax
 
+        # CPU multi-process needs the gloo collectives backend (the TPU
+        # path rides ICI/DCN natively). Sniff the env instead of calling
+        # jax.default_backend(): that would initialize backends BEFORE
+        # the coordination service, which breaks multi-process startup.
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001 — older jaxlib without gloo
+                pass
         eps = get_endpoints()
         coordinator = eps[0] if eps else None
         jax.distributed.initialize(
