@@ -88,10 +88,26 @@ class DistributedOptimizer:
 
         _reject_unsupported(strategy)
 
+        dcn = int(strategy.hybrid_dcn or 0)
         mesh = strategy.mesh
         if mesh is None:
-            axes = dict(strategy.mesh_axes) if strategy.mesh_axes else {"dp": -1}
+            if dcn >= 2:
+                axes = dict(strategy.mesh_axes) if strategy.mesh_axes else {}
+                if "dcn" not in axes:
+                    axes = {"dcn": dcn, **(axes or {"dp": -1})}
+            else:
+                axes = dict(strategy.mesh_axes) if strategy.mesh_axes else {"dp": -1}
             mesh = create_mesh(axes)
+        if dcn >= 2:
+            # a mesh without the outer axis would make c_dcn_grad_sync
+            # degrade to identity — silent parameter divergence; fail loud
+            if "dcn" not in mesh.axis_names or mesh.shape["dcn"] != dcn:
+                raise ValueError(
+                    f"strategy.hybrid_dcn={dcn} but the resolved mesh "
+                    f"{dict(mesh.shape)} has no matching 'dcn' axis; give "
+                    f"the mesh a 'dcn' axis of exactly that size (or drop "
+                    f"strategy.mesh/mesh_axes and let fleet build it)"
+                )
 
         # optimizer swaps (reference fleet/meta_optimizers/{lamb,lars}_
         # optimizer.py replace the inner optimizer the same way)
@@ -165,10 +181,30 @@ class DistributedOptimizer:
                 acc = mesh.shape["pp"]
             inner = PipelineOptimizer(inner, num_microbatches=acc)
 
+        if dcn >= 2:
+            # multi-slice: the executor runs the step MANUALLY sharded
+            # over (dcn, dp) so per-shard gradients are visible, and a
+            # c_dcn_grad_sync op per parameter does the two-level
+            # reduction (dense over ICI, dense-or-DGC over DCN)
+            inner = _DCNGradSyncOptimizer(inner, strategy)
+
         result = inner.minimize(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set,
         )
+
+        if dcn >= 2:
+            manual = tuple(a for a in ("dcn", "dp") if a in mesh.axis_names)
+            program._manual_axes = manual
+            for v in program.list_vars():
+                if getattr(v, "is_data", False) and v.shape:
+                    _parallel.set_var_sharding(
+                        v, (tuple(manual),) + (None,) * (len(v.shape) - 1)
+                    )
+            program._mesh = mesh
+            if startup_program is not None:
+                startup_program._mesh = mesh
+            return result
 
         if strategy.sharding and "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
             _shard_optimizer_states(inner, mesh)
@@ -199,16 +235,111 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     return DistributedOptimizer(optimizer, strategy)
 
 
+class _DCNGradSyncOptimizer:
+    """Insert a c_dcn_grad_sync op between backward and the optimizer
+    update for every parameter gradient (the multi-slice hybrid_dcn
+    mode). The inner optimizer must expose backward/apply_optimize
+    (plain + recompute optimizers do; amp/gradient_merge are rejected by
+    _reject_unsupported)."""
+
+    def __init__(self, inner, strategy):
+        self.inner_opt = inner
+        self._strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..fluid import unique_name
+        from ..fluid.optimizer import _create_persistable_var
+
+        strategy = self._strategy
+        n_dcn = int(strategy.hybrid_dcn)
+        params_grads = self.inner_opt.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        block = loss.block.program.global_block()
+        use_dgc = bool(strategy.dgc)
+        cfgs = strategy.dgc_configs or {}
+        sparsity = float(cfgs.get("sparsity", 0.999))
+        rampup = int(cfgs.get("rampup_begin_step", 0))
+        step_var = None
+        if use_dgc and rampup > 0:
+            # in-graph step counter driving the DGC dense warm-up
+            step_var = _create_persistable_var(
+                unique_name.generate("dcn_dgc_step"), [1], "float32", 0.0
+            )
+            block.append_op(
+                type="scale",
+                inputs={"X": [step_var]},
+                outputs={"Out": [step_var]},
+                attrs={"scale": 1.0, "bias": 1.0},
+            )
+        synced = []
+        for p, g in params_grads:
+            if g is None:
+                synced.append((p, g))
+                continue
+            inputs = {"X": [g]}
+            outputs = {}
+            if use_dgc:
+                # [n_dcn, *shape], SHARDED over "dcn": each slice owns its
+                # error-feedback residual (replicating it would collapse
+                # the per-slice state on any metadata-trusting reshard)
+                ef = _create_persistable_var(
+                    p.name + "@DGCErrorFeedback",
+                    (n_dcn,) + tuple(p.shape), "float32", 0.0,
+                )
+                set_var_sharding(
+                    ef, ("dcn",) + (None,) * len(tuple(p.shape))
+                )
+                inputs["ErrorFeedback"] = [ef]
+                outputs["ErrorFeedback"] = [ef]
+                if step_var is not None:
+                    inputs["Step"] = [step_var]
+            out_name = unique_name.generate(g.name + "@DCNSync")
+            block.append_op(
+                type="c_dcn_grad_sync",
+                inputs=inputs,
+                outputs={"Out": [out_name], **outputs},
+                attrs={"use_dgc": use_dgc, "sparsity": sparsity,
+                       "rampup_begin_step": rampup, "dcn_axis": "dcn"},
+            )
+            synced.append((p, block.var(out_name)))
+        opt_ops = self.inner_opt.apply_optimize(
+            loss, startup_program, synced
+        )
+        return opt_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
 def _reject_unsupported(strategy):
     """No silently ignored strategy field: every accepted-but-unimplemented
     flag raises with the reason (VERDICT round-1 weak #4)."""
-    if strategy.dgc:
+    if strategy.dgc and int(strategy.hybrid_dcn or 0) < 2:
         raise NotImplementedError(
             "strategy.dgc: deep gradient compression exists to survive slow "
             "interconnects (reference details/sparse_all_reduce_op_handle.cc); "
-            "over TPU ICI the XLA all-reduce runs near roofline, so DGC is "
-            "not applicable — unset strategy.dgc"
+            "over single-slice TPU ICI the XLA all-reduce runs near roofline "
+            "so compression only costs accuracy — set strategy.hybrid_dcn to "
+            "the slice count to apply DGC across the slow DCN axis, where it "
+            "belongs"
         )
+    if int(strategy.hybrid_dcn or 0) >= 2:
+        for flag, name in (
+            (strategy.tensor_parallel, "tensor_parallel"),
+            (strategy.pipeline, "pipeline"),
+            (strategy.sequence_parallel, "sequence_parallel"),
+            (strategy.expert_parallel, "expert_parallel"),
+            (strategy.gradient_merge, "gradient_merge"),
+            (strategy.amp, "amp"),
+            (strategy.sharding, "sharding"),
+        ):
+            if flag:
+                raise NotImplementedError(
+                    f"strategy.hybrid_dcn composes with plain data "
+                    f"parallelism only for now; unset strategy.{name}"
+                )
     if strategy.localsgd:
         raise NotImplementedError(
             "strategy.localsgd: GSPMD keeps parameters replicated, so "

@@ -316,6 +316,101 @@ class Executor:
             next_key = jax.random.fold_in(ctx.rng_state, 0x5EED)
             return fetches, new_state, next_key
 
+        manual_axes = getattr(program, "_manual_axes", None)
+        if mesh is not None and manual_axes:
+            # Manual multi-slice path (fleet hybrid_dcn): the whole step
+            # runs inside shard_map over (dcn, dp) so per-shard gradients
+            # stay VISIBLE — the program's c_dcn_grad_sync ops own the
+            # two-level reduction (dense pmean over ICI, dense-or-DGC
+            # over DCN) that GSPMD would otherwise fuse into one opaque
+            # all-reduce. Parameters/optimizer state ride replicated;
+            # identical synced grads keep them bitwise in lockstep.
+            # Restriction (documented in fleet): data-parallel programs —
+            # per-shard-divergent state like BN running stats is not
+            # representable under the replicated out_specs.
+            from jax import shard_map
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            gblock = program.global_block()
+
+            def pspec(name):
+                v = gblock._find_var_recursive(name)
+                spec = getattr(v, "_sharding", None) if v is not None else None
+                if spec is None:
+                    return PartitionSpec()
+                return spec if isinstance(spec, PartitionSpec) else PartitionSpec(*spec)
+
+            repl_p = PartitionSpec()
+            axis_sizes = [mesh.shape[a] for a in manual_axes]
+
+            def local_fn(feed_vals, donated_vals, kept_vals, rng_key):
+                import jax.lax as lax
+                import jax.numpy as jnp
+
+                # decorrelate per-shard randomness (dropout draws differ
+                # per data shard, like per-worker seeds in the reference);
+                # the RETURNED key advances from the unsalted key so the
+                # replicated out_spec holds
+                shard = lax.axis_index(manual_axes[0])
+                for ax, size in zip(manual_axes[1:], axis_sizes[1:]):
+                    shard = shard * size + lax.axis_index(ax)
+                salted = jax.random.fold_in(rng_key, shard)
+                ctx = registry.EmitContext(
+                    rng_key=salted, mesh=None, manual_axes=manual_axes
+                )
+                env: Dict[str, Any] = {}
+                env.update(kept_vals)
+                env.update(donated_vals)
+                env.update(feed_vals)
+                registry.emit_ops(ctx, ops, env)
+
+                def _sync(x):
+                    # fetches must be replicated: mean float metrics (the
+                    # global loss = mean of per-shard batch means); assume
+                    # non-floats are already replicated
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                        return lax.pmean(x, manual_axes)
+                    return x
+
+                fetches = [_sync(env[n]) for n in fetch_names]
+                new_state = {n: env[n] for n in state_out}
+                next_key = jax.random.fold_in(rng_key, 0x5EED)
+                return fetches, new_state, next_key
+
+            # state vars default to replicated; vars annotated with a
+            # sharding (the DGC per-slice error-feedback buffers, sharded
+            # over "dcn") keep their per-shard identity through the specs
+            in_specs = (
+                {n: pspec(n) for n in feed_names},
+                {n: pspec(n) for n in donate_names},
+                {n: pspec(n) for n in keep_names},
+                repl_p,
+            )
+            out_specs = (
+                [repl_p for _ in fetch_names],
+                {n: pspec(n) for n in state_out},
+                repl_p,
+            )
+            wrapped = shard_map(
+                local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+            jit_fn = jax.jit(wrapped, donate_argnums=(1,) if donate else ())
+            cb = _CompiledBlock(
+                jit_fn, list(feed_names), donate_names, keep_names,
+                state_out, fetch_names,
+            )
+            repl = NamedSharding(mesh, repl_p)
+            cb.state_shardings = {
+                n: NamedSharding(mesh, pspec(n))
+                for n in donate_names + keep_names
+            }
+            cb.feed_shardings = {
+                n: NamedSharding(mesh, pspec(n)) for n in feed_names
+            }
+            cb.repl_sharding = repl
+            return cb
+
         if mesh is not None:
             # GSPMD path: every var maps to a NamedSharding (default
             # replicated); XLA SPMD inserts the collectives. This replaces

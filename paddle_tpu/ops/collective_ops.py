@@ -106,3 +106,82 @@ def c_wait_compute(ctx, ins, attrs):
 @register("c_wait_comm", no_vjp_grad=True)
 def c_wait_comm(ctx, ins, attrs):
     return _noop(ctx, ins, attrs)
+
+
+@register("c_dcn_grad_sync", no_vjp_grad=True)
+def c_dcn_grad_sync(ctx, ins, attrs):
+    """Two-level multi-slice gradient sync (the TPU-era successor to the
+    reference's hierarchical allreduce, platform/nccl_helper.h:185
+    InitHierarchicalCtxs, and to DGC's sparse allreduce,
+    details/sparse_all_reduce_op_handle.cc).
+
+    Runs inside the executor's manual shard_map over ("dcn", inner axes):
+    the local gradient is densely pmean'd over the fast inner (ICI) axes,
+    then either densely pmean'd over "dcn" (use_dgc=False — hierarchical
+    allreduce) or DGC-compressed across it: add the persistent
+    error-feedback residual, take the top-k = (1 - sparsity) * numel
+    entries by magnitude, all-gather only those k (value, index) pairs
+    over the DCN axis — k floats+ints per slice instead of the full
+    tensor — scatter-add into a dense buffer, and keep what was NOT sent
+    as the next step's residual (error feedback makes the compression
+    unbiased over time).
+
+    Reference-parity knobs: `sparsity` (fraction dropped) and
+    `rampup_begin_step` with the in-graph `Step` counter input — steps
+    before the rampup boundary sync densely (DGC's warm-up), matching
+    DGCMomentumOptimizer's rampup contract.
+
+    Emitted outside a manual mesh region (world size 1), it degrades to
+    identity. In/out slot `ErrorFeedback` names the same persistable var
+    — shape [n_dcn, *param_shape], SHARDED over the dcn axis (each slice
+    owns its own residual; declaring it replicated would silently
+    collapse the per-slice residuals on any metadata-trusting reshard)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    g = ins["X"][0]
+    manual = getattr(ctx, "manual_axes", None) or ()
+    dcn_axis = attrs.get("dcn_axis", "dcn")
+    inner = tuple(a for a in manual if a != dcn_axis)
+    outs = {}
+    if dcn_axis not in manual:
+        outs["Out"] = [g]
+        if "ErrorFeedback" in ins:
+            outs["ErrorFeedback"] = [ins["ErrorFeedback"][0]]
+        return outs
+    if inner:
+        g = lax.pmean(g, inner)
+    if not attrs.get("use_dgc", False):
+        outs["Out"] = [lax.pmean(g, dcn_axis)]
+        if "ErrorFeedback" in ins:
+            outs["ErrorFeedback"] = [ins["ErrorFeedback"][0]]
+        return outs
+    n_dcn = lax.psum(jnp.ones((), jnp.float32), dcn_axis)
+    e3 = ins["ErrorFeedback"][0]  # local view [1, *param_shape]
+    e = e3[0]
+    acc = (g + e).astype(jnp.float32)
+    flat = acc.reshape(-1)
+    sparsity = float(attrs.get("sparsity", 0.999))
+    k = max(1, int(round(flat.size * (1.0 - sparsity))))
+    _, topi = lax.top_k(jnp.abs(flat), k)
+    vals = flat[topi]
+    sent = jnp.zeros_like(flat).at[topi].set(vals)
+    e_new = (flat - sent).reshape(acc.shape)
+    all_vals = lax.all_gather(vals, dcn_axis)  # [n_dcn, k] on the wire
+    all_idx = lax.all_gather(topi, dcn_axis)
+    sparse_sync = jnp.zeros_like(flat).at[all_idx.reshape(-1)].add(
+        all_vals.reshape(-1)
+    ).reshape(acc.shape) / n_dcn
+    rampup = int(attrs.get("rampup_begin_step", 0))
+    if rampup > 0 and "Step" in ins:
+        # DGC warm-up: dense sync (and zero residual) until the boundary
+        ramping = ins["Step"][0].reshape(()) < rampup
+        dense_sync = lax.pmean(acc, dcn_axis)
+        out = jnp.where(ramping, dense_sync, sparse_sync)
+        e_new = jnp.where(ramping, jnp.zeros_like(e_new), e_new)
+    else:
+        out = sparse_sync
+    outs["Out"] = [out.astype(ins["X"][0].dtype)]
+    outs["ErrorFeedback"] = [e_new[None].astype(e3.dtype)]
+    return outs

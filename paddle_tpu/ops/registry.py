@@ -30,12 +30,17 @@ Attrs = Dict[str, Any]
 class EmitContext:
     """Per-trace context handed to emitters (rng threading, mesh info)."""
 
-    def __init__(self, rng_key=None, mesh=None, axis_env=None):
+    def __init__(self, rng_key=None, mesh=None, axis_env=None,
+                 manual_axes=None):
         self._key = rng_key
         self._base_key = rng_key  # frozen per-step key for salted_rng
         self.mesh = mesh
         # mapping of logical ring_id -> mesh axis name, for collective ops
         self.axis_env = axis_env or {}
+        # mesh axes the surrounding shard_map runs MANUALLY over (the
+        # executor's multi-slice dcn mode); emitters needing collectives
+        # use lax.p* with these names directly
+        self.manual_axes = tuple(manual_axes) if manual_axes else ()
         # (type, fwd input names) -> LIFO of (outs, vjp_fn, fwd_ins):
         # captured at forward emission, consumed by the generic grad op —
         # the primal forward is computed ONCE (emitting the backward by
