@@ -263,15 +263,12 @@ class _DCNGradSyncOptimizer:
         rampup = int(cfgs.get("rampup_begin_step", 0))
         step_var = None
         if use_dgc and rampup > 0:
-            # in-graph step counter driving the DGC dense warm-up
+            # in-graph step counter driving the DGC dense warm-up; the
+            # increment is appended AFTER the sync ops below, so step i
+            # reads counter value i and `Step < rampup` gives exactly
+            # rampup dense steps (DGCMomentumOptimizer parity)
             step_var = _create_persistable_var(
                 unique_name.generate("dcn_dgc_step"), [1], "float32", 0.0
-            )
-            block.append_op(
-                type="scale",
-                inputs={"X": [step_var]},
-                outputs={"Out": [step_var]},
-                attrs={"scale": 1.0, "bias": 1.0},
             )
         synced = []
         for p, g in params_grads:
@@ -304,6 +301,13 @@ class _DCNGradSyncOptimizer:
                        "rampup_begin_step": rampup, "dcn_axis": "dcn"},
             )
             synced.append((p, block.var(out_name)))
+        if step_var is not None:
+            block.append_op(
+                type="scale",
+                inputs={"X": [step_var]},
+                outputs={"Out": [step_var]},
+                attrs={"scale": 1.0, "bias": 1.0},
+            )
         opt_ops = self.inner_opt.apply_optimize(
             loss, startup_program, synced
         )

@@ -1,0 +1,447 @@
+"""AST-based dygraph-to-static conversion (data-dependent control flow).
+
+Parity surface: reference
+python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py +
+program_translator.py:348 — Python `if`/`while`/`for range()` whose
+condition is a TENSOR become cond / while_loop ops, which a tracer alone
+cannot capture (it would bake in the branch taken by the example input).
+
+Design: a source-to-source rewrite with RUNTIME dispatch, the reference's
+convert_ifelse/convert_while_loop scheme. Each `if`/`while` is rewritten
+into nested functions over its carried names (the names assigned inside)
+plus a `_jst_if`/`_jst_while` call:
+
+    if pred: A            _t(c1..):  A;  return (c1..)
+    else:    B     ->     _f(c1..):  B;  return (c1..)
+                          (c1..) = _jst_if(pred, _t, _f, (c1..))
+
+At runtime, a plain Python bool takes the normal branch; a static
+`framework.Variable` (what flows through a to_static trace) builds
+layers.cond / layers.while_loop, so BOTH branches / the loop body are
+traced symbolically and the choice happens on-device.
+
+Supported subset (documented, reference-style): `if`/`while` whose
+bodies have no `return`/`break`/`continue` (such nodes are left
+untransformed and keep trace semantics), and `for <name> in range(...)`
+(desugared to a while). Carried names must be assignable tensors in the
+tensor-predicate case; names undefined on entry ride an UNDEF sentinel
+that raises only if actually used.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Any, Callable, Dict
+
+__all__ = [
+    "ast_to_static", "convert_ifelse", "convert_while", "ConversionError",
+]
+
+
+class ConversionError(RuntimeError):
+    pass
+
+
+class _Undef:
+    """Sentinel for 'name not bound on entry' — raises only when used."""
+
+    _inst = None
+
+    def __repr__(self):
+        return "<undefined local (dygraph_to_static)>"
+
+    def _raise(self, *_a, **_k):
+        raise ConversionError(
+            "a name assigned inside a converted tensor-condition branch "
+            "was read before being defined on every path"
+        )
+
+    __call__ = __add__ = __radd__ = __mul__ = __getattr__ = _raise
+
+
+_UNDEF = _Undef()
+
+
+def _is_static_var(x) -> bool:
+    from ... import framework
+
+    return isinstance(x, framework.Variable)
+
+
+def convert_ifelse(pred, true_fn, false_fn, args):
+    """Runtime dispatch for a rewritten `if` (reference
+    convert_operators.convert_ifelse)."""
+    if _is_static_var(pred):
+        from ...layers import control_flow
+
+        def _checked(fn):
+            # entry values may be UNDEF (name first assigned inside the
+            # branch); what each branch RETURNS must be real tensors, or
+            # cond cannot match the true/false structures
+            def run():
+                out = list(fn(*args))
+                if any(o is _UNDEF for o in out):
+                    raise ConversionError(
+                        "tensor-condition `if`: every name assigned in "
+                        "one branch must be assigned in the other (or "
+                        "defined before the `if`) — cond needs matching "
+                        "true/false structures"
+                    )
+                return out
+
+            return run
+
+        out = control_flow.cond(pred, _checked(true_fn), _checked(false_fn))
+        return tuple(out)
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def convert_while(cond_fn, body_fn, args):
+    """Runtime dispatch for a rewritten `while` (reference
+    convert_operators.convert_while_loop)."""
+    pred0 = cond_fn(*args)
+    if _is_static_var(pred0):
+        from ...layers import control_flow, tensor as _tensor
+
+        loop_vars = []
+        for a in args:
+            if a is _UNDEF:
+                raise ConversionError(
+                    "tensor-condition `while`: every carried name must be "
+                    "defined before the loop"
+                )
+            if not _is_static_var(a):
+                # python-number carried state (e.g. the desugared
+                # for-range counter) lifts to a constant tensor
+                import numbers
+
+                if not isinstance(a, numbers.Number):
+                    raise ConversionError(
+                        "tensor-condition `while`: carried values must be "
+                        f"tensors or numbers, got {type(a).__name__}"
+                    )
+                a = _tensor.fill_constant(
+                    [1], "int32" if isinstance(a, int) else "float32", a
+                )
+            loop_vars.append(a)
+        out = control_flow.while_loop(
+            lambda *vs: cond_fn(*vs), lambda *vs: list(body_fn(*vs)),
+            loop_vars,
+        )
+        return tuple(out)
+    while pred0:
+        args = tuple(body_fn(*args))
+        pred0 = cond_fn(*args)
+    return tuple(args)
+
+
+def _maybe(name: str) -> str:
+    # read a possibly-unbound local: UnboundLocalError/NameError -> UNDEF
+    return (
+        f"_jst_get(lambda: {name})"
+    )
+
+
+def _jst_get(thunk):
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return _UNDEF
+
+
+def _jst_eq(a, b):
+    if _is_static_var(a):
+        return a._binary(b, "equal")  # lifts python scalars
+    if _is_static_var(b):
+        return b._binary(a, "equal")
+    return a == b
+
+
+def _jst_ne(a, b):
+    if _is_static_var(a):
+        return a._binary(b, "not_equal")
+    if _is_static_var(b):
+        return b._binary(a, "not_equal")
+    return a != b
+
+
+def _assigned_names(stmts) -> set:
+    """Names (re)bound anywhere inside `stmts` — the carried state."""
+    out = set()
+
+    class V(ast.NodeVisitor):
+        def _target(self, t):
+            if isinstance(t, ast.Name):
+                if not t.id.startswith("_jst"):
+                    out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._target(e)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._target(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None:
+                self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            if not node.name.startswith("_jst"):
+                out.add(node.name)  # nested defs rebind their name
+
+    for s in stmts:
+        V().visit(s)
+    return out
+
+
+def _has_flow_escape(stmts) -> bool:
+    """return/break/continue at this statement level (not inside nested
+    function definitions) — such nodes keep trace semantics."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            nonlocal found
+            found = True
+
+        def visit_Break(self, node):
+            nonlocal found
+            found = True
+
+        def visit_Continue(self, node):
+            nonlocal found
+            found = True
+
+        def visit_FunctionDef(self, node):
+            pass  # different scope
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return found
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If / While / For-range into _jst_if/_jst_while calls."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, base):
+        self._n += 1
+        return f"_jst_{base}_{self._n}"
+
+    def _carried(self, *stmt_lists):
+        names = set()
+        for sl in stmt_lists:
+            names |= _assigned_names(sl)
+        return sorted(names)
+
+    def _stmt(self, src: str):
+        return ast.parse(textwrap.dedent(src)).body[0]
+
+    def _make_fn(self, name, params, body, result_names):
+        src = f"def {name}({', '.join(params)}):\n    pass"
+        fn = self._stmt(src)
+        ret = self._stmt(f"return ({', '.join(result_names)},)" if result_names
+                         else "return ()")
+        fn.body = body + [ret]
+        return fn
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node
+        carried = self._carried(node.body, node.orelse)
+        if not carried:
+            return node
+        tname, fname = self._fresh("true"), self._fresh("false")
+        t_fn = self._make_fn(tname, carried, node.body, carried)
+        f_fn = self._make_fn(
+            fname, carried, node.orelse or [ast.Pass()], carried
+        )
+        cur = ", ".join(_maybe(n) for n in carried)
+        call = self._stmt(
+            f"({', '.join(carried)},) = _jst_if(_jst_pred, {tname}, "
+            f"{fname}, ({cur},))"
+        )
+        # splice the original test expression in for _jst_pred
+        class Sub(ast.NodeTransformer):
+            def visit_Name(self, n):
+                if n.id == "_jst_pred":
+                    return node.test
+                return n
+
+        call = Sub().visit(call)
+        return [t_fn, f_fn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or node.orelse:
+            return node
+        carried = self._carried(node.body, [ast.Expr(node.test)])
+        # the test's read names that are assigned in the body are already
+        # carried; add names READ by the test that the body rebinds is
+        # covered; carry also test-only names that are plain locals? no:
+        # loop-invariant reads ride the closure.
+        if not carried:
+            return node
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        c_fn = self._make_fn(cname, carried, [], [])
+        c_fn.body = [ast.Return(node.test)]
+        b_fn = self._make_fn(bname, carried, node.body, carried)
+        cur = ", ".join(_maybe(n) for n in carried)
+        call = self._stmt(
+            f"({', '.join(carried)},) = _jst_while({cname}, {bname}, "
+            f"({cur},))"
+        )
+        return [c_fn, b_fn, call]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        step_lit = 1
+        if (
+            not isinstance(node.target, ast.Name)
+            or not isinstance(node.iter, ast.Call)
+            or not isinstance(node.iter.func, ast.Name)
+            or node.iter.func.id != "range"
+            or node.iter.keywords
+            or not 1 <= len(node.iter.args) <= 3
+        ):
+            return node
+        if len(node.iter.args) == 3:
+            # the loop direction must be known at transform time: only a
+            # literal step is accepted (a symbolic one would silently run
+            # `<` against a descending range)
+            s = node.iter.args[2]
+            if (
+                isinstance(s, ast.Constant) and isinstance(s.value, int)
+                and s.value != 0
+            ):
+                step_lit = s.value
+            elif (
+                isinstance(s, ast.UnaryOp) and isinstance(s.op, ast.USub)
+                and isinstance(s.operand, ast.Constant)
+                and isinstance(s.operand.value, int) and s.operand.value != 0
+            ):
+                step_lit = -s.operand.value
+            else:
+                return node
+        if _has_flow_escape(node.body) or node.orelse:
+            return node
+        i = node.target.id
+        a = node.iter.args
+        sv, ev = self._fresh("start"), self._fresh("stop")
+        pre = []
+        if len(a) == 1:
+            pre.append(self._stmt(f"{sv} = 0"))
+            pre.append(ast.Assign([ast.Name(ev, ast.Store())], a[0]))
+        else:
+            pre.append(ast.Assign([ast.Name(sv, ast.Store())], a[0]))
+            pre.append(ast.Assign([ast.Name(ev, ast.Store())], a[1]))
+        # pre-increment form: i enters at start-step and steps FIRST, so
+        # after the loop i holds the LAST iteration's value (Python's
+        # post-loop binding), not one-past-the-end
+        pre.append(self._stmt(f"{i} = {sv} - ({step_lit})"))
+        body = [self._stmt(f"{i} = {i} + ({step_lit})")] + list(node.body)
+        cmp = "<" if step_lit > 0 else ">"
+        wh = ast.While(
+            test=ast.parse(f"({i} + ({step_lit})) {cmp} ({ev} + 0)",
+                           mode="eval").body,
+            body=body, orelse=[],
+        )
+        out = self.visit_While(wh)
+        return pre + (out if isinstance(out, list) else [out])
+
+    def visit_Compare(self, node):
+        self.generic_visit(node)
+        # `a == b` / `a != b` on tensors must emit equal/not_equal ops,
+        # but patching Variable.__eq__ globally would corrupt identity
+        # checks and `in` membership across the codebase — so the rewrite
+        # is scoped to converted functions via a runtime helper
+        if len(node.ops) != 1 or not isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            return node
+        fn = "_jst_eq" if isinstance(node.ops[0], ast.Eq) else "_jst_ne"
+        return ast.Call(
+            func=ast.Name(fn, ast.Load()),
+            args=[node.left, node.comparators[0]], keywords=[],
+        )
+
+
+_converted: Dict[Any, Callable] = {}
+
+
+def ast_to_static(fn: Callable) -> Callable:
+    """Rewrite `fn`'s data-dependent control flow; returns the converted
+    function (or `fn` itself when the source is unavailable)."""
+    if fn in _converted:
+        return _converted[fn]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+        fndef = tree.body[0]
+        fndef.decorator_list = []
+        new_body = []
+        tr = _ControlFlowTransformer()
+        for s in fndef.body:
+            r = tr.visit(s)
+            new_body.extend(r if isinstance(r, list) else [r])
+        fndef.body = new_body
+        ast.fix_missing_locations(tree)
+        code = compile(tree, f"<to_static {fn.__qualname__}>", "exec")
+    except ConversionError:
+        raise
+    except Exception:  # noqa: BLE001 — unparseable constructs: trace as-is
+        return fn
+    helpers = {
+        "_jst_if": convert_ifelse,
+        "_jst_while": convert_while,
+        "_jst_get": _jst_get,
+        "_jst_eq": _jst_eq,
+        "_jst_ne": _jst_ne,
+    }
+    if fn.__closure__:
+        # closures force a by-value globals snapshot (cells cannot be
+        # reattached to recompiled code); closure-free functions — module
+        # functions and methods, the common case — exec against the LIVE
+        # module globals so later rebinding stays visible, with only the
+        # collision-safe _jst_* helper names added
+        glb = dict(fn.__globals__)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+        glb.update(helpers)
+        exec(code, glb)  # noqa: S102 — our own transformed source
+        out = glb[fn.__name__]
+    else:
+        fn.__globals__.update(helpers)
+        sentinel = object()
+        prev = fn.__globals__.get(fn.__name__, sentinel)
+        exec(code, fn.__globals__)  # noqa: S102
+        out = fn.__globals__[fn.__name__]
+        if prev is sentinel:
+            del fn.__globals__[fn.__name__]
+        else:
+            fn.__globals__[fn.__name__] = prev
+    out.__wrapped__ = fn
+    _converted[fn] = out
+    return out

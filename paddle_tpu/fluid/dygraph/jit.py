@@ -149,6 +149,13 @@ class StaticFunction:
 
     def __init__(self, fn):
         self._fn = fn
+        # AST conversion first (reference ast_transformer.py): tensor-
+        # condition if/while/for-range become cond/while_loop ops instead
+        # of being baked to the traced branch; unparseable sources fall
+        # back to the plain trace
+        from .dygraph_to_static import ast_to_static
+
+        self._ast_fn = ast_to_static(fn)
         self._cache: Dict[tuple, tuple] = {}
         from ..executor import Executor, Scope
 
@@ -170,7 +177,7 @@ class StaticFunction:
     def get_concrete_program(self, *args) -> ConcreteProgram:
         key = self._sig(args)
         if key not in self._cache:
-            _, cp = _trace(self._fn, args)
+            _, cp = _trace(self._ast_fn, args)
             self._cache[key] = cp
         return self._cache[key]
 

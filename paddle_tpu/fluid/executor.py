@@ -364,15 +364,26 @@ class Executor:
                 env.update(feed_vals)
                 registry.emit_ops(ctx, ops, env)
 
-                def _sync(x):
-                    # fetches must be replicated: mean float metrics (the
-                    # global loss = mean of per-shard batch means); assume
-                    # non-floats are already replicated
-                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
-                        return lax.pmean(x, manual_axes)
-                    return x
+                state_set = (
+                    set(donate_names) | set(keep_names) | set(state_out)
+                )
 
-                fetches = [_sync(env[n]) for n in fetch_names]
+                def _sync(n, x):
+                    # fetch contract: state vars are replicated already;
+                    # scalar floats are per-shard batch metrics (mean of
+                    # means == global mean); everything else is
+                    # batch-sharded on dim 0 — gather it back to the
+                    # global batch instead of silently averaging shards
+                    if n in state_set:
+                        return x
+                    xa = jnp.asarray(x)
+                    if xa.ndim == 0 or xa.size == 1:
+                        if jnp.issubdtype(xa.dtype, jnp.floating):
+                            return lax.pmean(x, manual_axes)
+                        return x
+                    return lax.all_gather(x, manual_axes, axis=0, tiled=True)
+
+                fetches = [_sync(n, env[n]) for n in fetch_names]
                 new_state = {n: env[n] for n in state_out}
                 next_key = jax.random.fold_in(rng_key, 0x5EED)
                 return fetches, new_state, next_key

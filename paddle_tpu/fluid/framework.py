@@ -127,6 +127,21 @@ class Variable:
 
         return layers.scale(self, scale=-1.0)
 
+    # comparison sugar (reference layers/math_op_patch.py monkey-patch):
+    # emits compare ops, which is what lets AST-converted `if x > 0:`
+    # build a cond predicate during a to_static trace
+    def __gt__(self, other):
+        return self._binary(other, "greater_than")
+
+    def __ge__(self, other):
+        return self._binary(other, "greater_equal")
+
+    def __lt__(self, other):
+        return self._binary(other, "less_than")
+
+    def __le__(self, other):
+        return self._binary(other, "less_equal")
+
 
 class Parameter(Variable):
     """Trainable persistable variable. Reference: framework.py:4970."""

@@ -172,6 +172,24 @@ def test_dgc_rampup_dense_warmup():
     assert not np.allclose(a[3:], b[3:], rtol=1e-7, atol=1e-8)
 
 
+def test_dgc_rampup_one_dense_step():
+    """rampup_begin_step=1: exactly ONE dense step (the off-by-one edge:
+    the counter increments after the sync reads it)."""
+
+    def dgc_ramp(s):
+        s.hybrid_dcn = 2
+        s.dgc = True
+        s.dgc_configs = {"sparsity": 0.9, "rampup_begin_step": 1}
+
+    def dense(s):
+        s.hybrid_dcn = 2
+
+    a = _train(dgc_ramp, steps=4)
+    b = _train(dense, steps=4)
+    np.testing.assert_allclose(a[:1], b[:1], rtol=2e-5, atol=2e-6)
+    assert not np.allclose(a[1:], b[1:], rtol=1e-7, atol=1e-8)
+
+
 def test_dcn_mismatched_mesh_raises():
     """A user mesh without the dcn axis would silently skip the sync —
     fleet must reject it loudly."""
