@@ -249,9 +249,21 @@ def main():
                 "seq_len": seq,
                 "steps": steps,
                 "amp_bf16": use_amp,
+                "peak_hbm_gb": _peak_hbm_gb(exe, m, data, loss),
             }
         )
     )
+
+
+def _peak_hbm_gb(exe, program, data, loss):
+    """XLA's buffer-assignment peak for the compiled step (the measured
+    form of the remat-vs-batch tradeoff); None when the backend cannot
+    report it."""
+    try:
+        ma = exe.memory_analysis(program, feed=data, fetch_list=[loss])
+        return round(ma["peak_bytes"] / 2**30, 3)
+    except Exception:  # noqa: BLE001 — diagnostics must not fail the bench
+        return None
 
 
 if __name__ == "__main__":

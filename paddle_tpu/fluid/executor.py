@@ -119,9 +119,6 @@ class Executor:
         block = program.global_block()
 
         feed_arrays = self._prepare_feed(block, feed)
-        feed_sig = tuple(
-            (n, tuple(a.shape), str(a.dtype)) for n, a in sorted(feed_arrays.items())
-        )
         from .flags import flag
 
         # the nan/inf debugging mode disables buffer donation (donated
@@ -129,7 +126,7 @@ class Executor:
         # the last good parameters after the raise" impossible), so the
         # compile cache must distinguish the two modes
         check_nan = flag("FLAGS_check_nan_inf")
-        key = (program._serial, program._version, feed_sig, fetch_names, check_nan)
+        key = self._cache_key(program, feed_arrays, fetch_names, check_nan)
         compiled = self._cache.get(key)
         if compiled is None:
             with RecordEvent("Executor::compile"):
@@ -240,6 +237,17 @@ class Executor:
                 )
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _cache_key(program, feed_arrays, fetch_names, check_nan):
+        """THE compile-cache key — run() and memory_analysis() must agree
+        on its exact shape, so both build it here."""
+        feed_sig = tuple(
+            (n, tuple(a.shape), str(a.dtype))
+            for n, a in sorted(feed_arrays.items())
+        )
+        return (program._serial, program._version, feed_sig, fetch_names,
+                check_nan)
+
     def _prepare_feed(self, block, feed):
         import jax
 
@@ -466,6 +474,81 @@ class Executor:
             jit_fn, list(feed_names), donate_names, keep_names, state_out, fetch_names
         )
 
+
+    # ------------------------------------------------------------------
+    def memory_analysis(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """XLA's buffer-assignment memory numbers for the compiled step
+        (the measured answer to "does this batch fit?" — reference-era
+        practice was trial-and-error against the allocator). Returns a
+        dict with argument/output/temp/alias bytes and the derived
+        `peak_bytes` (arguments + outputs + temps - aliased, XLA's HBM
+        high-water estimate for one execution).
+
+        The program must have been run at least once with this feed
+        signature IN the given scope (the analysis abstracts the scope's
+        live state). Cost note: the AOT lower().compile() does not share
+        jax.jit's per-call executable cache — unless the persistent XLA
+        compilation cache is configured, this pays one extra compile of
+        the step; call it once for diagnostics, not per step.
+        """
+        import jax
+
+        if program is None:
+            program = framework.default_main_program()
+        if hasattr(program, "_program"):
+            program = program._program
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+        fetch_names = tuple(
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in fetch_list
+        )
+        block = program.global_block()
+        feed_arrays = self._prepare_feed(block, feed)
+        from .flags import flag
+
+        key = self._cache_key(program, feed_arrays, fetch_names,
+                              flag("FLAGS_check_nan_inf"))
+        compiled = self._cache.get(key)
+        states = {
+            n: scope.find_var(n)
+            for n in (compiled.donate_names + compiled.keep_names)
+        } if compiled is not None else {}
+        rng = scope._rng_key
+        if compiled is None or rng is None or any(
+            v is None for v in states.values()
+        ):
+            raise RuntimeError(
+                "memory_analysis: run this (program, feed, fetch_list) "
+                "once first in the SAME scope — the analysis reads the "
+                "compiled executable and abstracts the scope's state"
+            )
+
+        def _abstract(x):
+            a = np.asarray(x) if not hasattr(x, "dtype") else x
+            return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+
+        donated = {n: _abstract(states[n]) for n in compiled.donate_names}
+        kept = {n: _abstract(states[n]) for n in compiled.keep_names}
+        feeds_abs = {n: _abstract(a) for n, a in feed_arrays.items()}
+        rng_abs = jax.ShapeDtypeStruct(np.shape(rng), rng.dtype)
+        ma = (
+            compiled.fn.lower(feeds_abs, donated, kept, rng_abs)
+            .compile()
+            .memory_analysis()
+        )
+        out = {}
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            out[k] = int(getattr(ma, k, 0) or 0)
+        out["peak_bytes"] = (
+            out["argument_size_in_bytes"] + out["output_size_in_bytes"]
+            + out["temp_size_in_bytes"] - out["alias_size_in_bytes"]
+        )
+        return out
 
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

@@ -3,6 +3,8 @@ the subset whose ops are implemented: iou_similarity, box_coder,
 prior_box, yolo_box, roi_align)."""
 from __future__ import annotations
 
+import numpy as np
+
 from ..layer_helper import LayerHelper, emit_op
 
 __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
@@ -675,14 +677,142 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     return tuple(outs)
 
 
+def _poly_fill(xs, ys, m):
+    """Even-odd polygon fill at pixel centers (x+.5, y+.5) on an m x m
+    grid — the numpy equivalent of the reference's COCO
+    upsample-walk-RLE rasterizer (mask_util.cc Poly2Mask)."""
+    inside = np.zeros((m, m), bool)
+    cy = (np.arange(m) + 0.5)[:, None]
+    cx = (np.arange(m) + 0.5)[None, :]
+    k = len(xs)
+    for e in range(k):
+        x1, y1 = xs[e], ys[e]
+        x2, y2 = xs[(e + 1) % k], ys[(e + 1) % k]
+        if y1 == y2:
+            continue
+        crosses = (y1 <= cy) != (y2 <= cy)
+        xc = x1 + (cy - y1) * (x2 - x1) / (y2 - y1)
+        inside ^= crosses & (cx < xc)
+    return inside
+
+
+def _polys_to_mask_wrt_box(polys, box, m):
+    """Union-rasterize `polys` (list of [K,2] arrays, image coords) into
+    the m x m grid of `box` (reference mask_util.cc Polys2MaskWrtBox)."""
+    w = max(box[2] - box[0], 1.0)
+    h = max(box[3] - box[1], 1.0)
+    mask = np.zeros((m, m), bool)
+    for p in polys:
+        xs = (p[:, 0] - box[0]) * m / w
+        ys = (p[:, 1] - box[1]) * m / h
+        mask |= _poly_fill(xs, ys, m)
+    return mask.astype(np.uint8)
+
+
 def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
-                         labels_int32, num_classes, resolution):
-    """Mask R-CNN mask-target rasterization (reference
-    generate_mask_labels_op.cc) needs polygon->mask rasterization of the
-    gt_segms LoD structure; supply rasterized masks and build targets
-    with roi_align + resize instead."""
-    raise NotImplementedError(
-        "generate_mask_labels: polygon rasterization is host-side in the "
-        "reference; rasterize masks in the data pipeline and use "
-        "roi_align + resize_bilinear to build mask targets"
-    )
+                         labels_int32, num_classes, resolution,
+                         segm_lengths=None):
+    """Mask R-CNN mask targets (reference generate_mask_labels_op.cc,
+    SampleMaskForOneImage): every fg roi is matched to the gt whose
+    polygon bounding box overlaps it most, the gt's polygons are
+    rasterized into the roi's resolution x resolution grid, and the
+    binary mask lands in the roi label's class slot (-1 elsewhere: the
+    ignore value ExpandMaskTarget writes).
+
+    Padded-batch convention (this framework's replacement for the
+    reference's 3-level LoD): gt_segms [N, G, P, V, 2] float32 holds up
+    to P polygons of up to V vertices per gt box, with `segm_lengths`
+    [N, G, P] int32 giving each polygon's true vertex count (0 = no
+    polygon). gt_classes / is_crowd [N, G] (class <= 0 = padding), rois
+    [N, R, 4], labels_int32 [N, R].
+
+    Returns (mask_rois [N, R, 4], roi_has_mask_int32 [N, R],
+    mask_int32 [N, R, num_classes * resolution**2], mask_nums [N]):
+    rows beyond mask_nums[i] are -1/0 padding.
+    """
+    if segm_lengths is None:
+        raise ValueError(
+            "generate_mask_labels: pass segm_lengths [N, G, P] int32 — "
+            "the padded-batch replacement for the reference's gt_segms "
+            "LoD levels"
+        )
+    from .control_flow import py_func
+
+    helper = LayerHelper("generate_mask_labels")
+    n, r = rois.shape[0], rois.shape[1]
+    m = int(resolution)
+    mask_dim = int(num_classes) * m * m
+
+    def _sample(iminfo, gtc, crowd, segms, seglen, rois_np, labels):
+        out_rois = np.zeros((n, r, 4), np.float32)
+        out_has = np.full((n, r), -1, np.int32)
+        out_mask = np.full((n, r, mask_dim), -1, np.int32)
+        out_num = np.zeros((n,), np.int32)
+        for i in range(n):
+            im_scale = float(iminfo[i, 2])
+            # gts carrying a mask: fg class, not crowd, >=1 real polygon
+            polys_per_gt = []
+            for gi in range(gtc.shape[1]):
+                if gtc[i, gi] <= 0 or crowd[i, gi] != 0:
+                    continue
+                polys = [
+                    segms[i, gi, pi, : seglen[i, gi, pi]]
+                    for pi in range(seglen.shape[2])
+                    if seglen[i, gi, pi] >= 3
+                ]
+                if polys:
+                    polys_per_gt.append(polys)
+            fg = np.where(labels[i] > 0)[0]
+            if len(fg) == 0 or not polys_per_gt:
+                # reference fallback: one bg roi with an all -1 mask
+                bg = np.where(labels[i] == 0)[0]
+                bg0 = int(bg[0]) if len(bg) else 0
+                out_num[i] = 1
+                out_has[i, 0] = bg0
+                out_rois[i, 0] = rois_np[i, bg0]
+                continue
+            # bbox enclosing each gt's polygons (Poly2Boxes)
+            gt_boxes = np.array([
+                [
+                    min(p[:, 0].min() for p in ps),
+                    min(p[:, 1].min() for p in ps),
+                    max(p[:, 0].max() for p in ps),
+                    max(p[:, 1].max() for p in ps),
+                ]
+                for ps in polys_per_gt
+            ], np.float32)
+            fg_rois = rois_np[i, fg] / max(im_scale, 1e-12)
+            x1 = np.maximum(fg_rois[:, None, 0], gt_boxes[None, :, 0])
+            y1 = np.maximum(fg_rois[:, None, 1], gt_boxes[None, :, 1])
+            x2 = np.minimum(fg_rois[:, None, 2], gt_boxes[None, :, 2])
+            y2 = np.minimum(fg_rois[:, None, 3], gt_boxes[None, :, 3])
+            inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+            a_r = ((fg_rois[:, 2] - fg_rois[:, 0])
+                   * (fg_rois[:, 3] - fg_rois[:, 1]))[:, None]
+            a_g = ((gt_boxes[:, 2] - gt_boxes[:, 0])
+                   * (gt_boxes[:, 3] - gt_boxes[:, 1]))[None, :]
+            iou = inter / np.maximum(a_r + a_g - inter, 1e-10)
+            best_gt = iou.argmax(axis=1)
+            out_num[i] = len(fg)
+            out_has[i, : len(fg)] = fg
+            out_rois[i, : len(fg)] = fg_rois * im_scale
+            for j, (roi_idx, gt_j) in enumerate(zip(fg, best_gt)):
+                msk = _polys_to_mask_wrt_box(
+                    polys_per_gt[gt_j], fg_rois[j], m
+                )
+                cls = int(labels[i, roi_idx])
+                if 0 < cls < num_classes:
+                    out_mask[i, j, cls * m * m:(cls + 1) * m * m] = (
+                        msk.reshape(-1)
+                    )
+        return out_rois, out_has, out_mask, out_num
+
+    outs = []
+    for dt, shape in [("float32", (n, r, 4)), ("int32", (n, r)),
+                      ("int32", (n, r, mask_dim)), ("int32", (n,))]:
+        v = helper.create_variable_for_type_inference(dt)
+        v.shape = shape
+        outs.append(v)
+    py_func(_sample, x=[im_info, gt_classes, is_crowd, gt_segms,
+                        segm_lengths, rois, labels_int32], out=outs)
+    return tuple(outs)

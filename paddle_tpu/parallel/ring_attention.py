@@ -13,11 +13,13 @@ The loop is a `lax.scan`, so reverse-mode AD works end-to-end: the
 backward pass rotates cotangents with the transposed permutation that JAX
 derives for ppermute — no custom VJP needed.
 
-When shapes permit (S_local % 128 == 0, D in {64,128,256}, no causal
-mask, no dropout), each local block runs the Pallas flash kernel via
-`flash_block_with_lse` — an (o, lse)-returning custom-VJP core — and the
-ring merges partials by log-sum-exp; otherwise the jnp online-softmax
-block math below runs (itself well fused by XLA).
+When shapes permit (S_local % 128 == 0, D in {64,128,256}), each local
+block runs the Pallas flash kernel via `flash_block_with_lse` — an
+(o, lse)-returning custom-VJP core — and the ring merges partials by
+log-sum-exp. Causal masking rides the kernel's (q_offset, k_offset)
+global-position pair and dropout its in-kernel PRNG, so the training
+configurations stay on the kernel path; the jnp online-softmax block
+math below remains the fallback for non-kernel shapes.
 """
 from __future__ import annotations
 
@@ -53,8 +55,13 @@ def ring_attention(q, k, v, axis_name: str, bias=None, sm_scale=None,
 
     from ..ops.pallas.flash_attention import flash_block_ok
 
-    if not causal and not use_dropout and flash_block_ok(s_loc, d):
-        return _ring_flash(q, k, v, axis_name, bias, sm_scale, n)
+    if flash_block_ok(s_loc, d):
+        return _ring_flash(
+            q, k, v, axis_name, bias, sm_scale, n,
+            causal=causal,
+            dropout_prob=dropout_prob if use_dropout else 0.0,
+            dropout_key=dropout_key if use_dropout else None,
+        )
 
     qf = q.astype(jnp.float32) * sm_scale
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -107,22 +114,57 @@ def ring_attention(q, k, v, axis_name: str, bias=None, sm_scale=None,
     return out.astype(q.dtype)
 
 
-def _ring_flash(q, k, v, axis_name, bias, sm_scale, n):
+def _ring_flash(q, k, v, axis_name, bias, sm_scale, n, causal=False,
+                dropout_prob=0.0, dropout_key=None):
     """Ring schedule where each block is the Pallas flash kernel: merge
     per-block (o, lse) partials by log-sum-exp. AD flows through the
-    kernel's custom VJP (the lse cotangent folds into delta)."""
+    kernel's custom VJP (the lse cotangent folds into delta).
+
+    causal: the kernel masks each visiting block by its GLOBAL positions
+    (q_offset = my shard start, k_offset = source shard start); blocks
+    entirely in the future produce lse=-inf partials that merge to zero
+    weight. dropout: regenerated in-kernel from a per-(shard, source)
+    seed (interpret mode precomputes the mask host-side — same math)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from ..ops.pallas.flash_attention import flash_block_with_lse
+    from ..ops.pallas.flash_attention import _interpret, flash_block_with_lse
 
     b, nh, s_loc, d = q.shape
+    idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    use_dropout = dropout_prob > 0.0 and dropout_key is not None
+    seed_base = None
+    if use_dropout and not _interpret():
+        seed_base = jax.random.randint(
+            dropout_key, (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+        )
 
-    def step(carry, _):
+    def step(carry, t):
         kb, vb, bb, m, l, acc = carry
-        o_b, lse_b = flash_block_with_lse(q, kb, vb, bb, sm_scale)
+        src = (idx - t) % n  # which rank's block we currently hold
+        kw = {}
+        if causal:
+            kw = dict(causal=True, q_offset=idx * s_loc,
+                      k_offset=src * s_loc)
+        if use_dropout:
+            kw["dropout_prob"] = dropout_prob
+            if seed_base is not None:
+                kw["dropout_seed"] = (
+                    seed_base + idx * jnp.int32(0x632BE59B)
+                    + src * jnp.int32(0x1B873593)
+                )
+            else:
+                # interpret (CPU) mode: the TPU in-kernel PRNG is
+                # unavailable — draw the same numerator-only mask host-side
+                kdrop = jax.random.fold_in(
+                    jax.random.fold_in(dropout_key, idx), src
+                )
+                kw["dropout_mask"] = jax.random.bernoulli(
+                    kdrop, 1.0 - dropout_prob, (b, nh, s_loc, s_loc)
+                ).astype(jnp.uint8)
+        o_b, lse_b = flash_block_with_lse(q, kb, vb, bb, sm_scale, **kw)
         lse_b = lse_b[..., None]  # [B, nh, S, 1]
         m_new = jnp.maximum(m, lse_b)
         scale_old = jnp.exp(m - m_new)

@@ -510,3 +510,95 @@ def test_multiclass_nms_return_index():
     # detections are boxes 0 (0.9) and 2 (0.7); padding index -1
     assert index[0, 0, 0] == 0 and index[0, 1, 0] == 2
     assert index[0, 2, 0] == -1
+
+
+def test_generate_mask_labels_rectangle_oracle():
+    """A square roi exactly covering a rectangular polygon rasterizes to
+    the polygon's pixel-exact mask in the roi label's class slot
+    (reference generate_mask_labels_op.cc + mask_util.cc semantics)."""
+    n, r, g, p, v = 1, 4, 2, 2, 6
+    res, ncls = 4, 3
+
+    def build():
+        ii = fluid.data("ii", [n, 3], "float32")
+        gtc = fluid.data("gtc", [n, g], "int32")
+        crowd = fluid.data("crowd", [n, g], "int32")
+        segms = fluid.data("segms", [n, g, p, v, 2], "float32")
+        seglen = fluid.data("seglen", [n, g, p], "int32")
+        rois = fluid.data("rois", [n, r, 4], "float32")
+        lbl = fluid.data("lbl", [n, r], "int32")
+        return layers.generate_mask_labels(
+            ii, gtc, crowd, segms, rois, lbl, num_classes=ncls,
+            resolution=res, segm_lengths=seglen)
+
+    # gt 0 (class 2): rectangle covering the LEFT half of [0,8]x[0,8]
+    segms_v = np.zeros((n, g, p, v, 2), "f4")
+    segms_v[0, 0, 0, :4] = [[0, 0], [4, 0], [4, 8], [0, 8]]
+    seglen_v = np.zeros((n, g, p), "i4")
+    seglen_v[0, 0, 0] = 4
+    rois_v = np.zeros((n, r, 4), "f4")
+    rois_v[0, 0] = [0, 0, 8, 8]       # fg roi: exactly the gt area
+    lbl_v = np.zeros((n, r), "i4")
+    lbl_v[0, 0] = 2
+    mrois, has, mask, nums = _run(build, {
+        "ii": np.asarray([[8, 8, 1.0]], "f4"),
+        "gtc": np.asarray([[2, 0]], "i4"),
+        "crowd": np.zeros((n, g), "i4"),
+        "segms": segms_v, "seglen": seglen_v,
+        "rois": rois_v, "lbl": lbl_v,
+    })
+    assert nums[0] == 1 and has[0, 0] == 0
+    np.testing.assert_allclose(mrois[0, 0], [0, 0, 8, 8])
+    mm = mask[0, 0].reshape(ncls, res, res)
+    # non-label class slots are the -1 ignore value
+    assert (mm[0] == -1).all() and (mm[1] == -1).all()
+    # label slot: left half of the 4x4 grid filled, right half empty
+    expect = np.zeros((res, res), "i4")
+    expect[:, :2] = 1
+    np.testing.assert_array_equal(mm[2], expect)
+
+
+def test_generate_mask_labels_multi_polygon_union_and_fallback():
+    n, r, g, p, v = 1, 3, 1, 2, 6
+    res, ncls = 4, 2
+
+    def build():
+        ii = fluid.data("ii", [n, 3], "float32")
+        gtc = fluid.data("gtc", [n, g], "int32")
+        crowd = fluid.data("crowd", [n, g], "int32")
+        segms = fluid.data("segms", [n, g, p, v, 2], "float32")
+        seglen = fluid.data("seglen", [n, g, p], "int32")
+        rois = fluid.data("rois", [n, r, 4], "float32")
+        lbl = fluid.data("lbl", [n, r], "int32")
+        return layers.generate_mask_labels(
+            ii, gtc, crowd, segms, rois, lbl, num_classes=ncls,
+            resolution=res, segm_lengths=seglen)
+
+    # two disjoint rectangles -> union mask (top-left + bottom-right 2x2)
+    segms_v = np.zeros((n, g, p, v, 2), "f4")
+    segms_v[0, 0, 0, :4] = [[0, 0], [4, 0], [4, 4], [0, 4]]
+    segms_v[0, 0, 1, :4] = [[4, 4], [8, 4], [8, 8], [4, 8]]
+    seglen_v = np.full((n, g, p), 4, "i4")
+    rois_v = np.zeros((n, r, 4), "f4")
+    rois_v[0, 0] = [0, 0, 8, 8]
+    lbl_v = np.zeros((n, r), "i4")
+    lbl_v[0, 0] = 1
+    feeds = {
+        "ii": np.asarray([[8, 8, 1.0]], "f4"),
+        "gtc": np.asarray([[1]], "i4"),
+        "crowd": np.zeros((n, g), "i4"),
+        "segms": segms_v, "seglen": seglen_v,
+        "rois": rois_v, "lbl": lbl_v,
+    }
+    mrois, has, mask, nums = _run(build, feeds)
+    mm = mask[0, 0].reshape(ncls, res, res)
+    expect = np.zeros((res, res), "i4")
+    expect[:2, :2] = 1
+    expect[2:, 2:] = 1
+    np.testing.assert_array_equal(mm[1], expect)
+
+    # no fg rois -> reference fallback: one bg roi, all -1 mask
+    feeds["lbl"] = np.zeros((n, r), "i4")
+    mrois, has, mask, nums = _run(build, feeds)
+    assert nums[0] == 1
+    assert (mask[0, 0] == -1).all()
