@@ -32,14 +32,17 @@ white_list = {
     # the whole fused stack runs in bf16; its emitter keeps layer_norm and
     # softmax internals in f32 (ops/encoder_stack.py) so this is safe
     "fused_encoder_stack",
+    "fused_decoder_stack",
     "fc",
+    # the emitter computes statistics in f32 internally (ops/nn_ops.py),
+    # so bf16 in/out only halves the residual-stream bandwidth
+    "layer_norm",
 }
 
 black_list = {
     "softmax_with_cross_entropy",
     "cross_entropy",
     "cross_entropy2",
-    "layer_norm",
     "batch_norm",
     "group_norm",
     "instance_norm",

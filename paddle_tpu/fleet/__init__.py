@@ -400,7 +400,11 @@ def apply_sequence_parallel(program, mesh):
     append_backward: grad ops snapshot forward attrs at creation."""
     for block in program.blocks:
         for op in block.ops:
-            if op.type in ("fused_multihead_attention", "fused_encoder_stack"):
+            if op.type in ("fused_multihead_attention", "fused_encoder_stack",
+                           "fused_decoder_stack"):
+                # the decoder stack has no ring path yet: its emitter
+                # RAISES on this attr rather than silently computing
+                # sp-local attention (use fuse_stack=False with sp)
                 op._set_attr("sequence_parallel", True)
 
 

@@ -63,3 +63,19 @@ def is_floating(dtype) -> bool:
 
 def is_integer(dtype) -> bool:
     return np.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def runtime_dtype(dtype) -> np.dtype:
+    """Device-side dtype for array CREATION under this framework's
+    standard x64-off jax config: a 64-bit request would be truncated by
+    jax anyway, with a UserWarning on every call — narrow it to the
+    32-bit runtime equivalent explicitly instead. Variable METADATA keeps
+    the declared 64-bit dtype (reference parity); only device arrays
+    narrow. With jax_enable_x64 on, 64-bit passes through untouched."""
+    d = convert_dtype(dtype)
+    if d.kind in "iuf" and d.itemsize == 8:
+        from jax import config as _jcfg
+
+        if not bool(getattr(_jcfg, "jax_enable_x64", False)):
+            return np.dtype(d.kind + "4")
+    return d

@@ -524,12 +524,14 @@ def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
                          bias_attr=bias_attr, name=name)
     dtype = input.dtype
     c = input.shape[1]
+    g = int(groups or 1)
     fs = [filter_size, filter_size] if isinstance(filter_size, int) \
         else list(filter_size)
-    std = (2.0 / (fs[0] * fs[1] * c)) ** 0.5
+    std = (2.0 / (fs[0] * fs[1] * (c // g))) ** 0.5  # He init over fan-in
+    # [Co, C/g, kh, kw] — the reference conv filter layout under groups
     w = helper.create_parameter(
-        helper.param_attr, shape=[num_filters, c, fs[0], fs[1]], dtype=dtype,
-        default_initializer=NormalInitializer(0.0, std))
+        helper.param_attr, shape=[num_filters, c // g, fs[0], fs[1]],
+        dtype=dtype, default_initializer=NormalInitializer(0.0, std))
     ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
     if modulated and mask is not None:
         ins["Mask"] = [mask]

@@ -36,6 +36,9 @@ class TransformerConfig:
     n_decoder_layers: int = 6
     dropout: float = 0.1
     label_smooth_eps: float = 0.1
+    # scan over layers (fused_encoder_stack / fused_decoder_stack):
+    # O(1)-in-depth compile, flash kernels for self- AND cross-attention
+    fuse_stack: bool = False
 
     @staticmethod
     def base() -> "TransformerConfig":
@@ -66,30 +69,17 @@ def _ln(x, name):
 
 
 def _cross_attention(cfg, q3, kv, kv_bias, name, is_test):
-    """Cross-attention with different q/kv lengths: jnp-composed ops
-    (XLA-fused); kv_bias is the source padding bias [B, 1, 1, S_src]."""
-    b, sq, h = q3.shape
-    sk = kv.shape[1]
-    nh = cfg.num_heads
-    dh = h // nh
+    """Cross-attention (trg queries over src keys) through the fused
+    attention op: square q/kv lengths run the Pallas flash kernel with
+    the source padding bias as a per-key mask; rectangular lengths take
+    the op's jnp composition (XLA-fused)."""
+    h = q3.shape[-1]
     q3 = _fc3(q3, h, f"{name}_query_fc")  # learned W_Q (dist_transformer
     k3 = _fc3(kv, h, f"{name}_key_fc")    # __compute_qkv projects q too)
     v3 = _fc3(kv, h, f"{name}_value_fc")
-
-    def split(x, s):
-        return layers.transpose(layers.reshape(x, [b, s, nh, dh]), [0, 2, 1, 3])
-
-    q = split(q3, sq)
-    k = split(k3, sk)
-    v = split(v3, sk)
-    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh))
-    scores = layers.elementwise_add(scores, kv_bias)
-    probs = layers.softmax(scores, axis=-1)
-    if not is_test and cfg.dropout > 0:
-        probs = layers.dropout(probs, cfg.dropout,
-                               dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)
-    return layers.reshape(layers.transpose(ctx, [0, 2, 1, 3]), [b, sq, h])
+    return layers.fused_multihead_attention(
+        q3, k3, v3, kv_bias, num_heads=cfg.num_heads,
+        dropout_prob=cfg.dropout, is_test=is_test, causal=False)
 
 
 def _self_attn_block(cfg, hidden, bias, name, is_test, causal):
@@ -136,9 +126,105 @@ def _pad_bias(mask):
     return layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])
 
 
+def _stack_param(helper, name, shape, init=None):
+    return helper.create_parameter(
+        ParamAttr(name=name, initializer=init or NormalInitializer(0.0, 0.02)),
+        shape=shape, dtype="float32")
+
+
+def _fused_encoder_stack(cfg, hidden, bias, is_test):
+    from ..fluid.layer_helper import LayerHelper
+    from ..fluid.layers.nn import _rng_salt_counter
+
+    L, h, f = cfg.n_encoder_layers, cfg.d_model, cfg.d_inner
+    helper = LayerHelper("fused_encoder_stack")
+    ones, zeros = ConstantInitializer(1.0), ConstantInitializer(0.0)
+    p = {
+        "QKVW": _stack_param(helper, "enc_stack.qkv_w", [L, h, 3 * h]),
+        "QKVB": _stack_param(helper, "enc_stack.qkv_b", [L, 3 * h], zeros),
+        "OutW": _stack_param(helper, "enc_stack.out_w", [L, h, h]),
+        "OutB": _stack_param(helper, "enc_stack.out_b", [L, h], zeros),
+        "Ln1S": _stack_param(helper, "enc_stack.ln1_s", [L, h], ones),
+        "Ln1B": _stack_param(helper, "enc_stack.ln1_b", [L, h], zeros),
+        "FfnW1": _stack_param(helper, "enc_stack.ffn_w1", [L, h, f]),
+        "FfnB1": _stack_param(helper, "enc_stack.ffn_b1", [L, f], zeros),
+        "FfnW2": _stack_param(helper, "enc_stack.ffn_w2", [L, f, h]),
+        "FfnB2": _stack_param(helper, "enc_stack.ffn_b2", [L, h], zeros),
+        "Ln2S": _stack_param(helper, "enc_stack.ln2_s", [L, h], ones),
+        "Ln2B": _stack_param(helper, "enc_stack.ln2_b", [L, h], zeros),
+    }
+    out = helper.create_variable_for_type_inference("float32")
+    _rng_salt_counter[0] += 1
+    helper.append_op(
+        type="fused_encoder_stack",
+        inputs={"Hidden": [hidden], "AttnBias": [bias],
+                **{k: [v] for k, v in p.items()}},
+        outputs={"Out": [out]},
+        attrs={"num_heads": cfg.num_heads, "act": "relu",
+               "dropout_prob": cfg.dropout,
+               "attn_dropout_prob": cfg.dropout, "is_test": is_test,
+               "use_flash_attention": getattr(cfg, "use_flash", True),
+               "rng_salt": _rng_salt_counter[0]},
+    )
+    return out
+
+
+def _fused_decoder_stack(cfg, hidden, enc_out, src_bias, is_test):
+    from ..fluid.layer_helper import LayerHelper
+    from ..fluid.layers.nn import _rng_salt_counter
+
+    L, h, f = cfg.n_decoder_layers, cfg.d_model, cfg.d_inner
+    helper = LayerHelper("fused_decoder_stack")
+    ones, zeros = ConstantInitializer(1.0), ConstantInitializer(0.0)
+
+    def p_(name, shape, init=None):
+        return _stack_param(helper, f"dec_stack.{name}", shape, init)
+
+    p = {
+        "SelfQKVW": p_("self_qkv_w", [L, h, 3 * h]),
+        "SelfQKVB": p_("self_qkv_b", [L, 3 * h], zeros),
+        "SelfOutW": p_("self_out_w", [L, h, h]),
+        "SelfOutB": p_("self_out_b", [L, h], zeros),
+        "Ln1S": p_("ln1_s", [L, h], ones),
+        "Ln1B": p_("ln1_b", [L, h], zeros),
+        "CrossQW": p_("cross_q_w", [L, h, h]),
+        "CrossQB": p_("cross_q_b", [L, h], zeros),
+        "CrossKW": p_("cross_k_w", [L, h, h]),
+        "CrossKB": p_("cross_k_b", [L, h], zeros),
+        "CrossVW": p_("cross_v_w", [L, h, h]),
+        "CrossVB": p_("cross_v_b", [L, h], zeros),
+        "CrossOutW": p_("cross_out_w", [L, h, h]),
+        "CrossOutB": p_("cross_out_b", [L, h], zeros),
+        "Ln2S": p_("ln2_s", [L, h], ones),
+        "Ln2B": p_("ln2_b", [L, h], zeros),
+        "FfnW1": p_("ffn_w1", [L, h, f]),
+        "FfnB1": p_("ffn_b1", [L, f], zeros),
+        "FfnW2": p_("ffn_w2", [L, f, h]),
+        "FfnB2": p_("ffn_b2", [L, h], zeros),
+        "Ln3S": p_("ln3_s", [L, h], ones),
+        "Ln3B": p_("ln3_b", [L, h], zeros),
+    }
+    out = helper.create_variable_for_type_inference("float32")
+    _rng_salt_counter[0] += 1
+    helper.append_op(
+        type="fused_decoder_stack",
+        inputs={"Hidden": [hidden], "EncOut": [enc_out],
+                "SrcBias": [src_bias], **{k: [v] for k, v in p.items()}},
+        outputs={"Out": [out]},
+        attrs={"num_heads": cfg.num_heads, "act": "relu",
+               "dropout_prob": cfg.dropout,
+               "attn_dropout_prob": cfg.dropout, "is_test": is_test,
+               "use_flash_attention": getattr(cfg, "use_flash", True),
+               "rng_salt": _rng_salt_counter[0]},
+    )
+    return out
+
+
 def transformer_encoder(cfg, src_ids, src_mask, is_test=False):
     hidden = _embed(cfg, src_ids, cfg.src_vocab_size, "src_embedding", is_test)
     bias = _pad_bias(src_mask)
+    if getattr(cfg, "fuse_stack", False):
+        return _fused_encoder_stack(cfg, hidden, bias, is_test), bias
     for i in range(cfg.n_encoder_layers):
         hidden = _self_attn_block(cfg, hidden, bias, f"enc_{i}", is_test,
                                   causal=False)
@@ -148,6 +234,8 @@ def transformer_encoder(cfg, src_ids, src_mask, is_test=False):
 
 def transformer_decoder(cfg, trg_ids, enc_out, src_bias, is_test=False):
     hidden = _embed(cfg, trg_ids, cfg.trg_vocab_size, "trg_embedding", is_test)
+    if getattr(cfg, "fuse_stack", False):
+        return _fused_decoder_stack(cfg, hidden, enc_out, src_bias, is_test)
     for i in range(cfg.n_decoder_layers):
         hidden = _self_attn_block(cfg, hidden, None, f"dec_{i}", is_test,
                                   causal=True)
@@ -191,18 +279,36 @@ def build_transformer_nmt_program(
 
         enc_out, src_bias = transformer_encoder(cfg, src_ids, src_mask, is_test)
         dec_out = transformer_decoder(cfg, trg_ids, enc_out, src_bias, is_test)
-        # shared target embedding as the output projection (weight tying)
+        # shared target embedding as the output projection (weight tying);
+        # logits STAY flat [B*St, V] end-to-end — reshaping to [B, St, V]
+        # forces a ~1GB layout copy of the largest tensor in the model
         trg_emb = main.global_block().var("trg_embedding")
         flat = layers.reshape(dec_out, [batch * trg_len, cfg.d_model])
         logits = layers.matmul(flat, trg_emb, transpose_y=True)
-        logits = layers.reshape(logits, [batch, trg_len, cfg.trg_vocab_size])
+        labels_flat = layers.reshape(labels, [batch * trg_len, 1])
+        weights_flat = layers.reshape(label_weights, [batch * trg_len, 1])
 
-        smooth = layers.label_smooth(
-            layers.one_hot(layers.reshape(labels, [batch, trg_len]),
-                           cfg.trg_vocab_size),
-            epsilon=cfg.label_smooth_eps)
-        ce = layers.softmax_with_cross_entropy(logits, smooth, soft_label=True)
-        ce = layers.elementwise_mul(ce, label_weights)
+        # analytic label smoothing: with y_sm = (1-eps)*onehot + eps/K,
+        # CE(y_sm) = (1-eps)*CE_hard + eps*(logsumexp - mean(logits)).
+        # Same value as label_smooth + soft-label CE, WITHOUT the [B*St,
+        # 30000] one-hot materialization (multi-GB of HBM traffic/step).
+        eps_ls = float(cfg.label_smooth_eps)
+        ce_hard = layers.softmax_with_cross_entropy(logits, labels_flat)
+        if eps_ls > 0.0:
+            mx = layers.reduce_max(logits, dim=-1, keep_dim=True)
+            lse = layers.elementwise_add(
+                layers.log(layers.reduce_sum(
+                    layers.exp(layers.elementwise_sub(logits, mx)),
+                    dim=-1, keep_dim=True)),
+                mx)
+            uniform_ce = layers.elementwise_sub(
+                lse, layers.reduce_mean(logits, dim=-1, keep_dim=True))
+            ce = layers.elementwise_add(
+                layers.scale(ce_hard, scale=1.0 - eps_ls),
+                layers.scale(uniform_ce, scale=eps_ls))
+        else:
+            ce = ce_hard
+        ce = layers.elementwise_mul(ce, weights_flat)
         denom = layers.elementwise_add(
             layers.reduce_sum(label_weights),
             layers.fill_constant([1], "float32", 1e-6))

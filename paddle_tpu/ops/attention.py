@@ -90,7 +90,9 @@ def fused_multihead_attention(ctx, ins, attrs):
     k = _split_heads(k3, nh)
     v = _split_heads(v3, nh)
 
-    if _use_pallas(q):
+    # cross-attention with square q/kv lengths rides the kernel too;
+    # rectangular lengths fall through to the jnp composition
+    if _use_pallas(q) and q.shape[2] == k.shape[2]:
         from .pallas.flash_attention import flash_attention
 
         dkey = None

@@ -13,12 +13,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..fluid.dtypes import convert_dtype
+from ..fluid.dtypes import convert_dtype, runtime_dtype
 from .registry import register
 
 
 def _attr_dtype(attrs, default="float32"):
-    return convert_dtype(attrs.get("dtype", default))
+    return runtime_dtype(attrs.get("dtype", default))
 
 
 def _attr_shape(attrs):
@@ -83,7 +83,7 @@ def assign(ctx, ins, attrs):
 
 @register("cast")
 def cast(ctx, ins, attrs):
-    dt = convert_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    dt = runtime_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
     return {"Out": [ins["X"][0].astype(dt)]}
 
 

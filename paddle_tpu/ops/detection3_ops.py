@@ -402,36 +402,52 @@ def deformable_conv(ctx, ins, attrs):
     dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
     groups = int(attrs.get("groups", 1) or 1)
     dg = int(attrs.get("deformable_groups", 1) or 1)
-    if groups != 1 or dg != 1:
-        raise NotImplementedError("deformable_conv: groups/deformable_groups > 1")
     n, c, h, wdt = x.shape
     co, _, kh, kw = w.shape
+    if c % groups or c % dg or co % groups:
+        raise ValueError(
+            f"deformable_conv: input channels {c} must divide by both "
+            f"groups={groups} and deformable_groups={dg}, and output "
+            f"channels {co} by groups"
+        )
     ho = (h + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) // strides[0] + 1
     wo = (wdt + 2 * paddings[1] - dilations[1] * (kw - 1) - 1) // strides[1] + 1
 
     base_y = (jnp.arange(ho) * strides[0] - paddings[0])[:, None]
     base_x = (jnp.arange(wo) * strides[1] - paddings[1])[None, :]
+    cdg = c // dg      # channels per deformable group (own offset set)
+    cg = c // groups   # input channels per conv group
+    cog = co // groups
 
     def one(img, off, m):
         cols = []
         for ki in range(kh):
             for kj in range(kw):
-                t = 2 * (ki * kw + kj)
-                oy = off[t]      # [Ho, Wo]
-                ox = off[t + 1]
-                ys = base_y + ki * dilations[0] + oy
-                xs = base_x + kj * dilations[1] + ox
-                v = _bilinear_at(img, ys, xs)  # [C, Ho, Wo]
-                if m is not None:
-                    v = v * m[ki * kw + kj][None]
-                cols.append(v)
+                vs = []
+                for gd in range(dg):
+                    t = 2 * (gd * kh * kw + ki * kw + kj)
+                    oy = off[t]      # [Ho, Wo]
+                    ox = off[t + 1]
+                    ys = base_y + ki * dilations[0] + oy
+                    xs = base_x + kj * dilations[1] + ox
+                    v = _bilinear_at(
+                        img[gd * cdg:(gd + 1) * cdg], ys, xs
+                    )  # [C/dg, Ho, Wo]
+                    if m is not None:
+                        v = v * m[gd * kh * kw + ki * kw + kj][None]
+                    vs.append(v)
+                cols.append(vs[0] if dg == 1 else jnp.concatenate(vs, axis=0))
         col = jnp.stack(cols, axis=1)  # [C, K, Ho, Wo]
-        col = col.reshape(c * kh * kw, ho * wo)
-        wk = w.transpose(0, 2, 3, 1).reshape(co, kh * kw * c)
-        # reorder col to (k-major, c-minor) to match wk layout
-        col2 = col.reshape(c, kh * kw, ho * wo).transpose(1, 0, 2).reshape(
-            kh * kw * c, ho * wo)
-        return (wk @ col2).reshape(co, ho, wo)
+        outs = []
+        for gi in range(groups):
+            colg = col[gi * cg:(gi + 1) * cg]
+            # reorder to (k-major, c-minor) to match the filter layout
+            col2 = colg.reshape(cg, kh * kw, ho * wo).transpose(
+                1, 0, 2).reshape(kh * kw * cg, ho * wo)
+            wk = w[gi * cog:(gi + 1) * cog].transpose(0, 2, 3, 1).reshape(
+                cog, kh * kw * cg)
+            outs.append((wk @ col2).reshape(cog, ho, wo))
+        return outs[0] if groups == 1 else jnp.concatenate(outs, axis=0)
 
     if mask is None:
         out = jax.vmap(lambda img, off: one(img, off, None))(x, offset)

@@ -52,6 +52,28 @@ def _act(name):
     }[name]
 
 
+def _ln_f32(x, scale, shift, eps):
+    """LayerNorm with f32 statistics regardless of compute dtype (bf16
+    under AMP) — shared by the encoder and decoder stacks."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32) \
+        + shift.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _cheap_dropout(x, prob, key):
+    """uint8 random bits: 4x less generator traffic than bernoulli's
+    32-bit uniforms (profiled ~10ms/step on BERT-base with f32 masks).
+    The threshold is quantized to 1/256, so rescale by the EFFECTIVE
+    keep probability to stay unbiased."""
+    thresh = max(1, min(255, round((1.0 - prob) * 256)))
+    keep_eff = thresh / 256.0
+    bits = jax.random.bits(key, x.shape, dtype=jnp.uint8)
+    return jnp.where(bits < jnp.uint8(thresh), x / keep_eff, 0.0)
+
+
 def _use_gpipe(ctx, attrs):
     return (
         bool(attrs.get("pipeline", False))
@@ -81,25 +103,12 @@ def fused_encoder_stack(ctx, ins, attrs):
     stacked = {k: ins[k][0] for k in _PARAM_KEYS}
 
     def ln(x, scale, shift):
-        # f32 statistics regardless of compute dtype (bf16 under AMP)
-        xf = x.astype(jnp.float32)
-        mu = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
-        y = (xf - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32) \
-            + shift.astype(jnp.float32)
-        return y.astype(x.dtype)
+        return _ln_f32(x, scale, shift, eps)
 
     def dropout(x, prob, key):
         if is_test or prob <= 0.0:
             return x
-        # uint8 random bits: 4x less generator traffic than bernoulli's
-        # 32-bit uniforms (profiled ~10ms/step on BERT-base with f32
-        # masks). The threshold is quantized to 1/256, so rescale by the
-        # EFFECTIVE keep probability to stay unbiased.
-        thresh = max(1, min(255, round((1.0 - prob) * 256)))
-        keep_eff = thresh / 256.0
-        bits = jax.random.bits(key, x.shape, dtype=jnp.uint8)
-        return jnp.where(bits < jnp.uint8(thresh), x / keep_eff, 0.0)
+        return _cheap_dropout(x, prob, key)
 
     def make_layer(bias_arr, mb_salt=None, manual=False):
         """Layer body closed over a (possibly microbatch-sliced) attention
@@ -286,3 +295,131 @@ def _flash_ok(s, dh):
     from .pallas.flash_attention import flash_shapes_ok
 
     return flash_shapes_ok(s, dh)
+
+
+_DEC_PARAM_KEYS = (
+    "SelfQKVW", "SelfQKVB", "SelfOutW", "SelfOutB", "Ln1S", "Ln1B",
+    "CrossQW", "CrossQB", "CrossKW", "CrossKB", "CrossVW", "CrossVB",
+    "CrossOutW", "CrossOutB", "Ln2S", "Ln2B",
+    "FfnW1", "FfnB1", "FfnW2", "FfnB2", "Ln3S", "Ln3B",
+)
+
+
+@register("fused_decoder_stack")
+def fused_decoder_stack(ctx, ins, attrs):
+    """Scan-fused transformer DECODER stack (causal self-attention +
+    cross-attention over a loop-invariant encoder memory + FFN, post-LN):
+    the NMT counterpart of fused_encoder_stack. The reference builds all
+    6 decoder layers as separate op lists (dist_transformer.py); one
+    scanned body compiles once, and both attentions run the Pallas flash
+    kernel — causal masking in-kernel for self-attention, the source
+    padding mask as a per-key bias for cross-attention (square q/kv
+    lengths; rectangular falls back to XLA-fused jnp block math).
+
+    Slots (stacked on dim 0 = layer): _DEC_PARAM_KEYS above; inputs
+    Hidden [B,St,H], EncOut [B,Ss,H], SrcBias [B,1,1,Ss]."""
+    hidden = ins["Hidden"][0]
+    enc_out = ins["EncOut"][0]
+    src_bias = ins.get("SrcBias", [None])[0]
+    nh = int(attrs["num_heads"])
+    act = _act(attrs.get("act", "relu"))
+    dropout_prob = float(attrs.get("dropout_prob", 0.0))
+    attn_dropout_prob = float(attrs.get("attn_dropout_prob", 0.0))
+    is_test = bool(attrs.get("is_test", False))
+    eps = float(attrs.get("epsilon", 1e-5))
+    use_flash = bool(attrs.get("use_flash_attention", True))
+    from ..parallel import ring_attention as ring_mod
+
+    if ring_mod.use_ring(ctx, attrs):
+        raise NotImplementedError(
+            "fused_decoder_stack has no sequence-parallel ring path yet; "
+            "set fuse_stack=False to run the per-layer decoder under sp"
+        )
+    base_key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+    stacked = {k: ins[k][0] for k in _DEC_PARAM_KEYS}
+
+    def ln(x, scale, shift):
+        return _ln_f32(x, scale, shift, eps)
+
+    def dropout(x, prob, key):
+        if is_test or prob <= 0.0:
+            return x
+        return _cheap_dropout(x, prob, key)
+
+    b, st, h = hidden.shape
+    ss = enc_out.shape[1]
+    dh = h // nh
+
+    def split_heads(x, s):
+        return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+
+    def merge_heads(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b, s, h)
+
+    def jnp_attn(q, k, v, bias4, causal, key):
+        scores = jnp.einsum(
+            "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32,
+        ) / math.sqrt(dh)
+        if bias4 is not None:
+            scores = scores + bias4.astype(scores.dtype)
+        if causal:
+            qlen, klen = scores.shape[-2], scores.shape[-1]
+            cm = jnp.arange(qlen)[:, None] >= jnp.arange(klen)[None, :]
+            scores = jnp.where(cm, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        probs = dropout(probs, attn_dropout_prob, key)
+        return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+
+    def attend(q, k, v, bias4, causal, key, slen):
+        if use_flash and q.shape[2] == k.shape[2] and _flash_ok(slen, dh):
+            from .pallas.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, bias4, causal=causal,
+                dropout_prob=0.0 if is_test else attn_dropout_prob,
+                dropout_key=None if is_test else key,
+            )
+        return jnp_attn(q, k, v, bias4, causal, key)
+
+    def layer(carry, p):
+        hid, idx = carry
+        key = jax.random.fold_in(base_key, idx)
+        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+
+        # --- causal self-attention
+        qkv = jnp.einsum("bsh,hk->bsk", hid, p["SelfQKVW"]) + p["SelfQKVB"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ctx_s = attend(split_heads(q, st), split_heads(k, st),
+                       split_heads(v, st), None, True, k1, st)
+        self_out = jnp.einsum(
+            "bsh,hk->bsk", merge_heads(ctx_s, st), p["SelfOutW"]
+        ) + p["SelfOutB"]
+        hid = ln(hid + dropout(self_out, dropout_prob, k2),
+                 p["Ln1S"], p["Ln1B"])
+
+        # --- cross-attention over the encoder memory
+        qc = jnp.einsum("bsh,hk->bsk", hid, p["CrossQW"]) + p["CrossQB"]
+        kc = jnp.einsum("bsh,hk->bsk", enc_out, p["CrossKW"]) + p["CrossKB"]
+        vc = jnp.einsum("bsh,hk->bsk", enc_out, p["CrossVW"]) + p["CrossVB"]
+        ctx_c = attend(split_heads(qc, st), split_heads(kc, ss),
+                       split_heads(vc, ss), src_bias, False, k3, ss)
+        cross_out = jnp.einsum(
+            "bsh,hk->bsk", merge_heads(ctx_c, st), p["CrossOutW"]
+        ) + p["CrossOutB"]
+        hid = ln(hid + dropout(cross_out, dropout_prob, k4),
+                 p["Ln2S"], p["Ln2B"])
+
+        # --- FFN
+        def ffn(h_, w1, b1, w2, b2, key5):
+            inter = act(jnp.einsum("bsh,hf->bsf", h_, w1) + b1)
+            out_ = jnp.einsum("bsf,fh->bsh", inter, w2) + b2
+            return dropout(out_, dropout_prob, key5)
+
+        if attrs.get("remat_ffn", False):
+            ffn = jax.checkpoint(ffn)
+        ffn_out = ffn(hid, p["FfnW1"], p["FfnB1"], p["FfnW2"], p["FfnB2"], k5)
+        hid = ln(hid + ffn_out, p["Ln3S"], p["Ln3B"])
+        return (hid, idx + 1), None
+
+    (out, _), _ = jax.lax.scan(layer, (hidden, jnp.int32(0)), stacked)
+    return {"Out": [out]}

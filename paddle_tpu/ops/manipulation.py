@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..fluid.dtypes import runtime_dtype
 from .registry import register, set_grad_maker
 
 
@@ -304,7 +305,7 @@ def arg_max(ctx, ins, attrs):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
     keepdims = attrs.get("keepdims", False)
-    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    out = jnp.argmax(x, axis=axis).astype(runtime_dtype("int64"))
     if keepdims:
         out = jnp.expand_dims(out, axis)
     return {"Out": [out]}
@@ -315,7 +316,7 @@ def arg_min(ctx, ins, attrs):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
     keepdims = attrs.get("keepdims", False)
-    out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+    out = jnp.argmin(x, axis=axis).astype(runtime_dtype("int64"))
     if keepdims:
         out = jnp.expand_dims(out, axis)
     return {"Out": [out]}
@@ -326,7 +327,7 @@ def argsort(ctx, ins, attrs):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
     desc = attrs.get("descending", False)
-    idx = jnp.argsort(-x if desc else x, axis=axis).astype(jnp.int64)
+    idx = jnp.argsort(-x if desc else x, axis=axis).astype(runtime_dtype("int64"))
     out = jnp.take_along_axis(x, idx, axis=axis)
     return {"Out": [out], "Indices": [idx]}
 
@@ -379,7 +380,7 @@ def top_k(ctx, ins, attrs):
     x = ins["X"][0]
     k = attrs["k"]
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(runtime_dtype("int64"))]}
 
 
 @register("top_k_grad", no_vjp_grad=True)
@@ -413,7 +414,7 @@ def top_k_v2(ctx, ins, attrs):
         vals = -vals
     return {
         "Out": [jnp.moveaxis(vals, -1, axis)],
-        "Indices": [jnp.moveaxis(idx.astype(jnp.int64), -1, axis)],
+        "Indices": [jnp.moveaxis(idx.astype(runtime_dtype("int64")), -1, axis)],
     }
 
 

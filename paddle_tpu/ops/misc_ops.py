@@ -15,6 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..fluid.dtypes import runtime_dtype
 from .registry import register
 
 
@@ -94,7 +95,7 @@ def sampling_id(ctx, ins, attrs):
     key = ctx.salted_rng(int(attrs.get("rng_salt", 0))) if attrs.get(
         "rng_salt") is not None else ctx.rng()
     ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-30)), axis=-1)
-    return {"Out": [ids.astype(jnp.int64)]}
+    return {"Out": [ids.astype(runtime_dtype("int64"))]}
 
 
 @register("hash", stop_gradient=True, no_vjp_grad=True)
@@ -112,7 +113,7 @@ def hash_op(ctx, ins, attrs):
         h = h ^ (h >> 16)
         h = h * jnp.uint32(0x85EBCA6B)
         h = h ^ (h >> 13)
-        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+        outs.append((h % jnp.uint32(mod_by)).astype(runtime_dtype("int64")))
     # reference emits [rows, num_hash, 1] for [rows, 1] input
     return {"Out": [jnp.stack(outs, axis=1).reshape(x.shape[0], num_hash, -1)]}
 
@@ -284,7 +285,7 @@ def randperm(ctx, ins, attrs):
     n = int(attrs["n"])
     key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
     perm = jax.random.permutation(key, n)
-    return {"Out": [perm.astype(convert_dtype(attrs.get("dtype", "int64")))]}
+    return {"Out": [perm.astype(runtime_dtype(attrs.get("dtype", "int64")))]}
 
 
 @register("tanh_shrink")

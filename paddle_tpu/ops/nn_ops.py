@@ -127,6 +127,34 @@ def conv3d(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+def adaptive_pool_nd(x, out_sizes, red):
+    """Adaptive pooling for NON-divisible output sizes (reference
+    pool_op.h AdaptStartIndex/AdaptEndIndex): spatial bin i of dimension
+    `in_size -> out` spans [floor(i*in/out), ceil((i+1)*in/out)). The
+    bin extents are static Python ints, so each bin is a static slice
+    reduced and stacked — fixed shapes, XLA-fusable, no gathers."""
+    spatial = x.shape[2:]
+    assert len(spatial) == len(out_sizes)
+
+    def pool_axis(arr, axis, in_size, out):
+        bins = [
+            (int(np.floor(i * in_size / out)),
+             int(np.ceil((i + 1) * in_size / out)))
+            for i in range(out)
+        ]
+        parts = [
+            red(jax.lax.slice_in_dim(arr, s, e, axis=axis), axis=axis,
+                keepdims=True)
+            for s, e in bins
+        ]
+        return jnp.concatenate(parts, axis=axis)
+
+    out = x
+    for d, (in_size, o) in enumerate(zip(spatial, out_sizes)):
+        out = pool_axis(out, 2 + d, in_size, o)
+    return out
+
+
 @register("pool2d")
 def pool2d(ctx, ins, attrs):
     x = ins["X"][0]  # NCHW
@@ -145,11 +173,11 @@ def pool2d(ctx, ins, attrs):
         return {"Out": [red(x, axis=(2, 3), keepdims=True)]}
     if adaptive:
         oh, ow = ksize
+        red = jnp.max if ptype == "max" else jnp.mean
         if H % oh == 0 and W % ow == 0:
             xr = x.reshape(x.shape[0], x.shape[1], oh, H // oh, ow, W // ow)
-            red = jnp.max if ptype == "max" else jnp.mean
             return {"Out": [red(xr, axis=(3, 5))]}
-        raise NotImplementedError("adaptive pool with non-divisible sizes")
+        return {"Out": [adaptive_pool_nd(x, (oh, ow), red)]}
 
     if algo == "SAME":
         pad = "SAME"
@@ -234,20 +262,25 @@ def batch_norm(ctx, ins, attrs):
 
 @register("layer_norm")
 def layer_norm(ctx, ins, attrs):
+    # statistics ALWAYS in f32 (the fused-stack ln() convention): the op
+    # can then sit on AMP's low-precision list — bf16 in/out keeps the
+    # residual stream at half bandwidth while the mean/variance math
+    # stays exact
     x = ins["X"][0]
     eps = attrs.get("epsilon", 1e-5)
     axis = attrs.get("begin_norm_axis", 1)
     lead = tuple(x.shape[:axis])
-    m = jnp.mean(x, axis=tuple(range(axis, x.ndim)), keepdims=True)
-    v = jnp.var(x, axis=tuple(range(axis, x.ndim)), keepdims=True)
-    y = (x - m) / jnp.sqrt(v + eps)
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=tuple(range(axis, x.ndim)), keepdims=True)
+    v = jnp.var(xf, axis=tuple(range(axis, x.ndim)), keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
     tail_shape = (1,) * axis + tuple(x.shape[axis:])
     if ins.get("Scale"):
-        y = y * ins["Scale"][0].reshape(tail_shape)
+        y = y * ins["Scale"][0].astype(jnp.float32).reshape(tail_shape)
     if ins.get("Bias"):
-        y = y + ins["Bias"][0].reshape(tail_shape)
+        y = y + ins["Bias"][0].astype(jnp.float32).reshape(tail_shape)
     return {
-        "Y": [y],
+        "Y": [y.astype(x.dtype)],
         "Mean": [m.reshape(lead)],
         "Variance": [v.reshape(lead)],
     }

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..fluid.dtypes import runtime_dtype
 from .registry import register
 
 NEG_INF = -1e30
@@ -48,9 +49,8 @@ def sequence_mask(ctx, ins, attrs):
     maxlen = int(attrs.get("maxlen", -1))
     if maxlen <= 0:
         raise ValueError("sequence_mask needs a static maxlen attr on TPU")
-    from ..fluid.dtypes import convert_dtype
 
-    dt = convert_dtype(attrs.get("out_dtype", "int64"))
+    dt = runtime_dtype(attrs.get("out_dtype", "int64"))
     out = (jnp.arange(maxlen)[None, :] < length[..., None]).astype(dt)
     return {"Y": [out]}
 
@@ -243,7 +243,7 @@ def edit_distance(ctx, ins, attrs):
     dist = jnp.take_along_axis(row, rlen[:, None], axis=1)[:, 0]
     if attrs.get("normalized", False):
         dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
-    seq_num = jnp.asarray([b], jnp.int64)
+    seq_num = jnp.asarray([b], runtime_dtype("int64"))
     return {"Out": [dist.reshape(b, 1)], "SequenceNum": [seq_num]}
 
 
@@ -484,7 +484,7 @@ def crf_decoding(ctx, ins, attrs):
     first, path_rev = jax.lax.scan(bwd, last, back, reverse=True)
     path = jnp.concatenate([first[None, :], path_rev], axis=0)  # [T, B]
     path = jnp.swapaxes(path, 0, 1)
-    path = (path * mask.astype(path.dtype)).astype(jnp.int64)
+    path = (path * mask.astype(path.dtype)).astype(runtime_dtype("int64"))
     return {"ViterbiPath": [path]}
 
 

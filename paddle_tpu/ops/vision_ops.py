@@ -32,10 +32,12 @@ def pool3d(ctx, ins, attrs):
     if attrs.get("adaptive", False):
         od, oh, ow = ksize
         d, h, w = x.shape[2:]
-        if d % od or h % oh or w % ow:
-            raise NotImplementedError("adaptive pool3d with non-divisible sizes")
-        xr = x.reshape(x.shape[0], x.shape[1], od, d // od, oh, h // oh, ow, w // ow)
         red = jnp.max if ptype == "max" else jnp.mean
+        if d % od or h % oh or w % ow:
+            from .nn_ops import adaptive_pool_nd
+
+            return {"Out": [adaptive_pool_nd(x, (od, oh, ow), red)]}
+        xr = x.reshape(x.shape[0], x.shape[1], od, d // od, oh, h // oh, ow, w // ow)
         return {"Out": [red(xr, axis=(3, 5, 7))]}
     pad = [(p, p) for p in paddings]
     window = (1, 1) + tuple(ksize)
@@ -63,21 +65,41 @@ def conv3d_transpose(ctx, ins, attrs):
     paddings = list(attrs.get("paddings", [0, 0, 0]))
     dilations = list(attrs.get("dilations", [1, 1, 1]))
     groups = int(attrs.get("groups", 1) or 1)
-    if groups != 1:
-        raise NotImplementedError("conv3d_transpose groups>1")
-    # jax transposed conv: conv_general_dilated with lhs_dilation=strides
+    # jax transposed conv: conv_general_dilated with lhs_dilation=strides;
+    # groups>1 runs one transposed conv per channel group ([Cin, Cout/g,
+    # kD,kH,kW] filters slice along Cin into g groups of Cin/g)
     k = w.shape[2:]
     pad = [
         (dilations[i] * (k[i] - 1) - paddings[i],
          dilations[i] * (k[i] - 1) - paddings[i])
         for i in range(3)
     ]
-    out = jax.lax.conv_general_dilated(
-        x, jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1),
-        window_strides=(1, 1, 1), padding=pad,
-        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-    )
+
+    def _tconv(xg, wg):
+        return jax.lax.conv_general_dilated(
+            xg, jnp.flip(wg, axis=(2, 3, 4)).swapaxes(0, 1),
+            window_strides=(1, 1, 1), padding=pad,
+            lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+
+    if groups == 1:
+        out = _tconv(x, w)
+    else:
+        cin = x.shape[1]
+        if cin % groups:
+            raise ValueError(
+                f"conv3d_transpose: Cin {cin} must divide by groups={groups}"
+            )
+        cig = cin // groups
+        out = jnp.concatenate(
+            [
+                _tconv(x[:, gi * cig:(gi + 1) * cig],
+                       w[gi * cig:(gi + 1) * cig])
+                for gi in range(groups)
+            ],
+            axis=1,
+        )
     if attrs.get("output_padding"):
         op_ = attrs["output_padding"]
         if any(op_):

@@ -602,3 +602,34 @@ def test_generate_mask_labels_multi_polygon_union_and_fallback():
     mrois, has, mask, nums = _run(build, feeds)
     assert nums[0] == 1
     assert (mask[0, 0] == -1).all()
+
+
+def test_deformable_conv_groups_zero_offset_matches_grouped_conv():
+    """groups=2, deformable_groups=2 with zero offsets == a grouped
+    standard conv (shared filter) — the edge case the round-2 build
+    rejected (ops/detection3_ops.py)."""
+    rng = np.random.RandomState(5)
+    c, co, kh = 4, 4, 3
+    xv = rng.randn(1, c, 6, 6).astype("f4")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1, c, 6, 6], "float32")
+        off = fluid.data("off", [1, 2 * 2 * kh * kh, 6, 6], "float32")
+        msk = fluid.data("msk", [1, 2 * kh * kh, 6, 6], "float32")
+        dc = layers.deformable_conv(
+            x, off, msk, co, kh, padding=1, groups=2, deformable_groups=2,
+            bias_attr=False, name="dcg0")
+        wname = [p.name for p in main.all_parameters()][0]
+        cv = layers.conv2d(x, co, kh, padding=1, groups=2, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name=wname))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        d, cref = exe.run(main, feed={
+            "x": xv,
+            "off": np.zeros((1, 2 * 2 * kh * kh, 6, 6), "f4"),
+            "msk": np.ones((1, 2 * kh * kh, 6, 6), "f4")},
+            fetch_list=[dc, cv])
+    np.testing.assert_allclose(np.asarray(d), np.asarray(cref),
+                               rtol=1e-4, atol=1e-5)

@@ -493,3 +493,95 @@ def test_conv3d_transpose_output_size_derivation():
 
     (out,) = _run(build, {"x": np.zeros((1, 2, 4, 4, 4), np.float32)})
     assert out.shape == (1, 3, 8, 8, 8)  # k = 8 - 3*1 + 0 = 5
+
+
+def test_conv3d_transpose_groups_matches_per_group():
+    """groups=2 transposed conv == concatenating the two single-group
+    transposes over the channel split (the round-2 restriction lifted)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    rng = np.random.RandomState(6)
+    xv = rng.randn(1, 4, 3, 3, 3).astype("f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1, 4, 3, 3, 3], "float32")
+        y = layers.conv3d_transpose(x, 4, filter_size=3, stride=2,
+                                    groups=2, bias_attr=False, name="ct_g")
+        wname = [p.name for p in main.all_parameters()][0]
+        w = main.global_block().var(wname)
+        # oracle: slice input+filter per group, run groups=1, concat
+        xa = layers.slice(x, axes=[1], starts=[0], ends=[2])
+        xb = layers.slice(x, axes=[1], starts=[2], ends=[4])
+        wa = layers.slice(w, axes=[0], starts=[0], ends=[2])
+        wb = layers.slice(w, axes=[0], starts=[2], ends=[4])
+        from paddle_tpu.fluid.layer_helper import emit_op
+
+        def one(xi, wi):
+            return emit_op("conv3d_transpose",
+                           {"Input": [xi], "Filter": [wi]},
+                           {"strides": [2, 2, 2], "paddings": [0, 0, 0],
+                            "dilations": [1, 1, 1], "groups": 1},
+                           out_slots=("Output",))
+
+        ya, yb = one(xa, wa), one(xb, wb)
+        ycat = layers.concat([ya, yb], axis=1)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        g, ref = exe.run(main, feed={"x": xv}, fetch_list=[y, ycat])
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_pool_non_divisible_matches_reference_bins():
+    """Adaptive pooling with non-divisible sizes: bin i spans
+    [floor(i*H/out), ceil((i+1)*H/out)) (reference pool_op.h
+    AdaptStart/EndIndex) — checked against a numpy oracle, avg and max,
+    2d (5->3) and 3d (5->2)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    rng = np.random.RandomState(7)
+    xv = rng.randn(2, 3, 5, 7).astype("f4")
+    x3v = rng.randn(1, 2, 5, 4, 6).astype("f4")
+
+    def bins(n, o):
+        return [(int(np.floor(i * n / o)), int(np.ceil((i + 1) * n / o)))
+                for i in range(o)]
+
+    def oracle2d(a, oh, ow, red):
+        out = np.zeros(a.shape[:2] + (oh, ow), a.dtype)
+        for i, (s0, e0) in enumerate(bins(a.shape[2], oh)):
+            for j, (s1, e1) in enumerate(bins(a.shape[3], ow)):
+                out[:, :, i, j] = red(a[:, :, s0:e0, s1:e1], axis=(2, 3))
+        return out
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 3, 5, 7], "float32")
+        x3 = fluid.data("x3", [1, 2, 5, 4, 6], "float32")
+        avg2 = layers.adaptive_pool2d(x, [3, 3], "avg")
+        max2 = layers.adaptive_pool2d(x, [3, 3], "max")
+        avg3 = layers.adaptive_pool3d(x3, [2, 3, 4], "avg")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        a2, m2, a3 = exe.run(main, feed={"x": xv, "x3": x3v},
+                             fetch_list=[avg2, max2, avg3])
+    np.testing.assert_allclose(np.asarray(a2), oracle2d(xv, 3, 3, np.mean),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2), oracle2d(xv, 3, 3, np.max),
+                               rtol=1e-5, atol=1e-6)
+
+    def oracle3d(a, od, oh, ow):
+        out = np.zeros(a.shape[:2] + (od, oh, ow), a.dtype)
+        for i, (s0, e0) in enumerate(bins(a.shape[2], od)):
+            for j, (s1, e1) in enumerate(bins(a.shape[3], oh)):
+                for k2, (s2, e2) in enumerate(bins(a.shape[4], ow)):
+                    out[:, :, i, j, k2] = np.mean(
+                        a[:, :, s0:e0, s1:e1, s2:e2], axis=(2, 3, 4))
+        return out
+
+    np.testing.assert_allclose(np.asarray(a3), oracle3d(x3v, 2, 3, 4),
+                               rtol=1e-5, atol=1e-6)

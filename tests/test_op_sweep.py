@@ -2980,6 +2980,10 @@ EXEMPT = {
     # fused mega-ops have dedicated oracle suites
     "moe_ffn": "test_moe.py (numpy routing oracle, capacity, ep parity)",
     "fused_encoder_stack": "test_bert.py (vs per-layer composition)",
+    "fused_decoder_stack": "test_sequence_models.py (fused NMT stack "
+                           "trains + stays causal)",
+    "c_dcn_grad_sync": "test_dcn.py (two-level sync parity + DGC "
+                       "oracles on the (dcn, dp) mesh)",
     "fused_multihead_attention": "test_flash_attention.py + test_bert.py",
     "recompute_segment": "test_meta_optimizers.py (recompute)",
     # explicit grad kernels: exercised by check_grad of their forward op

@@ -61,7 +61,7 @@ def test_rnn_sequence_length_masks_and_trains():
     with fluid.scope_guard(fluid.executor.Scope()):
         exe.run(startup)
         losses = [
-            float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]))
+            float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]).reshape(()))
             for _ in range(25)
         ]
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

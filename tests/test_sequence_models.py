@@ -369,3 +369,100 @@ def test_transformer_decoder_is_causal():
     d1, d2 = np.asarray(d1), np.asarray(d2)
     np.testing.assert_allclose(d1[:, :-1], d2[:, :-1], rtol=1e-5, atol=1e-6)
     assert not np.allclose(d1[:, -1], d2[:, -1])
+
+
+def test_transformer_fused_stack_trains_and_is_causal():
+    """fuse_stack=True routes through fused_encoder_stack +
+    fused_decoder_stack (scan over layers, flash self/cross attention):
+    it must train AND keep the decoder causal (future trg tokens cannot
+    change earlier positions)."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers as L
+    from paddle_tpu.models.transformer import (
+        TransformerConfig, build_transformer_nmt_program, random_nmt_batch,
+        transformer_decoder, transformer_encoder)
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), fuse_stack=True)
+    m, st, feeds, loss = build_transformer_nmt_program(cfg, 4, 16, 12)
+    with fluid.program_guard(m, st):
+        fluid.optimizer.AdamOptimizer(2e-3).minimize(loss)
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(st)
+        feed = random_nmt_batch(cfg, 4, 16, 12, seed=0)
+        vals = []
+        for _ in range(20):
+            (lv,) = exe.run(m, feed=feed, fetch_list=[loss])
+            vals.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0] * 0.98, (vals[0], vals[-1])
+
+    # causality: decoder outputs at position t must not depend on trg
+    # tokens > t (eval mode so dropout is off)
+    cfg_t = dataclasses.replace(cfg, dropout=0.0)
+    m2, st2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2, st2):
+        src = L.data("src", [2, 16], dtype="int32", append_batch_size=False)
+        trg = L.data("trg", [2, 12], dtype="int32", append_batch_size=False)
+        msk = L.data("msk", [2, 16], dtype="float32", append_batch_size=False)
+        enc, bias = transformer_encoder(cfg_t, src, msk, is_test=True)
+        dec = transformer_decoder(cfg_t, trg, enc, bias, is_test=True)
+    rng = np.random.RandomState(0)
+    srcv = rng.randint(0, 64, (2, 16)).astype("i4")
+    trg_a = rng.randint(0, 64, (2, 12)).astype("i4")
+    trg_b = trg_a.copy()
+    trg_b[:, 6:] = (trg_b[:, 6:] + 7) % 64  # change only the future
+    mskv = np.ones((2, 16), "f4")
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe2 = fluid.Executor()
+        exe2.run(st2)
+        (da,) = exe2.run(m2, feed={"src": srcv, "trg": trg_a, "msk": mskv},
+                         fetch_list=[dec])
+        (db,) = exe2.run(m2, feed={"src": srcv, "trg": trg_b, "msk": mskv},
+                         fetch_list=[dec])
+    np.testing.assert_allclose(np.asarray(da)[:, :6], np.asarray(db)[:, :6],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_label_smooth_loss_analytic_matches_onehot():
+    """The analytic smoothed CE == label_smooth(one_hot) + soft-label CE
+    (the one-hot path it replaced)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers as L
+
+    b, t, k, eps = 2, 3, 7, 0.1
+    rng = np.random.RandomState(1)
+    lg = rng.randn(b, t, k).astype("f4") * 3
+    lb = rng.randint(0, k, (b, t, 1)).astype("i4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        logits = fluid.data("lg", [b, t, k], "float32")
+        labels = fluid.data("lb", [b, t, 1], "int32")
+        ref = L.softmax_with_cross_entropy(
+            logits,
+            L.label_smooth(L.one_hot(L.reshape(labels, [b, t]), k),
+                           epsilon=eps),
+            soft_label=True)
+        ce_hard = L.softmax_with_cross_entropy(logits, labels)
+        mx = L.reduce_max(logits, dim=-1, keep_dim=True)
+        lse = L.elementwise_add(
+            L.log(L.reduce_sum(L.exp(L.elementwise_sub(logits, mx)),
+                               dim=-1, keep_dim=True)), mx)
+        uni = L.elementwise_sub(lse, L.reduce_mean(logits, dim=-1,
+                                                   keep_dim=True))
+        ana = L.elementwise_add(L.scale(ce_hard, scale=1.0 - eps),
+                                L.scale(uni, scale=eps))
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        r, a = exe.run(main, feed={"lg": lg, "lb": lb},
+                       fetch_list=[ref, ana])
+    np.testing.assert_allclose(np.asarray(r), np.asarray(a),
+                               rtol=1e-5, atol=1e-5)

@@ -26,6 +26,16 @@ out = fn(*args)
 print("entry() compiled and ran:", [getattr(v, "shape", None) for v in out])
 PY
 
+echo "== FFI clients =="
+# the Go inference client is EXPERIMENTAL: this image ships no Go
+# toolchain, so it compiles only where one exists (clients/go/README.md)
+if command -v go >/dev/null 2>&1; then
+  (cd clients/go/paddle && go vet . && go build .)
+  echo "go client: built"
+else
+  echo "go client: SKIPPED (no Go toolchain; marked experimental)"
+fi
+
 echo "== sdist build =="
 python setup.py --quiet sdist
 echo "CI OK"
