@@ -214,6 +214,7 @@ def main():
     cfg = BertConfig.base()
     cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
     cfg.remat_ffn = os.environ.get("BENCH_REMAT_FFN", "1") == "1"
+    cfg.remat_qkv = os.environ.get("BENCH_REMAT_QKV", "0") == "1"
     cfg.remat_layer = os.environ.get("BENCH_REMAT_LAYER", "0") == "1"
     batch = int(os.environ.get("BENCH_BATCH", 48))
     seq = int(os.environ.get("BENCH_SEQ", 512))

@@ -271,6 +271,7 @@ def _encoder_stack(cfg: BertConfig, hidden, attn_bias, is_test: bool):
             "is_test": is_test,
             "use_flash_attention": cfg.use_flash_attention,
             "remat_ffn": cfg.remat_ffn,
+            "remat_qkv": getattr(cfg, "remat_qkv", False),
             "remat_layer": getattr(cfg, "remat_layer", False),
             "rng_salt": _rng_salt_counter[0],
         },

@@ -126,13 +126,23 @@ def fused_encoder_stack(ctx, ins, attrs):
                 key = jax.random.fold_in(key, mb_salt)
             k1, k2, k3 = jax.random.split(key, 3)
 
-            qkv = jnp.einsum("bsh,hk->bsk", hid, p["QKVW"]) + p["QKVB"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            def project_qkv(hid_, w, bias_):
+                qkv = jnp.einsum("bsh,hk->bsk", hid_, w) + bias_
+                q_, k_, v_ = jnp.split(qkv, 3, axis=-1)
 
-            def split_heads(x):
-                return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+                def split_heads(x):
+                    return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
 
-            q, k, v = split_heads(q), split_heads(k), split_heads(v)
+                return (split_heads(q_), split_heads(k_), split_heads(v_))
+
+            if attrs.get("remat_qkv", False):
+                # recompute the q/k/v projections in the backward instead
+                # of stashing three [B,S,H] tensors per layer: one extra
+                # qkv matmul per layer buys ~3x H*S*B bytes off the
+                # residual stash (whose transposed-layout copies stall
+                # the forward scan)
+                project_qkv = jax.checkpoint(project_qkv)
+            q, k, v = project_qkv(hid, p["QKVW"], p["QKVB"])
             if ring:
                 # sequence-parallel ring attention over "sp"; probs dropout
                 # runs inside the ring. shard_map inside the scan body is
