@@ -118,8 +118,8 @@ def test_auc_layer_accumulates():
         exe.run(startup)
         (a1,) = exe.run(main, feed={"p": probs, "l": lab}, fetch_list=[auc_v])
         (a2,) = exe.run(main, feed={"p": probs, "l": lab}, fetch_list=[auc_v])
-    assert float(np.asarray(a1)) > 0.99
-    assert float(np.asarray(a2)) > 0.99  # stats persist across runs
+    assert float(np.asarray(a1).reshape(())) > 0.99
+    assert float(np.asarray(a2).reshape(())) > 0.99  # stats persist across runs
 
 
 def test_chunk_eval_iob():
@@ -139,10 +139,10 @@ def test_chunk_eval_iob():
         pv, rv, fv, ni, nl, nc = exe.run(
             main, feed={"inf": inf_v, "lab": lab_v},
             fetch_list=[p, r, f1, n_inf, n_lab, n_cor])
-    assert int(np.asarray(ni)) == 1 and int(np.asarray(nl)) == 2
-    assert int(np.asarray(nc)) == 1
-    np.testing.assert_allclose(float(np.asarray(pv)), 1.0)
-    np.testing.assert_allclose(float(np.asarray(rv)), 0.5)
+    assert int(np.asarray(ni).reshape(())) == 1 and int(np.asarray(nl).reshape(())) == 2
+    assert int(np.asarray(nc).reshape(())) == 1
+    np.testing.assert_allclose(float(np.asarray(pv).reshape(())), 1.0)
+    np.testing.assert_allclose(float(np.asarray(rv).reshape(())), 0.5)
 
 
 def test_py_reader_shim_feeds_training():
@@ -187,8 +187,8 @@ def test_chunk_eval_all_outside_reports_zero_chunks():
         exe.run(startup)
         pv, ni_v, nl_v = exe.run(main, feed={"inf": o, "lab": o},
                                  fetch_list=[p, ni, nl])
-    assert int(np.asarray(ni_v)) == 0 and int(np.asarray(nl_v)) == 0
-    assert float(np.asarray(pv)) == 0.0
+    assert int(np.asarray(ni_v).reshape(())) == 0 and int(np.asarray(nl_v).reshape(())) == 0
+    assert float(np.asarray(pv).reshape(())) == 0.0
 
 
 def test_precision_recall_streaming():

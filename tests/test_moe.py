@@ -82,7 +82,7 @@ def test_routing_matches_numpy_oracle(top_k):
     # aux loss: E * sum_e f_e * P_e with f from first-choice assignment
     frac = np.bincount(probs.argmax(-1), minlength=e) / probs.shape[0]
     want_aux = e * float((frac * probs.mean(0)).sum())
-    np.testing.assert_allclose(float(np.asarray(got_aux)), want_aux, rtol=1e-4)
+    np.testing.assert_allclose(float(np.asarray(got_aux).reshape(())), want_aux, rtol=1e-4)
 
 
 def test_capacity_overflow_drops_tokens():
