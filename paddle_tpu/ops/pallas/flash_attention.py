@@ -123,21 +123,48 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _dropout_quantized_thresh(keep_prob):
+    """THE single source of the 8-bit dropout quantization: keep a byte
+    iff byte < t, with t in [1, 256]. t == 256 keeps everything exactly
+    (bytes are <= 255), so near-1.0 keep probabilities round to a true
+    no-op instead of silently dropping 1/256. The numerator rescale must
+    divide by t/256 — derive BOTH from this function or the mask and the
+    rescale go out of sync (a systematic training bias)."""
+    return max(1, min(256, round(keep_prob * 256)))
+
+
+def _dropout_quantized_keep(keep_prob):
+    """Effective keep probability of the quantized in-kernel mask."""
+    return _dropout_quantized_thresh(keep_prob) / 256.0
+
+
 def _dropout_keep(seed_ref, bh, qi, ki, keep_prob, bq, bk):
     """[bq, bk] keep mask from the TPU hardware PRNG.
 
-    Compare in int32 throughout: Mosaic's u32 compare/shift lowerings are
-    signed, so mask the sign bit off the bitcast bits and compare 23-bit
-    values — well-defined signed arithmetic with ~8e6 resolution."""
+    One generated u32 word feeds up to FOUR mask bytes (column blocks of
+    bk // pack, pack = min(4, bk // 128) to keep 128-lane alignment):
+    the PRNG was ~12% of the forward kernel at one word per element.
+    Compare in int32 throughout — Mosaic's u32 lowerings are signed;
+    bytes are masked to [0, 255] so the arithmetic stays well-defined."""
     pltpu.prng_seed(
         seed_ref[0]
         + bh * jnp.int32(_SEED_BH)
         + qi * jnp.int32(_SEED_QI)
         + ki * jnp.int32(_SEED_KI)
     )
+    thresh = jnp.int32(_dropout_quantized_thresh(keep_prob))
+    pack = min(4, bk // 128)
+    if pack > 1:
+        words = pltpu.bitcast(
+            pltpu.prng_random_bits((bq, bk // pack)), jnp.int32
+        )
+        parts = [
+            ((words >> jnp.int32(8 * c)) & jnp.int32(0xFF)) < thresh
+            for c in range(pack)
+        ]
+        return jnp.concatenate(parts, axis=1)
     bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.int32)
-    thresh = jnp.int32(int(keep_prob * float(1 << 23)))
-    return (bits & jnp.int32(0x7FFFFF)) < thresh
+    return (bits & jnp.int32(0xFF)) < thresh
 
 
 def _identity(n):
@@ -219,6 +246,11 @@ def _make_fwd_kernel(*, sm_scale, causal, dropout_prob, bias_mode, use_prng,
         nk = seq_len // bk
         d = q_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
+        # PRNG path draws quantized 8-bit uniforms; the rescale must
+        # match its EFFECTIVE keep probability (mask path keeps exact)
+        keep_div = (
+            _dropout_quantized_keep(keep_prob) if use_prng else keep_prob
+        )
         q_off = off_ref[0] if has_offsets else 0
         k_off = off_ref[1] if has_offsets else 0
         ident = _identity(bq)
@@ -259,7 +291,7 @@ def _make_fwd_kernel(*, sm_scale, causal, dropout_prob, bias_mode, use_prng,
                         )
                     else:
                         keep = mask_ref[g, :, pl.ds(i * bk, bk)] != 0
-                    p_num = jnp.where(keep, p / keep_prob, 0.0)
+                    p_num = jnp.where(keep, p / keep_div, 0.0)
                 acc = acc * alpha + jax.lax.dot_general(
                     p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
@@ -389,6 +421,11 @@ def _make_bwd_dq_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
         nk = seq_len // bk
         d = q_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
+        # PRNG path draws quantized 8-bit uniforms; the rescale must
+        # match its EFFECTIVE keep probability (mask path keeps exact)
+        keep_div = (
+            _dropout_quantized_keep(keep_prob) if use_prng else keep_prob
+        )
         q_off = off_ref[0] if has_offsets else 0
         k_off = off_ref[1] if has_offsets else 0
         ident = _identity(bq)
@@ -425,7 +462,7 @@ def _make_bwd_dq_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
                         )
                     else:
                         keep = mask_ref[g, :, pl.ds(i * bk, bk)] != 0
-                    c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                    c = jnp.where(keep, 1.0 / keep_div, 0.0)
                     ds = p * (c * dp - delta) * sm_scale
                 else:
                     ds = p * (dp - delta) * sm_scale
@@ -474,6 +511,11 @@ def _make_bwd_dkv_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
         nq = seq_len // bq
         d = k_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
+        # PRNG path draws quantized 8-bit uniforms; the rescale must
+        # match its EFFECTIVE keep probability (mask path keeps exact)
+        keep_div = (
+            _dropout_quantized_keep(keep_prob) if use_prng else keep_prob
+        )
         q_off = off_ref[0] if has_offsets else 0
         k_off = off_ref[1] if has_offsets else 0
         ident = _identity(bq)
@@ -517,7 +559,7 @@ def _make_bwd_dkv_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
                         )
                     else:
                         keep = mask_ref[g, pl.ds(i * bq, bq), :] != 0
-                    c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                    c = jnp.where(keep, 1.0 / keep_div, 0.0)
                     p_num = p * c
                 else:
                     c = 1.0
@@ -588,6 +630,11 @@ def _make_bwd_fused_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
         nq = seq_len // bq
         d = k_ref.shape[-1]
         keep_prob = 1.0 - dropout_prob
+        # PRNG path draws quantized 8-bit uniforms; the rescale must
+        # match its EFFECTIVE keep probability (mask path keeps exact)
+        keep_div = (
+            _dropout_quantized_keep(keep_prob) if use_prng else keep_prob
+        )
         q_off = off_ref[0] if has_offsets else 0
         k_off = off_ref[1] if has_offsets else 0
         ident = _identity(bq)
@@ -635,7 +682,7 @@ def _make_bwd_fused_kernel(*, sm_scale, causal, dropout_prob, bias_mode,
                         )
                     else:
                         keep = mask_ref[g, pl.ds(i * bq, bq), :] != 0
-                    c = jnp.where(keep, 1.0 / keep_prob, 0.0)
+                    c = jnp.where(keep, 1.0 / keep_div, 0.0)
                     p_num = p * c
                 else:
                     c = 1.0
