@@ -193,7 +193,48 @@ def bench_transformer():
     }))
 
 
+# auto-remat escalation ladder: cheapest recompute first. The bench
+# probes each candidate's XLA memory analysis (compile only, no execute)
+# and runs the first whose projected peak fits HBM — no hand-picked
+# BENCH_REMAT_* env vars needed for long-context configs.
+_REMAT_LADDER = (
+    {"remat_ffn": True},
+    {"remat_policy": "flash,ln1_out,attn_out"},
+    {"remat_policy": "flash"},
+    {"remat_layer": True},
+)
+
+
+def _remat_from_env():
+    """Explicit BENCH_REMAT_* env vars override the auto ladder."""
+    out = {}
+    for env, field in (
+        ("BENCH_REMAT_FFN", "remat_ffn"),
+        ("BENCH_REMAT_QKV", "remat_qkv"),
+        ("BENCH_REMAT_LAYER", "remat_layer"),
+    ):
+        if env in os.environ:
+            out[field] = os.environ[env] == "1"
+    if os.environ.get("BENCH_REMAT_POLICY"):
+        out["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
+    return out or None
+
+
+def _hbm_limit_bytes():
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — CPU/interpret backends
+        pass
+    return None
+
+
 def main():
+    import dataclasses
+
     import jax
     import numpy as np
 
@@ -211,40 +252,68 @@ def main():
     if model == "transformer":
         return bench_transformer()
 
-    cfg = BertConfig.base()
-    cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
-    cfg.remat_ffn = os.environ.get("BENCH_REMAT_FFN", "1") == "1"
-    cfg.remat_qkv = os.environ.get("BENCH_REMAT_QKV", "0") == "1"
-    cfg.remat_layer = os.environ.get("BENCH_REMAT_LAYER", "0") == "1"
+    base_cfg = BertConfig.base()
+    base_cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
     batch = int(os.environ.get("BENCH_BATCH", 48))
     seq = int(os.environ.get("BENCH_SEQ", 512))
     # long-context runs: the position table must cover the sequence
-    cfg.max_position_embeddings = max(cfg.max_position_embeddings, seq)
+    base_cfg.max_position_embeddings = max(base_cfg.max_position_embeddings, seq)
     max_preds = 76
     steps = int(os.environ.get("BENCH_STEPS", 30))
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
 
-    main_p = fluid.Program()
-    startup = fluid.Program()
-    m, st, feeds, loss = build_bert_pretrain_program(
-        cfg, batch, seq, max_preds, main_program=main_p, startup_program=startup
-    )
-    with fluid.program_guard(m, st):
-        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
-        if use_amp:
-            opt = mixed_prec.decorate(opt, use_bf16=True)
-        opt.minimize(loss)
+    def build(remat):
+        cfg = dataclasses.replace(base_cfg, **remat)
+        main_p, startup = fluid.Program(), fluid.Program()
+        m, st, _feeds, loss = build_bert_pretrain_program(
+            cfg, batch, seq, max_preds, main_program=main_p,
+            startup_program=startup,
+        )
+        with fluid.program_guard(m, st):
+            opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+            if use_amp:
+                opt = mixed_prec.decorate(opt, use_bf16=True)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(st)
+        return cfg, exe, m, loss
 
-    exe = fluid.Executor()
-    exe.run(st)
-    data = random_pretrain_batch(cfg, batch, seq, max_preds, seed=0)
+    data = random_pretrain_batch(base_cfg, batch, seq, max_preds, seed=0)
     # device-resident feed: upload once, reuse every step
     data = {k: jax.device_put(np.asarray(v)) for k, v in data.items()}
+
+    env_remat = _remat_from_env()
+    candidates = [env_remat] if env_remat else list(_REMAT_LADDER)
+    limit = _hbm_limit_bytes()
+    peak_gb = None
+    for i, remat in enumerate(candidates):
+        cfg, exe, m, loss = build(remat)
+        last = i == len(candidates) - 1
+        if last and limit is None:
+            break
+        try:
+            ma = exe.memory_analysis(m, feed=data, fetch_list=[loss])
+        except Exception as e:  # XLA compile-time HBM OOM -> escalate
+            if last or "memory" not in str(e).lower():
+                raise
+            print(f"# remat {remat} failed to compile: OOM; escalating",
+                  file=sys.stderr)
+            continue
+        peak_gb = round(ma["peak_bytes"] / 2**30, 3)
+        if last or limit is None or ma["peak_bytes"] <= limit * 0.95:
+            break
+        print(f"# remat {remat} projected {peak_gb} GiB > "
+              f"{round(0.95 * limit / 2**30, 2)} GiB budget; escalating",
+              file=sys.stderr)
 
     dt, _ = _timed_run(exe, m, data, loss, steps)
 
     tokens_per_sec = batch * seq * steps / dt
     mfu = _bert_step_flops(cfg, batch, seq) * steps / dt / _peak_flops_per_chip()
+    remat_desc = cfg.remat_policy or ",".join(
+        k for k in ("remat_ffn", "remat_qkv", "remat_layer")
+        if getattr(cfg, k)
+    ) or "none"
     print(
         json.dumps(
             {
@@ -257,7 +326,9 @@ def main():
                 "seq_len": seq,
                 "steps": steps,
                 "amp_bf16": use_amp,
-                "peak_hbm_gb": _peak_hbm_gb(exe, m, data, loss),
+                "remat": remat_desc,
+                "peak_hbm_gb": peak_gb if peak_gb is not None
+                else _peak_hbm_gb(exe, m, data, loss),
             }
         )
     )

@@ -485,12 +485,15 @@ class Executor:
         `peak_bytes` (arguments + outputs + temps - aliased, XLA's HBM
         high-water estimate for one execution).
 
-        The program must have been run at least once with this feed
-        signature IN the given scope (the analysis abstracts the scope's
-        live state). Cost note: the AOT lower().compile() does not share
-        jax.jit's per-call executable cache — unless the persistent XLA
-        compilation cache is configured, this pays one extra compile of
-        the step; call it once for diagnostics, not per step.
+        The STARTUP program must have been run first in the given scope
+        (the analysis abstracts the scope's live state); the step program
+        itself is compiled on demand WITHOUT executing, so callers can
+        probe "does this config fit HBM?" before the first step — the
+        bench's auto-remat escalation relies on this. Cost note: the AOT
+        lower().compile() does not share jax.jit's per-call executable
+        cache — unless the persistent XLA compilation cache is
+        configured, this pays one extra compile of the step; call it for
+        config probing / diagnostics, not per step.
         """
         import jax
 
@@ -509,21 +512,35 @@ class Executor:
         feed_arrays = self._prepare_feed(block, feed)
         from .flags import flag
 
-        key = self._cache_key(program, feed_arrays, fetch_names,
-                              flag("FLAGS_check_nan_inf"))
+        check_nan = flag("FLAGS_check_nan_inf")
+        key = self._cache_key(program, feed_arrays, fetch_names, check_nan)
         compiled = self._cache.get(key)
+        if compiled is None:
+            # compile WITHOUT executing: callers can ask "does this step
+            # fit HBM?" BEFORE paying (or failing with an allocator OOM)
+            # the first run — the auto-remat escalation path in bench.py.
+            # The block is cached, so a subsequent run() reuses it.
+            compiled = self._compile(
+                program, block, sorted(feed_arrays), fetch_names, scope,
+                donate=not check_nan,
+            )
+            self._cache[key] = compiled
+        if scope._rng_key is None:
+            if jax.default_backend() in ("tpu", "axon"):
+                scope._rng_key = jax.random.key(
+                    program.random_seed or 0, impl="rbg"
+                )
+            else:
+                scope._rng_key = jax.random.PRNGKey(program.random_seed or 0)
         states = {
             n: scope.find_var(n)
             for n in (compiled.donate_names + compiled.keep_names)
-        } if compiled is not None else {}
+        }
         rng = scope._rng_key
-        if compiled is None or rng is None or any(
-            v is None for v in states.values()
-        ):
+        if any(v is None for v in states.values()):
             raise RuntimeError(
-                "memory_analysis: run this (program, feed, fetch_list) "
-                "once first in the SAME scope — the analysis reads the "
-                "compiled executable and abstracts the scope's state"
+                "memory_analysis: run the startup program first in the "
+                "SAME scope — the analysis abstracts the scope's state"
             )
 
         def _abstract(x):

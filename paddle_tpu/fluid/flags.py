@@ -21,6 +21,9 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_benchmark": (False, "Executor.run blocks until the step "
                                "finishes (sync timing)"),
     "FLAGS_use_flash_attention": (True, "ops/attention.py pallas gate"),
+    "FLAGS_use_fused_ln": (True, "ops/pallas/add_ln.py residual+LayerNorm "
+                                 "kernel gate (encoder/decoder stacks, "
+                                 "layer_norm emitter)"),
     # --- parity, inert on TPU (subsumed) ---
     "FLAGS_allocator_strategy": ("naive_best_fit", None),  # PJRT allocator
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, None),

@@ -46,7 +46,14 @@ class BertConfig:
     # recompute the FFN inter activation in backward (memory for FLOPs):
     # unlocks larger global batches on HBM-bound configs
     remat_ffn: bool = False
+    remat_qkv: bool = False  # recompute q/k/v projections in backward
     remat_layer: bool = False  # save only per-layer hidden (more FLOPs)
+    # checkpoint-policy remat (fuse_stack only): comma-separated
+    # checkpoint_name tags to SAVE per layer; everything else is
+    # recomputed. "flash" = the attention kernel's (o, lse) residuals —
+    # the backward then skips the forward kernel re-run that full-layer
+    # remat pays, while dropping the q/k/v stash. Long-context default.
+    remat_policy: str = ""
     # scan over stacked layer params (fused_encoder_stack op): O(1)-in-depth
     # compile time; param names become encoder_stack.* instead of per-layer
     fuse_stack: bool = False
@@ -273,6 +280,7 @@ def _encoder_stack(cfg: BertConfig, hidden, attn_bias, is_test: bool):
             "remat_ffn": cfg.remat_ffn,
             "remat_qkv": getattr(cfg, "remat_qkv", False),
             "remat_layer": getattr(cfg, "remat_layer", False),
+            "remat_policy": getattr(cfg, "remat_policy", ""),
             "rng_salt": _rng_salt_counter[0],
         },
     )

@@ -34,6 +34,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from .registry import register
 
@@ -41,6 +42,23 @@ _PARAM_KEYS = (
     "QKVW", "QKVB", "OutW", "OutB", "Ln1S", "Ln1B",
     "FfnW1", "FfnB1", "FfnW2", "FfnB2", "Ln2S", "Ln2B",
 )
+
+
+def _policy_names(spec):
+    """Parse a remat_policy attr: comma-separated checkpoint_name tags,
+    with the shorthand 'flash' -> the kernel's saved residuals (o, lse).
+    Tags available in the layer body: flash_o, flash_lse, attn_out,
+    ln1_out, ffn_inter."""
+    names = []
+    for tok in str(spec).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok == "flash":
+            names += ["flash_o", "flash_lse"]
+        else:
+            names.append(tok)
+    return tuple(dict.fromkeys(names))
 
 
 def _act(name):
@@ -61,6 +79,18 @@ def _ln_f32(x, scale, shift, eps):
     y = (xf - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32) \
         + shift.astype(jnp.float32)
     return y.astype(x.dtype)
+
+
+def _add_ln(x, y, scale, shift, eps):
+    """LayerNorm(x + y) — the residual+LN pair of both stacks. Dispatches
+    the fused Pallas kernel (ops/pallas/add_ln.py; XLA's convert+reduce
+    LN fusions measured ~30x the bandwidth roofline inside the encoder
+    scan) with the identical-math jnp fallback."""
+    from .pallas.add_ln import fused_add_ln, fused_ln_dispatch_ok
+
+    if fused_ln_dispatch_ok(x.shape):
+        return fused_add_ln(x, y, scale, shift, eps=eps)
+    return _ln_f32(x + y, scale, shift, eps)
 
 
 def _cheap_dropout(x, prob, key):
@@ -99,11 +129,18 @@ def fused_encoder_stack(ctx, ins, attrs):
     ring = ring_mod.use_ring(ctx, attrs)
     mesh = ctx.mesh
     base_key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+    remat_policy = _policy_names(attrs.get("remat_policy", ""))
+    if remat_policy:
+        # the policy checkpoint wraps the whole layer; inner blanket
+        # checkpoints would force recompute of values the policy elects
+        # to save, so they are mutually exclusive
+        attrs = dict(attrs)
+        attrs["remat_ffn"] = attrs["remat_qkv"] = attrs["remat_layer"] = False
 
     stacked = {k: ins[k][0] for k in _PARAM_KEYS}
 
-    def ln(x, scale, shift):
-        return _ln_f32(x, scale, shift, eps)
+    def add_ln(x, y, scale, shift):
+        return _add_ln(x, y, scale, shift, eps)
 
     def dropout(x, prob, key):
         if is_test or prob <= 0.0:
@@ -172,14 +209,24 @@ def fused_encoder_stack(ctx, ins, attrs):
                 probs = jax.nn.softmax(scores, axis=-1).astype(hid.dtype)
                 probs = dropout(probs, attn_dropout_prob, k1)
                 ctx_l = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+                # tag the fallback path's context too so remat_policy
+                # behaves the same when the kernel doesn't dispatch (the
+                # kernel path tags o/lse inside its custom-vjp forward)
+                ctx_l = checkpoint_name(ctx_l, "flash_o")
             ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
 
             attn_out = jnp.einsum("bsh,hk->bsk", ctx_l, p["OutW"]) + p["OutB"]
-            attn_out = dropout(attn_out, dropout_prob, k2)
-            hid = ln(hid + attn_out, p["Ln1S"], p["Ln1B"])
+            attn_out = checkpoint_name(
+                dropout(attn_out, dropout_prob, k2), "attn_out"
+            )
+            hid = checkpoint_name(
+                add_ln(hid, attn_out, p["Ln1S"], p["Ln1B"]), "ln1_out"
+            )
 
             def ffn(h_, w1, b1, w2, b2, key3):
-                inter = act(jnp.einsum("bsh,hf->bsf", h_, w1) + b1)
+                inter = checkpoint_name(
+                    act(jnp.einsum("bsh,hf->bsf", h_, w1) + b1), "ffn_inter"
+                )
                 out_ = jnp.einsum("bsf,fh->bsh", inter, w2) + b2
                 return dropout(out_, dropout_prob, key3)
 
@@ -190,10 +237,25 @@ def fused_encoder_stack(ctx, ins, attrs):
                 # unlocking larger batches
                 ffn = jax.checkpoint(ffn)
             ffn_out = ffn(hid, p["FfnW1"], p["FfnB1"], p["FfnW2"], p["FfnB2"], k3)
-            hid = ln(hid + ffn_out, p["Ln2S"], p["Ln2B"])
+            hid = add_ln(hid, ffn_out, p["Ln2S"], p["Ln2B"])
             return (hid, idx + 1), None
 
         return layer
+
+    if remat_policy and not _use_gpipe(ctx, attrs):
+        # policy remat: save ONLY the tagged values (e.g. the flash
+        # kernel's o/lse residuals) per layer; everything untagged — the
+        # qkv/out/ffn projections, norms, dropouts — is recomputed in the
+        # backward from the scan-carried hidden. With 'flash' saved the
+        # recompute DCEs the forward attention kernel, unlike remat_layer
+        # which re-runs it: the long-context (s>=4096) memory/FLOPs
+        # sweet spot, and it also kills the q/k/v residual-stash layout
+        # copies that stalled the forward scan at s512.
+        _layer = make_layer(bias)
+        pol = jax.checkpoint_policies.save_only_these_names(*remat_policy)
+        layer_ck = jax.checkpoint(lambda c, p: _layer(c, p), policy=pol)
+        (out, _), _ = jax.lax.scan(layer_ck, (hidden, jnp.int32(0)), stacked)
+        return {"Out": [out]}
 
     if attrs.get("remat_layer", False) and not _use_gpipe(ctx, attrs):
         # full-layer remat: save only the carried hidden per layer
@@ -348,8 +410,8 @@ def fused_decoder_stack(ctx, ins, attrs):
     base_key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
     stacked = {k: ins[k][0] for k in _DEC_PARAM_KEYS}
 
-    def ln(x, scale, shift):
-        return _ln_f32(x, scale, shift, eps)
+    def add_ln(x, y, scale, shift):
+        return _add_ln(x, y, scale, shift, eps)
 
     def dropout(x, prob, key):
         if is_test or prob <= 0.0:
@@ -404,8 +466,8 @@ def fused_decoder_stack(ctx, ins, attrs):
         self_out = jnp.einsum(
             "bsh,hk->bsk", merge_heads(ctx_s, st), p["SelfOutW"]
         ) + p["SelfOutB"]
-        hid = ln(hid + dropout(self_out, dropout_prob, k2),
-                 p["Ln1S"], p["Ln1B"])
+        hid = add_ln(hid, dropout(self_out, dropout_prob, k2),
+                     p["Ln1S"], p["Ln1B"])
 
         # --- cross-attention over the encoder memory
         qc = jnp.einsum("bsh,hk->bsk", hid, p["CrossQW"]) + p["CrossQB"]
@@ -416,8 +478,8 @@ def fused_decoder_stack(ctx, ins, attrs):
         cross_out = jnp.einsum(
             "bsh,hk->bsk", merge_heads(ctx_c, st), p["CrossOutW"]
         ) + p["CrossOutB"]
-        hid = ln(hid + dropout(cross_out, dropout_prob, k4),
-                 p["Ln2S"], p["Ln2B"])
+        hid = add_ln(hid, dropout(cross_out, dropout_prob, k4),
+                     p["Ln2S"], p["Ln2B"])
 
         # --- FFN
         def ffn(h_, w1, b1, w2, b2, key5):
@@ -428,7 +490,7 @@ def fused_decoder_stack(ctx, ins, attrs):
         if attrs.get("remat_ffn", False):
             ffn = jax.checkpoint(ffn)
         ffn_out = ffn(hid, p["FfnW1"], p["FfnB1"], p["FfnW2"], p["FfnB2"], k5)
-        hid = ln(hid + ffn_out, p["Ln3S"], p["Ln3B"])
+        hid = add_ln(hid, ffn_out, p["Ln3S"], p["Ln3B"])
         return (hid, idx + 1), None
 
     (out, _), _ = jax.lax.scan(layer, (hidden, jnp.int32(0)), stacked)

@@ -49,6 +49,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -973,6 +974,12 @@ def _make_flash_core(*, sm_scale, num_heads, causal, dropout_prob, bias_mode,
 
     def core_fwd(q, k, v, bias, mask, seed, offsets):
         o, lse = _flash_fwd(q, k, v, bias, mask, seed, offsets, **statics)
+        # checkpoint_name so a surrounding jax.checkpoint with a
+        # save_only_these_names policy can keep (o, lse) and let the
+        # recompute pass DCE the forward kernel while q/k/v come back from
+        # the (cheap) projection matmuls — the long-context remat story
+        o = checkpoint_name(o, "flash_o")
+        lse = checkpoint_name(lse, "flash_lse")
         return o, (q, k, v, bias, mask, seed, offsets, o, lse)
 
     def core_bwd(res, g):
@@ -1165,6 +1172,8 @@ def _make_flash_core_lse(*, sm_scale, num_heads, causal, dropout_prob,
 
     def core_fwd(q, k, v, bias, mask, seed, offsets):
         o, lse = _flash_fwd(q, k, v, bias, mask, seed, offsets, **statics)
+        o = checkpoint_name(o, "flash_o")
+        lse = checkpoint_name(lse, "flash_lse")
         return (o, lse.reshape(q.shape[0], q.shape[1])), (
             q, k, v, bias, mask, seed, offsets, o, lse,
         )

@@ -88,9 +88,10 @@ def test_remat_ffn_is_numerically_identity():
         random_pretrain_batch,
     )
 
-    def run(remat, remat_layer=False):
+    def run(remat, remat_layer=False, remat_policy=""):
         cfg = dataclasses.replace(BertConfig.tiny(), fuse_stack=True,
-                                  remat_ffn=remat, remat_layer=remat_layer)
+                                  remat_ffn=remat, remat_layer=remat_layer,
+                                  remat_policy=remat_policy)
         main, startup = fluid.Program(), fluid.Program()
         m, st, _, loss = build_bert_pretrain_program(
             cfg, 4, 64, 8, main_program=main, startup_program=startup
@@ -113,3 +114,10 @@ def test_remat_ffn_is_numerically_identity():
     np.testing.assert_allclose(run(True), base, rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(run(False, remat_layer=True), base,
                                rtol=5e-4, atol=5e-4)
+    # policy remat: save only the attention output per layer, recompute
+    # the projections/FFN — must be the same math as no remat
+    np.testing.assert_allclose(run(False, remat_policy="flash"), base,
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(
+        run(False, remat_policy="flash,ln1_out,attn_out"), base,
+        rtol=5e-4, atol=5e-4)
