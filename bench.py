@@ -196,10 +196,11 @@ def bench_transformer():
 # auto-remat escalation ladder: cheapest recompute first. The bench
 # probes each candidate's XLA memory analysis (compile only, no execute)
 # and runs the first whose projected peak fits HBM — no hand-picked
-# BENCH_REMAT_* env vars needed for long-context configs.
+# BENCH_REMAT_* env vars needed for long-context configs. Measured on
+# v5e s512/b64 (BSH kernel): remat_ffn 0.572 MFU @ 10.2G, policy
+# 'flash' 0.545 @ 4.6G, remat_layer last resort.
 _REMAT_LADDER = (
     {"remat_ffn": True},
-    {"remat_policy": "flash,ln1_out,attn_out"},
     {"remat_policy": "flash"},
     {"remat_layer": True},
 )
@@ -233,6 +234,47 @@ def _hbm_limit_bytes():
 
 
 def main():
+    model = os.environ.get("BENCH_MODEL", "bert")
+    if model == "resnet50":
+        return bench_resnet50()
+    if model == "transformer":
+        return bench_transformer()
+
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    seq = int(os.environ.get("BENCH_SEQ", 512))
+    max_preds = 76
+    steps = int(os.environ.get("BENCH_STEPS", 30))
+    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    out = _run_bert(batch, seq, max_preds, steps, use_amp)
+    result = {
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": out["tokens_per_sec"],
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(out["mfu"] / 0.35, 4),
+        "mfu": out["mfu"],
+        "batch": batch,
+        "seq_len": seq,
+        "steps": steps,
+        "amp_bf16": use_amp,
+        "remat": out["remat"],
+        "peak_hbm_gb": out["peak_hbm_gb"],
+    }
+    # long-context guard row (VERDICT r3: the s4096 config regressed with
+    # nothing measuring it): the default bench also runs s4096/b8 through
+    # the auto-remat ladder and reports it in the same JSON line
+    if seq == 512 and os.environ.get("BENCH_LONG_SEQ", "1") == "1":
+        ls = _run_bert(8, 4096, max_preds, max(steps // 2, 8), use_amp)
+        result["long_seq"] = {
+            "seq_len": 4096, "batch": 8, "mfu": ls["mfu"],
+            "tokens_per_sec": ls["tokens_per_sec"], "remat": ls["remat"],
+            "vs_long_target": round(ls["mfu"] / 0.37, 4),
+        }
+    print(json.dumps(result))
+
+
+def _run_bert(batch, seq, max_preds, steps, use_amp):
+    """Build + auto-remat-select + measure one BERT pretraining config."""
     import dataclasses
 
     import jax
@@ -246,21 +288,10 @@ def main():
         random_pretrain_batch,
     )
 
-    model = os.environ.get("BENCH_MODEL", "bert")
-    if model == "resnet50":
-        return bench_resnet50()
-    if model == "transformer":
-        return bench_transformer()
-
     base_cfg = BertConfig.base()
     base_cfg.fuse_stack = True  # scan over layers: O(1)-in-depth compile time
-    batch = int(os.environ.get("BENCH_BATCH", 48))
-    seq = int(os.environ.get("BENCH_SEQ", 512))
     # long-context runs: the position table must cover the sequence
     base_cfg.max_position_embeddings = max(base_cfg.max_position_embeddings, seq)
-    max_preds = 76
-    steps = int(os.environ.get("BENCH_STEPS", 30))
-    use_amp = os.environ.get("BENCH_AMP", "1") == "1"
 
     def build(remat):
         cfg = dataclasses.replace(base_cfg, **remat)
@@ -307,31 +338,18 @@ def main():
               file=sys.stderr)
 
     dt, _ = _timed_run(exe, m, data, loss, steps)
-
-    tokens_per_sec = batch * seq * steps / dt
     mfu = _bert_step_flops(cfg, batch, seq) * steps / dt / _peak_flops_per_chip()
     remat_desc = cfg.remat_policy or ",".join(
         k for k in ("remat_ffn", "remat_qkv", "remat_layer")
         if getattr(cfg, k)
     ) or "none"
-    print(
-        json.dumps(
-            {
-                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(mfu / 0.35, 4),
-                "mfu": round(mfu, 4),
-                "batch": batch,
-                "seq_len": seq,
-                "steps": steps,
-                "amp_bf16": use_amp,
-                "remat": remat_desc,
-                "peak_hbm_gb": peak_gb if peak_gb is not None
-                else _peak_hbm_gb(exe, m, data, loss),
-            }
-        )
-    )
+    return {
+        "tokens_per_sec": round(batch * seq * steps / dt, 1),
+        "mfu": round(mfu, 4),
+        "remat": remat_desc,
+        "peak_hbm_gb": peak_gb if peak_gb is not None
+        else _peak_hbm_gb(exe, m, data, loss),
+    }
 
 
 def _peak_hbm_gb(exe, program, data, loss):

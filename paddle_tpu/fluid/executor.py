@@ -126,29 +126,10 @@ class Executor:
         # the last good parameters after the raise" impossible), so the
         # compile cache must distinguish the two modes
         check_nan = flag("FLAGS_check_nan_inf")
-        key = self._cache_key(program, feed_arrays, fetch_names, check_nan)
-        compiled = self._cache.get(key)
-        if compiled is None:
-            with RecordEvent("Executor::compile"):
-                compiled = self._compile(
-                    program, block, sorted(feed_arrays), fetch_names, scope,
-                    donate=not check_nan,
-                )
-            self._cache[key] = compiled
-
-        if scope._rng_key is None:
-            import jax
-
-            # TPU: the rbg generator lowers to the hardware RNG; threefry
-            # costs real step time for dropout masks (profiled ~7% on
-            # BERT-base). CPU keeps threefry for cross-run determinism.
-            if jax.default_backend() in ("tpu", "axon"):
-                # typed key: fold_in/split/bernoulli all stay rbg
-                scope._rng_key = jax.random.key(
-                    program.random_seed or 0, impl="rbg"
-                )
-            else:
-                scope._rng_key = jax.random.PRNGKey(program.random_seed or 0)
+        compiled = self._ensure_compiled(
+            program, block, feed_arrays, fetch_names, scope, check_nan
+        )
+        self._ensure_rng(scope, program)
 
         def _load(names):
             d = {}
@@ -237,6 +218,39 @@ class Executor:
                 )
 
     # ------------------------------------------------------------------
+    def _ensure_compiled(self, program, block, feed_arrays, fetch_names,
+                         scope, check_nan):
+        """Fetch-or-build the compiled step for this cache key. Shared by
+        run() and memory_analysis() so both agree on compile semantics
+        (and memory_analysis can compile WITHOUT executing)."""
+        key = self._cache_key(program, feed_arrays, fetch_names, check_nan)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            with RecordEvent("Executor::compile"):
+                compiled = self._compile(
+                    program, block, sorted(feed_arrays), fetch_names, scope,
+                    donate=not check_nan,
+                )
+            self._cache[key] = compiled
+        return compiled
+
+    @staticmethod
+    def _ensure_rng(scope, program):
+        """Initialize the scope's PRNG key once. TPU: the rbg generator
+        lowers to the hardware RNG; threefry costs real step time for
+        dropout masks (profiled ~7% on BERT-base). CPU keeps threefry
+        for cross-run determinism."""
+        if scope._rng_key is None:
+            import jax
+
+            if jax.default_backend() in ("tpu", "axon"):
+                # typed key: fold_in/split/bernoulli all stay rbg
+                scope._rng_key = jax.random.key(
+                    program.random_seed or 0, impl="rbg"
+                )
+            else:
+                scope._rng_key = jax.random.PRNGKey(program.random_seed or 0)
+
     @staticmethod
     def _cache_key(program, feed_arrays, fetch_names, check_nan):
         """THE compile-cache key — run() and memory_analysis() must agree
@@ -512,26 +526,15 @@ class Executor:
         feed_arrays = self._prepare_feed(block, feed)
         from .flags import flag
 
-        check_nan = flag("FLAGS_check_nan_inf")
-        key = self._cache_key(program, feed_arrays, fetch_names, check_nan)
-        compiled = self._cache.get(key)
-        if compiled is None:
-            # compile WITHOUT executing: callers can ask "does this step
-            # fit HBM?" BEFORE paying (or failing with an allocator OOM)
-            # the first run — the auto-remat escalation path in bench.py.
-            # The block is cached, so a subsequent run() reuses it.
-            compiled = self._compile(
-                program, block, sorted(feed_arrays), fetch_names, scope,
-                donate=not check_nan,
-            )
-            self._cache[key] = compiled
-        if scope._rng_key is None:
-            if jax.default_backend() in ("tpu", "axon"):
-                scope._rng_key = jax.random.key(
-                    program.random_seed or 0, impl="rbg"
-                )
-            else:
-                scope._rng_key = jax.random.PRNGKey(program.random_seed or 0)
+        # compile WITHOUT executing: callers can ask "does this step fit
+        # HBM?" BEFORE paying (or failing with an allocator OOM) the
+        # first run — the auto-remat escalation path in bench.py. The
+        # block is cached, so a subsequent run() reuses it.
+        compiled = self._ensure_compiled(
+            program, block, feed_arrays, fetch_names, scope,
+            flag("FLAGS_check_nan_inf"),
+        )
+        self._ensure_rng(scope, program)
         states = {
             n: scope.find_var(n)
             for n in (compiled.donate_names + compiled.keep_names)

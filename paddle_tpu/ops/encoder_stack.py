@@ -163,9 +163,30 @@ def fused_encoder_stack(ctx, ins, attrs):
                 key = jax.random.fold_in(key, mb_salt)
             k1, k2, k3 = jax.random.split(key, 3)
 
-            def project_qkv(hid_, w, bias_):
+            # BSH fast path: the flash kernel reads q/k/v exactly as the
+            # projection produces them ([B,S,H], heads sliced in-kernel
+            # as static 64-lane views) — no head split/merge transposes,
+            # which profiled at ~30-45 ms/step on BERT-base s512/b48.
+            # Extreme lengths (whole-sequence VMEM residency won't fit)
+            # and full [.., S, S] biases fall back to the streamed BHSD
+            # kernel path below.
+            from .pallas.flash_attention import bsh_shapes_ok
+
+            keybias = bias_arr is None or (
+                bias_arr.ndim == 4 and bias_arr.shape[1] == 1
+                and bias_arr.shape[2] == 1
+            )
+            use_bsh = (
+                (not ring) and use_flash and _flash_ok(s, dh)
+                and keybias and bsh_shapes_ok(s, s, h)
+            )
+
+            def project_qkv_flat(hid_, w, bias_):
                 qkv = jnp.einsum("bsh,hk->bsk", hid_, w) + bias_
-                q_, k_, v_ = jnp.split(qkv, 3, axis=-1)
+                return jnp.split(qkv, 3, axis=-1)
+
+            def project_qkv(hid_, w, bias_):
+                q_, k_, v_ = project_qkv_flat(hid_, w, bias_)
 
                 def split_heads(x):
                     return x.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
@@ -179,27 +200,45 @@ def fused_encoder_stack(ctx, ins, attrs):
                 # residual stash (whose transposed-layout copies stall
                 # the forward scan)
                 project_qkv = jax.checkpoint(project_qkv)
-            q, k, v = project_qkv(hid, p["QKVW"], p["QKVB"])
-            if ring:
+                project_qkv_flat = jax.checkpoint(project_qkv_flat)
+
+            if use_bsh:
+                from .pallas.flash_attention import flash_attention_bsh
+
+                q, k, v = project_qkv_flat(hid, p["QKVW"], p["QKVB"])
+                ctx_l = flash_attention_bsh(
+                    q, k, v, bias_arr, num_heads=nh,
+                    dropout_prob=0.0 if is_test else attn_dropout_prob,
+                    dropout_key=None if is_test else k1,
+                    mesh=None if manual else mesh,
+                )  # [B, S, H] — already merged
+            elif ring:
                 # sequence-parallel ring attention over "sp"; probs dropout
                 # runs inside the ring. shard_map inside the scan body is
                 # fine — XLA sees one ring schedule per layer iteration
+                q, k, v = project_qkv(hid, p["QKVW"], p["QKVB"])
                 key_bias = ring_mod.key_bias_from_attn_bias(bias_arr, b)
                 ctx_l = ring_mod.ring_attention_global(
                     q, k, v, mesh, axis="sp", bias=key_bias, batch_axis="dp",
                     dropout_prob=0.0 if is_test else attn_dropout_prob,
                     dropout_key=None if is_test else k1,
                 )
+                ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
             elif use_flash and _flash_ok(s, dh):
+                # streamed BHSD kernel: serves the shapes BSH can't hold
+                # resident (very long S) and full [.., S, S] biases
                 from .pallas.flash_attention import flash_attention
 
+                q, k, v = project_qkv(hid, p["QKVW"], p["QKVB"])
                 ctx_l = flash_attention(
                     q, k, v, bias_arr,
                     dropout_prob=0.0 if is_test else attn_dropout_prob,
                     dropout_key=None if is_test else k1,
                     mesh=None if manual else mesh,
                 )
+                ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
             else:
+                q, k, v = project_qkv(hid, p["QKVW"], p["QKVB"])
                 scores = jnp.einsum(
                     "bnqd,bnkd->bnqk", q, k,
                     preferred_element_type=jnp.float32,
@@ -213,7 +252,7 @@ def fused_encoder_stack(ctx, ins, attrs):
                 # behaves the same when the kernel doesn't dispatch (the
                 # kernel path tags o/lse inside its custom-vjp forward)
                 ctx_l = checkpoint_name(ctx_l, "flash_o")
-            ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
+                ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
 
             attn_out = jnp.einsum("bsh,hk->bsk", ctx_l, p["OutW"]) + p["OutB"]
             attn_out = checkpoint_name(

@@ -1249,6 +1249,474 @@ def flash_block_with_lse(q, k, v, key_bias=None, sm_scale=None,
     return o.reshape(b, nh, s, d), lse.reshape(b, nh, s)
 
 
+# ---------------------------------------------------------------------------
+# BSH layout (transpose-free) kernels
+# ---------------------------------------------------------------------------
+#
+# The [B, nh, S, D] layout above needs head-split/merge transposes around
+# every kernel call; profiled on v5e (BERT-base s512/b48) those copies +
+# their backward/recompute doubles cost ~30-45 ms/step — an order of
+# magnitude more than the kernels themselves. These kernels read q/k/v
+# exactly as the qkv projection produces them — [B, S, H] with H = nh*D
+# — and slice each head's D lanes in-kernel with STATIC offsets (a
+# static 64-lane slice lowers to plain vreg selects; measured FASTER
+# than the pre-transposed layout even before counting the removed
+# copies). Rectangular attention (S_q != S_kv, the NMT cross-attention
+# shape) falls out for free because q and k/v carry separate lengths.
+#
+# Capabilities: per-key additive bias [B, 1, S_kv] (no dbias — padding
+# masks), causal with (q_offset, k_offset), in-kernel PRNG dropout (same
+# quantized-byte scheme and seed mixing as the BHSD kernels, bh = b*nh+h,
+# so masks are reproducible across fwd/bwd). Full [.., S, S] bias and
+# dbias stay on the BHSD path.
+
+
+def _make_fwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
+                         use_prng, has_mask, has_offsets, nh, d, bq, bk):
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref = next(it)          # [1, BQ, H]
+        k_ref = next(it)          # [1, Skv, H]
+        v_ref = next(it)          # [1, Skv, H]
+        bias_ref = next(it) if has_bias else None   # [1, 1, Skv]
+        mask_ref = next(it) if has_mask else None   # [1, nh, BQ, Skv]
+        seed_ref = next(it) if use_prng else None
+        off_ref = next(it) if has_offsets else None
+        o_ref = next(it)          # [1, BQ, H]
+        lse_ref = next(it)        # [1, nh, BQ]
+
+        b = pl.program_id(0)
+        qi = pl.program_id(1)
+        skv = k_ref.shape[1]
+        nk = skv // bk
+        keep_prob = 1.0 - dropout_prob
+        keep_div = (
+            _dropout_quantized_keep(keep_prob) if use_prng else keep_prob
+        )
+        q_off = off_ref[0] if has_offsets else 0
+        k_off = off_ref[1] if has_offsets else 0
+        ident = _identity(bq)
+        hi = _hi_blocks(causal, qi, bq, bk, nk, q_off, k_off)
+
+        for h in range(nh):
+            q = q_ref[0, :, h * d:(h + 1) * d]   # [BQ, D] static lanes
+            bh = b * nh + h
+
+            def body(i, carry, h=h, q=q, bh=bh):
+                m, l, acc = carry
+                k = k_ref[0, pl.ds(i * bk, bk), h * d:(h + 1) * d]
+                v = v_ref[0, pl.ds(i * bk, bk), h * d:(h + 1) * d]
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * sm_scale
+                if has_bias:
+                    s = s + bias_ref[0, 0, pl.ds(i * bk, bk)][None, :]
+                if causal:
+                    s = _causal_mask(
+                        s, q_off + qi * bq, k_off + i * bk, bq, bk
+                    )
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m - m_new)
+                l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                p_num = p
+                if dropout_prob > 0.0:
+                    if use_prng:
+                        keep = _dropout_keep(
+                            seed_ref, bh, qi, i, keep_prob, bq, bk
+                        )
+                    else:
+                        keep = mask_ref[0, h, :, pl.ds(i * bk, bk)] != 0
+                    p_num = jnp.where(keep, p / keep_div, 0.0)
+                acc = acc * alpha + jax.lax.dot_general(
+                    p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return m_new, l, acc
+
+            m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+            l0 = jnp.zeros((bq, 1), jnp.float32)
+            acc0 = jnp.zeros((bq, d), jnp.float32)
+            m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+            l_safe = jnp.maximum(l, 1e-30)
+            o_ref[0, :, h * d:(h + 1) * d] = (acc / l_safe).astype(o_ref.dtype)
+            lse_ref[0, h:h + 1, :] = _to_lanes(m + jnp.log(l_safe), ident)
+
+    return kernel
+
+
+def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
+                   causal, dropout_prob):
+    b, sq, hdim = q.shape
+    skv = k.shape[1]
+    d = hdim // nh
+    bq = _pick_block(sq)
+    bk = _pick_block(skv)
+    use_prng = dropout_prob > 0.0 and mask is None
+    has_mask = mask is not None and dropout_prob > 0.0
+    has_offsets = offsets is not None
+    has_bias = bias is not None
+
+    in_specs = [
+        pl.BlockSpec((1, bq, hdim), lambda b_, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, skv, hdim), lambda b_, i: (b_, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, skv, hdim), lambda b_, i: (b_, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, skv), lambda b_, i: (b_, 0, 0),
+                         memory_space=pltpu.VMEM))
+        args.append(bias)
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, nh, bq, skv), lambda b_, i: (b_, 0, i, 0),
+                         memory_space=pltpu.VMEM))
+        args.append(mask)
+    if use_prng:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    if has_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(offsets)
+
+    kernel = _make_fwd_bsh_kernel(
+        sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
+        has_bias=has_bias, use_prng=use_prng, has_mask=has_mask,
+        has_offsets=has_offsets, nh=nh, d=d, bq=bq, bk=bk,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, sq // bq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, hdim), lambda b_, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nh, bq), lambda b_, i: (b_, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, hdim), q.dtype),
+            jax.ShapeDtypeStruct((b, nh, sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_BSH_VMEM_LIMIT),
+        interpret=_interpret(),
+    )(*args)
+    return o, lse
+
+
+def _make_bwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
+                         use_prng, has_mask, has_offsets, nh, d, bq, bk):
+    """Single-pass BSH backward: grid (B, NKv) with NKv innermost per
+    batch row. Computes dk/dv for this k block and accumulates dq into a
+    revisited f32 output block (index constant in ki -> stays resident;
+    zeroed at ki == 0)."""
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref = next(it)          # [1, Sq, H]
+        k_ref = next(it)          # [1, BK, H]
+        v_ref = next(it)          # [1, BK, H]
+        bias_ref = next(it) if has_bias else None   # [1, 1, Skv]
+        mask_ref = next(it) if has_mask else None   # [1, nh, Sq, BK]
+        seed_ref = next(it) if use_prng else None
+        off_ref = next(it) if has_offsets else None
+        do_ref = next(it)         # [1, Sq, H]
+        lse_ref = next(it)        # [1, nh, Sq]
+        delta_ref = next(it)      # [1, nh, Sq]
+        dq_ref = next(it)         # [1, Sq, H] f32, revisited across ki
+        dk_ref = next(it)         # [1, BK, H]
+        dv_ref = next(it)         # [1, BK, H]
+
+        b = pl.program_id(0)
+        ki = pl.program_id(1)
+        sq = q_ref.shape[1]
+        nq = sq // bq
+        keep_prob = 1.0 - dropout_prob
+        keep_div = (
+            _dropout_quantized_keep(keep_prob) if use_prng else keep_prob
+        )
+        q_off = off_ref[0] if has_offsets else 0
+        k_off = off_ref[1] if has_offsets else 0
+        ident = _identity(bq)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_ref[...] = jnp.zeros_like(dq_ref)
+
+        lo = _lo_blocks(causal, ki, bq, bk, nq, q_off, k_off)
+        for h in range(nh):
+            k = k_ref[0, :, h * d:(h + 1) * d]   # [BK, D]
+            v = v_ref[0, :, h * d:(h + 1) * d]
+            bh = b * nh + h
+            if has_bias:
+                b_block = bias_ref[0, 0, pl.ds(ki * bk, bk)]
+
+            def body(i, carry, h=h, k=k, v=v, bh=bh):
+                dk, dv = carry
+                q = q_ref[0, pl.ds(i * bq, bq), h * d:(h + 1) * d]
+                do = do_ref[0, pl.ds(i * bq, bq), h * d:(h + 1) * d]
+                lse = _to_sublanes(
+                    lse_ref[0, h:h + 1, pl.ds(i * bq, bq)], ident
+                )
+                delta = _to_sublanes(
+                    delta_ref[0, h:h + 1, pl.ds(i * bq, bq)], ident
+                )
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * sm_scale
+                if has_bias:
+                    s = s + b_block[None, :]
+                if causal:
+                    s = _causal_mask(
+                        s, q_off + i * bq, k_off + ki * bk, bq, bk
+                    )
+                p = jnp.exp(s - lse)
+                dp = jax.lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if dropout_prob > 0.0:
+                    if use_prng:
+                        keep = _dropout_keep(
+                            seed_ref, bh, i, ki, keep_prob, bq, bk
+                        )
+                    else:
+                        keep = mask_ref[0, h, pl.ds(i * bq, bq), :] != 0
+                    c = jnp.where(keep, 1.0 / keep_div, 0.0)
+                    p_num = p * c
+                else:
+                    c = 1.0
+                    p_num = p
+                dv = dv + jax.lax.dot_general(
+                    p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                ds = (p * (dp * c - delta) * sm_scale).astype(q.dtype)
+                dk = dk + jax.lax.dot_general(
+                    ds, q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                dq_ref[0, pl.ds(i * bq, bq), h * d:(h + 1) * d] += (
+                    jax.lax.dot_general(
+                        ds, k, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+                return dk, dv
+
+            dk0 = jnp.zeros((bk, d), jnp.float32)
+            dv0 = jnp.zeros((bk, d), jnp.float32)
+            dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+            dk_ref[0, :, h * d:(h + 1) * d] = dk.astype(dk_ref.dtype)
+            dv_ref[0, :, h * d:(h + 1) * d] = dv.astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
+    q, k, v, bias, mask, seed, offsets, o, lse = res
+    b, sq, hdim = q.shape
+    skv = k.shape[1]
+    d = hdim // nh
+    bq = _pick_block(sq)
+    bk = _pick_block(skv)
+    use_prng = dropout_prob > 0.0 and mask is None
+    has_mask = mask is not None and dropout_prob > 0.0
+    has_offsets = offsets is not None
+    has_bias = bias is not None
+
+    # delta[b, h, s] = sum_d o*g per head, from the BSH layout
+    delta = (
+        (o.astype(jnp.float32) * g.astype(jnp.float32))
+        .reshape(b, sq, nh, d).sum(axis=-1).transpose(0, 2, 1)
+    )
+
+    fullq = pl.BlockSpec((1, sq, hdim), lambda b_, i: (b_, 0, 0),
+                        memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, bk, hdim), lambda b_, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM)
+    statspec = pl.BlockSpec((1, nh, sq), lambda b_, i: (b_, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    args = [q, k, v]
+    in_specs = [fullq, kspec, kspec]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, skv), lambda b_, i: (b_, 0, 0),
+                         memory_space=pltpu.VMEM))
+        args.append(bias)
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, nh, sq, bk), lambda b_, i: (b_, 0, 0, i),
+                         memory_space=pltpu.VMEM))
+        args.append(mask)
+    if use_prng:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    if has_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(offsets)
+    in_specs += [fullq, statspec, statspec]
+    args += [g, lse, delta]
+
+    dq, dk, dv = pl.pallas_call(
+        _make_bwd_bsh_kernel(
+            sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
+            has_bias=has_bias, use_prng=use_prng, has_mask=has_mask,
+            has_offsets=has_offsets, nh=nh, d=d, bq=bq, bk=bk,
+        ),
+        grid=(b, skv // bk),
+        in_specs=in_specs,
+        out_specs=[fullq, kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, hdim), jnp.float32),
+            jax.ShapeDtypeStruct((b, skv, hdim), k.dtype),
+            jax.ShapeDtypeStruct((b, skv, hdim), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_BSH_VMEM_LIMIT),
+        interpret=_interpret(),
+    )(*args)
+    return dq.astype(q.dtype), dk, dv
+
+
+# the BSH kernels keep whole sequences resident (k/v in fwd, q/do/dq in
+# bwd): ~40MB at s=4096/H=768, ~102MB at s=8192 (Mosaic's scoped-vmem
+# report). v5e has 128MB of VMEM; the default ~16MB scoped limit is far
+# below what the hardware allows, so raise it for these calls. Past the
+# estimate below, dispatch falls back to the BHSD kernels (streamed
+# blocks, head-transposed layout) — and beyond single-chip HBM, shard
+# the sequence (ring attention over "sp") instead.
+_BSH_VMEM_LIMIT = 112 * 1024 * 1024
+
+
+def bsh_shapes_ok(sq, skv, h) -> bool:
+    """Will the BSH kernels' whole-sequence VMEM residency fit? The 13
+    B/elem slope + fixed blocks/temps term is calibrated against
+    Mosaic's scoped-vmem report (s8192/h768 allocates 102M)."""
+    est = 13 * max(sq, skv) * h + 24 * 1024 * 1024
+    return est <= _BSH_VMEM_LIMIT
+
+
+@functools.lru_cache(maxsize=256)
+def _make_flash_core_bsh(*, sm_scale, nh, causal, dropout_prob):
+    statics = dict(sm_scale=sm_scale, nh=nh, causal=causal,
+                   dropout_prob=dropout_prob)
+
+    @jax.custom_vjp
+    def core(q, k, v, bias, mask, seed, offsets):
+        o, _ = _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, **statics)
+        return o
+
+    def core_fwd(q, k, v, bias, mask, seed, offsets):
+        o, lse = _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, **statics)
+        o = checkpoint_name(o, "flash_o")
+        lse = checkpoint_name(lse, "flash_lse")
+        return o, (q, k, v, bias, mask, seed, offsets, o, lse)
+
+    def core_bwd(res, g):
+        dq, dk, dv = _flash_bwd_bsh(res, g, **statics)
+        dbias = jnp.zeros_like(res[3]) if res[3] is not None else None
+        return (dq, dk, dv, dbias, None, None, None)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def flash_attention_bsh(q, k, v, bias=None, num_heads=None, sm_scale=None,
+                        causal=False, dropout_prob=0.0, dropout_key=None,
+                        dropout_seed=None, mesh=None, batch_axis="dp",
+                        head_axis="tp"):
+    """Transpose-free flash attention on projection-layout tensors.
+
+    q: [B, S_q, H], k/v: [B, S_kv, H] with H = num_heads * D — exactly
+    what the qkv/kv projections produce, no head split/merge transposes.
+    S_q and S_kv may differ (cross-attention). bias: [B, 1, 1, S_kv] or
+    [B, 1, S_kv] per-key additive (padding mask; zero cotangent — use the
+    BHSD `flash_attention` for full biases or dbias). Returns [B, S_q, H].
+
+    mesh: shard batch on `batch_axis` and HEADS on `head_axis` (the H
+    lane dim splits per head groups; num_heads % tp == 0).
+    """
+    b, sq, hdim = q.shape
+    if num_heads is None:
+        raise ValueError("flash_attention_bsh needs num_heads")
+    d = hdim // num_heads
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if bias is not None:
+        bias = bias.reshape(b, 1, k.shape[1]).astype(jnp.float32)
+
+    seed = None
+    mask = None
+    if dropout_prob > 0.0:
+        if dropout_seed is not None:
+            seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+        elif dropout_key is not None:
+            seed = jax.random.randint(
+                dropout_key, (1,), 0, jnp.iinfo(jnp.int32).max,
+                dtype=jnp.int32)
+        else:
+            raise ValueError("dropout needs dropout_key or dropout_seed")
+        if _interpret():
+            mkey = dropout_key if dropout_key is not None else (
+                jax.random.PRNGKey(seed[0]))
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(mkey, 7), 1.0 - dropout_prob,
+                (b, num_heads, sq, k.shape[1]),
+            ).astype(jnp.uint8)
+
+    def local(ql, kl, vl, bl, ml, sl, nh_local):
+        core = _make_flash_core_bsh(
+            sm_scale=float(sm_scale), nh=nh_local, causal=causal,
+            dropout_prob=dropout_prob)
+        return core(ql, kl, vl, bl, ml, sl, None)
+
+    axes = [
+        ax for ax in (batch_axis, head_axis)
+        if mesh is not None and ax in mesh.axis_names and mesh.shape[ax] > 1
+    ]
+    if not axes:
+        return local(q, k, v, bias, mask, seed, num_heads)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ba = batch_axis if batch_axis in axes else None
+    ha = head_axis if head_axis in axes else None
+    nh_local = num_heads // (mesh.shape[ha] if ha else 1)
+    qspec = P(ba, None, ha)
+    bias_spec = P(ba, None, None) if bias is not None else None
+    mask_spec = P(ba, ha, None, None) if mask is not None else None
+
+    def body(ql, kl, vl, bl, ml, sl):
+        local_seed = sl
+        if sl is not None:
+            import jax.lax as lax
+
+            salt = jnp.int32(0)
+            if ba:
+                salt = salt + lax.axis_index(ba) * jnp.int32(0x632BE59B)
+            if ha:
+                salt = salt + lax.axis_index(ha) * jnp.int32(0x1B873593)
+            local_seed = sl + salt
+        return local(ql, kl, vl, bl, ml, local_seed, nh_local)
+
+    in_specs = (qspec, qspec, qspec, bias_spec, mask_spec,
+                P() if seed is not None else None)
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=qspec,
+        check_vma=False,
+    )(q, k, v, bias, mask, seed)
+
+
 def flash_shapes_ok(s, d) -> bool:
     """THE shape/backend/flag gate for every flash dispatch site (the
     attention op, the encoder stack, and the ring path all call this)."""
