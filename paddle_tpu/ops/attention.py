@@ -86,12 +86,31 @@ def fused_multihead_attention(ctx, ins, attrs):
         )
         return {"Out": [_merge_heads(out)]}
 
+    # BSH fast path: no head transposes, rectangular (cross-attention)
+    # q/kv lengths included — per-key ([B,1,1,S]) or absent bias only
+    from .pallas.flash_attention import bsh_dispatch_ok
+
+    sq, skv, h = q3.shape[1], k3.shape[1], q3.shape[2]
+    if bsh_dispatch_ok(sq, skv, h, nh, bias=bias, batch=q3.shape[0],
+                       causal=causal):
+        from .pallas.flash_attention import flash_attention_bsh
+
+        dkey = None
+        if not is_test and dropout_prob > 0.0:
+            dkey = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+        out = flash_attention_bsh(
+            q3, k3, v3, bias, num_heads=nh, causal=causal,
+            dropout_prob=0.0 if is_test else dropout_prob,
+            dropout_key=dkey, mesh=ctx.mesh,
+        )
+        return {"Out": [out]}
+
     q = _split_heads(q3, nh)
     k = _split_heads(k3, nh)
     v = _split_heads(v3, nh)
 
-    # cross-attention with square q/kv lengths rides the kernel too;
-    # rectangular lengths fall through to the jnp composition
+    # full [.., S, S] biases on square q/kv lengths ride the BHSD kernel;
+    # everything else falls through to the jnp composition
     if _use_pallas(q) and q.shape[2] == k.shape[2]:
         from .pallas.flash_attention import flash_attention
 

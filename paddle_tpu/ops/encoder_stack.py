@@ -170,15 +170,11 @@ def fused_encoder_stack(ctx, ins, attrs):
             # Extreme lengths (whole-sequence VMEM residency won't fit)
             # and full [.., S, S] biases fall back to the streamed BHSD
             # kernel path below.
-            from .pallas.flash_attention import bsh_shapes_ok
+            from .pallas.flash_attention import bsh_dispatch_ok
 
-            keybias = bias_arr is None or (
-                bias_arr.ndim == 4 and bias_arr.shape[1] == 1
-                and bias_arr.shape[2] == 1
-            )
             use_bsh = (
-                (not ring) and use_flash and _flash_ok(s, dh)
-                and keybias and bsh_shapes_ok(s, s, h)
+                (not ring) and use_flash
+                and bsh_dispatch_ok(s, s, h, nh, bias=bias_arr, batch=b)
             )
 
             def project_qkv_flat(hid_, w, bias_):
@@ -422,10 +418,13 @@ def fused_decoder_stack(ctx, ins, attrs):
     cross-attention over a loop-invariant encoder memory + FFN, post-LN):
     the NMT counterpart of fused_encoder_stack. The reference builds all
     6 decoder layers as separate op lists (dist_transformer.py); one
-    scanned body compiles once, and both attentions run the Pallas flash
-    kernel — causal masking in-kernel for self-attention, the source
-    padding mask as a per-key bias for cross-attention (square q/kv
-    lengths; rectangular falls back to XLA-fused jnp block math).
+    scanned body compiles once, and both attentions run the BSH
+    (transpose-free) Pallas flash kernel — causal masking in-kernel for
+    self-attention, and RECTANGULAR (St != Ss) cross-attention with the
+    source padding mask as a per-key bias. Under sequence parallelism
+    ("sp" mesh axis): self-attention runs the causal ring over trg
+    shards; cross-attention keeps the jnp composition on global arrays
+    so GSPMD all-gathers the src-sharded k/v (Megatron-SP strategy).
 
     Slots (stacked on dim 0 = layer): _DEC_PARAM_KEYS above; inputs
     Hidden [B,St,H], EncOut [B,Ss,H], SrcBias [B,1,1,Ss]."""
@@ -441,11 +440,14 @@ def fused_decoder_stack(ctx, ins, attrs):
     use_flash = bool(attrs.get("use_flash_attention", True))
     from ..parallel import ring_attention as ring_mod
 
-    if ring_mod.use_ring(ctx, attrs):
-        raise NotImplementedError(
-            "fused_decoder_stack has no sequence-parallel ring path yet; "
-            "set fuse_stack=False to run the per-layer decoder under sp"
-        )
+    # sequence parallelism: causal self-attention runs the ring over
+    # "sp" (trg tokens sharded; k/v blocks rotate via ppermute); the
+    # rectangular cross-attention keeps the jnp composition on GLOBAL
+    # arrays — under GSPMD the trg dim stays sp-sharded and XLA
+    # all-gathers the (src-sharded) k/v projections, the Megatron-SP
+    # strategy for attending over a full memory from a sharded query
+    ring = ring_mod.use_ring(ctx, attrs)
+    mesh = ctx.mesh
     base_key = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
     stacked = {k: ins[k][0] for k in _DEC_PARAM_KEYS}
 
@@ -481,16 +483,41 @@ def fused_decoder_stack(ctx, ins, attrs):
         probs = dropout(probs, attn_dropout_prob, key)
         return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
 
-    def attend(q, k, v, bias4, causal, key, slen):
-        if use_flash and q.shape[2] == k.shape[2] and _flash_ok(slen, dh):
-            from .pallas.flash_attention import flash_attention
+    from .pallas.flash_attention import bsh_dispatch_ok
 
-            return flash_attention(
-                q, k, v, bias4, causal=causal,
+    def attend_flat(q3, k3, v3, bias4, causal, key):
+        """q3 [B,Sq,H], k3/v3 [B,Skv,H] -> [B,Sq,H]. BSH kernel when the
+        shapes allow — rectangular (cross-attention) included, no head
+        transposes; jnp composition otherwise."""
+        sq, skv = q3.shape[1], k3.shape[1]
+        if ring and causal:
+            # trg-sharded causal self-attention over the ring
+            ctx4 = ring_mod.ring_attention_global(
+                split_heads(q3, sq), split_heads(k3, skv),
+                split_heads(v3, skv), mesh, axis="sp", causal=True,
+                batch_axis="dp",
                 dropout_prob=0.0 if is_test else attn_dropout_prob,
                 dropout_key=None if is_test else key,
             )
-        return jnp_attn(q, k, v, bias4, causal, key)
+            return merge_heads(ctx4, sq)
+        if ring:
+            # cross-attention under sp: jnp path — GSPMD gathers k/v
+            ctx4 = jnp_attn(split_heads(q3, sq), split_heads(k3, skv),
+                            split_heads(v3, skv), bias4, False, key)
+            return merge_heads(ctx4, sq)
+        if use_flash and bsh_dispatch_ok(sq, skv, h, nh, bias=bias4,
+                                         batch=b, causal=causal):
+            from .pallas.flash_attention import flash_attention_bsh
+
+            return flash_attention_bsh(
+                q3, k3, v3, bias4, num_heads=nh, causal=causal,
+                dropout_prob=0.0 if is_test else attn_dropout_prob,
+                dropout_key=None if is_test else key,
+                mesh=mesh,
+            )
+        ctx4 = jnp_attn(split_heads(q3, sq), split_heads(k3, skv),
+                        split_heads(v3, skv), bias4, causal, key)
+        return merge_heads(ctx4, sq)
 
     def layer(carry, p):
         hid, idx = carry
@@ -500,22 +527,21 @@ def fused_decoder_stack(ctx, ins, attrs):
         # --- causal self-attention
         qkv = jnp.einsum("bsh,hk->bsk", hid, p["SelfQKVW"]) + p["SelfQKVB"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        ctx_s = attend(split_heads(q, st), split_heads(k, st),
-                       split_heads(v, st), None, True, k1, st)
+        ctx_s = attend_flat(q, k, v, None, True, k1)
         self_out = jnp.einsum(
-            "bsh,hk->bsk", merge_heads(ctx_s, st), p["SelfOutW"]
+            "bsh,hk->bsk", ctx_s, p["SelfOutW"]
         ) + p["SelfOutB"]
         hid = add_ln(hid, dropout(self_out, dropout_prob, k2),
                      p["Ln1S"], p["Ln1B"])
 
-        # --- cross-attention over the encoder memory
+        # --- cross-attention over the encoder memory (rectangular: trg
+        # queries over src keys — in-kernel via the BSH layout)
         qc = jnp.einsum("bsh,hk->bsk", hid, p["CrossQW"]) + p["CrossQB"]
         kc = jnp.einsum("bsh,hk->bsk", enc_out, p["CrossKW"]) + p["CrossKB"]
         vc = jnp.einsum("bsh,hk->bsk", enc_out, p["CrossVW"]) + p["CrossVB"]
-        ctx_c = attend(split_heads(qc, st), split_heads(kc, ss),
-                       split_heads(vc, ss), src_bias, False, k3, ss)
+        ctx_c = attend_flat(qc, kc, vc, src_bias, False, k3)
         cross_out = jnp.einsum(
-            "bsh,hk->bsk", merge_heads(ctx_c, st), p["CrossOutW"]
+            "bsh,hk->bsk", ctx_c, p["CrossOutW"]
         ) + p["CrossOutB"]
         hid = add_ln(hid, dropout(cross_out, dropout_prob, k4),
                      p["Ln2S"], p["Ln2B"])

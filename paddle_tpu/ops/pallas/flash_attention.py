@@ -1605,6 +1605,32 @@ def bsh_shapes_ok(sq, skv, h) -> bool:
     return est <= _BSH_VMEM_LIMIT
 
 
+def bsh_dispatch_ok(sq, skv, h, num_heads, bias=None, batch=None,
+                    causal=False) -> bool:
+    """THE fitness test for every BSH dispatch site (the attention op and
+    both fused stacks): flag/backend/shape gates on both lengths, VMEM
+    residency, per-key-only bias actually holdable as [B, 1, S_kv], and
+    no rectangular-causal (the kernel's zero-offset causal mask is
+    top-left aligned — silently wrong when sq != skv)."""
+    d = h // num_heads
+    if not (flash_shapes_ok(sq, d) and flash_shapes_ok(skv, d)
+            and bsh_shapes_ok(sq, skv, h)):
+        return False
+    if causal and sq != skv:
+        return False
+    if bias is None:
+        return True
+    if bias.ndim == 4:
+        bb, bn, bq_, bk_ = bias.shape
+    elif bias.ndim == 3:
+        bb, bn, bk_ = bias.shape
+        bq_ = 1
+    else:
+        return False
+    return (bn == 1 and bq_ == 1 and bk_ == skv
+            and (batch is None or bb == batch))
+
+
 @functools.lru_cache(maxsize=256)
 def _make_flash_core_bsh(*, sm_scale, nh, causal, dropout_prob):
     statics = dict(sm_scale=sm_scale, nh=nh, causal=causal,
@@ -1648,6 +1674,10 @@ def flash_attention_bsh(q, k, v, bias=None, num_heads=None, sm_scale=None,
     b, sq, hdim = q.shape
     if num_heads is None:
         raise ValueError("flash_attention_bsh needs num_heads")
+    if causal and sq != k.shape[1]:
+        raise ValueError(
+            "flash_attention_bsh: causal with sq != skv would be top-left "
+            "aligned (use the BHSD kernel with offsets, or equal lengths)")
     d = hdim // num_heads
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)

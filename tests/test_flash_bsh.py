@@ -59,8 +59,15 @@ def _force_pallas():
 def test_bsh_forward(sq, skv, causal):
     from paddle_tpu.ops.pallas.flash_attention import flash_attention_bsh
 
-    if causal and sq > skv:
-        pytest.skip("causal rectangular with sq > skv is not a model shape")
+    if causal and sq != skv:
+        # rectangular causal is rejected (top-left vs bottom-right mask
+        # alignment is ambiguous) — assert the loud failure and stop
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_bsh
+
+        q, k, v = _mk(sq, skv)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention_bsh(q, k, v, num_heads=NH, causal=True)
+        return
     q, k, v = _mk(sq, skv)
     out = flash_attention_bsh(q, k, v, num_heads=NH, causal=causal)
     ref = _oracle(q, k, v, causal=causal)
