@@ -34,16 +34,16 @@ white_list = {
     "fused_encoder_stack",
     "fused_decoder_stack",
     "fc",
-    # the emitter computes statistics in f32 internally (ops/nn_ops.py),
+    # these emitters compute statistics in f32 internally (ops/nn_ops.py),
     # so bf16 in/out only halves the residual-stream bandwidth
     "layer_norm",
+    "batch_norm",
 }
 
 black_list = {
     "softmax_with_cross_entropy",
     "cross_entropy",
     "cross_entropy2",
-    "batch_norm",
     "group_norm",
     "instance_norm",
     "reduce_sum",

@@ -54,6 +54,16 @@ def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
     program._bump_version()
 
 
+# input slots AMP must NEVER down-cast on white-listed ops: running
+# statistics and affine params whose f32 state is written back each step
+# (casting Mean/Variance would quantize the moving averages to bf16
+# every step, and an is_test pass would clobber the f32 stat params)
+_KEEP_F32_SLOTS = {
+    "batch_norm": {"Mean", "Variance", "Scale", "Bias"},
+    "layer_norm": {"Scale", "Bias"},
+}
+
+
 def _cast_op_inputs(block, idx, op, want, source_kind) -> int:
     """Insert cast ops before block.ops[idx] for inputs of dtype
     source_kind; rewires op inputs. Returns #ops inserted."""
@@ -61,8 +71,11 @@ def _cast_op_inputs(block, idx, op, want, source_kind) -> int:
 
     from ...fluid import unique_name
 
+    keep = _KEEP_F32_SLOTS.get(op.type, ())
     inserted = 0
     for slot, names in list(op.inputs.items()):
+        if slot in keep and np.dtype(want) != np.dtype(np.float32):
+            continue
         new_names = []
         for n in names:
             v = block._find_var_recursive(n)

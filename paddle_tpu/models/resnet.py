@@ -2,9 +2,15 @@
 the fluid image_classification models; BASELINE.md tracks ResNet-50
 images/sec/chip).
 
-TPU notes: NCHW layout (the layers default); batch_norm stays fp32 under
-AMP (black-listed) while convs hit the MXU in bf16; the whole train step
-compiles to one XLA program like every other model here.
+TPU notes: the public API takes NCHW images (the layers default), but
+the network COMPUTES in NHWC — one transpose at the stem puts channels
+in the lane dimension, which is the layout the TPU vector unit and
+XLA's conv emitters want (channel-minor); with NCHW internals XLA
+inserts per-layer layout copies instead. batch_norm runs bf16 in/out
+under AMP with f32 statistics inside the emitter (blacklisting it made
+AMP materialize f32 copies of every activation — profiled at ~2x the
+conv time on v5e). The whole train step compiles to one XLA program
+like every other model here.
 """
 from __future__ import annotations
 
@@ -22,6 +28,8 @@ class ResNetConfig:
     # bottleneck block counts per stage (depth 50 default)
     blocks: List[int] = field(default_factory=lambda: [3, 4, 6, 3])
     base_filters: int = 64
+    # internal compute layout; "NHWC" = channel-minor (TPU-native)
+    layout: str = "NHWC"
 
     @staticmethod
     def resnet50(num_classes: int = 1000) -> "ResNetConfig":
@@ -37,51 +45,70 @@ class ResNetConfig:
         return ResNetConfig(8, num_classes, [1, 1], base_filters=8)
 
 
-def _conv_bn(x, filters, ksize, stride=1, act=None, name=""):
+def _conv_bn(x, filters, ksize, stride=1, act=None, name="", layout="NCHW"):
     conv = layers.conv2d(
         x, filters, ksize, stride=stride, padding=(ksize - 1) // 2,
         param_attr=ParamAttr(name=f"{name}.w"), bias_attr=False,
+        data_format=layout,
     )
     return layers.batch_norm(conv, act=act, param_attr=ParamAttr(name=f"{name}.bn_s"),
-                             bias_attr=ParamAttr(name=f"{name}.bn_b"))
+                             bias_attr=ParamAttr(name=f"{name}.bn_b"),
+                             data_layout=layout)
 
 
-def _bottleneck(x, filters, stride, name):
+def _channels(x, layout):
+    return x.shape[1] if layout == "NCHW" else x.shape[-1]
+
+
+def _bottleneck(x, filters, stride, name, layout):
     """1x1 -> 3x3 -> 1x1 (x4) with projection shortcut when needed."""
-    out = _conv_bn(x, filters, 1, act="relu", name=f"{name}.c1")
-    out = _conv_bn(out, filters, 3, stride=stride, act="relu", name=f"{name}.c2")
-    out = _conv_bn(out, filters * 4, 1, name=f"{name}.c3")
-    if stride != 1 or x.shape[1] != filters * 4:
-        short = _conv_bn(x, filters * 4, 1, stride=stride, name=f"{name}.proj")
+    out = _conv_bn(x, filters, 1, act="relu", name=f"{name}.c1", layout=layout)
+    out = _conv_bn(out, filters, 3, stride=stride, act="relu",
+                   name=f"{name}.c2", layout=layout)
+    out = _conv_bn(out, filters * 4, 1, name=f"{name}.c3", layout=layout)
+    if stride != 1 or _channels(x, layout) != filters * 4:
+        short = _conv_bn(x, filters * 4, 1, stride=stride,
+                         name=f"{name}.proj", layout=layout)
     else:
         short = x
     return layers.relu(layers.elementwise_add(out, short))
 
 
-def _basic_block(x, filters, stride, name):
+def _basic_block(x, filters, stride, name, layout):
     """3x3 -> 3x3 (resnet18/34)."""
-    out = _conv_bn(x, filters, 3, stride=stride, act="relu", name=f"{name}.c1")
-    out = _conv_bn(out, filters, 3, name=f"{name}.c2")
-    if stride != 1 or x.shape[1] != filters:
-        short = _conv_bn(x, filters, 1, stride=stride, name=f"{name}.proj")
+    out = _conv_bn(x, filters, 3, stride=stride, act="relu",
+                   name=f"{name}.c1", layout=layout)
+    out = _conv_bn(out, filters, 3, name=f"{name}.c2", layout=layout)
+    if stride != 1 or _channels(x, layout) != filters:
+        short = _conv_bn(x, filters, 1, stride=stride,
+                         name=f"{name}.proj", layout=layout)
     else:
         short = x
     return layers.relu(layers.elementwise_add(out, short))
 
 
 def resnet(cfg: ResNetConfig, images):
-    """images [N, 3, H, W] -> logits [N, num_classes]."""
+    """images [N, 3, H, W] -> logits [N, num_classes]. Internal compute
+    follows cfg.layout (NHWC default: one stem transpose, channel-minor
+    everywhere after)."""
     bottleneck = cfg.depth >= 50
-    x = _conv_bn(images, cfg.base_filters, 7, stride=2, act="relu", name="stem")
-    x = layers.pool2d(x, 3, pool_type="max", pool_stride=2, pool_padding=1)
+    layout = cfg.layout
+    x = images
+    if layout == "NHWC":
+        x = layers.transpose(x, [0, 2, 3, 1])
+    x = _conv_bn(x, cfg.base_filters, 7, stride=2, act="relu", name="stem",
+                 layout=layout)
+    x = layers.pool2d(x, 3, pool_type="max", pool_stride=2, pool_padding=1,
+                      data_format=layout)
     filters = cfg.base_filters
     for stage, n_blocks in enumerate(cfg.blocks):
         for b in range(n_blocks):
             stride = 2 if (stage > 0 and b == 0) else 1
             block = _bottleneck if bottleneck else _basic_block
-            x = block(x, filters, stride, name=f"s{stage}.b{b}")
+            x = block(x, filters, stride, f"s{stage}.b{b}", layout)
         filters *= 2
-    x = layers.pool2d(x, 1, pool_type="avg", global_pooling=True)
+    x = layers.pool2d(x, 1, pool_type="avg", global_pooling=True,
+                      data_format=layout)
     return layers.fc(x, cfg.num_classes, param_attr=ParamAttr(name="head.w"))
 
 

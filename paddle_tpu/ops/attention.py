@@ -87,7 +87,11 @@ def fused_multihead_attention(ctx, ins, attrs):
         return {"Out": [_merge_heads(out)]}
 
     # BSH fast path: no head transposes, rectangular (cross-attention)
-    # q/kv lengths included — per-key ([B,1,1,S]) or absent bias only
+    # q/kv lengths included — per-key ([B,1,1,S]) or absent bias only.
+    # BiasQK gets a ZERO cotangent on every kernel path of this op (the
+    # BHSD call below also defaults bias_requires_grad=False): the op's
+    # bias contract is an additive mask derived from data, not a
+    # trainable parameter.
     from .pallas.flash_attention import bsh_dispatch_ok
 
     sq, skv, h = q3.shape[1], k3.shape[1], q3.shape[2]

@@ -50,10 +50,10 @@ def _conv2d_impl(x, w, attrs):
     if df in ("NCHW", "AnyLayout"):
         dn = ("NCHW", "OIHW", "NCHW")
     else:
-        dn = ("NHWC", "HWIO", "NHWC")
-        if w.ndim == 4 and w.shape[-1] != x.shape[-1] // groups:
-            # weights always stored OIHW in paddle; convert for NHWC math
-            w = jnp.transpose(w, (2, 3, 1, 0))
+        # weights are ALWAYS stored OIHW in paddle programs — tell lax so
+        # directly instead of transposing (shape-sniffing for HWIO
+        # misfired whenever k == C_in/groups)
+        dn = ("NHWC", "OIHW", "NHWC")
     return jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
@@ -157,7 +157,7 @@ def adaptive_pool_nd(x, out_sizes, red):
 
 @register("pool2d")
 def pool2d(ctx, ins, attrs):
-    x = ins["X"][0]  # NCHW
+    x = ins["X"][0]
     ptype = attrs.get("pooling_type", "max")
     ksize = list(attrs.get("ksize", [1, 1]))
     strides = list(attrs.get("strides", ksize))
@@ -166,17 +166,25 @@ def pool2d(ctx, ins, attrs):
     adaptive = attrs.get("adaptive", False)
     exclusive = attrs.get("exclusive", True)
     algo = attrs.get("padding_algorithm", "EXPLICIT")
-    H, W = x.shape[2], x.shape[3]
+    df = attrs.get("data_format", "NCHW")
+    hax, wax = (2, 3) if df == "NCHW" else (1, 2)
+    H, W = x.shape[hax], x.shape[wax]
 
     if gp or (adaptive and ksize == [1, 1]):
         red = jnp.max if ptype == "max" else jnp.mean
-        return {"Out": [red(x, axis=(2, 3), keepdims=True)]}
+        return {"Out": [red(x, axis=(hax, wax), keepdims=True)]}
     if adaptive:
         oh, ow = ksize
         red = jnp.max if ptype == "max" else jnp.mean
         if H % oh == 0 and W % ow == 0:
-            xr = x.reshape(x.shape[0], x.shape[1], oh, H // oh, ow, W // ow)
-            return {"Out": [red(xr, axis=(3, 5))]}
+            if df == "NCHW":
+                xr = x.reshape(x.shape[0], x.shape[1], oh, H // oh, ow, W // ow)
+                return {"Out": [red(xr, axis=(3, 5))]}
+            xr = x.reshape(x.shape[0], oh, H // oh, ow, W // ow, x.shape[3])
+            return {"Out": [red(xr, axis=(2, 4))]}
+        if df != "NCHW":
+            raise NotImplementedError(
+                "adaptive pool with non-divisible bins supports NCHW only")
         return {"Out": [adaptive_pool_nd(x, (oh, ow), red)]}
 
     if algo == "SAME":
@@ -201,9 +209,14 @@ def pool2d(ctx, ins, attrs):
             (pad[0][0], pad[0][1] + extra(H, ksize[0], strides[0], pad[0])),
             (pad[1][0], pad[1][1] + extra(W, ksize[1], strides[1], pad[1])),
         ]
-    window = (1, 1, ksize[0], ksize[1])
-    strid = (1, 1, strides[0], strides[1])
-    full_pad = "SAME" if pad == "SAME" else [(0, 0), (0, 0)] + pad
+    if df == "NCHW":
+        window = (1, 1, ksize[0], ksize[1])
+        strid = (1, 1, strides[0], strides[1])
+        full_pad = "SAME" if pad == "SAME" else [(0, 0), (0, 0)] + pad
+    else:
+        window = (1, ksize[0], ksize[1], 1)
+        strid = (1, strides[0], strides[1], 1)
+        full_pad = "SAME" if pad == "SAME" else [(0, 0)] + pad + [(0, 0)]
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strid, full_pad)
@@ -237,20 +250,35 @@ def batch_norm(ctx, ins, attrs):
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     bshape = tuple(x.shape[ch_axis] if i == ch_axis else 1 for i in range(x.ndim))
 
+    # statistics ALWAYS in f32 (the layer_norm convention): the op sits
+    # on AMP's low-precision list, so bf16 in/out halves the activation
+    # bandwidth of the conv stack while the mean/variance math stays
+    # exact. (Blacklisting BN instead made AMP materialize f32 copies of
+    # every bf16 activation — profiled as the dominant ResNet-50 cost.)
+    xf = x.astype(jnp.float32)
     if use_global:
         m, v = mean, var
         mean_out, var_out = mean, var
         saved_mean = jnp.zeros_like(mean)
         saved_var = jnp.zeros_like(var)
     else:
-        m = jnp.mean(x, axis=axes)
-        v = jnp.var(x, axis=axes)
+        # one-pass moments: E[x] and E[x^2] reduce in a single fusion
+        # over one read of the activation (jnp.var's subtract-then-square
+        # form costs a second full read); f32 accumulation keeps the
+        # cancellation benign at BN's normalized ranges (cuDNN does the
+        # same)
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.maximum(jnp.mean(xf * xf, axis=axes) - m * m, 0.0)
         mean_out = momentum * mean + (1 - momentum) * m
         var_out = momentum * var + (1 - momentum) * v
         saved_mean = m
         saved_var = 1.0 / jnp.sqrt(v + eps)
     inv = 1.0 / jnp.sqrt(v + eps)
-    y = (x - m.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    y = (
+        (xf - m.reshape(bshape)) * inv.reshape(bshape)
+        * scale.astype(jnp.float32).reshape(bshape)
+        + bias.astype(jnp.float32).reshape(bshape)
+    ).astype(x.dtype)
     return {
         "Y": [y],
         "MeanOut": [mean_out],

@@ -62,8 +62,6 @@ def test_bsh_forward(sq, skv, causal):
     if causal and sq != skv:
         # rectangular causal is rejected (top-left vs bottom-right mask
         # alignment is ambiguous) — assert the loud failure and stop
-        from paddle_tpu.ops.pallas.flash_attention import flash_attention_bsh
-
         q, k, v = _mk(sq, skv)
         with pytest.raises(ValueError, match="causal"):
             flash_attention_bsh(q, k, v, num_heads=NH, causal=True)
