@@ -1,0 +1,34 @@
+"""fluid.contrib.layers (reference python/paddle/fluid/contrib/layers/
+nn.py) — the contrib op surface. Currently: tree_conv (TBCNN)."""
+from __future__ import annotations
+
+from ...fluid.layer_helper import LayerHelper
+from ...fluid.param_attr import ParamAttr
+
+__all__ = ["tree_conv"]
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution over (NodesVector [B, N, FS], EdgeSet
+    [B, E, 2]) — reference contrib/layers/nn.py tree_conv over
+    tree_conv_op.cc. Returns [B, N, output_size, num_filters]."""
+    helper = LayerHelper("tree_conv", name=name, bias_attr=bias_attr,
+                         act=act)
+    feature_size = nodes_vector.shape[2]
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[feature_size, 3, output_size, num_filters],
+        dtype="float32",
+    )
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)},
+    )
+    out = helper.append_bias_op(out, dim_start=3)
+    return helper.append_activation(out)

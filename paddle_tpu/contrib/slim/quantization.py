@@ -134,6 +134,46 @@ def convert(program):
     return program
 
 
+def _kl_scale(hist, amax, levels=128):
+    """Entropy-calibration threshold (TensorRT algorithm; reference
+    post_training_quantization.py KL path): choose the clip bin i that
+    minimizes KL(P || Q) where P = hist[:i] with outliers folded into
+    the last bin and Q = P quantized to `levels` buckets and re-expanded
+    over P's nonzero support. Returns the SCALE (clip threshold)."""
+    hist = np.asarray(hist, np.float64)
+    nbins = hist.shape[0]
+    best_i, best_kl = nbins, np.inf
+    total = hist.sum()
+    if total <= 0:
+        return float(amax)
+    for i in range(levels, nbins + 1):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()
+        psum = p.sum()
+        if psum <= 0:
+            continue
+        # quantize to `levels` buckets, expand uniformly over nonzeros
+        q = np.zeros(i, np.float64)
+        edges = np.linspace(0, i, levels + 1).astype(np.int64)
+        ref = hist[:i]
+        for b in range(levels):
+            lo, hi = edges[b], edges[b + 1]
+            if hi <= lo:
+                continue
+            nz = ref[lo:hi] > 0
+            cnt = int(nz.sum())
+            if cnt:
+                q[lo:hi][nz] = p[lo:hi].sum() / cnt
+        mask = p > 0
+        # smooth empty q cells so KL stays finite (standard eps trick)
+        qm = np.where(q[mask] > 0, q[mask], 1e-12)
+        kl = float(np.sum(p[mask] / psum * np.log(p[mask] / psum
+                                                  / (qm / q.sum()))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return float(amax) * best_i / nbins
+
+
 class PostTrainingQuantization:
     """reference post_training_quantization.PostTrainingQuantization:
     calibrate activation scales on sample data, then emit a fixed-scale
@@ -144,8 +184,10 @@ class PostTrainingQuantization:
                  activation_bits=8,
                  quantizable_op_types=QUANTIZABLE_OP_TYPES,
                  scope=None):
-        if algo != "abs_max":
-            raise NotImplementedError(f"PTQ algo {algo!r}: only abs_max")
+        if algo not in ("abs_max", "KL"):
+            raise NotImplementedError(
+                f"PTQ algo {algo!r}: supported are 'abs_max' and 'KL'")
+        self._algo = algo
         self._exe = executor
         # work on a clone: the user's float program must stay intact
         # (reference PTQ loads its own copy of the model)
@@ -186,13 +228,35 @@ class PostTrainingQuantization:
                 if is_w and n not in scales:
                     scales[n] = float(np.abs(np.asarray(scope.find_var(n))).max())
             # activation scales from calibration batches
-            for batch in self._data:
+            data = list(self._data)  # KL needs a second pass
+            for batch in data:
                 vals = self._exe.run(
                     self._program, feed=batch, fetch_list=act_names,
                 )
                 for n, v in zip(act_names, vals):
                     m = float(np.abs(np.asarray(v)).max())
                     scales[n] = max(scales.get(n, 0.0), m)
+            if self._algo == "KL":
+                # second pass: histograms over [0, abs_max], then the
+                # entropy-calibration threshold (reference
+                # post_training_quantization.py _get_kl_scaling_factor)
+                nbins = 2048
+                hists = {n: np.zeros(nbins, np.int64) for n in act_names}
+                for batch in data:
+                    vals = self._exe.run(
+                        self._program, feed=batch, fetch_list=act_names,
+                    )
+                    for n, v in zip(act_names, vals):
+                        if scales[n] <= 0.0:
+                            continue
+                        h, _ = np.histogram(
+                            np.abs(np.asarray(v)).ravel(),
+                            bins=nbins, range=(0.0, scales[n]))
+                        hists[n] += h
+                for n in act_names:
+                    if scales[n] > 0.0:
+                        scales[n] = _kl_scale(
+                            hists[n], scales[n], 2 ** (self._abits - 1))
 
         # rewrite: fixed-scale qdq before each quantizable input
         block = self._program.global_block()

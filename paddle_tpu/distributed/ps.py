@@ -57,6 +57,11 @@ class ShardedHostTable:
         self.learning_rate = float(learning_rate)
         if optimizer not in ("sgd", "adagrad"):
             raise ValueError(f"unsupported server optimizer {optimizer!r}")
+        # server-traffic accounting (tests + ops dashboards): every push
+        # RPC-equivalent bumps these, so sync-mode vs geo-mode traffic is
+        # directly comparable
+        self.push_calls = 0
+        self.pushed_bytes = 0
         rng = np.random.RandomState(seed)
         std = initializer_std if initializer_std is not None else 1.0 / np.sqrt(self.dim)
         self._shards: List[np.ndarray] = []
@@ -91,6 +96,29 @@ class ShardedHostTable:
                     out[m] = self._shards[s][local[m]]
         return out
 
+    def push_delta(self, ids, deltas) -> None:
+        """Geo-SGD server half (reference GeoCommunicator,
+        operators/distributed/communicator.h:396): trainers push
+        accumulated parameter DELTAS every K steps; the server just adds
+        them (no server-side optimizer — the trainer already applied
+        its own). Repeated ids accumulate."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        deltas = np.asarray(deltas, np.float32).reshape(ids.shape[0], self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.shape[0], self.dim), np.float32)
+        np.add.at(acc, inv, deltas)
+        shard, local = self._locate(uniq)
+        self.push_calls += 1
+        self.pushed_bytes += int(deltas.nbytes + ids.nbytes)
+        for s in range(self.num_shards):
+            m = shard == s
+            if not m.any():
+                continue
+            with self._locks[s]:
+                self._shards[s][local[m]] = (
+                    self._shards[s][local[m]].astype(np.float32) + acc[m]
+                ).astype(self.dtype)
+
     def push_gradients(self, ids, grads) -> None:
         """Apply the server-side optimizer for the touched rows. Repeated
         ids in one batch are accumulated first (SelectedRows merge-add
@@ -101,6 +129,9 @@ class ShardedHostTable:
         acc = np.zeros((uniq.shape[0], self.dim), np.float32)
         np.add.at(acc, inv, grads)
         shard, local = self._locate(uniq)
+        # count only validated pushes (push_delta counts after _locate too)
+        self.push_calls += 1
+        self.pushed_bytes += int(grads.nbytes + ids.nbytes)
         lr = self.learning_rate
         for s in range(self.num_shards):
             m = shard == s
@@ -147,11 +178,122 @@ class ShardedHostTable:
         self.learning_rate = float(state.get("learning_rate", self.learning_rate))
 
 
-def create_table(name, shape, **kw) -> ShardedHostTable:
+class GeoSGDClient:
+    """Geo-SGD trainer half (reference geo_sgd_transpiler.py + the
+    GeoCommunicator send thread): the trainer optimizes a LOCAL copy of
+    the touched rows every step and pushes accumulated parameter deltas
+    (cur - at_last_sync, scaled 1/num_trainers) to the server every
+    `sync_steps` steps — K× less server traffic than per-step gradient
+    push, at the cost of staleness bounded by K.
+
+    API-compatible with ShardedHostTable for the lookup op (gather /
+    push_gradients), so `mode="geo"` is transparent to programs. Rows
+    are cached lazily: only touched rows live trainer-side."""
+
+    def __init__(self, server: ShardedHostTable, sync_steps: int = 100,
+                 num_trainers: int = 1):
+        self.server = server
+        self.name = server.name
+        self.dim = server.dim
+        self.rows = server.rows
+        self.dtype = server.dtype
+        self.sync_steps = int(sync_steps)
+        self.num_trainers = int(num_trainers)
+        self._local: Dict[int, np.ndarray] = {}
+        self._old: Dict[int, np.ndarray] = {}
+        self._touched: set = set()
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def _ensure_rows(self, uniq):
+        missing = [r for r in uniq if r not in self._local]
+        if missing:
+            pulled = self.server.gather(np.asarray(missing, np.int64))
+            for r, row in zip(missing, pulled):
+                self._local[r] = row.astype(np.float32).copy()
+                self._old[r] = row.astype(np.float32).copy()
+
+    def gather(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._lock:
+            self._ensure_rows(np.unique(ids).tolist())
+            return np.stack([self._local[int(r)] for r in ids]).astype(
+                self.dtype)
+
+    def push_gradients(self, ids, grads) -> None:
+        """LOCAL optimizer step on the touched rows; every sync_steps
+        pushes, the accumulated deltas go to the server."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.shape[0], self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        lr = self.server.learning_rate
+        with self._lock:
+            self._ensure_rows(uniq.tolist())
+            for r, g in zip(uniq.tolist(), acc):
+                self._local[r] = self._local[r] - lr * g
+                self._touched.add(r)
+            self._step += 1
+            if self._step % self.sync_steps == 0:
+                self._sync_locked()
+
+    def _sync_locked(self):
+        if not self._touched:
+            return
+        rows = np.asarray(sorted(self._touched), np.int64)
+        delta = np.stack([
+            (self._local[int(r)] - self._old[int(r)]) / self.num_trainers
+            for r in rows
+        ])
+        self.server.push_delta(rows, delta)
+        fresh = self.server.gather(rows)
+        for r, row in zip(rows.tolist(), fresh):
+            self._local[r] = row.astype(np.float32).copy()
+            self._old[r] = self._local[r].copy()
+        self._touched.clear()
+
+    def flush(self) -> None:
+        """Push any pending deltas now (checkpoint / shutdown barrier)."""
+        with self._lock:
+            self._sync_locked()
+
+    # -- delegation so geo tables checkpoint like plain ones --------------
+    def nbytes(self) -> int:
+        return self.server.nbytes()
+
+    def to_dense(self) -> np.ndarray:
+        self.flush()
+        return self.server.to_dense()
+
+    def state_dict(self):
+        self.flush()
+        return self.server.state_dict()
+
+    def load_state_dict(self, state):
+        with self._lock:
+            self._local.clear()
+            self._old.clear()
+            self._touched.clear()
+        self.server.load_state_dict(state)
+
+
+def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
+                 num_trainers: int = 1, **kw):
+    """mode: "sync"/"async" — per-step gradient push, server-side
+    optimizer (Downpour); "geo" — local optimizer + K-step delta push
+    (Geo-SGD, reference geo_sgd_transpiler.py)."""
     with _lock:
         if name in _tables:
             raise ValueError(f"table {name!r} already exists")
         t = ShardedHostTable(name, shape, **kw)
+        if mode == "geo":
+            if t.optimizer != "sgd":
+                raise ValueError(
+                    "geo mode applies SGD trainer-side (reference "
+                    "geo_sgd_transpiler.py restriction); use optimizer='sgd'")
+            t = GeoSGDClient(t, sync_steps=geo_sync_steps,
+                             num_trainers=num_trainers)
         _tables[name] = t
         return t
 

@@ -149,25 +149,99 @@ def _worker_loop(dataset, index_q, result_q, collate_fn, worker_init_fn, wid):
         pass
 
 
-class _MultiprocessIter:
-    """Order-preserving fan-out over fork()ed workers.
+def _spawn_safe(dataset, collate_fn, worker_init_fn) -> bool:
+    """Spawn requires the worker args to pickle (fork inherits them) AND
+    to be importable from the child: objects whose class/function lives
+    in __main__ pickle fine by reference but a spawned child re-executes
+    the main script to resolve them (bootstrap errors without a
+    __main__ guard; unresolvable in REPLs/notebooks) — keep fork for
+    those. The pickle probe writes to a null sink (no byte copy of
+    large in-memory datasets)."""
+    import io
+    import pickle
 
-    Keeps at most `prefetch` index-batches outstanding per worker; results
-    arrive in completion order and are buffered until their turn, so the
-    output sequence is identical to single-process iteration.
+    for obj in (dataset, collate_fn, worker_init_fn):
+        if obj is None:
+            continue
+        mod = getattr(type(obj), "__module__", None)
+        if callable(obj) and not isinstance(obj, type):
+            mod = getattr(obj, "__module__", mod)
+        if mod == "__main__":
+            return False
+
+    class _Null(io.RawIOBase):
+        def write(self, b):
+            return len(b)
+
+    try:
+        pickle.Pickler(_Null()).dump((dataset, collate_fn, worker_init_fn))
+        return True
+    except Exception:  # noqa: BLE001 — any pickling failure means fork
+        return False
+
+
+class _child_env:
+    """Environment for worker start(): spawned children re-run the
+    interpreter, re-importing this package and therefore jax — force the
+    CPU backend and drop accelerator-tunnel vars so a DATA worker never
+    claims the TPU (single-chip hosts deadlock otherwise)."""
+
+    _SCRUB = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None,
+              "PALLAS_AXON_REMOTE_COMPILE": None}
+
+    def __enter__(self):
+        import os
+
+        self._saved = {k: os.environ.get(k) for k in self._SCRUB}
+        for k, v in self._SCRUB.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def __exit__(self, *exc):
+        import os
+
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class _MultiprocessIter:
+    """Order-preserving fan-out over worker processes.
+
+    Default start method is SPAWN when the dataset/collate/init pickle
+    (fresh interpreters — os.fork() under the multithreaded JAX runtime
+    can deadlock a child on a lock some backend thread held at fork
+    time), falling back to fork with a warning for closure-captured
+    datasets. Keeps at most `prefetch` index-batches outstanding per
+    worker; results arrive in completion order and are buffered until
+    their turn, so the output sequence is identical to single-process
+    iteration.
     """
 
     def __init__(self, dataset, batches, collate_fn, num_workers,
                  worker_init_fn, timeout, prefetch=2, mp_context=None):
         import multiprocessing as mp
 
-        # fork (default) inherits closures/datasets without pickling, the
-        # same trade-off as the reference's and torch's Linux loaders; it
-        # is unsafe if a forked child allocates while a backend thread
-        # holds the malloc lock — pass multiprocessing_context="spawn" to
-        # DataLoader for picklable datasets if children ever deadlock
-        if mp_context is None or isinstance(mp_context, str):
-            ctx = mp.get_context(mp_context or "fork")
+        if mp_context is None:
+            if _spawn_safe(dataset, collate_fn, worker_init_fn):
+                mp_context = "spawn"
+            else:
+                import warnings
+
+                warnings.warn(
+                    "DataLoader: dataset/collate_fn/worker_init_fn are not "
+                    "picklable; falling back to fork() workers, which can "
+                    "deadlock under the multithreaded JAX runtime — make "
+                    "them module-level (picklable) to use spawn",
+                    RuntimeWarning, stacklevel=3,
+                )
+                mp_context = "fork"
+        if isinstance(mp_context, str):
+            ctx = mp.get_context(mp_context)
         else:
             ctx = mp_context
         self._batches = batches
@@ -183,8 +257,9 @@ class _MultiprocessIter:
             )
             for w in range(num_workers)
         ]
-        for w in self._workers:
-            w.start()
+        with _child_env():
+            for w in self._workers:
+                w.start()
         self._send = enumerate(batches)
         self._pending = {}
         self._next = 0

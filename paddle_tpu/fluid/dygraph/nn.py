@@ -593,11 +593,37 @@ class RowConv(Layer):
 
 
 class TreeConv(Layer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "TreeConv (tree_conv_op.cc) operates on ragged tree adjacency "
-            "structures; no dense lowering is provided"
-        )
+    """Tree-based convolution (reference dygraph/nn.py TreeConv over
+    tree_conv_op.cc): patch structure from EdgeSet host-side, learnable
+    einsum on device (ops/misc_ops.py tree_conv)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters],
+            attr=ParamAttr._to_attr(param_attr))
+        # sibling-layer convention: None -> default bias, False -> none
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, nodes_vector, edge_set):
+        out = _trace_op(
+            "tree_conv",
+            {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+             "Filter": [self.weight]},
+            {"max_depth": self._max_depth}, ["Out"])[0]
+        if self.bias is not None:
+            out = _trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                            {"axis": 3}, ["Out"])[0]
+        if self._act:
+            out = _trace_op(self._act, {"X": [out]}, {}, ["Out"])[0]
+        return out
 
 
 class Sequential(Layer):

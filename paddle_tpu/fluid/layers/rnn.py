@@ -25,7 +25,7 @@ from . import tensor as _tensor
 from .control_flow import StaticRNN
 
 __all__ = [
-    "RNNCell", "GRUCell", "LSTMCell", "rnn", "birnn_unsupported",
+    "RNNCell", "GRUCell", "LSTMCell", "rnn", "birnn", "birnn_unsupported",
     "Decoder", "BasicDecoder", "DecodeHelper", "TrainingHelper",
     "GreedyEmbeddingHelper", "SampleEmbeddingHelper", "BeamSearchDecoder",
     "dynamic_decode", "beam_search_decode", "lstm_unit", "gru_unit",
@@ -188,8 +188,25 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
     return outputs, srnn.final_states
 
 
-def birnn_unsupported(*a, **k):  # pragma: no cover
-    raise NotImplementedError("use rnn(is_reverse=True) + concat")
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional rnn (reference rnn.py birnn): run cell_fw forward
+    and cell_bw reverse-scanned over the same inputs, concat outputs on
+    the feature dim. Returns (outputs, (fw_final, bw_final))."""
+    states_fw = states_bw = None
+    if initial_states is not None:
+        states_fw, states_bw = initial_states
+    out_fw, fin_fw = rnn(cell_fw, inputs, states_fw,
+                         sequence_length=sequence_length,
+                         time_major=time_major, **kwargs)
+    out_bw, fin_bw = rnn(cell_bw, inputs, states_bw,
+                         sequence_length=sequence_length,
+                         time_major=time_major, is_reverse=True, **kwargs)
+    outputs = _tensor.concat([out_fw, out_bw], axis=2)
+    return outputs, (fin_fw, fin_bw)
+
+
+birnn_unsupported = birnn  # legacy alias (pre-round-4 name)
 
 
 # ---------------------------------------------------------------------------
@@ -536,31 +553,50 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
 def lstm(input, init_h, init_c, max_len, hidden_size, num_layers=1,
          dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
          default_initializer=None, seed=-1):
-    """Multi-layer LSTM over [B, T, D] (reference nn.py lstm / cudnn_lstm).
-    init_h/init_c: [num_layers, B, H]."""
-    if is_bidirec:
-        raise NotImplementedError("lstm: bidirectional not yet supported")
+    """Multi-layer LSTM over [B, T, D] (reference nn.py lstm /
+    cudnn_lstm_op.cc, including `is_bidirec`). init_h/init_c:
+    [num_layers * num_directions, B, H], direction-major per layer like
+    cuDNN (layer0-fw, layer0-bw, layer1-fw, ...). Bidirectional layers
+    run a reverse-scanned second cell and concat the two direction
+    outputs on the feature dim — the cuDNN kernel's semantics expressed
+    as two lax scans."""
+    ndir = 2 if is_bidirec else 1
+
+    def state0(buf, idx):
+        return _nn.reshape(
+            _nn.slice(buf, axes=[0], starts=[idx], ends=[idx + 1]),
+            [buf.shape[1], hidden_size])
+
     out = input
     last_h, last_c = [], []
     for layer in range(num_layers):
-        h0 = _nn.reshape(
-            _nn.slice(init_h, axes=[0], starts=[layer], ends=[layer + 1]),
-            [init_h.shape[1], hidden_size])
-        c0 = _nn.reshape(
-            _nn.slice(init_c, axes=[0], starts=[layer], ends=[layer + 1]),
-            [init_c.shape[1], hidden_size])
-        cell = LSTMCell(hidden_size, name=f"{name or 'lstm'}_l{layer}")
-        out, (h, c) = _rnn_with_final(cell, out, [h0, c0])
+        base = f"{name or 'lstm'}_l{layer}"
+        cell_f = LSTMCell(hidden_size,
+                          name=base if ndir == 1 else f"{base}_fw")
+        h0 = state0(init_h, ndir * layer)
+        c0 = state0(init_c, ndir * layer)
+        out_f, (h, c) = _rnn_with_final(cell_f, out, [h0, c0])
         last_h.append(h)
         last_c.append(c)
+        if is_bidirec:
+            cell_b = LSTMCell(hidden_size, name=f"{base}_bw")
+            h0b = state0(init_h, ndir * layer + 1)
+            c0b = state0(init_c, ndir * layer + 1)
+            out_b, (hb, cb) = _rnn_with_final(
+                cell_b, out, [h0b, c0b], is_reverse=True)
+            last_h.append(hb)
+            last_c.append(cb)
+            out = _tensor.concat([out_f, out_b], axis=2)
+        else:
+            out = out_f
         if dropout_prob > 0.0 and not is_test and layer < num_layers - 1:
             out = _nn.dropout(out, dropout_prob)
     return out, _nn.stack(last_h, axis=0), _nn.stack(last_c, axis=0)
 
 
-def _rnn_with_final(cell, inputs, states):
+def _rnn_with_final(cell, inputs, states, is_reverse=False):
     """rnn() now surfaces the true final (h, c) states."""
-    outputs, final = rnn(cell, inputs, states)
+    outputs, final = rnn(cell, inputs, states, is_reverse=is_reverse)
     return outputs, (final[0], final[1])
 
 

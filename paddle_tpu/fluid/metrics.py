@@ -112,10 +112,9 @@ class Auc(MetricBase):
 
     def __init__(self, name=None, curve="ROC", num_thresholds=4095):
         super().__init__(name)
-        if curve != "ROC":
-            raise NotImplementedError(
-                f"Auc curve {curve!r}: only ROC is implemented"
-            )
+        if curve not in ("ROC", "PR"):
+            raise ValueError(f"Auc curve {curve!r}: use 'ROC' or 'PR'")
+        self.curve = curve
         self.num_thresholds = num_thresholds
         self.reset()
 
@@ -136,6 +135,19 @@ class Auc(MetricBase):
         pos = np.cumsum(self._stat_pos[::-1])
         neg = np.cumsum(self._stat_neg[::-1])
         tot_pos, tot_neg = pos[-1], neg[-1]
+        if self.curve == "PR":
+            if tot_pos == 0:
+                return 0.0
+            tp = pos.astype(np.float64)
+            fp = neg.astype(np.float64)
+            # no predictions above threshold -> precision is vacuous (1):
+            # emitting 0 there would poison the trapezoid at recall 0
+            prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1.0), 1.0)
+            rec = tp / tot_pos
+            p_pts = np.concatenate([[1.0], prec])
+            r_pts = np.concatenate([[0.0], rec])
+            return float(np.sum(
+                (r_pts[1:] - r_pts[:-1]) * (p_pts[1:] + p_pts[:-1]) / 2.0))
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
         x = np.concatenate([[0], neg])

@@ -21,6 +21,19 @@ import numpy as np
 
 from . import framework
 
+
+def _generator_producer(q, reader):
+    """Child body for GeneratorLoader.use_multiprocess (module-level so
+    spawn can pickle it by reference)."""
+    try:
+        for batch in reader():
+            q.put([np.asarray(a) for a in batch])
+        q.put(None)
+    except Exception as e:  # noqa: BLE001 — shipped to parent
+        q.put(("__error__", repr(e)))
+    except KeyboardInterrupt:
+        pass
+
 _END = object()
 
 
@@ -129,25 +142,33 @@ class GeneratorLoader:
             stop.set()
 
     def _iter_multiprocess(self):
-        """One fork()ed producer streaming batches over an mp queue."""
+        """One off-process producer streaming batches over an mp queue.
+        Spawn when the reader pickles (fork under the multithreaded JAX
+        runtime risks child deadlock); fork otherwise."""
         import multiprocessing as mp
 
-        ctx = mp.get_context("fork")
+        from .dataloader import _child_env, _spawn_safe
+
+        if _spawn_safe(self._batch_reader, None, None):
+            method = "spawn"
+        else:
+            import warnings
+
+            warnings.warn(
+                "GeneratorLoader: the batch reader is not picklable; "
+                "falling back to fork() for the producer process, which "
+                "can deadlock under the multithreaded JAX runtime — use a "
+                "module-level reader function to enable spawn",
+                RuntimeWarning, stacklevel=3,
+            )
+            method = "fork"
+        ctx = mp.get_context(method)
         q = ctx.Queue(maxsize=self._capacity)
-        reader = self._batch_reader
 
-        def producer():
-            try:
-                for batch in reader():
-                    q.put([np.asarray(a) for a in batch])
-                q.put(None)
-            except Exception as e:  # noqa: BLE001 — shipped to parent
-                q.put(("__error__", repr(e)))
-            except KeyboardInterrupt:
-                pass
-
-        p = ctx.Process(target=producer, daemon=True)
-        p.start()
+        p = ctx.Process(target=_generator_producer,
+                        args=(q, self._batch_reader), daemon=True)
+        with _child_env():
+            p.start()
         try:
             while True:
                 try:

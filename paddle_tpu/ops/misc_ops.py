@@ -342,3 +342,72 @@ def precision_recall(ctx, ins, attrs):
     accum = six(new_states[:, 0], new_states[:, 1], new_states[:, 3])
     return {"BatchMetrics": [batch], "AccumMetrics": [accum],
             "AccumStatesInfo": [new_states]}
+
+
+def _tree_conv_coeffs(edges, n, max_depth):
+    """Host-side tree2col coefficients (reference operators/math/
+    tree2col.cc behavior, contract pinned by test_tree_conv_op.py's
+    naive oracle): C[b, u, v, k] = eta_k of node v in node u's patch
+    (nodes within `max_depth` hops, coefficients from depth and sibling
+    position). Integer tree structure only — no gradients flow here."""
+    import numpy as np
+
+    edges = np.asarray(edges)
+    b = edges.shape[0]
+    out = np.zeros((b, n, n, 3), np.float32)
+    for bi in range(b):
+        children = [[] for _ in range(n + 2)]
+        for p, c in edges[bi].tolist():
+            if p >= 1:
+                children[int(p)].append(int(c))
+
+        for u in range(1, n + 1):
+            # (node, idx-among-siblings, n-siblings, depth)
+            stack = [(u, 1, 1, 0)]
+            entries = []
+            while stack:
+                node, idx, l, depth = stack.pop()
+                entries.append((node, idx, l, depth))
+                if depth + 1 < max_depth:
+                    ch = children[node]
+                    for i, c in enumerate(ch, 1):
+                        stack.append((c, i, len(ch), depth + 1))
+            for node, idx, l, depth in entries:
+                eta_t = float(max_depth - depth) / float(max_depth)
+                eta_l = (1.0 - eta_t) * (
+                    0.5 if l == 1 else float(idx - 1) / float(l - 1))
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                out[bi, u - 1, node - 1, 0] += eta_l
+                out[bi, u - 1, node - 1, 1] += eta_r
+                out[bi, u - 1, node - 1, 2] += eta_t
+    return out
+
+
+@register("tree_conv")
+def tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (TBCNN; reference tree_conv_op.cc): the
+    data-dependent patch structure is built HOST-side from the integer
+    EdgeSet (stop-gradient), and the learnable math is one einsum —
+    fully differentiable wrt NodesVector and Filter on device."""
+    nodes = ins["NodesVector"][0]          # [B, N, FS]
+    edges = ins["EdgeSet"][0]              # [B, E, 2] int
+    w = ins["Filter"][0]                   # [FS, 3, OUT, NF]
+    max_depth = int(attrs.get("max_depth", 2))
+    bsz, n, _fs = nodes.shape
+
+    if jax.default_backend() == "axon":
+        raise NotImplementedError(
+            "tree_conv builds patches via host callbacks, which the axon "
+            "dev tunnel does not support; run on a real TPU host or the "
+            "CPU backend")
+    import functools as _ft
+
+    coeffs = jax.pure_callback(
+        _ft.partial(_tree_conv_coeffs, n=n, max_depth=max_depth),
+        jax.ShapeDtypeStruct((bsz, n, n, 3), jnp.float32),
+        edges,
+    )
+    coeffs = jax.lax.stop_gradient(coeffs)
+    out = jnp.einsum("buvk,bvi,ikof->buof", coeffs,
+                     nodes.astype(jnp.float32), w.astype(jnp.float32))
+    return {"Out": [out.astype(nodes.dtype)]}

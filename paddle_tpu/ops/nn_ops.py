@@ -672,11 +672,31 @@ def auc(ctx, ins, attrs):
     is_pos = (label > 0).astype(stat_pos.dtype)
     stat_pos = stat_pos.at[idx].add(is_pos)
     stat_neg = stat_neg.at[idx].add(1 - is_pos)
-    # integrate high->low threshold: x = FPR-ish cum neg, y = cum pos
+    # integrate high->low threshold
     pos_rev = jnp.cumsum(stat_pos[::-1])
     neg_rev = jnp.cumsum(stat_neg[::-1])
     tot_pos = pos_rev[-1]
     tot_neg = neg_rev[-1]
+    if str(attrs.get("curve", "ROC")) == "PR":
+        # precision/recall points from the same buckets: TP = cum pos
+        # from the high-threshold end, FP = cum neg; start at the
+        # conventional (recall 0, precision 1) anchor
+        tp = pos_rev.astype(jnp.float32)
+        fp = neg_rev.astype(jnp.float32)
+        # vacuous precision (no predictions above threshold) counts as 1
+        prec = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1.0), 1.0)
+        rec = tp / jnp.maximum(tot_pos.astype(jnp.float32), 1.0)
+        p_pts = jnp.concatenate([jnp.ones(1, jnp.float32), prec])
+        r_pts = jnp.concatenate([jnp.zeros(1, jnp.float32), rec])
+        area = jnp.sum(
+            (r_pts[1:] - r_pts[:-1]) * (p_pts[1:] + p_pts[:-1]) / 2.0
+        )
+        out = jnp.where(tot_pos > 0, area, 0.0)
+        return {
+            "AUC": [out.reshape(1)],
+            "StatPosOut": [stat_pos.reshape(ins["StatPos"][0].shape)],
+            "StatNegOut": [stat_neg.reshape(ins["StatNeg"][0].shape)],
+        }
     x = jnp.concatenate([jnp.zeros(1, neg_rev.dtype), neg_rev])
     y = jnp.concatenate([jnp.zeros(1, pos_rev.dtype), pos_rev])
     area = jnp.sum(

@@ -267,6 +267,18 @@ def test_dataloader_map_style_single_process():
     np.testing.assert_allclose(batches[1][1].ravel(), [16, 25, 36, 49])
 
 
+class _BadDataset:
+    """Module-level (picklable -> spawn) dataset whose item 5 raises."""
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return (float(i),)
+
+    def __len__(self):
+        return 8
+
+
 def test_dataloader_workers_match_inline_order():
     """num_workers=2 must yield the byte-identical batch sequence as
     num_workers=0 (submission order restored by _MultiprocessIter)."""
@@ -305,20 +317,35 @@ def test_dataloader_shuffle_deterministic_and_complete():
 def test_dataloader_worker_exception_propagates():
     import pytest
 
-    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.io import DataLoader
 
-    class Bad(Dataset):
-        def __getitem__(self, i):
-            if i == 5:
-                raise ValueError("boom at 5")
-            return (float(i),)
-
-        def __len__(self):
-            return 8
-
-    loader = DataLoader(Bad(), batch_size=2, return_list=True, num_workers=2)
+    loader = DataLoader(_BadDataset(), batch_size=2, return_list=True,
+                        num_workers=2)
     with pytest.raises(RuntimeError, match="worker failed"):
         list(loader)
+
+
+def test_dataloader_unpicklable_falls_back_to_fork():
+    """Closure-captured datasets can't spawn; the loader must warn and
+    fall back to fork() workers (still correct, just riskier)."""
+    import numpy as np
+    import pytest
+
+    from paddle_tpu.io import DataLoader, Dataset
+
+    secret = [2.0]
+
+    class Closure(Dataset):  # local class + closure -> unpicklable
+        def __getitem__(self, i):
+            return (np.float32(i * secret[0]),)
+
+        def __len__(self):
+            return 6
+
+    with pytest.warns(RuntimeWarning, match="not picklable"):
+        batches = list(DataLoader(Closure(), batch_size=3, return_list=True,
+                                  num_workers=2))
+    np.testing.assert_allclose(batches[0][0], [0.0, 2.0, 4.0])
 
 
 def test_dataloader_iterable_dataset():

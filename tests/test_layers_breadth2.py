@@ -283,3 +283,56 @@ def test_img_conv_group_validates_list_lengths():
         with pytest.raises(ValueError, match="conv_num_filter"):
             fluid.nets.img_conv_group(img, conv_num_filter=[4, 4, 4],
                                       pool_size=2, conv_padding=[1, 1])
+
+
+def test_auc_pr_curve_metric_and_op():
+    """PR-curve AUC (reference metrics/auc_op.cc curve attr): oracle =
+    average-precision-style trapezoid on exact precision/recall points;
+    the bucketed metric and op must land close, and a perfect ranking
+    must give area ~1."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.metrics import Auc
+
+    rng = np.random.RandomState(0)
+    n = 2000
+    labels = (rng.rand(n) > 0.6).astype(np.int64)
+    # informative but noisy scores
+    scores = np.clip(0.4 * labels + 0.4 * rng.rand(n), 0.0, 1.0)
+
+    def oracle_pr(scores_, labels_):
+        order = np.argsort(-scores_, kind="stable")
+        tp = np.cumsum(labels_[order])
+        fp = np.cumsum(1 - labels_[order])
+        prec = tp / np.maximum(tp + fp, 1)
+        rec = tp / max(labels_.sum(), 1)
+        p = np.concatenate([[1.0], prec])
+        r = np.concatenate([[0.0], rec])
+        return float(np.sum((r[1:] - r[:-1]) * (p[1:] + p[:-1]) / 2))
+
+    ref = oracle_pr(scores, labels)
+
+    m = Auc(curve="PR")
+    m.update(scores, labels)
+    assert abs(m.eval() - ref) < 0.01, (m.eval(), ref)
+
+    # perfect separation -> area ~= 1
+    m2 = Auc(curve="PR")
+    m2.update(labels.astype(np.float64) * 0.9 + 0.05, labels)
+    assert m2.eval() > 0.99
+
+    # the op agrees with the metric
+    preds = np.stack([1 - scores, scores], axis=1).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p_in = fluid.data("p", [n, 2], "float32")
+        y_in = fluid.data("y", [n, 1], "int64")
+        auc_out, _ = layers.auc(p_in, y_in, curve="PR")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={"p": preds, "y": labels[:, None]},
+                       fetch_list=[auc_out])
+    assert abs(float(np.asarray(v).reshape(())) - ref) < 0.01

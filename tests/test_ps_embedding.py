@@ -167,3 +167,71 @@ def test_out_of_range_ids_raise(table):
     with pytest.raises(IndexError, match="out of range"):
         table.push_gradients(np.asarray([10_000], np.int64),
                              np.ones((1, 16), np.float32))
+
+
+def test_geo_sgd_converges_and_saves_traffic():
+    """Geo mode (reference geo_sgd_transpiler.py / GeoCommunicator):
+    K-step parameter-delta push must converge on the embedding task
+    while sending ~1/K the server pushes of per-step sync mode."""
+    K, STEPS, N, DIM = 5, 30, 200, 8
+    rng = np.random.RandomState(3)
+    target = rng.randn(N, DIM).astype(np.float32)
+
+    def train(mode):
+        name = f"geo_cmp_{mode}"
+        ps.drop_table(name)
+        t = ps.create_table(name, shape=(N, DIM), num_shards=2,
+                            optimizer="sgd", learning_rate=0.5,
+                            mode=mode, geo_sync_steps=K, seed=1)
+        server = t.server if mode == "geo" else t
+        losses = []
+        for step in range(STEPS):
+            ids = rng.randint(0, N, (32,)).astype(np.int64)
+            rows = t.gather(ids).astype(np.float32)
+            # L2 regression toward the target rows: grad = (w - target)
+            g = rows - target[ids]
+            losses.append(float(np.mean(g * g)))
+            t.push_gradients(ids, g)
+        if mode == "geo":
+            t.flush()
+        final = t.to_dense()
+        err = float(np.mean((final - target) ** 2))
+        ps.drop_table(name)
+        return losses, err, server.push_calls
+
+    l_sync, err_sync, calls_sync = train("sync")
+    l_geo, err_geo, calls_geo = train("geo")
+    # both converge (loss shrinks by >5x; final error small)
+    assert l_sync[-1] < l_sync[0] / 5
+    assert l_geo[-1] < l_geo[0] / 5
+    assert err_geo < 0.1
+    # geo pushes ~1/K as often (+1 for the final flush)
+    assert calls_sync == STEPS
+    assert calls_geo <= STEPS // K + 1
+
+
+def test_geo_sgd_matches_local_sgd_between_syncs():
+    """Between syncs the geo client is EXACTLY local SGD; after a sync
+    the server holds the accumulated delta."""
+    ps.drop_table("geo_exact")
+    t = ps.create_table("geo_exact", shape=(50, 4), num_shards=2,
+                        optimizer="sgd", learning_rate=0.1,
+                        mode="geo", geo_sync_steps=3, seed=2)
+    ids = np.asarray([7, 7, 11], np.int64)
+    w0 = t.gather(np.asarray([7, 11], np.int64)).astype(np.float32)
+    server_before = t.server.to_dense()[[7, 11]].copy()
+    g = np.ones((3, 4), np.float32)
+    t.push_gradients(ids, g)  # local: w7 -= 0.1*2, w11 -= 0.1*1
+    got = t.gather(np.asarray([7, 11], np.int64)).astype(np.float32)
+    np.testing.assert_allclose(got[0], w0[0] - 0.2, rtol=1e-6)
+    np.testing.assert_allclose(got[1], w0[1] - 0.1, rtol=1e-6)
+    # server unchanged until the K-th step
+    np.testing.assert_allclose(t.server.to_dense()[[7, 11]], server_before,
+                               rtol=1e-6)
+    t.push_gradients(ids, g)
+    t.push_gradients(ids, g)  # 3rd push -> sync fires
+    np.testing.assert_allclose(
+        t.server.to_dense()[7], w0[0] - 3 * 0.2, rtol=1e-5)
+    np.testing.assert_allclose(
+        t.server.to_dense()[11], w0[1] - 3 * 0.1, rtol=1e-5)
+    ps.drop_table("geo_exact")

@@ -136,3 +136,61 @@ def test_ste_gradient_is_identity():
         xa = np.random.RandomState(2).randn(3, 4).astype(np.float32)
         (g,) = exe.run(main, feed={"xin": xa}, fetch_list=["xin@GRAD"])
     np.testing.assert_array_equal(np.asarray(g), np.ones((3, 4), np.float32))
+
+
+def test_kl_scale_clips_outliers():
+    """The entropy threshold must land well below a lone outlier while
+    abs_max calibration would keep the full (wasteful) range."""
+    from paddle_tpu.contrib.slim.quantization import _kl_scale
+
+    hist = np.zeros(2048, np.int64)
+    hist[:200] = 1000          # bulk of the distribution in [0, ~10%]
+    hist[2047] = 1             # one outlier at the max
+    scale = _kl_scale(hist, amax=100.0, levels=128)
+    assert scale < 30.0, scale           # clipped far below the outlier
+    assert scale >= 100.0 * 128 / 2048   # but >= the minimum window
+    # degenerate histogram -> fall back to abs_max
+    assert _kl_scale(np.zeros(2048, np.int64), 7.0) == 7.0
+
+
+def test_ptq_kl_runs_and_beats_absmax_on_outliers(tmp_path):
+    """End-to-end KL PTQ: with an outlier-heavy calibration input, the
+    KL scales are tighter than abs_max and the quantized model is at
+    least as accurate on the bulk distribution."""
+    main, startup, x, y, pred, loss = _build()
+    rng = np.random.RandomState(3)
+    xa = rng.rand(16, 8).astype(np.float32)
+
+    def calib():
+        out = []
+        for i in range(4):
+            b = rng.rand(16, 8).astype(np.float32)
+            b[0, 0] = 50.0  # rare outlier blows up abs_max calibration
+            out.append({"x": b, "y": np.zeros((16, 1), np.float32)})
+        return out
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (float_out,) = exe.run(
+            main, feed={"x": xa, "y": np.zeros((16, 1), np.float32)},
+            fetch_list=[pred])
+        float_out = np.asarray(float_out).copy()
+
+        def quantize(algo):
+            ptq = PostTrainingQuantization(
+                exe, main, ["x"], [pred], calib(), algo=algo)
+            prog = ptq.quantize()
+            (q_out,) = exe.run(
+                prog, feed={"x": xa, "y": np.zeros((16, 1), np.float32)},
+                fetch_list=[pred])
+            return np.asarray(q_out), ptq._scales
+
+        kl_out, kl_scales = quantize("KL")
+        am_out, am_scales = quantize("abs_max")
+        # KL clips the activation range below abs_max somewhere
+        act_keys = [k for k in kl_scales if k in am_scales]
+        assert any(kl_scales[k] < am_scales[k] * 0.9 for k in act_keys)
+        err_kl = np.abs(kl_out - float_out).max()
+        err_am = np.abs(am_out - float_out).max()
+        assert err_kl <= err_am * 1.05, (err_kl, err_am)

@@ -243,3 +243,67 @@ def test_rnn_returns_true_final_states():
     # final c is a genuinely different tensor from final h
     assert not np.allclose(np.asarray(fc), fh)
     assert not np.allclose(np.asarray(lc), np.asarray(lh))
+
+
+def test_bidirectional_lstm_matches_manual_composition():
+    """lstm(is_bidirec=True) == rnn(cell_fw) ++ rnn(cell_bw, reverse)
+    when the cells share parameter names (same vars in one program), and
+    the reverse half really scans back-to-front (numpy check)."""
+    B, T, D, H = 3, 5, 4, 6
+    rng = np.random.RandomState(7)
+    x_np = rng.randn(B, T, D).astype(np.float32)
+    h0_np = rng.randn(2, B, H).astype(np.float32)
+    c0_np = rng.randn(2, B, H).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [B, T, D], "float32")
+        h0 = fluid.data("h0", [2, B, H], "float32")
+        c0 = fluid.data("c0", [2, B, H], "float32")
+        out, last_h, last_c = layers.lstm(
+            x, h0, c0, max_len=T, hidden_size=H, num_layers=1,
+            is_bidirec=True, name="bi")
+        # manual composition sharing the SAME parameter names
+        cell_fw = layers.LSTMCell(H, name="bi_l0_fw")
+        cell_bw = layers.LSTMCell(H, name="bi_l0_bw")
+
+        def st(buf, i):
+            return layers.reshape(
+                layers.slice(buf, axes=[0], starts=[i], ends=[i + 1]), [B, H])
+
+        out2, (fin_fw, fin_bw) = layers.birnn(
+            cell_fw, cell_bw, x,
+            initial_states=([st(h0, 0), st(c0, 0)], [st(h0, 1), st(c0, 1)]))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        feed = {"x": x_np, "h0": h0_np, "c0": c0_np}
+        o1, o2, lh, lc, ffw0, fbw0 = exe.run(
+            main, feed=feed,
+            fetch_list=[out, out2, last_h, last_c, fin_fw[0], fin_bw[0]])
+    assert o1.shape == (B, T, 2 * H)
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+    # cuDNN state layout: [ndir*layer + dir, B, H]
+    np.testing.assert_allclose(lh[0], ffw0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(lh[1], fbw0, rtol=1e-5, atol=1e-6)
+    # reverse-scan alignment oracle: with the SAME cell (shared param
+    # name), rnn(is_reverse=True) on x must equal flip(rnn(flip(x))) —
+    # i.e. outputs are re-aligned to input positions (cuDNN semantics)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.data("x", [B, T, D], "float32")
+        xr = fluid.data("xr", [B, T, D], "float32")
+        h2 = fluid.data("h2", [B, H], "float32")
+        c2 = fluid.data("c2", [B, H], "float32")
+        cell_a = layers.LSTMCell(H, name="shared")
+        cell_b = layers.LSTMCell(H, name="shared")
+        o_rev, _ = layers.rnn(cell_a, x2, [h2, c2], is_reverse=True)
+        o_fwd_on_rev, _ = layers.rnn(cell_b, xr, [h2, c2])
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe2 = fluid.Executor()
+        exe2.run(startup2)
+        a, b = exe2.run(main2, feed={
+            "x": x_np, "xr": x_np[:, ::-1].copy(),
+            "h2": h0_np[0], "c2": c0_np[0],
+        }, fetch_list=[o_rev, o_fwd_on_rev])
+    np.testing.assert_allclose(a, b[:, ::-1], rtol=1e-5, atol=1e-6)

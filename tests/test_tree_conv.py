@@ -1,0 +1,112 @@
+"""tree_conv op/layer vs the reference naive oracle
+(/root/reference/.../test_tree_conv_op.py collect_node_patch math)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.contrib.layers import tree_conv
+from paddle_tpu.fluid import layers
+
+
+def _naive(vectors, edges, W, max_depth):
+    """Reference test's get_output_naive, verbatim math."""
+    bsz, n, fs = vectors.shape
+    Wt = np.transpose(W, (1, 0, 2, 3))  # [3, fs, out, nf]
+    out = np.zeros((bsz, n, W.shape[2], W.shape[3]))
+    for b in range(bsz):
+        og = [[] for _ in range(n + 2)]
+        for p, c in edges[b].tolist():
+            og[p].append(c)
+
+        def gen(node):
+            collected = [(node, 1, 1, 0)]
+
+            def rec(nd, depth):
+                if depth > max_depth:
+                    return
+                l = len(og[nd])
+                for idx, c in enumerate(og[nd], 1):
+                    if depth + 1 < max_depth:
+                        collected.append((c, idx, l, depth + 1))
+                        rec(c, depth + 1)
+
+            rec(node, 0)
+            return collected
+
+        for u in range(1, n + 1):
+            res = np.zeros((W.shape[2], W.shape[3]))
+            for node, idx, l, depth in gen(u):
+                eta_t = float(max_depth - depth) / max_depth
+                eta_l = (1.0 - eta_t) * (0.5 if l == 1
+                                         else float(idx - 1) / (l - 1))
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                eta = np.array([eta_l, eta_r, eta_t]).reshape(3, 1)
+                Wconvi = np.tensordot(eta, Wt, axes=([0], [0]))[0]
+                res = res + np.tensordot(vectors[b, node - 1], Wconvi,
+                                         axes=([0], [0]))
+            out[b, u - 1] = res
+    return out
+
+
+_ADJ = np.array([1, 2, 1, 3, 1, 4, 1, 5, 2, 6, 2, 7, 2, 8, 4, 9, 4, 10,
+                 5, 11, 6, 12, 6, 13, 9, 14, 9, 15, 9, 16, 9, 17])
+
+
+def test_tree_conv_matches_reference_oracle():
+    n, fs, out_sz, nf, md, bsz = 17, 3, 2, 2, 2, 2
+    rng = np.random.RandomState(0)
+    vectors = rng.rand(bsz, n, fs).astype(np.float32)
+    edges = np.tile(_ADJ.reshape(1, n - 1, 2), (bsz, 1, 1)).astype(np.int32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nv = fluid.data("nv", [bsz, n, fs], "float32")
+        es = fluid.data("es", [bsz, n - 1, 2], "int32")
+        o = tree_conv(nv, es, out_sz, num_filters=nf, max_depth=md, act=None)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        w_name = [v.name for v in main.list_vars()
+                  if v.persistable and "tree_conv" in v.name][0]
+        W = np.asarray(fluid.global_scope().find_var(w_name))
+        (got,) = exe.run(main, feed={"nv": vectors, "es": edges},
+                         fetch_list=[o])
+    ref = _naive(vectors, edges, W, md)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tree_conv_trains():
+    """Gradients flow to NodesVector-producing params and the Filter."""
+    n, fs = 17, 4
+    rng = np.random.RandomState(1)
+    edges = _ADJ.reshape(1, n - 1, 2).astype(np.int32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nv = fluid.data("nv", [1, n, fs], "float32")
+        es = fluid.data("es", [1, n - 1, 2], "int32")
+        h = layers.fc(nv, fs, num_flatten_dims=2)
+        o = tree_conv(h, es, 3, num_filters=2, max_depth=2, act="tanh",
+                      bias_attr=fluid.ParamAttr(name="tc_bias"))
+        loss = layers.reduce_mean(layers.square(o))
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        feed = {"nv": rng.rand(1, n, fs).astype(np.float32), "es": edges}
+        vals = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0]).reshape(()))
+                for _ in range(10)]
+    assert vals[-1] < vals[0]
+
+
+def test_tree_conv_dygraph_layer():
+    from paddle_tpu.fluid import dygraph
+
+    n, fs = 17, 3
+    rng = np.random.RandomState(2)
+    with dygraph.guard():
+        tc = dygraph.nn.TreeConv(fs, 4, num_filters=2, max_depth=2)
+        nv = dygraph.to_variable(rng.rand(1, n, fs).astype(np.float32))
+        es = dygraph.to_variable(_ADJ.reshape(1, n - 1, 2).astype(np.int32))
+        out = tc(nv, es)
+        assert tuple(out.shape) == (1, n, 4, 2)
+        assert np.isfinite(np.asarray(out.numpy())).all()
