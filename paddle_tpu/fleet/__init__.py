@@ -183,10 +183,14 @@ class DistributedOptimizer:
 
         if dcn >= 2:
             # multi-slice: the executor runs the step MANUALLY sharded
-            # over (dcn, dp) so per-shard gradients are visible, and a
-            # c_dcn_grad_sync op per parameter does the two-level
-            # reduction (dense over ICI, dense-or-DGC over DCN)
-            inner = _DCNGradSyncOptimizer(inner, strategy)
+            # over (dcn, dp) so per-shard gradients are visible. Either
+            # a c_dcn_grad_sync op per parameter does the two-level
+            # reduction (dense over ICI, dense-or-DGC over DCN), or
+            # LocalSGD keeps per-slice weights with k-step consensus
+            if strategy.localsgd:
+                inner = _DCNLocalSGDOptimizer(inner, strategy)
+            else:
+                inner = _DCNGradSyncOptimizer(inner, strategy)
 
         result = inner.minimize(
             loss, startup_program=startup_program,
@@ -317,6 +321,100 @@ class _DCNGradSyncOptimizer:
         return getattr(self.inner_opt, item)
 
 
+class _DCNLocalSGDOptimizer:
+    """LocalSGD across the slow DCN axis (reference
+    transpiler/collective.py:270 LocalSGD transpile +
+    DistributedStrategy.localsgd_configs): gradients pmean only INSIDE
+    the slice (fast ICI, intra_only c_dcn_grad_sync); the inner
+    optimizer then updates PER-SLICE divergent parameters — stored
+    [n_dcn, *shape] sharded over "dcn", squeezed to the local view by
+    the executor — and every k_steps a c_dcn_localsgd_sync op averages
+    the parameters over "dcn". Optimizer accumulators (momentum/Adam
+    moments) follow their per-slice gradients, so they get the same
+    divergent storage."""
+
+    def __init__(self, inner, strategy):
+        self.inner_opt = inner
+        self._strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..fluid import framework, unique_name
+        from ..fluid.optimizer import _create_persistable_var
+
+        strategy = self._strategy
+        n_dcn = int(strategy.hybrid_dcn)
+        k_steps = max(
+            1, int((strategy.localsgd_configs or {}).get("k_steps", 1)))
+        params_grads = self.inner_opt.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        block = program.global_block()
+        synced = []
+        for p, g in params_grads:
+            if g is None:
+                synced.append((p, g))
+                continue
+            out_name = unique_name.generate(g.name + "@DPSync")
+            block.append_op(
+                type="c_dcn_grad_sync",
+                inputs={"X": [g]},
+                outputs={"Out": [out_name]},
+                attrs={"intra_only": True, "dcn_axis": "dcn"},
+            )
+            synced.append((p, block.var(out_name)))
+        opt_ops = self.inner_opt.apply_optimize(loss, startup_program, synced)
+
+        # replicated in-graph step counter, incremented AFTER the sync
+        # ops: step i reads value i, so `i % k == k-1` fires the first
+        # consensus after exactly k local updates
+        step_var = _create_persistable_var(
+            unique_name.generate("localsgd_step"), [1], "float32", 0.0)
+        divergent = set(getattr(program, "_dcn_divergent_names", ()))
+        for p, g in params_grads:
+            if g is None:
+                continue
+            block.append_op(
+                type="c_dcn_localsgd_sync",
+                inputs={"X": [p], "Step": [step_var]},
+                outputs={"Out": [p]},
+                attrs={"k_steps": k_steps, "dcn_axis": "dcn"},
+            )
+            divergent.add(p.name)
+            _parallel.set_var_sharding(
+                p, ("dcn",) + (None,) * len(tuple(p.shape)))
+        block.append_op(
+            type="scale", inputs={"X": [step_var]},
+            outputs={"Out": [step_var]}, attrs={"scale": 1.0, "bias": 1.0},
+        )
+        # accumulators diverge with their slice's gradients
+        for slot in getattr(self.inner_opt, "_accumulators", {}).values():
+            for acc_var in slot.values():
+                divergent.add(acc_var.name)
+                _parallel.set_var_sharding(
+                    acc_var, ("dcn",) + (None,) * len(tuple(acc_var.shape)))
+        program._dcn_divergent_names = divergent
+
+        # startup: expand every divergent var's storage to [n_dcn, *shape]
+        sp = startup_program or framework.default_startup_program()
+        sblock = sp.global_block()
+        for name in sorted(divergent):
+            if sblock.has_var(name):
+                sv = sblock.var(name)
+                sblock.append_op(
+                    type="dcn_expand_param",
+                    inputs={"X": [sv]},
+                    outputs={"Out": [sv]},
+                    attrs={"n_dcn": n_dcn,
+                           "param_rank": len(tuple(sv.shape))},
+                )
+        return opt_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
 def _reject_unsupported(strategy):
     """No silently ignored strategy field: every accepted-but-unimplemented
     flag raises with the reason (VERDICT round-1 weak #4)."""
@@ -345,13 +443,22 @@ def _reject_unsupported(strategy):
                     f"parallelism only for now; unset strategy.{name}"
                 )
     if strategy.localsgd:
-        raise NotImplementedError(
-            "strategy.localsgd: GSPMD keeps parameters replicated, so "
-            "per-worker divergent weights (transpiler/collective.py:270) "
-            "cannot exist in the static executor; use "
-            "fluid.dygraph.parallel.LocalSGD on the eager multi-process "
-            "path, or gradient_merge for fewer optimizer steps"
-        )
+        if int(strategy.hybrid_dcn or 0) < 2:
+            raise NotImplementedError(
+                "strategy.localsgd: single-slice GSPMD keeps parameters "
+                "replicated, and over fast ICI the dense all-reduce is "
+                "near roofline — LocalSGD's infrequent-sync regime is the "
+                "slow DCN axis: set strategy.hybrid_dcn to the slice "
+                "count (per-slice divergent weights, k-step consensus). "
+                "The eager multi-process path has "
+                "fluid.dygraph.parallel.LocalSGD."
+            )
+        if strategy.dgc:
+            raise NotImplementedError(
+                "strategy.localsgd + strategy.dgc: pick ONE dcn-axis sync "
+                "model — k-step parameter averaging (localsgd) or "
+                "per-step compressed gradients (dgc)"
+            )
     if strategy.elastic:
         raise NotImplementedError(
             "strategy.elastic: a dead flag in the reference too "
@@ -402,9 +509,9 @@ def apply_sequence_parallel(program, mesh):
         for op in block.ops:
             if op.type in ("fused_multihead_attention", "fused_encoder_stack",
                            "fused_decoder_stack"):
-                # the decoder stack has no ring path yet: its emitter
-                # RAISES on this attr rather than silently computing
-                # sp-local attention (use fuse_stack=False with sp)
+                # decoder stack under sp: causal self-attention rides the
+                # ring over trg shards, cross-attention k/v is gathered
+                # by GSPMD (ops/encoder_stack.py fused_decoder_stack)
                 op._set_attr("sequence_parallel", True)
 
 

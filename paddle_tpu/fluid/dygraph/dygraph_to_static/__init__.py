@@ -80,15 +80,35 @@ def convert_ifelse(pred, true_fn, false_fn, args):
             # branch); what each branch RETURNS must be real tensors, or
             # cond cannot match the true/false structures
             def run():
+                import numbers
+
+                from ...layers import tensor as _tensor
+
                 out = list(fn(*args))
-                if any(o is _UNDEF for o in out):
-                    raise ConversionError(
-                        "tensor-condition `if`: every name assigned in "
-                        "one branch must be assigned in the other (or "
-                        "defined before the `if`) — cond needs matching "
-                        "true/false structures"
-                    )
-                return out
+                lifted = []
+                for o in out:
+                    if o is _UNDEF:
+                        raise ConversionError(
+                            "tensor-condition `if`: every name assigned "
+                            "in one branch must be assigned in the other "
+                            "(or defined before the `if`) — cond needs "
+                            "matching true/false structures"
+                        )
+                    if not _is_static_var(o):
+                        # python-number carried values lift to constant
+                        # tensors, matching convert_while (ADVICE r3)
+                        if not isinstance(o, numbers.Number):
+                            raise ConversionError(
+                                "tensor-condition `if`: branch-carried "
+                                "values must be tensors or numbers, got "
+                                f"{type(o).__name__}"
+                            )
+                        o = _tensor.fill_constant(
+                            [1], "int32" if isinstance(o, int) else "float32",
+                            o,
+                        )
+                    lifted.append(o)
+                return lifted
 
             return run
 
@@ -287,7 +307,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 return n
 
         call = Sub().visit(call)
-        return [t_fn, f_fn, call]
+        # python-bool path: a name assigned in only one branch comes back
+        # as the _UNDEF sentinel — unbind it so later reads raise the
+        # normal UnboundLocalError instead of leaking the sentinel into
+        # identity checks / repr / pass-through (ADVICE r3). The tensor
+        # path never returns _UNDEF (convert_ifelse raises first).
+        cleanup = [
+            self._stmt(f"if {n} is _jst_UNDEF:\n    del {n}")
+            for n in carried
+        ]
+        return [t_fn, f_fn, call] + cleanup
 
     def visit_While(self, node):
         self.generic_visit(node)
@@ -414,6 +443,7 @@ def ast_to_static(fn: Callable) -> Callable:
         "_jst_if": convert_ifelse,
         "_jst_while": convert_while,
         "_jst_get": _jst_get,
+        "_jst_UNDEF": _UNDEF,
         "_jst_eq": _jst_eq,
         "_jst_ne": _jst_ne,
     }

@@ -365,6 +365,11 @@ class Executor:
             repl_p = PartitionSpec()
             axis_sizes = [mesh.shape[a] for a in manual_axes]
 
+            # LocalSGD-style per-slice divergent state: stored/sharded as
+            # [n_dcn, *shape] over "dcn", but ops consume the plain
+            # [*shape] local view — squeeze on entry, restore on exit
+            divergent = set(getattr(program, "_dcn_divergent_names", ()))
+
             def local_fn(feed_vals, donated_vals, kept_vals, rng_key):
                 import jax.lax as lax
                 import jax.numpy as jnp
@@ -384,7 +389,13 @@ class Executor:
                 env.update(kept_vals)
                 env.update(donated_vals)
                 env.update(feed_vals)
+                for n in divergent:
+                    if n in env:
+                        env[n] = jnp.squeeze(env[n], axis=0)
                 registry.emit_ops(ctx, ops, env)
+                for n in divergent:
+                    if n in env:
+                        env[n] = env[n][None]
 
                 state_set = (
                     set(donate_names) | set(keep_names) | set(state_out)
@@ -402,7 +413,17 @@ class Executor:
                     if xa.ndim == 0 or xa.size == 1:
                         if jnp.issubdtype(xa.dtype, jnp.floating):
                             return lax.pmean(x, manual_axes)
-                        return x
+                        # ADVICE r3: an integer scalar is ambiguous here
+                        # (per-shard count -> psum, replicated value ->
+                        # identity); silently returning one shard's value
+                        # was wrong either way — make the caller choose
+                        raise TypeError(
+                            f"manual-mesh fetch {n!r} is a non-float "
+                            f"scalar: per-shard integer metrics have no "
+                            f"canonical global reduction — cast it to "
+                            f"float32 in-program (mean semantics) or sum "
+                            f"counts in-program before fetching"
+                        )
                     return lax.all_gather(x, manual_axes, axis=0, tiled=True)
 
                 fetches = [_sync(n, env[n]) for n in fetch_names]

@@ -152,6 +152,12 @@ def c_dcn_grad_sync(ctx, ins, attrs):
         return outs
     if inner:
         g = lax.pmean(g, inner)
+    if attrs.get("intra_only", False):
+        # LocalSGD regime: gradients sync only WITHIN the slice (fast
+        # ICI); parameters diverge per slice and are averaged over the
+        # slow DCN axis every k steps by c_dcn_localsgd_sync
+        outs["Out"] = [g]
+        return outs
     if not attrs.get("use_dgc", False):
         outs["Out"] = [lax.pmean(g, dcn_axis)]
         if "ErrorFeedback" in ins:
@@ -185,3 +191,49 @@ def c_dcn_grad_sync(ctx, ins, attrs):
     outs["Out"] = [out.astype(ins["X"][0].dtype)]
     outs["ErrorFeedback"] = [e_new[None].astype(e3.dtype)]
     return outs
+
+
+@register("dcn_expand_param", no_vjp_grad=True)
+def dcn_expand_param(ctx, ins, attrs):
+    """Startup-time LocalSGD storage expansion: tile an initialized
+    parameter to [n_dcn, *shape] so the training program can shard it
+    over "dcn" (per-slice divergent weights — reference LocalSGD,
+    transpiler/collective.py:270, keeps per-worker weights the same
+    way). Idempotent: an already-expanded value passes through."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    n = int(attrs["n_dcn"])
+    rank = int(attrs["param_rank"])
+    if x.ndim == rank + 1 and x.shape[0] == n:
+        return {"Out": [x]}
+    return {"Out": [jnp.tile(x[None], (n,) + (1,) * x.ndim)]}
+
+
+@register("c_dcn_localsgd_sync", no_vjp_grad=True)
+def c_dcn_localsgd_sync(ctx, ins, attrs):
+    """LocalSGD consensus step (reference transpiler/collective.py:270
+    LocalSGD transpile + DistributedStrategy localsgd_configs): every
+    `k_steps` optimizer steps, average the per-slice divergent
+    parameters over the slow "dcn" axis; other steps pass through. The
+    replicated in-graph Step counter makes every slice take the same
+    branch, so the collective inside lax.cond is safe."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    p = ins["X"][0]
+    manual = getattr(ctx, "manual_axes", None) or ()
+    dcn_axis = attrs.get("dcn_axis", "dcn")
+    if dcn_axis not in manual:
+        return {"Out": [p]}
+    k = max(1, int(attrs.get("k_steps", 1)))
+    step = ins["Step"][0].reshape(()).astype(jnp.int32)
+    do_sync = (step % k) == (k - 1)
+    out = jax.lax.cond(
+        do_sync,
+        lambda x: lax.pmean(x, dcn_axis),
+        lambda x: x,
+        p,
+    )
+    return {"Out": [out]}

@@ -210,15 +210,28 @@ def fused_encoder_stack(ctx, ins, attrs):
                 )  # [B, S, H] — already merged
             elif ring:
                 # sequence-parallel ring attention over "sp"; probs dropout
-                # runs inside the ring. shard_map inside the scan body is
-                # fine — XLA sees one ring schedule per layer iteration
+                # runs inside the ring. Outside a manual region the ring
+                # wraps itself in shard_map (one ring schedule per layer
+                # iteration); under GPipe (manual=True) we are ALREADY
+                # inside the pipeline's shard_map, where every mesh axis
+                # is bound — call the per-shard ring body directly (the
+                # pp x sp composition: microbatches flow over "pp" while
+                # each stage's attention rotates k/v over "sp")
                 q, k, v = project_qkv(hid, p["QKVW"], p["QKVB"])
                 key_bias = ring_mod.key_bias_from_attn_bias(bias_arr, b)
-                ctx_l = ring_mod.ring_attention_global(
-                    q, k, v, mesh, axis="sp", bias=key_bias, batch_axis="dp",
-                    dropout_prob=0.0 if is_test else attn_dropout_prob,
-                    dropout_key=None if is_test else k1,
-                )
+                if manual:
+                    ctx_l = ring_mod.ring_attention(
+                        q, k, v, "sp", bias=key_bias,
+                        dropout_prob=0.0 if is_test else attn_dropout_prob,
+                        dropout_key=None if is_test else k1,
+                    )
+                else:
+                    ctx_l = ring_mod.ring_attention_global(
+                        q, k, v, mesh, axis="sp", bias=key_bias,
+                        batch_axis="dp",
+                        dropout_prob=0.0 if is_test else attn_dropout_prob,
+                        dropout_key=None if is_test else k1,
+                    )
                 ctx_l = ctx_l.transpose(0, 2, 1, 3).reshape(b, s, h)
             elif use_flash and _flash_ok(s, dh):
                 # streamed BHSD kernel: serves the shapes BSH can't hold
@@ -300,13 +313,9 @@ def fused_encoder_stack(ctx, ins, attrs):
         return {"Out": [out]}
 
     if _use_gpipe(ctx, attrs):
-        if ring:
-            raise NotImplementedError(
-                "pipeline + sequence_parallel on one encoder stack is not "
-                "supported yet; use pp with dp/tp"
-            )
         M = int(attrs.get("num_microbatches", 0)) or mesh.shape["pp"]
-        out = _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer)
+        out = _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer,
+                           ring=ring)
         return {"Out": [out]}
 
     layer = make_layer(bias)
@@ -314,18 +323,25 @@ def fused_encoder_stack(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer):
+def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer, ring=False):
     """GPipe schedule over the "pp" axis. Stage s owns layers
     [s*L/pp, (s+1)*L/pp); microbatch m enters stage 0 at tick m and leaves
     stage pp-1 at tick m+pp-1. Activations rotate via ppermute; the
     attention bias is replicated over pp, so each stage just indexes the
-    microbatch it is currently processing (m = t - s) — no transfer."""
+    microbatch it is currently processing (m = t - s) — no transfer.
+    ring=True additionally shards the SEQUENCE dim over "sp" (hidden and
+    the per-key bias); the layer body then runs ring attention inside
+    this shard_map (pp x sp composition for long-context pipelines)."""
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
 
     npp = mesh.shape["pp"]
     dp = "dp" if "dp" in mesh.axis_names else None
     dp_size = mesh.shape[dp] if dp else 1
+    sp = (
+        "sp" if ring and "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+        else None
+    )
     L = stacked["QKVW"].shape[0]
     if L % npp != 0:
         raise ValueError(f"num layers {L} must divide by pp={npp}")
@@ -337,8 +353,8 @@ def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer):
         )
 
     keys = list(_PARAM_KEYS)
-    hid_spec = P(dp, None, None)
-    bias_spec = P(dp, None, None, None)
+    hid_spec = P(dp, sp, None)
+    bias_spec = P(dp, None, None, sp)
     p_specs = tuple(P("pp") for _ in keys)
     perm = [(i, i + 1) for i in range(npp - 1)]
 

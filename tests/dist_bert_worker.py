@@ -46,7 +46,17 @@ def build_and_train(steps=8):
     )
     with fluid.program_guard(m, st):
         strategy = fleet.DistributedStrategy()
-        strategy.mesh_axes = {"dp": -1}  # all 8 global devices
+        mesh_spec = os.environ.get("PADDLE_DIST_MESH", "dp8")
+        if mesh_spec == "dp4tp2":
+            # cross-process SHARDED collectives: the tp axis spans ranks
+            # (megatron column/row-parallel rules), not just dp psum
+            from paddle_tpu.models.bert import tensor_parallel_rules
+
+            strategy.mesh_axes = {"dp": 4, "tp": 2}
+            strategy.tensor_parallel = True
+            strategy.tensor_parallel_rules = tensor_parallel_rules()
+        else:
+            strategy.mesh_axes = {"dp": -1}  # all 8 global devices
         fleet.init()
         opt = fleet.distributed_optimizer(
             fluid.optimizer.AdamOptimizer(1e-3), strategy

@@ -199,3 +199,62 @@ def test_tensor_equality_rewrites_to_equal_op():
         x = np.ones((2,), np.float32)
         out = _val(f(dygraph.to_variable(x)))
     np.testing.assert_allclose(out, x + 5.0)
+
+
+def test_tensor_if_lifts_python_number_outputs():
+    """ADVICE r3: a branch assigning a plain Python number under a
+    TENSOR `if` must lift it to a constant tensor (convert_while
+    parity), not crash inside layers.cond."""
+
+    @to_static
+    def f(x):
+        s = layers.reduce_sum(x)
+        if s > 0:
+            y = 1
+        else:
+            y = 2
+        return x * y
+
+    with dygraph.guard():
+        pos = np.ones((2, 2), np.float32)
+        neg = -np.ones((2, 2), np.float32)
+        np.testing.assert_allclose(np.asarray(f(pos).numpy()),
+                                   np.ones((2, 2)), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(f(neg).numpy()),
+                                   -2 * np.ones((2, 2)), rtol=1e-6)
+
+
+def test_python_if_unbinds_branch_local_names():
+    """ADVICE r3: on the PYTHON-bool path a name assigned only in the
+    untaken branch must be unbound after the `if` (UnboundLocalError on
+    read), not silently bound to the _UNDEF sentinel. Exercised on the
+    rewritten function directly (plain python values, no tracing)."""
+    from paddle_tpu.fluid.dygraph.dygraph_to_static import ast_to_static
+
+    def f(x, flag):
+        if flag:
+            extra = x * 2
+        out = x + 1
+        if flag:
+            out = out + extra
+        return out, (extra is None if flag else None)
+
+    rf = ast_to_static(f)
+    out, chk = rf(np.ones((2,), np.float32), True)
+    np.testing.assert_allclose(out, [4.0, 4.0])
+    assert chk is False  # identity check saw the real array, no sentinel
+
+    out2, chk2 = rf(np.ones((2,), np.float32), False)
+    np.testing.assert_allclose(out2, [2.0, 2.0])
+    assert chk2 is None
+
+    def g(x, flag):
+        if flag:
+            extra = x * 2
+        return extra  # unbound when flag is False
+
+    rg = ast_to_static(g)
+    np.testing.assert_allclose(rg(np.ones((2,), np.float32), True),
+                               [2.0, 2.0])
+    with pytest.raises((UnboundLocalError, NameError)):
+        rg(np.ones((2,), np.float32), False)

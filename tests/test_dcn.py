@@ -207,3 +207,143 @@ def test_dcn_mismatched_mesh_raises():
         )
         with pytest.raises(ValueError, match="dcn"):
             opt.minimize(loss)
+
+
+# ---------------------------------------------------------------------------
+# LocalSGD across the DCN axis (reference transpiler/collective.py:270)
+# ---------------------------------------------------------------------------
+
+
+def _build_linear(seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [16, 8], "float32")
+        y = fluid.data("y", [16, 1], "float32")
+        pred = layers.fc(x, 1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="lsgd_w"))
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def _train_localsgd(k_steps, steps=6, lr=0.1):
+    main, startup, loss = _build_linear()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_dcn = 2
+            strategy.localsgd = True
+            strategy.localsgd_configs = {"k_steps": k_steps}
+            fleet.init()
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.SGDOptimizer(learning_rate=lr), strategy)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for i in range(steps):
+            (lv,) = exe.run(main, feed=_feed(i), fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        w_final = np.asarray(scope.find_var("lsgd_w"))
+    return losses, w_final
+
+
+def test_localsgd_matches_numpy_oracle():
+    """Hand-rolled LocalSGD trace: per-slice SGD on each slice's half of
+    the batch, parameter consensus (mean over slices) every k steps —
+    the in-graph c_dcn_localsgd_sync path must reproduce it exactly."""
+    k, steps, lr = 2, 6, 0.1
+    losses, w_final = _train_localsgd(k, steps=steps, lr=lr)
+
+    # oracle: both slices start from the SAME init (read it from a fresh
+    # startup run of the same seeded program)
+    main, startup, loss = _build_linear()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        w0 = np.asarray(scope.find_var("lsgd_w")).astype(np.float64)
+
+    w = [w0.copy(), w0.copy()]  # per-slice params
+    ref_losses = []
+    for i in range(steps):
+        feed = _feed(i)
+        x, y = feed["x"].astype(np.float64), feed["y"].astype(np.float64)
+        halves = [(x[:8], y[:8]), (x[8:], y[8:])]
+        step_losses = []
+        for s, (xs, ys) in enumerate(halves):
+            err = xs @ w[s] - ys
+            step_losses.append(float(np.mean(err ** 2)))
+            g = 2.0 * xs.T @ err / xs.shape[0]
+            w[s] = w[s] - lr * g
+        ref_losses.append(float(np.mean(step_losses)))
+        if i % k == k - 1:
+            consensus = (w[0] + w[1]) / 2.0
+            w = [consensus.copy(), consensus.copy()]
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(w_final[0], w[0], rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(w_final[1], w[1], rtol=2e-5, atol=1e-6)
+    # steps=6, k=2 -> the last step synced: slices agree
+    np.testing.assert_allclose(w_final[0], w_final[1], rtol=1e-6)
+
+
+def test_localsgd_k1_equals_dense_sync():
+    """k_steps=1 averages parameters every step; for SGD this is
+    algebraically the dense gradient-mean path."""
+    losses_l, w_l = _train_localsgd(1)
+
+    main, startup, loss = _build_linear()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_dcn = 2
+            fleet.init()
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.SGDOptimizer(learning_rate=0.1), strategy)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses_d = []
+        for i in range(6):
+            (lv,) = exe.run(main, feed=_feed(i), fetch_list=[loss])
+            losses_d.append(float(np.asarray(lv).reshape(())))
+    np.testing.assert_allclose(losses_l, losses_d, rtol=2e-5, atol=1e-6)
+
+
+def test_localsgd_with_momentum_diverges_then_syncs():
+    """Momentum accumulators ride the divergent storage: training runs,
+    loss decreases, and a sync step re-unifies the slices."""
+    main, startup, loss = _build_linear()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_dcn = 2
+            strategy.localsgd = True
+            strategy.localsgd_configs = {"k_steps": 3}
+            fleet.init()
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.MomentumOptimizer(0.05, momentum=0.9),
+                strategy)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for i in range(9):
+            (lv,) = exe.run(main, feed=_feed(i % 3), fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+            w = np.asarray(scope.find_var("lsgd_w"))
+            if i % 3 == 2:  # sync step: slices agree
+                np.testing.assert_allclose(w[0], w[1], rtol=1e-6)
+            elif i % 3 == 1:  # mid-cycle: slices have diverged
+                assert not np.allclose(w[0], w[1])
+    assert losses[-1] < losses[0]
+
+
+def test_localsgd_requires_dcn_mesh():
+    strategy = fleet.DistributedStrategy()
+    strategy.localsgd = True
+    with pytest.raises(NotImplementedError, match="hybrid_dcn"):
+        fleet._reject_unsupported(strategy)

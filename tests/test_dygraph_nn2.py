@@ -104,8 +104,9 @@ def test_row_conv_and_sequence_conv_classes():
         sc = dygraph.SequenceConv(num_filters=7, filter_size=3,
                                   input_dim=5)(x)
         assert sc.shape == (2, 6, 7)
-    with pytest.raises(NotImplementedError):
-        dygraph.TreeConv()
+    # TreeConv is real since round 4 (see tests/test_tree_conv.py)
+    tc = dygraph.TreeConv(5, 4, num_filters=2)
+    assert tuple(tc.weight.shape) == (5, 3, 4, 2)
 
 
 def test_conv_transpose_output_size():
