@@ -138,5 +138,10 @@ def fused_multihead_attention(ctx, ins, attrs):
         rng = None
         if not is_test and dropout_prob > 0.0:
             rng = ctx.salted_rng(int(attrs.get("rng_salt", 0)))
+        # zero-cotangent BiasQK contract: the kernel paths above never
+        # produce a dbias, so the fallback must not either — a shape or
+        # backend change would otherwise flip gradient semantics
+        if bias is not None:
+            bias = jax.lax.stop_gradient(bias)
         out = _reference_attention(q, k, v, bias, dropout_prob, is_test, rng)
     return {"Out": [_merge_heads(out)]}

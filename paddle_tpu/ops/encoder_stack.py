@@ -314,7 +314,19 @@ def fused_encoder_stack(ctx, ins, attrs):
 
     if _use_gpipe(ctx, attrs):
         M = int(attrs.get("num_microbatches", 0)) or mesh.shape["pp"]
-        out = _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer,
+        ml = make_layer
+        if remat_policy:
+            # the policy wraps each stage-local layer body inside the
+            # GPipe shard_map, so pipeline + remat_policy saves only the
+            # tagged values per layer (same contract as the scan path)
+            pol = jax.checkpoint_policies.save_only_these_names(
+                *remat_policy)
+
+            def ml(bias_arr, mb_salt=None, manual=False):
+                inner = make_layer(bias_arr, mb_salt, manual)
+                return jax.checkpoint(
+                    lambda c, p: inner(c, p), policy=pol)
+        out = _gpipe_stack(hidden, stacked, bias, mesh, M, ml,
                            ring=ring)
         return {"Out": [out]}
 

@@ -362,11 +362,17 @@ def _tree_conv_coeffs(edges, n, max_depth):
                 children[int(p)].append(int(c))
 
         for u in range(1, n + 1):
-            # (node, idx-among-siblings, n-siblings, depth)
+            # (node, idx-among-siblings, n-siblings, depth); a per-root
+            # visited set (reference construct_patch) counts each node
+            # once even with duplicate edges or multi-parent EdgeSets
             stack = [(u, 1, 1, 0)]
+            visited = set()
             entries = []
             while stack:
                 node, idx, l, depth = stack.pop()
+                if node in visited:
+                    continue
+                visited.add(node)
                 entries.append((node, idx, l, depth))
                 if depth + 1 < max_depth:
                     ch = children[node]

@@ -2984,6 +2984,10 @@ EXEMPT = {
                            "trains + stays causal)",
     "c_dcn_grad_sync": "test_dcn.py (two-level sync parity + DGC "
                        "oracles on the (dcn, dp) mesh)",
+    "c_dcn_localsgd_sync": "test_dcn.py (LocalSGD consensus oracle on "
+                           "the (dcn, dp) mesh)",
+    "dcn_expand_param": "test_dcn.py (outer-optimizer state expansion)",
+    "tree_conv": "test_tree_conv.py (numpy eta-coefficient oracle)",
     "fused_multihead_attention": "test_flash_attention.py + test_bert.py",
     "recompute_segment": "test_meta_optimizers.py (recompute)",
     # explicit grad kernels: exercised by check_grad of their forward op

@@ -223,3 +223,42 @@ def test_gpipe_sp_gradients_flow():
     for k, g in grads.items():
         assert np.isfinite(np.asarray(g)).all(), k
         assert float(jnp.abs(g).sum()) > 0.0, k
+
+
+def test_gpipe_with_remat_policy_matches_sequential():
+    """pipeline + remat_policy: the policy checkpoint wraps each
+    stage-local layer (round-4 advice: it used to be silently dropped,
+    leaving NO remat at all). Numerics must match the sequential stack
+    and gradients must flow."""
+    L, B, S, H, F, NH = 4, 4, 8, 16, 32, 4
+    params = _stacked_params(L, H, F, seed=9)
+    hidden = jnp.asarray(
+        np.random.RandomState(10).randn(B, S, H).astype(np.float32))
+    spec = registry.get("fused_encoder_stack")
+    base_attrs = {"num_heads": NH, "is_test": True,
+                  "use_flash_attention": False}
+
+    ins = {"Hidden": [hidden]}
+    ins.update({k: [v] for k, v in params.items()})
+    ctx_seq = registry.EmitContext(rng_key=jax.random.PRNGKey(0))
+    (ref,) = spec.emit(ctx_seq, ins, dict(base_attrs))["Out"]
+
+    mesh = create_mesh({"pp": 4})
+    attrs_pp = dict(base_attrs, pipeline=True, num_microbatches=2,
+                    remat_policy="flash")
+
+    def loss_fn(p):
+        ctx = registry.EmitContext(rng_key=jax.random.PRNGKey(0), mesh=mesh)
+        i = {"Hidden": [hidden]}
+        i.update({k: [v] for k, v in p.items()})
+        (out,) = spec.emit(ctx, i, dict(attrs_pp))["Out"]
+        return out
+
+    out = jax.jit(loss_fn)(params)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    grads = jax.jit(jax.grad(lambda p: jnp.sum(loss_fn(p) ** 2)))(params)
+    for k, g in grads.items():
+        gn = np.asarray(jnp.abs(g).sum(axis=tuple(range(1, g.ndim))))
+        assert (gn > 0).all(), f"zero grad for some stage layers of {k}: {gn}"

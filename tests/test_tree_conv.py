@@ -110,3 +110,19 @@ def test_tree_conv_dygraph_layer():
         out = tc(nv, es)
         assert tuple(out.shape) == (1, n, 4, 2)
         assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_tree_conv_duplicate_edges_counted_once():
+    """construct_patch marks visited nodes: a duplicated edge (or a
+    multi-parent EdgeSet) must not double a node's eta coefficients."""
+    from paddle_tpu.ops.misc_ops import _tree_conv_coeffs
+
+    edges = np.array([[[1, 2], [1, 3]]], np.int32)
+    dup = np.array([[[1, 2], [1, 2], [1, 3]]], np.int32)
+    # duplicated child edge: node 2 appears twice in node 1's child list,
+    # but the visited set must keep its coefficients single-counted
+    c_ref = _tree_conv_coeffs(edges, n=3, max_depth=2)
+    c_dup = _tree_conv_coeffs(dup, n=3, max_depth=2)
+    # node 2's eta_t from root 1's patch is identical (counted once)
+    np.testing.assert_allclose(c_dup[0, 0, 1, 2], c_ref[0, 0, 1, 2])
+    assert c_dup[0, 0, 1, 2] > 0
