@@ -173,6 +173,18 @@ class OptimizerWithMixedPrecision:
             self._bad_steps,
         )
 
+    def apply_optimize(self, loss, startup_program, params_grads):
+        """Same contract as Optimizer.apply_optimize — THIS level's
+        apply_gradients (unscale/f32-cast), not the inner's. Lets
+        backward-then-apply callers (fleet's hybrid_dcn wrappers, which
+        insert c_dcn_grad_sync between the two) compose with AMP without
+        __getattr__ silently bypassing the gradient post-processing."""
+        with framework.program_guard(
+            loss.block.program,
+            startup_program or framework.default_startup_program(),
+        ):
+            return self.apply_gradients(params_grads)
+
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         scaled_loss, params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set

@@ -19,6 +19,7 @@ from typing import Optional
 
 from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from . import metrics  # noqa: F401  (reference paddle.fleet.metrics)
 
 from .. import parallel as _parallel
 from ..parallel import create_mesh, set_var_sharding
@@ -155,7 +156,10 @@ class DistributedOptimizer:
         if strategy.amp:
             from ..contrib.mixed_precision import decorate
 
-            inner = decorate(inner, **(strategy.amp_configs or {}))
+            amp_cfg = dict(strategy.amp_configs or {})
+            # consumed by the dcn sync ops, not the decorator
+            amp_cfg.pop("bf16_grad_sync", None)
+            inner = decorate(inner, **amp_cfg)
         if strategy.recompute and strategy.recompute_configs.get("checkpoints"):
             from ..fluid.optimizer import RecomputeOptimizer
 
@@ -239,12 +243,27 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     return DistributedOptimizer(optimizer, strategy)
 
 
+def _backward_params_grads(inner, loss, startup_program, parameter_list,
+                           no_grad_set):
+    """backward() across inner-optimizer flavors: the AMP decorator
+    returns (scaled_loss, params_grads) (reference decorator.py
+    backward:112), plain/recompute optimizers return params_grads."""
+    res = inner.backward(loss, startup_program, parameter_list,
+                         no_grad_set)
+    if (isinstance(res, tuple) and len(res) == 2
+            and isinstance(res[1], list)):
+        return res[1]
+    return res
+
+
 class _DCNGradSyncOptimizer:
     """Insert a c_dcn_grad_sync op between backward and the optimizer
     update for every parameter gradient (the multi-slice hybrid_dcn
-    mode). The inner optimizer must expose backward/apply_optimize
-    (plain + recompute optimizers do; amp/gradient_merge are rejected by
-    _reject_unsupported)."""
+    mode). The inner optimizer must expose backward/apply_optimize:
+    plain, recompute, and AMP optimizers do — amp composes by wrapping
+    (AMP backward emits bf16 grads, the sync ops ride them, AMP
+    apply_optimize casts f32 for the update); gradient_merge is
+    rejected by _reject_unsupported."""
 
     def __init__(self, inner, strategy):
         self.inner_opt = inner
@@ -257,14 +276,24 @@ class _DCNGradSyncOptimizer:
 
         strategy = self._strategy
         n_dcn = int(strategy.hybrid_dcn)
-        params_grads = self.inner_opt.backward(
-            loss, startup_program, parameter_list, no_grad_set
-        )
+        params_grads = _backward_params_grads(
+            self.inner_opt, loss, startup_program, parameter_list,
+            no_grad_set)
         block = loss.block.program.global_block()
         use_dgc = bool(strategy.dgc)
         cfgs = strategy.dgc_configs or {}
         sparsity = float(cfgs.get("sparsity", 0.999))
         rampup = int(cfgs.get("rampup_begin_step", 0))
+        # AMP composes: parameter grads reach here as f32 masters (the
+        # cast vjp accumulates f32), so low-precision lives on the WIRE —
+        # the slow dcn hop runs bf16 (reference fp16_allreduce analog)
+        # unless amp_configs["bf16_grad_sync"] turns it off
+        wire = (
+            "bfloat16"
+            if strategy.amp
+            and (strategy.amp_configs or {}).get("bf16_grad_sync", True)
+            else ""
+        )
         step_var = None
         if use_dgc and rampup > 0:
             # in-graph step counter driving the DGC dense warm-up; the
@@ -302,7 +331,8 @@ class _DCNGradSyncOptimizer:
                 inputs=inputs,
                 outputs={"Out": [out_name], **outputs},
                 attrs={"use_dgc": use_dgc, "sparsity": sparsity,
-                       "rampup_begin_step": rampup, "dcn_axis": "dcn"},
+                       "rampup_begin_step": rampup, "dcn_axis": "dcn",
+                       "wire_dtype": wire},
             )
             synced.append((p, block.var(out_name)))
         if step_var is not None:
@@ -346,9 +376,9 @@ class _DCNLocalSGDOptimizer:
         n_dcn = int(strategy.hybrid_dcn)
         k_steps = max(
             1, int((strategy.localsgd_configs or {}).get("k_steps", 1)))
-        params_grads = self.inner_opt.backward(
-            loss, startup_program, parameter_list, no_grad_set
-        )
+        params_grads = _backward_params_grads(
+            self.inner_opt, loss, startup_program, parameter_list,
+            no_grad_set)
         program = loss.block.program
         block = program.global_block()
         synced = []
@@ -369,8 +399,10 @@ class _DCNLocalSGDOptimizer:
         # replicated in-graph step counter, incremented AFTER the sync
         # ops: step i reads value i, so `i % k == k-1` fires the first
         # consensus after exactly k local updates
+        # int32: a float32 counter saturates at 2^24 (x+1 == x), which
+        # would freeze step%k on very long runs
         step_var = _create_persistable_var(
-            unique_name.generate("localsgd_step"), [1], "float32", 0.0)
+            unique_name.generate("localsgd_step"), [1], "int32", 0.0)
         divergent = set(getattr(program, "_dcn_divergent_names", ()))
         for p, g in params_grads:
             if g is None:
@@ -385,8 +417,8 @@ class _DCNLocalSGDOptimizer:
             _parallel.set_var_sharding(
                 p, ("dcn",) + (None,) * len(tuple(p.shape)))
         block.append_op(
-            type="scale", inputs={"X": [step_var]},
-            outputs={"Out": [step_var]}, attrs={"scale": 1.0, "bias": 1.0},
+            type="increment", inputs={"X": [step_var]},
+            outputs={"Out": [step_var]}, attrs={"step": 1},
         )
         # accumulators diverge with their slice's gradients
         for slot in getattr(self.inner_opt, "_accumulators", {}).values():
@@ -434,14 +466,23 @@ def _reject_unsupported(strategy):
             (strategy.sequence_parallel, "sequence_parallel"),
             (strategy.expert_parallel, "expert_parallel"),
             (strategy.gradient_merge, "gradient_merge"),
-            (strategy.amp, "amp"),
-            (strategy.sharding, "sharding"),
         ):
             if flag:
                 raise NotImplementedError(
-                    f"strategy.hybrid_dcn composes with plain data "
-                    f"parallelism only for now; unset strategy.{name}"
+                    f"strategy.hybrid_dcn composes with data parallelism "
+                    f"and amp for now; unset strategy.{name}"
                 )
+        if strategy.sharding:
+            raise NotImplementedError(
+                "strategy.sharding + hybrid_dcn: ZeRO state sharding "
+                "relies on GSPMD resharding the accumulator at the "
+                "update, but the multi-slice step runs MANUALLY sharded "
+                "(executor shard_map over (dcn, dp)) where a dp-sharded "
+                "accumulator's local view cannot meet the replicated "
+                "parameter — gathering it in-step would forfeit the "
+                "memory saving sharding exists for. Use sharding on "
+                "single-slice meshes"
+            )
     if strategy.localsgd:
         if int(strategy.hybrid_dcn or 0) < 2:
             raise NotImplementedError(

@@ -158,8 +158,14 @@ def c_dcn_grad_sync(ctx, ins, attrs):
         # slow DCN axis every k steps by c_dcn_localsgd_sync
         outs["Out"] = [g]
         return outs
+    # wire_dtype (fleet sets bfloat16 under AMP — the reference
+    # fp16_allreduce meta-optimizer's analog): the ICI-level mean above
+    # stays full precision; only the SLOW dcn hop is quantized, halving
+    # DCN traffic. The result is cast back to the gradient dtype.
+    wire = attrs.get("wire_dtype", "") or ""
     if not attrs.get("use_dgc", False):
-        outs["Out"] = [lax.pmean(g, dcn_axis)]
+        gw = g.astype(wire) if wire else g
+        outs["Out"] = [lax.pmean(gw, dcn_axis).astype(g.dtype)]
         if "ErrorFeedback" in ins:
             outs["ErrorFeedback"] = [ins["ErrorFeedback"][0]]
         return outs
@@ -172,12 +178,17 @@ def c_dcn_grad_sync(ctx, ins, attrs):
     k = max(1, int(round(flat.size * (1.0 - sparsity))))
     _, topi = lax.top_k(jnp.abs(flat), k)
     vals = flat[topi]
-    sent = jnp.zeros_like(flat).at[topi].set(vals)
+    if wire:
+        # quantize the transmitted values; the residual below keeps the
+        # UNSENT remainder (incl. quantization error) as error feedback,
+        # so the compression stays unbiased over time
+        vals = vals.astype(wire)
+    sent = jnp.zeros_like(flat).at[topi].set(vals.astype(flat.dtype))
     e_new = (flat - sent).reshape(acc.shape)
     all_vals = lax.all_gather(vals, dcn_axis)  # [n_dcn, k] on the wire
     all_idx = lax.all_gather(topi, dcn_axis)
     sparse_sync = jnp.zeros_like(flat).at[all_idx.reshape(-1)].add(
-        all_vals.reshape(-1)
+        all_vals.reshape(-1).astype(flat.dtype)
     ).reshape(acc.shape) / n_dcn
     rampup = int(attrs.get("rampup_begin_step", 0))
     if rampup > 0 and "Step" in ins:
