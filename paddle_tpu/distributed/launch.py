@@ -71,9 +71,95 @@ def _parse_args(argv):
         "stale for this many seconds — catches collective deadlocks that "
         "never exit. 0 = off",
     )
+    p.add_argument(
+        "--server_num", type=int, default=0,
+        help="spawn N local parameter-server processes "
+        "(distributed/ps_server.py) on free ports and export "
+        "PADDLE_PSERVERS_IP_PORT_LIST to the trainers (reference "
+        "launch_ps.py). Servers outlive elastic restarts, so hosted "
+        "tables survive a trainer-group respawn",
+    )
+    p.add_argument(
+        "--servers", default="",
+        help="explicit pserver endpoint list host:port,... — endpoints "
+        "whose host matches this node are spawned here; the full list "
+        "is exported to trainers (multi-node PS). Overrides --server_num",
+    )
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def start_pservers(server_num: int, servers: str, node_ip: str,
+                   log_dir: Optional[str] = None):
+    """Spawn this node's pserver processes (reference launch_ps.py
+    start_procs). Returns (procs, full_endpoint_list). --server_num
+    spawns on launcher-chosen free ports (the child binds port 0 and
+    reports the bound port on stdout, so there is no pick-then-bind
+    race); --servers spawns the endpoints whose host is this node."""
+    procs, endpoints = [], []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    def spawn(port: int, host: str, idx: int):
+        env = dict(os.environ)
+        env["PADDLE_TRAINING_ROLE"] = "PSERVER"
+        cmd = [sys.executable, "-u", "-m",
+               "paddle_tpu.distributed.ps_server",
+               "--port", str(port), "--host", host]
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        line = proc.stdout.readline()  # "[ps_server] listening on h:p"
+        if "listening on" not in line:
+            proc.kill()
+            raise RuntimeError(f"pserver {idx} failed to start: {line!r}")
+        bound = int(line.rsplit(":", 1)[1])
+        if log_dir:
+            log = open(os.path.join(log_dir, f"serverlog.{idx}"), "w")
+            log.write(line)
+
+            def drain(p=proc, f=log):
+                for ln in p.stdout:
+                    f.write(ln)
+                f.close()
+        else:
+            def drain(p=proc):
+                for _ in p.stdout:
+                    pass
+        import threading
+
+        threading.Thread(target=drain, daemon=True).start()
+        procs.append(proc)
+        return bound
+
+    try:
+        if servers:
+            eps = [e.strip() for e in servers.split(",") if e.strip()]
+            for i, ep in enumerate(eps):
+                host, port = ep.rsplit(":", 1)
+                if host in (node_ip, "127.0.0.1", "localhost"):
+                    spawn(int(port), host, i)
+            endpoints = eps
+        else:
+            for i in range(server_num):
+                bound = spawn(0, "127.0.0.1", i)
+                endpoints.append(f"127.0.0.1:{bound}")
+    except BaseException:
+        # partial startup must not orphan the servers already running
+        terminate_pservers(procs)
+        raise
+    return procs, endpoints
+
+
+def terminate_pservers(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
 
 
 def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
@@ -183,9 +269,17 @@ def launch(argv=None) -> int:
             heartbeat_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
             own_heartbeat_dir = True
 
+    pservers = []
     try:
+        if args.server_num or args.servers:
+            pservers, endpoints = start_pservers(
+                args.server_num, args.servers, node_ip, args.log_dir)
+            # trainers inherit the list through start_local_trainers' env
+            os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(endpoints)
+            os.environ.setdefault("PADDLE_TRAINING_ROLE", "TRAINER")
         return _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir)
     finally:
+        terminate_pservers(pservers)
         if own_heartbeat_dir:
             import shutil
 

@@ -7,10 +7,14 @@ and the Downpour-style async update flow (distributed/communicator.h).
 
 This is the one capability XLA does not subsume (SURVEY.md §7): an
 embedding table larger than chip HBM. The table lives in HOST memory,
-row-sharded across `num_shards` shard stores (on one host these are
-in-process shards; a multi-host deployment maps shards to processes via
-the launcher env — the storage/update protocol is identical). The device
-step interacts with it through two callbacks:
+row-sharded across `num_shards` shard stores. Single-process runs keep
+the shards in-process; under the launcher's PS mode
+(PADDLE_PSERVERS_IP_PORT_LIST) the same table lives in a dedicated
+pserver PROCESS and create_table hands back a ps_server.RemoteTable
+client with the identical gather/push surface, so N trainer processes
+share one table over TCP (ps_server.py — the listen_and_serv/gRPC
+data-plane analog). The device step interacts with it through two
+callbacks:
 
   gather  — forward: jax.pure_callback pulls just the looked-up rows to
             the device ([batch, dim], never the full table)
@@ -279,14 +283,38 @@ class GeoSGDClient:
 
 
 def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
-                 num_trainers: int = 1, **kw):
-    """mode: "sync"/"async" — per-step gradient push, server-side
-    optimizer (Downpour); "geo" — local optimizer + K-step delta push
-    (Geo-SGD, reference geo_sgd_transpiler.py)."""
+                 num_trainers: Optional[int] = None, **kw):
+    """mode: "sync" — per-step gradient push with a server-side barrier
+    across trainers (reference DistributeTranspiler sync_mode); "async"
+    — per-step push applied on arrival (Downpour); "geo" — local
+    optimizer + K-step delta push (Geo-SGD, geo_sgd_transpiler.py).
+
+    When the launcher exports PADDLE_PSERVERS_IP_PORT_LIST (launch.py
+    --server_num), the table is HOSTED: this process gets a RemoteTable
+    client and the rows live in the pserver process(es), shared by every
+    trainer (ps_server.py). Without it, the table is in-process (single
+    trainer / tests). In-process "sync" and "async" behave identically
+    (there is no peer to barrier with)."""
+    import os as _os
+
+    from . import ps_server as _net
+
+    if num_trainers is None:
+        num_trainers = int(_os.environ.get("PADDLE_TRAINERS_NUM", 1))
     with _lock:
         if name in _tables:
             raise ValueError(f"table {name!r} already exists")
-        t = ShardedHostTable(name, shape, **kw)
+        endpoints = _net.pserver_endpoints()
+        if endpoints and _net.training_role() == "TRAINER":
+            if mode not in ("sync", "async", "geo"):
+                raise ValueError(f"unknown PS mode {mode!r}")
+            t = _net.RemoteTable(
+                name, shape, endpoints,
+                sync_trainers=num_trainers if mode == "sync" else 0,
+                trainer_id=int(_os.environ.get("PADDLE_TRAINER_ID", 0)),
+                **kw)
+        else:
+            t = ShardedHostTable(name, shape, **kw)
         if mode == "geo":
             if t.optimizer != "sgd":
                 raise ValueError(

@@ -1,0 +1,510 @@
+"""Networked parameter-server data plane: tables in a server PROCESS.
+
+Parity surface: the reference's cross-process PS runtime —
+operators/distributed_ops/listen_and_serv_op.cc (server event loop),
+operators/distributed/grpc/grpc_client.h:176 (async client),
+operators/distributed/communicator.h:180-396 (send queues, Geo), and the
+PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_TRAINING_ROLE / PADDLE_PORT env
+contract (fleet/base/role_maker.py:497).
+
+TPU-native redesign: the device step only ever sees [batch, dim] row
+slices through the existing gather/push callbacks (ops/ps_ops.py), so
+the wire protocol is four verbs over TCP — create / gather / push /
+admin — not a full RPC graph executor. One server process (or several,
+round-robin row-sharded like the reference ps_dispatcher) owns
+ShardedHostTable instances; N launcher-spawned trainer processes talk to
+it through RemoteTable, which is duck-type identical to the in-process
+table, so ops/ps_ops.py and GeoSGDClient run unchanged on top.
+
+Sync semantics (reference DistributeTranspiler sync_mode): in
+`sync` mode the server BARRIERS each push round — it accumulates one
+push per trainer, merges them (concat + dedup scatter-add, scaled
+1/num_trainers: dp-mean convention, same as the framework's allreduce
+mean), applies the optimizer ONCE, then releases every waiter. Two
+trainers each pushing d(mean loss over their half-batch) therefore
+produce exactly the single-process full-batch update — the loss-parity
+contract tests/test_ps_dist.py asserts. `async` skips the barrier
+(Downpour: apply on arrival); `geo` trainers push deltas (additive,
+no barrier) through GeoSGDClient wrapping a RemoteTable.
+
+Framing: 8-byte big-endian length + pickle (trusted cluster transport,
+like the reference's protobuf-over-gRPC — auth/encryption is deployment
+infra, not the data plane's job).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .ps import ShardedHostTable
+
+_LEN = struct.Struct(">Q")
+
+# a barrier that outlives this window means a peer trainer died mid-step:
+# fail fast so the launcher's watcher can abort/restart the group
+SYNC_TIMEOUT = float(os.environ.get("PADDLE_PS_SYNC_TIMEOUT", 120.0))
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the PS connection")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _SyncState:
+    """Per-table push barrier (sync mode): round r applies once all
+    `num_trainers` contributions for r have arrived.
+
+    Completion is tracked per-CONTRIBUTION (a token each waiter removes
+    after waking), not by an applied-step high-water mark — a restarted
+    trainer group (launch.py --elastic_retries; the server process
+    deliberately outlives restarts so hosted tables survive) restarts
+    its step counter at 0, and a high-water mark would let its pushes
+    return before the merge. A push that finds a stale same-trainer
+    entry in its round (left by a crashed group) simply overwrites it:
+    the dead process no longer waits, and a live trainer never pushes
+    the same (table, round) twice by construction (the client's step
+    counter increments per push)."""
+
+    def __init__(self, num_trainers: int):
+        self.cond = threading.Condition()
+        self.num = int(num_trainers)
+        self.rounds: Dict[int, Dict[int, tuple]] = {}
+        self.done: set = set()
+
+
+class PSServer:
+    """Event loop owning the host tables (listen_and_serv analog)."""
+
+    def __init__(self):
+        self.tables: Dict[str, ShardedHostTable] = {}
+        self.specs: Dict[str, dict] = {}
+        self.sync: Dict[str, _SyncState] = {}
+        self.lock = threading.Lock()
+        self.shutdown_event = threading.Event()
+
+    # -- verbs -----------------------------------------------------------
+
+    def create_table(self, spec: dict):
+        """Idempotent across trainers: the first create wins; later
+        creates with an IDENTICAL spec are no-ops, mismatches error."""
+        name = spec["name"]
+        with self.lock:
+            if name in self.tables:
+                if spec != self.specs[name]:
+                    raise ValueError(
+                        f"table {name!r} already exists with a different "
+                        f"spec: {self.specs[name]} vs {spec}")
+                return {"rows": self.tables[name].rows,
+                        "dim": self.tables[name].dim}
+            kw = {k: v for k, v in spec.items()
+                  if k not in ("name", "shape", "sync_trainers")}
+            t = ShardedHostTable(name, spec["shape"], **kw)
+            self.tables[name] = t
+            self.specs[name] = dict(spec)
+            self.sync[name] = _SyncState(int(spec.get("sync_trainers", 0)))
+            return {"rows": t.rows, "dim": t.dim}
+
+    def _table(self, name: str) -> ShardedHostTable:
+        t = self.tables.get(name)
+        if t is None:
+            raise KeyError(f"no table {name!r} on this pserver")
+        return t
+
+    def gather(self, name, ids):
+        return self._table(name).gather(ids)
+
+    def push_gradients(self, name, ids, grads, trainer_id=0, step=0):
+        table = self._table(name)
+        st = self.sync[name]
+        if st.num <= 1:
+            table.push_gradients(ids, grads)  # async / single trainer
+            return 0
+        token = object()
+        with st.cond:
+            buf = st.rounds.setdefault(step, {})
+            # overwrite-not-raise: a pre-existing entry can only be a
+            # crashed group's leftover (see _SyncState docstring)
+            buf[trainer_id] = (np.asarray(ids), np.asarray(grads), token)
+            if len(buf) == st.num:
+                # trainer-id order, not arrival order: the merged batch
+                # is then exactly the single-process batch layout, so
+                # duplicate-id float accumulation is order-identical
+                ids_m = np.concatenate([buf[t][0] for t in sorted(buf)])
+                g_m = np.concatenate([buf[t][1] for t in sorted(buf)])
+                table.push_gradients(ids_m, g_m / st.num)
+                for t in buf:
+                    st.done.add(buf[t][2])
+                st.done.discard(token)  # the merger does not wait
+                del st.rounds[step]
+                st.cond.notify_all()
+            elif st.cond.wait_for(lambda: token in st.done,
+                                  timeout=SYNC_TIMEOUT):
+                st.done.discard(token)  # each waiter prunes its own
+            else:
+                # drop our contribution so the round can't half-fire if
+                # this trainer is restarted and retries
+                if step in st.rounds:
+                    st.rounds[step].pop(trainer_id, None)
+                raise RuntimeError(
+                    f"sync-PS barrier timed out after {SYNC_TIMEOUT}s: "
+                    f"only {len(st.rounds.get(step, {}))}/{st.num} "
+                    f"trainers pushed table {name!r} round {step} — a "
+                    f"peer trainer likely died")
+        return 0
+
+    def push_delta(self, name, ids, deltas):
+        self._table(name).push_delta(ids, deltas)
+        return 0
+
+    def handle(self, method: str, kwargs: dict):
+        if method == "ping":
+            return "pong"
+        if method == "create_table":
+            return self.create_table(kwargs["spec"])
+        if method == "gather":
+            return self.gather(kwargs["name"], kwargs["ids"])
+        if method == "push_gradients":
+            return self.push_gradients(
+                kwargs["name"], kwargs["ids"], kwargs["grads"],
+                kwargs.get("trainer_id", 0), kwargs.get("step", 0))
+        if method == "push_delta":
+            return self.push_delta(
+                kwargs["name"], kwargs["ids"], kwargs["deltas"])
+        if method == "to_dense":
+            return self._table(kwargs["name"]).to_dense()
+        if method == "nbytes":
+            return self._table(kwargs["name"]).nbytes()
+        if method == "stats":
+            t = self._table(kwargs["name"])
+            return {"push_calls": t.push_calls,
+                    "pushed_bytes": t.pushed_bytes}
+        if method == "state_dict":
+            return self._table(kwargs["name"]).state_dict()
+        if method == "load_state_dict":
+            self._table(kwargs["name"]).load_state_dict(kwargs["state"])
+            return 0
+        if method == "drop_table":
+            with self.lock:
+                self.tables.pop(kwargs["name"], None)
+                self.specs.pop(kwargs["name"], None)
+                self.sync.pop(kwargs["name"], None)
+            return 0
+        if method == "shutdown":
+            self.shutdown_event.set()
+            return 0
+        raise ValueError(f"unknown PS method {method!r}")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        srv: PSServer = self.server.ps  # type: ignore[attr-defined]
+        while True:
+            try:
+                method, kwargs = _recv_msg(self.request)
+            except (ConnectionError, EOFError):
+                return
+            try:
+                result = srv.handle(method, kwargs)
+                _send_msg(self.request, (True, result))
+            except BaseException as e:  # noqa: BLE001 — ship to client
+                try:
+                    _send_msg(self.request, (False, f"{type(e).__name__}: {e}"))
+                except OSError:
+                    return
+            if srv.shutdown_event.is_set():
+                threading.Thread(
+                    target=self.server.shutdown, daemon=True).start()
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None):
+    """Run the pserver event loop (blocks). port=0 picks a free port;
+    ready_cb (tests) receives the bound (host, port)."""
+    srv = _TCPServer((host, port), _Handler)
+    srv.ps = PSServer()  # type: ignore[attr-defined]
+    if ready_cb is not None:
+        ready_cb(srv.server_address)
+    try:
+        srv.serve_forever(poll_interval=0.1)
+    finally:
+        srv.server_close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.ps_server")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("PADDLE_PORT", 0)))
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+
+    def ready(addr):
+        # the launcher reads this line to learn the bound port
+        print(f"[ps_server] listening on {addr[0]}:{addr[1]}", flush=True)
+
+    serve(args.port, args.host, ready_cb=ready)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class _Conn:
+    """Pooled client connections to ONE endpoint. Pooling (not one shared
+    socket) matters: a sync-mode push BLOCKS in the server barrier, and a
+    second table's push or a gather from another runtime thread must not
+    queue behind it — the cross-table ordering deadlock the reference
+    avoids with per-request gRPC calls (grpc_client.h AsyncSendVar)."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self._free: List[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def _checkout(self) -> socket.socket:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        s = socket.create_connection(self.addr, timeout=SYNC_TIMEOUT + 30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def call(self, method: str, **kwargs):
+        s = self._checkout()
+        try:
+            _send_msg(s, (method, kwargs))
+            ok, result = _recv_msg(s)
+        except BaseException:
+            try:
+                s.close()
+            finally:
+                pass
+            raise
+        with self._lock:
+            self._free.append(s)
+        if not ok:
+            raise RuntimeError(f"pserver {self.addr}: {result}")
+        return result
+
+    def close(self):
+        with self._lock:
+            for s in self._free:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._free.clear()
+
+
+class RemoteTable:
+    """Client shim: the ShardedHostTable duck type over N pservers.
+
+    Rows are round-robin sharded across servers (global row r lives on
+    server r % n at local row r // n — the reference ps_dispatcher
+    RoundRobin placement), so with one server the hosted table is
+    byte-identical (same seed, same shape) to the in-process one.
+    """
+
+    def __init__(self, name, shape, endpoints: List[str],
+                 dtype: str = "float32", num_shards: int = 4,
+                 optimizer: str = "sgd", learning_rate: float = 0.1,
+                 initializer_std: Optional[float] = None, seed: int = 0,
+                 sync_trainers: int = 0, trainer_id: int = 0):
+        self.name = name
+        self.rows, self.dim = int(shape[0]), int(shape[1])
+        self.dtype = np.dtype(dtype)
+        self.learning_rate = float(learning_rate)
+        self.optimizer = optimizer
+        self.endpoints = list(endpoints)
+        self.trainer_id = int(trainer_id)
+        self._n = len(self.endpoints)
+        self._conns = [_Conn(e) for e in self.endpoints]
+        self._step = 0
+        self._step_lock = threading.Lock()
+        # multi-server fan-out pool: per-server RPCs overlap instead of
+        # serializing N round-trips (the reference's async gRPC client
+        # model, grpc_client.h AsyncSendVar); connections are pooled per
+        # endpoint so concurrent calls never share a socket
+        self._pool = None
+        if self._n > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=self._n)
+        for s, conn in enumerate(self._conns):
+            n_rows = (self.rows - s + self._n - 1) // self._n
+            conn.call("create_table", spec={
+                "name": name, "shape": (n_rows, self.dim),
+                "dtype": str(self.dtype), "num_shards": num_shards,
+                "optimizer": optimizer, "learning_rate": learning_rate,
+                "initializer_std": initializer_std,
+                # distinct per-server streams when sharded; the single-
+                # server layout reproduces the local table bit-for-bit
+                "seed": seed if self._n == 1 else seed + s,
+                "sync_trainers": sync_trainers,
+            })
+
+    # -- addressing ------------------------------------------------------
+    def _locate(self, ids: np.ndarray):
+        if ids.size and (ids.min() < 0 or ids.max() >= self.rows):
+            bad = ids[(ids < 0) | (ids >= self.rows)][0]
+            raise IndexError(
+                f"table {self.name!r}: id {int(bad)} out of range "
+                f"[0, {self.rows})")
+        return ids % self._n, ids // self._n
+
+    def _fanout(self, thunks):
+        """Run one thunk per server, overlapped when a pool exists."""
+        if self._pool is None:
+            return [t() for t in thunks]
+        return [f.result() for f in
+                [self._pool.submit(t) for t in thunks]]
+
+    # -- serving ---------------------------------------------------------
+    def gather(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        srv, local = self._locate(ids)
+        out = np.empty((ids.shape[0], self.dim), self.dtype)
+        masks = [srv == s for s in range(self._n)]
+        rows = self._fanout([
+            (lambda s=s, m=m: self._conns[s].call(
+                "gather", name=self.name, ids=local[m]))
+            if m.any() else (lambda: None)
+            for s, m in enumerate(masks)
+        ])
+        for m, r in zip(masks, rows):
+            if r is not None:
+                out[m] = r
+        return out
+
+    def push_gradients(self, ids, grads) -> None:
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        with self._step_lock:
+            step = self._step
+            self._step += 1
+        srv, local = self._locate(ids)
+        # every server participates in every sync round (even with zero
+        # rows) so its barrier bookkeeping sees all trainers each step;
+        # overlapped: in sync mode each call blocks on the barrier
+        self._fanout([
+            lambda s=s: self._conns[s].call(
+                "push_gradients", name=self.name, ids=local[srv == s],
+                grads=grads[srv == s], trainer_id=self.trainer_id,
+                step=step)
+            for s in range(self._n)
+        ])
+
+    def push_delta(self, ids, deltas) -> None:
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        deltas = np.asarray(deltas, np.float32).reshape(
+            ids.shape[0], self.dim)
+        srv, local = self._locate(ids)
+        masks = [srv == s for s in range(self._n)]
+        self._fanout([
+            (lambda s=s, m=m: self._conns[s].call(
+                "push_delta", name=self.name, ids=local[m],
+                deltas=deltas[m]))
+            if m.any() else (lambda: None)
+            for s, m in enumerate(masks)
+        ])
+
+    # -- introspection / checkpoint --------------------------------------
+    def nbytes(self) -> int:
+        return sum(c.call("nbytes", name=self.name) for c in self._conns)
+
+    def stats(self) -> dict:
+        agg = {"push_calls": 0, "pushed_bytes": 0}
+        for c in self._conns:
+            st = c.call("stats", name=self.name)
+            for k in agg:
+                agg[k] += st[k]
+        return agg
+
+    def to_dense(self) -> np.ndarray:
+        out = np.empty((self.rows, self.dim), self.dtype)
+        for s in range(self._n):
+            out[s::self._n] = self._conns[s].call(
+                "to_dense", name=self.name)
+        return out
+
+    def state_dict(self):
+        return {"servers": [c.call("state_dict", name=self.name)
+                            for c in self._conns]}
+
+    def load_state_dict(self, state):
+        if "servers" in state:
+            for c, st in zip(self._conns, state["servers"]):
+                c.call("load_state_dict", name=self.name, state=st)
+        else:  # a local-table checkpoint restored into a hosted run
+            if self._n != 1:
+                raise ValueError(
+                    "single-table checkpoint needs exactly 1 pserver")
+            self._conns[0].call(
+                "load_state_dict", name=self.name, state=state)
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        for c in self._conns:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# env contract
+# ---------------------------------------------------------------------------
+
+
+def pserver_endpoints() -> List[str]:
+    """PADDLE_PSERVERS_IP_PORT_LIST (reference role_maker.py:497)."""
+    raw = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return [e.strip() for e in raw.split(",") if e.strip()]
+
+
+def training_role() -> str:
+    return os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER").upper()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

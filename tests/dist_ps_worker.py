@@ -1,0 +1,91 @@
+"""Worker for tests/test_ps_dist.py: PS-embedding training whose loss
+trace must match a single-process run exactly (the reference
+TestDistBase contract, test_dist_base.py:506, applied to the
+listen_and_serv/gRPC-analog data plane in distributed/ps_server.py).
+
+Modes (env):
+  PADDLE_PSERVERS_IP_PORT_LIST set  -> hosted table (RemoteTable client)
+  unset                             -> in-process table (single-proc ref)
+  PS_TEST_KILL_RANK=r               -> rank r exits(3) after KILL_STEP
+                                       pushes (dead-trainer drill: the
+                                       survivor must FAIL FAST on the
+                                       server's sync barrier, not hang)
+
+Each trainer sees the per-rank half of one fixed global batch; only the
+PS table trains (the projection is frozen), so no dense-gradient
+allreduce is needed and the trace depends on the table alone.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import ps
+from paddle_tpu.fluid import layers
+
+GLOBAL_B, DIM, NCLS, ROWS, STEPS, KILL_STEP = 32, 16, 7, 5_000, 12, 4
+
+
+def main():
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    mode = os.environ.get("PS_TEST_MODE", "sync")
+    kill_rank = int(os.environ.get("PS_TEST_KILL_RANK", -1))
+
+    rng = np.random.RandomState(0)
+    all_ids = rng.randint(0, ROWS, (GLOBAL_B,)).astype(np.int64)
+    all_labels = (all_ids % NCLS).astype(np.int64)[:, None]
+    per = GLOBAL_B // world
+    ids = all_ids[rank * per:(rank + 1) * per]
+    labels = all_labels[rank * per:(rank + 1) * per]
+
+    table = ps.create_table("ps_dist_table", shape=(ROWS, DIM),
+                            mode=mode, num_shards=4, optimizer="sgd",
+                            learning_rate=0.5, seed=7,
+                            geo_sync_steps=3)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        w = layers.data("ids", [per], dtype="int64",
+                        append_batch_size=False)
+        y = layers.data("y", [per, 1], dtype="int64",
+                        append_batch_size=False)
+        emb = layers.distributed_embedding(w, "ps_dist_table")
+        # frozen projection: deterministic across processes, so the loss
+        # trace is a pure function of the (shared) table state
+        proj = layers.fc(
+            emb, NCLS,
+            param_attr=fluid.ParamAttr(
+                name="proj_w", trainable=False,
+                initializer=fluid.initializer.UniformInitializer(
+                    low=-0.3, high=0.3, seed=11)),
+            bias_attr=False)
+        loss = layers.mean(layers.softmax_with_cross_entropy(proj, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for step in range(STEPS):
+        (lv,) = exe.run(main_prog, feed={"ids": ids, "y": labels},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(())))
+        if rank == kill_rank and step + 1 == KILL_STEP:
+            os._exit(3)  # simulated hard trainer death (no cleanup)
+    if hasattr(table, "flush"):  # geo: drain pending deltas
+        table.flush()
+
+    trace_dir = os.environ.get("PADDLE_DIST_TRACE_DIR", ".")
+    dense = table.to_dense()
+    with open(os.path.join(trace_dir, f"trace.{rank}.json"), "w") as f:
+        json.dump({"losses": losses,
+                   "table_sum": float(np.float64(dense.sum())),
+                   "table_touched": dense[np.unique(all_ids)][:4].tolist()},
+                  f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
