@@ -1,0 +1,42 @@
+"""Worker for tests/test_fleet_metrics.py::test_two_process_parity:
+each rank holds DIFFERENT local metric stats; the fleet.metrics helpers
+must return the globally-reduced value on every rank (reference
+fleet/metrics/metric.py semantics over the role maker's MPI)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+from paddle_tpu import fleet
+from paddle_tpu.parallel.env import init_parallel_env
+
+
+def main():
+    init_parallel_env()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    # deterministic per-rank stats
+    local_sum = np.asarray([1.5 + rank, 2.0 * (rank + 1)], np.float32)
+    correct = np.asarray([10.0 + 5 * rank], np.float32)
+    total = np.asarray([20.0], np.float32)
+    rng = np.random.RandomState(rank)
+    pos = rng.randint(0, 50, (8,)).astype(np.float64)
+    neg = rng.randint(0, 50, (8,)).astype(np.float64)
+
+    out = {
+        "sum": fleet.metrics.sum(local_sum).tolist(),
+        "max": fleet.metrics.max(local_sum).tolist(),
+        "min": fleet.metrics.min(local_sum).tolist(),
+        "acc": fleet.metrics.acc(correct, total),
+        "auc": fleet.metrics.auc(pos, neg),
+        "mae": fleet.metrics.mae(np.asarray([6.0 + rank]), 10.0),
+    }
+    trace_dir = os.environ.get("PADDLE_DIST_TRACE_DIR", ".")
+    with open(os.path.join(trace_dir, f"metrics.{rank}.json"), "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
