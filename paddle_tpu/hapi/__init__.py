@@ -21,7 +21,7 @@ from ..fluid import layers
 from . import callbacks as callbacks_mod
 from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger  # noqa: F401
 from .metrics import Accuracy, Metric  # noqa: F401
-from . import datasets, vision  # noqa: F401
+from . import datasets, text, vision  # noqa: F401
 
 __all__ = [
     "Input", "Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
@@ -48,7 +48,7 @@ def _to_list(x):
 # reference's Program.clone(for_test=True) _inference_optimize flips)
 _TEST_MODE_OPS = {
     "dropout", "batch_norm", "fused_multihead_attention",
-    "fused_encoder_stack", "instance_norm",
+    "fused_encoder_stack", "fused_decoder_stack", "instance_norm",
 }
 
 
