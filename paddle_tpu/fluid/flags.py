@@ -24,6 +24,17 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_use_fused_ln": (True, "ops/pallas/add_ln.py residual+LayerNorm "
                                  "kernel gate (encoder/decoder stacks, "
                                  "layer_norm emitter)"),
+    "FLAGS_conv_dw_im2col": (
+        False, "ops/nn_ops.py conv2d: reformulate the WEIGHT gradient as "
+               "im2col patches + one matmul (MXU-friendly) instead of "
+               "XLA's dW-convolution lowering; NHWC groups=1 non-1x1 "
+               "kernels only. The TPU answer to the reference's cudnn "
+               "exhaustive dW algo search (conv_cudnn_op.cu.cc)"),
+    "FLAGS_dataloader_require_spawn": (
+        False, "fluid/dataloader: raise instead of warning when worker "
+               "args are unpicklable and the loader would fall back to "
+               "fork() (which can deadlock under the multithreaded JAX "
+               "runtime) — the production-config hard-fail"),
     # --- parity, inert on TPU (subsumed) ---
     "FLAGS_allocator_strategy": ("naive_best_fit", None),  # PJRT allocator
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, None),

@@ -60,9 +60,93 @@ def _conv2d_impl(x, w, attrs):
     )
 
 
+def _conv2d_key(attrs):
+    return (
+        tuple(attrs.get("strides", [1, 1])),
+        tuple(attrs.get("dilations", [1, 1])),
+        int(attrs.get("groups", 1)),
+        attrs.get("padding_algorithm", "EXPLICIT"),
+        tuple(attrs.get("paddings", [0, 0])),
+        attrs.get("data_format", "NCHW"),
+    )
+
+
+import functools as _ft  # noqa: E402 — local to the conv vjp cache
+
+
+@_ft.lru_cache(maxsize=64)
+def _conv2d_im2col_dw_fn(key):
+    """conv2d with an im2col-matmul dW formulation (custom vjp).
+
+    The reference answers dW-conv slowness with cudnn's exhaustive algo
+    search (conv_cudnn_op.cu.cc); XLA has one dW lowering and no search
+    knob. This path reformulates ONLY the weight gradient: extract the
+    kernel-window patches of x (conv_general_dilated_patches) and
+    contract them against dy in a single [C*kh*kw, NHoWo]x[NHoWo, O]
+    einsum — the MXU sees one big matmul instead of XLA's dW-conv
+    schedule. dX keeps the standard transposed-conv lowering (it was
+    never the bottleneck). NHWC, groups=1. Costs kh*kw x activation
+    traffic for the patches, so it wins only where the dW conv is far
+    off roofline — gate via FLAGS_conv_dw_im2col and measure.
+    """
+    strides, dil, groups, algo, paddings, df = key
+    attrs = {"strides": list(strides), "dilations": list(dil),
+             "groups": groups, "padding_algorithm": algo,
+             "paddings": list(paddings), "data_format": df}
+
+    @jax.custom_vjp
+    def conv(x, w):
+        return _conv2d_impl(x, w, attrs)
+
+    def fwd(x, w):
+        return conv(x, w), (x, w)
+
+    def bwd(res, dy):
+        x, w = res
+        # dX: XLA's transposed-conv lowering via the standard vjp
+        _, vjp_x = jax.vjp(lambda x_: _conv2d_impl(x_, w, attrs), x)
+        (dx,) = vjp_x(dy)
+        # dW: im2col patches -> one matmul
+        o, cg, kh, kw = w.shape
+        pad = _conv_padding(paddings, algo, 2)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, filter_shape=(kh, kw), window_strides=strides,
+            padding=pad if isinstance(pad, str) else tuple(pad),
+            rhs_dilation=dil,
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+        )  # [N, Ho, Wo, C*kh*kw], feature index = c*kh*kw + ki*kw + kj
+        dw_flat = jnp.einsum(
+            "nhwp,nhwo->op", patches, dy,
+            preferred_element_type=jnp.float32,
+        )
+        dw = dw_flat.reshape(o, cg, kh, kw).astype(w.dtype)
+        return dx, dw
+
+    conv.defvjp(fwd, bwd)
+    return conv
+
+
+def _use_im2col_dw(attrs, w_shape):
+    from ..fluid import flags as _flags
+
+    if not _flags.get_flags(
+            ["FLAGS_conv_dw_im2col"])["FLAGS_conv_dw_im2col"]:
+        return False
+    df = attrs.get("data_format", "NCHW")
+    groups = int(attrs.get("groups", 1))
+    kh, kw = int(w_shape[2]), int(w_shape[3])
+    # NHWC only (the patches layout above), grouped convs excluded, and
+    # 1x1 kernels gain nothing (dW already IS one matmul there)
+    return df == "NHWC" and groups == 1 and (kh, kw) != (1, 1)
+
+
 @register("conv2d")
 def conv2d(ctx, ins, attrs):
-    return {"Output": [_conv2d_impl(ins["Input"][0], ins["Filter"][0], attrs)]}
+    x, w = ins["Input"][0], ins["Filter"][0]
+    if _use_im2col_dw(attrs, w.shape):
+        fn = _conv2d_im2col_dw_fn(_conv2d_key(attrs))
+        return {"Output": [fn(x, w)]}
+    return {"Output": [_conv2d_impl(x, w, attrs)]}
 
 
 @register("depthwise_conv2d")

@@ -348,6 +348,39 @@ def test_dataloader_unpicklable_falls_back_to_fork():
     np.testing.assert_allclose(batches[0][0], [0.0, 2.0, 4.0])
 
 
+def test_dataloader_require_spawn_flag_hard_fails():
+    """FLAGS_dataloader_require_spawn (production configs): the fork()
+    fallback RAISES instead of warning — a silent fork in a long-running
+    job is a latent deadlock under the multithreaded JAX runtime."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.io import DataLoader, Dataset
+
+    secret = [3.0]
+
+    class Closure(Dataset):  # unpicklable on purpose
+        def __getitem__(self, i):
+            return (np.float32(i * secret[0]),)
+
+        def __len__(self):
+            return 6
+
+    fluid.flags.set_flags({"FLAGS_dataloader_require_spawn": True})
+    try:
+        with pytest.raises(RuntimeError,
+                           match="FLAGS_dataloader_require_spawn"):
+            list(DataLoader(Closure(), batch_size=3, return_list=True,
+                            num_workers=2))
+        # picklable datasets are unaffected by the flag
+        batches = list(DataLoader(_SquareDataset(n=6), batch_size=2,
+                                  return_list=True, num_workers=2))
+        assert len(batches) == 3
+    finally:
+        fluid.flags.set_flags({"FLAGS_dataloader_require_spawn": False})
+
+
 def test_dataloader_iterable_dataset():
     import numpy as np
     import pytest

@@ -27,13 +27,16 @@ print("entry() compiled and ran:", [getattr(v, "shape", None) for v in out])
 PY
 
 echo "== FFI clients =="
-# the Go inference client is EXPERIMENTAL: this image ships no Go
-# toolchain, so it compiles only where one exists (clients/go/README.md)
+# the Go client's ABI is checked against capi.cc on EVERY run (dlsym
+# symbol presence + signature arity, tools/check_go_client.py); full
+# compilation additionally runs wherever a Go toolchain exists
+python tools/check_go_client.py
 if command -v go >/dev/null 2>&1; then
   (cd clients/go/paddle && go vet . && go build .)
   echo "go client: built"
 else
-  echo "go client: SKIPPED (no Go toolchain; marked experimental)"
+  echo "go client: ABI-checked only (no Go toolchain for compile; "
+  echo "  clients/go/README.md documents the consumer-side build)"
 fi
 
 echo "== sdist build =="
