@@ -30,6 +30,12 @@ class ResNetConfig:
     base_filters: int = 64
     # internal compute layout; "NHWC" = channel-minor (TPU-native)
     layout: str = "NHWC"
+    # fold 2x2 input blocks into channels and train a 4x4/s1 stem on 12
+    # channels instead of 7x7/s2 on 3 (the MLPerf TPU ResNet trick): a
+    # 3-in-channel conv runs the 128-lane MXU at 3/128 occupancy, the
+    # folded form at 12/128 with a quarter the positions. Same
+    # receptive-field family (4x4 folded = 8x8 unfolded ⊇ 7x7); NHWC only
+    stem_space_to_depth: bool = False
 
     @staticmethod
     def resnet50(num_classes: int = 1000) -> "ResNetConfig":
@@ -96,8 +102,29 @@ def resnet(cfg: ResNetConfig, images):
     x = images
     if layout == "NHWC":
         x = layers.transpose(x, [0, 2, 3, 1])
-    x = _conv_bn(x, cfg.base_filters, 7, stride=2, act="relu", name="stem",
-                 layout=layout)
+    s2d = (
+        getattr(cfg, "stem_space_to_depth", False)
+        and layout == "NHWC"
+        and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0
+    )
+    if s2d:
+        b, h, w, c = x.shape
+        x = layers.reshape(x, [b, h // 2, 2, w // 2, 2, c])
+        x = layers.transpose(x, [0, 1, 3, 2, 4, 5])
+        x = layers.reshape(x, [b, h // 2, w // 2, 4 * c])
+        # 4x4/s1 on the folded grid ≡ 8x8/s2 on the original; pad (2,1)
+        # keeps the output aligned with the canonical 7x7/s2 pad-3 stem
+        conv = layers.conv2d(
+            x, cfg.base_filters, 4, stride=1, padding=[2, 1, 2, 1],
+            param_attr=ParamAttr(name="stem.w"), bias_attr=False,
+            data_format=layout,
+        )
+        x = layers.batch_norm(
+            conv, act="relu", param_attr=ParamAttr(name="stem.bn_s"),
+            bias_attr=ParamAttr(name="stem.bn_b"), data_layout=layout)
+    else:
+        x = _conv_bn(x, cfg.base_filters, 7, stride=2, act="relu",
+                     name="stem", layout=layout)
     x = layers.pool2d(x, 3, pool_type="max", pool_stride=2, pool_padding=1,
                       data_format=layout)
     filters = cfg.base_filters

@@ -47,3 +47,38 @@ def test_resnet50_program_builds():
     # (2 flops/MAC), step = 3x fwd -> ~23 GFLOP
     fl = resnet_step_flops(cfg, 1, 224)
     assert 18e9 < fl < 30e9, fl
+
+
+def test_resnet_s2d_stem_trains():
+    """stem_space_to_depth (fold 2x2 input blocks, 4x4/s1 stem): builds,
+    trains, and halves the stem's spatial grid exactly like 7x7/s2."""
+    import dataclasses
+
+    cfg = dataclasses.replace(ResNetConfig.tiny(num_classes=5),
+                              stem_space_to_depth=True)
+    B, S = 8, 32
+    main, startup = fluid.Program(), fluid.Program()
+    m, st, feeds, loss = build_resnet_train_program(cfg, B, S, main, startup)
+    with fluid.program_guard(m, st):
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-3).minimize(loss)
+    # the folded stem conv exists with the folded kernel shape
+    stem_ops = [op for op in m.global_block().ops
+                if op.type == "conv2d"
+                and op.input("Filter")[0].startswith("stem")]
+    w = m.global_block()._find_var_recursive(stem_ops[0].input("Filter")[0])
+    assert tuple(w.shape) == (cfg.base_filters, 12, 4, 4)
+
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 5, (B,)).astype(np.int64)
+    imgs = (rng.randn(B, 3, S, S) * 0.2 +
+            labels[:, None, None, None] * 0.5).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(st)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(m, feed={"image": imgs, "label": labels[:, None]},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
