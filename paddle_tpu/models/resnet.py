@@ -51,9 +51,11 @@ class ResNetConfig:
         return ResNetConfig(8, num_classes, [1, 1], base_filters=8)
 
 
-def _conv_bn(x, filters, ksize, stride=1, act=None, name="", layout="NCHW"):
+def _conv_bn(x, filters, ksize, stride=1, act=None, name="", layout="NCHW",
+             padding=None):
     conv = layers.conv2d(
-        x, filters, ksize, stride=stride, padding=(ksize - 1) // 2,
+        x, filters, ksize, stride=stride,
+        padding=(ksize - 1) // 2 if padding is None else padding,
         param_attr=ParamAttr(name=f"{name}.w"), bias_attr=False,
         data_format=layout,
     )
@@ -114,14 +116,8 @@ def resnet(cfg: ResNetConfig, images):
         x = layers.reshape(x, [b, h // 2, w // 2, 4 * c])
         # 4x4/s1 on the folded grid ≡ 8x8/s2 on the original; pad (2,1)
         # keeps the output aligned with the canonical 7x7/s2 pad-3 stem
-        conv = layers.conv2d(
-            x, cfg.base_filters, 4, stride=1, padding=[2, 1, 2, 1],
-            param_attr=ParamAttr(name="stem.w"), bias_attr=False,
-            data_format=layout,
-        )
-        x = layers.batch_norm(
-            conv, act="relu", param_attr=ParamAttr(name="stem.bn_s"),
-            bias_attr=ParamAttr(name="stem.bn_b"), data_layout=layout)
+        x = _conv_bn(x, cfg.base_filters, 4, stride=1, act="relu",
+                     name="stem", layout=layout, padding=[2, 1, 2, 1])
     else:
         x = _conv_bn(x, cfg.base_filters, 7, stride=2, act="relu",
                      name="stem", layout=layout)

@@ -65,7 +65,13 @@ _VMEM_BUDGET = 9 * 1024 * 1024 + 512 * 1024
 def _pick_block(s):
     """Largest block that tiles s, capped at 512: the whole score tile
     fits VMEM and bigger dots keep the MXU busy (128-blocks are
-    latency-bound: profiled 4x slower at S=512)."""
+    latency-bound: profiled 4x slower at S=512). PADDLE_FLASH_BLOCK
+    overrides for tuning sweeps (must divide s)."""
+    import os
+
+    forced = int(os.environ.get("PADDLE_FLASH_BLOCK", "0"))
+    if forced >= MIN_BLOCK and s % forced == 0:
+        return forced
     for cand in (512, 256, 128):
         if s % cand == 0:
             return cand
@@ -1271,8 +1277,21 @@ def flash_block_with_lse(q, k, v, key_bias=None, sm_scale=None,
 # dbias stay on the BHSD path.
 
 
+def _prescale_ok(sm_scale) -> bool:
+    """Fold sm_scale into q BEFORE the qk dot when it is a power of two
+    (d = 64/256 -> 1/8, 1/16): a bf16 exponent shift is EXACT, and it
+    deletes one [BQ, BK] f32 multiply per (head, k-block) from the
+    VPU-bound softmax pipeline. Non-pow2 scales (d=128) keep the
+    per-block multiply — prescaling would perturb every logit by the
+    bf16 rounding of the scale."""
+    import math
+
+    return math.frexp(float(sm_scale))[0] == 0.5
+
+
 def _make_fwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
-                         use_prng, has_mask, has_offsets, nh, d, bq, bk):
+                         use_prng, has_mask, has_offsets, nh, d, bq, bk,
+                         prescale=False):
     def kernel(*refs):
         it = iter(refs)
         q_ref = next(it)          # [1, BQ, H]
@@ -1300,6 +1319,8 @@ def _make_fwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
 
         for h in range(nh):
             q = q_ref[0, :, h * d:(h + 1) * d]   # [BQ, D] static lanes
+            if prescale:
+                q = q * jnp.asarray(sm_scale, q.dtype)
             bh = b * nh + h
 
             def body(i, carry, h=h, q=q, bh=bh):
@@ -1309,7 +1330,9 @@ def _make_fwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                ) * sm_scale
+                )
+                if not prescale:
+                    s = s * sm_scale
                 if has_bias:
                     s = s + bias_ref[0, 0, pl.ds(i * bk, bk)][None, :]
                 if causal:
@@ -1346,14 +1369,47 @@ def _make_fwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
     return kernel
 
 
+def _pick_block_bsh(s, skv, h, bwd=False, sync_bwd=False):
+    """BSH kernels tolerate bigger tiles than the streamed BHSD path
+    (whole-sequence VMEM residency is already the design): at S>=4096 a
+    1024 tile measured 0.4266 vs 0.4240 MFU (BERT-base s4096/b8, v5e) —
+    fewer block iterations amortize the per-block softmax epilogue.
+    Footprint gates (v5e-calibrated): the fwd holds k/v resident —
+    skv-sized, ~8 B/elem double-buffered — plus ~40MB of 1024-tile
+    temporaries; the bwd's q/do/dq residency measured 124MB at
+    (s8192, bq1024) vs the 112MB limit, so it escalates only at
+    s==4096 (fits; the full s4096/b8 bench runs it).
+
+    sync_bwd: in-kernel PRNG dropout seeds per (bh, q-block, k-block)
+    and draws [bq, bk] masks, so the keep pattern DEPENDS on the block
+    partition — when the fwd applied PRNG dropout, the bwd must
+    regenerate the identical mask, which means identical tiles. Callers
+    set sync_bwd on the fwd pick whenever use_prng, forcing the fwd
+    down to whatever the bwd can afford. Without dropout (or with a
+    materialized mask), mixed fwd/bwd tiles are fine — lse and delta
+    ride as full [B, nh, S] arrays."""
+    import os
+
+    forced = int(os.environ.get("PADDLE_FLASH_BLOCK", "0"))
+    if forced >= MIN_BLOCK and s % forced == 0:
+        return forced
+    if s >= 4096 and s % 1024 == 0:
+        if bwd or sync_bwd:
+            if s == 4096 and skv == 4096:
+                return 1024
+        elif 8 * skv * h + 40 * 2**20 <= _BSH_VMEM_LIMIT:
+            return 1024
+    return _pick_block(s)
+
+
 def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
                    causal, dropout_prob):
     b, sq, hdim = q.shape
     skv = k.shape[1]
     d = hdim // nh
-    bq = _pick_block(sq)
-    bk = _pick_block(skv)
     use_prng = dropout_prob > 0.0 and mask is None
+    bq = _pick_block_bsh(sq, skv, hdim, sync_bwd=use_prng)
+    bk = _pick_block_bsh(skv, skv, hdim, sync_bwd=use_prng)
     has_mask = mask is not None and dropout_prob > 0.0
     has_offsets = offsets is not None
     has_bias = bias is not None
@@ -1388,6 +1444,7 @@ def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
         sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
         has_bias=has_bias, use_prng=use_prng, has_mask=has_mask,
         has_offsets=has_offsets, nh=nh, d=d, bq=bq, bk=bk,
+        prescale=_prescale_ok(sm_scale),
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -1411,7 +1468,8 @@ def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
 
 
 def _make_bwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
-                         use_prng, has_mask, has_offsets, nh, d, bq, bk):
+                         use_prng, has_mask, has_offsets, nh, d, bq, bk,
+                         prescale=False):
     """Single-pass BSH backward: grid (B, NKv) with NKv innermost per
     batch row. Computes dk/dv for this k block and accumulates dq into a
     revisited f32 output block (index constant in ki -> stays resident;
@@ -1460,6 +1518,12 @@ def _make_bwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
             def body(i, carry, h=h, k=k, v=v, bh=bh):
                 dk, dv = carry
                 q = q_ref[0, pl.ds(i * bq, bq), h * d:(h + 1) * d]
+                if prescale:
+                    # exact pow2 shift; dk = ds_nos^T @ q_pre is then
+                    # ALREADY chain-rule scaled, and dq accumulates
+                    # unscaled ds_nos @ k with ONE final scale pass —
+                    # both per-block [BQ,BK] sm_scale multiplies gone
+                    q = q * jnp.asarray(sm_scale, q.dtype)
                 do = do_ref[0, pl.ds(i * bq, bq), h * d:(h + 1) * d]
                 lse = _to_sublanes(
                     lse_ref[0, h:h + 1, pl.ds(i * bq, bq)], ident
@@ -1470,7 +1534,9 @@ def _make_bwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                ) * sm_scale
+                )
+                if not prescale:
+                    s = s * sm_scale
                 if has_bias:
                     s = s + b_block[None, :]
                 if causal:
@@ -1498,7 +1564,10 @@ def _make_bwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
                     p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-                ds = (p * (dp * c - delta) * sm_scale).astype(q.dtype)
+                if prescale:
+                    ds = (p * (dp * c - delta)).astype(q.dtype)
+                else:
+                    ds = (p * (dp * c - delta) * sm_scale).astype(q.dtype)
                 dk = dk + jax.lax.dot_general(
                     ds, q, (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
@@ -1517,6 +1586,14 @@ def _make_bwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
             dk_ref[0, :, h * d:(h + 1) * d] = dk.astype(dk_ref.dtype)
             dv_ref[0, :, h * d:(h + 1) * d] = dv.astype(dv_ref.dtype)
 
+        if prescale:
+            # dq accumulated UNSCALED ds @ k across every ki: apply the
+            # chain-rule sm_scale once, on the resident f32 buffer,
+            # after the last k block of this batch row
+            @pl.when(ki == pl.num_programs(1) - 1)
+            def _scale_dq():
+                dq_ref[...] = dq_ref[...] * sm_scale
+
     return kernel
 
 
@@ -1525,8 +1602,8 @@ def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
     b, sq, hdim = q.shape
     skv = k.shape[1]
     d = hdim // nh
-    bq = _pick_block(sq)
-    bk = _pick_block(skv)
+    bq = _pick_block_bsh(sq, skv, hdim, bwd=True)
+    bk = _pick_block_bsh(skv, skv, hdim, bwd=True)
     use_prng = dropout_prob > 0.0 and mask is None
     has_mask = mask is not None and dropout_prob > 0.0
     has_offsets = offsets is not None
@@ -1571,6 +1648,7 @@ def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
             sm_scale=sm_scale, causal=causal, dropout_prob=dropout_prob,
             has_bias=has_bias, use_prng=use_prng, has_mask=has_mask,
             has_offsets=has_offsets, nh=nh, d=d, bq=bq, bk=bk,
+            prescale=_prescale_ok(sm_scale),
         ),
         grid=(b, skv // bk),
         in_specs=in_specs,
