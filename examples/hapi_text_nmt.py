@@ -1,0 +1,60 @@
+"""hapi.text transformer NMT under Model.fit (reference
+incubate/hapi/text + the hapi transformer example).
+
+    python examples/hapi_text_nmt.py
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.hapi import Input, Model, text
+
+B, S, T, V, H, NH = 16, 24, 20, 200, 64, 4
+
+
+def main():
+    enc = text.TransformerEncoder(n_layer=2, n_head=NH, d_model=H,
+                                  d_inner_hid=4 * H, name="enc")
+    dec = text.TransformerDecoder(n_layer=2, n_head=NH, d_model=H,
+                                  d_inner_hid=4 * H, name="dec")
+
+    def network(src_ids, trg_ids, src_mask):
+        semb = layers.add_position_encoding(layers.scale(
+            layers.embedding(src_ids, size=[V, H],
+                             param_attr=fluid.ParamAttr(name="src_emb")),
+            scale=H ** 0.5), alpha=1.0, beta=1.0)
+        bias = layers.unsqueeze(layers.unsqueeze(layers.scale(
+            layers.cast(src_mask, "float32"), scale=1e4, bias=-1e4),
+            [1]), [1])
+        temb = layers.add_position_encoding(layers.scale(
+            layers.embedding(trg_ids, size=[V, H],
+                             param_attr=fluid.ParamAttr(name="trg_emb")),
+            scale=H ** 0.5), alpha=1.0, beta=1.0)
+        out = dec(temb, enc(semb, bias), bias)
+        return layers.fc(out, V, num_flatten_dims=2)
+
+    def loss_fn(logits, label):
+        return layers.mean(layers.softmax_with_cross_entropy(logits, label))
+
+    # synthetic reversal task: target = reversed source prefix
+    rng = np.random.RandomState(0)
+    n = 256
+    src = rng.randint(2, V, (n, S)).astype(np.int64)
+    trg = src[:, :T][:, ::-1].copy()
+    lbl = np.roll(trg, -1, axis=1)[..., None]
+    mask = np.ones((n, S), np.int64)
+
+    model = Model(
+        network,
+        [Input("src", [B, S], "int64"), Input("trg", [B, T], "int64"),
+         Input("mask", [B, S], "int64")],
+        Input("lbl", [B, T, 1], "int64"))
+    model.prepare(fluid.optimizer.AdamOptimizer(learning_rate=3e-3),
+                  loss_fn)
+    hist = model.fit((src, trg, mask, lbl), batch_size=B, epochs=8,
+                     verbose=2)
+    print("loss trace:", [round(v, 3) for v in hist["loss"]])
+
+
+if __name__ == "__main__":
+    main()

@@ -283,18 +283,19 @@ class GeoSGDClient:
 
 
 def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
-                 num_trainers: Optional[int] = None, **kw):
+                 num_trainers: Optional[int] = None, endpoints=None, **kw):
     """mode: "sync" — per-step gradient push with a server-side barrier
     across trainers (reference DistributeTranspiler sync_mode); "async"
     — per-step push applied on arrival (Downpour); "geo" — local
     optimizer + K-step delta push (Geo-SGD, geo_sgd_transpiler.py).
 
-    When the launcher exports PADDLE_PSERVERS_IP_PORT_LIST (launch.py
-    --server_num), the table is HOSTED: this process gets a RemoteTable
-    client and the rows live in the pserver process(es), shared by every
-    trainer (ps_server.py). Without it, the table is in-process (single
-    trainer / tests). In-process "sync" and "async" behave identically
-    (there is no peer to barrier with)."""
+    When `endpoints` is given, or the launcher exports
+    PADDLE_PSERVERS_IP_PORT_LIST (launch.py --server_num), the table is
+    HOSTED: this process gets a RemoteTable client and the rows live in
+    the pserver process(es), shared by every trainer (ps_server.py).
+    Without either, the table is in-process (single trainer / tests).
+    In-process "sync" and "async" behave identically (there is no peer
+    to barrier with)."""
     import os as _os
 
     from . import ps_server as _net
@@ -304,8 +305,9 @@ def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
     with _lock:
         if name in _tables:
             raise ValueError(f"table {name!r} already exists")
-        endpoints = _net.pserver_endpoints()
-        if endpoints and _net.training_role() == "TRAINER":
+        if endpoints is None and _net.training_role() == "TRAINER":
+            endpoints = _net.pserver_endpoints()
+        if endpoints:
             if mode not in ("sync", "async", "geo"):
                 raise ValueError(f"unknown PS mode {mode!r}")
             t = _net.RemoteTable(

@@ -1,0 +1,246 @@
+"""DistributeTranspiler: programs written with plain layers.embedding
+move onto the parameter server without model changes (reference
+transpiler/distribute_transpiler.py:545, here scoped to the one thing
+GSPMD does not subsume — host-resident lookup tables)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import ps, ps_server
+from paddle_tpu.fluid import layers
+
+ROWS, DIM, NCLS, B = 3000, 16, 5, 16
+
+
+def _build(emb_name):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [B], dtype="int64", append_batch_size=False)
+        y = layers.data("y", [B, 1], dtype="int64", append_batch_size=False)
+        emb = layers.embedding(
+            ids, size=[ROWS, DIM],
+            param_attr=fluid.ParamAttr(name=emb_name))
+        logits = layers.fc(emb, NCLS,
+                           param_attr=fluid.ParamAttr(name="cls_w"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    return main, startup, loss
+
+
+def _train(main, startup, loss, steps=80):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, ROWS, (B,)).astype(np.int64)
+    labels = (ids % NCLS).astype(np.int64)[:, None]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        out = []
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"ids": ids, "y": labels},
+                            fetch_list=[loss])
+            out.append(float(np.asarray(lv).reshape(())))
+    return out
+
+
+def test_transpile_rewrites_lookup_and_trains():
+    name = "tp_emb1"
+    ps.drop_table(name)
+    main, startup, loss = _build(name)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.server_learning_rate = 0.5
+    with fluid.program_guard(main, startup):
+        t = fluid.DistributeTranspiler(cfg)
+        tables = t.transpile(trainer_id=0, program=main,
+                             startup_program=startup)
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+    try:
+        assert tables == [name]
+        block = main.global_block()
+        assert not any(op.type.startswith("lookup_table")
+                       for op in block.ops)
+        dl = [op for op in block.ops
+              if op.type == "distributed_lookup_table"]
+        assert len(dl) == 1 and dl[0].attr("table_names") == [name]
+        # W left the device program entirely
+        assert block._find_var_recursive(name) is None
+        assert not any(
+            name in [n for ns in op.outputs.values() for n in ns]
+            for op in startup.global_block().ops)
+
+        losses = _train(main, startup, loss)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # the trained rows live in the host table
+        table = ps.get_table(name)
+        assert table.rows == ROWS and table.dim == DIM
+        assert table.push_calls > 0
+    finally:
+        ps.drop_table(name)
+
+
+def test_transpile_after_minimize_raises():
+    name = "tp_emb2"
+    ps.drop_table(name)
+    main, startup, loss = _build(name)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        t = fluid.DistributeTranspiler()
+        with pytest.raises(RuntimeError, match="BEFORE minimize"):
+            t.transpile(trainer_id=0, program=main,
+                        startup_program=startup)
+    ps.drop_table(name)
+
+
+def test_transpile_min_rows_threshold_keeps_small_tables_on_device():
+    name = "tp_emb3"
+    ps.drop_table(name)
+    main, startup, loss = _build(name)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_rows_for_ps = ROWS + 1  # table too small to move
+    with fluid.program_guard(main, startup):
+        t = fluid.DistributeTranspiler(cfg)
+        tables = t.transpile(trainer_id=0, program=main,
+                             startup_program=startup)
+    assert tables == []
+    assert any(op.type.startswith("lookup_table")
+               for op in main.global_block().ops)
+    ps.drop_table(name)
+
+
+def test_transpile_to_hosted_pserver():
+    """pservers="host:port" routes the transpiled table through the
+    networked data plane (RemoteTable over the TCP server)."""
+    addr, ready = {}, threading.Event()
+
+    def cb(a):
+        addr["ep"] = f"127.0.0.1:{a[1]}"
+        ready.set()
+
+    th = threading.Thread(target=ps_server.serve,
+                          args=(0, "127.0.0.1", cb), daemon=True)
+    th.start()
+    assert ready.wait(10)
+
+    name = "tp_emb4"
+    ps.drop_table(name)
+    main, startup, loss = _build(name)
+    try:
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.server_learning_rate = 0.5
+        with fluid.program_guard(main, startup):
+            t = fluid.DistributeTranspiler(cfg)
+            t.transpile(trainer_id=0, program=main, pservers=addr["ep"],
+                        trainers=1, startup_program=startup)
+            fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+        table = ps.get_table(name)
+        assert type(table).__name__ == "RemoteTable"
+        losses = _train(main, startup, loss)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        assert table.stats()["push_calls"] > 0
+    finally:
+        ps.drop_table(name)
+        try:
+            ps_server._Conn(addr["ep"]).call("shutdown")
+        except Exception:
+            pass
+
+
+def test_transpile_geo_mode():
+    name = "tp_emb5"
+    ps.drop_table(name)
+    main, startup, loss = _build(name)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = "geo"
+    cfg.geo_sgd_need_push_nums = 5
+    cfg.server_learning_rate = 0.5
+    with fluid.program_guard(main, startup):
+        t = fluid.DistributeTranspiler(cfg)
+        t.transpile(trainer_id=0, program=main, startup_program=startup)
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+    try:
+        table = ps.get_table(name)
+        assert type(table).__name__ == "GeoSGDClient"
+        losses = _train(main, startup, loss)
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+    finally:
+        ps.drop_table(name)
+
+
+def test_transpile_tied_embeddings_one_table():
+    """Two lookup ops sharing one W (tied embeddings) get ONE table and
+    both ops rewritten (review finding: the second create used to crash
+    mid-rewrite)."""
+    name = "tp_emb_tied"
+    ps.drop_table(name)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [B], dtype="int64", append_batch_size=False)
+        b = layers.data("b", [B], dtype="int64", append_batch_size=False)
+        ea = layers.embedding(a, size=[ROWS, DIM],
+                              param_attr=fluid.ParamAttr(name=name))
+        eb = layers.embedding(b, size=[ROWS, DIM],
+                              param_attr=fluid.ParamAttr(name=name))
+        loss = layers.mean(layers.square(layers.elementwise_sub(ea, eb)))
+        t = fluid.DistributeTranspiler()
+        tables = t.transpile(trainer_id=0, program=main,
+                             startup_program=startup)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    try:
+        assert tables == [name]
+        dl = [op for op in main.global_block().ops
+              if op.type == "distributed_lookup_table"]
+        assert len(dl) == 2
+        rng = np.random.RandomState(1)
+        feed = {"a": rng.randint(0, ROWS, (B,)).astype(np.int64),
+                "b": rng.randint(0, ROWS, (B,)).astype(np.int64)}
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv).reshape(())))
+    finally:
+        ps.drop_table(name)
+
+
+def test_transpile_rejects_padding_idx():
+    name = "tp_emb_pad"
+    ps.drop_table(name)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [B], dtype="int64",
+                          append_batch_size=False)
+        layers.embedding(ids, size=[ROWS, DIM], padding_idx=0,
+                         param_attr=fluid.ParamAttr(name=name))
+        t = fluid.DistributeTranspiler()
+        with pytest.raises(NotImplementedError, match="padding_idx"):
+            t.transpile(trainer_id=0, program=main,
+                        startup_program=startup)
+    ps.drop_table(name)
+
+
+def test_transpile_carries_gaussian_init_and_warns_on_others():
+    import warnings
+
+    from paddle_tpu.fluid.initializer import NormalInitializer
+
+    name = "tp_emb_init"
+    ps.drop_table(name)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [B], dtype="int64",
+                          append_batch_size=False)
+        layers.embedding(
+            ids, size=[ROWS, DIM],
+            param_attr=fluid.ParamAttr(
+                name=name, initializer=NormalInitializer(0.0, 0.33)))
+        t = fluid.DistributeTranspiler()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # gaussian maps -> no warning
+            t.transpile(trainer_id=0, program=main,
+                        startup_program=startup)
+    try:
+        table = ps.get_table(name)
+        # std carried into the host table's init
+        assert abs(float(table.to_dense().std()) - 0.33) < 0.02
+    finally:
+        ps.drop_table(name)
