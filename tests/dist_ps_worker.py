@@ -33,6 +33,13 @@ def main():
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
     mode = os.environ.get("PS_TEST_MODE", "sync")
     kill_rank = int(os.environ.get("PS_TEST_KILL_RANK", -1))
+    # crash-once drill (elastic restart): rank `kill_rank` dies at
+    # KILL_STEP on attempt 0 only; the restarted group must finish
+    # against the SURVIVING pserver (stale barrier round + partially
+    # trained table)
+    if (os.environ.get("PS_TEST_CRASH_ONCE") == "1"
+            and int(os.environ.get("PADDLE_ELASTIC_RESTART", 0)) > 0):
+        kill_rank = -1
 
     rng = np.random.RandomState(0)
     all_ids = rng.randint(0, ROWS, (GLOBAL_B,)).astype(np.int64)
