@@ -84,6 +84,39 @@ def _recv_msg(sock: socket.socket):
 # ---------------------------------------------------------------------------
 
 
+def _validated_state(state, table, name):
+    """Preload checkpoints fail LOUDLY instead of silently corrupting
+    the table: a RemoteTable.state_dict() ({"servers": [...]}) unwraps
+    only in the 1-server case, and the shard geometry must match the
+    table this server actually hosts (a full-table checkpoint loaded
+    into a multi-server PARTITION would misalign every row)."""
+    if isinstance(state, dict) and "servers" in state:
+        if len(state["servers"]) != 1:
+            raise ValueError(
+                f"preload {name!r}: checkpoint was saved from "
+                f"{len(state['servers'])} pservers; restore it into the "
+                f"same server count (per-server .pkl files)")
+        state = state["servers"][0]
+    shards = state.get("shards") if isinstance(state, dict) else None
+    if shards is None:
+        raise ValueError(
+            f"preload {name!r}: not a table state_dict (expected a "
+            f"'shards' key; got {type(state).__name__})")
+    rows = sum(int(s.shape[0]) for s in shards)
+    dims = {int(s.shape[1]) for s in shards}
+    if rows != table.rows or dims != {table.dim}:
+        raise ValueError(
+            f"preload {name!r}: checkpoint geometry [{rows}, {dims}] "
+            f"does not match this server's table "
+            f"[{table.rows}, {table.dim}] — on multi-server deployments "
+            f"each server needs ITS OWN partition checkpoint")
+    if len(shards) != table.num_shards:
+        raise ValueError(
+            f"preload {name!r}: checkpoint has {len(shards)} shards, "
+            f"table expects {table.num_shards}")
+    return state
+
+
 class _SyncState:
     """Per-table push barrier (sync mode): round r applies once all
     `num_trainers` contributions for r have arrived.
@@ -107,14 +140,20 @@ class _SyncState:
 
 
 class PSServer:
-    """Event loop owning the host tables (listen_and_serv analog)."""
+    """Event loop owning the host tables (listen_and_serv analog).
 
-    def __init__(self):
+    preload_dir (fleet.init_server(model_dir)): when a table is first
+    created, `<preload_dir>/<name>.pkl` — a `table.state_dict()` pickle
+    saved by a previous run — is loaded into it, the reference's
+    init_server checkpoint-restore contract."""
+
+    def __init__(self, preload_dir: Optional[str] = None):
         self.tables: Dict[str, ShardedHostTable] = {}
         self.specs: Dict[str, dict] = {}
         self.sync: Dict[str, _SyncState] = {}
         self.lock = threading.Lock()
         self.shutdown_event = threading.Event()
+        self.preload_dir = preload_dir
 
     # -- verbs -----------------------------------------------------------
 
@@ -133,6 +172,12 @@ class PSServer:
             kw = {k: v for k, v in spec.items()
                   if k not in ("name", "shape", "sync_trainers")}
             t = ShardedHostTable(name, spec["shape"], **kw)
+            if self.preload_dir:
+                path = os.path.join(self.preload_dir, f"{name}.pkl")
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        t.load_state_dict(
+                            _validated_state(pickle.load(f), t, name))
             self.tables[name] = t
             self.specs[name] = dict(spec)
             self.sync[name] = _SyncState(int(spec.get("sync_trainers", 0)))
@@ -257,11 +302,12 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None):
+def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
+          preload_dir: Optional[str] = None):
     """Run the pserver event loop (blocks). port=0 picks a free port;
     ready_cb (tests) receives the bound (host, port)."""
     srv = _TCPServer((host, port), _Handler)
-    srv.ps = PSServer()  # type: ignore[attr-defined]
+    srv.ps = PSServer(preload_dir=preload_dir)  # type: ignore[attr-defined]
     if ready_cb is not None:
         ready_cb(srv.server_address)
     try:
@@ -275,13 +321,16 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int,
                    default=int(os.environ.get("PADDLE_PORT", 0)))
     p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--preload_dir", default=os.environ.get(
+        "PADDLE_PS_PRELOAD_DIR", ""))
     args = p.parse_args(argv)
 
     def ready(addr):
         # the launcher reads this line to learn the bound port
         print(f"[ps_server] listening on {addr[0]}:{addr[1]}", flush=True)
 
-    serve(args.port, args.host, ready_cb=ready)
+    serve(args.port, args.host, ready_cb=ready,
+          preload_dir=args.preload_dir or None)
     return 0
 
 

@@ -39,6 +39,73 @@ def is_first_worker() -> bool:
     return get_rank() == 0
 
 
+# -- PS-role lifecycle (reference fleet_base.py:235-249) -------------------
+
+
+def init_worker() -> None:
+    """Trainer-side PS bootstrap (reference fleet_base.init_worker).
+    RemoteTable clients connect lazily on create_table, so this only
+    bootstraps the coordination env; kept for API parity — launched
+    trainer scripts can call it unconditionally."""
+    init_parallel_env()
+
+
+def init_server(model_dir: Optional[str] = None, **kwargs) -> None:
+    """Server-side init (reference fleet_base.init_server): record the
+    checkpoint directory whose `<table>.pkl` state_dicts preload each
+    table on first creation (saved via `ps.get_table(n).state_dict()`)."""
+    _fleet_state["ps_model_dir"] = model_dir
+
+
+def run_server() -> None:
+    """Run the pserver event loop on PADDLE_PORT (blocks until a client
+    sends shutdown — the listen_and_serv analog, distributed/
+    ps_server.py). The process role contract matches the reference:
+    TRAINING_ROLE=PSERVER processes call init_server() + run_server(),
+    trainers call init_worker() and train. PADDLE_PORT is required:
+    trainers resolve a FIXED port from PADDLE_PSERVERS_IP_PORT_LIST, so
+    binding an ephemeral one would wedge the job undiscoverably."""
+    import os as _os
+
+    from ..distributed import ps_server
+
+    port = int(_os.environ.get("PADDLE_PORT", 0))
+    if port <= 0:
+        raise RuntimeError(
+            "fleet.run_server: PADDLE_PORT is not set; the pserver must "
+            "bind the port trainers were told about "
+            "(PADDLE_PSERVERS_IP_PORT_LIST). For an OS-assigned port use "
+            "`python -m paddle_tpu.distributed.ps_server --port 0`, "
+            "which prints the bound port")
+
+    def ready(addr):
+        print(f"[fleet.run_server] listening on {addr[0]}:{addr[1]}",
+              flush=True)
+
+    ps_server.serve(
+        port=port,
+        preload_dir=_fleet_state.get("ps_model_dir"),
+        ready_cb=ready,
+    )
+
+
+def stop_worker() -> None:
+    """Trainer-side teardown (reference fleet_base.stop_worker): flush
+    pending Geo deltas, close RemoteTable connections, and drop the
+    tables from the process-local registry so a restarted training
+    phase can create_table again."""
+    from ..distributed import ps
+
+    for name, t in list(ps._tables.items()):
+        if hasattr(t, "flush"):
+            t.flush()
+        closer = getattr(t, "close", None) or getattr(
+            getattr(t, "server", None), "close", None)
+        if closer:
+            closer()
+        ps.drop_table(name)
+
+
 def worker_index() -> int:
     return get_rank()
 

@@ -369,3 +369,103 @@ def test_elastic_restart_with_surviving_pserver(tmp_path):
     assert len(t0["losses"]) == len(t1["losses"])
     np.testing.assert_allclose(t0["table_sum"], t1["table_sum"], rtol=0)
     assert np.isfinite(t0["losses"]).all()
+
+
+def test_fleet_server_lifecycle_with_preload(tmp_path):
+    """fleet.init_server(model_dir)/run_server/init_worker/stop_worker
+    (reference fleet_base.py:235-249): the server preloads table
+    checkpoints, trainers connect/train/flush through the fleet
+    surface."""
+    import pickle
+
+    import paddle_tpu.fleet as fleet
+
+    # checkpoint from a "previous run": a known table state
+    seed_table = ps.ShardedHostTable("lc_tbl", (60, 4), num_shards=2,
+                                     learning_rate=0.5, seed=11)
+    seed_table.push_gradients(np.arange(60, dtype=np.int64),
+                              np.ones((60, 4), np.float32))
+    want = seed_table.to_dense().copy()
+    with open(tmp_path / "lc_tbl.pkl", "wb") as f:
+        pickle.dump(seed_table.state_dict(), f)
+
+    # the REAL fleet wiring: init_server(model_dir) -> run_server on
+    # PADDLE_PORT (a typo in the preload plumbing must fail this test)
+    import socket as _socket
+    import time as _time
+
+    with _socket.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+    os.environ["PADDLE_PORT"] = str(port)
+    ep = f"127.0.0.1:{port}"
+
+    def run_srv():
+        fleet.init_server(model_dir=str(tmp_path))
+        fleet.run_server()
+
+    th = threading.Thread(target=run_srv, daemon=True)
+    th.start()
+    for _ in range(100):
+        try:
+            ps_server._Conn(ep).call("ping")
+            break
+        except OSError:
+            _time.sleep(0.1)
+
+    ps.drop_table("lc_tbl")
+    try:
+        fleet.init_worker()
+        t = ps.create_table("lc_tbl", shape=(60, 4), num_shards=2,
+                            learning_rate=0.5, seed=11, endpoints=[ep])
+        # the server restored the checkpointed rows, not a fresh init
+        np.testing.assert_array_equal(t.gather(np.arange(60)), want)
+        # geometry-mismatched checkpoints fail LOUDLY, not silently
+        with open(tmp_path / "lc_bad.pkl", "wb") as f:
+            pickle.dump(seed_table.state_dict(), f)  # 60 rows
+        with pytest.raises(RuntimeError, match="geometry"):
+            ps.create_table("lc_bad", shape=(30, 4), num_shards=2,
+                            endpoints=[ep])
+        ps.drop_table("lc_bad")
+        fleet.stop_worker()  # closes AND unregisters the client
+        assert "lc_tbl" not in ps._tables
+    finally:
+        ps.drop_table("lc_tbl")
+        os.environ.pop("PADDLE_PORT", None)
+        try:
+            ps_server._Conn(ep).call("shutdown")
+        except Exception:
+            pass
+
+
+def test_fleet_run_server_blocks_and_shuts_down():
+    """fleet.run_server() hosts on PADDLE_PORT until shutdown."""
+    import socket
+
+    import paddle_tpu.fleet as fleet
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    os.environ["PADDLE_PORT"] = str(port)
+    try:
+        fleet.init_server()
+        th = threading.Thread(target=fleet.run_server, daemon=True)
+        th.start()
+        ep = f"127.0.0.1:{port}"
+        deadline = 50
+        for _ in range(deadline):
+            try:
+                assert ps_server._Conn(ep).call("ping") == "pong"
+                break
+            except OSError:
+                import time
+
+                time.sleep(0.1)
+        else:
+            raise AssertionError("fleet.run_server never came up")
+        ps_server._Conn(ep).call("shutdown")
+        th.join(timeout=10)
+        assert not th.is_alive(), "run_server must return after shutdown"
+    finally:
+        os.environ.pop("PADDLE_PORT", None)
