@@ -259,8 +259,12 @@ class Executor:
             (n, tuple(a.shape), str(a.dtype))
             for n, a in sorted(feed_arrays.items())
         )
+        from .flags import flag
+
+        # diagnostic flags belong in the key: toggling one to debug must
+        # recompile, not silently hit the pre-toggle cache entry
         return (program._serial, program._version, feed_sig, fetch_names,
-                check_nan)
+                check_nan, flag("FLAGS_enable_unused_var_check"))
 
     def _prepare_feed(self, block, feed):
         import jax
@@ -302,6 +306,30 @@ class Executor:
                     state_in.append(n)
             for n in op.output_names():
                 written.add(n)
+
+        from .flags import flag
+
+        if flag("FLAGS_enable_unused_var_check"):
+            # reference unused_var_check.cc (FLAGS_enable_unused_var_check,
+            # operator.cc:987): surface feeds no op consumes — the
+            # classic silently-ignored-input bug. Sub-block programs read
+            # outer vars through their own ops, so only block-0 feeds
+            # are checkable here; fetch-only feeds are legitimate.
+            consumed = {
+                n for b in program.blocks for op in b.ops
+                for n in op.input_names()
+            }
+            unused = [n for n in feed_names
+                      if n not in consumed and n not in fetch_names]
+            if unused:
+                import warnings
+
+                # _compile <- _ensure_compiled <- run <- user call site
+                warnings.warn(
+                    f"Executor: feed variable(s) {unused} are consumed "
+                    f"by no op in the program (FLAGS_enable_unused_var_"
+                    f"check) — a misspelled feed name or dead input?",
+                    RuntimeWarning, stacklevel=4)
         # fetches that are pure feeds/state also work
         for n in fetch_names:
             if n not in written and n not in state_in and n not in feed_names:

@@ -24,6 +24,10 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_use_fused_ln": (True, "ops/pallas/add_ln.py residual+LayerNorm "
                                  "kernel gate (encoder/decoder stacks, "
                                  "layer_norm emitter)"),
+    "FLAGS_enable_unused_var_check": (
+        False, "Executor._compile warns when a feed variable is consumed "
+               "by no op (reference unused_var_check.cc / operator.cc:987 "
+               "— the silently-ignored-input bug class)"),
     "FLAGS_conv_dw_im2col": (
         False, "ops/nn_ops.py conv2d: reformulate the WEIGHT gradient as "
                "im2col patches + one matmul (MXU-friendly) instead of "

@@ -97,6 +97,60 @@ def _load_arrays(dirname: str, names: List[str], scope,
         scope.set_var(n, jnp.asarray(a))
 
 
+def _ps_table_names(program) -> List[str]:
+    names = []
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "distributed_lookup_table":
+                # mirror the emitter's attr fallback (ops/ps_ops.py:95)
+                got = op.attr("table_names", []) or (
+                    [op.attr("table_name")] if op.attr("table_name")
+                    else [])
+                names.extend(got)
+    return sorted(set(names))
+
+
+def _save_ps_tables(dirname: str, program) -> None:
+    """Checkpoint host/pserver tables alongside the scope persistables
+    (the reference pulls parameter blocks back from pservers at save —
+    io.py:1019 + checkpoint_notify_op; here the table's state_dict is
+    pickled to `<dirname>/<table>.pkl`, the SAME format
+    fleet.init_server(model_dir)/ps_server preload restores from)."""
+    import warnings
+
+    from ..distributed import ps
+
+    for name in _ps_table_names(program):
+        try:
+            t = ps.get_table(name)
+        except KeyError:
+            # surface NOW, not at the far-away restore: loading this
+            # "successful" checkpoint would fail on the missing .pkl
+            warnings.warn(
+                f"save: program references PS table {name!r} but no such "
+                f"table is registered in this process — the checkpoint "
+                f"will NOT contain it and load_persistables will reject "
+                f"it. create_table before saving (or drop the lookup op)",
+                RuntimeWarning, stacklevel=3)
+            continue
+        with open(os.path.join(dirname, f"{name}.pkl"), "wb") as f:
+            pickle.dump(t.state_dict(), f)
+
+
+def _load_ps_tables(dirname: str, program) -> None:
+    for name in _ps_table_names(program):
+        path = os.path.join(dirname, f"{name}.pkl")
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"checkpoint at {dirname!r} is missing PS table "
+                f"{name!r} ({name}.pkl); the program's "
+                f"distributed_lookup_table ops cannot resume without it")
+        from ..distributed import ps
+
+        with open(path, "rb") as f:
+            ps.get_table(name).load_state_dict(pickle.load(f))
+
+
 def save_params(executor, dirname, main_program=None, filename=None):
     """reference io.py:373 — trainable parameters only."""
     program = main_program or framework.default_main_program()
@@ -104,9 +158,11 @@ def save_params(executor, dirname, main_program=None, filename=None):
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):
-    """reference io.py:598 — params + optimizer moments + LR etc."""
+    """reference io.py:598 — params + optimizer moments + LR etc.;
+    host/pserver embedding tables ride along as <table>.pkl."""
     program = main_program or framework.default_main_program()
     _save_arrays(dirname, _persistable_names(program), global_scope(), filename)
+    _save_ps_tables(dirname, program)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -117,6 +173,7 @@ def load_params(executor, dirname, main_program=None, filename=None):
 def load_persistables(executor, dirname, main_program=None, filename=None):
     program = main_program or framework.default_main_program()
     _load_arrays(dirname, _persistable_names(program), global_scope(), filename)
+    _load_ps_tables(dirname, program)
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +351,10 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 def save(program, model_path: str):
-    """Orbax sharded checkpoint of all persistables (+ program text)."""
+    """Orbax sharded checkpoint of all persistables (+ program text);
+    host/pserver tables ride along as `<model_path>.ps/<table>.pkl` —
+    the table's W left the device program (transpiler), so the scope
+    walk alone would silently lose the embedding state."""
     import orbax.checkpoint as ocp
 
     scope = global_scope()
@@ -309,6 +369,9 @@ def save(program, model_path: str):
     ckptr.wait_until_finished()
     with open(path + ".pdmodel", "wb") as f:
         f.write(_serialize_program(program))
+    if _ps_table_names(program):
+        os.makedirs(path + ".ps", exist_ok=True)
+        _save_ps_tables(path + ".ps", program)
 
 
 def load(program, model_path: str, executor=None):
@@ -321,6 +384,8 @@ def load(program, model_path: str, executor=None):
     restored = ckptr.restore(path + ".ckpt")
     for n, a in restored.items():
         scope.set_var(n.replace("__slash__", "/"), jax.numpy.asarray(a))
+    if _ps_table_names(program):
+        _load_ps_tables(path + ".ps", program)
 
 
 # ---------------------------------------------------------------------------

@@ -185,3 +185,173 @@ def test_inference_model_saves_buffers_and_encrypts_params(tmp_path):
         (ov,) = exe.run(prog, feed={feeds[0]: np.ones((4, 3), np.float32)},
                         fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(ov), np.asarray(rv), rtol=1e-6)
+
+
+def test_persistables_checkpoint_includes_ps_tables(tmp_path):
+    """A PS-embedding program's save/load_persistables carries the host
+    table (the reference pulls parameter blocks from pservers at save,
+    io.py:1019); the .pkl format matches the pserver preload contract
+    (fleet.init_server(model_dir))."""
+    import numpy as np
+
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.fluid import layers
+
+    name = "ckpt_tbl"
+    ps.drop_table(name)
+    t = ps.create_table(name, shape=(200, 8), learning_rate=0.5, seed=3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [4], dtype="int64",
+                          append_batch_size=False)
+        emb = layers.distributed_embedding(ids, name)
+        loss = layers.mean(emb)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    try:
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            exe.run(main, feed={"ids": np.asarray([1, 2, 3, 1], "i8")},
+                    fetch_list=[loss])
+            fluid.io.save_persistables(exe, str(tmp_path), main)
+            assert (tmp_path / f"{name}.pkl").exists()
+            snapshot = t.to_dense().copy()
+            # train further, then restore: the table must roll back
+            exe.run(main, feed={"ids": np.asarray([1, 2, 3, 1], "i8")},
+                    fetch_list=[loss])
+            assert not np.allclose(t.to_dense(), snapshot)
+            fluid.io.load_persistables(exe, str(tmp_path), main)
+            np.testing.assert_array_equal(t.to_dense(), snapshot)
+
+        # a checkpoint missing the table file fails loudly
+        (tmp_path / f"{name}.pkl").unlink()
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            import pytest as _pytest
+
+            with _pytest.raises(RuntimeError, match="missing PS table"):
+                fluid.io.load_persistables(exe, str(tmp_path), main)
+    finally:
+        ps.drop_table(name)
+
+
+def test_unused_var_check_flag_warns(tmp_path):
+    """FLAGS_enable_unused_var_check (reference unused_var_check.cc):
+    a feed no op consumes triggers a warning naming it."""
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        fluid.data("dead_input", [4, 1], "float32")
+        y = layers.fc(x, 2)
+    exe = fluid.Executor()
+    fluid.set_flags({"FLAGS_enable_unused_var_check": True})
+    try:
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            feed = {"x": np.zeros((4, 3), "f4"),
+                    "dead_input": np.zeros((4, 1), "f4")}
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                exe.run(main, feed=feed, fetch_list=[y])
+            assert any("dead_input" in str(x.message) for x in w), (
+                [str(x.message) for x in w])
+    finally:
+        fluid.set_flags({"FLAGS_enable_unused_var_check": False})
+
+
+def test_unused_var_check_toggle_after_compile_still_fires(tmp_path):
+    """The debug flag participates in the compile-cache key: turning it
+    ON after the program already compiled must still warn (the
+    turn-it-on-to-debug workflow)."""
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 3], "float32")
+        fluid.data("phantom", [2, 1], "float32")
+        y = layers.fc(x, 2)
+    exe = fluid.Executor()
+    feed = {"x": np.zeros((2, 3), "f4"), "phantom": np.zeros((2, 1), "f4")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[y])  # compiled, flag off
+        fluid.set_flags({"FLAGS_enable_unused_var_check": True})
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                exe.run(main, feed=feed, fetch_list=[y])
+            assert any("phantom" in str(i.message) for i in w)
+        finally:
+            fluid.set_flags({"FLAGS_enable_unused_var_check": False})
+
+
+def test_orbax_save_load_includes_ps_tables(tmp_path):
+    """fluid.io.save/load (new-style Orbax) carry PS tables too — the
+    table's W left the device program, so the scope walk alone would
+    silently lose the embedding state."""
+    import numpy as np
+
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.fluid import layers
+
+    name = "orbax_tbl"
+    ps.drop_table(name)
+    t = ps.create_table(name, shape=(50, 4), learning_rate=0.5, seed=9)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [3], dtype="int64",
+                          append_batch_size=False)
+        loss = layers.mean(layers.distributed_embedding(ids, name))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    try:
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            feed = {"ids": np.asarray([1, 2, 1], "i8")}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            fluid.io.save(main, str(tmp_path / "m"))
+            snap = t.to_dense().copy()
+            exe.run(main, feed=feed, fetch_list=[loss])
+            assert not np.allclose(t.to_dense(), snap)
+            fluid.io.load(main, str(tmp_path / "m"))
+            np.testing.assert_array_equal(t.to_dense(), snap)
+    finally:
+        ps.drop_table(name)
+
+
+def test_save_warns_on_unregistered_ps_table(tmp_path):
+    """A program referencing a PS table that is not registered warns AT
+    SAVE TIME instead of producing a checkpoint that fails at restore."""
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.fluid import layers
+
+    name = "ghost_tbl"
+    ps.drop_table(name)
+    t = ps.create_table(name, shape=(20, 4))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [2], dtype="int64",
+                          append_batch_size=False)
+        layers.distributed_embedding(ids, name)
+    ps.drop_table(name)  # now the program references a ghost
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fluid.io.save_persistables(exe, str(tmp_path), main)
+    assert any("ghost_tbl" in str(i.message) for i in w)
