@@ -35,10 +35,13 @@ from ..fluid.layer_helper import LayerHelper
 from ..fluid.param_attr import ParamAttr
 
 __all__ = [
-    "BasicLSTMCell", "BasicGRUCell", "RNN", "BidirectionalRNN",
-    "Conv1dPoolLayer", "CNNEncoder", "TransformerEncoder",
-    "TransformerDecoder", "DynamicDecode", "LinearChainCRF",
-    "CRFDecoding", "SequenceTagging", "Seq2SeqEncoder", "Seq2SeqDecoder",
+    "BasicLSTMCell", "BasicGRUCell", "StackedRNNCell", "StackedLSTMCell",
+    "StackedGRUCell", "LSTM", "GRU", "BidirectionalLSTM",
+    "BidirectionalGRU", "RNN", "BidirectionalRNN", "Conv1dPoolLayer",
+    "CNNEncoder", "PrePostProcessLayer", "MultiHeadAttention", "FFN",
+    "TransformerEncoder", "TransformerDecoder", "DynamicDecode",
+    "LinearChainCRF", "CRFDecoding", "SequenceTagging", "Seq2SeqEncoder",
+    "Seq2SeqDecoder",
 ]
 
 
@@ -74,6 +77,143 @@ class BasicGRUCell(layers.GRUCell):
             gate_activation=gate_activation, activation=activation,
             dtype=dtype, name=name or unique_name.generate("basic_gru_cell"))
         self.input_size = input_size
+
+
+class StackedRNNCell(layers.RNNCell):
+    """Reference StackedRNNCell (text.py:639): a stack of cells behaving
+    as ONE cell — step input flows cell 0 -> 1 -> ... The composite
+    state is FLAT ([h0, c0, h1, c1, ...]): the scanned runner
+    (layers.rnn / StaticRNN) carries one memory per state Variable, so
+    nesting must not reach it."""
+
+    def __init__(self, cells):
+        self.cells = list(cells)
+
+    def _counts(self):
+        # states-per-cell from the declared shapes ([..]=1, [[..],..]=n)
+        out = []
+        for c in self.cells:
+            s = c.state_shape
+            out.append(len(s) if isinstance(s[0], (list, tuple)) else 1)
+        return out
+
+    @property
+    def state_shape(self):
+        flat = []
+        for c in self.cells:
+            s = c.state_shape
+            flat.extend(s if isinstance(s[0], (list, tuple)) else [s])
+        return flat
+
+    def call(self, inputs, states):
+        new_states = []
+        out = inputs
+        i = 0
+        for cell, n in zip(self.cells, self._counts()):
+            st = states[i:i + n]
+            out, ns = cell.call(out, st[0] if n == 1 else st)
+            new_states.extend(ns if isinstance(ns, (list, tuple)) else [ns])
+            i += n
+        return out, new_states
+
+
+class StackedLSTMCell(StackedRNNCell):
+    """Reference StackedLSTMCell (text.py:734)."""
+
+    def __init__(self, input_size=None, hidden_size=128, num_layers=1,
+                 forget_bias=1.0, dtype="float32", name=None):
+        name = name or unique_name.generate("stacked_lstm")
+        super().__init__([
+            BasicLSTMCell(hidden_size=hidden_size,
+                          forget_bias=forget_bias, dtype=dtype,
+                          name=f"{name}.l{i}")
+            for i in range(num_layers)
+        ])
+
+
+class StackedGRUCell(StackedRNNCell):
+    """Reference StackedGRUCell (text.py:1337)."""
+
+    def __init__(self, input_size=None, hidden_size=128, num_layers=1,
+                 dtype="float32", name=None):
+        name = name or unique_name.generate("stacked_gru")
+        super().__init__([
+            BasicGRUCell(hidden_size=hidden_size, dtype=dtype,
+                         name=f"{name}.l{i}")
+            for i in range(num_layers)
+        ])
+
+
+class LSTM:
+    """Reference LSTM (text.py:886): multi-layer LSTM over a sequence.
+    Returns (outputs, final_states) where final_states is the stacked
+    cell's FLAT state list [h0, c0, h1, c1, ...] (see StackedRNNCell —
+    layer i's final h/c are final_states[2*i] / final_states[2*i+1])."""
+
+    def __init__(self, input_size=None, hidden_size=128, num_layers=1,
+                 forget_bias=1.0, is_reverse=False, time_major=False,
+                 dtype="float32", name=None):
+        self.cell = StackedLSTMCell(input_size, hidden_size, num_layers,
+                                    forget_bias, dtype, name)
+        self.rnn = RNN(self.cell, is_reverse=is_reverse,
+                       time_major=time_major)
+
+    def __call__(self, inputs, initial_states=None, sequence_length=None):
+        return self.rnn(inputs, initial_states, sequence_length)
+
+
+class GRU:
+    """Reference GRU (text.py:1470)."""
+
+    def __init__(self, input_size=None, hidden_size=128, num_layers=1,
+                 is_reverse=False, time_major=False, dtype="float32",
+                 name=None):
+        self.cell = StackedGRUCell(input_size, hidden_size, num_layers,
+                                   dtype, name)
+        self.rnn = RNN(self.cell, is_reverse=is_reverse,
+                       time_major=time_major)
+
+    def __call__(self, inputs, initial_states=None, sequence_length=None):
+        return self.rnn(inputs, initial_states, sequence_length)
+
+
+class BidirectionalLSTM:
+    """Reference BidirectionalLSTM (text.py:1144): concat merge."""
+
+    def __init__(self, input_size=None, hidden_size=128, num_layers=1,
+                 forget_bias=1.0, time_major=False, dtype="float32",
+                 name=None):
+        name = name or unique_name.generate("bilstm")
+        self.time_major = bool(time_major)
+        self.fw = StackedLSTMCell(input_size, hidden_size, num_layers,
+                                  forget_bias, dtype, f"{name}.fw")
+        self.bw = StackedLSTMCell(input_size, hidden_size, num_layers,
+                                  forget_bias, dtype, f"{name}.bw")
+
+    def __call__(self, inputs, initial_states=None, sequence_length=None):
+        return layers.birnn(self.fw, self.bw, inputs,
+                            initial_states=initial_states,
+                            sequence_length=sequence_length,
+                            time_major=self.time_major)
+
+
+class BidirectionalGRU:
+    """Reference BidirectionalGRU (text.py:1581)."""
+
+    def __init__(self, input_size=None, hidden_size=128, num_layers=1,
+                 time_major=False, dtype="float32", name=None):
+        name = name or unique_name.generate("bigru")
+        self.time_major = bool(time_major)
+        self.fw = StackedGRUCell(input_size, hidden_size, num_layers,
+                                 dtype, f"{name}.fw")
+        self.bw = StackedGRUCell(input_size, hidden_size, num_layers,
+                                 dtype, f"{name}.bw")
+
+    def __call__(self, inputs, initial_states=None, sequence_length=None):
+        return layers.birnn(self.fw, self.bw, inputs,
+                            initial_states=initial_states,
+                            sequence_length=sequence_length,
+                            time_major=self.time_major)
 
 
 class RNN:
@@ -190,6 +330,101 @@ class CNNEncoder:
     def __call__(self, x):
         outs = [conv(x) for conv in self.convs]
         return layers.concat(outs, axis=-1) if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# transformer sub-blocks (reference text.py PrePostProcessLayer:2609,
+# MultiHeadAttention:2687, FFN:2900) — the composable pieces; whole
+# stacks should prefer TransformerEncoder/Decoder below (fused scan)
+# ---------------------------------------------------------------------------
+
+
+class PrePostProcessLayer:
+    """process_cmd string: 'a' residual add, 'n' layer_norm,
+    'd' dropout — applied in order (reference text.py:2609)."""
+
+    def __init__(self, process_cmd, d_model=None, dropout_rate=0.0,
+                 name=None):
+        self.cmd = process_cmd
+        self.dropout_rate = float(dropout_rate)
+        self.name = name or unique_name.generate("prepost")
+
+    def __call__(self, prev_out, out=None, is_test=False):
+        x = out if out is not None else prev_out
+        for c in self.cmd:
+            if c == "a" and prev_out is not None and out is not None:
+                x = layers.elementwise_add(prev_out, x)
+            elif c == "n":
+                x = layers.layer_norm(
+                    x, begin_norm_axis=len(x.shape) - 1,
+                    param_attr=ParamAttr(name=f"{self.name}.ln_s"),
+                    bias_attr=ParamAttr(name=f"{self.name}.ln_b"))
+            elif c == "d" and self.dropout_rate and not is_test:
+                x = layers.dropout(
+                    x, self.dropout_rate,
+                    dropout_implementation="upscale_in_train")
+        return x
+
+
+class MultiHeadAttention:
+    """q/k/v projections + the fused attention op + output projection
+    (reference text.py:2687; here the score math is the Pallas flash
+    kernel instead of decomposed matmuls). `d_key`/`d_value` are
+    accepted for signature parity but UNUSED: the fused kernel reads
+    head-interleaved [B, S, d_model] with head dim d_model // n_head —
+    configs where d_key * n_head != d_model are not expressible."""
+
+    def __init__(self, d_key=None, d_value=None, d_model=512, n_head=1,
+                 dropout_rate=0.0, name=None):
+        self.d_model = int(d_model)
+        self.n_head = int(n_head)
+        self.dropout_rate = float(dropout_rate)
+        self.name = name or unique_name.generate("mha")
+
+    def _fc(self, x, suffix):
+        return layers.fc(
+            x, self.d_model, num_flatten_dims=2,
+            param_attr=ParamAttr(name=f"{self.name}.{suffix}.w"),
+            bias_attr=ParamAttr(name=f"{self.name}.{suffix}.b"))
+
+    def __call__(self, queries, keys=None, values=None, attn_bias=None,
+                 causal=False, is_test=False):
+        keys = queries if keys is None else keys
+        values = keys if values is None else values
+        q = self._fc(queries, "q")
+        k = self._fc(keys, "k")
+        v = self._fc(values, "v")
+        ctx = layers.fused_multihead_attention(
+            q, k, v, attn_bias, num_heads=self.n_head,
+            dropout_prob=self.dropout_rate, is_test=is_test,
+            causal=causal)
+        return self._fc(ctx, "out")
+
+
+class FFN:
+    """Position-wise feed-forward (reference text.py:2900)."""
+
+    def __init__(self, d_inner_hid, d_model, dropout_rate=0.0,
+                 fc1_act="relu", name=None):
+        self.d_inner = int(d_inner_hid)
+        self.d_model = int(d_model)
+        self.dropout_rate = float(dropout_rate)
+        self.act = fc1_act
+        self.name = name or unique_name.generate("ffn")
+
+    def __call__(self, x, is_test=False):
+        inter = layers.fc(
+            x, self.d_inner, num_flatten_dims=2, act=self.act,
+            param_attr=ParamAttr(name=f"{self.name}.fc1.w"),
+            bias_attr=ParamAttr(name=f"{self.name}.fc1.b"))
+        if self.dropout_rate and not is_test:
+            inter = layers.dropout(
+                inter, self.dropout_rate,
+                dropout_implementation="upscale_in_train")
+        return layers.fc(
+            inter, self.d_model, num_flatten_dims=2,
+            param_attr=ParamAttr(name=f"{self.name}.fc2.w"),
+            bias_attr=ParamAttr(name=f"{self.name}.fc2.b"))
 
 
 # ---------------------------------------------------------------------------
