@@ -39,7 +39,8 @@ __all__ = [
     "StackedGRUCell", "LSTM", "GRU", "BidirectionalLSTM",
     "BidirectionalGRU", "RNN", "BidirectionalRNN", "Conv1dPoolLayer",
     "CNNEncoder", "PrePostProcessLayer", "MultiHeadAttention", "FFN",
-    "TransformerEncoder", "TransformerDecoder", "DynamicDecode",
+    "TransformerEncoder", "TransformerDecoder", "TransformerCell",
+    "TransformerBeamSearchDecoder", "DynamicDecode",
     "LinearChainCRF", "CRFDecoding", "SequenceTagging", "Seq2SeqEncoder",
     "Seq2SeqDecoder",
 ]
@@ -262,6 +263,16 @@ class DynamicDecode:
         self.max_step_num = max_step_num
         self.output_time_major = output_time_major
         self.return_length = return_length
+        # dynamic_decode runs exactly max_step_num steps, touching
+        # buffer positions [0, max_step_num)
+        cell_max = getattr(getattr(decoder, "cell", None), "max_len", None)
+        if (cell_max is not None and max_step_num is not None
+                and int(max_step_num) > int(cell_max)):
+            raise ValueError(
+                f"DynamicDecode: max_step_num={max_step_num} exceeds the "
+                f"TransformerCell's max_len={cell_max}; past the static "
+                f"buffer every position mask is zero and outputs degrade "
+                f"silently — raise max_len or lower max_step_num")
 
     def __call__(self, inits=None, **kwargs):
         return layers.dynamic_decode(
@@ -564,6 +575,87 @@ class TransformerDecoder:
                    "is_test": is_test, "use_flash_attention": True,
                    "rng_salt": _rng_salt_counter[0]})
         return out
+
+
+class TransformerCell(layers.RNNCell):
+    """Reference TransformerCell (text.py:2252): step-wise decoding over
+    a TransformerDecoder.
+
+    TPU-native redesign: instead of per-layer k/v caches (dynamic
+    shapes), the cell carries a STATIC [B, max_len, H] embedding buffer
+    and re-runs the fused decoder stack over the whole prefix each step
+    — the causal mask makes row `pos` exact, shapes stay compile-time
+    constant, and the O(T^2 · L) decode cost is the standard static-
+    shape trade for short generation lengths. State (all plain tensors,
+    so BeamSearchDecoder's tile/gather machinery just works):
+    [buffer, pos, enc_output, cross_bias?].
+
+    CONTRACT: decode at most `max_len` steps (dynamic_decode's
+    max_step_num must be < max_len); past the buffer the position mask
+    would be all-zero and outputs degrade silently. DynamicDecode
+    asserts this when it can see the cell.
+    """
+
+    def __init__(self, decoder, max_len=64, with_bias=True):
+        self.decoder = decoder
+        self.max_len = int(max_len)
+        self.with_bias = bool(with_bias)
+
+    def get_initial_states(self, enc_output, cross_attn_bias=None,
+                           dtype="float32"):
+        """enc_output [B, S, H] (+ the [B, 1, 1, S] source bias iff the
+        cell was built with with_bias=True — a mismatch would silently
+        drop the bias or destabilize the state structure)."""
+        from ..fluid.layers import tensor as _tensor
+
+        if (cross_attn_bias is not None) != self.with_bias:
+            raise ValueError(
+                f"TransformerCell(with_bias={self.with_bias}) but "
+                f"cross_attn_bias is "
+                f"{'set' if cross_attn_bias is not None else 'missing'} "
+                f"— the bias rides the state list, so the two must agree")
+        b = enc_output.shape[0]
+        h = enc_output.shape[-1]
+        buf = _tensor.fill_constant([b, self.max_len, h], dtype, 0.0)
+        pos = _tensor.fill_constant([b], "int64", 0)
+        states = [buf, pos, enc_output]
+        if cross_attn_bias is not None:
+            states.append(cross_attn_bias)
+        return states
+
+    def call(self, inputs, states):
+        """inputs: current token embedding [B, H] (position encoding is
+        applied in-cell over the whole buffer, identical to training)."""
+        buf, pos, enc_out = states[0], states[1], states[2]
+        bias = states[3] if self.with_bias and len(states) > 3 else None
+        # one_hot on [B, 1] then squeeze: the [B] form would dispatch to
+        # the legacy one_hot op at B==1 (shape[-1]==1) and lose the
+        # batch dim (round-5 review finding)
+        onehot = layers.squeeze(
+            layers.one_hot(layers.unsqueeze(pos, [1]), self.max_len),
+            axes=[1])  # [B, L]
+        mask3 = layers.unsqueeze(onehot, [2])       # [B, L, 1]
+        buf = layers.elementwise_add(
+            layers.elementwise_mul(
+                buf, layers.scale(mask3, scale=-1.0, bias=1.0)),
+            layers.elementwise_mul(layers.unsqueeze(inputs, [1]), mask3))
+        x = layers.add_position_encoding(buf, alpha=1.0, beta=1.0)
+        dec_out = self.decoder(x, enc_out, bias, is_test=True)
+        out_row = layers.reduce_sum(
+            layers.elementwise_mul(dec_out, mask3), dim=1)  # [B, H]
+        new_pos = layers.elementwise_add(
+            pos, layers.fill_constant([1], "int64", 1))
+        new_states = [buf, new_pos, enc_out]
+        if bias is not None:
+            new_states.append(bias)
+        return out_row, new_states
+
+
+class TransformerBeamSearchDecoder(layers.BeamSearchDecoder):
+    """Reference TransformerBeamSearchDecoder (text.py:2421). The
+    generic beam machinery already beam-tiles and parent-gathers every
+    tensor in TransformerCell's state list (buffer, pos, enc_output,
+    bias), so this subclass is the reference-named entry point."""
 
 
 # ---------------------------------------------------------------------------
