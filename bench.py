@@ -82,10 +82,16 @@ def _timed_run(exe, program, data, loss, steps):
     return dt, lv
 
 
-def bench_resnet50():
-    """Secondary tracked config (BASELINE.md): ResNet-50 images/sec/chip.
+def bench_resnet(depth=50):
+    """Secondary tracked configs (BASELINE.md): ResNet images/sec/chip,
+    any depth in the hapi roster (BENCH_MODEL=resnet18/34/50/101/152).
     BASELINE.md sets no ResNet target ("TBD"), so vs_baseline reports
-    raw MFU rather than a ratio against an invented bar."""
+    raw MFU rather than a ratio against an invented bar.
+
+    BENCH_CONV_BN_FUSION=1 routes every conv->BN(->relu) triple through
+    the fused_conv_bn mega-kernel (fluid/fusion_pass.py +
+    ops/pallas/conv_bn.py); default 0 keeps the tracked baseline
+    schedule. The fusion flag is reported in the JSON row."""
     import jax
     import numpy as np
 
@@ -97,11 +103,13 @@ def bench_resnet50():
         resnet_step_flops,
     )
 
-    cfg = ResNetConfig.resnet50()
+    cfg = getattr(ResNetConfig, f"resnet{depth}")()
     batch = int(os.environ.get("BENCH_BATCH", 128))
     size = int(os.environ.get("BENCH_IMAGE", 224))
     steps = int(os.environ.get("BENCH_STEPS", 20))
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
+    use_fusion = os.environ.get("BENCH_CONV_BN_FUSION", "0") == "1"
+    fluid.flags.set_flags({"FLAGS_conv_bn_fusion": use_fusion})
 
     main_p, startup = fluid.Program(), fluid.Program()
     m, st, feeds, loss = build_resnet_train_program(cfg, batch, size, main_p, startup)
@@ -121,7 +129,7 @@ def bench_resnet50():
     imgs_per_sec = batch * steps / dt
     mfu = resnet_step_flops(cfg, batch, size) * steps / dt / _peak_flops_per_chip()
     print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
+        "metric": f"resnet{depth}_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "images/s/chip",
         "vs_baseline": None,  # BASELINE.md sets no ResNet target ("TBD")
@@ -130,6 +138,7 @@ def bench_resnet50():
         "image_size": size,
         "steps": steps,
         "amp_bf16": use_amp,
+        "conv_bn_fusion": use_fusion,
     }))
 
 
@@ -233,10 +242,28 @@ def _hbm_limit_bytes():
     return None
 
 
+def _apply_smoke_defaults():
+    """`bench.py --smoke` (CI): tiny shapes, 2 steps — asserts the bench
+    path still builds, trains, and emits one valid JSON line on the CPU
+    backend. Explicit BENCH_* env vars still win (setdefault)."""
+    for k, v in (
+        ("BENCH_BATCH", "2"),
+        ("BENCH_STEPS", "2"),
+        ("BENCH_IMAGE", "32"),
+        ("BENCH_SEQ", "64"),
+        ("BENCH_SRC", "32"),
+        ("BENCH_TRG", "32"),
+        ("BENCH_LONG_SEQ", "0"),
+    ):
+        os.environ.setdefault(k, v)
+
+
 def main():
+    if "--smoke" in sys.argv:
+        _apply_smoke_defaults()
     model = os.environ.get("BENCH_MODEL", "bert")
-    if model == "resnet50":
-        return bench_resnet50()
+    if model.startswith("resnet"):
+        return bench_resnet(int(model[len("resnet"):] or 50))
     if model == "transformer":
         return bench_transformer()
 

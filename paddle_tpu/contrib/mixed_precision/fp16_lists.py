@@ -38,6 +38,9 @@ white_list = {
     # so bf16 in/out only halves the residual-stream bandwidth
     "layer_norm",
     "batch_norm",
+    # fused conv+BN(+relu): conv on the MXU in bf16, statistics and the
+    # normalize chain in f32 inside the kernel (ops/pallas/conv_bn.py)
+    "fused_conv_bn",
 }
 
 black_list = {

@@ -60,6 +60,7 @@ def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
 # every step, and an is_test pass would clobber the f32 stat params)
 _KEEP_F32_SLOTS = {
     "batch_norm": {"Mean", "Variance", "Scale", "Bias"},
+    "fused_conv_bn": {"Mean", "Variance", "Scale", "Bias"},
     "layer_norm": {"Scale", "Bias"},
 }
 

@@ -28,6 +28,21 @@ _DEFS: Dict[str, tuple] = {
         False, "Executor._compile warns when a feed variable is consumed "
                "by no op (reference unused_var_check.cc / operator.cc:987 "
                "— the silently-ignored-input bug class)"),
+    "FLAGS_conv_bn_fusion": (
+        False, "fluid/fusion_pass.py: rewrite conv2d->batch_norm[->relu] "
+               "triples into one fused_conv_bn op before append_backward "
+               "(Pallas conv+stats+normalize mega-kernel, "
+               "ops/pallas/conv_bn.py; is_test folds BN into the conv "
+               "weights). Applied by Optimizer.backward and the AMP "
+               "decorator; off = program is bit-identical to the unfused "
+               "baseline"),
+    "FLAGS_pipeline_single_program_fallback": (
+        False, "fluid/optimizer.py PipelineOptimizer: explicitly accept "
+               "multi-stage device_guard programs as ONE co-scheduled XLA "
+               "program (warn instead of raise). Off = minimize raises, "
+               "honoring the no-silently-ignored-flags rule: stage tags "
+               "name a partition the single-program lowering does not "
+               "perform"),
     "FLAGS_conv_dw_im2col": (
         False, "ops/nn_ops.py conv2d: reformulate the WEIGHT gradient as "
                "im2col patches + one matmul (MXU-friendly) instead of "

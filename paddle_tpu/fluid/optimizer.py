@@ -111,6 +111,11 @@ class Optimizer:
         no_grad_set=None,
         callbacks=None,
     ):
+        # graph-level fusion passes run BEFORE backward so grad synthesis
+        # differentiates the fused ops (flag-gated no-op by default)
+        from .fusion_pass import maybe_apply_conv_bn_fusion
+
+        maybe_apply_conv_bn_fusion(loss.block.program)
         return append_backward(
             loss, parameter_list or self._parameter_list, no_grad_set, callbacks
         )
@@ -398,6 +403,105 @@ class AdagradOptimizer(Optimizer):
             },
             outputs={"ParamOut": [p], "MomentOut": [m]},
             attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    """Adamax (reference optimizer.py Adamax, operators/optimizers/
+    adamax_op.cc): Adam with the L-infinity norm in place of the second
+    moment. The op has no Beta1PowOut slot (reference parity), so the
+    beta1 power accumulator advances via a scale op in _finish_update."""
+
+    type = "adamax"
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator(
+                "beta1_pow_acc", p, fill_value=self._beta1, shape=(1,)
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "Moment": [self._get_accumulator("moment", p)],
+                "InfNorm": [self._get_accumulator("inf_norm", p)],
+                "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
+                "LearningRate": [self._learning_rate_var],
+            },
+            outputs={
+                "ParamOut": [p],
+                "MomentOut": [self._get_accumulator("moment", p)],
+                "InfNormOut": [self._get_accumulator("inf_norm", p)],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+            },
+        )
+
+    def _finish_update(self, block, params_grads):
+        for p, g in params_grads:
+            if g is None:
+                continue
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(
+                type="scale",
+                inputs={"X": [b1p]},
+                outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1, "bias": 0.0,
+                       "bias_after_scale": True},
+            )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    """Decayed Adagrad (reference optimizer.py DecayedAdagrad,
+    operators/optimizers/decayed_adagrad_op.cc): adagrad whose squared-
+    gradient accumulator decays by `decay` each step."""
+
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "Moment": [self._get_accumulator("moment", p)],
+                "LearningRate": [self._learning_rate_var],
+            },
+            outputs={
+                "ParamOut": [p],
+                "MomentOut": [self._get_accumulator("moment", p)],
+            },
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
         )
 
 
@@ -952,6 +1056,37 @@ class PipelineOptimizer:
                     op._set_attr("pipeline", True)
                     op._set_attr("num_microbatches", self._num_microbatches)
         self._stage_ops = self._collect_stages(program)
+        if len(self._stage_ops) > 1:
+            # the single-program lowering co-schedules every stage in one
+            # XLA computation: multi-stage device_guard tags describe a
+            # partition it does NOT perform. Raise (no-silently-ignored-
+            # flags rule) unless the fallback is explicitly requested.
+            from .flags import flag
+
+            stages = ", ".join(sorted(self._stage_ops))
+            if flag("FLAGS_pipeline_single_program_fallback"):
+                import warnings
+
+                warnings.warn(
+                    f"PipelineOptimizer: device_guard names {len(self._stage_ops)} "
+                    f"stages ({stages}); running them co-scheduled in ONE "
+                    f"compiled program (FLAGS_pipeline_single_program_fallback=1). "
+                    f"Stage placement is not performed — use the 'pp' mesh "
+                    f"axis with fused_encoder_stack for real pipeline "
+                    f"parallelism.",
+                    stacklevel=2,
+                )
+            else:
+                raise RuntimeError(
+                    f"PipelineOptimizer: this program tags ops with "
+                    f"{len(self._stage_ops)} device_guard stages ({stages}), "
+                    f"but the TPU lowering compiles ONE program and performs "
+                    f"no stage placement — the tags would be silently "
+                    f"ignored. Use the 'pp' mesh axis (fused_encoder_stack "
+                    f"GPipe schedule) for pipeline parallelism, or set "
+                    f"FLAGS_pipeline_single_program_fallback=1 to accept "
+                    f"co-scheduled single-program execution."
+                )
         return self.inner_opt.minimize(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set,
@@ -1258,6 +1393,8 @@ Momentum = MomentumOptimizer
 Adam = AdamOptimizer
 AdamW = AdamWOptimizer
 Adagrad = AdagradOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
 RMSProp = RMSPropOptimizer
 Lamb = LambOptimizer
 Ftrl = FtrlOptimizer

@@ -37,6 +37,8 @@ class ResNetConfig:
     # receptive-field family (4x4 folded = 8x8 unfolded ⊇ 7x7); NHWC only
     stem_space_to_depth: bool = False
 
+    # block rosters match hapi/vision.py _RESNET_CFGS (the de-drift
+    # contract: one depth table for the bench zoo and the hapi models)
     @staticmethod
     def resnet50(num_classes: int = 1000) -> "ResNetConfig":
         return ResNetConfig(50, num_classes, [3, 4, 6, 3])
@@ -44,6 +46,18 @@ class ResNetConfig:
     @staticmethod
     def resnet18(num_classes: int = 1000) -> "ResNetConfig":
         return ResNetConfig(18, num_classes, [2, 2, 2, 2])
+
+    @staticmethod
+    def resnet34(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(34, num_classes, [3, 4, 6, 3])
+
+    @staticmethod
+    def resnet101(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(101, num_classes, [3, 4, 23, 3])
+
+    @staticmethod
+    def resnet152(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(152, num_classes, [3, 8, 36, 3])
 
     @staticmethod
     def tiny(num_classes: int = 10) -> "ResNetConfig":

@@ -372,6 +372,82 @@ def batch_norm(ctx, ins, attrs):
     }
 
 
+@register("fused_conv_bn")
+def fused_conv_bn(ctx, ins, attrs):
+    """conv2d -> batch_norm [-> relu] as ONE op (fluid/fusion_pass.py).
+
+    Training mode routes through the Pallas mega-kernels
+    (ops/pallas/conv_bn.py) — conv tiles + batch statistics in one pass,
+    normalize+relu in a second, with a custom VJP fusing the relu/BN
+    backward chain — falling back to the identical-math jnp composition
+    for shapes the kernel doesn't cover. Inference (is_test /
+    use_global_stats) folds the BN into the conv weights instead: one
+    conv + one bias add, no normalization pass at all.
+
+    Output contract matches batch_norm's (Y + the four stat outputs) so
+    the fusion pass can rewire the BN's consumers verbatim.
+    """
+    from .pallas import conv_bn as _cb
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    with_relu = bool(attrs.get("with_relu", False))
+    strides = tuple(attrs.get("strides", [1, 1]))
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    pads = _conv_padding(attrs.get("paddings", [0, 0]), algo, 2)
+    is_test = attrs.get("is_test", False)
+    use_global = attrs.get("use_global_stats", False) or is_test
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+
+    if use_global:
+        # weight folding: y = conv(x, w*(s*inv)) + (b - m*s*inv)
+        sf = scale.astype(jnp.float32)
+        inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + eps)
+        gain = sf * inv
+        wf = (w.astype(jnp.float32) * gain.reshape(-1, 1, 1, 1)).astype(w.dtype)
+        shift = bias.astype(jnp.float32) - mean.astype(jnp.float32) * gain
+        z = _conv2d_impl(x, wf, attrs)
+        bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        y = z.astype(jnp.float32) + shift.reshape(bshape)
+        if with_relu:
+            y = jnp.maximum(y, 0.0)
+        return {
+            "Y": [y.astype(x.dtype)],
+            "MeanOut": [mean],
+            "VarianceOut": [var],
+            "SavedMean": [jnp.zeros_like(mean)],
+            "SavedVariance": [jnp.zeros_like(var)],
+        }
+
+    if nhwc:
+        y, m, v = _cb.fused_conv_bn(
+            x, w, scale, bias, strides=strides, pads=pads, eps=eps,
+            with_relu=with_relu,
+        )
+    else:
+        # NCHW never reaches the Pallas path; compose via channel-last
+        xt = jnp.transpose(x, (0, 2, 3, 1))
+        pads_r = _cb._resolve_pads(pads, xt.shape[1], xt.shape[2],
+                                   int(w.shape[2]), int(w.shape[3]), strides)
+        y, m, v = _cb.conv_bn_reference(
+            xt, w, scale, bias, strides=strides, pads=pads_r, eps=eps,
+            with_relu=with_relu,
+        )
+        y = jnp.transpose(y, (0, 3, 1, 2))
+    mean_out = momentum * mean + (1 - momentum) * m.astype(mean.dtype)
+    var_out = momentum * var + (1 - momentum) * v.astype(var.dtype)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [m.astype(mean.dtype)],
+        "SavedVariance": [(1.0 / jnp.sqrt(v + eps)).astype(var.dtype)],
+    }
+
+
 @register("layer_norm")
 def layer_norm(ctx, ins, attrs):
     # statistics ALWAYS in f32 (the fused-stack ln() convention): the op

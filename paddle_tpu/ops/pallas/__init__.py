@@ -4,4 +4,8 @@ Where the reference ships hand-written CUDA (e.g.
 /root/reference/paddle/fluid/operators/math/bert_encoder_functor.cu), this
 package ships Pallas kernels tuned for the MXU/VMEM; everything else rides
 XLA fusion.
+
+Kernels: flash_attention (fused MHA), add_ln (residual+LayerNorm),
+conv_bn (conv + batch-norm statistics + normalize + relu — the ResNet
+conv-path bandwidth lever, bench_artifacts/resnet50_ceiling.md).
 """

@@ -1265,6 +1265,38 @@ def _batch_norm():
     )
 
 
+@case("fused_conv_bn")
+def _fused_conv_bn():
+    # 1x1 NHWC so the numpy oracle is one einsum; the kernel-shape sweep
+    # (strides, SAME/VALID, kxk, odd channels) lives in
+    # tests/test_conv_bn_fusion.py
+    rng = R(77)
+    x = _mix(rng, 2, 4, 4, 3)
+    w = _mix(rng, 5, 3, 1, 1)
+    scale, bias = _pos(rng, 5), _mix(rng, 5)
+    mean, var = np.zeros(5, np.float32), np.ones(5, np.float32)
+
+    def oracle(ins, a):
+        xx, ww = ins["Input"][0], ins["Filter"][0]
+        z = np.einsum("nhwc,oc->nhwo", xx, ww[:, :, 0, 0])
+        m = z.mean((0, 1, 2))
+        v = z.var((0, 1, 2))
+        y = (z - m) / np.sqrt(v + 1e-5) * ins["Scale"][0] + ins["Bias"][0]
+        return {"Y": [f32(np.maximum(y, 0.0))], "SavedMean": [f32(m)]}
+
+    return OpTest(
+        "fused_conv_bn",
+        {"Input": x, "Filter": w, "Scale": scale, "Bias": bias,
+         "Mean": mean, "Variance": var},
+        oracle,
+        attrs={"epsilon": 1e-5, "momentum": 0.9, "data_format": "NHWC",
+               "data_layout": "NHWC", "with_relu": True},
+        outputs={"Y": 1, "MeanOut": 1, "VarianceOut": 1, "SavedMean": 1,
+                 "SavedVariance": 1},
+        tol=1e-4,
+    )
+
+
 @case("layer_norm")
 def _layer_norm():
     rng = R(431)

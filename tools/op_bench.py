@@ -7,8 +7,19 @@ Usage:
     python tools/op_bench.py conv2d --shape Input=8x64x56x56 \
         --shape Filter=128x64x3x3 --attr strides=1,1 --out Output
 
+    # the fused conv+BN(+relu) mega-kernel at a ResNet stage shape
+    # (NHWC; Scale/Bias/Mean/Variance are the per-channel BN operands):
+    python tools/op_bench.py fused_conv_bn \
+        --shape Input=8x28x28x128 --shape Filter=128x128x3x3 \
+        --shape Scale=128 --shape Bias=128 --shape Mean=128 \
+        --shape Variance=128 \
+        --attr data_format=NHWC --attr padding_algorithm=SAME \
+        --attr with_relu=1 --out Y
+
 Builds a one-op Program, runs it through the real Executor (whole-block
-XLA), and reports steady-state latency after a compile warmup.
+XLA), and reports steady-state latency after a compile warmup. --flag
+sets FLAGS_* before the run (flag-gated kernels: FLAGS_conv_dw_im2col,
+FLAGS_use_fused_ln, ...).
 """
 import argparse
 import json
@@ -42,9 +53,14 @@ def main():
     ap.add_argument("--attr", action="append", default=[])
     ap.add_argument("--out", default="Out", help="output slot name")
     ap.add_argument("--repeat", type=int, default=100)
+    ap.add_argument("--flag", action="append", default=[],
+                    help="FLAGS_name=value set before the run")
     args = ap.parse_args()
 
     import paddle_tpu.fluid as fluid
+
+    if args.flag:
+        fluid.flags.set_flags(dict(f.split("=", 1) for f in args.flag))
 
     shapes = dict(_parse_shape(s) for s in args.shape)
     attrs = dict(_parse_attr(a) for a in args.attr)
