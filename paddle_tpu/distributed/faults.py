@@ -1,0 +1,186 @@
+"""Deterministic fault injection for the PS data plane.
+
+Parity surface: the reference hardens its distributed runtime against
+real faults (grpc_client.h retries, HeartBeatMonitor timeouts,
+checkpoint_notify recovery) but tests them with sleeps and luck; here
+faults are INJECTED on a deterministic schedule so the chaos tests in
+tests/test_ps_faults.py assert exact recovery behavior instead of
+probabilistic survival.
+
+Gate: the layer is active only when BOTH the FLAGS_ps_fault_injection
+flag is on AND PADDLE_PS_FAULT_SPEC is non-empty. Flag-off behavior is
+bit-identical to a build without this module: ps_server consults
+`injector()` once per RPC and gets None.
+
+Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
+
+    <action>:<method>:<nth>[:<arg>]
+
+    action  one of
+            drop    client side: close the connection AFTER sending the
+                    request, before reading the reply — the server has
+                    (usually) applied it, the client cannot know:
+                    exercises the retry + dedup path
+            refuse  client side: raise ConnectionError BEFORE sending —
+                    the request never reaches the server: exercises the
+                    plain retry path
+            delay   client side: sleep <arg> seconds before sending
+            kill    server side: os._exit(1) the pserver process once it
+                    has handled <nth> RPCs in total (method filter still
+                    applies): exercises supervision + snapshot recovery
+    method  an RPC verb name (gather, push_gradients, ...) or "*"
+    nth     1-based index of the matching call AT THE INJECTION SITE;
+            each rule fires exactly once, on its Nth match
+
+Example: "drop:push_gradients:3;kill:*:40" drops the third push RPC the
+client issues and kills the pserver after it has handled 40 RPCs.
+
+Counting is per-process and per-rule, so the schedule is a pure function
+of the RPC sequence — reruns inject the same faults at the same points.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+ENV_SPEC = "PADDLE_PS_FAULT_SPEC"
+
+_CLIENT_ACTIONS = ("drop", "refuse", "delay")
+_SERVER_ACTIONS = ("kill",)
+
+
+class FaultError(ConnectionError):
+    """Raised by client-side `refuse`/`drop` rules; a subclass of
+    ConnectionError so it flows through the exact retry path a real
+    transport fault would take."""
+
+
+class _Rule:
+    __slots__ = ("action", "method", "nth", "arg", "count", "fired")
+
+    def __init__(self, action: str, method: str, nth: int, arg: float):
+        self.action = action
+        self.method = method
+        self.nth = nth
+        self.arg = arg
+        self.count = 0
+        self.fired = False
+
+    def matches(self, method: str) -> bool:
+        return self.method in ("*", method)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"_Rule({self.action}:{self.method}:{self.nth}"
+                f"{':' + str(self.arg) if self.arg else ''})")
+
+
+def parse_spec(spec: str) -> List[_Rule]:
+    rules = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"bad fault rule {raw!r}: want action:method:nth[:arg]")
+        action, method, nth = parts[0], parts[1], parts[2]
+        if action not in _CLIENT_ACTIONS + _SERVER_ACTIONS:
+            raise ValueError(
+                f"bad fault rule {raw!r}: unknown action {action!r} "
+                f"(want one of {_CLIENT_ACTIONS + _SERVER_ACTIONS})")
+        try:
+            n = int(nth)
+        except ValueError:
+            raise ValueError(f"bad fault rule {raw!r}: nth must be an int")
+        if n < 1:
+            raise ValueError(f"bad fault rule {raw!r}: nth is 1-based")
+        arg = float(parts[3]) if len(parts) == 4 else 0.0
+        rules.append(_Rule(action, method, n, arg))
+    return rules
+
+
+class FaultInjector:
+    """One injection schedule, shared by every connection in a process.
+
+    Client hooks (called by ps_server._Conn.call):
+      before_send(method)  — fires refuse (raises FaultError) and delay
+      drop_after_send(method) -> bool — True: close the socket now
+
+    Server hook (called by ps_server.PSServer.handle):
+      on_server_call(method) — fires kill (os._exit) once the counter
+      reaches the rule's nth
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._rules = parse_spec(spec)
+        self._lock = threading.Lock()
+        self._server_calls = 0
+
+    def _take(self, site_actions, method: str) -> List[_Rule]:
+        """Advance matching rules' counters; return the rules firing NOW."""
+        firing = []
+        with self._lock:
+            for r in self._rules:
+                if r.action not in site_actions or r.fired:
+                    continue
+                if not r.matches(method):
+                    continue
+                r.count += 1
+                if r.count == r.nth:
+                    r.fired = True
+                    firing.append(r)
+        return firing
+
+    # -- client side -----------------------------------------------------
+    def before_send(self, method: str) -> None:
+        for r in self._take(("refuse", "delay"), method):
+            if r.action == "delay":
+                time.sleep(r.arg)
+            else:
+                raise FaultError(
+                    f"fault injection: refused {method!r} RPC "
+                    f"(rule {r.action}:{r.method}:{r.nth})")
+
+    def drop_after_send(self, method: str) -> bool:
+        return bool(self._take(("drop",), method))
+
+    # -- server side -----------------------------------------------------
+    def on_server_call(self, method: str) -> None:
+        for r in self._take(("kill",), method):
+            # hard death, no cleanup: the supervision + snapshot story
+            # must recover from exactly this
+            os.write(2, (f"[faults] killing pserver pid {os.getpid()} "
+                         f"(rule kill:{r.method}:{r.nth})\n").encode())
+            os._exit(1)
+
+
+_injector: Optional[FaultInjector] = None
+_injector_lock = threading.Lock()
+
+
+def injector() -> Optional[FaultInjector]:
+    """The process-wide injector, or None when the layer is off (the
+    common case: one flag read + one env read, no state)."""
+    from ..fluid import flags
+
+    if not flags.flag("FLAGS_ps_fault_injection"):
+        return None
+    spec = os.environ.get(ENV_SPEC, "")
+    if not spec.strip():
+        return None
+    global _injector
+    with _injector_lock:
+        if _injector is None or _injector.spec != spec:
+            _injector = FaultInjector(spec)
+        return _injector
+
+
+def reset() -> None:
+    """Drop the cached injector (tests: fresh counters per case)."""
+    global _injector
+    with _injector_lock:
+        _injector = None

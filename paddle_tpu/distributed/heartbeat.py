@@ -20,19 +20,24 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Union
 
 ENV_DIR = "PADDLE_HEARTBEAT_DIR"
 
+# a "rank" is an int trainer rank or a string tag (pservers stamp as
+# "ps<idx>" — ps_server.serve / launch.py supervision share this channel)
+Rank = Union[int, str]
 
-def _stamp_path(directory: str, rank: int) -> str:
+
+def _stamp_path(directory: str, rank: Rank) -> str:
     return os.path.join(directory, f"heartbeat.{rank}")
 
 
 class HeartBeatWorker:
-    """Daemon thread stamping this trainer's heartbeat file."""
+    """Daemon thread stamping this process's heartbeat file (trainers
+    stamp their integer rank; pservers stamp a string tag)."""
 
-    def __init__(self, directory: str, rank: int, interval: float = 1.0):
+    def __init__(self, directory: str, rank: Rank, interval: float = 1.0):
         self.path = _stamp_path(directory, rank)
         self.interval = interval
         self._stop = threading.Event()
@@ -90,7 +95,7 @@ class HeartBeatMonitor:
     import or first compile) would go undetected forever.
     """
 
-    def __init__(self, directory: str, ranks: List[int], timeout: float,
+    def __init__(self, directory: str, ranks: List[Rank], timeout: float,
                  startup_grace: Optional[float] = None):
         self.directory = directory
         self.ranks = list(ranks)
@@ -103,7 +108,7 @@ class HeartBeatMonitor:
         self._t0 = time.time()
 
     def stale_ranks(self, now: Optional[float] = None,
-                    ranks: Optional[List[int]] = None) -> List[int]:
+                    ranks: Optional[List[Rank]] = None) -> List[Rank]:
         """`ranks` narrows the check (the launcher passes only ranks whose
         process is still running — a trainer that already exited cleanly
         stops stamping and must not read as hung)."""

@@ -10,6 +10,16 @@ parallel/env.py):
   PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
   PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT
 
+Fault tolerance: --elastic_retries supervises BOTH sides of the PS data
+plane. Trainer groups are respawned after a failure (reference behavior
+is fail-fast only), and pserver processes are watched the same way —
+a dead pserver is restarted on its ORIGINAL port with --preload_dir
+pointed at its periodic snapshot directory (ps_server.PSServer.snapshot:
+atomic state_dict pickles), so trainers' retrying RPC clients reconnect
+and the job loses at most one snapshot interval of table updates instead
+of hanging (the reference launcher only watches trainers; a dead pserver
+is a whole-job hang there).
+
 TPU notes: one process per HOST is the normal topology (all local chips
 belong to one PJRT client); --nproc_per_node exists for CPU fleets and
 tests. Rendezvous is the JAX coordination service bootstrapped from the
@@ -22,6 +32,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import List, Optional
 
@@ -32,6 +43,22 @@ class Trainer:
         self.endpoint = endpoint
         self.proc: Optional[subprocess.Popen] = None
         self.log = None
+
+
+class PServer:
+    """One supervised pserver child: the respawn identity (idx, host,
+    bound port) needed to restart it in place."""
+
+    def __init__(self, idx: int, host: str, port: int,
+                 proc: subprocess.Popen):
+        self.idx = idx
+        self.host = host
+        self.port = port  # bound port — respawns MUST rebind it
+        self.proc = proc
+
+    @property
+    def tag(self) -> str:
+        return f"ps{self.idx}"
 
 
 def get_cluster(ips: List[str], nproc_per_node: int, start_port: int):
@@ -61,7 +88,8 @@ def _parse_args(argv):
         "--elastic_retries", type=int, default=0,
         help="restart the local trainer group up to N times after a "
         "failure (trainers resume from their own checkpoints; "
-        "PADDLE_ELASTIC_RESTART carries the attempt number). 0 = "
+        "PADDLE_ELASTIC_RESTART carries the attempt number), and "
+        "restart a dead pserver up to N times (snapshot recovery). 0 = "
         "reference behavior: fail fast (utils.py:407)",
     )
     p.add_argument(
@@ -85,52 +113,92 @@ def _parse_args(argv):
         "whose host matches this node are spawned here; the full list "
         "is exported to trainers (multi-node PS). Overrides --server_num",
     )
+    p.add_argument(
+        "--ps_snapshot_secs", type=float, default=None,
+        help="pserver snapshot interval (atomic per-table state_dict "
+        "pickles a supervised restart recovers from). Default: "
+        "PADDLE_PS_SNAPSHOT_SECS if set, else 1.0 when --elastic_retries "
+        "> 0 (supervision without snapshots would restart pservers "
+        "EMPTY), else 0 (off)",
+    )
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def start_pservers(server_num: int, servers: str, node_ip: str,
-                   log_dir: Optional[str] = None):
-    """Spawn this node's pserver processes (reference launch_ps.py
-    start_procs). Returns (procs, full_endpoint_list). --server_num
-    spawns on launcher-chosen free ports (the child binds port 0 and
-    reports the bound port on stdout, so there is no pick-then-bind
-    race); --servers spawns the endpoints whose host is this node."""
-    procs, endpoints = [], []
+def _spawn_pserver(idx: int, host: str, port: int,
+                   log_dir: Optional[str] = None,
+                   snapshot_root: Optional[str] = None,
+                   snapshot_secs: float = 0.0,
+                   preload_snapshots: bool = False,
+                   heartbeat_dir: Optional[str] = None,
+                   log_mode: str = "w") -> subprocess.Popen:
+    """Fork one pserver child and wait for its bound-port banner; the
+    caller learns the bound port via proc.ps_bound_port. Snapshots live
+    in a PER-SERVER subdir of snapshot_root — each server hosts its own
+    row PARTITION of a table under the same name, and a shared dir would
+    let server 1's respawn silently preload server 0's rows whenever the
+    partition geometries coincide. Respawns pass the original port and
+    preload_snapshots=True (recovery)."""
+    env = dict(os.environ)
+    env["PADDLE_TRAINING_ROLE"] = "PSERVER"
+    env["PADDLE_PS_RANK_TAG"] = f"ps{idx}"
+    if heartbeat_dir:
+        env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
+    snap = os.path.join(snapshot_root, f"ps{idx}") if snapshot_root else None
+    cmd = [sys.executable, "-u", "-m",
+           "paddle_tpu.distributed.ps_server",
+           "--port", str(port), "--host", host]
+    if preload_snapshots and snap:
+        cmd += ["--preload_dir", snap]
+    if snap and snapshot_secs > 0:
+        cmd += ["--snapshot_dir", snap,
+                "--snapshot_secs", str(snapshot_secs)]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    line = proc.stdout.readline()  # "[ps_server] listening on h:p"
+    if "listening on" not in line:
+        proc.kill()
+        raise RuntimeError(f"pserver {idx} failed to start: {line!r}")
+    proc.ps_bound_port = int(line.rsplit(":", 1)[1])
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+        log = open(os.path.join(log_dir, f"serverlog.{idx}"), log_mode)
+        log.write(line)
 
-    def spawn(port: int, host: str, idx: int):
-        env = dict(os.environ)
-        env["PADDLE_TRAINING_ROLE"] = "PSERVER"
-        cmd = [sys.executable, "-u", "-m",
-               "paddle_tpu.distributed.ps_server",
-               "--port", str(port), "--host", host]
-        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
-        line = proc.stdout.readline()  # "[ps_server] listening on h:p"
-        if "listening on" not in line:
-            proc.kill()
-            raise RuntimeError(f"pserver {idx} failed to start: {line!r}")
-        bound = int(line.rsplit(":", 1)[1])
-        if log_dir:
-            log = open(os.path.join(log_dir, f"serverlog.{idx}"), "w")
-            log.write(line)
+        def drain(p=proc, f=log):
+            for ln in p.stdout:
+                f.write(ln)
+            f.close()
+    else:
+        def drain(p=proc):
+            for _ in p.stdout:
+                pass
 
-            def drain(p=proc, f=log):
-                for ln in p.stdout:
-                    f.write(ln)
-                f.close()
-        else:
-            def drain(p=proc):
-                for _ in p.stdout:
-                    pass
-        import threading
+    threading.Thread(target=drain, daemon=True).start()
+    return proc
 
-        threading.Thread(target=drain, daemon=True).start()
-        procs.append(proc)
-        return bound
+
+def start_pservers(server_num: int, servers: str, node_ip: str,
+                   log_dir: Optional[str] = None,
+                   snapshot_dir: Optional[str] = None,
+                   snapshot_secs: float = 0.0,
+                   heartbeat_dir: Optional[str] = None):
+    """Spawn this node's pserver processes (reference launch_ps.py
+    start_procs). Returns (List[PServer], full_endpoint_list).
+    --server_num spawns on launcher-chosen free ports (the child binds
+    port 0 and reports the bound port on stdout, so there is no
+    pick-then-bind race); --servers spawns the endpoints whose host is
+    this node."""
+    pservers: List[PServer] = []
+
+    def spawn(port: int, host: str, idx: int) -> int:
+        proc = _spawn_pserver(idx, host, port, log_dir=log_dir,
+                              snapshot_root=snapshot_dir,
+                              snapshot_secs=snapshot_secs,
+                              heartbeat_dir=heartbeat_dir)
+        pservers.append(PServer(idx, host, proc.ps_bound_port, proc))
+        return proc.ps_bound_port
 
     try:
         if servers:
@@ -141,25 +209,100 @@ def start_pservers(server_num: int, servers: str, node_ip: str,
                     spawn(int(port), host, i)
             endpoints = eps
         else:
+            endpoints = []
             for i in range(server_num):
                 bound = spawn(0, "127.0.0.1", i)
                 endpoints.append(f"127.0.0.1:{bound}")
     except BaseException:
         # partial startup must not orphan the servers already running
-        terminate_pservers(procs)
+        terminate_pservers(pservers)
         raise
-    return procs, endpoints
+    return pservers, endpoints
 
 
-def terminate_pservers(procs):
-    for p in procs:
-        if p.poll() is None:
-            p.terminate()
-    for p in procs:
+def terminate_pservers(pservers: List[PServer]):
+    for p in pservers:
+        if p.proc.poll() is None:
+            p.proc.terminate()
+    for p in pservers:
         try:
-            p.wait(timeout=5)
+            p.proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
-            p.kill()
+            p.proc.kill()
+
+
+class PServerSupervisor:
+    """Poll pserver children and respawn the dead ones in place (same
+    host:port — trainers hold the endpoint list; their RPC retry loop
+    rides out the gap). Recovery state comes from the snapshot dir: the
+    respawn preloads the latest atomic snapshot, and trainers that find
+    their table missing re-create it (RemoteTable._call), restoring the
+    Downpour bounded-staleness contract instead of losing the job.
+
+    A shared restart budget (--elastic_retries) bounds flapping; with
+    heartbeats enabled, a pserver process that freezes (stamps stale) is
+    killed and handled through the same respawn path."""
+
+    def __init__(self, pservers: List[PServer], retries: int,
+                 log_dir: Optional[str], snapshot_dir: Optional[str],
+                 snapshot_secs: float, heartbeat_dir: Optional[str] = None,
+                 heartbeat_timeout: float = 0.0):
+        self.pservers = pservers
+        self.retries_left = int(retries)
+        self.log_dir = log_dir
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_secs = snapshot_secs
+        self.heartbeat_dir = heartbeat_dir
+        self.aborted = False  # budget gone: no point restarting trainers
+        self.monitor = None
+        if heartbeat_dir and heartbeat_timeout > 0:
+            from .heartbeat import HeartBeatMonitor
+
+            self.monitor = HeartBeatMonitor(
+                heartbeat_dir, [p.tag for p in pservers], heartbeat_timeout)
+
+    def check(self) -> Optional[int]:
+        """None = all healthy (possibly after respawns); an int = abort
+        the job with that exit code (restart budget exhausted)."""
+        if self.monitor is not None:
+            running = [p for p in self.pservers if p.proc.poll() is None]
+            stale = set(self.monitor.stale_ranks(
+                ranks=[p.tag for p in running]))
+            for p in running:
+                if p.tag in stale:
+                    print(f"[launch] pserver {p.idx} ({p.host}:{p.port}) "
+                          f"stopped heartbeating (frozen?); killing it "
+                          f"for respawn", file=sys.stderr)
+                    p.proc.kill()
+                    p.proc.wait()
+        for p in self.pservers:
+            rc = p.proc.poll()
+            if rc is None:
+                continue
+            if self.retries_left <= 0:
+                print(f"[launch] pserver {p.idx} ({p.host}:{p.port}) "
+                      f"exited with {rc} and no restarts remain; "
+                      f"aborting the job", file=sys.stderr)
+                self.aborted = True
+                return rc if rc != 0 else 1
+            self.retries_left -= 1
+            print(f"[launch] pserver {p.idx} ({p.host}:{p.port}) exited "
+                  f"with {rc}; restarting it on the same port "
+                  f"(snapshot recovery, {self.retries_left} restarts "
+                  f"left)", file=sys.stderr)
+            try:
+                p.proc = _spawn_pserver(
+                    p.idx, p.host, p.port, log_dir=self.log_dir,
+                    snapshot_root=self.snapshot_dir,
+                    snapshot_secs=self.snapshot_secs,
+                    preload_snapshots=True,
+                    heartbeat_dir=self.heartbeat_dir, log_mode="a")
+            except RuntimeError as e:
+                print(f"[launch] pserver {p.idx} respawn failed: {e}; "
+                      f"aborting the job", file=sys.stderr)
+                self.aborted = True
+                return 1
+        return None
 
 
 def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
@@ -212,12 +355,14 @@ def terminate_local_trainers(trainers: List[Trainer]):
 
 
 def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
-                         monitor=None) -> int:
+                         monitor=None, ps_supervisor=None) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
     aborts the whole local group (reference watch_local_trainers:407:
-    fail fast; heartbeat parity: heart_beat_monitor.h:54). Returns the
-    job's exit code."""
+    fail fast; heartbeat parity: heart_beat_monitor.h:54). A
+    `ps_supervisor` (PServerSupervisor) is polled on the same cadence:
+    it respawns dead pservers in place, or returns an exit code to abort
+    with when the restart budget is gone. Returns the job's exit code."""
     try:
         while True:
             alive = False
@@ -247,6 +392,11 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                     )
                     terminate_local_trainers(trainers)
                     return 124  # timeout-style exit code
+            if ps_supervisor is not None:
+                rc = ps_supervisor.check()
+                if rc is not None:
+                    terminate_local_trainers(trainers)
+                    return rc
             time.sleep(poll_interval)
     except KeyboardInterrupt:
         terminate_local_trainers(trainers)
@@ -269,24 +419,63 @@ def launch(argv=None) -> int:
             heartbeat_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
             own_heartbeat_dir = True
 
-    pservers = []
+    # snapshot interval: explicit flag > env > supervision-implied default
+    snapshot_secs = args.ps_snapshot_secs
+    if snapshot_secs is None:
+        env_secs = os.environ.get("PADDLE_PS_SNAPSHOT_SECS")
+        if env_secs:
+            snapshot_secs = float(env_secs)
+        else:
+            snapshot_secs = 1.0 if args.elastic_retries > 0 else 0.0
+
+    pservers: List[PServer] = []
+    ps_supervisor = None
+    snapshot_dir = None
+    own_snapshot_dir = False
     try:
         if args.server_num or args.servers:
+            if snapshot_secs > 0:
+                snapshot_dir = os.environ.get("PADDLE_PS_SNAPSHOT_DIR")
+                if not snapshot_dir:
+                    if args.log_dir:
+                        snapshot_dir = os.path.join(
+                            args.log_dir, "ps_snapshots")
+                    else:
+                        import tempfile
+
+                        snapshot_dir = tempfile.mkdtemp(
+                            prefix="paddle_tpu_ps_")
+                        own_snapshot_dir = True
+                os.makedirs(snapshot_dir, exist_ok=True)
             pservers, endpoints = start_pservers(
-                args.server_num, args.servers, node_ip, args.log_dir)
+                args.server_num, args.servers, node_ip, args.log_dir,
+                snapshot_dir=snapshot_dir, snapshot_secs=snapshot_secs,
+                heartbeat_dir=heartbeat_dir)
             # trainers inherit the list through start_local_trainers' env
             os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(endpoints)
             os.environ.setdefault("PADDLE_TRAINING_ROLE", "TRAINER")
-        return _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir)
+            if args.elastic_retries > 0:
+                ps_supervisor = PServerSupervisor(
+                    pservers, args.elastic_retries, args.log_dir,
+                    snapshot_dir, snapshot_secs,
+                    heartbeat_dir=heartbeat_dir,
+                    heartbeat_timeout=args.heartbeat_timeout)
+        return _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
+                                ps_supervisor)
     finally:
         terminate_pservers(pservers)
         if own_heartbeat_dir:
             import shutil
 
             shutil.rmtree(heartbeat_dir, ignore_errors=True)
+        if own_snapshot_dir:
+            import shutil
+
+            shutil.rmtree(snapshot_dir, ignore_errors=True)
 
 
-def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir) -> int:
+def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
+                     ps_supervisor=None) -> int:
     attempt = 0
     while True:
         local = start_local_trainers(
@@ -306,8 +495,11 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir) -> int:
             monitor = HeartBeatMonitor(
                 heartbeat_dir, [t.rank for t in local], args.heartbeat_timeout
             )
-        rc = watch_local_trainers(local, monitor=monitor)
-        if rc == 0 or attempt >= args.elastic_retries or rc == 128 + signal.SIGINT:
+        rc = watch_local_trainers(local, monitor=monitor,
+                                  ps_supervisor=ps_supervisor)
+        if (rc == 0 or attempt >= args.elastic_retries
+                or rc == 128 + signal.SIGINT
+                or (ps_supervisor is not None and ps_supervisor.aborted)):
             return rc
         attempt += 1
         print(

@@ -165,10 +165,20 @@ class ShardedHostTable:
 
     def state_dict(self):
         # deep copies: a checkpoint must be a snapshot, not an alias of
-        # the live shards (np.asarray with a matching dtype is a no-op)
+        # the live shards (np.asarray with a matching dtype is a no-op).
+        # Copies happen UNDER the shard locks: the pserver's periodic
+        # snapshotter (ps_server.PSServer.snapshot) runs concurrently
+        # with pushes, and an unlocked copy could capture a half-updated
+        # row (torn between the optimizer's read and write)
+        shards, accum = [], []
+        for s in range(self.num_shards):
+            with self._locks[s]:
+                shards.append(self._shards[s].copy())
+                accum.append(
+                    None if self._accum[s] is None else self._accum[s].copy())
         return {
-            "shards": [s.copy() for s in self._shards],
-            "accum": [None if a is None else a.copy() for a in self._accum],
+            "shards": shards,
+            "accum": accum,
             "optimizer": self.optimizer,
             "learning_rate": self.learning_rate,
         }

@@ -27,6 +27,31 @@ contract tests/test_ps_dist.py asserts. `async` skips the barrier
 (Downpour: apply on arrival); `geo` trainers push deltas (additive,
 no barrier) through GeoSGDClient wrapping a RemoteTable.
 
+Fault tolerance (tests/test_ps_faults.py):
+
+  client   — every RPC runs in a retry loop: per-attempt socket, exp
+             backoff with jitter, transparent reconnect on
+             ConnectionError/EOF/timeout. Idempotent verbs retry freely;
+             push_gradients / push_delta carry a (trainer_id, step|seq)
+             dedup key and a `retry` marker so a replayed push that
+             already LANDED (reply lost) is applied exactly once.
+  server   — `generation` rides the create_table handshake
+             (PADDLE_ELASTIC_RESTART): a restarted trainer group bumps
+             it and the server RESETS the table's push barrier, so the
+             half-filled round a crashed group left behind can never
+             merge with — or deadlock — the new group's pushes.
+  state    — periodic atomic snapshots (state_dict -> tmp + os.replace,
+             PADDLE_PS_SNAPSHOT_SECS / PADDLE_PS_SNAPSHOT_DIR); a
+             supervised restart (launch.py) preloads them, and a client
+             that finds its table missing after a server restart
+             re-issues the idempotent create_table (which restores the
+             snapshot) and replays the verb — a pserver crash costs at
+             most one snapshot interval of updates (Downpour
+             bounded-staleness), not the job.
+  faults   — distributed/faults.py injects drop/refuse/delay/kill on a
+             deterministic schedule (FLAGS_ps_fault_injection +
+             PADDLE_PS_FAULT_SPEC); flag-off is bit-identical.
+
 Framing: 8-byte big-endian length + pickle (trusted cluster transport,
 like the reference's protobuf-over-gRPC — auth/encryption is deployment
 infra, not the data plane's job).
@@ -36,22 +61,38 @@ from __future__ import annotations
 import argparse
 import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import faults
 from .ps import ShardedHostTable
 
 _LEN = struct.Struct(">Q")
 
-# a barrier that outlives this window means a peer trainer died mid-step:
+# a barrier that outlives this window means a peer trainer died mid-round:
 # fail fast so the launcher's watcher can abort/restart the group
 SYNC_TIMEOUT = float(os.environ.get("PADDLE_PS_SYNC_TIMEOUT", 120.0))
+
+# client retry envelope: total in-band wait ~= sum of capped backoffs,
+# sized to ride out a supervised pserver restart (launch.py respawn:
+# poll interval + python startup, a few seconds) with room to spare
+RPC_MAX_RETRIES = int(os.environ.get("PADDLE_PS_RPC_RETRIES", 10))
+RPC_BACKOFF_BASE = float(os.environ.get("PADDLE_PS_RPC_BACKOFF", 0.05))
+RPC_BACKOFF_CAP = float(os.environ.get("PADDLE_PS_RPC_BACKOFF_CAP", 2.0))
+
+
+class TableMissingError(RuntimeError):
+    """Server says the table does not exist — after a pserver restart the
+    client re-creates it (idempotent; the server's preload_dir restores
+    the latest snapshot) and replays the verb (RemoteTable._call)."""
 
 
 # ---------------------------------------------------------------------------
@@ -122,21 +163,36 @@ class _SyncState:
     `num_trainers` contributions for r have arrived.
 
     Completion is tracked per-CONTRIBUTION (a token each waiter removes
-    after waking), not by an applied-step high-water mark — a restarted
-    trainer group (launch.py --elastic_retries; the server process
-    deliberately outlives restarts so hosted tables survive) restarts
-    its step counter at 0, and a high-water mark would let its pushes
-    return before the merge. A push that finds a stale same-trainer
-    entry in its round (left by a crashed group) simply overwrites it:
-    the dead process no longer waits, and a live trainer never pushes
-    the same (table, round) twice by construction (the client's step
-    counter increments per push)."""
+    after waking) AND by an applied-round high-water mark used ONLY for
+    replay dedup: `last_applied` is consulted when a push arrives with
+    the `retry` marker (its first send may have landed before the
+    connection died), never for first sends. Within one trainer-group
+    GENERATION the mark is exact — sync rounds complete in lockstep, so
+    a retried round number is either still pending (join the barrier) or
+    <= last_applied (already merged: return without re-applying).
+
+    A restarted trainer group restarts its step counter at 0, which
+    would poison the high-water mark and leave half-filled rounds from
+    the dead group in `rounds` — so the create_table handshake carries a
+    `generation` (launch.py PADDLE_ELASTIC_RESTART) and the server swaps
+    in a FRESH _SyncState when it bumps, marking the old one `reset` and
+    waking its stale waiters to fail fast instead of timing out.
+
+    `async_seen` / `delta_seen` are the barrier-less analogs: per-trainer
+    high-water marks that dedup RETRIED async pushes / geo deltas.
+    Downpour semantics make the high-water approximation safe: within one
+    client, pushes are issued in step order, and async mode tolerates
+    bounded reordering/loss by design."""
 
     def __init__(self, num_trainers: int):
         self.cond = threading.Condition()
         self.num = int(num_trainers)
         self.rounds: Dict[int, Dict[int, tuple]] = {}
         self.done: set = set()
+        self.last_applied = -1
+        self.async_seen: Dict[int, int] = {}
+        self.delta_seen: Dict[int, int] = {}
+        self.reset = False  # generation bumped: stale waiters fail fast
 
 
 class PSServer:
@@ -145,21 +201,33 @@ class PSServer:
     preload_dir (fleet.init_server(model_dir)): when a table is first
     created, `<preload_dir>/<name>.pkl` — a `table.state_dict()` pickle
     saved by a previous run — is loaded into it, the reference's
-    init_server checkpoint-restore contract."""
+    init_server checkpoint-restore contract. Snapshots
+    (snapshot_dir/snapshot_secs) write the SAME format, so a supervised
+    restart preloads the latest snapshot through this path."""
 
-    def __init__(self, preload_dir: Optional[str] = None):
+    def __init__(self, preload_dir: Optional[str] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_secs: float = 0.0):
         self.tables: Dict[str, ShardedHostTable] = {}
         self.specs: Dict[str, dict] = {}
         self.sync: Dict[str, _SyncState] = {}
+        self.gens: Dict[str, int] = {}
         self.lock = threading.Lock()
         self.shutdown_event = threading.Event()
         self.preload_dir = preload_dir
+        self.snapshot_dir = snapshot_dir or None
+        self.snapshot_secs = float(snapshot_secs or 0.0)
+        self._snap_thread: Optional[threading.Thread] = None
 
     # -- verbs -----------------------------------------------------------
 
     def create_table(self, spec: dict):
         """Idempotent across trainers: the first create wins; later
-        creates with an IDENTICAL spec are no-ops, mismatches error."""
+        creates with an IDENTICAL spec are no-ops, mismatches error.
+        `generation` (not part of the identity spec) is the trainer
+        group's restart attempt: a bump resets the sync barrier."""
+        spec = dict(spec)
+        gen = int(spec.pop("generation", 0))
         name = spec["name"]
         with self.lock:
             if name in self.tables:
@@ -167,6 +235,16 @@ class PSServer:
                     raise ValueError(
                         f"table {name!r} already exists with a different "
                         f"spec: {self.specs[name]} vs {spec}")
+                if gen > self.gens.get(name, 0):
+                    # elastic restart: the new group must never share
+                    # barrier state (half-filled rounds, applied marks,
+                    # step high-water) with the dead one
+                    old = self.sync[name]
+                    self.sync[name] = _SyncState(old.num)
+                    self.gens[name] = gen
+                    with old.cond:
+                        old.reset = True
+                        old.cond.notify_all()
                 return {"rows": self.tables[name].rows,
                         "dim": self.tables[name].dim}
             kw = {k: v for k, v in spec.items()
@@ -181,6 +259,7 @@ class PSServer:
             self.tables[name] = t
             self.specs[name] = dict(spec)
             self.sync[name] = _SyncState(int(spec.get("sync_trainers", 0)))
+            self.gens[name] = gen
             return {"rows": t.rows, "dim": t.dim}
 
     def _table(self, name: str) -> ShardedHostTable:
@@ -192,17 +271,31 @@ class PSServer:
     def gather(self, name, ids):
         return self._table(name).gather(ids)
 
-    def push_gradients(self, name, ids, grads, trainer_id=0, step=0):
+    def push_gradients(self, name, ids, grads, trainer_id=0, step=0,
+                       retry=False):
         table = self._table(name)
         st = self.sync[name]
         if st.num <= 1:
-            table.push_gradients(ids, grads)  # async / single trainer
+            # async / single trainer: apply on arrival (Downpour). A
+            # RETRIED push whose first send already landed is skipped.
+            with st.cond:
+                if retry and st.async_seen.get(trainer_id, -1) >= step:
+                    return 0
+                st.async_seen[trainer_id] = max(
+                    st.async_seen.get(trainer_id, -1), step)
+            table.push_gradients(ids, grads)
             return 0
         token = object()
         with st.cond:
+            if retry and step <= st.last_applied:
+                # replay of a round that merged before the reply was
+                # lost: the update already landed exactly once
+                return 0
             buf = st.rounds.setdefault(step, {})
-            # overwrite-not-raise: a pre-existing entry can only be a
-            # crashed group's leftover (see _SyncState docstring)
+            # overwrite-not-raise: a pre-existing same-trainer entry is a
+            # dropped connection's orphan (its server thread still waits
+            # on a token that will never complete and times out) — the
+            # retry's token supersedes it
             buf[trainer_id] = (np.asarray(ids), np.asarray(grads), token)
             if len(buf) == st.num:
                 # trainer-id order, not arrival order: the merged batch
@@ -214,11 +307,19 @@ class PSServer:
                 for t in buf:
                     st.done.add(buf[t][2])
                 st.done.discard(token)  # the merger does not wait
+                st.last_applied = max(st.last_applied, step)
                 del st.rounds[step]
                 st.cond.notify_all()
-            elif st.cond.wait_for(lambda: token in st.done,
+            elif st.cond.wait_for(lambda: token in st.done or st.reset,
                                   timeout=SYNC_TIMEOUT):
-                st.done.discard(token)  # each waiter prunes its own
+                if token in st.done:
+                    st.done.discard(token)  # each waiter prunes its own
+                else:
+                    # generation bump while we waited: our group is dead
+                    raise RuntimeError(
+                        f"sync-PS round abandoned: the trainer group "
+                        f"restarted while table {name!r} round {step} "
+                        f"was waiting for peers")
             else:
                 # drop our contribution so the round can't half-fire if
                 # this trainer is restarted and retries
@@ -231,11 +332,23 @@ class PSServer:
                     f"peer trainer likely died")
         return 0
 
-    def push_delta(self, name, ids, deltas):
-        self._table(name).push_delta(ids, deltas)
+    def push_delta(self, name, ids, deltas, trainer_id=0, seq=-1,
+                   retry=False):
+        table = self._table(name)
+        if seq >= 0:
+            st = self.sync[name]
+            with st.cond:
+                if retry and st.delta_seen.get(trainer_id, -1) >= seq:
+                    return 0  # replayed delta already accumulated
+                st.delta_seen[trainer_id] = max(
+                    st.delta_seen.get(trainer_id, -1), seq)
+        table.push_delta(ids, deltas)
         return 0
 
     def handle(self, method: str, kwargs: dict):
+        inj = faults.injector()
+        if inj is not None:
+            inj.on_server_call(method)  # may os._exit (kill rule)
         if method == "ping":
             return "pong"
         if method == "create_table":
@@ -245,10 +358,13 @@ class PSServer:
         if method == "push_gradients":
             return self.push_gradients(
                 kwargs["name"], kwargs["ids"], kwargs["grads"],
-                kwargs.get("trainer_id", 0), kwargs.get("step", 0))
+                kwargs.get("trainer_id", 0), kwargs.get("step", 0),
+                kwargs.get("retry", False))
         if method == "push_delta":
             return self.push_delta(
-                kwargs["name"], kwargs["ids"], kwargs["deltas"])
+                kwargs["name"], kwargs["ids"], kwargs["deltas"],
+                kwargs.get("trainer_id", 0), kwargs.get("seq", -1),
+                kwargs.get("retry", False))
         if method == "to_dense":
             return self._table(kwargs["name"]).to_dense()
         if method == "nbytes":
@@ -262,16 +378,70 @@ class PSServer:
         if method == "load_state_dict":
             self._table(kwargs["name"]).load_state_dict(kwargs["state"])
             return 0
+        if method == "snapshot":
+            return self.snapshot()
         if method == "drop_table":
             with self.lock:
                 self.tables.pop(kwargs["name"], None)
                 self.specs.pop(kwargs["name"], None)
                 self.sync.pop(kwargs["name"], None)
+                self.gens.pop(kwargs["name"], None)
             return 0
         if method == "shutdown":
             self.shutdown_event.set()
             return 0
         raise ValueError(f"unknown PS method {method!r}")
+
+    # -- snapshots --------------------------------------------------------
+
+    def snapshot(self) -> int:
+        """Atomically checkpoint every hosted table to
+        `<snapshot_dir>/<name>.pkl` (tmp + os.replace: a crash mid-write
+        can never leave a torn file, so the newest snapshot on disk is
+        always loadable). Same format as preload_dir, so a supervised
+        restart restores it through the existing create_table path.
+        Returns the number of tables written."""
+        if not self.snapshot_dir:
+            return 0
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        with self.lock:
+            items = list(self.tables.items())
+        n = 0
+        for name, t in items:
+            path = os.path.join(self.snapshot_dir, f"{name}.pkl")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(t.state_dict(), f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            n += 1
+        return n
+
+    def start_snapshotter(self) -> None:
+        if not (self.snapshot_dir and self.snapshot_secs > 0):
+            return
+        if self._snap_thread is not None:
+            return
+
+        def loop():
+            while not self.shutdown_event.wait(self.snapshot_secs):
+                try:
+                    self.snapshot()
+                except Exception as e:  # keep serving; snapshots degrade
+                    print(f"[ps_server] snapshot failed: {e}",
+                          file=sys.stderr, flush=True)
+
+        self._snap_thread = threading.Thread(target=loop, daemon=True)
+        self._snap_thread.start()
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -303,17 +473,46 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 
 def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
-          preload_dir: Optional[str] = None):
+          preload_dir: Optional[str] = None,
+          snapshot_dir: Optional[str] = None,
+          snapshot_secs: Optional[float] = None):
     """Run the pserver event loop (blocks). port=0 picks a free port;
-    ready_cb (tests) receives the bound (host, port)."""
+    ready_cb (tests) receives the bound (host, port). Snapshot knobs
+    default from PADDLE_PS_SNAPSHOT_DIR / PADDLE_PS_SNAPSHOT_SECS; a
+    clean shutdown writes one final snapshot so a graceful restart is
+    lossless (a crash loses at most one interval)."""
+    if snapshot_dir is None:
+        snapshot_dir = os.environ.get("PADDLE_PS_SNAPSHOT_DIR") or None
+    if snapshot_secs is None:
+        snapshot_secs = float(
+            os.environ.get("PADDLE_PS_SNAPSHOT_SECS", 0) or 0)
     srv = _TCPServer((host, port), _Handler)
-    srv.ps = PSServer(preload_dir=preload_dir)  # type: ignore[attr-defined]
+    srv.ps = PSServer(preload_dir=preload_dir,  # type: ignore[attr-defined]
+                      snapshot_dir=snapshot_dir,
+                      snapshot_secs=snapshot_secs)
+    srv.ps.start_snapshotter()
+    # stamp liveness for the launcher's supervisor when heartbeats are on
+    # (same channel trainers use; catches a HUNG pserver, not just death)
+    hb = None
+    hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
+    hb_tag = os.environ.get("PADDLE_PS_RANK_TAG")
+    if hb_dir and hb_tag:
+        from .heartbeat import HeartBeatWorker
+
+        hb = HeartBeatWorker(hb_dir, hb_tag).start()
     if ready_cb is not None:
         ready_cb(srv.server_address)
     try:
         srv.serve_forever(poll_interval=0.1)
     finally:
+        if hb is not None:
+            hb.stop()
         srv.server_close()
+        try:
+            srv.ps.snapshot()
+        except Exception as e:
+            print(f"[ps_server] final snapshot failed: {e}",
+                  file=sys.stderr, flush=True)
 
 
 def main(argv=None) -> int:
@@ -323,6 +522,10 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--preload_dir", default=os.environ.get(
         "PADDLE_PS_PRELOAD_DIR", ""))
+    p.add_argument("--snapshot_dir", default=os.environ.get(
+        "PADDLE_PS_SNAPSHOT_DIR", ""))
+    p.add_argument("--snapshot_secs", type=float, default=float(
+        os.environ.get("PADDLE_PS_SNAPSHOT_SECS", 0) or 0))
     args = p.parse_args(argv)
 
     def ready(addr):
@@ -330,7 +533,9 @@ def main(argv=None) -> int:
         print(f"[ps_server] listening on {addr[0]}:{addr[1]}", flush=True)
 
     serve(args.port, args.host, ready_cb=ready,
-          preload_dir=args.preload_dir or None)
+          preload_dir=args.preload_dir or None,
+          snapshot_dir=args.snapshot_dir or None,
+          snapshot_secs=args.snapshot_secs)
     return 0
 
 
@@ -344,7 +549,18 @@ class _Conn:
     socket) matters: a sync-mode push BLOCKS in the server barrier, and a
     second table's push or a gather from another runtime thread must not
     queue behind it — the cross-table ordering deadlock the reference
-    avoids with per-request gRPC calls (grpc_client.h AsyncSendVar)."""
+    avoids with per-request gRPC calls (grpc_client.h AsyncSendVar).
+
+    call() retries transport faults (ConnectionError / EOF / timeout /
+    refused connect) with exponential backoff + jitter and a fresh
+    socket per attempt, so a pserver restart is invisible to the caller.
+    Replay-sensitive verbs (push_gradients, push_delta) are marked
+    `retry=True` from the second attempt on; the server's dedup keys
+    make the replay apply-once. Application errors the server REPLIED
+    with are never retried — the RPC itself succeeded."""
+
+    # verbs whose replay the server dedups via (trainer_id, step|seq)
+    _MARK_RETRY = ("push_gradients", "push_delta")
 
     def __init__(self, endpoint: str):
         host, port = endpoint.rsplit(":", 1)
@@ -361,21 +577,54 @@ class _Conn:
         return s
 
     def call(self, method: str, **kwargs):
-        s = self._checkout()
-        try:
-            _send_msg(s, (method, kwargs))
-            ok, result = _recv_msg(s)
-        except BaseException:
+        inj = faults.injector()
+        last_err: Optional[BaseException] = None
+        for attempt in range(RPC_MAX_RETRIES + 1):
+            if attempt:
+                if method in self._MARK_RETRY:
+                    kwargs["retry"] = True
+                back = min(RPC_BACKOFF_CAP,
+                           RPC_BACKOFF_BASE * (2 ** (attempt - 1)))
+                time.sleep(back * (0.5 + random.random()))  # jittered
+            s = None
             try:
-                s.close()
-            finally:
-                pass
-            raise
-        with self._lock:
-            self._free.append(s)
-        if not ok:
-            raise RuntimeError(f"pserver {self.addr}: {result}")
-        return result
+                s = self._checkout()
+                if inj is not None:
+                    inj.before_send(method)  # refuse/delay rules
+                _send_msg(s, (method, kwargs))
+                if inj is not None and inj.drop_after_send(method):
+                    raise faults.FaultError(
+                        f"fault injection: dropped connection after "
+                        f"sending {method!r}")
+                ok, result = _recv_msg(s)
+            except (OSError, EOFError) as e:
+                # includes ConnectionError, socket.timeout, refused
+                # connects while a supervised pserver restarts
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                last_err = e
+                continue
+            except BaseException:
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                raise
+            with self._lock:
+                self._free.append(s)
+            if not ok:
+                if isinstance(result, str) and result.startswith(
+                        "KeyError") and "no table" in result:
+                    raise TableMissingError(f"pserver {self.addr}: {result}")
+                raise RuntimeError(f"pserver {self.addr}: {result}")
+            return result
+        raise ConnectionError(
+            f"pserver {self.addr}: RPC {method!r} still failing after "
+            f"{RPC_MAX_RETRIES + 1} attempts: {last_err}") from last_err
 
     def close(self):
         with self._lock:
@@ -394,13 +643,20 @@ class RemoteTable:
     server r % n at local row r // n — the reference ps_dispatcher
     RoundRobin placement), so with one server the hosted table is
     byte-identical (same seed, same shape) to the in-process one.
+
+    generation (default PADDLE_ELASTIC_RESTART): the trainer group's
+    restart attempt, carried in the create_table handshake so a server
+    that outlived the previous group resets its sync barrier. Every verb
+    goes through _call, which re-creates the table (idempotent; the
+    server preloads its latest snapshot) if a restarted pserver lost it.
     """
 
     def __init__(self, name, shape, endpoints: List[str],
                  dtype: str = "float32", num_shards: int = 4,
                  optimizer: str = "sgd", learning_rate: float = 0.1,
                  initializer_std: Optional[float] = None, seed: int = 0,
-                 sync_trainers: int = 0, trainer_id: int = 0):
+                 sync_trainers: int = 0, trainer_id: int = 0,
+                 generation: Optional[int] = None):
         self.name = name
         self.rows, self.dim = int(shape[0]), int(shape[1])
         self.dtype = np.dtype(dtype)
@@ -408,9 +664,13 @@ class RemoteTable:
         self.optimizer = optimizer
         self.endpoints = list(endpoints)
         self.trainer_id = int(trainer_id)
+        self.generation = int(
+            os.environ.get("PADDLE_ELASTIC_RESTART", 0)
+            if generation is None else generation)
         self._n = len(self.endpoints)
         self._conns = [_Conn(e) for e in self.endpoints]
         self._step = 0
+        self._delta_seq = 0
         self._step_lock = threading.Lock()
         # multi-server fan-out pool: per-server RPCs overlap instead of
         # serializing N round-trips (the reference's async gRPC client
@@ -421,9 +681,10 @@ class RemoteTable:
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(max_workers=self._n)
-        for s, conn in enumerate(self._conns):
+        self._specs: List[dict] = []
+        for s in range(self._n):
             n_rows = (self.rows - s + self._n - 1) // self._n
-            conn.call("create_table", spec={
+            self._specs.append({
                 "name": name, "shape": (n_rows, self.dim),
                 "dtype": str(self.dtype), "num_shards": num_shards,
                 "optimizer": optimizer, "learning_rate": learning_rate,
@@ -432,7 +693,10 @@ class RemoteTable:
                 # server layout reproduces the local table bit-for-bit
                 "seed": seed if self._n == 1 else seed + s,
                 "sync_trainers": sync_trainers,
+                "generation": self.generation,
             })
+        for s, conn in enumerate(self._conns):
+            conn.call("create_table", spec=self._specs[s])
 
     # -- addressing ------------------------------------------------------
     def _locate(self, ids: np.ndarray):
@@ -442,6 +706,16 @@ class RemoteTable:
                 f"table {self.name!r}: id {int(bad)} out of range "
                 f"[0, {self.rows})")
         return ids % self._n, ids // self._n
+
+    def _call(self, s: int, method: str, **kwargs):
+        """One server's RPC with restart recovery: a pserver that came
+        back empty (supervised respawn) gets the idempotent create —
+        which preloads its latest snapshot — and the verb is replayed."""
+        try:
+            return self._conns[s].call(method, **kwargs)
+        except TableMissingError:
+            self._conns[s].call("create_table", spec=self._specs[s])
+            return self._conns[s].call(method, **kwargs)
 
     def _fanout(self, thunks):
         """Run one thunk per server, overlapped when a pool exists."""
@@ -457,8 +731,8 @@ class RemoteTable:
         out = np.empty((ids.shape[0], self.dim), self.dtype)
         masks = [srv == s for s in range(self._n)]
         rows = self._fanout([
-            (lambda s=s, m=m: self._conns[s].call(
-                "gather", name=self.name, ids=local[m]))
+            (lambda s=s, m=m: self._call(
+                s, "gather", name=self.name, ids=local[m]))
             if m.any() else (lambda: None)
             for s, m in enumerate(masks)
         ])
@@ -478,8 +752,8 @@ class RemoteTable:
         # rows) so its barrier bookkeeping sees all trainers each step;
         # overlapped: in sync mode each call blocks on the barrier
         self._fanout([
-            lambda s=s: self._conns[s].call(
-                "push_gradients", name=self.name, ids=local[srv == s],
+            lambda s=s: self._call(
+                s, "push_gradients", name=self.name, ids=local[srv == s],
                 grads=grads[srv == s], trainer_id=self.trainer_id,
                 step=step)
             for s in range(self._n)
@@ -489,24 +763,28 @@ class RemoteTable:
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         deltas = np.asarray(deltas, np.float32).reshape(
             ids.shape[0], self.dim)
+        with self._step_lock:
+            seq = self._delta_seq
+            self._delta_seq += 1
         srv, local = self._locate(ids)
         masks = [srv == s for s in range(self._n)]
         self._fanout([
-            (lambda s=s, m=m: self._conns[s].call(
-                "push_delta", name=self.name, ids=local[m],
-                deltas=deltas[m]))
+            (lambda s=s, m=m: self._call(
+                s, "push_delta", name=self.name, ids=local[m],
+                deltas=deltas[m], trainer_id=self.trainer_id, seq=seq))
             if m.any() else (lambda: None)
             for s, m in enumerate(masks)
         ])
 
     # -- introspection / checkpoint --------------------------------------
     def nbytes(self) -> int:
-        return sum(c.call("nbytes", name=self.name) for c in self._conns)
+        return sum(self._call(s, "nbytes", name=self.name)
+                   for s in range(self._n))
 
     def stats(self) -> dict:
         agg = {"push_calls": 0, "pushed_bytes": 0}
-        for c in self._conns:
-            st = c.call("stats", name=self.name)
+        for s in range(self._n):
+            st = self._call(s, "stats", name=self.name)
             for k in agg:
                 agg[k] += st[k]
         return agg
@@ -514,24 +792,22 @@ class RemoteTable:
     def to_dense(self) -> np.ndarray:
         out = np.empty((self.rows, self.dim), self.dtype)
         for s in range(self._n):
-            out[s::self._n] = self._conns[s].call(
-                "to_dense", name=self.name)
+            out[s::self._n] = self._call(s, "to_dense", name=self.name)
         return out
 
     def state_dict(self):
-        return {"servers": [c.call("state_dict", name=self.name)
-                            for c in self._conns]}
+        return {"servers": [self._call(s, "state_dict", name=self.name)
+                            for s in range(self._n)]}
 
     def load_state_dict(self, state):
         if "servers" in state:
-            for c, st in zip(self._conns, state["servers"]):
-                c.call("load_state_dict", name=self.name, state=st)
+            for s, st in enumerate(state["servers"]):
+                self._call(s, "load_state_dict", name=self.name, state=st)
         else:  # a local-table checkpoint restored into a hosted run
             if self._n != 1:
                 raise ValueError(
                     "single-table checkpoint needs exactly 1 pserver")
-            self._conns[0].call(
-                "load_state_dict", name=self.name, state=state)
+            self._call(0, "load_state_dict", name=self.name, state=state)
 
     def close(self):
         if self._pool is not None:

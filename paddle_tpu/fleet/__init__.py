@@ -50,11 +50,22 @@ def init_worker() -> None:
     init_parallel_env()
 
 
-def init_server(model_dir: Optional[str] = None, **kwargs) -> None:
+def init_server(model_dir: Optional[str] = None,
+                snapshot_dir: Optional[str] = None,
+                snapshot_secs: Optional[float] = None, **kwargs) -> None:
     """Server-side init (reference fleet_base.init_server): record the
     checkpoint directory whose `<table>.pkl` state_dicts preload each
-    table on first creation (saved via `ps.get_table(n).state_dict()`)."""
+    table on first creation (saved via `ps.get_table(n).state_dict()`).
+
+    snapshot_secs > 0 makes run_server() checkpoint every table
+    atomically on that interval (ps_server.PSServer.snapshot), into
+    snapshot_dir — defaulting to model_dir, so a crashed-and-restarted
+    server resumes from its own latest snapshot through the same preload
+    path (bounded-staleness recovery; env fallbacks:
+    PADDLE_PS_SNAPSHOT_DIR / PADDLE_PS_SNAPSHOT_SECS)."""
     _fleet_state["ps_model_dir"] = model_dir
+    _fleet_state["ps_snapshot_dir"] = snapshot_dir or model_dir
+    _fleet_state["ps_snapshot_secs"] = snapshot_secs
 
 
 def run_server() -> None:
@@ -85,6 +96,8 @@ def run_server() -> None:
     ps_server.serve(
         port=port,
         preload_dir=_fleet_state.get("ps_model_dir"),
+        snapshot_dir=_fleet_state.get("ps_snapshot_dir"),
+        snapshot_secs=_fleet_state.get("ps_snapshot_secs"),
         ready_cb=ready,
     )
 

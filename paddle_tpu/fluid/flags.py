@@ -49,6 +49,13 @@ _DEFS: Dict[str, tuple] = {
                "XLA's dW-convolution lowering; NHWC groups=1 non-1x1 "
                "kernels only. The TPU answer to the reference's cudnn "
                "exhaustive dW algo search (conv_cudnn_op.cu.cc)"),
+    "FLAGS_ps_fault_injection": (
+        False, "distributed/faults.py: deterministic PS-RPC fault layer "
+               "(PADDLE_PS_FAULT_SPEC rules drop/refuse/delay the Nth "
+               "client RPC or kill the pserver after N handled RPCs) — "
+               "drives tests/test_ps_faults.py and the tools/ci.sh chaos "
+               "smoke. Off = injector() returns None and the data plane "
+               "is bit-identical to a build without the layer"),
     "FLAGS_dataloader_require_spawn": (
         False, "fluid/dataloader: raise instead of warning when worker "
                "args are unpicklable and the loader would fall back to "

@@ -24,6 +24,26 @@ from . import framework
 from .executor import global_scope
 
 
+def _atomic_write_bytes(path: str, blob: bytes) -> None:
+    """Write-to-temp + os.replace: a crash mid-save can never leave a
+    torn file at `path` for preload/load_train_model to reject — the
+    reader sees either the complete old file or the complete new one
+    (same contract as ps_server.PSServer.snapshot)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def _persistable_names(program) -> List[str]:
     return [v.name for v in program.list_vars() if v.persistable]
 
@@ -52,8 +72,7 @@ def _save_arrays(dirname: str, names: List[str], scope,
             from . import crypto
 
             blob = crypto.encrypt_bytes(blob, encrypt_key)
-        with open(path, "wb") as f:
-            f.write(blob)
+        _atomic_write_bytes(path, blob)
 
     if filename is not None:
         _write(os.path.join(dirname, filename),
@@ -133,8 +152,8 @@ def _save_ps_tables(dirname: str, program) -> None:
                 f"it. create_table before saving (or drop the lookup op)",
                 RuntimeWarning, stacklevel=3)
             continue
-        with open(os.path.join(dirname, f"{name}.pkl"), "wb") as f:
-            pickle.dump(t.state_dict(), f)
+        _atomic_write_bytes(os.path.join(dirname, f"{name}.pkl"),
+                            pickle.dumps(t.state_dict()))
 
 
 def _load_ps_tables(dirname: str, program) -> None:
@@ -301,13 +320,14 @@ def save_inference_model(
         from . import crypto
 
         blob = crypto.encrypt_bytes(blob, encrypt_key)
-    with open(os.path.join(dirname, model_filename), "wb") as f:
-        f.write(blob)
+    _atomic_write_bytes(os.path.join(dirname, model_filename), blob)
     fetch_names = [
         v.name if isinstance(v, framework.Variable) else str(v) for v in target_vars
     ]
-    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
-        json.dump({"feed_names": list(feeded_var_names), "fetch_names": fetch_names}, f)
+    _atomic_write_bytes(
+        os.path.join(dirname, "__meta__.json"),
+        json.dumps({"feed_names": list(feeded_var_names),
+                    "fetch_names": fetch_names}).encode())
     # save every persistable reachable in the pruned graph — Parameters
     # AND buffers (BatchNorm running stats, traced constants); a
     # Parameters-only filter would silently drop buffers and make the
@@ -367,8 +387,7 @@ def save(program, model_path: str):
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path + ".ckpt", state, force=True)
     ckptr.wait_until_finished()
-    with open(path + ".pdmodel", "wb") as f:
-        f.write(_serialize_program(program))
+    _atomic_write_bytes(path + ".pdmodel", _serialize_program(program))
     if _ps_table_names(program):
         os.makedirs(path + ".ps", exist_ok=True)
         _save_ps_tables(path + ".ps", program)
@@ -402,14 +421,15 @@ def save_train_model(executor, dirname, feed_names, loss, main_program=None,
     main_program = main_program or framework.default_main_program()
     startup_program = startup_program or framework.default_startup_program()
     os.makedirs(dirname, exist_ok=True)
-    with open(os.path.join(dirname, "__train_model__"), "wb") as f:
-        pickle.dump({
-            "version": 1,
-            "main": _serialize_program(main_program),
-            "startup": _serialize_program(startup_program),
-            "feed_names": list(feed_names),
-            "loss_name": loss if isinstance(loss, str) else loss.name,
-        }, f)
+    _atomic_write_bytes(os.path.join(dirname, "__train_model__"),
+                        pickle.dumps({
+                            "version": 1,
+                            "main": _serialize_program(main_program),
+                            "startup": _serialize_program(startup_program),
+                            "feed_names": list(feed_names),
+                            "loss_name": loss if isinstance(loss, str)
+                            else loss.name,
+                        }))
     save_persistables(executor, dirname, main_program=main_program)
 
 

@@ -1,0 +1,101 @@
+"""HeartBeatMonitor edge cases (distributed/heartbeat.py).
+
+The monitor's subtleties are exactly where liveness detection goes
+wrong in production: a rank that NEVER stamps must still be flagged
+(after the startup grace), a rank that exited cleanly must NOT be, and
+stamps left by a previous attempt in a reused directory must be ignored
+by a fresh monitor. All time arithmetic is driven through the explicit
+`now=` parameter so no test sleeps.
+"""
+import os
+import time
+
+from paddle_tpu.distributed.heartbeat import (
+    HeartBeatMonitor, HeartBeatWorker, _stamp_path)
+
+
+def _stamp(directory, rank, mtime=None):
+    p = _stamp_path(str(directory), rank)
+    with open(p, "w") as f:
+        f.write(repr(time.time()))
+    if mtime is not None:
+        os.utime(p, (mtime, mtime))
+    return p
+
+
+def test_never_stamping_rank_flagged_only_after_startup_grace(tmp_path):
+    """Rank 1 hangs before its FIRST stamp (deadlock during import /
+    first compile): invisible during the grace window — startup can
+    legitimately exceed the heartbeat timeout — but flagged once the
+    grace runs out, otherwise the targeted hang class is undetectable."""
+    mon = HeartBeatMonitor(str(tmp_path), [0, 1], timeout=1.0,
+                           startup_grace=10.0)
+    # rank 0 boots and keeps stamping; rank 1 never does
+    _stamp(tmp_path, 0, mtime=mon._t0 + 4.5)
+    assert mon.stale_ranks(now=mon._t0 + 5.0) == []  # inside grace
+    _stamp(tmp_path, 0, mtime=mon._t0 + 10.5)
+    assert mon.stale_ranks(now=mon._t0 + 11.0) == [1]  # grace expired
+
+
+def test_cleanly_exited_rank_is_not_flagged(tmp_path):
+    """A rank that finished and exited 0 stops stamping; the launcher
+    narrows the check to still-running ranks via `ranks=` and the
+    finished rank must never read as hung."""
+    mon = HeartBeatMonitor(str(tmp_path), [0, 1], timeout=1.0,
+                           startup_grace=10.0)
+    _stamp(tmp_path, 0)
+    _stamp(tmp_path, 1)
+    late = mon._t0 + 50.0  # both stamps are long stale by now
+    assert set(mon.stale_ranks(now=late)) == {0, 1}
+    # rank 0 exited cleanly: only rank 1 is still running
+    assert mon.stale_ranks(now=late, ranks=[1]) == [1]
+    assert mon.stale_ranks(now=late, ranks=[]) == []
+
+
+def test_stale_stamps_from_previous_attempt_are_ignored(tmp_path):
+    """A reused heartbeat dir holds stamps from a previous job/attempt
+    (hours old): a FRESH monitor must not read them as live heartbeats
+    NOR as instant hangs — they count as 'never stamped under this
+    monitor', so only the startup grace applies."""
+    _stamp(tmp_path, 0, mtime=1.0)  # epoch-old leftover
+    mon = HeartBeatMonitor(str(tmp_path), [0], timeout=1.0,
+                           startup_grace=10.0)
+    # the leftover is neither trusted (no instant-stale kill) ...
+    assert mon.stale_ranks(now=mon._t0 + 5.0) == []
+    # ... nor does it hide a rank that never produces a fresh stamp
+    assert mon.stale_ranks(now=mon._t0 + 11.0) == [0]
+    # a fresh stamp (newer than the monitor, recent at probe time)
+    # clears it
+    _stamp(tmp_path, 0, mtime=mon._t0 + 10.5)
+    assert mon.stale_ranks(now=mon._t0 + 11.0) == []
+
+
+def test_string_rank_tags_for_pservers(tmp_path):
+    """Pservers stamp string tags ('ps0') through the same channel
+    (ps_server.serve + launch.PServerSupervisor); the monitor treats
+    them exactly like integer trainer ranks."""
+    mon = HeartBeatMonitor(str(tmp_path), ["ps0", "ps1"], timeout=1.0,
+                           startup_grace=5.0)
+    # ps0 beats recently (relative to the probe time); ps1 never does
+    _stamp(tmp_path, "ps0", mtime=mon._t0 + 5.5)
+    assert mon.stale_ranks(now=mon._t0 + 6.0) == ["ps1"]
+    # narrowing by tag works like integer ranks: ps0's stamp is long
+    # stale by +60 and it is the only rank still checked
+    assert mon.stale_ranks(now=mon._t0 + 60.0, ranks=["ps0"]) == ["ps0"]
+
+
+def test_worker_stamps_atomically_and_stop_is_idempotent(tmp_path):
+    w = HeartBeatWorker(str(tmp_path), 3, interval=0.05)
+    assert w.start() is w
+    assert w.start() is w  # second start is a no-op, not a second thread
+    p = _stamp_path(str(tmp_path), 3)
+    assert os.path.exists(p)
+    m0 = os.path.getmtime(p)
+    deadline = time.time() + 5
+    while os.path.getmtime(p) == m0 and time.time() < deadline:
+        time.sleep(0.02)
+    assert os.path.getmtime(p) >= m0
+    # no torn temp files visible to a monitor scanning the dir
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    w.stop()
+    w.stop()  # idempotent

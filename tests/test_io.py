@@ -355,3 +355,41 @@ def test_save_warns_on_unregistered_ps_table(tmp_path):
             warnings.simplefilter("always")
             fluid.io.save_persistables(exe, str(tmp_path), main)
     assert any("ghost_tbl" in str(i.message) for i in w)
+
+
+def test_atomic_saves_survive_crash_mid_write(tmp_path, monkeypatch):
+    """Every save path writes tmp + os.replace: a crash BEFORE the
+    replace (simulated by making os.replace raise) must leave the
+    previous checkpoint intact and loadable — never a torn file that
+    load_train_model/preload then rejects."""
+    import os
+
+    x, y, pred, loss = _small_model()
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((4, 8), "float32"), "y": np.ones((4, 1), "float32")}
+    exe.run(feed=feed, fetch_list=[loss])
+
+    d = str(tmp_path / "train_model")
+    fluid.io.save_train_model(exe, d, ["x", "y"], loss)
+    (ref,) = exe.run(feed=feed, fetch_list=[loss])
+
+    # crash mid-save: the replace never happens
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated crash before rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with np.testing.assert_raises(OSError):
+        fluid.io.save_train_model(exe, d, ["x", "y"], loss)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    # no torn temp files pollute the checkpoint dir ...
+    assert not [f for f in os.listdir(d) if ".tmp" in f]
+    # ... and the PREVIOUS checkpoint still loads and reproduces the loss
+    with fluid.scope_guard(fluid.executor.Scope()):
+        main, startup, feeds, loss_name = fluid.io.load_train_model(exe, d)
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss_name])
+    np.testing.assert_allclose(np.asarray(lv), np.asarray(ref), rtol=1e-6)

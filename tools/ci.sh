@@ -10,15 +10,23 @@ cd "$(dirname "$0")/.."
 echo "== unit + integration tests (8-device virtual CPU mesh) =="
 # tee the run into TESTLOG (committed artifact): pytest tail + the
 # DOTS_PASSED count the tier-1 gate greps for — so every CI run leaves
-# an auditable record of what actually passed
+# an auditable record of what actually passed. Slow chaos drills are
+# excluded here (tier-1 wall time stays flat) and run explicitly below.
 rm -f /tmp/ci_pytest.log
-python -m pytest tests/ -x -q 2>&1 | tee /tmp/ci_pytest.log
+python -m pytest tests/ -x -q -m 'not slow' 2>&1 | tee /tmp/ci_pytest.log
 {
   echo "# TESTLOG — written by tools/ci.sh; pytest tail + dot count"
   echo "# (regenerate: tools/ci.sh quick)"
   tail -n 25 /tmp/ci_pytest.log
   echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/ci_pytest.log | tr -cd . | wc -c)"
 } > TESTLOG
+
+echo "== PS chaos smoke (deterministic fault injection) =="
+# tiny 2-trainer + 1-pserver jobs under PADDLE_PS_FAULT_SPEC: injected
+# connection drops must train to the EXACT no-fault loss (retry+dedup),
+# and a mid-run pserver kill must recover via supervised respawn +
+# snapshot preload (tests/test_ps_faults.py, the @slow process drills)
+python -m pytest tests/test_ps_faults.py -q -m slow
 
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
