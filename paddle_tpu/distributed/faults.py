@@ -1,11 +1,11 @@
-"""Deterministic fault injection for the PS data plane.
+"""Deterministic fault injection for the PS data plane and checkpoints.
 
 Parity surface: the reference hardens its distributed runtime against
 real faults (grpc_client.h retries, HeartBeatMonitor timeouts,
 checkpoint_notify recovery) but tests them with sleeps and luck; here
 faults are INJECTED on a deterministic schedule so the chaos tests in
-tests/test_ps_faults.py assert exact recovery behavior instead of
-probabilistic survival.
+tests/test_ps_faults.py and tests/test_checkpoint.py assert exact
+recovery behavior instead of probabilistic survival.
 
 Gate: the layer is active only when BOTH the FLAGS_ps_fault_injection
 flag is on AND PADDLE_PS_FAULT_SPEC is non-empty. Flag-off behavior is
@@ -28,7 +28,16 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
             kill    server side: os._exit(1) the pserver process once it
                     has handled <nth> RPCs in total (method filter still
                     applies): exercises supervision + snapshot recovery
-    method  an RPC verb name (gather, push_gradients, ...) or "*"
+            crash   phase side: os._exit(1) at the Nth arrival at a
+                    named code phase (crash_point(phase) call sites; the
+                    <method> field names the phase). Checkpoint commit
+                    phases: "ckpt_tmp_written" (content files written,
+                    step dir not yet renamed into place) and
+                    "ckpt_before_commit" (step dir in place, manifest —
+                    the commit point — not yet written): exercises the
+                    torn-checkpoint fallback in fluid/checkpoint.py
+    method  an RPC verb name (gather, push_gradients, ...), a phase
+            name (crash rules), or "*"
     nth     1-based index of the matching call AT THE INJECTION SITE;
             each rule fires exactly once, on its Nth match
 
@@ -49,6 +58,7 @@ ENV_SPEC = "PADDLE_PS_FAULT_SPEC"
 
 _CLIENT_ACTIONS = ("drop", "refuse", "delay")
 _SERVER_ACTIONS = ("kill",)
+_PHASE_ACTIONS = ("crash",)
 
 
 class FaultError(ConnectionError):
@@ -87,10 +97,11 @@ def parse_spec(spec: str) -> List[_Rule]:
             raise ValueError(
                 f"bad fault rule {raw!r}: want action:method:nth[:arg]")
         action, method, nth = parts[0], parts[1], parts[2]
-        if action not in _CLIENT_ACTIONS + _SERVER_ACTIONS:
+        known = _CLIENT_ACTIONS + _SERVER_ACTIONS + _PHASE_ACTIONS
+        if action not in known:
             raise ValueError(
                 f"bad fault rule {raw!r}: unknown action {action!r} "
-                f"(want one of {_CLIENT_ACTIONS + _SERVER_ACTIONS})")
+                f"(want one of {known})")
         try:
             n = int(nth)
         except ValueError:
@@ -112,6 +123,11 @@ class FaultInjector:
     Server hook (called by ps_server.PSServer.handle):
       on_server_call(method) — fires kill (os._exit) once the counter
       reaches the rule's nth
+
+    Phase hook (called through crash_point() at named code phases, e.g.
+    fluid/checkpoint.py's commit protocol):
+      at_phase(phase) — fires crash (os._exit) on the Nth arrival at
+      the matching phase
     """
 
     def __init__(self, spec: str):
@@ -157,6 +173,16 @@ class FaultInjector:
                          f"(rule kill:{r.method}:{r.nth})\n").encode())
             os._exit(1)
 
+    # -- phase side ------------------------------------------------------
+    def at_phase(self, phase: str) -> None:
+        for r in self._take(("crash",), phase):
+            # same hard death as kill: the atomic-commit protocol must
+            # leave a recoverable state at EVERY phase boundary
+            os.write(2, (f"[faults] crashing pid {os.getpid()} at phase "
+                         f"{phase!r} (rule crash:{r.method}:{r.nth})\n"
+                         ).encode())
+            os._exit(1)
+
 
 _injector: Optional[FaultInjector] = None
 _injector_lock = threading.Lock()
@@ -177,6 +203,16 @@ def injector() -> Optional[FaultInjector]:
         if _injector is None or _injector.spec != spec:
             _injector = FaultInjector(spec)
         return _injector
+
+
+def crash_point(phase: str) -> None:
+    """Deterministic kill site: os._exit(1) if an armed crash rule
+    matches this phase on this arrival. One flag read when the layer is
+    off — callers (checkpoint commit protocol) pay nothing in
+    production."""
+    inj = injector()
+    if inj is not None:
+        inj.at_phase(phase)
 
 
 def reset() -> None:

@@ -20,6 +20,21 @@ and the job loses at most one snapshot interval of table updates instead
 of hanging (the reference launcher only watches trainers; a dead pserver
 is a whole-job hang there).
 
+Preemption: SIGTERM to the LAUNCHER is forwarded to every trainer and
+the job gets --sigterm_grace seconds to finish its final checkpoints
+(fluid/checkpoint.py training loops honor the signal at the next step
+boundary) before being terminated — the TPU-pod eviction contract. A
+SIGTERM'd TRAINER that checkpointed exits with
+checkpoint.PREEMPTED_EXIT_CODE (75); like any nonzero exit it consumes
+one --elastic_retries attempt, and the respawned trainer auto-resumes
+from the latest valid checkpoint (Model.fit(resume=...)).
+
+Cross-job PS state: when PADDLE_PS_SNAPSHOT_DIR names a STABLE directory
+(not this launcher's tempdir), freshly spawned pservers preload from it
+on FIRST start too — a new job adopts the previous job's tables (epoch +
+generation recorded in the snapshot manifest.json) the way
+fleet.init_server(model_dir) does manually.
+
 TPU notes: one process per HOST is the normal topology (all local chips
 belong to one PJRT client); --nproc_per_node exists for CPU fleets and
 tests. Rendezvous is the JAX coordination service bootstrapped from the
@@ -91,6 +106,13 @@ def _parse_args(argv):
         "PADDLE_ELASTIC_RESTART carries the attempt number), and "
         "restart a dead pserver up to N times (snapshot recovery). 0 = "
         "reference behavior: fail fast (utils.py:407)",
+    )
+    p.add_argument(
+        "--sigterm_grace", type=float, default=30.0,
+        help="seconds the job gets to checkpoint after the launcher "
+        "receives SIGTERM (forwarded to every trainer; training loops "
+        "with a CheckpointManager write a final checkpoint and exit). "
+        "After the grace window remaining trainers are terminated",
     )
     p.add_argument(
         "--heartbeat_timeout", type=float, default=0.0,
@@ -183,19 +205,23 @@ def start_pservers(server_num: int, servers: str, node_ip: str,
                    log_dir: Optional[str] = None,
                    snapshot_dir: Optional[str] = None,
                    snapshot_secs: float = 0.0,
-                   heartbeat_dir: Optional[str] = None):
+                   heartbeat_dir: Optional[str] = None,
+                   adopt_snapshots: bool = False):
     """Spawn this node's pserver processes (reference launch_ps.py
     start_procs). Returns (List[PServer], full_endpoint_list).
     --server_num spawns on launcher-chosen free ports (the child binds
     port 0 and reports the bound port on stdout, so there is no
     pick-then-bind race); --servers spawns the endpoints whose host is
-    this node."""
+    this node. adopt_snapshots (stable PADDLE_PS_SNAPSHOT_DIR): preload
+    each server's snapshot partition on FIRST spawn, not just respawn —
+    a new job adopts a previous job's tables."""
     pservers: List[PServer] = []
 
     def spawn(port: int, host: str, idx: int) -> int:
         proc = _spawn_pserver(idx, host, port, log_dir=log_dir,
                               snapshot_root=snapshot_dir,
                               snapshot_secs=snapshot_secs,
+                              preload_snapshots=adopt_snapshots,
                               heartbeat_dir=heartbeat_dir)
         pservers.append(PServer(idx, host, proc.ps_bound_port, proc))
         return proc.ps_bound_port
@@ -305,6 +331,48 @@ class PServerSupervisor:
         return None
 
 
+class SigtermGrace:
+    """Launcher-side preemption protocol: on SIGTERM, forward the signal
+    to every live trainer (their training loops checkpoint and exit) and
+    give the group `grace_secs` to drain before the watcher terminates
+    whatever is left. install() chains any previous handler; trainers
+    are registered per elastic attempt."""
+
+    def __init__(self, grace_secs: float):
+        self.grace_secs = float(grace_secs)
+        self.requested = threading.Event()
+        self.deadline: Optional[float] = None
+        self.trainers: List[Trainer] = []
+
+    def install(self) -> bool:
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _handler(sig, frame):
+                self.requested.set()
+                self.deadline = time.time() + self.grace_secs
+                print("[launch] SIGTERM: forwarding to trainers for a "
+                      f"final checkpoint ({self.grace_secs}s grace)",
+                      file=sys.stderr)
+                for t in self.trainers:
+                    if t.proc is not None and t.proc.poll() is None:
+                        try:
+                            t.proc.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+                if callable(prev) and prev not in (signal.SIG_IGN,
+                                                   signal.SIG_DFL):
+                    prev(sig, frame)
+
+            signal.signal(signal.SIGTERM, _handler)
+            return True
+        except ValueError:  # not the main thread (tests calling launch())
+            return False
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.time() > self.deadline
+
+
 def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
                          script_args: List[str], log_dir: Optional[str],
                          restart_count: int = 0,
@@ -355,16 +423,28 @@ def terminate_local_trainers(trainers: List[Trainer]):
 
 
 def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
-                         monitor=None, ps_supervisor=None) -> int:
+                         monitor=None, ps_supervisor=None,
+                         grace: Optional[SigtermGrace] = None) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
     aborts the whole local group (reference watch_local_trainers:407:
     fail fast; heartbeat parity: heart_beat_monitor.h:54). A
     `ps_supervisor` (PServerSupervisor) is polled on the same cadence:
     it respawns dead pservers in place, or returns an exit code to abort
-    with when the restart budget is gone. Returns the job's exit code."""
+    with when the restart budget is gone. Under a SIGTERM `grace` the
+    watcher waits for the (already signaled) trainers to finish their
+    final checkpoints, terminating stragglers when the grace window
+    expires, and reports 128+SIGTERM. Returns the job's exit code."""
     try:
         while True:
+            if grace is not None and grace.requested.is_set():
+                # preemption drain: children got SIGTERM from the grace
+                # handler; each checkpoints and exits on its own
+                while (any(t.proc.poll() is None for t in trainers)
+                       and not grace.expired()):
+                    time.sleep(poll_interval)
+                terminate_local_trainers(trainers)
+                return 128 + signal.SIGTERM
             alive = False
             for t in trainers:
                 rc = t.proc.poll()
@@ -428,15 +508,23 @@ def launch(argv=None) -> int:
         else:
             snapshot_secs = 1.0 if args.elastic_retries > 0 else 0.0
 
+    grace = SigtermGrace(args.sigterm_grace)
+    grace.install()
+
     pservers: List[PServer] = []
     ps_supervisor = None
     snapshot_dir = None
     own_snapshot_dir = False
+    adopt_snapshots = False
     try:
         if args.server_num or args.servers:
             if snapshot_secs > 0:
                 snapshot_dir = os.environ.get("PADDLE_PS_SNAPSHOT_DIR")
-                if not snapshot_dir:
+                if snapshot_dir:
+                    # stable cross-job dir: a previous job's snapshots
+                    # (+ manifest) are adopted on first spawn
+                    adopt_snapshots = True
+                else:
                     if args.log_dir:
                         snapshot_dir = os.path.join(
                             args.log_dir, "ps_snapshots")
@@ -450,7 +538,8 @@ def launch(argv=None) -> int:
             pservers, endpoints = start_pservers(
                 args.server_num, args.servers, node_ip, args.log_dir,
                 snapshot_dir=snapshot_dir, snapshot_secs=snapshot_secs,
-                heartbeat_dir=heartbeat_dir)
+                heartbeat_dir=heartbeat_dir,
+                adopt_snapshots=adopt_snapshots)
             # trainers inherit the list through start_local_trainers' env
             os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(endpoints)
             os.environ.setdefault("PADDLE_TRAINING_ROLE", "TRAINER")
@@ -461,7 +550,7 @@ def launch(argv=None) -> int:
                     heartbeat_dir=heartbeat_dir,
                     heartbeat_timeout=args.heartbeat_timeout)
         return _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
-                                ps_supervisor)
+                                ps_supervisor, grace)
     finally:
         terminate_pservers(pservers)
         if own_heartbeat_dir:
@@ -475,7 +564,7 @@ def launch(argv=None) -> int:
 
 
 def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
-                     ps_supervisor=None) -> int:
+                     ps_supervisor=None, grace=None) -> int:
     attempt = 0
     while True:
         local = start_local_trainers(
@@ -485,6 +574,8 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
         if not local:
             print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
             return 2
+        if grace is not None:
+            grace.trainers = local
         monitor = None
         if heartbeat_dir:
             from .heartbeat import HeartBeatMonitor
@@ -496,9 +587,10 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                 heartbeat_dir, [t.rank for t in local], args.heartbeat_timeout
             )
         rc = watch_local_trainers(local, monitor=monitor,
-                                  ps_supervisor=ps_supervisor)
+                                  ps_supervisor=ps_supervisor, grace=grace)
         if (rc == 0 or attempt >= args.elastic_retries
                 or rc == 128 + signal.SIGINT
+                or rc == 128 + signal.SIGTERM  # whole-job preemption
                 or (ps_supervisor is not None and ps_supervisor.aborted)):
             return rc
         attempt += 1

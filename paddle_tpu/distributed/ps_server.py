@@ -59,6 +59,7 @@ infra, not the data plane's job).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import pickle
 import random
@@ -123,6 +124,36 @@ def _recv_msg(sock: socket.socket):
 # ---------------------------------------------------------------------------
 # server
 # ---------------------------------------------------------------------------
+
+
+def _atomic_write(path: str, blob: bytes) -> None:
+    """tmp + os.replace (the fluid/io.py contract): a crash mid-write
+    can never leave a torn file at `path`."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_snapshot_manifest(dirname: str) -> Optional[dict]:
+    """Parsed `<dirname>/manifest.json` of a PS snapshot dir, or None
+    when absent/unreadable (pre-manifest snapshot dirs stay loadable —
+    the per-table .pkl files are the state; the manifest is metadata)."""
+    try:
+        with open(os.path.join(dirname, "manifest.json")) as f:
+            m = json.load(f)
+        return m if isinstance(m, dict) else None
+    except (OSError, ValueError):
+        return None
 
 
 def _validated_state(state, table, name):
@@ -218,6 +249,17 @@ class PSServer:
         self.snapshot_dir = snapshot_dir or None
         self.snapshot_secs = float(snapshot_secs or 0.0)
         self._snap_thread: Optional[threading.Thread] = None
+        # cross-job adoption: a stable snapshot dir carries a manifest
+        # (snapshot epoch + trainer-group generation); a new job's
+        # server picks up the epoch counter where the old job left it,
+        # and serve() reports what was adopted
+        self._snapshot_epoch = 0
+        self.adopted_manifest: Optional[dict] = None
+        if preload_dir:
+            m = read_snapshot_manifest(preload_dir)
+            if m is not None:
+                self.adopted_manifest = m
+                self._snapshot_epoch = int(m.get("snapshot_epoch", 0))
 
     # -- verbs -----------------------------------------------------------
 
@@ -400,30 +442,37 @@ class PSServer:
         can never leave a torn file, so the newest snapshot on disk is
         always loadable). Same format as preload_dir, so a supervised
         restart restores it through the existing create_table path.
-        Returns the number of tables written."""
+        A manifest.json (snapshot epoch, trainer-group generation, table
+        geometries) is committed LAST, so a stable cross-job snapshot
+        dir is self-describing: the next job's servers adopt the tables
+        and the manifest tells operators what they adopted. Returns the
+        number of tables written."""
         if not self.snapshot_dir:
             return 0
         os.makedirs(self.snapshot_dir, exist_ok=True)
         with self.lock:
             items = list(self.tables.items())
+            gens = dict(self.gens)
         n = 0
         for name, t in items:
-            path = os.path.join(self.snapshot_dir, f"{name}.pkl")
-            tmp = f"{path}.tmp.{os.getpid()}"
-            try:
-                with open(tmp, "wb") as f:
-                    pickle.dump(t.state_dict(), f,
-                                protocol=pickle.HIGHEST_PROTOCOL)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
-            except BaseException:
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
-                raise
+            _atomic_write(os.path.join(self.snapshot_dir, f"{name}.pkl"),
+                          pickle.dumps(t.state_dict(),
+                                       protocol=pickle.HIGHEST_PROTOCOL))
             n += 1
+        if n:
+            self._snapshot_epoch += 1
+            manifest = {
+                "format": 1,
+                "snapshot_epoch": self._snapshot_epoch,
+                "generation": max(gens.values(), default=0),
+                "unix_time": time.time(),
+                "tables": {
+                    name: {"rows": t.rows, "dim": t.dim}
+                    for name, t in items
+                },
+            }
+            _atomic_write(os.path.join(self.snapshot_dir, "manifest.json"),
+                          json.dumps(manifest, indent=1).encode())
         return n
 
     def start_snapshotter(self) -> None:
@@ -502,6 +551,14 @@ def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
         hb = HeartBeatWorker(hb_dir, hb_tag).start()
     if ready_cb is not None:
         ready_cb(srv.server_address)
+    if srv.ps.adopted_manifest is not None:
+        # printed AFTER the ready banner: the launcher reads the first
+        # stdout line to learn the bound port
+        m = srv.ps.adopted_manifest
+        print(f"[ps_server] adopting snapshot dir {preload_dir!r} "
+              f"(epoch {m.get('snapshot_epoch')}, generation "
+              f"{m.get('generation')}, tables "
+              f"{sorted(m.get('tables', {}))})", flush=True)
     try:
         srv.serve_forever(poll_interval=0.1)
     finally:

@@ -62,10 +62,26 @@ def init_server(model_dir: Optional[str] = None,
     snapshot_dir — defaulting to model_dir, so a crashed-and-restarted
     server resumes from its own latest snapshot through the same preload
     path (bounded-staleness recovery; env fallbacks:
-    PADDLE_PS_SNAPSHOT_DIR / PADDLE_PS_SNAPSHOT_SECS)."""
+    PADDLE_PS_SNAPSHOT_DIR / PADDLE_PS_SNAPSHOT_SECS).
+
+    Cross-job adoption: each snapshot dir carries a `manifest.json`
+    (snapshot epoch, trainer-group generation, table geometries) written
+    atomically AFTER the table pickles. Point a NEW job's model_dir — or
+    its launcher's stable PADDLE_PS_SNAPSHOT_DIR — at a previous job's
+    snapshot dir and the tables are adopted automatically, the way this
+    manual init_server(model_dir) contract always worked; inspect what
+    will be adopted with fleet.ps_snapshot_manifest(dir)."""
     _fleet_state["ps_model_dir"] = model_dir
     _fleet_state["ps_snapshot_dir"] = snapshot_dir or model_dir
     _fleet_state["ps_snapshot_secs"] = snapshot_secs
+
+
+def ps_snapshot_manifest(dirname: str) -> Optional[dict]:
+    """Parsed manifest.json of a PS snapshot directory (snapshot epoch,
+    generation, tables), or None for absent/pre-manifest dirs."""
+    from ..distributed.ps_server import read_snapshot_manifest
+
+    return read_snapshot_manifest(dirname)
 
 
 def run_server() -> None:

@@ -24,8 +24,13 @@ def save_dygraph(state_dict, model_path: str) -> None:
             kk: np.asarray(vv) for kk, vv in v.items()
         }
     os.makedirs(os.path.dirname(os.path.abspath(model_path)) or ".", exist_ok=True)
-    with open(model_path + suffix, "wb") as f:
-        pickle.dump(arrays, f)
+    # tmp + os.replace, same contract as every fluid/io.py save path: a
+    # crash mid-save can never leave a torn .pdparams/.pdopt for the
+    # next load_dygraph to choke on — it sees the complete old file or
+    # the complete new one
+    from ..io import _atomic_write_bytes
+
+    _atomic_write_bytes(model_path + suffix, pickle.dumps(arrays))
 
 
 def load_dygraph(model_path: str):

@@ -121,13 +121,15 @@ class Executor:
         feed_arrays = self._prepare_feed(block, feed)
         from .flags import flag
 
-        # the nan/inf debugging mode disables buffer donation (donated
-        # buffers are destroyed by the step, which would make "recover
-        # the last good parameters after the raise" impossible), so the
-        # compile cache must distinguish the two modes
+        # the nan/inf debugging mode and the bad-step guard both disable
+        # buffer donation (donated buffers are destroyed by the step,
+        # which would make "recover / keep the last good parameters"
+        # impossible), so the compile cache must distinguish the modes
         check_nan = flag("FLAGS_check_nan_inf")
+        check_numerics = flag("FLAGS_check_numerics")
         compiled = self._ensure_compiled(
-            program, block, feed_arrays, fetch_names, scope, check_nan
+            program, block, feed_arrays, fetch_names, scope,
+            check_nan or check_numerics,
         )
         self._ensure_rng(scope, program)
 
@@ -175,6 +177,19 @@ class Executor:
             fetches, new_state, new_key = compiled.fn(
                 feed_arrays, donated, kept, scope._rng_key
             )
+        if check_numerics:
+            # bad-step guard (FLAGS_check_numerics): refuse to COMMIT a
+            # step whose gradients went non-finite — scope (params,
+            # moments, RNG key) stays exactly pre-step, so the caller
+            # can skip the batch or roll back. Raised before check_nan:
+            # skip semantics win over fail-fast when both are on.
+            bad = self._scan_bad_step(new_state)
+            if bad is not None:
+                from .checkpoint import BadStepError
+
+                raise BadStepError(
+                    f"FLAGS_check_numerics: {bad}; step NOT committed "
+                    f"(parameters, optimizer state and RNG unchanged)")
         if check_nan:
             # reference FLAGS_check_nan_inf scans every op output
             # (operator.cc:1020); with whole-block XLA compilation the
@@ -194,6 +209,33 @@ class Executor:
             with RecordEvent("Executor::fetch"):
                 return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _scan_bad_step(new_state):
+        """Guard-var check for FLAGS_check_numerics. Programs built with
+        the flag on carry one or more `check_numerics_bad_*` persistable
+        vars (Optimizer._append_check_numerics_guard: an in-graph
+        any-grad-non-finite reduction — grads are fused intermediates
+        the host could never scan). Programs without a guard var (built
+        flag-off, or no optimizer) fall back to scanning the updated
+        state itself. Returns a description of the violation or None."""
+        import jax.numpy as jnp
+
+        guard_vals = {n: v for n, v in new_state.items()
+                      if n.startswith("check_numerics_bad")}
+        if guard_vals:
+            for n, v in guard_vals.items():
+                if bool(jnp.any(jnp.asarray(v) != 0)):
+                    return f"non-finite gradient detected (guard {n!r})"
+            return None
+        for n, v in new_state.items():
+            try:
+                ok = bool(jnp.all(jnp.isfinite(v)))
+            except TypeError:  # non-float state (ints, keys)
+                continue
+            if not ok:
+                return f"variable {n!r} would become non-finite"
+        return None
 
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, new_state):
@@ -219,17 +261,19 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _ensure_compiled(self, program, block, feed_arrays, fetch_names,
-                         scope, check_nan):
+                         scope, no_donate):
         """Fetch-or-build the compiled step for this cache key. Shared by
         run() and memory_analysis() so both agree on compile semantics
-        (and memory_analysis can compile WITHOUT executing)."""
-        key = self._cache_key(program, feed_arrays, fetch_names, check_nan)
+        (and memory_analysis can compile WITHOUT executing). no_donate:
+        diagnostic/guard modes (check_nan_inf, check_numerics) must keep
+        the pre-step buffers alive."""
+        key = self._cache_key(program, feed_arrays, fetch_names, no_donate)
         compiled = self._cache.get(key)
         if compiled is None:
             with RecordEvent("Executor::compile"):
                 compiled = self._compile(
                     program, block, sorted(feed_arrays), fetch_names, scope,
-                    donate=not check_nan,
+                    donate=not no_donate,
                 )
             self._cache[key] = compiled
         return compiled
@@ -252,7 +296,7 @@ class Executor:
                 scope._rng_key = jax.random.PRNGKey(program.random_seed or 0)
 
     @staticmethod
-    def _cache_key(program, feed_arrays, fetch_names, check_nan):
+    def _cache_key(program, feed_arrays, fetch_names, no_donate):
         """THE compile-cache key — run() and memory_analysis() must agree
         on its exact shape, so both build it here."""
         feed_sig = tuple(
@@ -264,7 +308,7 @@ class Executor:
         # diagnostic flags belong in the key: toggling one to debug must
         # recompile, not silently hit the pre-toggle cache entry
         return (program._serial, program._version, feed_sig, fetch_names,
-                check_nan, flag("FLAGS_enable_unused_var_check"))
+                no_donate, flag("FLAGS_enable_unused_var_check"))
 
     def _prepare_feed(self, block, feed):
         import jax
@@ -581,7 +625,7 @@ class Executor:
         # block is cached, so a subsequent run() reuses it.
         compiled = self._ensure_compiled(
             program, block, feed_arrays, fetch_names, scope,
-            flag("FLAGS_check_nan_inf"),
+            flag("FLAGS_check_nan_inf") or flag("FLAGS_check_numerics"),
         )
         self._ensure_rng(scope, program)
         states = {
@@ -622,12 +666,26 @@ class Executor:
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           checkpoint_dir=None, checkpoint_freq=0,
+                           checkpoint_keep=3, resume=False):
         """Train by streaming batches from a Dataset (reference
         executor.py:1546 → C++ MultiTrainer/HogwildWorker hot loop,
         hogwild_worker.cc:191). The TPU executor has no per-thread scopes:
         the dataset iterator feeds the one compiled step, which is already
-        the whole fwd+bwd+update program."""
+        the whole fwd+bwd+update program.
+
+        checkpoint_dir arms the preemption-safe layer
+        (fluid/checkpoint.py): every `checkpoint_freq` consumed batches
+        the full training state (persistables, RNG, reader position) is
+        committed atomically; resume=True restores the newest VALID
+        checkpoint and skips the already-consumed batches, continuing
+        with a bit-identical loss trace; a SIGTERM (or
+        checkpoint.request_preemption()) gets a final checkpoint and
+        raises checkpoint.Preempted. Under FLAGS_check_numerics a bad
+        step is skipped, and after FLAGS_check_numerics_max_bad_steps
+        consecutive bad steps the run rolls back to the last checkpoint
+        (re-reading the dataset from its recorded position)."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         if thread:
@@ -637,19 +695,75 @@ class Executor:
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in fetch_list
         ]
+        from . import checkpoint as ckpt_mod
+        from .flags import flag
+
+        mgr = None
+        consumed = 0
+        if checkpoint_dir:
+            if program is None:
+                program = framework.default_main_program()
+            if hasattr(program, "_program"):
+                program = program._program
+            mgr = ckpt_mod.CheckpointManager(
+                checkpoint_dir, keep_last_n=checkpoint_keep,
+                program=program, scope=scope or global_scope())
+            ckpt_mod.install_preemption_handler()
+            if resume:
+                st = mgr.restore()
+                if st is not None:
+                    consumed = int(st["extra"].get("consumed_batches", 0))
+        max_bad = max(1, int(flag("FLAGS_check_numerics_max_bad_steps")))
+        bad_streak, last_rollback_sig = 0, None
         last = None
-        for step, feed in enumerate(dataset._as_loader(drop_last=True)):
-            last = self.run(
-                program, feed=feed, fetch_list=fetch_names, scope=scope
-            )
-            if debug and fetch_names and step % print_period == 0:
-                info = fetch_info or fetch_names
-                vals = ", ".join(
-                    f"{n}={np.asarray(v).reshape(-1)[0]:.6f}"
-                    for n, v in zip(info, last)
-                )
-                print(f"step {step}: {vals}")
-        return last
+        while True:
+            rolled_back = False
+            step = 0
+            for feed in dataset._as_loader(drop_last=True):
+                if step < consumed:  # replaying up to the restored position
+                    step += 1
+                    continue
+                if mgr is not None and ckpt_mod.preemption_requested():
+                    mgr.save(step, extra_state={"consumed_batches": step})
+                    raise ckpt_mod.Preempted(
+                        f"preemption requested: checkpointed at batch "
+                        f"{step} in {checkpoint_dir!r}")
+                try:
+                    last = self.run(program, feed=feed,
+                                    fetch_list=fetch_names, scope=scope)
+                except ckpt_mod.BadStepError:
+                    bad_streak += 1
+                    if bad_streak >= max_bad:
+                        # same-position repeat streak: the replay
+                        # re-diverged deterministically — propagate
+                        # instead of rolling back forever
+                        sig = step - bad_streak + 1
+                        if (mgr is None or mgr.latest_step() is None
+                                or sig == last_rollback_sig):
+                            raise
+                        last_rollback_sig = sig
+                        st = mgr.restore()
+                        consumed = int(
+                            st["extra"].get("consumed_batches", 0))
+                        bad_streak = 0
+                        rolled_back = True
+                        break
+                    step += 1  # skip the poisoned batch, keep training
+                    continue
+                bad_streak = 0
+                if debug and fetch_names and step % print_period == 0:
+                    info = fetch_info or fetch_names
+                    vals = ", ".join(
+                        f"{n}={np.asarray(v).reshape(-1)[0]:.6f}"
+                        for n, v in zip(info, last)
+                    )
+                    print(f"step {step}: {vals}")
+                step += 1
+                if (mgr is not None and checkpoint_freq
+                        and step % checkpoint_freq == 0):
+                    mgr.save(step, extra_state={"consumed_batches": step})
+            if not rolled_back:
+                return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

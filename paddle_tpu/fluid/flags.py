@@ -50,12 +50,31 @@ _DEFS: Dict[str, tuple] = {
                "kernels only. The TPU answer to the reference's cudnn "
                "exhaustive dW algo search (conv_cudnn_op.cu.cc)"),
     "FLAGS_ps_fault_injection": (
-        False, "distributed/faults.py: deterministic PS-RPC fault layer "
+        False, "distributed/faults.py: deterministic fault layer "
                "(PADDLE_PS_FAULT_SPEC rules drop/refuse/delay the Nth "
-               "client RPC or kill the pserver after N handled RPCs) — "
-               "drives tests/test_ps_faults.py and the tools/ci.sh chaos "
+               "client RPC, kill the pserver after N handled RPCs, or "
+               "crash the process at a named phase of the checkpoint "
+               "commit protocol) — drives tests/test_ps_faults.py, "
+               "tests/test_checkpoint.py and the tools/ci.sh chaos "
                "smoke. Off = injector() returns None and the data plane "
                "is bit-identical to a build without the layer"),
+    "FLAGS_check_numerics": (
+        False, "bad-step guard on the fp32 path (AMP has its own "
+               "found_inf protocol): Optimizer.apply_gradients emits an "
+               "in-graph any-gradient-non-finite reduction into a "
+               "persistable check_numerics_bad_* var, Executor.run "
+               "refuses to commit a step whose guard tripped (raises "
+               "checkpoint.BadStepError with the scope untouched), and "
+               "the training loops (Model.fit, train_from_dataset) skip "
+               "the step — after FLAGS_check_numerics_max_bad_steps "
+               "consecutive bad steps they roll back to the last valid "
+               "checkpoint. Off = no guard ops, donation unchanged: "
+               "bit-identical to baseline"),
+    "FLAGS_check_numerics_max_bad_steps": (
+        3, "consecutive BadStepError count that triggers a rollback to "
+           "the newest valid checkpoint (or re-raises when no "
+           "CheckpointManager is active). Only read when "
+           "FLAGS_check_numerics is on"),
     "FLAGS_dataloader_require_spawn": (
         False, "fluid/dataloader: raise instead of warning when worker "
                "args are unpicklable and the loader would fall back to "

@@ -131,6 +131,10 @@ class Optimizer:
         if grad_clip is not None:
             params_grads = grad_clip(params_grads)
         params_grads = append_regularization_ops(params_grads, self.regularization)
+        from .flags import flag as _flag
+
+        if _flag("FLAGS_check_numerics"):
+            self._append_check_numerics_guard(params_grads)
         self._create_global_learning_rate()
         optimize_ops = []
         block = framework.default_main_program().global_block()
@@ -142,11 +146,69 @@ class Optimizer:
         self._finish_update(block, params_grads)
         return optimize_ops
 
+    def _append_check_numerics_guard(self, params_grads):
+        """Bad-step guard (FLAGS_check_numerics), fp32 path: reduce
+        every gradient to ONE persistable `check_numerics_bad_*` scalar
+        (1.0 iff any grad holds NaN/Inf) inside the step program —
+        gradients are fused XLA intermediates, so the host can only see
+        them through an in-graph reduction like this (same technique as
+        AMP's found_inf, which owns the fp16 path: under AMP the grads
+        reaching this optimizer are already zeroed on overflow, so the
+        guard stays silent there). Executor.run reads the guard from the
+        step's state outputs and refuses to commit when it tripped."""
+        from . import layers
+
+        grads = [g for _, g in params_grads
+                 if g is not None and str(g.dtype) in ("float32",
+                                                       "float64")]
+        if not grads:
+            return
+        bad = layers.fill_constant([1], "bool", 0.0)
+        for g in grads:
+            bad = layers.logical_or(
+                bad,
+                layers.logical_not(layers.reduce_all(layers.isfinite_v2(g))),
+            )
+        name = unique_name.generate("check_numerics_bad")
+        main_block = framework.default_main_program().global_block()
+        v = main_block.create_var(
+            name=name, shape=(1,), dtype="float32", persistable=True,
+            stop_gradient=True,
+        )
+        sblock = framework.default_startup_program().global_block()
+        sv = sblock.create_var(
+            name=name, shape=(1,), dtype="float32", persistable=True
+        )
+        ConstantInitializer(0.0)(sv, sblock)
+        layers.assign(layers.cast(bad, "float32"), v)
+
     def apply_optimize(self, loss, startup_program, params_grads):
         with program_guard(loss.block.program, startup_program):
             return self.apply_gradients(params_grads)
 
     # -- dygraph (eager) path -------------------------------------------
+    def state_dict(self):
+        """Dygraph accumulator state, {param_name: {accum_name: array}} —
+        the save_dygraph .pdopt payload (reference optimizer.state_dict).
+        Static-graph accumulators live in the scope and ride along with
+        save_persistables / CheckpointManager instead."""
+        return {
+            pname: {k: np.asarray(v) for k, v in st.items()}
+            for pname, st in getattr(self, "_eager_state", {}).items()
+        }
+
+    def set_state_dict(self, state_dict):
+        """Restore dygraph accumulator state (load_dygraph's .pdopt dict).
+        Keyed by parameter name: a fresh process re-building the same
+        model reproduces the same names (unique_name restarts at 0),
+        which is the resume contract."""
+        self._eager_state = {
+            pname: dict(st) for pname, st in (state_dict or {}).items()
+        }
+
+    # parity alias (reference exposes both spellings across versions)
+    load_state_dict = set_state_dict
+
     def _lr_value(self):
         """Current LR as a jax scalar array (dygraph path)."""
         import jax.numpy as jnp

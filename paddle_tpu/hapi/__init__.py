@@ -192,6 +192,28 @@ class Model:
             return out
         return data  # already a list of batches
 
+    def _checkpoint_manager(self, dirname, keep_last_n=3):
+        """One CheckpointManager per checkpoint root, bound to the train
+        program and this model's scope (shared by fit(resume=...) and
+        the step-frequency ModelCheckpoint callback)."""
+        import os
+
+        from ..fluid import checkpoint as ckpt_mod
+
+        if not self._prepared:
+            raise RuntimeError("call prepare() first")
+        key = os.path.abspath(dirname)
+        mgrs = getattr(self, "_ckpt_mgrs", None)
+        if mgrs is None:
+            mgrs = self._ckpt_mgrs = {}
+        if key not in mgrs:
+            mode = "train" if "train" in self._progs else \
+                next(iter(self._progs))
+            mgrs[key] = ckpt_mod.CheckpointManager(
+                dirname, keep_last_n=keep_last_n,
+                program=self._progs[mode][0], scope=self._scope)
+        return mgrs[key]
+
     def fit(
         self,
         train_data,
@@ -205,8 +227,49 @@ class Model:
         verbose=2,
         shuffle=True,
         callbacks=None,
+        checkpoint_dir=None,
+        checkpoint_freq=0,
+        checkpoint_keep=3,
+        resume=False,
     ):
-        """reference hapi fit:1119."""
+        """reference hapi fit:1119, plus the preemption-safe layer
+        (fluid/checkpoint.py):
+
+        checkpoint_dir   arm a CheckpointManager there; every
+                         `checkpoint_freq` train steps (0 = only on
+                         preemption) the FULL training state — params,
+                         optimizer moments, AMP loss scale, RNG key,
+                         (epoch, step) position, loss history — is
+                         committed atomically with checkpoint_keep
+                         retained.
+        resume           True: restore the newest VALID checkpoint from
+                         checkpoint_dir and continue mid-epoch with a
+                         bit-identical loss trace (a torn latest
+                         checkpoint falls back to the previous one). A
+                         path string doubles as checkpoint_dir. Empty
+                         dir = fresh start.
+        SIGTERM          (or checkpoint.request_preemption()) is honored
+                         at the next step boundary: final checkpoint,
+                         then checkpoint.Preempted is raised — exit with
+                         checkpoint.PREEMPTED_EXIT_CODE so a supervisor
+                         respawns + auto-resumes.
+        FLAGS_check_numerics  a non-finite-grad step is SKIPPED (scope
+                         untouched); after FLAGS_check_numerics_max_bad_steps
+                         consecutive bad steps fit rolls back to the
+                         last checkpoint and re-trains from there (one
+                         rollback without an intervening good step —
+                         then the error propagates).
+        """
+        from ..fluid import checkpoint as ckpt_mod
+        from ..fluid.flags import flag
+
+        if isinstance(resume, str):
+            checkpoint_dir = checkpoint_dir or resume
+        mgr = (self._checkpoint_manager(checkpoint_dir, checkpoint_keep)
+               if checkpoint_dir else None)
+        if mgr is not None:
+            ckpt_mod.install_preemption_handler()
+
         cbks = callbacks_mod.CallbackList(
             _to_list(callbacks)
             or ([ProgBarLogger(log_freq, verbose=verbose)] if verbose else [])
@@ -214,21 +277,91 @@ class Model:
         cbks.set_model(self)
         cbks.on_train_begin()
         history = {"loss": []}
-        stop = False
         train_data = self._materialize(train_data)
         if eval_data is not None:
             eval_data = self._materialize(eval_data)
-        for epoch in range(epochs):
+
+        epoch, resume_step, pending_losses, global_step = 0, 0, [], 0
+        if mgr is not None and resume:
+            st = mgr.restore()
+            if st is not None:
+                ex = st["extra"]
+                epoch = int(ex.get("epoch", 0))
+                resume_step = int(ex.get("step", 0))
+                pending_losses = list(ex.get("epoch_losses", []))
+                history = {k: list(v)
+                           for k, v in ex.get("history", history).items()}
+                global_step = int(ex.get("global_step", 0))
+
+        def _position(step, losses):
+            return {"epoch": epoch, "step": step,
+                    "epoch_losses": list(losses),
+                    "history": {k: list(v) for k, v in history.items()},
+                    "global_step": global_step}
+
+        max_bad = max(1, int(flag("FLAGS_check_numerics_max_bad_steps")))
+        bad_streak, last_rollback_sig = 0, None
+        n_in = len(self._inputs)
+        stop = False
+        while epoch < epochs and not stop:
             cbks.on_epoch_begin(epoch)
-            batches = self._batches(train_data, batch_size, shuffle, seed=epoch)
-            losses = []
-            for step, batch in enumerate(batches):
+            batches = self._batches(train_data, batch_size, shuffle,
+                                    seed=epoch)
+            losses = pending_losses if resume_step else []
+            step = resume_step
+            pending_losses, resume_step = [], 0
+            rolled_back = False
+            while step < len(batches):
+                if mgr is not None and ckpt_mod.preemption_requested():
+                    mgr.save(global_step,
+                             extra_state=_position(step, losses))
+                    raise ckpt_mod.Preempted(
+                        f"preemption requested: checkpointed at global "
+                        f"step {global_step} in {checkpoint_dir!r}")
+                batch = batches[step]
                 cbks.on_batch_begin("train", step)
-                n_in = len(self._inputs)
-                outs = self.train_batch(batch[:n_in], batch[n_in:])
+                try:
+                    outs = self.train_batch(batch[:n_in], batch[n_in:])
+                except ckpt_mod.BadStepError:
+                    bad_streak += 1
+                    if bad_streak >= max_bad:
+                        # a streak starting at the SAME position as the
+                        # last rollback means the replay re-diverged
+                        # deterministically — rolling back again would
+                        # loop forever, so the error propagates
+                        sig = (epoch, step - bad_streak + 1)
+                        if (mgr is None or mgr.latest_step() is None
+                                or sig == last_rollback_sig):
+                            raise
+                        last_rollback_sig = sig
+                        st = mgr.restore()
+                        ex = st["extra"]
+                        epoch = int(ex.get("epoch", 0))
+                        resume_step = int(ex.get("step", 0))
+                        pending_losses = list(ex.get("epoch_losses", []))
+                        history = {
+                            k: list(v)
+                            for k, v in ex.get("history", {}).items()
+                        } or history
+                        global_step = int(ex.get("global_step", 0))
+                        bad_streak = 0
+                        rolled_back = True
+                        break
+                    step += 1  # skip the poisoned batch
+                    global_step += 1
+                    continue
+                bad_streak = 0
                 loss = float(np.asarray(outs[0]).reshape(()))
                 losses.append(loss)
                 cbks.on_batch_end("train", step, {"loss": loss})
+                step += 1
+                global_step += 1
+                if (mgr is not None and checkpoint_freq
+                        and global_step % checkpoint_freq == 0):
+                    mgr.save(global_step,
+                             extra_state=_position(step, losses))
+            if rolled_back:
+                continue  # re-enter at the restored (epoch, step)
             logs = {"loss": float(np.mean(losses))}
             history["loss"].append(logs["loss"])
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
@@ -241,8 +374,7 @@ class Model:
                 self.save(os.path.join(save_dir, f"epoch_{epoch}"))
             if cbks.on_epoch_end(epoch, logs):
                 stop = True
-            if stop:
-                break
+            epoch += 1
         cbks.on_train_end()
         return history
 

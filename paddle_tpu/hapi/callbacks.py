@@ -94,18 +94,60 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """Save persistables every `save_freq` epochs (reference
-    callbacks.ModelCheckpoint)."""
+    """Save every `save_freq` epochs (reference callbacks.ModelCheckpoint)
+    or — save_freq_unit="step" — every `save_freq` train STEPS, so a
+    preemption mid-epoch costs minutes of work, not the epoch.
 
-    def __init__(self, save_freq=1, save_dir="checkpoints"):
-        self.save_freq = save_freq
+    keep_last_n switches the save path to the model's CheckpointManager
+    (fluid/checkpoint.py): step-numbered atomic checkpoint dirs under
+    save_dir with only the newest N retained, loadable with
+    Model.fit(resume=...). keep_last_n=None keeps the legacy behavior
+    for epoch saves (Model.save to save_dir/epoch_<n>, unbounded)."""
+
+    def __init__(self, save_freq=1, save_dir="checkpoints",
+                 save_freq_unit="epoch", keep_last_n=None):
+        if save_freq_unit not in ("epoch", "step"):
+            raise ValueError(
+                f"save_freq_unit must be 'epoch' or 'step', got "
+                f"{save_freq_unit!r}")
+        if save_freq_unit == "step" and keep_last_n is None:
+            keep_last_n = 3  # unbounded step snapshots would fill disk
+        self.save_freq = int(save_freq)
         self.save_dir = save_dir
+        self.save_freq_unit = save_freq_unit
+        self.keep_last_n = keep_last_n
+        self._gstep = 0
+        self._epoch = 0
+
+    def _manager(self):
+        return self.model._checkpoint_manager(
+            self.save_dir, keep_last_n=self.keep_last_n or 3)
+
+    def on_epoch_begin(self, epoch):
+        self._epoch = epoch
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        self._gstep += 1
+        if (self.save_freq_unit == "step"
+                and self._gstep % self.save_freq == 0):
+            self._manager().save(
+                self._gstep,
+                extra_state={"epoch": self._epoch,
+                             "global_step": self._gstep})
 
     def on_epoch_end(self, epoch, logs=None):
-        if (epoch + 1) % self.save_freq == 0:
-            import os
+        if self.save_freq_unit == "epoch" and (epoch + 1) % self.save_freq == 0:
+            if self.keep_last_n is not None:
+                self._manager().save(
+                    self._gstep,
+                    extra_state={"epoch": epoch + 1,
+                                 "global_step": self._gstep})
+            else:
+                import os
 
-            self.model.save(os.path.join(self.save_dir, f"epoch_{epoch}"))
+                self.model.save(os.path.join(self.save_dir, f"epoch_{epoch}"))
         return False
 
 

@@ -1,0 +1,124 @@
+"""Worker for tests/test_checkpoint.py preemption drills: a small
+Model.fit job with dropout (so the RNG stream matters), checkpointing to
+CKPT_TEST_DIR and appending every train step's loss to a CKPT_TEST_TRACE
+jsonl — the file survives the process, so the concatenation of all
+attempts' lines IS the job's loss trace, comparable exactly against an
+uninterrupted run.
+
+Env knobs:
+  CKPT_TEST_DIR            checkpoint root (fit checkpoint_dir, resume=True)
+  CKPT_TEST_TRACE          jsonl trace path (append across attempts)
+  CKPT_TEST_DONE           final-state json written on clean completion
+  CKPT_TEST_PREEMPT_AT     >0: on attempt 0 only, SIGTERM OURSELVES after
+                           that many train steps — the deterministic
+                           stand-in for a TPU-pod eviction
+  CKPT_TEST_PREEMPT_PARENT "1": send the SIGTERM to the LAUNCHER instead
+                           (exercises its grace handler + forwarding)
+  CKPT_TEST_CKPT_FREQ      checkpoint every N steps (default 4)
+
+Exit: checkpoint.PREEMPTED_EXIT_CODE (75) after an honored preemption,
+so the launcher's elastic restart respawns a trainer that auto-resumes.
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import checkpoint as ckpt
+from paddle_tpu.fluid import layers
+from paddle_tpu.hapi import Callback, Input, Model
+
+BATCH, NSAMP, EPOCHS = 8, 64, 3
+STEPS_PER_EPOCH = NSAMP // BATCH
+
+
+def _net(x):
+    h = layers.fc(x, 16, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)
+    return layers.fc(h, 1)
+
+
+def _model():
+    m = Model(_net, Input("x", [BATCH, 4]), Input("y", [BATCH, 1]))
+    m.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2),
+        lambda p, y: layers.mean(layers.square_error_cost(p, y)),
+    )
+    return m
+
+
+class TraceRecorder(Callback):
+    """Append {"gs": global step, "loss": loss} per train step; the file
+    outlives the process, so attempts concatenate."""
+
+    def __init__(self, path):
+        self.path = path
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch):
+        self._epoch = epoch
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"gs": self._epoch * STEPS_PER_EPOCH + step,
+                                "loss": (logs or {}).get("loss")}) + "\n")
+            f.flush()
+
+
+class PreemptAt(Callback):
+    def __init__(self, at, target_pid):
+        self.at = int(at)
+        self.target_pid = target_pid
+        self.n = 0
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode == "train":
+            self.n += 1
+            if self.n == self.at:
+                os.kill(self.target_pid, signal.SIGTERM)
+
+
+def main():
+    attempt = int(os.environ.get("PADDLE_ELASTIC_RESTART", 0))
+    ckpt_dir = os.environ["CKPT_TEST_DIR"]
+    trace = os.environ["CKPT_TEST_TRACE"]
+    preempt_at = int(os.environ.get("CKPT_TEST_PREEMPT_AT", 0))
+    freq = int(os.environ.get("CKPT_TEST_CKPT_FREQ", 4))
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(NSAMP, 4).astype(np.float32)
+    Y = rng.randn(NSAMP, 1).astype(np.float32)
+
+    cbs = [TraceRecorder(trace)]
+    if preempt_at > 0 and attempt == 0:
+        target = (os.getppid()
+                  if os.environ.get("CKPT_TEST_PREEMPT_PARENT") == "1"
+                  else os.getpid())
+        cbs.append(PreemptAt(preempt_at, target))
+
+    model = _model()
+    try:
+        model.fit((X, Y), batch_size=BATCH, epochs=EPOCHS, verbose=0,
+                  shuffle=True, checkpoint_dir=ckpt_dir,
+                  checkpoint_freq=freq, resume=True, callbacks=cbs)
+    except ckpt.Preempted:
+        sys.exit(ckpt.PREEMPTED_EXIT_CODE)
+
+    done = os.environ.get("CKPT_TEST_DONE")
+    if done:
+        params = model.parameters()
+        with open(done, "w") as f:
+            json.dump({
+                "params_sum": {k: float(np.asarray(v, np.float64).sum())
+                               for k, v in sorted(params.items())},
+            }, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,742 @@
+"""Preemption-safe training (fluid/checkpoint.py + the bad-step guard).
+
+  unit layer    — atomic commit protocol (contents -> rename -> manifest
+                  via os.replace), checksum verification, fallback to
+                  the newest VALID checkpoint past a torn latest,
+                  keep_last_n retention, deterministic crash injection
+                  between tmp write and manifest commit
+                  (faults crash:<phase> rules), bad-step guard skip /
+                  rollback semantics with the scope provably untouched,
+                  resume determinism for the static-graph (Model.fit,
+                  train_from_dataset) and dygraph (save/load_dygraph)
+                  paths, PS snapshot manifests (cross-job adoption)
+  process layer — (slow) a launcher job is SIGTERM'd mid-training, the
+                  trainer writes a final checkpoint and exits 75, the
+                  elastic restart auto-resumes, and the concatenated
+                  loss trace is EXACTLY the uninterrupted run's
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import checkpoint as ckpt
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid import flags as fl
+from paddle_tpu.fluid.checkpoint import BadStepError, CheckpointManager
+from paddle_tpu.hapi import Callback, Input, Model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_ckpt_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _net(x):
+    h = layers.fc(x, 16, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)  # RNG restore must matter
+    return layers.fc(h, 1)
+
+
+def _make_model():
+    m = Model(_net, Input("x", [8, 4]), Input("y", [8, 1]))
+    m.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2),
+        lambda p, y: layers.mean(layers.square_error_cost(p, y)),
+    )
+    return m
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 4).astype(np.float32),
+            rng.randn(n, 1).astype(np.float32))
+
+
+class PreemptAtStep(Callback):
+    """Deterministic stand-in for SIGTERM delivery at an exact step."""
+
+    def __init__(self, at):
+        self.at = int(at)
+        self.n = 0
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode == "train":
+            self.n += 1
+            if self.n == self.at:
+                ckpt.request_preemption()
+
+
+@pytest.fixture(autouse=True)
+def _clear_preemption():
+    ckpt.clear_preemption()
+    yield
+    ckpt.clear_preemption()
+
+
+# ---------------------------------------------------------------------------
+# commit protocol
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_commit_retention_and_verify(tmp_path):
+    scope = fluid.executor.Scope()
+    scope.set_var("w", np.arange(6, dtype=np.float32))
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2, scope=scope)
+    for s in range(1, 5):
+        scope.set_var("w", np.full(6, float(s), np.float32))
+        mgr.save(s, extra_state={"mark": s})
+    # retention: only the newest keep_last_n=2 survive
+    assert mgr.steps() == [3, 4]
+    assert sorted(os.listdir(tmp_path)) == ["ckpt-00000003", "ckpt-00000004"]
+    m = mgr.manifest(4)
+    assert m["step"] == 4
+    assert {"state.pkl", "rng.pkl", "extra.pkl"} <= set(m["files"])
+    for meta in m["files"].values():
+        assert set(meta) == {"sha256", "bytes"}
+    assert mgr.verify(4)
+    st = mgr.restore()
+    assert st["step"] == 4 and st["extra"]["mark"] == 4
+    np.testing.assert_array_equal(np.asarray(scope.find_var("w")),
+                                  np.full(6, 4.0, np.float32))
+
+
+def test_restore_falls_back_past_torn_and_corrupt(tmp_path):
+    scope = fluid.executor.Scope()
+    scope.set_var("w", np.zeros(3, np.float32))
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=4, scope=scope)
+    scope.set_var("w", np.full(3, 1.0, np.float32))
+    mgr.save(1)
+    scope.set_var("w", np.full(3, 2.0, np.float32))
+    mgr.save(2)
+    scope.set_var("w", np.full(3, 3.0, np.float32))
+    mgr.save(3)
+
+    # step 3: torn (kill between rename and manifest commit)
+    os.remove(tmp_path / "ckpt-00000003" / "manifest.json")
+    # step 2: bit rot after commit (checksum must catch it)
+    p = tmp_path / "ckpt-00000002" / "state.pkl"
+    blob = bytearray(p.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    p.write_bytes(bytes(blob))
+
+    assert mgr.steps() == [1, 2]  # 3 is not a checkpoint at all
+    assert not mgr.verify(2)
+    with pytest.warns(RuntimeWarning):
+        st = mgr.restore()
+    assert st["step"] == 1
+    np.testing.assert_array_equal(np.asarray(scope.find_var("w")),
+                                  np.full(3, 1.0, np.float32))
+
+
+def test_restore_empty_dir_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), scope=fluid.executor.Scope())
+    assert mgr.restore() is None
+    assert mgr.latest_step() is None
+
+
+_CRASH_SCRIPT = """
+import os, sys
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.checkpoint import CheckpointManager
+
+root = sys.argv[1]
+scope = fluid.global_scope()
+scope.set_var("w", np.full(4, 1.0, np.float32))
+mgr = CheckpointManager(root, keep_last_n=3, scope=scope)
+mgr.save(1)                      # commits: crash rules have nth=2
+scope.set_var("w", np.full(4, 2.0, np.float32))
+mgr.save(2)                      # crash rule fires inside here
+print("UNREACHABLE")             # the crash is os._exit(1)
+"""
+
+
+@pytest.mark.parametrize("phase,leaves_dir", [
+    ("ckpt_before_commit", True),   # dir renamed in, manifest never written
+    ("ckpt_tmp_written", False),    # tmp dir never renamed in
+])
+def test_crash_injection_between_tmp_and_commit(tmp_path, phase, leaves_dir):
+    """Acceptance: a kill between tmp write and manifest commit leaves
+    the PREVIOUS checkpoint loadable — proven by a deterministic
+    in-process kill (faults crash rule), not by luck."""
+    script = tmp_path / "crasher.py"
+    script.write_text(textwrap.dedent(_CRASH_SCRIPT))
+    root = tmp_path / "ckpts"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               FLAGS_ps_fault_injection="1")
+    env["PADDLE_PS_FAULT_SPEC"] = f"crash:{phase}:2"
+    r = subprocess.run([sys.executable, str(script), str(root)], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert "crashing pid" in r.stderr and phase in r.stderr
+
+    assert (root / "ckpt-00000002").exists() == leaves_dir
+    scope = fluid.executor.Scope()
+    mgr = CheckpointManager(str(root), scope=scope)
+    assert mgr.steps() == [1]  # step 2 never committed
+    st = mgr.restore()
+    assert st["step"] == 1
+    np.testing.assert_array_equal(np.asarray(scope.find_var("w")),
+                                  np.full(4, 1.0, np.float32))
+    # the torn dir is overwritable: a post-restart save at step 2 commits
+    scope.set_var("w", np.full(4, 5.0, np.float32))
+    mgr.save(2)
+    assert mgr.verify(2) and mgr.latest_step() == 2
+
+
+# ---------------------------------------------------------------------------
+# bad-step guard (FLAGS_check_numerics)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def check_numerics():
+    fl.set_flags({"FLAGS_check_numerics": True})
+    yield
+    fl.set_flags({"FLAGS_check_numerics": False,
+                  "FLAGS_check_numerics_max_bad_steps": 3})
+
+
+def _linear_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        p = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_guard_flag_off_emits_nothing():
+    main, _, _ = _linear_program()
+    assert not [v.name for v in main.list_vars()
+                if v.name.startswith("check_numerics_bad")]
+    assert not [op for op in main.global_block().ops
+                if op.type in ("isfinite_v2",)]
+
+
+def test_bad_step_raises_and_scope_is_untouched(check_numerics):
+    main, startup, loss = _linear_program()
+    assert [v.name for v in main.list_vars()
+            if v.name.startswith("check_numerics_bad")]
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xb, yb = _data(8, seed=1)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        before = {
+            p.name: np.asarray(scope.find_var(p.name)).copy()
+            for p in main.all_parameters()
+        }
+        rng_before = scope._rng_key
+        bad = xb.copy()
+        bad[0, 0] = np.nan
+        with pytest.raises(BadStepError):
+            exe.run(main, feed={"x": bad, "y": yb}, fetch_list=[loss])
+        for n, v in before.items():
+            np.testing.assert_array_equal(np.asarray(scope.find_var(n)), v)
+        assert scope._rng_key is rng_before  # skipped steps consume no RNG
+        # training continues on the next good batch
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+
+
+def test_fit_skips_poisoned_batch_with_exact_parity(check_numerics):
+    """One NaN batch in the stream: the guard skips it and the rest of
+    the trace is bit-identical to a run that never saw the batch."""
+    xb, yb = _data(48, seed=2)
+    good = [[xb[i:i + 8], yb[i:i + 8]] for i in range(0, 48, 8)]
+    poisoned = [b for b in good]
+    bad = [xb[:8].copy(), yb[:8].copy()]
+    bad[0][3, 1] = np.inf
+    poisoned.insert(3, bad)
+
+    m_ref = _make_model()
+    h_ref = m_ref.fit(good, batch_size=8, epochs=2, verbose=0, shuffle=False)
+    m_poi = _make_model()
+    h_poi = m_poi.fit(poisoned, batch_size=8, epochs=2, verbose=0,
+                      shuffle=False)
+    # per-epoch means differ only through the skipped batch's absence
+    # from the divisor — compare the underlying step traces via params
+    p_ref, p_poi = m_ref.parameters(), m_poi.parameters()
+    assert set(p_ref) == set(p_poi)
+    for k in p_ref:
+        np.testing.assert_array_equal(p_ref[k], p_poi[k])
+    assert h_ref["loss"] == h_poi["loss"]
+
+
+def test_rollback_after_k_bad_steps_then_propagates(tmp_path,
+                                                    check_numerics):
+    """K consecutive bad steps -> restore the last checkpoint and replay;
+    a second streak at the same position (deterministic data) raises
+    instead of looping."""
+    fl.set_flags({"FLAGS_check_numerics_max_bad_steps": 2})
+    xb, yb = _data(32, seed=3)
+    batches = [[xb[i:i + 8], yb[i:i + 8]] for i in range(0, 32, 8)]
+    for b in batches[2:]:  # tail of every epoch is poisoned
+        b[0][0, 0] = np.nan
+
+    m = _make_model()
+    restores = []
+    orig_restore = CheckpointManager.restore
+
+    def spy(self, *a, **k):
+        out = orig_restore(self, *a, **k)
+        restores.append(out and out["step"])
+        return out
+
+    CheckpointManager.restore = spy
+    try:
+        with pytest.raises(BadStepError):
+            m.fit(batches, batch_size=8, epochs=2, verbose=0, shuffle=False,
+                  checkpoint_dir=str(tmp_path), checkpoint_freq=1)
+    finally:
+        CheckpointManager.restore = orig_restore
+    assert restores, "rollback never restored a checkpoint"
+
+
+# ---------------------------------------------------------------------------
+# resume determinism — static graph
+# ---------------------------------------------------------------------------
+
+
+def test_fit_preempt_resume_trace_bit_identical(tmp_path):
+    """fit N steps -> preemption (exact step) -> fresh process-equivalent
+    Model resumes -> history and params bit-identical to uninterrupted."""
+    X, Y = _data(64)
+    m_ref = _make_model()
+    h_ref = m_ref.fit((X, Y), batch_size=8, epochs=4, verbose=0)
+
+    m_int = _make_model()
+    with pytest.raises(ckpt.Preempted):
+        m_int.fit((X, Y), batch_size=8, epochs=4, verbose=0,
+                  checkpoint_dir=str(tmp_path), checkpoint_freq=5,
+                  callbacks=[PreemptAtStep(13)])  # mid-epoch 1
+    ckpt.clear_preemption()
+
+    m_res = _make_model()
+    h_res = m_res.fit((X, Y), batch_size=8, epochs=4, verbose=0,
+                      checkpoint_dir=str(tmp_path), resume=True)
+    assert h_ref["loss"] == h_res["loss"]
+    p_ref, p_res = m_ref.parameters(), m_res.parameters()
+    for k in p_ref:
+        np.testing.assert_array_equal(p_ref[k], p_res[k])
+
+
+def test_fit_resume_from_torn_latest_falls_back(tmp_path):
+    """Tear the newest checkpoint after preemption: resume silently uses
+    the previous valid one and STILL reproduces the uninterrupted run
+    (it just replays more steps)."""
+    X, Y = _data(64)
+    m_ref = _make_model()
+    h_ref = m_ref.fit((X, Y), batch_size=8, epochs=3, verbose=0)
+
+    m_int = _make_model()
+    with pytest.raises(ckpt.Preempted):
+        m_int.fit((X, Y), batch_size=8, epochs=3, verbose=0,
+                  checkpoint_dir=str(tmp_path), checkpoint_freq=4,
+                  callbacks=[PreemptAtStep(10)])
+    ckpt.clear_preemption()
+    mgr = CheckpointManager(str(tmp_path))
+    latest = mgr.latest_step()
+    # tear one checkpoint (no manifest: silently not-a-checkpoint) and
+    # corrupt the next (manifest present, checksum mismatch: warned)
+    os.remove(tmp_path / f"ckpt-{latest:08d}" / "manifest.json")
+    prev = CheckpointManager(str(tmp_path)).latest_step()
+    p = tmp_path / f"ckpt-{prev:08d}" / "state.pkl"
+    blob = bytearray(p.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    p.write_bytes(bytes(blob))
+
+    m_res = _make_model()
+    with pytest.warns(RuntimeWarning):
+        h_res = m_res.fit((X, Y), batch_size=8, epochs=3, verbose=0,
+                          checkpoint_dir=str(tmp_path), resume=True)
+    assert h_ref["loss"] == h_res["loss"]
+    for k, v in m_ref.parameters().items():
+        np.testing.assert_array_equal(v, m_res.parameters()[k])
+
+
+def test_train_from_dataset_resume(tmp_path):
+    """Executor.train_from_dataset: checkpoint every N batches, preempt,
+    resume skips the consumed prefix — final params bit-identical."""
+    rng = np.random.RandomState(5)
+    files = []
+    for i in range(2):
+        path = str(tmp_path / f"d{i}.txt")
+        with open(path, "w") as f:
+            for _ in range(64):
+                xv = rng.randn(4)
+                f.write(" ".join(f"{v:.5f}" for v in xv)
+                        + f" {float(xv.sum()):.5f}\n")
+        files.append(path)
+
+    def build():
+        from paddle_tpu.fluid import unique_name
+
+        main, startup = fluid.Program(), fluid.Program()
+        # a fresh process restarts the name counter; simulate that so
+        # the resumed program's param names match the checkpoint's
+        with unique_name.guard(), fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+            dataset = fluid.DatasetFactory().create_dataset("QueueDataset")
+            dataset.set_batch_size(16)
+            dataset.set_use_var([x, y])
+            dataset.set_filelist(files)
+        return main, startup, loss, dataset
+
+    wname = None
+
+    def run(scope, ckpt_dir=None, preempt_after=None, resume=False):
+        nonlocal wname
+        main, startup, loss, dataset = build()
+        wname = main.global_block().all_parameters()[0].name
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            if not resume:
+                exe.run(startup)
+            if preempt_after is not None:
+                orig = fluid.Executor.run
+                calls = {"n": 0}
+
+                def counting(self, *a, **k):
+                    out = orig(self, *a, **k)
+                    calls["n"] += 1
+                    if calls["n"] == preempt_after:
+                        ckpt.request_preemption()
+                    return out
+
+                fluid.Executor.run = counting
+                try:
+                    with pytest.raises(ckpt.Preempted):
+                        exe.train_from_dataset(
+                            main, dataset, fetch_list=[loss],
+                            checkpoint_dir=ckpt_dir, checkpoint_freq=2,
+                            resume=resume)
+                finally:
+                    fluid.Executor.run = orig
+            else:
+                exe.train_from_dataset(
+                    main, dataset, fetch_list=[loss],
+                    checkpoint_dir=ckpt_dir, checkpoint_freq=2,
+                    resume=resume)
+            return np.asarray(scope.find_var(wname)).copy()
+
+    ref_scope = fluid.executor.Scope()
+    w_ref = run(ref_scope)
+
+    ck = str(tmp_path / "ck")
+    int_scope = fluid.executor.Scope()
+    run(int_scope, ckpt_dir=ck, preempt_after=3)
+    ckpt.clear_preemption()
+    res_scope = fluid.executor.Scope()
+    w_res = run(res_scope, ckpt_dir=ck, resume=True)
+    np.testing.assert_array_equal(w_ref, w_res)
+
+
+# ---------------------------------------------------------------------------
+# resume determinism — dygraph
+# ---------------------------------------------------------------------------
+
+
+def test_dygraph_save_load_resume_bit_identical(tmp_path):
+    """Dygraph path: train N steps, save_dygraph params+opt, train to 2N
+    -> a fresh model loading the step-N files and continuing matches the
+    uninterrupted run bitwise."""
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.fluid.dygraph import Linear
+    from paddle_tpu.fluid.dygraph.base import to_variable
+
+    rng = np.random.RandomState(7)
+    xs = [rng.randn(4, 3).astype(np.float32) for _ in range(8)]
+    ys = [rng.randn(4, 1).astype(np.float32) for _ in range(8)]
+
+    def loss_of(model, x, y):
+        diff = model(to_variable(x))
+        from paddle_tpu.fluid.dygraph.base import _trace_op
+
+        d = _trace_op("elementwise_sub",
+                      {"X": [diff], "Y": [to_variable(y)]}, {}, ["Out"])[0]
+        sq = _trace_op("square", {"X": [d]}, {}, ["Out"])[0]
+        return _trace_op("reduce_mean", {"X": [sq]},
+                         {"reduce_all": True}, ["Out"])[0]
+
+    def train(model, opt, batches):
+        out = []
+        for x, y in batches:
+            loss = loss_of(model, x, y)
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            out.append(float(loss.numpy().reshape(())))
+        return out
+
+    with dygraph.guard():
+        # identical deterministic init for every instance (Layer
+        # state_dict keys are structural: weight/bias)
+        init = {"weight": np.full((3, 1), 0.3, np.float32),
+                "bias": np.zeros((1,), np.float32)}
+
+        def fresh():
+            m = Linear(3, 1)
+            m.set_dict(init)
+            o = fluid.optimizer.MomentumOptimizer(
+                0.05, 0.9, parameter_list=m.parameters())
+            return m, o
+
+        m_ref, o_ref = fresh()
+        trace_ref = train(m_ref, o_ref, list(zip(xs, ys)))
+
+        m_int, o_int = fresh()
+        trace_head = train(m_int, o_int, list(zip(xs[:4], ys[:4])))
+        dygraph.save_dygraph(m_int.state_dict(), str(tmp_path / "ck"))
+        dygraph.save_dygraph(o_int.state_dict(), str(tmp_path / "ck"))
+
+        m_res, o_res = fresh()
+        params, opt_state = dygraph.load_dygraph(str(tmp_path / "ck"))
+        m_res.set_dict(params)
+        # opt state is keyed by param NAME; a real process restart
+        # reproduces the names (unique_name restarts at 0), but a third
+        # in-process instance gets fresh ones — remap positionally here
+        o_res.set_state_dict(dict(zip(
+            [p.name for p in m_res.parameters()], opt_state.values())))
+        trace_tail = train(m_res, o_res, list(zip(xs[4:], ys[4:])))
+
+    assert trace_head + trace_tail == trace_ref
+    for k, v in m_ref.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(m_res.state_dict()[k]))
+
+
+# ---------------------------------------------------------------------------
+# ModelCheckpoint callback (step frequency + retention)
+# ---------------------------------------------------------------------------
+
+
+def test_model_checkpoint_callback_step_freq_and_retention(tmp_path):
+    from paddle_tpu.hapi import ModelCheckpoint
+
+    X, Y = _data(64)
+    m = _make_model()
+    cb = ModelCheckpoint(save_freq=5, save_dir=str(tmp_path),
+                         save_freq_unit="step", keep_last_n=2)
+    m.fit((X, Y), batch_size=8, epochs=2, verbose=0, callbacks=[cb])
+    mgr = CheckpointManager(str(tmp_path))
+    steps = mgr.steps()
+    # 16 train steps -> saves at 5, 10, 15; retention keeps the last 2
+    assert steps == [10, 15]
+    assert all(mgr.verify(s) for s in steps)
+    # the checkpoint is loadable into a fresh model's scope
+    m2 = _make_model()
+    st = m2._checkpoint_manager(str(tmp_path)).restore()
+    assert st["step"] == 15 and st["extra"]["global_step"] == 15
+
+
+def test_model_checkpoint_callback_epoch_unit_validation():
+    from paddle_tpu.hapi import ModelCheckpoint
+
+    with pytest.raises(ValueError):
+        ModelCheckpoint(save_freq_unit="minute")
+
+
+# ---------------------------------------------------------------------------
+# PS integration: tables inside checkpoints + snapshot manifests
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_carries_ps_table_and_rolls_it_back(tmp_path):
+    from paddle_tpu.distributed import ps
+
+    table = ps.create_table("ckpt_ps_table", shape=(128, 8),
+                            optimizer="sgd", learning_rate=0.5, seed=3)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            w = layers.data("ids", [8], dtype="int64",
+                            append_batch_size=False)
+            emb = layers.distributed_embedding(w, "ckpt_ps_table")
+            loss = layers.mean(emb)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        scope = fluid.executor.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ids = np.arange(8, dtype=np.int64)
+            exe.run(main, feed={"ids": ids}, fetch_list=[loss])
+        mgr = CheckpointManager(str(tmp_path), program=main, scope=scope)
+        mgr.save(1)
+        m = mgr.manifest(1)
+        assert m["ps"]["tables"] == ["ckpt_ps_table"]
+        assert "ckpt_ps_table.pkl" in m["files"]
+        snap = table.to_dense().copy()
+        # mutate the table, then roll back via restore
+        table.push_gradients(ids, np.ones((8, 8), np.float32))
+        assert not np.array_equal(table.to_dense(), snap)
+        mgr.restore()
+        np.testing.assert_array_equal(table.to_dense(), snap)
+    finally:
+        ps.drop_table("ckpt_ps_table")
+
+
+def test_ps_snapshot_manifest_and_cross_job_adoption(tmp_path):
+    from paddle_tpu.distributed import ps_server
+
+    snap = str(tmp_path / "stable")
+    srv = ps_server.PSServer(snapshot_dir=snap)
+    srv.create_table({"name": "jobtab", "shape": (32, 4), "seed": 1,
+                      "sync_trainers": 0, "generation": 2})
+    assert srv.snapshot() == 1
+    m1 = ps_server.read_snapshot_manifest(snap)
+    assert m1["snapshot_epoch"] == 1 and m1["generation"] == 2
+    assert m1["tables"]["jobtab"] == {"rows": 32, "dim": 4}
+    srv.tables["jobtab"].push_gradients(
+        np.arange(4, dtype=np.int64), np.ones((4, 4), np.float32))
+    srv.snapshot()
+    assert ps_server.read_snapshot_manifest(snap)["snapshot_epoch"] == 2
+    want = srv.tables["jobtab"].to_dense().copy()
+
+    # NEW job: a fresh server pointed at the stable dir adopts the
+    # previous job's table (and continues its epoch counter)
+    srv2 = ps_server.PSServer(preload_dir=snap, snapshot_dir=snap)
+    assert srv2.adopted_manifest["snapshot_epoch"] == 2
+    srv2.create_table({"name": "jobtab", "shape": (32, 4), "seed": 9,
+                       "sync_trainers": 0, "generation": 0})
+    np.testing.assert_array_equal(srv2.tables["jobtab"].to_dense(), want)
+    srv2.snapshot()
+    assert ps_server.read_snapshot_manifest(snap)["snapshot_epoch"] == 3
+
+    import paddle_tpu.fleet as fleet
+
+    assert fleet.ps_snapshot_manifest(snap)["snapshot_epoch"] == 3
+    assert fleet.ps_snapshot_manifest(str(tmp_path / "nope")) is None
+
+
+# ---------------------------------------------------------------------------
+# process layer — slow preemption drills
+# ---------------------------------------------------------------------------
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    for k in ("PADDLE_PSERVERS_IP_PORT_LIST", "PADDLE_TRAINERS_NUM",
+              "PADDLE_PS_FAULT_SPEC", "FLAGS_ps_fault_injection",
+              "PADDLE_ELASTIC_RESTART"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.update(extra or {})
+    return env
+
+
+def _read_trace(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+@pytest.mark.slow
+def test_preemption_drill_sigterm_resume_exact_trace(tmp_path):
+    """Acceptance: SIGTERM mid-training -> final checkpoint -> exit 75 ->
+    elastic respawn -> auto-resume -> the concatenated loss trace and
+    final params are EXACTLY the uninterrupted run's."""
+    ref = {
+        "CKPT_TEST_DIR": str(tmp_path / "ref_ck"),
+        "CKPT_TEST_TRACE": str(tmp_path / "ref_trace.jsonl"),
+        "CKPT_TEST_DONE": str(tmp_path / "ref_done.json"),
+    }
+    r = subprocess.run([sys.executable, "-u", WORKER], env=_env(ref),
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+    drill = {
+        "CKPT_TEST_DIR": str(tmp_path / "ck"),
+        "CKPT_TEST_TRACE": str(tmp_path / "trace.jsonl"),
+        "CKPT_TEST_DONE": str(tmp_path / "done.json"),
+        "CKPT_TEST_PREEMPT_AT": "10",
+    }
+    r = subprocess.run(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--elastic_retries", "1",
+         "--log_dir", str(tmp_path / "logs"), WORKER],
+        env=_env(drill), capture_output=True, text=True, timeout=600,
+        cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "exited with 75" in r.stderr
+    assert "elastic restart 1/1" in r.stderr
+
+    t_ref = _read_trace(ref["CKPT_TEST_TRACE"])
+    t_drill = _read_trace(drill["CKPT_TEST_TRACE"])
+    # exact continuation: no dropped, repeated, or perturbed steps
+    assert [e["gs"] for e in t_drill] == [e["gs"] for e in t_ref]
+    assert [e["loss"] for e in t_drill] == [e["loss"] for e in t_ref]
+    done_ref = json.load(open(ref["CKPT_TEST_DONE"]))
+    done = json.load(open(drill["CKPT_TEST_DONE"]))
+    assert done == done_ref
+
+
+@pytest.mark.slow
+def test_preemption_drill_launcher_sigterm_grace(tmp_path):
+    """SIGTERM to the LAUNCHER: the grace handler forwards it, the
+    trainer checkpoints, the job exits 128+SIGTERM — and a relaunch
+    resumes to a trace consistent with the uninterrupted run."""
+    ref = {
+        "CKPT_TEST_DIR": str(tmp_path / "ref_ck"),
+        "CKPT_TEST_TRACE": str(tmp_path / "ref_trace.jsonl"),
+        "CKPT_TEST_DONE": str(tmp_path / "ref_done.json"),
+    }
+    r = subprocess.run([sys.executable, "-u", WORKER], env=_env(ref),
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+    drill = {
+        "CKPT_TEST_DIR": str(tmp_path / "ck"),
+        "CKPT_TEST_TRACE": str(tmp_path / "trace.jsonl"),
+        "CKPT_TEST_DONE": str(tmp_path / "done.json"),
+        "CKPT_TEST_PREEMPT_AT": "6",
+        "CKPT_TEST_PREEMPT_PARENT": "1",
+    }
+    args = [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "1", "--sigterm_grace", "60",
+            "--log_dir", str(tmp_path / "logs"), WORKER]
+    r = subprocess.run(args, env=_env(drill), capture_output=True,
+                       text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 128 + signal.SIGTERM, (r.stdout, r.stderr)
+    assert "forwarding to trainers for a final checkpoint" in r.stderr
+    ckm = CheckpointManager(drill["CKPT_TEST_DIR"],
+                            scope=fluid.executor.Scope())
+    assert ckm.latest_step() is not None  # final checkpoint landed
+
+    # relaunch (a new job, no preemption this time): auto-resume
+    # finishes the run
+    resume_env = {k: v for k, v in drill.items()
+                  if not k.startswith("CKPT_TEST_PREEMPT")}
+    r = subprocess.run(args, env=_env(resume_env), capture_output=True,
+                       text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    by_gs_ref = {e["gs"]: e["loss"]
+                 for e in _read_trace(ref["CKPT_TEST_TRACE"])}
+    by_gs = {}
+    for e in _read_trace(drill["CKPT_TEST_TRACE"]):
+        if e["gs"] in by_gs:  # a replayed step must replay EXACTLY
+            assert by_gs[e["gs"]] == e["loss"]
+        by_gs[e["gs"]] = e["loss"]
+    assert by_gs == by_gs_ref
+    assert json.load(open(drill["CKPT_TEST_DONE"])) == \
+        json.load(open(ref["CKPT_TEST_DONE"]))

@@ -393,3 +393,31 @@ def test_atomic_saves_survive_crash_mid_write(tmp_path, monkeypatch):
         main, startup, feeds, loss_name = fluid.io.load_train_model(exe, d)
         (lv,) = exe.run(main, feed=feed, fetch_list=[loss_name])
     np.testing.assert_allclose(np.asarray(lv), np.asarray(ref), rtol=1e-6)
+
+
+def test_save_dygraph_atomic_survives_crash_mid_write(tmp_path, monkeypatch):
+    """save_dygraph writes tmp + os.replace like every fluid/io.py save
+    path (PR 2 fixed io.py but missed this one): a crash before the
+    rename leaves the previous .pdparams/.pdopt intact and loadable."""
+    import os
+
+    from paddle_tpu.fluid import dygraph
+
+    path = str(tmp_path / "m")
+    good = {"w": np.full((2, 3), 1.5, np.float32)}
+    dygraph.save_dygraph(good, path)
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated crash before rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with np.testing.assert_raises(OSError):
+        dygraph.save_dygraph({"w": np.zeros((2, 3), np.float32)}, path)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    # no torn temp files, and the previous save is bit-intact
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    params, _ = dygraph.load_dygraph(path)
+    np.testing.assert_array_equal(params["w"], good["w"])

@@ -28,6 +28,14 @@ echo "== PS chaos smoke (deterministic fault injection) =="
 # snapshot preload (tests/test_ps_faults.py, the @slow process drills)
 python -m pytest tests/test_ps_faults.py -q -m slow
 
+echo "== preemption drill (SIGTERM mid-training -> resume, exact trace) =="
+# a launcher job is SIGTERM'd mid-training: the trainer commits a final
+# checkpoint and exits 75, the elastic restart auto-resumes, and the
+# concatenated loss trace must be EXACTLY the uninterrupted run's; the
+# launcher-level grace handler is drilled the same way
+# (tests/test_checkpoint.py, the @slow process drills)
+python -m pytest tests/test_checkpoint.py -q -m slow
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json
