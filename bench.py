@@ -82,6 +82,24 @@ def _timed_run(exe, program, data, loss, steps):
     return dt, lv
 
 
+def _emit_result(result: dict) -> None:
+    """Print THE one JSON result line (the bench contract) and publish
+    the same row through the unified telemetry layer — a gauge per
+    numeric field in the process registry plus a kind="bench" JSONL
+    record when PADDLE_METRICS_PATH is set — so BENCH_* numbers and
+    production telemetry share one code path (ISSUE 4)."""
+    print(json.dumps(result))
+    from paddle_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    metric = str(result.get("metric", "bench"))
+    for key in ("value", "mfu", "peak_hbm_gb", "vs_baseline"):
+        v = result.get(key)
+        if isinstance(v, (int, float)):
+            reg.gauge(f"bench_{key}", metric=metric).set(v)
+    telemetry.emit({"kind": "bench", **result})
+
+
 def bench_resnet(depth=50):
     """Secondary tracked configs (BASELINE.md): ResNet images/sec/chip,
     any depth in the hapi roster (BENCH_MODEL=resnet18/34/50/101/152).
@@ -128,7 +146,7 @@ def bench_resnet(depth=50):
     dt, _ = _timed_run(exe, m, data, loss, steps)
     imgs_per_sec = batch * steps / dt
     mfu = resnet_step_flops(cfg, batch, size) * steps / dt / _peak_flops_per_chip()
-    print(json.dumps({
+    _emit_result({
         "metric": f"resnet{depth}_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "images/s/chip",
@@ -139,7 +157,7 @@ def bench_resnet(depth=50):
         "steps": steps,
         "amp_bf16": use_amp,
         "conv_bn_fusion": use_fusion,
-    }))
+    })
 
 
 def bench_transformer():
@@ -188,7 +206,7 @@ def bench_transformer():
     tokens_per_sec = batch * (src_len + trg_len) * steps / dt
     mfu = (transformer_step_flops(cfg, batch, src_len, trg_len) * steps / dt
            / _peak_flops_per_chip())
-    print(json.dumps({
+    _emit_result({
         "metric": "transformer_base_nmt_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
@@ -199,7 +217,7 @@ def bench_transformer():
         "trg_len": trg_len,
         "steps": steps,
         "amp_bf16": use_amp,
-    }))
+    })
 
 
 # auto-remat escalation ladder: cheapest recompute first. The bench

@@ -7,7 +7,7 @@ ICI/DCN; hot kernels use Pallas. See SURVEY.md for the design blueprint.
 """
 __version__ = "0.1.0"
 
-from . import dataset, fluid, hapi, inference, io, nn, ops, reader, tensor  # noqa: F401
+from . import dataset, fluid, hapi, inference, io, nn, ops, reader, telemetry, tensor  # noqa: F401
 from .tensor import *  # noqa: F401,F403 — 2.0 puts tensor ops at the root
 from .fluid import (  # noqa: F401
     CPUPlace,

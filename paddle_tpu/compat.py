@@ -1,0 +1,62 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current jax API surface; this module absorbs
+the renames between releases so every call site imports ONE spelling.
+
+shard_map moved twice upstream:
+
+  jax <= 0.4.x   jax.experimental.shard_map.shard_map(check_rep=...)
+  jax >= 0.5     jax.shard_map(...)  (check_rep)
+  jax >= 0.6     jax.shard_map(...)  (check_rep renamed check_vma)
+
+`shard_map` below resolves the import once and maps the replication-
+check kwarg to whatever the installed jax spells it, defaulting it OFF
+(every manual region here uses explicit collectives whose replication
+the checker cannot always prove).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional
+
+_shard_map_impl = None
+_check_kwarg: Optional[str] = None
+
+
+def _resolve():
+    global _shard_map_impl, _check_kwarg
+    if _shard_map_impl is not None:
+        return
+    try:
+        from jax import shard_map as impl  # jax >= 0.5
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as impl
+    params = inspect.signature(impl).parameters
+    if "check_vma" in params:
+        _check_kwarg = "check_vma"
+    elif "check_rep" in params:
+        _check_kwarg = "check_rep"
+    else:  # future jax that dropped the knob entirely
+        _check_kwarg = None
+    _shard_map_impl = impl
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False) -> Any:
+    """Version-stable shard_map. `check` maps onto check_rep/check_vma
+    (whichever the installed jax has); call sites here always pass
+    False — manual collective regions the checker rejects."""
+    _resolve()
+    kw = {_check_kwarg: check} if _check_kwarg is not None else {}
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams across the rename (<=0.4.x spells it
+    TPUCompilerParams). Fields (vmem_limit_bytes, dimension_semantics,
+    ...) are identical; only the class name moved."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)

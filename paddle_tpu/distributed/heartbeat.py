@@ -17,10 +17,11 @@ checkpoint.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
-from typing import List, Optional, Union
+from typing import Callable, List, Optional, Tuple, Union
 
 ENV_DIR = "PADDLE_HEARTBEAT_DIR"
 
@@ -28,9 +29,39 @@ ENV_DIR = "PADDLE_HEARTBEAT_DIR"
 # "ps<idx>" — ps_server.serve / launch.py supervision share this channel)
 Rank = Union[int, str]
 
+# step-rate payload for the stamps: fluid/monitor.py registers its
+# (global step, avg step seconds) sampler here on the first executed
+# step, so launched trainers carry progress in their heartbeats without
+# code changes — the launcher's straggler detection reads it back
+_step_provider: Optional[Callable[[], Tuple[int, Optional[float]]]] = None
+
+
+def set_step_provider(fn: Callable[[], Tuple[int, Optional[float]]]) -> None:
+    global _step_provider
+    _step_provider = fn
+
 
 def _stamp_path(directory: str, rank: Rank) -> str:
     return os.path.join(directory, f"heartbeat.{rank}")
+
+
+def read_stamp(directory: str, rank: Rank) -> Optional[dict]:
+    """Parsed stamp content: {"t": unix seconds[, "step": int,
+    "avg_step_s": float]}. Pre-telemetry stamps (a bare repr(float))
+    parse as {"t": value}. None when absent/torn."""
+    try:
+        with open(_stamp_path(directory, rank)) as f:
+            raw = f.read()
+    except OSError:
+        return None
+    try:
+        d = json.loads(raw)
+        return d if isinstance(d, dict) else {"t": float(d)}
+    except ValueError:
+        try:
+            return {"t": float(raw)}
+        except ValueError:
+            return None
 
 
 class HeartBeatWorker:
@@ -45,9 +76,18 @@ class HeartBeatWorker:
         os.makedirs(directory, exist_ok=True)
 
     def _beat(self):
+        stamp = {"t": time.time()}
+        if _step_provider is not None:
+            try:
+                step, avg = _step_provider()
+                stamp["step"] = int(step)
+                if avg is not None:
+                    stamp["avg_step_s"] = round(avg, 6)
+            except Exception:  # noqa: BLE001 — liveness must never die
+                pass
         tmp = f"{self.path}.tmp"
         with open(tmp, "w") as f:
-            f.write(repr(time.time()))
+            f.write(json.dumps(stamp))
         os.replace(tmp, self.path)  # atomic: monitor never reads a torn file
 
     def start(self):
@@ -80,6 +120,36 @@ def start_heartbeat(interval: float = 1.0) -> Optional[HeartBeatWorker]:
         return None
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
     return HeartBeatWorker(directory, rank, interval).start()
+
+
+class StragglerMonitor:
+    """Launcher-side straggler detection over the heartbeat channel.
+
+    Trainers' stamps carry (step, t) once fluid/monitor.py registers its
+    step provider; poll() feeds every fresh sample into a
+    telemetry.straggler.StragglerDetector and returns the structured
+    `straggler` events it raised — a rank whose step time exceeds
+    `factor` x the median of its peers (or that stopped advancing while
+    peers run). The launcher prints each event as one JSON log line and
+    keeps the job running: straggler detection is diagnosis, not
+    enforcement (kill policy stays with --heartbeat_timeout)."""
+
+    def __init__(self, directory: str, ranks: List[Rank],
+                 factor: float = 3.0, min_steps: Optional[int] = None):
+        from ..telemetry.straggler import StragglerDetector
+
+        self.directory = directory
+        self.ranks = list(ranks)
+        kw = {} if min_steps is None else {"min_steps": min_steps}
+        self.detector = StragglerDetector(factor=factor, **kw)
+
+    def poll(self) -> List[dict]:
+        for r in self.ranks:
+            stamp = read_stamp(self.directory, r)
+            if stamp is None or "step" not in stamp:
+                continue
+            self.detector.observe(r, int(stamp["step"]), float(stamp["t"]))
+        return self.detector.events()
 
 
 class HeartBeatMonitor:

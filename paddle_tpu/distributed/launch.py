@@ -122,6 +122,22 @@ def _parse_args(argv):
         "never exit. 0 = off",
     )
     p.add_argument(
+        "--straggler_factor", type=float, default=0.0,
+        help="log a structured `straggler` event when a trainer's step "
+        "time exceeds this multiple of the median across ranks (step "
+        "rates ride the heartbeat stamps; fluid/monitor.py publishes "
+        "them automatically). Diagnosis only — the job keeps running. "
+        "0 = off",
+    )
+    p.add_argument(
+        "--trace_dir", default=None,
+        help="collect per-rank chrome traces: trainers record host "
+        "spans (PADDLE_TRACE_DIR contract, fluid/profiler.py) and dump "
+        "trace.<rank>.json here at exit; after the job the launcher "
+        "merges them into <trace_dir>/timeline.json (pid=rank — open "
+        "in Perfetto / chrome://tracing)",
+    )
+    p.add_argument(
         "--server_num", type=int, default=0,
         help="spawn N local parameter-server processes "
         "(distributed/ps_server.py) on free ports and export "
@@ -424,7 +440,8 @@ def terminate_local_trainers(trainers: List[Trainer]):
 
 def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                          monitor=None, ps_supervisor=None,
-                         grace: Optional[SigtermGrace] = None) -> int:
+                         grace: Optional[SigtermGrace] = None,
+                         straggler=None) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
     aborts the whole local group (reference watch_local_trainers:407:
@@ -472,6 +489,13 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                     )
                     terminate_local_trainers(trainers)
                     return 124  # timeout-style exit code
+            if straggler is not None:
+                # diagnosis only: one structured JSON line per episode
+                # (heartbeat.StragglerMonitor); the job keeps running
+                from ..telemetry.straggler import format_event
+
+                for ev in straggler.poll():
+                    print(format_event(ev), file=sys.stderr, flush=True)
             if ps_supervisor is not None:
                 rc = ps_supervisor.check()
                 if rc is not None:
@@ -491,13 +515,21 @@ def launch(argv=None) -> int:
 
     heartbeat_dir = None
     own_heartbeat_dir = False
-    if args.heartbeat_timeout > 0:
+    # straggler detection rides the same heartbeat channel (stamps carry
+    # step counts), so either flag provisions the directory
+    if args.heartbeat_timeout > 0 or args.straggler_factor > 0:
         heartbeat_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
         if not heartbeat_dir:
             import tempfile
 
             heartbeat_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
             own_heartbeat_dir = True
+
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        # trainers inherit it via start_local_trainers' env copy and
+        # auto-dump per-rank traces (profiler.maybe_start_trace_collection)
+        os.environ["PADDLE_TRACE_DIR"] = args.trace_dir
 
     # snapshot interval: explicit flag > env > supervision-implied default
     snapshot_secs = args.ps_snapshot_secs
@@ -549,8 +581,16 @@ def launch(argv=None) -> int:
                     snapshot_dir, snapshot_secs,
                     heartbeat_dir=heartbeat_dir,
                     heartbeat_timeout=args.heartbeat_timeout)
-        return _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
-                                ps_supervisor, grace)
+        rc = _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
+                              ps_supervisor, grace)
+        if args.trace_dir:
+            from ..telemetry.timeline import merge_traces
+
+            merged = merge_traces(args.trace_dir)
+            if merged:
+                print(f"[launch] merged timeline: {merged} (open in "
+                      f"Perfetto / chrome://tracing)", file=sys.stderr)
+        return rc
     finally:
         terminate_pservers(pservers)
         if own_heartbeat_dir:
@@ -577,7 +617,7 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
         if grace is not None:
             grace.trainers = local
         monitor = None
-        if heartbeat_dir:
+        if heartbeat_dir and args.heartbeat_timeout > 0:
             from .heartbeat import HeartBeatMonitor
 
             # created AFTER spawn: a fresh monitor ignores stamps older
@@ -586,8 +626,16 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
             monitor = HeartBeatMonitor(
                 heartbeat_dir, [t.rank for t in local], args.heartbeat_timeout
             )
+        straggler = None
+        if heartbeat_dir and args.straggler_factor > 0:
+            from .heartbeat import StragglerMonitor
+
+            straggler = StragglerMonitor(
+                heartbeat_dir, [t.rank for t in local],
+                factor=args.straggler_factor)
         rc = watch_local_trainers(local, monitor=monitor,
-                                  ps_supervisor=ps_supervisor, grace=grace)
+                                  ps_supervisor=ps_supervisor, grace=grace,
+                                  straggler=straggler)
         if (rc == 0 or attempt >= args.elastic_retries
                 or rc == 128 + signal.SIGINT
                 or rc == 128 + signal.SIGTERM  # whole-job preemption

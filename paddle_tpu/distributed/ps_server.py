@@ -75,8 +75,14 @@ import numpy as np
 
 from . import faults
 from .ps import ShardedHostTable
+from ..telemetry import BYTE_BUCKETS, get_registry
 
 _LEN = struct.Struct(">Q")
+
+# process metrics registry (paddle_tpu.telemetry): client- and server-
+# side series use disjoint name prefixes (ps_client_* / ps_server_*) so
+# in-process test servers sharing the registry stay distinguishable
+_REG = get_registry()
 
 # a barrier that outlives this window means a peer trainer died mid-round:
 # fail fast so the launcher's watcher can abort/restart the group
@@ -101,9 +107,11 @@ class TableMissingError(RuntimeError):
 # ---------------------------------------------------------------------------
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
+def _send_msg(sock: socket.socket, obj) -> int:
+    """Returns wire bytes written (framing + payload) for telemetry."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(payload)) + payload)
+    return _LEN.size + len(payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -119,6 +127,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_msg(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_msg_sized(sock: socket.socket):
+    """(message, wire bytes read) — the telemetry-aware receive."""
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n)), _LEN.size + n
 
 
 # ---------------------------------------------------------------------------
@@ -322,6 +336,10 @@ class PSServer:
             # RETRIED push whose first send already landed is skipped.
             with st.cond:
                 if retry and st.async_seen.get(trainer_id, -1) >= step:
+                    _REG.counter("ps_server_replay_dedup_total",
+                                 help="retried pushes whose first send "
+                                      "already landed (applied once)",
+                                 verb="push_gradients").inc()
                     return 0
                 st.async_seen[trainer_id] = max(
                     st.async_seen.get(trainer_id, -1), step)
@@ -332,6 +350,8 @@ class PSServer:
             if retry and step <= st.last_applied:
                 # replay of a round that merged before the reply was
                 # lost: the update already landed exactly once
+                _REG.counter("ps_server_replay_dedup_total",
+                             verb="push_gradients").inc()
                 return 0
             buf = st.rounds.setdefault(step, {})
             # overwrite-not-raise: a pre-existing same-trainer entry is a
@@ -381,6 +401,8 @@ class PSServer:
             st = self.sync[name]
             with st.cond:
                 if retry and st.delta_seen.get(trainer_id, -1) >= seq:
+                    _REG.counter("ps_server_replay_dedup_total",
+                                 verb="push_delta").inc()
                     return 0  # replayed delta already accumulated
                 st.delta_seen[trainer_id] = max(
                     st.delta_seen.get(trainer_id, -1), seq)
@@ -391,6 +413,12 @@ class PSServer:
         inj = faults.injector()
         if inj is not None:
             inj.on_server_call(method)  # may os._exit (kill rule)
+        if kwargs.get("retry"):
+            # the client marked this a replay attempt (its first send may
+            # have landed); dedup hits are counted separately above
+            _REG.counter("ps_server_retry_received_total",
+                         help="RPCs arriving with the retry marker",
+                         verb=method).inc()
         if method == "ping":
             return "pong"
         if method == "create_table":
@@ -412,9 +440,17 @@ class PSServer:
         if method == "nbytes":
             return self._table(kwargs["name"]).nbytes()
         if method == "stats":
-            t = self._table(kwargs["name"])
-            return {"push_calls": t.push_calls,
-                    "pushed_bytes": t.pushed_bytes}
+            # idempotent observability verb: per-table traffic counters
+            # (when a name is given) + this server process's telemetry
+            # registry slice — per-verb latency histogram summaries,
+            # retry/replay-dedup counters, bytes in/out
+            out = {"server": server_telemetry()}
+            name = kwargs.get("name")
+            if name:
+                t = self._table(name)
+                out["push_calls"] = t.push_calls
+                out["pushed_bytes"] = t.pushed_bytes
+            return out
         if method == "state_dict":
             return self._table(kwargs["name"]).state_dict()
         if method == "load_state_dict":
@@ -493,23 +529,46 @@ class PSServer:
         self._snap_thread.start()
 
 
+def server_telemetry() -> dict:
+    """This process's ps_server_* registry slice, JSON-ready — the
+    payload of the `stats` verb. Histograms dump as summaries
+    (count/sum/min/max/avg); the Prometheus exposition carries full
+    buckets for scrapers."""
+    snap = _REG.snapshot()
+    return {k: v for k, v in snap.items() if k.startswith("ps_server_")}
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         srv: PSServer = self.server.ps  # type: ignore[attr-defined]
         while True:
             try:
-                method, kwargs = _recv_msg(self.request)
+                (method, kwargs), n_in = _recv_msg_sized(self.request)
             except (ConnectionError, EOFError):
                 return
+            # counted at ARRIVAL, not after the reply: an RPC whose
+            # client vanished mid-round-trip was still handled and must
+            # show in the books deterministically
+            _REG.counter("ps_server_rpc_total", verb=method).inc()
+            _REG.counter("ps_server_bytes_in_total", verb=method).inc(n_in)
+            t0 = time.perf_counter()
             try:
                 result = srv.handle(method, kwargs)
-                _send_msg(self.request, (True, result))
+                reply = (True, result)
             except BaseException as e:  # noqa: BLE001 — ship to client
-                try:
-                    _send_msg(self.request, (False, f"{type(e).__name__}: {e}"))
-                except OSError:
-                    return
+                _REG.counter("ps_server_errors_total", verb=method).inc()
+                reply = (False, f"{type(e).__name__}: {e}")
+            _REG.histogram("ps_server_rpc_ms",
+                           help="server-side verb handling latency "
+                                "(sync pushes include the barrier wait)",
+                           verb=method).observe(
+                (time.perf_counter() - t0) * 1e3)
+            try:
+                n_out = _send_msg(self.request, reply)
+            except OSError:
+                return  # peer gone; the retry path owns recovery
+            _REG.counter("ps_server_bytes_out_total", verb=method).inc(n_out)
             if srv.shutdown_event.is_set():
                 threading.Thread(
                     target=self.server.shutdown, daemon=True).start()
@@ -636,6 +695,8 @@ class _Conn:
     def call(self, method: str, **kwargs):
         inj = faults.injector()
         last_err: Optional[BaseException] = None
+        t_rpc = time.perf_counter()
+        sent_bytes = rcvd_bytes = 0
         for attempt in range(RPC_MAX_RETRIES + 1):
             if attempt:
                 if method in self._MARK_RETRY:
@@ -648,12 +709,13 @@ class _Conn:
                 s = self._checkout()
                 if inj is not None:
                     inj.before_send(method)  # refuse/delay rules
-                _send_msg(s, (method, kwargs))
+                sent_bytes += _send_msg(s, (method, kwargs))
                 if inj is not None and inj.drop_after_send(method):
                     raise faults.FaultError(
                         f"fault injection: dropped connection after "
                         f"sending {method!r}")
-                ok, result = _recv_msg(s)
+                (ok, result), n_in = _recv_msg_sized(s)
+                rcvd_bytes += n_in
             except (OSError, EOFError) as e:
                 # includes ConnectionError, socket.timeout, refused
                 # connects while a supervised pserver restarts
@@ -673,12 +735,30 @@ class _Conn:
                 raise
             with self._lock:
                 self._free.append(s)
+            # per-verb client telemetry: wall latency INCLUDING backoff
+            # (what the training step actually waited), retries, bytes
+            _REG.histogram("ps_client_rpc_ms",
+                           help="client RPC wall latency incl. retries",
+                           verb=method).observe(
+                (time.perf_counter() - t_rpc) * 1e3)
+            _REG.counter("ps_client_rpc_total", verb=method).inc()
+            if attempt:
+                _REG.counter("ps_client_retries_total",
+                             help="retried RPC attempts",
+                             verb=method).inc(attempt)
+            _REG.counter("ps_client_bytes_sent_total",
+                         verb=method).inc(sent_bytes)
+            _REG.counter("ps_client_bytes_received_total",
+                         verb=method).inc(rcvd_bytes)
             if not ok:
+                _REG.counter("ps_client_app_errors_total",
+                             verb=method).inc()
                 if isinstance(result, str) and result.startswith(
                         "KeyError") and "no table" in result:
                     raise TableMissingError(f"pserver {self.addr}: {result}")
                 raise RuntimeError(f"pserver {self.addr}: {result}")
             return result
+        _REG.counter("ps_client_rpc_failed_total", verb=method).inc()
         raise ConnectionError(
             f"pserver {self.addr}: RPC {method!r} still failing after "
             f"{RPC_MAX_RETRIES + 1} attempts: {last_err}") from last_err
@@ -839,12 +919,21 @@ class RemoteTable:
                    for s in range(self._n))
 
     def stats(self) -> dict:
-        agg = {"push_calls": 0, "pushed_bytes": 0}
+        """Aggregated table traffic counters + each pserver's telemetry
+        slice under "servers" (the idempotent `stats` verb)."""
+        agg = {"push_calls": 0, "pushed_bytes": 0, "servers": []}
         for s in range(self._n):
             st = self._call(s, "stats", name=self.name)
-            for k in agg:
-                agg[k] += st[k]
+            agg["push_calls"] += st["push_calls"]
+            agg["pushed_bytes"] += st["pushed_bytes"]
+            agg["servers"].append(st.get("server", {}))
         return agg
+
+    def server_stats(self) -> List[dict]:
+        """Per-pserver telemetry snapshots (no table counters) — verb
+        latencies, retry/replay-dedup counters, bytes in/out."""
+        return [self._conns[s].call("stats").get("server", {})
+                for s in range(self._n)]
 
     def to_dense(self) -> np.ndarray:
         out = np.empty((self.rows, self.dim), self.dtype)

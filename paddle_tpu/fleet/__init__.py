@@ -84,6 +84,34 @@ def ps_snapshot_manifest(dirname: str) -> Optional[dict]:
     return read_snapshot_manifest(dirname)
 
 
+def ps_stats(table_name: Optional[str] = None) -> dict:
+    """PS data-plane telemetry through the idempotent `stats` verb
+    (ISSUE 4): per-verb latency summaries, retry / replay-dedup
+    counters and bytes in/out from each pserver process, plus per-table
+    traffic counters.
+
+    table_name names one registered table; None reports every table
+    this process created. Hosted tables (RemoteTable) fan the verb out
+    to their pservers; in-process tables report their local counters.
+    Returns {table_name: stats_dict}."""
+    from ..distributed import ps
+
+    names = [table_name] if table_name else sorted(ps._tables)
+    out = {}
+    for n in names:
+        t = ps.get_table(n)
+        # GeoSGDClient wraps either table kind: unwrap to whatever can
+        # actually report (RemoteTable.stats or the local counters)
+        target = t if hasattr(t, "stats") else getattr(t, "server", t)
+        if hasattr(target, "stats"):
+            out[n] = target.stats()
+        else:  # in-process ShardedHostTable
+            out[n] = {"push_calls": target.push_calls,
+                      "pushed_bytes": target.pushed_bytes,
+                      "servers": []}
+    return out
+
+
 def run_server() -> None:
     """Run the pserver event loop on PADDLE_PORT (blocks until a client
     sends shutdown — the listen_and_serv analog, distributed/

@@ -17,7 +17,7 @@ from . import (  # noqa: F401
     regularizer,
     unique_name,
 )
-from . import checkpoint, compiler, crypto, dataset, learning_rate_scheduler, metrics, nets, profiler, reader, transpiler  # noqa: F401
+from . import checkpoint, compiler, crypto, dataset, learning_rate_scheduler, metrics, monitor, nets, profiler, reader, transpiler  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401

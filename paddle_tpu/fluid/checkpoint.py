@@ -258,6 +258,19 @@ class CheckpointManager:
     # -- save ------------------------------------------------------------
     def save(self, step: int, extra_state: Optional[dict] = None,
              program=None, scope=None) -> str:
+        import time as _time
+
+        from . import monitor
+
+        t0 = _time.perf_counter()
+        out = self._save_impl(step, extra_state, program, scope)
+        # telemetry: checkpoint time is part of the step-time story
+        # (attached to the next committed step record + its histogram)
+        monitor.observe_checkpoint_save((_time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _save_impl(self, step: int, extra_state: Optional[dict] = None,
+                   program=None, scope=None) -> str:
         program = program if program is not None else self.program
         scope = scope if scope is not None else (self.scope or global_scope())
 

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import framework
+from . import framework, monitor
 from .dtypes import convert_dtype
 from .profiler import RecordEvent
 from ..ops import registry
@@ -104,6 +104,23 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,  # parity arg; always cached
     ):
+        # step telemetry (fluid/monitor.py): rec is None unless
+        # PADDLE_METRICS_PATH armed the JSONL sink — the flag-off hot
+        # path pays one attribute read here and nothing below
+        rec = monitor.begin_step()
+        try:
+            out = self._run_impl(program, feed, fetch_list, scope,
+                                 return_numpy, rec)
+        except BaseException:
+            monitor.abandon_step()
+            raise
+        monitor.commit_step(rec)
+        return out
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  rec):
+        import time as _time
+
         if program is None:
             program = framework.default_main_program()
         # CompiledProgram wrapper (compiler.py) delegates here
@@ -118,7 +135,10 @@ class Executor:
         )
         block = program.global_block()
 
+        t_feed = _time.perf_counter() if rec is not None else 0.0
         feed_arrays = self._prepare_feed(block, feed)
+        if rec is not None:
+            rec.data_wait_ms += (_time.perf_counter() - t_feed) * 1e3
         from .flags import flag
 
         # the nan/inf debugging mode and the bad-step guard both disable
@@ -173,10 +193,30 @@ class Executor:
                     scope._rng_key = jax.device_put(
                         scope._rng_key, compiled.repl_sharding
                     )
+        bench = flag("FLAGS_benchmark")
+        t_dev = _time.perf_counter() if rec is not None else 0.0
         with RecordEvent("Executor::run"):
             fetches, new_state, new_key = compiled.fn(
                 feed_arrays, donated, kept, scope._rng_key
             )
+            if rec is not None and bench:
+                # honest device time needs a fence; gated on the same
+                # FLAGS_benchmark that already syncs below, so telemetry
+                # never adds a fence the run didn't opt into
+                import jax
+
+                jax.block_until_ready(fetches)
+                rec.fenced = True
+        if rec is not None:
+            dt = (_time.perf_counter() - t_dev) * 1e3
+            if rec.cache_hit:
+                rec.device_ms += dt
+            else:
+                # jax.jit compiles lazily: on a cache-miss step XLA's
+                # compile happens INSIDE this first call, so the window
+                # belongs to compile_ms — device_ms would otherwise
+                # spike once per signature and poison step-time stats
+                rec.compile_ms += dt
         if check_numerics:
             # bad-step guard (FLAGS_check_numerics): refuse to COMMIT a
             # step whose gradients went non-finite — scope (params,
@@ -201,13 +241,17 @@ class Executor:
         scope._rng_key = new_key
         for n, v in new_state.items():
             scope.set_var(n, v)
-        if flag("FLAGS_benchmark"):
+        if bench:
             import jax
 
             jax.block_until_ready(fetches)
         if return_numpy:
             with RecordEvent("Executor::fetch"):
-                return [np.asarray(f) for f in fetches]
+                t_f = _time.perf_counter() if rec is not None else 0.0
+                out = [np.asarray(f) for f in fetches]
+                if rec is not None:
+                    rec.fetch_ms += (_time.perf_counter() - t_f) * 1e3
+                return out
         return list(fetches)
 
     @staticmethod
@@ -270,12 +314,24 @@ class Executor:
         key = self._cache_key(program, feed_arrays, fetch_names, no_donate)
         compiled = self._cache.get(key)
         if compiled is None:
+            # a RETRACE is a recompile of a program the cache already
+            # holds under another signature (shape change, new fetch
+            # list, flag toggle) — the shape-instability tax telemetry
+            # counts separately from first compiles
+            retrace = any(k[0] == program._serial for k in self._cache)
+            import time as _time
+
+            t0 = _time.perf_counter()
             with RecordEvent("Executor::compile"):
                 compiled = self._compile(
                     program, block, sorted(feed_arrays), fetch_names, scope,
                     donate=not no_donate,
                 )
+            monitor.record_compile((_time.perf_counter() - t0) * 1e3,
+                                   retrace)
             self._cache[key] = compiled
+        else:
+            monitor.record_cache_hit()
         return compiled
 
     @staticmethod
@@ -422,8 +478,9 @@ class Executor:
             # Restriction (documented in fleet): data-parallel programs —
             # per-shard-divergent state like BN running stats is not
             # representable under the replicated out_specs.
-            from jax import shard_map
             from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..compat import shard_map
 
             gblock = program.global_block()
 
@@ -519,7 +576,7 @@ class Executor:
             )
             wrapped = shard_map(
                 local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
+                check=False,
             )
             jit_fn = jax.jit(wrapped, donate_argnums=(1,) if donate else ())
             cb = _CompiledBlock(
@@ -719,7 +776,9 @@ class Executor:
         while True:
             rolled_back = False
             step = 0
-            for feed in dataset._as_loader(drop_last=True):
+            # timed_iter: time blocked on the input iterator lands in
+            # the next step record's data_wait_ms (no-op when off)
+            for feed in monitor.timed_iter(dataset._as_loader(drop_last=True)):
                 if step < consumed:  # replaying up to the restored position
                     step += 1
                     continue

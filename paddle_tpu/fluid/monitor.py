@@ -1,0 +1,256 @@
+"""Executor step-time breakdown on the telemetry layer (ISSUE 4).
+
+Answers "where did this step go" for the whole-block-XLA execution
+model, where op boundaries vanish inside one compiled program
+(arXiv:2301.13062) and the only honest per-phase account is at the
+executor's seams:
+
+  data_wait_ms   host time spent materializing the feed (plus, in
+                 dataset loops, the time blocked on the input iterator
+                 — timed_iter / add_data_wait)
+  compile_ms     trace + XLA compile when the step misses the cache;
+                 cache_hit / retraces count the misses that matter
+                 (a RETRACE is a new compile for a program the cache
+                 already held under a different signature — the silent
+                 shape-instability tax)
+  device_ms      the compiled step call. Honest only under the
+                 FLAGS_benchmark fence (block_until_ready inside the
+                 timed window); without the fence it measures dispatch,
+                 which is what the async hot path actually pays
+  fetch_ms       device->host conversion of the fetch list
+  ckpt_save_ms   CheckpointManager.save durations (attached to the next
+                 committed step record)
+  peak_hbm_bytes device allocator high-water (jax memory_stats; 0 where
+                 the backend reports none, e.g. CPU)
+
+Cost contract: with PADDLE_METRICS_PATH unset nothing here touches the
+filesystem or fences the device; the always-on residue is a handful of
+counter increments and one deque append per step (the step-rate sample
+the straggler heartbeat rides on), unmeasurable next to any real step.
+
+Every number also lands in the process metrics registry
+(telemetry.get_registry()) for the Prometheus exposition.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional, Tuple
+
+from ..telemetry import get_registry, sink
+
+_reg = get_registry()
+
+# always-on counters, resolved per use (get-or-create) so a registry
+# reset() in tests never leaves orphaned metric objects behind
+
+
+def _counter(name, help=""):
+    return _reg.counter(name, help=help)
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+# step-rate sample for the heartbeat/straggler channel: recent commit
+# timestamps (monotonic) -> avg step seconds over the window
+_recent = collections.deque(maxlen=16)
+_step_count = 0
+_pending_data_wait_ms = 0.0
+_pending_ckpt_save_ms = 0.0
+_hb_registered = False
+
+
+def enabled() -> bool:
+    """True when per-step records are being written (PADDLE_METRICS_PATH
+    set or telemetry.sink.enable() called)."""
+    return sink.enabled()
+
+
+class StepRecord:
+    __slots__ = ("data_wait_ms", "compile_ms", "device_ms", "fetch_ms",
+                 "ckpt_save_ms", "cache_hit", "fenced")
+
+    def __init__(self):
+        self.data_wait_ms = 0.0
+        self.compile_ms = 0.0
+        self.device_ms = 0.0
+        self.fetch_ms = 0.0
+        self.ckpt_save_ms = 0.0
+        self.cache_hit = True
+        self.fenced = False
+
+
+def begin_step() -> Optional[StepRecord]:
+    """Open a step record when telemetry output is on; None otherwise.
+    The record is thread-local so _ensure_compiled (called deeper in
+    the stack) can contribute compile numbers."""
+    if not sink.enabled():
+        return None
+    rec = StepRecord()
+    _tls.rec = rec
+    return rec
+
+
+def current_record() -> Optional[StepRecord]:
+    return getattr(_tls, "rec", None)
+
+
+def abandon_step() -> None:
+    """Drop the open record (step raised; nothing committed)."""
+    _tls.rec = None
+
+
+def record_compile(ms: float, retrace: bool) -> None:
+    """Called by Executor._ensure_compiled on a cache MISS."""
+    _counter("executor_cache_misses_total",
+             "compile-cache misses (first compiles)").inc()
+    if retrace:
+        _counter("executor_retraces_total",
+                 "recompiles of an already-compiled program under a new "
+                 "feed signature / flag set (shape instability)").inc()
+    _reg.histogram("executor_compile_ms",
+                   help="trace+XLA compile durations").observe(ms)
+    rec = current_record()
+    if rec is not None:
+        rec.compile_ms += ms
+        rec.cache_hit = False
+
+
+def record_cache_hit() -> None:
+    _counter("executor_cache_hits_total", "compile-cache hits").inc()
+
+
+def add_data_wait(ms: float) -> None:
+    """Input-pipeline wait attributed to the NEXT step (dataset loops
+    block on the iterator BEFORE calling run)."""
+    global _pending_data_wait_ms
+    with _lock:
+        _pending_data_wait_ms += ms
+
+
+def observe_checkpoint_save(ms: float) -> None:
+    global _pending_ckpt_save_ms
+    _reg.histogram("checkpoint_save_ms",
+                   help="CheckpointManager.save durations").observe(ms)
+    with _lock:
+        _pending_ckpt_save_ms += ms
+
+
+def timed_iter(iterable):
+    """Wrap a batch iterator so time blocked on next() lands in the
+    following step's data_wait_ms. Pass-through when telemetry is off."""
+    if not sink.enabled():
+        yield from iterable
+        return
+    it = iter(iterable)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            v = next(it)
+        except StopIteration:
+            return
+        add_data_wait((time.perf_counter() - t0) * 1e3)
+        yield v
+
+
+def peak_hbm_bytes() -> int:
+    """Device allocator high-water mark (jax memory_stats). 0 when the
+    backend reports nothing (CPU)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return int(stats.get("peak_bytes_in_use")
+                       or stats.get("bytes_in_use") or 0)
+    except Exception:  # noqa: BLE001 — diagnostics never fail the step
+        pass
+    return 0
+
+
+def mark_step() -> int:
+    """Always-on per-step bookkeeping: step counter + the step-rate
+    sample the heartbeat stamps carry. Returns the step index just
+    completed (0-based monotone per process)."""
+    global _step_count, _hb_registered
+    _counter("executor_steps_total", "Executor.run completions").inc()
+    with _lock:
+        step = _step_count
+        _step_count += 1
+        _recent.append(time.monotonic())
+    if not _hb_registered:
+        _hb_registered = True
+        try:  # publish (step, avg step time) through the heartbeat file
+            from ..distributed import heartbeat
+
+            heartbeat.set_step_provider(step_rate_sample)
+        except Exception:  # noqa: BLE001 — liveness channel is optional
+            pass
+    return step
+
+
+def global_step() -> int:
+    return _step_count
+
+
+def step_rate_sample() -> Tuple[int, Optional[float]]:
+    """(steps completed, recent avg step seconds or None) — the payload
+    heartbeat stamps carry for launcher-side straggler detection."""
+    with _lock:
+        n = _step_count
+        if len(_recent) >= 2:
+            span = _recent[-1] - _recent[0]
+            avg = span / (len(_recent) - 1) if span > 0 else None
+        else:
+            avg = None
+    return n, avg
+
+
+def commit_step(rec: Optional[StepRecord]) -> None:
+    """Close the step: always-on bookkeeping, plus the JSONL record and
+    gauges when telemetry output is on."""
+    global _pending_data_wait_ms, _pending_ckpt_save_ms
+    step = mark_step()
+    if rec is None:
+        return
+    _tls.rec = None
+    with _lock:
+        rec.data_wait_ms += _pending_data_wait_ms
+        rec.ckpt_save_ms += _pending_ckpt_save_ms
+        _pending_data_wait_ms = 0.0
+        _pending_ckpt_save_ms = 0.0
+    peak = peak_hbm_bytes()
+    _reg.gauge("peak_hbm_bytes",
+               help="device allocator high-water (bytes)").set(peak)
+    _reg.histogram("executor_device_ms",
+                   help="compiled step call (fenced iff FLAGS_benchmark)"
+                   ).observe(rec.device_ms)
+    _reg.histogram("executor_data_wait_ms",
+                   help="feed materialization + input-iterator wait"
+                   ).observe(rec.data_wait_ms)
+    sink.emit({
+        "kind": "step",
+        "step": step,
+        "data_wait_ms": round(rec.data_wait_ms, 3),
+        "compile_ms": round(rec.compile_ms, 3),
+        "device_ms": round(rec.device_ms, 3),
+        "fetch_ms": round(rec.fetch_ms, 3),
+        "ckpt_save_ms": round(rec.ckpt_save_ms, 3),
+        "cache_hit": rec.cache_hit,
+        "fenced": rec.fenced,
+        "retraces": _counter("executor_retraces_total").value,
+        "peak_hbm_bytes": peak,
+    })
+
+
+def reset_for_tests() -> None:
+    """Zero the per-process step state (unit tests only; the registry
+    is reset separately via telemetry.get_registry().reset())."""
+    global _step_count, _pending_data_wait_ms, _pending_ckpt_save_ms
+    with _lock:
+        _step_count = 0
+        _recent.clear()
+        _pending_data_wait_ms = 0.0
+        _pending_ckpt_save_ms = 0.0
+    _tls.rec = None

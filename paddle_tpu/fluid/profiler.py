@@ -104,7 +104,9 @@ def stop_profiler(sorted_key: Optional[str] = "total",
     chrome = _host_chrome_events(events)
     chrome += _device_chrome_events(_trace_dir)
     out = profile_path if profile_path.endswith(".json") else profile_path + ".json"
-    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    d = os.path.dirname(out)
+    if d:  # dirless paths write to the cwd — nothing to create
+        os.makedirs(d, exist_ok=True)
     with open(out, "w") as f:
         json.dump({"traceEvents": chrome, "displayTimeUnit": "ms"}, f)
     if _trace_dir:
@@ -112,6 +114,52 @@ def stop_profiler(sorted_key: Optional[str] = "total",
     else:
         print(f"[profiler] chrome trace: {out}")
     _trace_dir = None
+
+
+def export_chrome_trace(path: str) -> str:
+    """SNAPSHOT the host spans recorded so far into a chrome-trace JSON
+    WITHOUT stopping the profiler (events keep accumulating; device
+    xplane events only appear in stop_profiler's trace — the device
+    trace cannot be read mid-flight). The launcher's per-rank timeline
+    collection (PADDLE_TRACE_DIR) uses exactly this. Returns the path
+    written."""
+    with _lock:
+        events = list(_events)
+    out = path if path.endswith(".json") else path + ".json"
+    d = os.path.dirname(out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": _host_chrome_events(events),
+                   "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, out)  # the launcher may merge while we run
+    return out
+
+
+_collection_started = False
+
+
+def maybe_start_trace_collection() -> bool:
+    """Launcher contract (launch.py --trace_dir): when PADDLE_TRACE_DIR
+    is set, record host spans for the life of the process and dump
+    `<dir>/trace.<rank>.json` at exit; the launcher merges the per-rank
+    files into one timeline (telemetry.timeline). Called by
+    parallel.env.init_parallel_env — launched trainers opt in without
+    code changes. No-op (False) when the env var is unset."""
+    global _collection_started, _enabled
+    directory = os.environ.get("PADDLE_TRACE_DIR")
+    if not directory or _collection_started:
+        return _collection_started
+    _collection_started = True
+    _enabled = True  # host spans only; device tracing stays user-driven
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    path = os.path.join(directory, f"trace.{rank}.json")
+
+    import atexit
+
+    atexit.register(lambda: export_chrome_trace(path))
+    return True
 
 
 @contextlib.contextmanager
@@ -167,23 +215,37 @@ def _host_chrome_events(events):
 
 def _device_chrome_events(trace_dir):
     """Parse the xplane protobuf into chrome events (device pid 1+).
-    Best-effort: returns [] when the xplane schema is unavailable."""
+    Best-effort, but never SILENT: when the device track is dropped the
+    reason is logged once, so a host-only trace is explainable instead
+    of mysterious."""
     if not trace_dir:
         return []
+    import sys
     import glob
 
     files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                       recursive=True)
     if not files:
+        print(f"[profiler] device track skipped: no .xplane.pb under "
+              f"{trace_dir} (device tracing produced no output)",
+              file=sys.stderr)
         return []
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:  # noqa: BLE001 — schema unavailable: skip merge
+    except Exception as e:  # noqa: BLE001 — schema unavailable: skip merge
+        print(f"[profiler] device track skipped: xplane schema "
+              f"unavailable ({type(e).__name__}: {e}); raw xplane kept "
+              f"at {trace_dir} for xprof/tensorboard", file=sys.stderr)
         return []
     xs = xplane_pb2.XSpace()
-    with open(files[0], "rb") as f:
-        xs.ParseFromString(f.read())
+    try:
+        with open(files[0], "rb") as f:
+            xs.ParseFromString(f.read())
+    except Exception as e:  # noqa: BLE001 — torn/foreign xplane file
+        print(f"[profiler] device track skipped: failed to parse "
+              f"{files[0]} ({type(e).__name__}: {e})", file=sys.stderr)
+        return []
     out = []
     raw = []
     pid = 1

@@ -270,10 +270,17 @@ class Model:
         if mgr is not None:
             ckpt_mod.install_preemption_handler()
 
-        cbks = callbacks_mod.CallbackList(
-            _to_list(callbacks)
-            or ([ProgBarLogger(log_freq, verbose=verbose)] if verbose else [])
-        )
+        cb_list = (_to_list(callbacks)
+                   or ([ProgBarLogger(log_freq, verbose=verbose)]
+                       if verbose else []))
+        from .. import telemetry
+
+        if telemetry.enabled() and not any(
+                isinstance(c, callbacks_mod.MetricsLogger) for c in cb_list):
+            # PADDLE_METRICS_PATH armed the sink: fit reports through the
+            # same registry/JSONL path as the executor and bench (ISSUE 4)
+            cb_list = list(cb_list) + [callbacks_mod.MetricsLogger()]
+        cbks = callbacks_mod.CallbackList(cb_list)
         cbks.set_model(self)
         cbks.on_train_begin()
         history = {"loss": []}

@@ -151,6 +151,65 @@ class ModelCheckpoint(Callback):
         return False
 
 
+class MetricsLogger(Callback):
+    """Emit hapi training metrics through the unified telemetry layer
+    (paddle_tpu.telemetry) so Model.fit, bench.py and the executor's
+    step breakdown share one registry / JSONL code path (ISSUE 4).
+
+    Registry series (always cheap, scrapeable via
+    telemetry.to_prometheus()):
+      hapi_train_batches_total   counter
+      hapi_train_loss            gauge (last batch loss)
+      hapi_batch_ms              histogram (on_batch_begin..end wall)
+      hapi_epochs_total          counter
+    JSONL (only when PADDLE_METRICS_PATH is set): one kind="train_epoch"
+    record per epoch with the epoch logs (loss, val_* ...).
+
+    Model.fit appends one automatically when the telemetry sink is
+    active and the callback list doesn't already carry one."""
+
+    def __init__(self):
+        self._t0 = None
+
+    def on_batch_begin(self, mode, step):
+        if mode == "train":
+            import time
+
+            self._t0 = time.perf_counter()
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        import time
+
+        from .. import telemetry
+
+        reg = telemetry.get_registry()
+        reg.counter("hapi_train_batches_total").inc()
+        if self._t0 is not None:
+            reg.histogram("hapi_batch_ms",
+                          help="fit() train batch wall time").observe(
+                (time.perf_counter() - self._t0) * 1e3)
+            self._t0 = None
+        loss = (logs or {}).get("loss")
+        if loss is not None:
+            reg.gauge("hapi_train_loss").set(float(loss))
+
+    def on_epoch_end(self, epoch, logs=None):
+        from .. import telemetry
+
+        telemetry.get_registry().counter("hapi_epochs_total").inc()
+        rec = {"kind": "train_epoch", "epoch": int(epoch)}
+        for k, v in (logs or {}).items():
+            if v is not None:
+                try:
+                    rec[k] = float(v)
+                except (TypeError, ValueError):
+                    pass
+        telemetry.emit(rec)
+        return False
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="val_loss", patience=3, min_delta=0.0,
                  mode="min"):

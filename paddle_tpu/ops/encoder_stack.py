@@ -344,7 +344,9 @@ def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer, ring=False):
     ring=True additionally shards the SEQUENCE dim over "sp" (hidden and
     the per-key bias); the layer body then runs ring attention inside
     this shard_map (pp x sp composition for long-context pipelines)."""
-    from jax import lax, shard_map
+    from jax import lax
+
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     npp = mesh.shape["pp"]
@@ -417,12 +419,12 @@ def _gpipe_stack(hidden, stacked, bias, mesh, M, make_layer, ring=False):
 
         return shard_map(
             body_nobias, mesh=mesh, in_specs=(hid_spec,) + p_specs,
-            out_specs=hid_spec, check_vma=False,
+            out_specs=hid_spec, check=False,
         )(hidden, *[stacked[k] for k in keys])
 
     return shard_map(
         body, mesh=mesh, in_specs=(hid_spec, bias_spec) + p_specs,
-        out_specs=hid_spec, check_vma=False,
+        out_specs=hid_spec, check=False,
     )(hidden, bias, *[stacked[k] for k in keys])
 
 

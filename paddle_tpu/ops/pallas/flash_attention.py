@@ -49,6 +49,8 @@ import math
 
 import jax
 import jax.numpy as jnp
+
+from ... import compat as _compat
 from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -1120,7 +1122,7 @@ def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
     if not axes:
         return _flash_local(q, k, v, bias, mask, seed, **kwargs)
 
-    from jax import shard_map
+    from ...compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ba = batch_axis if batch_axis in axes else None
@@ -1156,7 +1158,7 @@ def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
 
     in_specs = (qspec, qspec, qspec, bias_spec, mask_spec, P() if seed is not None else None)
     return shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=qspec, check_vma=False,
+        body, mesh=mesh, in_specs=in_specs, out_specs=qspec, check=False,
     )(q, k, v, bias, mask, seed)
 
 
@@ -1460,7 +1462,7 @@ def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
             jax.ShapeDtypeStruct((b, sq, hdim), q.dtype),
             jax.ShapeDtypeStruct((b, nh, sq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.tpu_compiler_params(
             vmem_limit_bytes=_BSH_VMEM_LIMIT),
         interpret=_interpret(),
     )(*args)
@@ -1658,7 +1660,7 @@ def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
             jax.ShapeDtypeStruct((b, skv, hdim), k.dtype),
             jax.ShapeDtypeStruct((b, skv, hdim), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.tpu_compiler_params(
             vmem_limit_bytes=_BSH_VMEM_LIMIT),
         interpret=_interpret(),
     )(*args)
@@ -1794,7 +1796,7 @@ def flash_attention_bsh(q, k, v, bias=None, num_heads=None, sm_scale=None,
     if not axes:
         return local(q, k, v, bias, mask, seed, num_heads)
 
-    from jax import shard_map
+    from ...compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ba = batch_axis if batch_axis in axes else None
@@ -1821,7 +1823,7 @@ def flash_attention_bsh(q, k, v, bias=None, num_heads=None, sm_scale=None,
                 P() if seed is not None else None)
     return shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=qspec,
-        check_vma=False,
+        check=False,
     )(q, k, v, bias, mask, seed)
 
 

@@ -50,6 +50,11 @@ def init_parallel_env() -> None:
     from ..distributed.heartbeat import start_heartbeat
 
     start_heartbeat()
+    # per-rank timeline collection for the launcher's merged trace
+    # (no-op unless the launcher set PADDLE_TRACE_DIR via --trace_dir)
+    from ..fluid.profiler import maybe_start_trace_collection
+
+    maybe_start_trace_collection()
     world = get_world_size()
     if world > 1:
         import jax

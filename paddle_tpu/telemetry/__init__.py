@@ -1,0 +1,41 @@
+"""Unified telemetry (ISSUE 4): metrics registry + JSONL sink +
+distributed timeline + straggler detection.
+
+Layering:
+
+  telemetry.registry   process-wide counters/gauges/histograms with a
+                       Prometheus text exposition (scrape or dump)
+  telemetry.sink       per-step JSONL records (PADDLE_METRICS_PATH)
+  telemetry.timeline   merge per-rank chrome traces (launcher)
+  telemetry.straggler  per-rank step-rate comparison (launcher)
+  fluid/monitor.py     the executor-facing step-time breakdown built on
+                       the registry + sink
+
+Everything here is dependency-free (stdlib only) so the pserver and
+launcher processes can import it without pulling jax.
+"""
+from __future__ import annotations
+
+from . import sink, straggler, timeline  # noqa: F401
+from .registry import (  # noqa: F401
+    BYTE_BUCKETS,
+    DEFAULT_MS_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from .sink import emit, enabled  # noqa: F401
+
+
+def to_prometheus() -> str:
+    """One-call text exposition of the process registry (serve it from
+    any HTTP handler, or dump to a file for node-exporter's textfile
+    collector)."""
+    return get_registry().to_prometheus()
+
+
+def snapshot() -> dict:
+    """JSON-ready dump of the process registry."""
+    return get_registry().snapshot()

@@ -1,0 +1,134 @@
+"""Per-step JSONL sink (PADDLE_METRICS_PATH).
+
+One JSON object per line, append-only, flushed per record so a killed
+process loses at most the in-flight line. Schema contract (stable —
+tools and tests parse it):
+
+  every record    {"kind": str, "ts": float unix seconds, "rank": int}
+  kind="step"     step-time breakdown from fluid/monitor.py:
+                  {"step": int monotone per process, "data_wait_ms",
+                   "compile_ms", "device_ms", "fetch_ms", "ckpt_save_ms",
+                   "cache_hit": bool, "retraces": int cumulative,
+                   "peak_hbm_bytes": int}
+  kind="bench"    one bench.py result row (same keys as its stdout JSON)
+  kind="train_epoch"  hapi MetricsLogger epoch summary
+
+The sink is OFF (every emit a no-op costing one attribute read) unless
+PADDLE_METRICS_PATH is set or enable(path) is called — the flag-off hot
+path does no I/O and allocates nothing.
+
+A `%r`/`{rank}` placeholder in the path expands to the trainer rank so
+launched jobs don't interleave writers; otherwise a rank suffix is
+appended automatically when PADDLE_TRAINER_ID > 0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import IO, Optional
+
+ENV_PATH = "PADDLE_METRICS_PATH"
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    except ValueError:
+        return 0
+
+
+def _expand(path: str, rank: int) -> str:
+    if "{rank}" in path:
+        return path.replace("{rank}", str(rank))
+    if "%r" in path:
+        return path.replace("%r", str(rank))
+    if rank:
+        root, ext = os.path.splitext(path)
+        return f"{root}.rank{rank}{ext or '.jsonl'}"
+    return path
+
+
+class JsonlSink:
+    def __init__(self, path: str):
+        self.rank = _rank()
+        self.path = _expand(path, self.rank)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f: Optional[IO] = None
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict) -> None:
+        rec = dict(record)
+        rec.setdefault("ts", round(time.time(), 6))
+        rec.setdefault("rank", self.rank)
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            if self._f is None:
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def _json_default(v):
+    """numpy / jax scalars slip into records from fetch lists."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+_sink: Optional[JsonlSink] = None
+_resolved = False
+_lock = threading.Lock()
+
+
+def active_sink() -> Optional[JsonlSink]:
+    """The process sink, or None when telemetry output is off. Resolved
+    once from PADDLE_METRICS_PATH; enable()/disable() override."""
+    global _sink, _resolved
+    if _resolved:
+        return _sink
+    with _lock:
+        if not _resolved:
+            path = os.environ.get(ENV_PATH)
+            _sink = JsonlSink(path) if path else None
+            _resolved = True
+    return _sink
+
+
+def enabled() -> bool:
+    return active_sink() is not None
+
+
+def enable(path: str) -> JsonlSink:
+    global _sink, _resolved
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = JsonlSink(path)
+        _resolved = True
+    return _sink
+
+
+def disable() -> None:
+    global _sink, _resolved
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = None
+        _resolved = True
+
+
+def emit(record: dict) -> None:
+    """Write one record if the sink is on; free no-op otherwise."""
+    s = active_sink()
+    if s is not None:
+        s.emit(record)

@@ -1,0 +1,107 @@
+"""Straggler detection from per-rank step-rate samples.
+
+The heartbeat channel (distributed/heartbeat.py) carries each rank's
+(monotone step count, timestamp) in its stamp; the launcher feeds those
+samples into a StragglerDetector, which derives each rank's recent step
+time from consecutive samples and flags any rank whose step time
+exceeds `factor` x the median across ranks — the EQuARX-style locate-
+the-slow-participant primitive, host-side so it also catches input
+stalls and background-process interference that device profiles miss.
+
+Detection is windowed and hysteretic: a rank is reported once per
+continuous straggling episode (re-armed when it returns under the
+threshold), so the launcher log carries one structured `straggler`
+event per incident, not one per poll tick.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+DEFAULT_FACTOR = float(os.environ.get("PADDLE_STRAGGLER_FACTOR", 3.0) or 3.0)
+MIN_STEPS = int(os.environ.get("PADDLE_STRAGGLER_MIN_STEPS", 3) or 3)
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class StragglerDetector:
+    """Feed (rank, step, t) samples via observe(); events() drains the
+    structured straggler events detected since the last call.
+
+    factor      step-time multiple of the cross-rank median that flags
+                a rank (PADDLE_STRAGGLER_FACTOR, default 3.0)
+    min_steps   samples ignored until a rank has advanced this many
+                steps (compile warmup would otherwise always flag)
+    """
+
+    def __init__(self, factor: float = DEFAULT_FACTOR,
+                 min_steps: int = MIN_STEPS):
+        self.factor = float(factor)
+        self.min_steps = int(min_steps)
+        # rank -> (last_step, last_t, step_time_s or None)
+        self._state: Dict[object, tuple] = {}
+        self._flagged: Dict[object, bool] = {}
+        self._events: List[dict] = []
+
+    def observe(self, rank, step: int, t: float) -> None:
+        last = self._state.get(rank)
+        if last is None or step < last[0]:  # first sample / restarted rank
+            self._state[rank] = (step, t, None)
+            self._flagged.pop(rank, None)
+            return
+        last_step, last_t, step_time = last
+        if step == last_step:
+            # no progress: stretch the implied step time as time passes,
+            # so a fully wedged rank keeps growing instead of freezing
+            # at its last healthy value
+            if step_time is not None and t > last_t:
+                implied = step_time + (t - last_t)
+                self._state[rank] = (last_step, last_t, step_time)
+                self._check(rank, implied, step)
+            return
+        dt = (t - last_t) / (step - last_step)
+        self._state[rank] = (step, t, dt)
+        if step >= self.min_steps:
+            self._check(rank, dt, step)
+
+    def _step_times(self) -> Dict[object, float]:
+        return {r: st for r, (_s, _t, st) in self._state.items()
+                if st is not None}
+
+    def _check(self, rank, step_time: float, step: int) -> None:
+        times = self._step_times()
+        times[rank] = step_time
+        if len(times) < 2:
+            return  # no peers to compare against
+        others = [v for r, v in times.items() if r != rank]
+        med = _median(others)
+        if med <= 0:
+            return
+        if step_time > self.factor * med:
+            if not self._flagged.get(rank):
+                self._flagged[rank] = True
+                self._events.append({
+                    "event": "straggler",
+                    "rank": rank,
+                    "step": int(step),
+                    "step_time_ms": round(step_time * 1e3, 3),
+                    "median_step_time_ms": round(med * 1e3, 3),
+                    "slowdown": round(step_time / med, 2),
+                    "factor": self.factor,
+                })
+        else:
+            self._flagged[rank] = False  # episode over: re-arm
+
+    def events(self) -> List[dict]:
+        out, self._events = self._events, []
+        return out
+
+
+def format_event(ev: dict) -> str:
+    """One structured log line (grep '\"event\": \"straggler\"')."""
+    return f"[telemetry] {json.dumps(ev, sort_keys=True)}"

@@ -1,0 +1,84 @@
+"""Distributed timeline: merge per-rank chrome traces into one file.
+
+Each launched trainer writes its own chrome-trace JSON (fluid/profiler:
+stop_profiler or export_chrome_trace, auto-dumped when the launcher
+sets PADDLE_TRACE_DIR). This module merges them into a single trace the
+way tools/timeline.py did for the reference's per-trainer profiles:
+rank r's events land under pid = r * PID_STRIDE + original_pid, with a
+process_name metadata row naming the rank, so Perfetto shows one
+swimlane group per rank (host track + device tracks side by side).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+# per-rank pid namespace: profiler.py uses pid 0 for host and 1+ per
+# device plane, far below this stride
+PID_STRIDE = 100
+
+TRACE_NAME_RE = re.compile(r"trace\.(?P<rank>\w+)\.json$")
+
+
+def rank_trace_path(directory: str, rank) -> str:
+    return os.path.join(directory, f"trace.{rank}.json")
+
+
+def find_rank_traces(directory: str) -> Dict[str, str]:
+    """{rank: path} for every per-rank trace in `directory`."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(directory, "trace.*.json"))):
+        m = TRACE_NAME_RE.search(os.path.basename(p))
+        if m:
+            out[m.group("rank")] = p
+    return out
+
+
+def merge_traces(directory: str, out_path: Optional[str] = None) -> Optional[str]:
+    """Merge `<directory>/trace.<rank>.json` files into
+    `<directory>/timeline.json` (or `out_path`). Returns the output path,
+    or None when no per-rank traces exist. Unreadable files are skipped
+    with a warning line rather than failing the merge — a crashed rank
+    must not cost the surviving ranks' timeline."""
+    traces = find_rank_traces(directory)
+    if not traces:
+        return None
+    out_path = out_path or os.path.join(directory, "timeline.json")
+    merged: List[dict] = []
+    for rank, path in traces.items():
+        try:
+            with open(path) as f:
+                events = json.load(f).get("traceEvents", [])
+        except (OSError, ValueError) as e:
+            print(f"[telemetry] skipping unreadable trace {path}: {e}")
+            continue
+        try:
+            base = int(rank) * PID_STRIDE
+            label = f"rank {rank}"
+        except ValueError:  # string tags (ps0) ride above the trainers
+            base = (10_000 + abs(hash(rank)) % 1000) * PID_STRIDE
+            label = str(rank)
+        seen_pids = set()
+        for ev in events:
+            ev = dict(ev)
+            pid = int(ev.get("pid", 0))
+            ev["pid"] = base + pid
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                # prefix the rank so Perfetto's process list reads
+                # "rank 1 / device: TPU:0"
+                args = dict(ev.get("args", {}))
+                args["name"] = f"{label} / {args.get('name', '')}".rstrip(" /")
+                ev["args"] = args
+                seen_pids.add(pid)
+            merged.append(ev)
+        if 0 not in seen_pids:  # host pid had no metadata row
+            merged.append({"name": "process_name", "ph": "M", "pid": base,
+                           "args": {"name": f"{label} / host"}})
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, out_path)
+    return out_path

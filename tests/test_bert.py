@@ -74,6 +74,7 @@ def test_bert_flash_and_reference_agree():
     np.testing.assert_allclose(results[False], results[True], rtol=1e-4)
 
 
+@pytest.mark.slow  # 40s numerical-identity property; slow lane keeps tier-1 wall time flat
 def test_remat_ffn_is_numerically_identity():
     """jax.checkpoint on the FFN must not change the math: same seeds,
     same loss trajectory with and without remat_ffn."""

@@ -1,5 +1,6 @@
 """Launcher (reference distributed/launch.py + utils.watch_local_trainers):
 spawn with the env protocol, collect, abort-all on child failure."""
+import json
 import os
 import subprocess
 import sys
@@ -201,3 +202,88 @@ def test_launch_heartbeat_ignores_clean_exit_and_stale_leftovers(tmp_path):
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=60)
     assert r.returncode == 0, (r.returncode, r.stderr)
+
+
+def test_launch_straggler_drill_logs_structured_event(tmp_path):
+    """Telemetry (ISSUE 4): a deliberately slow rank must produce one
+    structured `straggler` JSON event in the launcher log — step rates
+    ride the heartbeat stamps (fluid/monitor.py publishes them; here the
+    worker fakes the provider so the drill needs no jax import) and the
+    job is NOT killed (diagnosis, not enforcement)."""
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import json, os, sys, time
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.distributed import heartbeat
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        step = [0]
+        heartbeat.set_step_provider(lambda: (step[0], None))
+        hb = heartbeat.start_heartbeat(interval=0.1)
+        per_step = 0.02 if rank == 0 else 0.25  # rank 1 drags >10x
+        for _ in range(24):
+            time.sleep(per_step)
+            step[0] += 1
+        time.sleep(0.3)  # one more beat with the final count
+        hb.stop()
+        """
+    ))
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node", "2", "--straggler_factor", "3.0",
+        str(script),
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO, REPO=REPO,
+               PADDLE_HEARTBEAT_DIR=str(hb_dir))
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    events = []
+    for line in r.stderr.splitlines():
+        if line.startswith("[telemetry] "):
+            events.append(json.loads(line[len("[telemetry] "):]))
+    stragglers = [e for e in events if e.get("event") == "straggler"]
+    assert stragglers, r.stderr
+    assert all(str(e["rank"]) == "1" for e in stragglers)
+    ev = stragglers[0]
+    assert ev["step_time_ms"] > 3 * ev["median_step_time_ms"]
+
+
+def test_launch_trace_dir_merges_per_rank_timeline(tmp_path):
+    """--trace_dir: each rank auto-dumps its host-span chrome trace
+    (PADDLE_TRACE_DIR contract) and the launcher merges them into one
+    timeline.json with per-rank pids."""
+    trace_dir = tmp_path / "traces"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import os, sys, time
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.fluid import profiler
+        assert profiler.maybe_start_trace_collection()
+        with profiler.RecordEvent("unit_of_work"):
+            time.sleep(0.05)
+        """
+    ))
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node", "2", "--trace_dir", str(trace_dir),
+        str(script),
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO, REPO=REPO)
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "merged timeline" in r.stderr
+    merged = trace_dir / "timeline.json"
+    assert merged.exists()
+    evs = json.load(open(merged))["traceEvents"]
+    spans = [e for e in evs if e["name"] == "unit_of_work"]
+    # one span per rank, under per-rank pid namespaces
+    assert {e["pid"] // 100 for e in spans} == {0, 1}
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any(n.startswith("rank 0") for n in names)
+    assert any(n.startswith("rank 1") for n in names)

@@ -88,6 +88,7 @@ def test_gpipe_gradients_flow():
         assert (gn > 0).all(), f"zero grad for some stage layers of {k}: {gn}"
 
 
+@pytest.mark.slow  # heavy end-to-end parity; gpipe unit tests cover tier-1
 def test_pipeline_fleet_training_matches_dp():
     """BERT-tiny (fused stack) trained with dp2 x pp4 pipeline == dp-only."""
     from paddle_tpu.models.bert import (

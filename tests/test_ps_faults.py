@@ -211,6 +211,66 @@ def test_geo_delta_replay_dedups(server, inject):
     remote.close()
 
 
+def test_stats_verb_reports_retry_counts_matching_drop_spec(server, inject):
+    """Telemetry (ISSUE 4): the idempotent `stats` verb must account for
+    exactly the faults the injected spec produced — 2 dropped push RPCs
+    mean 2 client retries, 2 retry-marked arrivals and 2 replay-dedup
+    hits server-side, and per-verb latency histograms that saw every
+    RPC. In-thread server: client and server share the process registry,
+    so counters are asserted as deltas."""
+    from paddle_tpu import telemetry
+
+    reg = telemetry.get_registry()
+
+    def val(name, verb="push_gradients"):
+        return reg.counter(name, verb=verb).value
+
+    before = {n: val(n) for n in (
+        "ps_client_retries_total", "ps_server_retry_received_total",
+        "ps_server_replay_dedup_total", "ps_client_rpc_total",
+        "ps_server_rpc_total")}
+    kw = dict(num_shards=2, optimizer="sgd", learning_rate=0.2, seed=5)
+    remote = ps_server.RemoteTable("f_stats", (100, 4), [server], **kw)
+    inject("drop:push_gradients:2;drop:push_gradients:4")
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        ids = rng.randint(0, 100, (10,)).astype(np.int64)
+        remote.push_gradients(ids, rng.randn(10, 4).astype(np.float32))
+    st = remote.stats()
+    # table-level traffic: apply-once despite the two drops
+    assert st["push_calls"] == 5
+    # client side: one retry attempt per dropped RPC, successes count 5
+    assert val("ps_client_retries_total") - before[
+        "ps_client_retries_total"] == 2
+    assert val("ps_client_rpc_total") - before["ps_client_rpc_total"] == 5
+    # server side, via the stats verb payload: both replays arrived
+    # marked and were deduped (the first sends had landed)
+    (tele,) = st["servers"]
+
+    def server_val(name, verb="push_gradients"):
+        for row in tele.get(name, {}).get("series", []):
+            if row["labels"].get("verb") == verb:
+                return row["value"]
+        return 0
+
+    assert server_val("ps_server_retry_received_total") - _srv_before(
+        before, "ps_server_retry_received_total") == 2
+    assert server_val("ps_server_replay_dedup_total") - _srv_before(
+        before, "ps_server_replay_dedup_total") == 2
+    # the server handled 5 first sends + 2 replays of push_gradients
+    assert server_val("ps_server_rpc_total") - _srv_before(
+        before, "ps_server_rpc_total") == 7
+    # latency histograms exist for the verbs that ran
+    lat = tele.get("ps_server_rpc_ms", {}).get("series", [])
+    assert any(r["labels"].get("verb") == "push_gradients" and r["count"]
+               for r in lat)
+    remote.close()
+
+
+def _srv_before(before, name):
+    return before[name]
+
+
 def test_retry_exhaustion_raises_connection_error(monkeypatch):
     monkeypatch.setattr(ps_server, "RPC_MAX_RETRIES", 2)
     monkeypatch.setattr(ps_server, "RPC_BACKOFF_BASE", 0.01)

@@ -35,8 +35,13 @@ def _rand(shape, seed):
     return jnp.asarray(np.random.RandomState(seed).randn(*shape).astype(np.float32))
 
 
-@pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("with_bias", [False, True])
+# tier-1 keeps one representative (False, False) of the jnp-oracle grid;
+# the remaining parametrizations ride the slow lane (tools/ci.sh) so the
+# 'not slow' suite stays inside its wall-clock budget
+@pytest.mark.parametrize("causal", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
+@pytest.mark.parametrize("with_bias", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_ring_matches_full_attention(causal, with_bias):
     mesh = create_mesh({"sp": 8})
     b, nh, s, d = 2, 4, 64, 16
@@ -209,6 +214,7 @@ def test_ring_flash_path_matches_jnp_ring():
     np.testing.assert_allclose(g_flash, g_jnp, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # heavy 8-shard oracle; non-causal flash-path test covers tier-1
 def test_ring_flash_path_causal_matches_jnp_ring():
     """VERDICT r2 weak #6: causal masking must run ON the kernel path
     (offset-causal blocks), not fall back to jnp — and match it."""

@@ -307,6 +307,7 @@ def test_recommender_system_trains():
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
+@pytest.mark.slow  # 19s breadth sweep; fused/decoder tests keep tier-1 coverage
 def test_transformer_model_family_trains():
     """models/transformer.py (Transformer-base NMT, BASELINE config):
     tiny config trains, causal decoder masks the future."""
@@ -468,6 +469,7 @@ def test_label_smooth_loss_analytic_matches_onehot():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # heavy SP parity; ring/gpipe SP tests cover tier-1
 def test_transformer_fused_decoder_sequence_parallel_parity():
     """Fused encoder+decoder stacks under dp2 x sp4 sequence parallelism
     (causal self-attention over the ring, cross-attention k/v gathered by

@@ -1,0 +1,428 @@
+"""Unified telemetry (ISSUE 4): registry semantics, JSONL schema,
+Prometheus exposition, executor step breakdown + cache/retrace
+counters, straggler detection, timeline merge, heartbeat step payload,
+hapi MetricsLogger — the observability layer's unit surface.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import telemetry
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid import monitor as monitor_mod
+from paddle_tpu.telemetry import sink as sink_mod
+from paddle_tpu.telemetry.registry import MetricsRegistry
+from paddle_tpu.telemetry.straggler import StragglerDetector
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c", help="a counter")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("c").value == 5  # get-or-create returns the same
+    g = reg.gauge("g")
+    g.set(2.5)
+    g.inc()
+    g.dec(0.5)
+    assert reg.gauge("g").value == 3.0
+
+
+def test_metric_type_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_labeled_series_are_independent():
+    reg = MetricsRegistry()
+    reg.counter("rpc_total", verb="gather").inc(3)
+    reg.counter("rpc_total", verb="push").inc(1)
+    snap = reg.snapshot()["rpc_total"]
+    by_verb = {tuple(r["labels"].items()): r["value"]
+               for r in snap["series"]}
+    assert by_verb[(("verb", "gather"),)] == 3
+    assert by_verb[(("verb", "push"),)] == 1
+
+
+def test_histogram_semantics_and_quantile():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", buckets=(1, 10, 100))
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4 and s["min"] == 0.5 and s["max"] == 500
+    assert s["sum"] == pytest.approx(555.5)
+    # counts land in the right (non-cumulative) buckets incl. overflow
+    assert h.counts == [1, 1, 1, 1]
+    assert h.quantile(0.25) == 1  # first bucket boundary
+    assert h.quantile(1.0) == 500  # overflow reports the observed max
+
+
+def test_unsorted_buckets_rejected():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("bad", buckets=(10, 1))
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("steps_total", help="steps").inc(2)
+    reg.gauge("hbm_bytes").set(7)
+    h = reg.histogram("ms", buckets=(1, 10), verb="run")
+    h.observe(0.5)
+    h.observe(99)
+    text = reg.to_prometheus()
+    assert "# HELP steps_total steps" in text
+    assert "# TYPE steps_total counter" in text
+    assert "steps_total 2" in text
+    assert "hbm_bytes 7.0" in text
+    # histogram: cumulative le buckets + +Inf + sum/count
+    assert 'ms_bucket{verb="run",le="1"} 1' in text
+    assert 'ms_bucket{verb="run",le="10"} 1' in text
+    assert 'ms_bucket{verb="run",le="+Inf"} 2' in text
+    assert 'ms_sum{verb="run"} 99.5' in text
+    assert 'ms_count{verb="run"} 2' in text
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink + executor step breakdown
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def jsonl(tmp_path):
+    """Arm the process sink at a temp path; restore 'off' afterwards."""
+    path = str(tmp_path / "metrics.jsonl")
+    sink_mod.enable(path)
+    yield path
+    sink_mod.disable()
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _tiny_step(steps=3, batch=8):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [batch, 4], append_batch_size=False)
+        y = layers.data("y", [batch, 1], append_batch_size=False)
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        xa = np.random.RandomState(0).rand(batch, 4).astype(np.float32)
+        ya = xa.sum(1, keepdims=True).astype(np.float32)
+        for _ in range(steps):
+            exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[loss])
+    return exe
+
+
+STEP_KEYS = {"kind", "step", "ts", "rank", "data_wait_ms", "compile_ms",
+             "device_ms", "fetch_ms", "ckpt_save_ms", "cache_hit",
+             "fenced", "retraces", "peak_hbm_bytes"}
+
+
+def test_step_records_schema_and_monotone(jsonl):
+    _tiny_step(steps=3)
+    recs = [r for r in _records(jsonl) if r["kind"] == "step"]
+    # startup + 3 train steps
+    assert len(recs) == 4
+    for r in recs:
+        assert set(r) == STEP_KEYS  # schema contract (README documents it)
+        assert r["data_wait_ms"] >= 0 and r["device_ms"] >= 0
+    steps = [r["step"] for r in recs]
+    assert steps == sorted(steps) and len(set(steps)) == len(steps)
+    # first main-program run compiles; the rest hit the cache
+    assert recs[1]["cache_hit"] is False and recs[1]["compile_ms"] > 0
+    assert recs[2]["cache_hit"] is True and recs[2]["compile_ms"] == 0
+
+
+def test_cache_hit_and_retrace_counters_across_shape_change(jsonl):
+    reg = telemetry.get_registry()
+
+    def val(name):
+        return reg.counter(name).value
+
+    hits0, miss0, retr0 = (val("executor_cache_hits_total"),
+                           val("executor_cache_misses_total"),
+                           val("executor_retraces_total"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], append_batch_size=False)
+        loss = layers.mean(layers.fc(x, 1))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        for _ in range(3):  # one miss + two hits
+            exe.run(main, feed={"x": np.zeros((8, 4), "f4")},
+                    fetch_list=[loss])
+        # shape change: same program recompiles -> a RETRACE, not a
+        # plain first-compile
+        exe.run(main, feed={"x": np.zeros((16, 4), "f4")},
+                fetch_list=[loss])
+        exe.run(main, feed={"x": np.zeros((16, 4), "f4")},
+                fetch_list=[loss])
+    assert val("executor_cache_hits_total") - hits0 == 3
+    # startup compile + first main compile + retrace
+    assert val("executor_cache_misses_total") - miss0 == 3
+    assert val("executor_retraces_total") - retr0 == 1
+    recs = [r for r in _records(jsonl) if r["kind"] == "step"]
+    assert recs[-3]["cache_hit"] and not recs[-2]["cache_hit"]
+    assert recs[-2]["retraces"] == recs[-3]["retraces"] + 1
+
+
+def test_flag_off_no_sink_io(tmp_path):
+    """With the sink off, a step emits nothing and opens no file."""
+    sink_mod.disable()
+    assert not monitor_mod.enabled()
+    _tiny_step(steps=1)
+    assert sink_mod.active_sink() is None
+
+
+def test_benchmark_flag_fences_device_time(jsonl):
+    fluid.set_flags({"FLAGS_benchmark": True})
+    try:
+        _tiny_step(steps=2)
+    finally:
+        fluid.set_flags({"FLAGS_benchmark": False})
+    recs = [r for r in _records(jsonl) if r["kind"] == "step"]
+    assert all(r["fenced"] for r in recs)
+
+
+def test_checkpoint_save_duration_lands_in_next_record(jsonl, tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 4], append_batch_size=False)
+        loss = layers.mean(layers.fc(x, 1))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.zeros((4, 4), "f4")}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        mgr = fluid.CheckpointManager(str(tmp_path / "ck"), program=main,
+                                      scope=scope)
+        mgr.save(1)
+        exe.run(main, feed=feed, fetch_list=[loss])
+    recs = [r for r in _records(jsonl) if r["kind"] == "step"]
+    assert recs[-1]["ckpt_save_ms"] > 0
+    assert all(r["ckpt_save_ms"] == 0 for r in recs[:-1])
+
+
+def test_timed_iter_attributes_data_wait(jsonl):
+    import time as _t
+
+    def gen():
+        for i in range(2):
+            _t.sleep(0.05)  # slow input pipeline
+            yield i
+
+    consumed = list(monitor_mod.timed_iter(gen()))
+    assert consumed == [0, 1]
+    _tiny_step(steps=1)
+    recs = [r for r in _records(jsonl) if r["kind"] == "step"]
+    # the accumulated iterator wait lands on the next committed step
+    assert recs[0]["data_wait_ms"] >= 90
+
+
+def test_rank_suffix_when_launched(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    s = sink_mod.JsonlSink(str(tmp_path / "m.jsonl"))
+    s.emit({"kind": "step"})
+    s.close()
+    assert os.path.exists(tmp_path / "m.rank2.jsonl")
+    (rec,) = _records(str(tmp_path / "m.rank2.jsonl"))
+    assert rec["rank"] == 2
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_flagged_once_and_rearmed():
+    det = StragglerDetector(factor=3.0, min_steps=2)
+    t = 0.0
+    # ranks 0/1 run 1 step/s; rank 2 runs 1 step per 10s
+    for i in range(1, 6):
+        det.observe(0, i, float(i))
+        det.observe(1, i, float(i))
+        det.observe(2, i, float(i) * 10)
+    evs = det.events()
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["event"] == "straggler" and ev["rank"] == 2
+    assert ev["slowdown"] >= 3
+    assert ev["median_step_time_ms"] == pytest.approx(1000, rel=0.01)
+    # still slow: the episode is open, no duplicate event
+    det.observe(2, 6, 70.0)
+    assert det.events() == []
+    # recovery re-arms, a later slowdown raises a NEW event
+    for i in range(7, 12):
+        det.observe(0, i + 5, float(i))
+        det.observe(1, i + 5, float(i))
+        det.observe(2, i, 60.0 + (i - 6) * 1.0)
+    assert not det._flagged.get(2, False)
+    t0 = 80.0
+    det.observe(2, 12, t0 + 30)  # slow again
+    assert [e["rank"] for e in det.events()] == [2]
+
+
+def test_straggler_ignores_warmup_and_single_rank():
+    det = StragglerDetector(factor=2.0, min_steps=5)
+    det.observe(0, 1, 1.0)
+    det.observe(0, 2, 100.0)  # huge "step time" but under min_steps
+    assert det.events() == []
+    det2 = StragglerDetector(factor=2.0, min_steps=1)
+    for i in range(1, 5):
+        det2.observe(0, i, float(i))  # no peers -> never flagged
+    assert det2.events() == []
+
+
+def test_straggler_monitor_reads_heartbeat_stamps(tmp_path):
+    from paddle_tpu.distributed.heartbeat import StragglerMonitor
+
+    def stamp(rank, step, t):
+        with open(tmp_path / f"heartbeat.{rank}", "w") as f:
+            f.write(json.dumps({"t": t, "step": step}))
+
+    mon = StragglerMonitor(str(tmp_path), [0, 1, 2], factor=3.0,
+                           min_steps=2)
+    for i in range(1, 6):
+        stamp(0, i, float(i))
+        stamp(1, i, float(i))
+        stamp(2, i, float(i) * 8)
+        evs = mon.poll()
+        if evs:
+            break
+    assert evs and evs[0]["rank"] == 2
+
+
+def test_heartbeat_stamp_carries_step_provider(tmp_path):
+    from paddle_tpu.distributed import heartbeat
+
+    hb = heartbeat.HeartBeatWorker(str(tmp_path), 0)
+    old = heartbeat._step_provider
+    heartbeat.set_step_provider(lambda: (17, 0.25))
+    try:
+        hb._beat()
+    finally:
+        heartbeat._step_provider = old
+    stamp = heartbeat.read_stamp(str(tmp_path), 0)
+    assert stamp["step"] == 17 and stamp["avg_step_s"] == 0.25
+    assert stamp["t"] > 0
+
+
+def test_read_stamp_accepts_legacy_float(tmp_path):
+    from paddle_tpu.distributed import heartbeat
+
+    with open(tmp_path / "heartbeat.3", "w") as f:
+        f.write(repr(1234.5))
+    assert heartbeat.read_stamp(str(tmp_path), 3) == {"t": 1234.5}
+
+
+# ---------------------------------------------------------------------------
+# timeline merge + profiler snapshot export
+# ---------------------------------------------------------------------------
+
+
+def test_merge_traces_remaps_pids(tmp_path):
+    from paddle_tpu.telemetry import timeline
+
+    for rank in (0, 1):
+        with open(tmp_path / f"trace.{rank}.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 0,
+                 "args": {"name": "host (python)"}},
+                {"name": "Executor::run", "ph": "X", "pid": 0, "tid": 1,
+                 "ts": 0.0, "dur": 5.0},
+                {"name": "step", "ph": "X", "pid": 1, "tid": 0,
+                 "ts": 1.0, "dur": 2.0},
+            ]}, f)
+    out = timeline.merge_traces(str(tmp_path))
+    assert out == str(tmp_path / "timeline.json")
+    evs = json.load(open(out))["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    # rank 0 keeps pids 0/1; rank 1 shifts by the stride
+    assert {0, 1, 100, 101} <= pids
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any(n.startswith("rank 0") for n in names)
+    assert any(n.startswith("rank 1") for n in names)
+
+
+def test_merge_traces_empty_dir(tmp_path):
+    from paddle_tpu.telemetry import timeline
+
+    assert timeline.merge_traces(str(tmp_path)) is None
+
+
+def test_export_chrome_trace_is_snapshot(tmp_path):
+    from paddle_tpu.fluid import profiler
+
+    path = str(tmp_path / "snap")
+    profiler.start_profiler(state="CPU")
+    try:
+        with profiler.RecordEvent("span_a"):
+            pass
+        out = profiler.export_chrome_trace(path)
+        # STILL enabled (snapshot semantics): more spans keep recording
+        assert profiler.is_profiler_enabled()
+        with profiler.RecordEvent("span_b"):
+            pass
+        names1 = {e["name"] for e in
+                  json.load(open(out))["traceEvents"]}
+        assert "span_a" in names1 and "span_b" not in names1
+        out2 = profiler.export_chrome_trace(path)
+        names2 = {e["name"] for e in
+                  json.load(open(out2))["traceEvents"]}
+        assert {"span_a", "span_b"} <= names2
+    finally:
+        profiler.stop_profiler(profile_path=str(tmp_path / "final"))
+
+
+# ---------------------------------------------------------------------------
+# hapi MetricsLogger + prometheus one-call
+# ---------------------------------------------------------------------------
+
+
+def test_hapi_fit_emits_through_registry(jsonl):
+    from paddle_tpu import hapi
+
+    reg = telemetry.get_registry()
+    batches0 = reg.counter("hapi_train_batches_total").value
+    model = hapi.Model(lambda x: layers.fc(x, 1),
+                       hapi.Input("x", [8, 4]), hapi.Input("y", [8, 1]))
+    model.prepare(
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01),
+        lambda p, l: layers.mean(layers.square_error_cost(p, l)),
+    )
+    xa = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    ya = xa.sum(1, keepdims=True).astype(np.float32)
+    model.fit([xa, ya], batch_size=8, epochs=2, verbose=0)
+    assert reg.counter("hapi_train_batches_total").value - batches0 == 4
+    assert reg.gauge("hapi_train_loss").value > 0
+    epochs = [r for r in _records(jsonl) if r["kind"] == "train_epoch"]
+    assert [r["epoch"] for r in epochs] == [0, 1]
+    assert all("loss" in r for r in epochs)
+
+
+def test_to_prometheus_one_call():
+    text = telemetry.to_prometheus()
+    # the executor counters from earlier tests are exposed
+    assert "# TYPE executor_steps_total counter" in text
