@@ -76,6 +76,7 @@ import numpy as np
 from . import faults
 from .ps import ShardedHostTable
 from ..telemetry import BYTE_BUCKETS, get_registry
+from ..telemetry import sink as _metrics_sink
 
 _LEN = struct.Struct(">Q")
 
@@ -83,6 +84,32 @@ _LEN = struct.Struct(">Q")
 # side series use disjoint name prefixes (ps_client_* / ps_server_*) so
 # in-process test servers sharing the registry stay distinguishable
 _REG = get_registry()
+
+def _arm_metrics_sink() -> None:
+    """Pserver-side JSONL records on the SAME env var trainers use
+    (PADDLE_METRICS_PATH, ROADMAP telemetry follow-on): the path gets a
+    per-process `ps` tag (launch.py's PADDLE_PS_RANK_TAG, pid fallback)
+    so a co-located trainer's rank-0 file is never interleaved. Unset =
+    sink stays off and every emit below is a no-op."""
+    path = os.environ.get(_metrics_sink.ENV_PATH)
+    if not path:
+        return
+    tag = os.environ.get("PADDLE_PS_RANK_TAG") or f"ps{os.getpid()}"
+    root, ext = os.path.splitext(path)
+    _metrics_sink.enable(f"{root}.{tag}{ext or '.jsonl'}")
+
+
+def _emit_ps_step(table: str, mode: str, step: int, rows: int,
+                  apply_ms: float) -> None:
+    """One kind="ps_step" JSONL record per APPLIED update — the pserver's
+    analog of the trainer's kind="step" record (a sync round merges once;
+    async/delta pushes apply on arrival)."""
+    _metrics_sink.emit({
+        "kind": "ps_step", "table": table, "mode": mode,
+        "step": int(step), "rows": int(rows),
+        "apply_ms": round(apply_ms, 3),
+    })
+
 
 # a barrier that outlives this window means a peer trainer died mid-round:
 # fail fast so the launcher's watcher can abort/restart the group
@@ -343,9 +370,13 @@ class PSServer:
                     return 0
                 st.async_seen[trainer_id] = max(
                     st.async_seen.get(trainer_id, -1), step)
+            t0 = time.perf_counter()
             table.push_gradients(ids, grads)
+            _emit_ps_step(name, "async", step, len(np.asarray(ids)),
+                          (time.perf_counter() - t0) * 1e3)
             return 0
         token = object()
+        merged = None  # (rows, apply_ms) when THIS call merged the round
         with st.cond:
             if retry and step <= st.last_applied:
                 # replay of a round that merged before the reply was
@@ -365,7 +396,9 @@ class PSServer:
                 # duplicate-id float accumulation is order-identical
                 ids_m = np.concatenate([buf[t][0] for t in sorted(buf)])
                 g_m = np.concatenate([buf[t][1] for t in sorted(buf)])
+                t0 = time.perf_counter()
                 table.push_gradients(ids_m, g_m / st.num)
+                merged = (len(ids_m), (time.perf_counter() - t0) * 1e3)
                 for t in buf:
                     st.done.add(buf[t][2])
                 st.done.discard(token)  # the merger does not wait
@@ -392,6 +425,10 @@ class PSServer:
                     f"only {len(st.rounds.get(step, {}))}/{st.num} "
                     f"trainers pushed table {name!r} round {step} — a "
                     f"peer trainer likely died")
+        if merged is not None:
+            # emitted outside the barrier lock: sink I/O must never
+            # extend the round's critical section
+            _emit_ps_step(name, "sync", step, merged[0], merged[1])
         return 0
 
     def push_delta(self, name, ids, deltas, trainer_id=0, seq=-1,
@@ -406,7 +443,10 @@ class PSServer:
                     return 0  # replayed delta already accumulated
                 st.delta_seen[trainer_id] = max(
                     st.delta_seen.get(trainer_id, -1), seq)
+        t0 = time.perf_counter()
         table.push_delta(ids, deltas)
+        _emit_ps_step(name, "delta", seq, len(np.asarray(ids)),
+                      (time.perf_counter() - t0) * 1e3)
         return 0
 
     def handle(self, method: str, kwargs: dict):
@@ -594,6 +634,7 @@ def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
     if snapshot_secs is None:
         snapshot_secs = float(
             os.environ.get("PADDLE_PS_SNAPSHOT_SECS", 0) or 0)
+    _arm_metrics_sink()
     srv = _TCPServer((host, port), _Handler)
     srv.ps = PSServer(preload_dir=preload_dir,  # type: ignore[attr-defined]
                       snapshot_dir=snapshot_dir,

@@ -1,0 +1,56 @@
+"""Static verification framework over the Program IR (proglint).
+
+The Program IS the source of truth in this design (fluid/framework.py):
+the Executor traces a whole block into ONE jitted function, so a
+malformed graph — dangling input, dtype clash, stale last-writer left
+behind by a rewrite pass — surfaces as a cryptic JAX trace error
+hundreds of frames from the op that caused it. This package is the
+TPU-native rebuild of the reference's C++ InferShape checks +
+`op_callstack` attribution (operator.cc exception enrichment): a pass
+manager running pluggable whole-graph checks, each finding carrying
+severity, op position, and the USER call stack captured at
+`Block.append_op` time.
+
+Three entry points:
+
+  verify-on-compile   FLAGS_program_verify=1 makes Executor._ensure_compiled
+                      verify every program before XLA sees it, raising a
+                      structured ProgramVerifyError that points at the
+                      user's layer call instead of letting XLA fail later.
+  pass sandwich       apply_conv_bn_fusion / append_backward verify the
+                      program before AND after rewriting (same flag);
+                      findings the pass introduced are attributed to it —
+                      the MLIR-verifier convention for rewrite pipelines.
+  proglint CLI        tools/proglint.py lints any saved or constructed
+                      program standalone and exits nonzero on errors.
+
+Check catalog (registered name -> module):
+
+  dangling-ref, use-before-def, maybe-uninitialized   analysis/dataflow.py
+  stale-last-writer, dead-op, unused-var              analysis/dataflow.py
+  shape-dtype (eval_shape recompute, -1 tolerant)     analysis/typecheck.py
+  dtype-clash, fill-truncation                        analysis/typecheck.py
+  grad-integrity, grad-shape-mirror                   analysis/gradcheck.py
+  subblock-persistable-write, subblock-rng            analysis/structure.py
+  device-stage                                        analysis/structure.py
+"""
+from .core import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARNING,
+    CheckContext,
+    Finding,
+    PassManager,
+    ProgramVerifyError,
+    all_checks,
+    assert_valid,
+    format_findings,
+    register_check,
+    user_frame,
+    verify_program,
+    walk_blocks,
+)
+from .sandwich import pass_sandwich  # noqa: F401
+
+# importing the check modules registers their checks with core
+from . import dataflow, gradcheck, structure, typecheck  # noqa: F401,E402

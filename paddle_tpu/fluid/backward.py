@@ -62,7 +62,27 @@ def append_backward(
     (parity with RecomputeOptimizer's _append_backward_ops_with_checkpoints_;
     on TPU the XLA-level jax.checkpoint path in the executor is preferred,
     see contrib/recompute).
+
+    Under FLAGS_program_verify the builder runs pass-sandwiched
+    (fluid/analysis): the program is verified before and after, and any
+    error finding the backward pass introduced (torn grad graph, broken
+    grad metadata) raises a ProgramVerifyError attributed to it.
     """
+    from .analysis import pass_sandwich
+
+    with pass_sandwich(loss.block.program, "append_backward",
+                       live_out=(loss.name,)):
+        return _append_backward_impl(
+            loss, parameter_list, no_grad_set, callbacks, checkpoints)
+
+
+def _append_backward_impl(
+    loss: framework.Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    callbacks=None,
+    checkpoints: Optional[List] = None,
+) -> List[Tuple[framework.Parameter, framework.Variable]]:
     if parameter_list is not None:
         parameter_list = [
             p.name if isinstance(p, framework.Variable) else p for p in parameter_list

@@ -314,6 +314,20 @@ class Executor:
         key = self._cache_key(program, feed_arrays, fetch_names, no_donate)
         compiled = self._cache.get(key)
         if compiled is None:
+            from .flags import flag
+
+            if flag("FLAGS_program_verify"):
+                # static verification BEFORE XLA sees the block: a
+                # malformed graph raises a ProgramVerifyError pointing
+                # at the op's build-time call stack instead of a trace
+                # error hundreds of frames deep. Flag-off cost: this one
+                # dict lookup, only on a compile-cache miss.
+                from .analysis import assert_valid
+
+                assert_valid(
+                    program,
+                    live_out=set(feed_arrays) | set(fetch_names),
+                    where="Executor compile (FLAGS_program_verify)")
             # a RETRACE is a recompile of a program the cache already
             # holds under another signature (shape change, new fetch
             # list, flag toggle) — the shape-instability tax telemetry
@@ -364,7 +378,8 @@ class Executor:
         # diagnostic flags belong in the key: toggling one to debug must
         # recompile, not silently hit the pre-toggle cache entry
         return (program._serial, program._version, feed_sig, fetch_names,
-                no_donate, flag("FLAGS_enable_unused_var_check"))
+                no_donate, flag("FLAGS_enable_unused_var_check"),
+                flag("FLAGS_program_verify"))
 
     def _prepare_feed(self, block, feed):
         import jax

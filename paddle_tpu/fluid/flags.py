@@ -75,6 +75,23 @@ _DEFS: Dict[str, tuple] = {
            "the newest valid checkpoint (or re-raises when no "
            "CheckpointManager is active). Only read when "
            "FLAGS_check_numerics is on"),
+    "FLAGS_program_verify": (
+        False, "fluid/analysis static verifier: Executor._ensure_compiled "
+               "verifies every program on compile-cache miss (raising "
+               "ProgramVerifyError with the offending op's build-time "
+               "call stack instead of letting XLA fail later), and "
+               "apply_conv_bn_fusion / append_backward run pass-"
+               "sandwiched (verify before/after; NEW error findings are "
+               "attributed to the pass, MLIR-verifier style). Off = no "
+               "check runs and the compile path is bit-identical. "
+               "Standalone linting: tools/proglint.py"),
+    "FLAGS_op_callstack": (
+        True, "Block.append_op captures the Python call stack into the "
+              "op's __op_callstack__ attr (reference OpDesc op_callstack) "
+              "so verifier findings point at the USER layer call. Capture "
+              "is a frame walk (no source reads, ~µs/op); disable for "
+              "build-speed-critical jobs — diagnostics then lose source "
+              "attribution"),
     "FLAGS_dataloader_require_spawn": (
         False, "fluid/dataloader: raise instead of warning when worker "
                "args are unpicklable and the loader would fall back to "

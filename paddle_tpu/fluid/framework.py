@@ -17,15 +17,42 @@ from __future__ import annotations
 import contextlib
 import itertools
 import copy
+import sys
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from . import unique_name
-from .dtypes import convert_dtype, dtype_name
+from .dtypes import convert_dtype, dtype_name, is_floating
+from .flags import flag
 
 GRAD_VAR_SUFFIX = "@GRAD"
 _dummy_batch_probes = (3, 5)
+
+# op attr holding the build-time Python call stack (reference OpDesc attr
+# "op_callstack", operator.cc exception enrichment). Double-underscored so
+# the registry's attr signatures (registry._attrs_sig) and the generic
+# grad path ignore it — pure diagnostics, never semantics.
+OP_CALLSTACK_ATTR = "__op_callstack__"
+
+
+def _capture_callstack(skip: int = 2, limit: int = 32):
+    """Cheap (file, line, fn) stack walk for op attribution — no source
+    lines are read (unlike traceback.extract_stack), so this costs a few
+    microseconds per op. FLAGS_op_callstack=0 disables capture for
+    build-speed-critical jobs."""
+    if not flag("FLAGS_op_callstack"):
+        return None
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return None
+    out = []
+    while f is not None and len(out) < limit:
+        code = f.f_code
+        out.append((code.co_filename, f.f_lineno, code.co_name))
+        f = f.f_back
+    return tuple(out)
 
 
 class Variable:
@@ -90,8 +117,16 @@ class Variable:
 
         fn = getattr(layers, fn_name)
         if not isinstance(other, Variable):
+            value = float(other)
+            dtype = self.dtype
+            if not is_floating(dtype) and not value.is_integer():
+                # int/bool var against a fractional scalar: a same-dtype
+                # constant would silently truncate (x * 0.5 -> x * 0, the
+                # bug proglint's fill-truncation check flags). Promote the
+                # scalar; the op's jnp promotion yields the float result.
+                dtype = "float32"
             other = layers.fill_constant(
-                shape=[1], dtype=self.dtype, value=float(other)
+                shape=[1], dtype=dtype, value=value
             )
         return fn(other, self) if reverse else fn(self, other)
 
@@ -278,6 +313,10 @@ class Block:
         dev = _current_op_device()
         if dev is not None and "op_device" not in op.attrs:
             op.attrs["op_device"] = dev
+        if OP_CALLSTACK_ATTR not in op.attrs:
+            cs = _capture_callstack()
+            if cs is not None:
+                op.attrs[OP_CALLSTACK_ATTR] = cs
         self.ops.append(op)
         self._post_insert(op, infer)
         return op
@@ -291,6 +330,10 @@ class Block:
             outputs=_normalize_io(kwargs.get("outputs")),
             attrs=kwargs.get("attrs"),
         )
+        if OP_CALLSTACK_ATTR not in op.attrs:
+            cs = _capture_callstack()
+            if cs is not None:
+                op.attrs[OP_CALLSTACK_ATTR] = cs
         self.ops.insert(index, op)
         self._post_insert(op, infer)
         return op
@@ -452,33 +495,27 @@ class Program:
 # ---------------------------------------------------------------------------
 
 
-def infer_op_outputs(block: Block, op: Operator):
-    """Set shapes/dtypes of op's output vars by abstractly tracing the
-    registered JAX emitter (twice, with different probe values standing in
-    for -1 dims, to detect batch-dim propagation)."""
+def compute_op_output_metas(block: Block, op: Operator):
+    """Pure output-meta inference: {slot: [(shape, dtype)]} from the
+    registered emitter (jax.eval_shape dual-probe for -1 dims) or the
+    explicit infer_shape override. Returns None for no_infer ops. Never
+    mutates the program — the static verifier (fluid/analysis) re-runs
+    this to cross-check recorded metadata after graph rewrites."""
     from ..ops import registry
 
     spec = registry.get(op.type)
     if spec is None:
         raise KeyError(f"op {op.type!r} is not registered")
-    if spec.infer_shape is not None:
-        # explicit override (control flow, data-dependent shapes)
-        metas = spec.infer_shape(
-            {
-                slot: [_var_meta(block, n) for n in names]
-                for slot, names in op.inputs.items()
-            },
-            op.attrs,
-        )
-        _apply_metas(block, op, metas)
-        return
-    if spec.no_infer:
-        return
-
     in_metas = {
         slot: [_var_meta(block, n) for n in names]
         for slot, names in op.inputs.items()
     }
+    if spec.infer_shape is not None:
+        # explicit override (control flow, data-dependent shapes)
+        return spec.infer_shape(in_metas, op.attrs)
+    if spec.no_infer:
+        return None
+
     has_dynamic = any(
         (m[0] is not None and -1 in m[0]) for ms in in_metas.values() for m in ms
     )
@@ -497,7 +534,16 @@ def infer_op_outputs(block: Block, op: Operator):
             else:
                 shape = shape0
             metas[slot].append((shape, dt))
-    _apply_metas(block, op, metas)
+    return metas
+
+
+def infer_op_outputs(block: Block, op: Operator):
+    """Set shapes/dtypes of op's output vars by abstractly tracing the
+    registered JAX emitter (twice, with different probe values standing in
+    for -1 dims, to detect batch-dim propagation)."""
+    metas = compute_op_output_metas(block, op)
+    if metas is not None:
+        _apply_metas(block, op, metas)
 
 
 def _apply_metas(block, op, metas):

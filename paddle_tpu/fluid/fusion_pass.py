@@ -101,6 +101,10 @@ def _try_fuse_at(block, i) -> bool:
     dev = conv.attr("op_device")
     if dev is not None:
         attrs["op_device"] = dev
+    cs = conv.attr(framework.OP_CALLSTACK_ATTR)
+    if cs is not None:
+        # diagnostics on the fused op point at the user's conv call
+        attrs[framework.OP_CALLSTACK_ATTR] = cs
 
     fused = framework.Operator(
         block,
@@ -130,6 +134,15 @@ def _try_fuse_at(block, i) -> bool:
         v = block._find_var_recursive(n)
         if v is not None:
             v.op = fused
+    # the exclusive intermediates the deleted ops produced (conv output,
+    # and the BN Y when the relu folded in) now have neither producer nor
+    # consumer; leaving them in block.vars kept stale Variable.op links
+    # to the removed ops (proglint: stale-last-writer / unused-var)
+    dead = [conv_out]
+    if relu_idx is not None:
+        dead.append(y)
+    for n in dead:
+        block.vars.pop(n, None)
     block.program._bump_version()
     return True
 
@@ -140,14 +153,21 @@ def apply_conv_bn_fusion(program) -> int:
     Returns the number of fusions performed. Unconditional (an explicit
     call states intent); the training wiring goes through
     `maybe_apply_conv_bn_fusion`, which honors FLAGS_conv_bn_fusion.
+
+    Under FLAGS_program_verify the rewrite runs pass-sandwiched
+    (fluid/analysis): the program is verified before and after, and any
+    error finding the pass introduced raises attributed to it.
     """
+    from .analysis import pass_sandwich
+
     fused = 0
-    for block in program.blocks:
-        i = 0
-        while i < len(block.ops):
-            if _try_fuse_at(block, i):
-                fused += 1
-            i += 1
+    with pass_sandwich(program, "conv_bn_fusion"):
+        for block in program.blocks:
+            i = 0
+            while i < len(block.ops):
+                if _try_fuse_at(block, i):
+                    fused += 1
+                i += 1
     return fused
 
 

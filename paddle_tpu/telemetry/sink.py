@@ -12,6 +12,11 @@ tools and tests parse it):
                    "peak_hbm_bytes": int}
   kind="bench"    one bench.py result row (same keys as its stdout JSON)
   kind="train_epoch"  hapi MetricsLogger epoch summary
+  kind="ps_step"  one APPLIED pserver update (distributed/ps_server.py;
+                  the pserver arms this sink itself with a per-process
+                  `ps` tag in the filename):
+                  {"table": str, "mode": "sync"|"async"|"delta",
+                   "step": int round/seq, "rows": int, "apply_ms": float}
 
 The sink is OFF (every emit a no-op costing one attribute read) unless
 PADDLE_METRICS_PATH is set or enable(path) is called — the flag-off hot

@@ -1,0 +1,499 @@
+"""Static verifier (fluid/analysis, ISSUE 5): every shipped check has a
+triggering (deliberately broken program) and a non-triggering (clean
+canonical program) case; findings carry user-code call stacks; the
+FLAGS_program_verify-off compile path is bit-identical and runs no
+check; pass sandwiches attribute NEW findings to the rewrite; the
+proglint CLI lints built and saved programs.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import analysis, backward, fusion_pass, layers
+from paddle_tpu.fluid.analysis import (
+    ERROR,
+    ProgramVerifyError,
+    pass_sandwich,
+    user_frame,
+    verify_program,
+)
+
+THIS_FILE = os.path.abspath(__file__)
+
+
+def _fresh():
+    return fluid.Program(), fluid.Program()
+
+
+def _small_train(batch=4):
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [batch, 8], append_batch_size=False)
+        y = layers.data("y", [batch, 1], append_batch_size=False)
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 4, act="relu"), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _conv_bn_relu(batch=2, size=8, is_test=False):
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [batch, 3, size, size],
+                          append_batch_size=False)
+        c = layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        b = layers.batch_norm(c, is_test=is_test)
+        r = layers.relu(b)
+        loss = layers.mean(r)
+    return main, startup, loss
+
+
+def _checks(findings, severity=None):
+    return {f.check for f in findings
+            if severity is None or f.severity == severity}
+
+
+# ---------------------------------------------------------------------------
+# triggering cases — one deliberately broken program per check
+# ---------------------------------------------------------------------------
+
+
+def test_dangling_ref_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        layers.data("x", [4, 8], append_batch_size=False)
+    main.global_block().append_op(
+        type="relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]},
+        infer=False)
+    assert "dangling-ref" in _checks(verify_program(main), ERROR)
+
+
+def test_use_before_def_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        h = layers.relu(x)
+        layers.scale(h, scale=2.0)
+    blk = main.global_block()
+    blk.ops[0], blk.ops[1] = blk.ops[1], blk.ops[0]  # consumer first
+    assert "use-before-def" in _checks(verify_program(main), ERROR)
+
+
+def test_stale_last_writer_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        y = layers.relu(x)
+    del main.global_block().ops[0]  # bad pass: op removed, link kept
+    fs = verify_program(main, live_out={y.name})
+    stale = [f for f in fs if f.check == "stale-last-writer"]
+    assert stale and stale[0].severity == ERROR
+    assert stale[0].var == y.name
+
+
+def test_shape_dtype_mismatch_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        y = layers.fc(x, 4)
+    v = main.global_block().var(y.name)
+    v.shape = (9, 9)  # recorded metadata no longer matches the emitter
+    assert "shape-dtype" in _checks(
+        verify_program(main, live_out={y.name}), ERROR)
+    v.shape = (4, 4)
+    v.dtype = np.dtype("int32")
+    assert "shape-dtype" in _checks(
+        verify_program(main, live_out={y.name}), ERROR)
+
+
+def test_dtype_clash_float_widths_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        xh = layers.cast(x, "float16")
+        z = layers.elementwise_add(xh, x)  # f16 + f32: missed cast
+    assert "dtype-clash" in _checks(
+        verify_program(main, live_out={z.name}), ERROR)
+
+
+def test_fill_truncation_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        c = layers.fill_constant([2], "int32", 2.5)
+    fs = verify_program(main, live_out={c.name})
+    trunc = [f for f in fs if f.check == "fill-truncation"]
+    assert trunc and trunc[0].severity == ERROR
+    assert "truncated" in trunc[0].message
+
+
+def test_grad_integrity_flagged():
+    main, _, loss = _small_train()
+    blk = main.global_block()
+    # tear the grad graph: remove the d(loss)/d(loss)=1 seed
+    idx = next(i for i, op in enumerate(blk.ops)
+               if loss.name + "@GRAD" in op.output_names())
+    del blk.ops[idx]
+    assert "grad-integrity" in _checks(verify_program(main), ERROR)
+
+
+def test_grad_shape_mirror_flagged():
+    main, _, loss = _small_train()
+    blk = main.global_block()
+    gop = next(op for op in blk.ops
+               if op.type.endswith("_grad")
+               and op.attrs.get("__fwd_in_slots__"))
+    slot = next(s for s in gop.attrs["__fwd_in_slots__"]
+                if gop.outputs.get(s + "@GRAD"))
+    gname = next(n for n in gop.outputs[slot + "@GRAD"]
+                 if not n.endswith("@UNUSED"))
+    blk._find_var_recursive(gname).shape = (1, 2, 3, 4)
+    assert "grad-shape-mirror" in _checks(verify_program(main), ERROR)
+
+
+def _manual_cond(main, sub_builder, captured, out_names):
+    """Attach a hand-built cond op over one sub-block (broken-program
+    tests need raw IR access, not the layers API)."""
+    blk = main.global_block()
+    pred = blk.create_var(name="pred", shape=(1,), dtype="bool",
+                          is_data=True)
+    sub = main._create_block()
+    sub_builder(sub)
+    main._rollback()
+    blk.append_op(
+        type="cond",
+        inputs={"Cond": [pred.name], "Input": list(captured)},
+        outputs={"Out": ["cond_out"]},
+        attrs={"true_block": sub, "false_block": sub,
+               "captured_names": list(captured),
+               "true_out_names": list(out_names),
+               "false_out_names": list(out_names)},
+        infer=False)
+
+
+def test_subblock_uncaptured_read_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        layers.data("x", [4], append_batch_size=False)
+        layers.data("y", [4], append_batch_size=False)
+
+    def build(sub):
+        # reads y, which the cond op does NOT capture: emit_ops KeyErrors
+        sub.append_op(type="relu", inputs={"X": ["y"]},
+                      outputs={"Out": ["sub_o"]}, infer=False)
+
+    _manual_cond(main, build, captured=["x"], out_names=["sub_o"])
+    fs = verify_program(main, live_out={"cond_out"})
+    ubd = [f for f in fs if f.check == "use-before-def"]
+    assert ubd and ubd[0].severity == ERROR and "captured" in ubd[0].message
+
+
+def test_subblock_persistable_write_flagged():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        layers.data("x", [4], append_batch_size=False)
+    blk = main.global_block()
+    blk.create_var(name="running_stat", shape=(4,), persistable=True)
+
+    def build(sub):
+        # the functional lowering discards this write
+        sub.append_op(type="assign", inputs={"X": ["x"]},
+                      outputs={"Out": ["running_stat"]}, infer=False)
+
+    _manual_cond(main, build, captured=["x"], out_names=["running_stat"])
+    assert "subblock-persistable-write" in _checks(
+        verify_program(main, live_out={"cond_out"}), ERROR)
+
+
+def test_subblock_rng_warns_in_loop_body():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        s = layers.data("s", [4], append_batch_size=False)
+
+        def cond(i, s):
+            return layers.less_than(
+                i, layers.fill_constant([1], "int64", 3))
+
+        def body(i, s):
+            return [i + 1, layers.dropout(s, dropout_prob=0.5)]
+
+        i2, s2 = layers.while_loop(cond, body, [i, s])
+    fs = verify_program(main, live_out={i2.name, s2.name})
+    rng = [f for f in fs if f.check == "subblock-rng"]
+    assert rng and rng[0].severity == "warning"
+    assert "SAME random draw" in rng[0].message
+    # and no error-severity findings: the program is legal, just risky
+    assert not [f for f in fs if f.severity == ERROR]
+
+
+def test_device_stage_warns_on_revisit_and_gaps():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        device_guard = fluid.framework.device_guard
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        with device_guard("gpu:0"):
+            a = layers.relu(x)
+        b = layers.scale(a, scale=2.0)  # untagged op inside the region
+        with device_guard("gpu:1"):
+            c = layers.relu(b)
+        with device_guard("gpu:0"):  # stage 0 reappears
+            d = layers.scale(c, scale=3.0)
+    fs = verify_program(main, live_out={d.name})
+    msgs = [f.message for f in fs if f.check == "device-stage"]
+    assert any("no device_guard tag" in m for m in msgs)
+    assert any("reappears" in m for m in msgs)
+    assert not [f for f in fs if f.severity == ERROR]
+
+
+# ---------------------------------------------------------------------------
+# non-triggering cases — canonical programs stay clean
+# ---------------------------------------------------------------------------
+
+
+def test_clean_small_train_program():
+    main, startup, loss = _small_train()
+    assert verify_program(main, live_out={"x", "y", loss.name}) == []
+    assert verify_program(startup) == []
+
+
+def test_clean_fused_backward_resnet_block():
+    """The ISSUE's flagship negative: a ResNet block (conv+BN+relu
+    chains), conv_bn fused, backward appended — zero findings."""
+    from paddle_tpu.models.resnet import (
+        ResNetConfig,
+        build_resnet_train_program,
+    )
+
+    main, startup = _fresh()
+    main, startup, feeds, loss = build_resnet_train_program(
+        ResNetConfig.resnet18(), 2, 32, main, startup)
+    assert fusion_pass.apply_conv_bn_fusion(main) > 0
+    backward.append_backward(loss)
+    fs = verify_program(main, live_out=set(feeds) | {loss.name})
+    assert fs == [], analysis.format_findings(fs)
+    assert verify_program(startup) == []
+
+
+def test_clean_control_flow_program():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1], "float32")
+        a = layers.fill_constant([2], "float32", 2.0)
+        pred = layers.greater_than(
+            x, layers.fill_constant([1], "float32", 0.0))
+        out = layers.cond(pred, lambda: layers.scale(a, 2.0),
+                          lambda: layers.scale(a, -1.0))
+    fs = verify_program(main, live_out={"x", out.name})
+    assert fs == [], analysis.format_findings(fs)
+
+
+# ---------------------------------------------------------------------------
+# regressions: real bugs the verifier flagged in existing code
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_drops_dead_intermediates_regression():
+    """conv+BN fusion used to leave the conv output (and the BN Y when
+    the relu folded) in block.vars with Variable.op pointing at the
+    DELETED ops — the stale-last-writer breakage this verifier exists
+    to catch."""
+    main, startup, loss = _conv_bn_relu()
+    blk = main.global_block()
+    conv_out = blk.ops[0].output("Output")[0]
+    bn_y = blk.ops[1].output("Y")[0]
+    assert fusion_pass.apply_conv_bn_fusion(main) == 1
+    assert conv_out not in blk.vars and bn_y not in blk.vars
+    fs = verify_program(main, live_out={"img", loss.name})
+    assert fs == [], analysis.format_findings(fs)
+
+
+def test_binary_scalar_promotion_regression():
+    """`int_var * 2.5` used to emit fill_constant(dtype=int32, 2.5) —
+    silently truncated to 2 (proglint: fill-truncation). The scalar now
+    promotes to float32 and the math is right."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xi = layers.data("xi", [4], dtype="int32", append_batch_size=False)
+        z = xi * 2.5
+    fs = verify_program(main, live_out={"xi", z.name})
+    assert not [f for f in fs if f.severity == ERROR], \
+        analysis.format_findings(fs)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        (out,) = exe.run(main, feed={"xi": np.array([1, 2, 3, 4], "i4")},
+                         fetch_list=[z])
+    np.testing.assert_allclose(out, [2.5, 5.0, 7.5, 10.0])
+
+
+# ---------------------------------------------------------------------------
+# call-stack attribution
+# ---------------------------------------------------------------------------
+
+
+def test_op_callstack_points_at_user_code():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        layers.relu(x)
+    op = main.global_block().ops[-1]
+    frame = user_frame(op.attrs.get("__op_callstack__"))
+    assert frame is not None
+    assert os.path.abspath(frame[0]) == THIS_FILE
+    assert frame[2] == "test_op_callstack_points_at_user_code"
+
+
+def test_verify_error_names_user_call_site():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        y = layers.relu(x)
+    del main.global_block().ops[0]
+    exe = fluid.Executor()
+    fluid.set_flags({"FLAGS_program_verify": True})
+    try:
+        with fluid.scope_guard(fluid.executor.Scope()):
+            with pytest.raises(ProgramVerifyError) as ei:
+                exe.run(main, feed={"x": np.zeros((4, 8), "f4")},
+                        fetch_list=[y])
+        assert os.path.basename(THIS_FILE) in str(ei.value)
+        assert any(f.severity == ERROR for f in ei.value.findings)
+    finally:
+        fluid.set_flags({"FLAGS_program_verify": False})
+
+
+def test_callstack_capture_can_be_disabled():
+    fluid.set_flags({"FLAGS_op_callstack": False})
+    try:
+        main, startup = _fresh()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [4, 8], append_batch_size=False)
+            layers.relu(x)
+        assert "__op_callstack__" not in main.global_block().ops[-1].attrs
+    finally:
+        fluid.set_flags({"FLAGS_op_callstack": True})
+
+
+# ---------------------------------------------------------------------------
+# flag-off contract: no checks run, compile path bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_flag_off_runs_no_check_and_toggle_on_verifies(monkeypatch):
+    main, startup, loss = _small_train()
+    calls = []
+    real = analysis.assert_valid
+    monkeypatch.setattr(
+        analysis, "assert_valid",
+        lambda *a, **k: (calls.append(1), real(*a, **k))[1])
+    exe = fluid.Executor()
+    feed = {"x": np.zeros((4, 8), "f4"), "y": np.zeros((4, 1), "f4")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        (l0,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert calls == [], "flag off must run zero checks"
+        # turn-it-on-to-debug: the flag is part of the compile-cache key,
+        # so toggling AFTER the first compile still verifies
+        fluid.set_flags({"FLAGS_program_verify": True})
+        try:
+            (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+        finally:
+            fluid.set_flags({"FLAGS_program_verify": False})
+        assert calls == [1], "toggle-on must verify despite the cache"
+
+
+def test_verify_is_read_only():
+    main, startup, loss = _small_train()
+    v0 = main._version
+    verify_program(main, live_out={loss.name})
+    assert main._version == v0, "verification must not mutate the program"
+
+
+# ---------------------------------------------------------------------------
+# pass sandwich
+# ---------------------------------------------------------------------------
+
+
+def test_pass_sandwich_attributes_new_findings():
+    main, startup, loss = _small_train()
+    fluid.set_flags({"FLAGS_program_verify": True})
+    try:
+        with pytest.raises(ProgramVerifyError) as ei:
+            with pass_sandwich(main, "evil_pass", live_out={loss.name}):
+                del main.global_block().ops[0]  # introduces stale links
+        assert all(f.pass_name == "evil_pass" for f in ei.value.findings)
+        assert "evil_pass" in str(ei.value)
+    finally:
+        fluid.set_flags({"FLAGS_program_verify": False})
+
+
+def test_pass_sandwich_flag_off_is_noop():
+    main, startup, loss = _small_train()
+    with pass_sandwich(main, "evil_pass"):
+        del main.global_block().ops[0]  # broken, but nobody looked
+
+
+def test_fusion_and_backward_sandwiched_clean():
+    """The real wired passes run sandwich-verified under the flag and
+    stay clean on a canonical conv net (the acceptance bar: verified
+    rewrites, no false positives)."""
+    main, startup, loss = _conv_bn_relu()
+    fluid.set_flags({"FLAGS_program_verify": True})
+    try:
+        assert fusion_pass.apply_conv_bn_fusion(main) == 1
+        backward.append_backward(loss)
+    finally:
+        fluid.set_flags({"FLAGS_program_verify": False})
+
+
+# ---------------------------------------------------------------------------
+# proglint CLI
+# ---------------------------------------------------------------------------
+
+
+def _proglint():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(THIS_FILE)),
+                        "tools", "proglint.py")
+    spec = importlib.util.spec_from_file_location("proglint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_proglint_clean_model(capsys):
+    rc = _proglint().main(["--model", "resnet18", "--fuse", "--backward",
+                           "--image-size", "32"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "0 error(s)" in out and "OK" in out
+
+
+def test_proglint_saved_program(tmp_path, capsys):
+    from paddle_tpu.fluid import io as fio
+
+    main, startup, loss = _small_train()
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "__model__").write_bytes(fio._serialize_program(main))
+    rc = _proglint().main(["--program", str(good),
+                           "--live-out", f"x,y,{loss.name}"])
+    assert rc == 0
+
+    # break it in a way that survives serialization (deserialize rebuilds
+    # Variable.op links, so use a dangling input name, not a deleted op)
+    op0 = main.global_block().ops[0]
+    slot = next(iter(op0.inputs))
+    op0.inputs[slot] = ["ghost_input"]
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "__model__").write_bytes(fio._serialize_program(main))
+    rc = _proglint().main(["--program", str(bad), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    import json as _json
+
+    recs = [_json.loads(l) for l in out.splitlines()
+            if l.startswith("{")]
+    assert any(r["severity"] == "error" for r in recs)

@@ -75,7 +75,12 @@ def test_orbax_save_load(tmp_path):
 
 
 def test_encrypted_inference_model_roundtrip(tmp_path):
-    """AES-encrypted model export/import (reference framework/io/crypto)."""
+    """AES-encrypted model export/import (reference framework/io/crypto).
+    Skips (not fails) where the `cryptography` package is absent — the
+    crypto layer is optional and the container does not ship it."""
+    import pytest
+
+    pytest.importorskip("cryptography")
     import numpy as np
 
     import paddle_tpu.fluid as fluid
@@ -129,10 +134,9 @@ def test_jit_save_load_translated_layer(tmp_path):
     assert out2.shape == (4, 2)
 
 
-def test_inference_model_saves_buffers_and_encrypts_params(tmp_path):
+def test_inference_model_saves_buffers(tmp_path):
     """Non-Parameter persistables (BatchNorm running stats) survive
-    export/import; with encrypt_key set, the weight files on disk are
-    ciphertext too (review findings)."""
+    export/import (review findings)."""
     import numpy as np
 
     import paddle_tpu.fluid as fluid
@@ -157,6 +161,17 @@ def test_inference_model_saves_buffers_and_encrypts_params(tmp_path):
         jit.save(net, path, input_spec=[dygraph.to_variable(x)])
     out = jit.load(path)(x).numpy()
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_inference_model_encrypts_params(tmp_path):
+    """With encrypt_key set, the weight files on disk are ciphertext too
+    (review findings). Skips without the optional `cryptography` dep."""
+    import pytest
+
+    pytest.importorskip("cryptography")
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
 
     # encrypted: every array file is ciphertext, round trip needs the key
     import os

@@ -250,6 +250,82 @@ def test_rank_suffix_when_launched(tmp_path, monkeypatch):
     assert rec["rank"] == 2
 
 
+PS_STEP_KEYS = {"kind", "ts", "rank", "table", "mode", "step", "rows",
+                "apply_ms"}
+
+
+def test_ps_server_step_records_schema(tmp_path, monkeypatch):
+    """Pservers honor PADDLE_METRICS_PATH with a per-process ps tag
+    (ROADMAP telemetry follow-on): one kind="ps_step" record per APPLIED
+    update, schema-stable, in a file a co-located trainer never
+    interleaves."""
+    from paddle_tpu.distributed import ps_server
+
+    monkeypatch.setenv("PADDLE_METRICS_PATH", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("PADDLE_PS_RANK_TAG", "ps0")
+    ps_server._arm_metrics_sink()
+    try:
+        srv = ps_server.PSServer()
+        srv.create_table({"name": "tele_tbl", "shape": (16, 4),
+                          "sync_trainers": 0})
+        srv.push_gradients("tele_tbl", np.array([1, 2, 3]),
+                           np.ones((3, 4), np.float32), trainer_id=0,
+                           step=0)
+        srv.push_gradients("tele_tbl", np.array([1]),
+                           np.ones((1, 4), np.float32), trainer_id=0,
+                           step=1)
+        srv.push_delta("tele_tbl", np.array([2, 5]),
+                       np.ones((2, 4), np.float32), trainer_id=0, seq=0)
+    finally:
+        sink_mod.disable()
+    # the per-process suffix keeps the trainer's rank-0 path untouched
+    assert not os.path.exists(tmp_path / "m.jsonl")
+    path = tmp_path / "m.ps0.jsonl"
+    assert os.path.exists(path), "pserver sink must carry the ps tag"
+    steps = [r for r in _records(str(path)) if r["kind"] == "ps_step"]
+    assert len(steps) == 3
+    for r in steps:
+        missing = PS_STEP_KEYS - set(r)
+        assert not missing, f"ps_step record missing {missing}: {r}"
+        assert r["table"] == "tele_tbl"
+        assert r["apply_ms"] >= 0 and r["rows"] > 0
+    assert [r["mode"] for r in steps] == ["async", "async", "delta"]
+    assert [r["step"] for r in steps] == [0, 1, 0]
+
+
+def test_ps_server_sync_round_emits_one_record(tmp_path, monkeypatch):
+    """A sync barrier round emits ONE record (from the merging call),
+    counting the merged rows of all trainers."""
+    import threading
+
+    from paddle_tpu.distributed import ps_server
+
+    monkeypatch.setenv("PADDLE_METRICS_PATH", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("PADDLE_PS_RANK_TAG", "ps1")
+    ps_server._arm_metrics_sink()
+    try:
+        srv = ps_server.PSServer()
+        srv.create_table({"name": "sync_tbl", "shape": (16, 4),
+                          "sync_trainers": 2})
+
+        def push(tid):
+            srv.push_gradients("sync_tbl", np.array([tid]),
+                               np.ones((1, 4), np.float32),
+                               trainer_id=tid, step=0)
+
+        t = threading.Thread(target=push, args=(0,))
+        t.start()
+        push(1)
+        t.join()
+    finally:
+        sink_mod.disable()
+    steps = [r for r in _records(str(tmp_path / "m.ps1.jsonl"))
+             if r["kind"] == "ps_step"]
+    assert len(steps) == 1, steps
+    assert steps[0]["mode"] == "sync" and steps[0]["rows"] == 2
+    assert PS_STEP_KEYS <= set(steps[0])
+
+
 # ---------------------------------------------------------------------------
 # straggler detection
 # ---------------------------------------------------------------------------

@@ -89,6 +89,16 @@ assert any(r["cache_hit"] for r in steps[2:]), "steady state should hit the cach
 print(f"telemetry smoke OK: {len(steps)} step records, monotone, schema complete")
 PY
 
+echo "== proglint (static program verification over bench models) =="
+# ISSUE 5 acceptance: the bench-model programs — forward, +backward,
+# +conv_bn_fusion — must carry ZERO error-severity findings (dangling
+# refs, dtype clashes, stale last-writer links, torn grad graphs, ...).
+# The same checks run flag-gated in the Executor (FLAGS_program_verify);
+# this is the standalone CI entry. Exit is nonzero on any error finding.
+JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50
+JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50 --fuse --backward
+JAX_PLATFORMS=cpu python tools/proglint.py --model bert --backward
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""proglint — static lint for Program IR graphs (fluid/analysis).
+
+Lints a bench-model program built in-process, or any saved program
+(`__model__` pickle written by fluid.io.save_inference_model /
+save_train_model), and exits nonzero on error-severity findings. The
+same checks run flag-gated inside the Executor (FLAGS_program_verify)
+and around the rewrite passes; this CLI is the standalone/CI entry.
+
+Examples:
+
+    python tools/proglint.py --model resnet50
+    python tools/proglint.py --model resnet50 --fuse --backward
+    python tools/proglint.py --model bert --backward
+    python tools/proglint.py --program path/to/model_dir   # __model__ inside
+    python tools/proglint.py --model resnet18 --json --werror
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESNETS = ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152")
+
+
+def _build_model(args):
+    """Returns [(label, program, live_out)] for the requested model.
+    Tiny default shapes: lint coverage depends on graph STRUCTURE, not
+    batch size, and CI wants this cheap."""
+    import paddle_tpu.fluid as fluid
+
+    if args.model in RESNETS:
+        from paddle_tpu.models.resnet import (
+            ResNetConfig,
+            build_resnet_train_program,
+        )
+
+        cfg = getattr(ResNetConfig, args.model)()
+        main, startup, feeds, loss = build_resnet_train_program(
+            cfg, args.batch, args.image_size, fluid.Program(),
+            fluid.Program())
+    elif args.model == "bert":
+        from paddle_tpu.models.bert import (
+            BertConfig,
+            build_bert_pretrain_program,
+        )
+
+        main, startup, feeds, loss = build_bert_pretrain_program(
+            BertConfig(), args.batch, args.seq, args.max_preds)
+    else:
+        raise SystemExit(
+            f"unknown --model {args.model!r} (choose from "
+            f"{', '.join(RESNETS + ('bert',))})")
+
+    if args.fuse:
+        from paddle_tpu.fluid.fusion_pass import apply_conv_bn_fusion
+
+        n = apply_conv_bn_fusion(main)
+        print(f"# conv_bn_fusion: {n} triple(s) fused", file=sys.stderr)
+    if args.backward:
+        from paddle_tpu.fluid.backward import append_backward
+
+        append_backward(loss)
+    live = set(feeds) | {loss.name}
+    return [(f"{args.model}:main", main, live),
+            (f"{args.model}:startup", startup, set())]
+
+
+def _load_program(path):
+    from paddle_tpu.fluid import io as fio
+
+    meta_live = set()
+    if os.path.isdir(path):
+        meta_path = os.path.join(path, "__meta__.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                m = json.load(f)
+            meta_live = set(m.get("feed_names", ())) | set(
+                m.get("fetch_names", ()))
+        for cand in ("__model__", "__train_model__"):
+            p = os.path.join(path, cand)
+            if os.path.exists(p):
+                path = p
+                break
+        else:
+            raise SystemExit(f"{path}: no __model__ file in directory")
+    with open(path, "rb") as f:
+        data = f.read()
+    if os.path.basename(path) == "__train_model__":
+        import pickle
+
+        meta = pickle.loads(data)
+        live = set(meta.get("feed_names", ())) | {meta.get("loss_name")}
+        live = {n for n in live if n}
+        return [(f"{path}:main", fio._deserialize_program(meta["main"]),
+                 live),
+                (f"{path}:startup",
+                 fio._deserialize_program(meta["startup"]), set())]
+    return [(path, fio._deserialize_program(data), meta_live)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proglint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model", help="bench model to build and lint: "
+                     f"{', '.join(RESNETS + ('bert',))}")
+    src.add_argument("--program", help="saved program (__model__ pickle "
+                     "or a dir containing one)")
+    ap.add_argument("--backward", action="store_true",
+                    help="append_backward on the model's loss before "
+                    "linting (grad-graph checks get a real graph)")
+    ap.add_argument("--fuse", action="store_true",
+                    help="apply conv+BN fusion before linting")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--max-preds", type=int, default=8)
+    ap.add_argument("--checks", help="comma-separated subset of checks "
+                    "(default: all registered)")
+    ap.add_argument("--live-out", help="comma-separated extra names to "
+                    "treat as live (fetch targets)")
+    ap.add_argument("--werror", action="store_true",
+                    help="exit nonzero on warnings too")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per finding on stdout")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.fluid.analysis import (
+        ERROR,
+        WARNING,
+        all_checks,
+        format_findings,
+        verify_program,
+    )
+
+    checks = args.checks.split(",") if args.checks else None
+    if checks:
+        bad = [c for c in checks if c not in all_checks()]
+        if bad:
+            raise SystemExit(f"unknown check(s) {bad}; "
+                             f"registered: {all_checks()}")
+    extra_live = set(filter(None, (args.live_out or "").split(",")))
+
+    targets = (_build_model(args) if args.model
+               else _load_program(args.program))
+    n_err = n_warn = 0
+    for label, program, live in targets:
+        findings = verify_program(program, checks=checks,
+                                  live_out=live | extra_live)
+        n_err += sum(1 for f in findings if f.severity == ERROR)
+        n_warn += sum(1 for f in findings if f.severity == WARNING)
+        if args.json:
+            for f in findings:
+                print(json.dumps({
+                    "target": label, "check": f.check,
+                    "severity": f.severity, "message": f.message,
+                    "block": f.block_idx, "op_index": f.op_index,
+                    "op_type": f.op_type, "var": f.var,
+                    "pass": f.pass_name,
+                }))
+        else:
+            print(f"== {label}: "
+                  f"{len(program.global_block().ops)} root ops, "
+                  f"{len(findings)} finding(s)")
+            if findings:
+                print(format_findings(findings))
+    failed = n_err > 0 or (args.werror and n_warn > 0)
+    print(f"proglint: {n_err} error(s), {n_warn} warning(s) -> "
+          f"{'FAIL' if failed else 'OK'}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
