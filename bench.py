@@ -22,23 +22,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _peak_flops_per_chip():
-    """bf16 peak FLOP/s for the local chip (best-effort detect)."""
-    import jax
+    """bf16 peak FLOP/s for the local chip. The detection table lives in
+    telemetry/cost.py so the bench rows and the measured-MFU gauge share
+    one denominator."""
+    from paddle_tpu.telemetry.cost import peak_flops_per_chip
 
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v5 lite": 197e12,  # v5e
-        "v5e": 197e12,
-        "v5p": 459e12,
-        "v4": 275e12,
-        "v6": 918e12,  # trillium
-        "v3": 123e12,
-        "v2": 45e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 197e12  # conservative default
+    return peak_flops_per_chip()
 
 
 def _bert_step_flops(cfg, batch, seq):
@@ -80,6 +69,27 @@ def _timed_run(exe, program, data, loss, steps):
         dt = time.perf_counter() - t0
     assert np.isfinite(lv), f"loss not finite: {lv}"
     return dt, lv
+
+
+def _maybe_op_profile(exe, program, data, loss, formula_flops_per_step,
+                      model):
+    """BENCH_OP_PROFILE=1: after the timed loop, re-run a few steps
+    under FLAGS_op_profile and report the measured-MFU gauge + per-op
+    attribution coverage in the bench row (telemetry/cost.py; the full
+    report lands on the debugz /proftop endpoint and in the registry).
+    Off = empty dict, the timed loop untouched."""
+    if os.environ.get("BENCH_OP_PROFILE", "0") != "1":
+        return {}
+    from paddle_tpu.telemetry import cost
+
+    rep = cost.profile_executor_run(
+        exe, program, data, [loss],
+        steps=int(os.environ.get("BENCH_OP_PROFILE_STEPS", "3")),
+        formula_flops_per_step=formula_flops_per_step, model=model)
+    return {
+        "measured_mfu": rep.measured_mfu,
+        "op_profile_coverage": round(rep.coverage, 4),
+    }
 
 
 def _emit_result(result: dict) -> None:
@@ -145,7 +155,8 @@ def bench_resnet(depth=50):
     }
     dt, _ = _timed_run(exe, m, data, loss, steps)
     imgs_per_sec = batch * steps / dt
-    mfu = resnet_step_flops(cfg, batch, size) * steps / dt / _peak_flops_per_chip()
+    formula_flops = resnet_step_flops(cfg, batch, size)
+    mfu = formula_flops * steps / dt / _peak_flops_per_chip()
     _emit_result({
         "metric": f"resnet{depth}_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
@@ -157,6 +168,8 @@ def bench_resnet(depth=50):
         "steps": steps,
         "amp_bf16": use_amp,
         "conv_bn_fusion": use_fusion,
+        **_maybe_op_profile(exe, m, data, loss, formula_flops,
+                            f"resnet{depth}"),
     })
 
 
@@ -305,6 +318,9 @@ def main():
         "remat": out["remat"],
         "peak_hbm_gb": out["peak_hbm_gb"],
     }
+    for k in ("measured_mfu", "op_profile_coverage"):
+        if k in out:
+            result[k] = out[k]
     # long-context guard row (VERDICT r3: the s4096 config regressed with
     # nothing measuring it): the default bench also runs s4096/b8 through
     # the auto-remat ladder and reports it in the same JSON line
@@ -387,7 +403,8 @@ def _run_bert(batch, seq, max_preds, steps, use_amp):
               file=sys.stderr)
 
     dt, _ = _timed_run(exe, m, data, loss, steps)
-    mfu = _bert_step_flops(cfg, batch, seq) * steps / dt / _peak_flops_per_chip()
+    formula_flops = _bert_step_flops(cfg, batch, seq)
+    mfu = formula_flops * steps / dt / _peak_flops_per_chip()
     remat_desc = cfg.remat_policy or ",".join(
         k for k in ("remat_ffn", "remat_qkv", "remat_layer")
         if getattr(cfg, k)
@@ -398,6 +415,7 @@ def _run_bert(batch, seq, max_preds, steps, use_amp):
         "remat": remat_desc,
         "peak_hbm_gb": peak_gb if peak_gb is not None
         else _peak_hbm_gb(exe, m, data, loss),
+        **_maybe_op_profile(exe, m, data, loss, formula_flops, "bert"),
     }
 
 

@@ -138,6 +138,14 @@ def _parse_args(argv):
         "in Perfetto / chrome://tracing)",
     )
     p.add_argument(
+        "--debugz_port", type=int, default=None,
+        help="arm every trainer's live introspection server "
+        "(telemetry/debugz.py: /metrics /statusz /steps /proftop "
+        "/healthz) with deterministic per-rank ports: rank r serves on "
+        "debugz_port + r. Default: PADDLE_DEBUGZ_PORT if set (same "
+        "offset rule), else off",
+    )
+    p.add_argument(
         "--server_num", type=int, default=0,
         help="spawn N local parameter-server processes "
         "(distributed/ps_server.py) on free ports and export "
@@ -392,9 +400,12 @@ class SigtermGrace:
 def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
                          script_args: List[str], log_dir: Optional[str],
                          restart_count: int = 0,
-                         heartbeat_dir: Optional[str] = None):
+                         heartbeat_dir: Optional[str] = None,
+                         debugz_base_port: Optional[int] = None):
     """Fork this node's trainers with the env protocol (reference
-    utils.start_local_trainers:340)."""
+    utils.start_local_trainers:340). debugz_base_port arms each rank's
+    introspection server on base + rank (deterministic: operators and
+    scrape configs can address any rank's /metrics without discovery)."""
     endpoints = ",".join(t.endpoint for t in cluster)
     local = [t for t in cluster if t.endpoint.split(":")[0] == node_ip]
     if log_dir:
@@ -408,6 +419,8 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
             PADDLE_CURRENT_ENDPOINT=t.endpoint,
             PADDLE_ELASTIC_RESTART=str(restart_count),
         )
+        if debugz_base_port is not None:
+            env["PADDLE_DEBUGZ_PORT"] = str(debugz_base_port + t.rank)
         if heartbeat_dir:
             env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
         cmd = [sys.executable, "-u", script] + list(script_args)
@@ -605,11 +618,20 @@ def launch(argv=None) -> int:
 
 def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                      ps_supervisor=None, grace=None) -> int:
+    debugz_base = args.debugz_port
+    if debugz_base is None:
+        raw = os.environ.get("PADDLE_DEBUGZ_PORT")
+        if raw:
+            try:
+                debugz_base = int(raw)
+            except ValueError:
+                debugz_base = None
     attempt = 0
     while True:
         local = start_local_trainers(
             cluster, node_ip, args.training_script, args.training_script_args,
             args.log_dir, restart_count=attempt, heartbeat_dir=heartbeat_dir,
+            debugz_base_port=debugz_base,
         )
         if not local:
             print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)

@@ -377,9 +377,11 @@ class Executor:
 
         # diagnostic flags belong in the key: toggling one to debug must
         # recompile, not silently hit the pre-toggle cache entry
+        # (FLAGS_op_profile changes the traced computation's metadata, so
+        # toggling it back off must return to the scope-free executable)
         return (program._serial, program._version, feed_sig, fetch_names,
                 no_donate, flag("FLAGS_enable_unused_var_check"),
-                flag("FLAGS_program_verify"))
+                flag("FLAGS_program_verify"), flag("FLAGS_op_profile"))
 
     def _prepare_feed(self, block, feed):
         import jax
@@ -466,9 +468,23 @@ class Executor:
         donate_names = [n for n in state_in if n in set(state_out)]
         keep_names = [n for n in state_in if n not in set(state_out)]
         mesh = program._mesh
+        # captured at compile time (the flag is in the cache key): per-op
+        # named scopes for device-time attribution (telemetry/cost.py)
+        op_profile = flag("FLAGS_op_profile")
+
+        import contextlib
+
+        def fwk_scope(name):
+            # framework epilogue compute (rng advance, fetch sync) gets
+            # its own named scope under FLAGS_op_profile: real device
+            # time that belongs to no Program op, but must still be
+            # NAMED in the cost report instead of diluting coverage
+            return (jax.named_scope(f"fwk:{name}") if op_profile
+                    else contextlib.nullcontext())
 
         def fn(feed_vals, donated_vals, kept_vals, rng_key):
-            ctx = registry.EmitContext(rng_key=rng_key, mesh=mesh)
+            ctx = registry.EmitContext(rng_key=rng_key, mesh=mesh,
+                                       op_scopes=op_profile)
             env: Dict[str, Any] = {}
             env.update(kept_vals)
             env.update(donated_vals)
@@ -478,7 +494,8 @@ class Executor:
             new_state = {n: env[n] for n in state_out}
             # advance the scope key even if no op split it, so salted_rng
             # (per-op fold_in of the base key) differs across steps
-            next_key = jax.random.fold_in(ctx.rng_state, 0x5EED)
+            with fwk_scope("rng_advance"):
+                next_key = jax.random.fold_in(ctx.rng_state, 0x5EED)
             return fetches, new_state, next_key
 
         manual_axes = getattr(program, "_manual_axes", None)
@@ -522,12 +539,14 @@ class Executor:
                 # per data shard, like per-worker seeds in the reference);
                 # the RETURNED key advances from the unsalted key so the
                 # replicated out_spec holds
-                shard = lax.axis_index(manual_axes[0])
-                for ax, size in zip(manual_axes[1:], axis_sizes[1:]):
-                    shard = shard * size + lax.axis_index(ax)
-                salted = jax.random.fold_in(rng_key, shard)
+                with fwk_scope("rng_shard_salt"):
+                    shard = lax.axis_index(manual_axes[0])
+                    for ax, size in zip(manual_axes[1:], axis_sizes[1:]):
+                        shard = shard * size + lax.axis_index(ax)
+                    salted = jax.random.fold_in(rng_key, shard)
                 ctx = registry.EmitContext(
-                    rng_key=salted, mesh=None, manual_axes=manual_axes
+                    rng_key=salted, mesh=None, manual_axes=manual_axes,
+                    op_scopes=op_profile,
                 )
                 env: Dict[str, Any] = {}
                 env.update(kept_vals)
@@ -570,9 +589,11 @@ class Executor:
                         )
                     return lax.all_gather(x, manual_axes, axis=0, tiled=True)
 
-                fetches = [_sync(n, env[n]) for n in fetch_names]
+                with fwk_scope("fetch_sync"):
+                    fetches = [_sync(n, env[n]) for n in fetch_names]
                 new_state = {n: env[n] for n in state_out}
-                next_key = jax.random.fold_in(rng_key, 0x5EED)
+                with fwk_scope("rng_advance"):
+                    next_key = jax.random.fold_in(rng_key, 0x5EED)
                 return fetches, new_state, next_key
 
             # state vars default to replicated; vars annotated with a
@@ -655,25 +676,19 @@ class Executor:
 
 
     # ------------------------------------------------------------------
-    def memory_analysis(self, program=None, feed=None, fetch_list=None,
-                        scope=None):
-        """XLA's buffer-assignment memory numbers for the compiled step
-        (the measured answer to "does this batch fit?" — reference-era
-        practice was trial-and-error against the allocator). Returns a
-        dict with argument/output/temp/alias bytes and the derived
-        `peak_bytes` (arguments + outputs + temps - aliased, XLA's HBM
-        high-water estimate for one execution).
-
-        The STARTUP program must have been run first in the given scope
-        (the analysis abstracts the scope's live state); the step program
-        itself is compiled on demand WITHOUT executing, so callers can
-        probe "does this config fit HBM?" before the first step — the
-        bench's auto-remat escalation relies on this. Cost note: the AOT
-        lower().compile() does not share jax.jit's per-call executable
-        cache — unless the persistent XLA compilation cache is
-        configured, this pays one extra compile of the step; call it for
-        config probing / diagnostics, not per step.
-        """
+    def aot_step(self, program=None, feed=None, fetch_list=None,
+                 scope=None):
+        """AOT lower+compile the step for this (program, feed signature,
+        fetch list) WITHOUT executing it, and return the jax Compiled
+        object — the introspection handle behind memory_analysis()
+        (.memory_analysis()), per-op cost attribution (.as_text(): the
+        optimized HLO whose op_name metadata carries FLAGS_op_profile's
+        op scopes — telemetry/cost.py joins xplane events through it)
+        and measured flop counts (.cost_analysis()). Shares
+        _ensure_compiled with run(), so the traced computation is the
+        one the hot path executes; the AOT compile itself is a second
+        XLA compile unless the persistent compilation cache is armed —
+        diagnostics pricing, not per-step pricing."""
         import jax
 
         if program is None:
@@ -707,7 +722,7 @@ class Executor:
         rng = scope._rng_key
         if any(v is None for v in states.values()):
             raise RuntimeError(
-                "memory_analysis: run the startup program first in the "
+                "aot_step: run the startup program first in the "
                 "SAME scope — the analysis abstracts the scope's state"
             )
 
@@ -719,11 +734,28 @@ class Executor:
         kept = {n: _abstract(states[n]) for n in compiled.keep_names}
         feeds_abs = {n: _abstract(a) for n, a in feed_arrays.items()}
         rng_abs = jax.ShapeDtypeStruct(np.shape(rng), rng.dtype)
-        ma = (
-            compiled.fn.lower(feeds_abs, donated, kept, rng_abs)
-            .compile()
-            .memory_analysis()
-        )
+        return compiled.fn.lower(feeds_abs, donated, kept, rng_abs).compile()
+
+    def memory_analysis(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """XLA's buffer-assignment memory numbers for the compiled step
+        (the measured answer to "does this batch fit?" — reference-era
+        practice was trial-and-error against the allocator). Returns a
+        dict with argument/output/temp/alias bytes and the derived
+        `peak_bytes` (arguments + outputs + temps - aliased, XLA's HBM
+        high-water estimate for one execution).
+
+        The STARTUP program must have been run first in the given scope
+        (the analysis abstracts the scope's live state); the step program
+        itself is compiled on demand WITHOUT executing, so callers can
+        probe "does this config fit HBM?" before the first step — the
+        bench's auto-remat escalation relies on this. Cost note: the AOT
+        lower().compile() does not share jax.jit's per-call executable
+        cache — unless the persistent XLA compilation cache is
+        configured, this pays one extra compile of the step; call it for
+        config probing / diagnostics, not per step.
+        """
+        ma = self.aot_step(program, feed, fetch_list, scope).memory_analysis()
         out = {}
         for k in ("argument_size_in_bytes", "output_size_in_bytes",
                   "temp_size_in_bytes", "alias_size_in_bytes",

@@ -92,6 +92,15 @@ _DEFS: Dict[str, tuple] = {
               "is a frame walk (no source reads, ~µs/op); disable for "
               "build-speed-critical jobs — diagnostics then lose source "
               "attribution"),
+    "FLAGS_op_profile": (
+        False, "per-op device-time attribution (telemetry/cost.py): the "
+               "Executor wraps each op's lowering in "
+               "jax.named_scope('op<idx>:<type>') so xplane device events "
+               "carry the op scope in their HLO op_name metadata — "
+               "tools/proftop.py and telemetry.cost join the profile back "
+               "to Program IR ops (+ user callstacks). The flag is part "
+               "of the compile-cache key; off = the traced computation is "
+               "bit-identical to a build without the layer"),
     "FLAGS_dataloader_require_spawn": (
         False, "fluid/dataloader: raise instead of warning when worker "
                "args are unpicklable and the loader would fall back to "

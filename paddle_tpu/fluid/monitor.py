@@ -60,11 +60,44 @@ _pending_data_wait_ms = 0.0
 _pending_ckpt_save_ms = 0.0
 _hb_registered = False
 
+# recent step records for the debugz /steps endpoint (same dicts the
+# JSONL sink writes); populated only while a consumer exists (sink on
+# or debugz armed) — the flag-off hot path builds no dicts
+_recent_steps = collections.deque(maxlen=128)
+_keep_recent = False
+_aux_armed = False
+
 
 def enabled() -> bool:
     """True when per-step records are being written (PADDLE_METRICS_PATH
     set or telemetry.sink.enable() called)."""
     return sink.enabled()
+
+
+def _arm_aux() -> None:
+    """One-shot arming of the env-gated telemetry consumers that ride
+    the step loop: the debugz introspection server (PADDLE_DEBUGZ_PORT —
+    arming it also turns on the /steps ring buffer) and the metrics push
+    exporter (PADDLE_METRICS_PUSH_URL). Cost after the first call: one
+    bool read."""
+    global _aux_armed, _keep_recent
+    if _aux_armed:
+        return
+    _aux_armed = True
+    try:
+        from ..telemetry import debugz, export
+
+        if debugz.maybe_serve() is not None:
+            _keep_recent = True
+        export.maybe_start()
+    except Exception:  # noqa: BLE001 — introspection never fails a step
+        pass
+
+
+def recent_steps() -> list:
+    """Most-recent step records, oldest first (debugz /steps)."""
+    with _lock:
+        return list(_recent_steps)
 
 
 class StepRecord:
@@ -82,10 +115,12 @@ class StepRecord:
 
 
 def begin_step() -> Optional[StepRecord]:
-    """Open a step record when telemetry output is on; None otherwise.
-    The record is thread-local so _ensure_compiled (called deeper in
-    the stack) can contribute compile numbers."""
-    if not sink.enabled():
+    """Open a step record when a consumer exists (JSONL sink on, or the
+    debugz server armed — its /steps page reads the same records); None
+    otherwise. The record is thread-local so _ensure_compiled (called
+    deeper in the stack) can contribute compile numbers."""
+    _arm_aux()
+    if not (sink.enabled() or _keep_recent):
         return None
     rec = StepRecord()
     _tls.rec = rec
@@ -229,7 +264,7 @@ def commit_step(rec: Optional[StepRecord]) -> None:
     _reg.histogram("executor_data_wait_ms",
                    help="feed materialization + input-iterator wait"
                    ).observe(rec.data_wait_ms)
-    sink.emit({
+    payload = {
         "kind": "step",
         "step": step,
         "data_wait_ms": round(rec.data_wait_ms, 3),
@@ -241,16 +276,24 @@ def commit_step(rec: Optional[StepRecord]) -> None:
         "fenced": rec.fenced,
         "retraces": _counter("executor_retraces_total").value,
         "peak_hbm_bytes": peak,
-    })
+    }
+    if _keep_recent:
+        with _lock:
+            _recent_steps.append(dict(payload, ts=round(time.time(), 6)))
+    sink.emit(payload)
 
 
 def reset_for_tests() -> None:
     """Zero the per-process step state (unit tests only; the registry
     is reset separately via telemetry.get_registry().reset())."""
     global _step_count, _pending_data_wait_ms, _pending_ckpt_save_ms
+    global _aux_armed, _keep_recent
     with _lock:
         _step_count = 0
         _recent.clear()
+        _recent_steps.clear()
         _pending_data_wait_ms = 0.0
         _pending_ckpt_save_ms = 0.0
+    _aux_armed = False
+    _keep_recent = False
     _tls.rec = None

@@ -20,7 +20,7 @@ import os
 import tempfile
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _lock = threading.Lock()
 _enabled = False
@@ -213,23 +213,25 @@ def _host_chrome_events(events):
     return out
 
 
-def _device_chrome_events(trace_dir):
-    """Parse the xplane protobuf into chrome events (device pid 1+).
-    Best-effort, but never SILENT: when the device track is dropped the
-    reason is logged once, so a host-only trace is explainable instead
-    of mysterious."""
-    if not trace_dir:
-        return []
+def load_xplane(trace_dir) -> Optional[Any]:
+    """Locate and parse the newest .xplane.pb under `trace_dir` into an
+    XSpace proto. Best-effort, but never SILENT: when the device track
+    is unavailable the reason is logged once, so a host-only trace (or
+    an empty cost report) is explainable instead of mysterious. Returns
+    None when the file or the schema is missing."""
     import sys
     import glob
 
-    files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                      recursive=True)
+    if not trace_dir:
+        return None
+    files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True),
+                   key=os.path.getmtime)
     if not files:
         print(f"[profiler] device track skipped: no .xplane.pb under "
               f"{trace_dir} (device tracing produced no output)",
               file=sys.stderr)
-        return []
+        return None
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
@@ -237,14 +239,93 @@ def _device_chrome_events(trace_dir):
         print(f"[profiler] device track skipped: xplane schema "
               f"unavailable ({type(e).__name__}: {e}); raw xplane kept "
               f"at {trace_dir} for xprof/tensorboard", file=sys.stderr)
-        return []
+        return None
     xs = xplane_pb2.XSpace()
     try:
-        with open(files[0], "rb") as f:
+        with open(files[-1], "rb") as f:
             xs.ParseFromString(f.read())
     except Exception as e:  # noqa: BLE001 — torn/foreign xplane file
         print(f"[profiler] device track skipped: failed to parse "
-              f"{files[0]} ({type(e).__name__}: {e})", file=sys.stderr)
+              f"{files[-1]} ({type(e).__name__}: {e})", file=sys.stderr)
+        return None
+    return xs
+
+
+def xplane_op_events(source) -> Dict[str, Dict[str, Any]]:
+    """Aggregate XLA op executions out of an xplane trace: HLO
+    instruction name -> {dur_ps, count, flops, bytes_accessed,
+    hlo_module}. `source` is a trace dir or an already-parsed XSpace.
+
+    An event counts as an op execution when it carries an `hlo_op` stat
+    (the CPU thunk executor and the GPU/TPU device planes both stamp
+    one) or lives on an "XLA Ops" device line (TPU op track). Everything
+    else — thunk scheduling, host python, allocator spans — is runtime
+    overhead, not op time, and is excluded from both the numerator and
+    the denominator of telemetry.cost's attribution coverage. Where the
+    backend reports per-op flop counts / bytes accessed (TPU op
+    profile), they ride along; the CPU backend reports none.
+
+    Control-flow op events NEST: a `while` instruction's span contains
+    its body's op executions, which the trace records as their own
+    events — counting both would double-charge every scanned layer. Op
+    events fully contained in an earlier-starting op event of the same
+    plane are dropped: the outer instruction (which carries the op scope
+    of the Program op that emitted the loop) is charged its whole span."""
+    xs = load_xplane(source) if isinstance(source, str) else source
+    out: Dict[str, Dict[str, Any]] = {}
+    if xs is None:
+        return out
+    for plane in xs.planes:
+        stat_names = {k: v.name for k, v in plane.stat_metadata.items()}
+        candidates = []  # (start_ps, end_ps, name, stats)
+        for line in plane.lines:
+            line_is_op_track = "xla op" in (line.name or "").lower()
+            base_ps = int(line.timestamp_ns) * 1000
+            for ev in line.events:
+                stats = {}
+                for st in ev.stats:
+                    sn = stat_names.get(st.metadata_id)
+                    if sn:
+                        stats[sn] = (st.str_value or st.int64_value
+                                     or st.uint64_value or st.double_value
+                                     or st.ref_value)
+                if "hlo_op" not in stats and not line_is_op_track:
+                    continue
+                meta = plane.event_metadata[ev.metadata_id]
+                name = meta.name or str(ev.metadata_id)
+                start = base_ps + int(ev.offset_ps)
+                candidates.append(
+                    (start, start + int(ev.duration_ps), name, stats))
+        # drop op events nested inside another op event (strict interval
+        # containment): sort by (start, -end) so an outer span precedes
+        # its children; `actives` holds kept spans still open
+        candidates.sort(key=lambda c: (c[0], -c[1]))
+        actives: List[Tuple[int, int]] = []
+        for start, end, name, stats in candidates:
+            actives = [a for a in actives if a[1] > start]
+            if any(a[0] <= start and end <= a[1] for a in actives):
+                continue
+            actives.append((start, end))
+            row = out.setdefault(name, {
+                "dur_ps": 0, "count": 0, "flops": 0.0,
+                "bytes_accessed": 0, "hlo_module": None,
+            })
+            row["dur_ps"] += end - start
+            row["count"] += 1
+            for key in ("flops", "bytes_accessed"):
+                v = stats.get(key)
+                if isinstance(v, (int, float)) and v:
+                    row[key] += v
+            mod = stats.get("hlo_module")
+            if isinstance(mod, str) and mod:
+                row["hlo_module"] = mod
+    return out
+
+
+def _device_chrome_events(trace_dir):
+    """Parse the xplane protobuf into chrome events (device pid 1+)."""
+    xs = load_xplane(trace_dir)
+    if xs is None:
         return []
     out = []
     raw = []

@@ -31,10 +31,14 @@ class EmitContext:
     """Per-trace context handed to emitters (rng threading, mesh info)."""
 
     def __init__(self, rng_key=None, mesh=None, axis_env=None,
-                 manual_axes=None):
+                 manual_axes=None, op_scopes=False):
         self._key = rng_key
         self._base_key = rng_key  # frozen per-step key for salted_rng
         self.mesh = mesh
+        # FLAGS_op_profile: emit_ops wraps each op's lowering in
+        # jax.named_scope("op<idx>:<type>") so device profiles attribute
+        # back to Program IR ops (telemetry/cost.py). Trace-time only.
+        self.op_scopes = bool(op_scopes)
         # mapping of logical ring_id -> mesh axis name, for collective ops
         self.axis_env = axis_env or {}
         # mesh axes the surrounding shard_map runs MANUALLY over (the
@@ -260,8 +264,23 @@ def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
     Primal reuse: forward ops whose generic grad op appears later in the
     list are emitted under jax.vjp ONCE; the grad op consumes the stored
     vjp instead of re-tracing the forward (a re-traced scanned encoder
-    would otherwise run twice — XLA cannot CSE differing while loops)."""
+    would otherwise run twice — XLA cannot CSE differing while loops).
+
+    Op-scope tagging (ctx.op_scopes, FLAGS_op_profile): every op's
+    emission is wrapped in jax.named_scope("op<idx>:<type>") so each HLO
+    instruction's op_name metadata carries the Program IR position of
+    the op that lowered it — the join key telemetry/cost.py aggregates
+    xplane device events by. Grad-op backward compute (the cached vjp_fn
+    call) is tagged at the GRAD op's index; sub-block emitters recursing
+    through emit_ops nest their scopes under the parent op's."""
+    import contextlib
+
     import jax
+
+    def _scope(idx, op):
+        if not ctx.op_scopes:
+            return contextlib.nullcontext()
+        return jax.named_scope(f"op{idx}:{op.type}")
 
     wanted: Dict[tuple, int] = {}
     for op in ops:
@@ -271,7 +290,7 @@ def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
                 k = _fwd_key_from_grad(op)
                 wanted[k] = wanted.get(k, 0) + 1
 
-    for op in ops:
+    for op_idx, op in enumerate(ops):
         spec = get(op.type)
         if spec is None:
             raise KeyError(f"op {op.type!r} has no registered emitter")
@@ -288,29 +307,30 @@ def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
             if vals:
                 ins[slot] = vals
 
-        outs = None
-        if spec.generic_vjp:
-            cached = ctx.vjp_cache.get(_fwd_key_from_grad(op))
-            if cached:
-                f_outs, vjp_fn, fwd_ins = cached.pop()
-                outs = _apply_vjp(ins, f_outs, vjp_fn, fwd_ins)
-        elif (
-            not spec.no_vjp_grad
-            and not spec.stop_gradient
-            and spec.grad_maker is None
-            and wanted.get(_fwd_key_from_fwd(op), 0) > 0
-        ):
-            key = _fwd_key_from_fwd(op)
-            attrs = op.attrs
+        with _scope(op_idx, op):
+            outs = None
+            if spec.generic_vjp:
+                cached = ctx.vjp_cache.get(_fwd_key_from_grad(op))
+                if cached:
+                    f_outs, vjp_fn, fwd_ins = cached.pop()
+                    outs = _apply_vjp(ins, f_outs, vjp_fn, fwd_ins)
+            elif (
+                not spec.no_vjp_grad
+                and not spec.stop_gradient
+                and spec.grad_maker is None
+                and wanted.get(_fwd_key_from_fwd(op), 0) > 0
+            ):
+                key = _fwd_key_from_fwd(op)
+                attrs = op.attrs
 
-            def fn(fi, _spec=spec, _attrs=attrs):
-                return _spec.emit(ctx, fi, _attrs)
+                def fn(fi, _spec=spec, _attrs=attrs):
+                    return _spec.emit(ctx, fi, _attrs)
 
-            outs, vjp_fn = jax.vjp(fn, ins)
-            ctx.vjp_cache.setdefault(key, []).append((outs, vjp_fn, ins))
-            wanted[key] -= 1
-        if outs is None:
-            outs = spec.emit(ctx, ins, op.attrs)
+                outs, vjp_fn = jax.vjp(fn, ins)
+                ctx.vjp_cache.setdefault(key, []).append((outs, vjp_fn, ins))
+                wanted[key] -= 1
+            if outs is None:
+                outs = spec.emit(ctx, ins, op.attrs)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:

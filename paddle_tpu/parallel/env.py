@@ -55,6 +55,13 @@ def init_parallel_env() -> None:
     from ..fluid.profiler import maybe_start_trace_collection
 
     maybe_start_trace_collection()
+    # live introspection server + metrics push exporter (no-ops unless
+    # the launcher set PADDLE_DEBUGZ_PORT / PADDLE_METRICS_PUSH_URL; the
+    # executor step loop arms them too, for un-launched processes)
+    from ..telemetry import debugz, export
+
+    debugz.maybe_serve()
+    export.maybe_start()
     world = get_world_size()
     if world > 1:
         import jax

@@ -1,5 +1,6 @@
-"""Unified telemetry (ISSUE 4): metrics registry + JSONL sink +
-distributed timeline + straggler detection.
+"""Unified telemetry (ISSUE 4 + ISSUE 6): metrics registry + JSONL sink
++ distributed timeline + straggler detection + per-op device-time
+attribution + live introspection.
 
 Layering:
 
@@ -8,15 +9,23 @@ Layering:
   telemetry.sink       per-step JSONL records (PADDLE_METRICS_PATH)
   telemetry.timeline   merge per-rank chrome traces (launcher)
   telemetry.straggler  per-rank step-rate comparison (launcher)
+  telemetry.cost       per-op device-time attribution: xplane events
+                       joined back to Program IR ops via FLAGS_op_profile
+                       named scopes; CostReport + measured-MFU gauge
+  telemetry.debugz     introspection HTTP server (PADDLE_DEBUGZ_PORT):
+                       /metrics /statusz /steps /proftop /healthz
+  telemetry.export     periodic push exporter (PADDLE_METRICS_PUSH_URL):
+                       OTLP-shaped snapshot() JSON or pushgateway text
   fluid/monitor.py     the executor-facing step-time breakdown built on
                        the registry + sink
 
-Everything here is dependency-free (stdlib only) so the pserver and
-launcher processes can import it without pulling jax.
+Module tops are dependency-free (stdlib only) so the pserver and
+launcher processes can import the package without pulling jax; cost.py
+imports jax/protobuf inside functions for the same reason.
 """
 from __future__ import annotations
 
-from . import sink, straggler, timeline  # noqa: F401
+from . import cost, debugz, export, sink, straggler, timeline  # noqa: F401
 from .registry import (  # noqa: F401
     BYTE_BUCKETS,
     DEFAULT_MS_BUCKETS,

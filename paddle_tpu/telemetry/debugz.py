@@ -1,0 +1,203 @@
+"""debugz: live introspection HTTP server (ISSUE 6).
+
+The in-process answer to "what is this trainer doing RIGHT NOW" —
+borgmon-style status pages served straight from the process state, no
+sidecar, no log scraping:
+
+  /metrics   Prometheus text exposition of the process registry
+             (point a scraper at it, or curl it)
+  /statusz   build + flags + mesh + step summary (JSON)
+  /steps     recent per-step breakdown records (JSON list; the same
+             schema the PADDLE_METRICS_PATH JSONL sink writes)
+  /proftop   last per-op cost report built in this process (JSON;
+             404-shaped {} until telemetry.cost builds one)
+  /healthz   "ok" — liveness for orchestration probes
+
+Arming: PADDLE_DEBUGZ_PORT=<port> starts the server on first executor
+step (fluid/monitor.mark_step calls maybe_serve once), or call serve()
+explicitly. launch.py --debugz_port B arms every trainer with a
+deterministic per-rank offset (rank r serves on B + r), so a fleet's
+pages are addressable without discovery. Port 0 binds an ephemeral port
+(tests); the bound port is on `server.server_address`. Unset = nothing
+listens and nothing is imported — the flag-off cost is one env read.
+
+The server is a daemon-threaded stdlib ThreadingHTTPServer: requests
+never block training, and the thread dies with the process.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+ENV_PORT = "PADDLE_DEBUGZ_PORT"
+
+_server = None
+_checked = False
+_lock = threading.Lock()
+
+
+def _statusz() -> dict:
+    """Build + flags + mesh + step summary. Imports stay inside: the
+    page reports whatever is importable and never takes the process
+    down with it."""
+    out: dict = {"pid": os.getpid(),
+                 "rank": os.environ.get("PADDLE_TRAINER_ID"),
+                 "role": os.environ.get("PADDLE_TRAINING_ROLE"),
+                 "endpoint": os.environ.get("PADDLE_CURRENT_ENDPOINT")}
+    try:
+        import paddle_tpu
+
+        out["build"] = {"paddle_tpu": getattr(paddle_tpu, "__version__",
+                                              "dev")}
+    except Exception:  # noqa: BLE001
+        out["build"] = {}
+    try:
+        import jax
+
+        out["build"]["jax"] = jax.__version__
+        out["build"]["backend"] = jax.default_backend()
+        out["build"]["device_count"] = jax.device_count()
+    except Exception:  # noqa: BLE001 — report pages must never crash
+        pass
+    try:
+        from ..fluid import flags as fl
+
+        out["flags"] = dict(fl._values)
+    except Exception:  # noqa: BLE001
+        out["flags"] = {}
+    try:
+        from ..fluid import framework
+
+        mesh = framework.default_main_program()._mesh
+        out["mesh"] = (
+            {"axes": dict(zip(mesh.axis_names,
+                              (int(s) for s in mesh.devices.shape)))}
+            if mesh is not None else None)
+    except Exception:  # noqa: BLE001
+        out["mesh"] = None
+    try:
+        from ..fluid import monitor
+
+        n, avg = monitor.step_rate_sample()
+        out["steps"] = {"completed": n, "avg_step_s": avg}
+    except Exception:  # noqa: BLE001
+        out["steps"] = None
+    return out
+
+
+def _route(path: str):
+    """(status, content_type, body bytes) for a request path."""
+    from .registry import get_registry
+
+    if path in ("/healthz", "/health"):
+        return 200, "text/plain; charset=utf-8", b"ok\n"
+    if path == "/metrics":
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                get_registry().to_prometheus().encode())
+    if path == "/statusz":
+        return (200, "application/json",
+                json.dumps(_statusz(), default=str).encode())
+    if path == "/steps":
+        try:
+            from ..fluid import monitor
+
+            body = json.dumps(monitor.recent_steps()).encode()
+        except Exception:  # noqa: BLE001
+            body = b"[]"
+        return 200, "application/json", body
+    if path == "/proftop":
+        from . import cost
+
+        rep = cost.last_report()
+        if rep is None:
+            return (404, "application/json",
+                    json.dumps({"error": "no cost report built yet; run "
+                                "with FLAGS_op_profile (tools/proftop.py "
+                                "or telemetry.cost.profile_executor_run)"
+                                }).encode())
+        return 200, "application/json", json.dumps(rep.to_json()).encode()
+    if path in ("", "/", "/index.html"):
+        return (200, "text/plain; charset=utf-8",
+                b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
+                b"/healthz\n")
+    return 404, "text/plain; charset=utf-8", b"not found\n"
+
+
+def serve(port: Optional[int] = None, host: str = "0.0.0.0"):
+    """Start the introspection server (idempotent per process) and
+    return it; `server.server_address[1]` is the bound port (useful with
+    port 0). The serving thread is a daemon — no shutdown bookkeeping
+    needed, but stop() exists for tests."""
+    global _server
+    with _lock:
+        if _server is not None:
+            return _server
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                try:
+                    status, ctype, body = _route(self.path.split("?")[0])
+                except Exception as e:  # noqa: BLE001 — never take the
+                    # trainer down for a status page
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    body = f"debugz error: {type(e).__name__}: {e}\n".encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+        if port is None:
+            port = int(os.environ.get(ENV_PORT, "0") or 0)
+        srv = ThreadingHTTPServer((host, port), Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="paddle-tpu-debugz").start()
+        _server = srv
+        return srv
+
+
+def maybe_serve():
+    """Arm from PADDLE_DEBUGZ_PORT (launch.py sets it per rank with
+    deterministic offsets). No-op — one env read — when unset; resolved
+    once per process."""
+    global _checked
+    if _checked:
+        return _server
+    _checked = True
+    raw = os.environ.get(ENV_PORT)
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    try:
+        return serve(port)
+    except OSError as e:
+        import sys
+
+        print(f"[debugz] could not bind port {port}: {e}; introspection "
+              f"server disabled", file=sys.stderr)
+        return None
+
+
+def armed() -> bool:
+    return _server is not None
+
+
+def stop():
+    """Tests only: shut the server down and allow a re-serve."""
+    global _server, _checked
+    with _lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+        _server = None
+        _checked = False

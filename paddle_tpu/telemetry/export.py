@@ -1,0 +1,185 @@
+"""Metrics push exporter (ISSUE 6 satellite; closes the ROADMAP OTLP/
+pushgateway follow-on).
+
+Periodically POSTs the process registry to PADDLE_METRICS_PUSH_URL:
+
+  * JSON mode (default): the registry's snapshot() — already OTLP-shaped
+    ({name: {type, series: [{labels, value|summary}]}}) — wrapped with a
+    resource block (rank/pid/job), for OTLP-ish JSON collectors.
+  * Prometheus mode: the text exposition, for a Prometheus pushgateway.
+    Selected when the URL contains "/metrics/job" (the pushgateway path
+    convention) or PADDLE_METRICS_PUSH_FORMAT=prom; pushgateway merges
+    by job/instance labels in the URL, so the caller encodes those.
+
+Delivery contract: one POST per interval (PADDLE_METRICS_PUSH_SECS,
+default 15s), bounded retry on failure — PADDLE_METRICS_PUSH_RETRIES
+attempts (default 3) with exponential backoff + jitter — then the
+sample is DROPPED and counted (metrics_push_failures_total); the next
+interval pushes fresh state, so a dead collector costs bounded work and
+zero unbounded queueing. Flag-off (env unset) = zero network, zero
+threads, one env read per process.
+
+stdlib-only (urllib) by design: the pserver and launcher can push too.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from .registry import get_registry
+
+ENV_URL = "PADDLE_METRICS_PUSH_URL"
+ENV_SECS = "PADDLE_METRICS_PUSH_SECS"
+ENV_RETRIES = "PADDLE_METRICS_PUSH_RETRIES"
+ENV_FORMAT = "PADDLE_METRICS_PUSH_FORMAT"
+
+_exporter: Optional["PushExporter"] = None
+_checked = False
+_lock = threading.Lock()
+
+
+class PushExporter:
+    """Daemon-thread periodic pusher. start() is idempotent; flush()
+    pushes one sample synchronously (tests and atexit-style final
+    pushes)."""
+
+    def __init__(self, url: str, interval_s: float = 15.0,
+                 retries: int = 3, fmt: Optional[str] = None,
+                 timeout_s: float = 5.0, backoff_s: float = 0.2):
+        self.url = url
+        self.interval_s = max(0.05, float(interval_s))
+        self.retries = max(1, int(retries))
+        self.timeout_s = timeout_s
+        self.backoff_s = backoff_s
+        if fmt is None:
+            fmt = "prom" if "/metrics/job" in url else "json"
+        self.fmt = fmt
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._pushed = reg.counter(
+            "metrics_push_total", "successful metrics pushes")
+        self._failed = reg.counter(
+            "metrics_push_failures_total",
+            "metrics samples dropped after the bounded retry budget")
+
+    # -- payload ---------------------------------------------------------
+    def _body(self):
+        if self.fmt == "prom":
+            return (get_registry().to_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+        payload = {
+            "resource": {
+                "job": os.environ.get("PADDLE_JOB_NAME", "paddle_tpu"),
+                "rank": os.environ.get("PADDLE_TRAINER_ID"),
+                "role": os.environ.get("PADDLE_TRAINING_ROLE"),
+                "pid": os.getpid(),
+            },
+            "ts": round(time.time(), 6),
+            "metrics": get_registry().snapshot(),
+        }
+        return json.dumps(payload).encode(), "application/json"
+
+    # -- delivery --------------------------------------------------------
+    def _post_once(self, body: bytes, ctype: str) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            resp.read()
+
+    def flush(self) -> bool:
+        """Push one sample now; True on delivery, False when the retry
+        budget is exhausted (the sample is dropped and counted)."""
+        body, ctype = self._body()
+        for attempt in range(self.retries):
+            try:
+                self._post_once(body, ctype)
+                self._pushed.inc()
+                return True
+            except Exception:  # noqa: BLE001 — collector down/unreachable
+                if attempt + 1 >= self.retries:
+                    break
+                # exp backoff + jitter: a fleet of ranks whose collector
+                # hiccuped must not retry in lockstep
+                delay = self.backoff_s * (2 ** attempt)
+                self._stop.wait(delay * (0.5 + random.random()))
+                if self._stop.is_set():
+                    break
+        self._failed.inc()
+        return False
+
+    # -- lifecycle -------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def start(self) -> "PushExporter":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="paddle-tpu-metrics-push")
+            self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = False):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if final_flush:
+            self.flush()
+
+
+def start(url: str, **kwargs) -> PushExporter:
+    """Explicit start (programmatic alternative to the env contract)."""
+    global _exporter, _checked
+    with _lock:
+        if _exporter is not None:
+            _exporter.stop()
+        _exporter = PushExporter(url, **kwargs).start()
+        _checked = True
+        return _exporter
+
+
+def maybe_start() -> Optional[PushExporter]:
+    """Arm from PADDLE_METRICS_PUSH_URL; resolved once per process.
+    Unset = None and never another env read."""
+    global _exporter, _checked
+    if _checked:
+        return _exporter
+    with _lock:
+        if _checked:
+            return _exporter
+        _checked = True
+        url = os.environ.get(ENV_URL)
+        if not url:
+            return None
+        _exporter = PushExporter(
+            url,
+            interval_s=float(os.environ.get(ENV_SECS, "15") or 15),
+            retries=int(os.environ.get(ENV_RETRIES, "3") or 3),
+            fmt=(os.environ.get(ENV_FORMAT) or None),
+        ).start()
+        return _exporter
+
+
+def active() -> Optional[PushExporter]:
+    return _exporter
+
+
+def stop():
+    """Tests: tear down and allow re-arming."""
+    global _exporter, _checked
+    with _lock:
+        if _exporter is not None:
+            _exporter.stop()
+        _exporter = None
+        _checked = False

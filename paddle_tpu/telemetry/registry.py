@@ -32,10 +32,18 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text exposition escaping for label VALUES: backslash,
+    double-quote and newline (exposition format 0.0.4 spec) — a path or
+    free-text label must not tear the sample line."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labelkey) -> str:
     if not labelkey:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labelkey)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labelkey)
     return "{" + inner + "}"
 
 
@@ -106,22 +114,26 @@ class Histogram:
             self.max = v if self.max is None else max(self.max, v)
 
     def summary(self) -> dict:
+        """count/sum/min/max/avg. An EMPTY histogram reports zeros, not
+        Nones — consumers (debugz pages, exporters, report arithmetic)
+        must never have to None-guard a summary field."""
         with self._lock:
             return {
                 "count": self.count,
                 "sum": round(self.sum, 6),
-                "min": self.min,
-                "max": self.max,
-                "avg": round(self.sum / self.count, 6) if self.count else None,
+                "min": self.min if self.min is not None else 0.0,
+                "max": self.max if self.max is not None else 0.0,
+                "avg": round(self.sum / self.count, 6) if self.count else 0.0,
             }
 
-    def quantile(self, q: float) -> Optional[float]:
+    def quantile(self, q: float) -> float:
         """Bucket-boundary estimate of the q-quantile (upper boundary of
-        the bucket containing it); None when empty, max for the overflow
-        bucket."""
+        the bucket containing it); max for the overflow bucket. An empty
+        histogram reports 0.0 — well-defined instead of None-propagating
+        into consumers."""
         with self._lock:
             if not self.count:
-                return None
+                return 0.0
             target = q * self.count
             acc = 0
             for i, c in enumerate(self.counts):

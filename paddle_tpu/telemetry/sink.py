@@ -24,7 +24,13 @@ path does no I/O and allocates nothing.
 
 A `%r`/`{rank}` placeholder in the path expands to the trainer rank so
 launched jobs don't interleave writers; otherwise a rank suffix is
-appended automatically when PADDLE_TRAINER_ID > 0.
+appended automatically when PADDLE_TRAINER_ID > 0. When
+PADDLE_TRAINER_ID is UNSET (processes not started by the launcher), the
+placeholder — and the `.rank0` that two un-launched local processes
+would otherwise collide on — falls back to the PID, so sharing one
+PADDLE_METRICS_PATH template across ad-hoc processes yields one file
+each. An explicit placeholder-free path stays exactly as given (the
+single-process contract tools and CI read).
 """
 from __future__ import annotations
 
@@ -45,10 +51,14 @@ def _rank() -> int:
 
 
 def _expand(path: str, rank: int) -> str:
+    # no launcher rank: two local processes sharing one path template
+    # must not interleave into a single file — the PID is the suffix
+    launched = "PADDLE_TRAINER_ID" in os.environ
+    tag = str(rank) if launched else f"pid{os.getpid()}"
     if "{rank}" in path:
-        return path.replace("{rank}", str(rank))
+        return path.replace("{rank}", tag)
     if "%r" in path:
-        return path.replace("%r", str(rank))
+        return path.replace("%r", tag)
     if rank:
         root, ext = os.path.splitext(path)
         return f"{root}.rank{rank}{ext or '.jsonl'}"

@@ -99,6 +99,72 @@ JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50
 JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50 --fuse --backward
 JAX_PLATFORMS=cpu python tools/proglint.py --model bert --backward
 
+echo "== proftop smoke (per-op device-time attribution + debugz) =="
+# ISSUE 6 acceptance: a 3-step profiled CPU train (FLAGS_op_profile
+# named scopes -> xplane join) must attribute >=90% of device-op time
+# to named op scopes on BOTH bench models, every reported row must
+# carry an op index + user callstack, and the measured-MFU gauge must
+# agree with bench.py's model formula within the documented 2x
+# tolerance (same time base; the ratio compares flop accounting)
+JAX_PLATFORMS=cpu python tools/proftop.py --model resnet50 --steps 3 \
+  --json > /tmp/ci_proftop_resnet50.json
+JAX_PLATFORMS=cpu python tools/proftop.py --model bert --steps 3 \
+  --json > /tmp/ci_proftop_bert.json
+python - <<'PY'
+import json
+
+for model in ("resnet50", "bert"):
+    rep = json.load(open(f"/tmp/ci_proftop_{model}.json"))
+    assert rep["model"] == model
+    assert rep["coverage"] >= 0.9, (model, rep["coverage"])
+    assert rep["rows"], f"{model}: no attributed op rows"
+    for row in rep["rows"]:
+        assert row["op_index"] >= 0, (model, row)
+        assert row["layer"], (model, row["scope"], "missing callstack")
+    ratio = rep["measured_mfu"] / rep["formula_mfu"]
+    assert 0.5 <= ratio <= 2.0, (model, ratio)
+    print(f"proftop {model}: coverage {rep['coverage']:.3f}, "
+          f"{len(rep['rows'])} rows, measured/formula MFU {ratio:.2f}")
+PY
+# debugz: the introspection server must serve one valid /metrics scrape
+# (and /steps) off a 3-step train armed only by PADDLE_DEBUGZ_PORT
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import urllib.request
+
+os.environ["PADDLE_DEBUGZ_PORT"] = "0"  # ephemeral port
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = layers.data("x", [16, 8], append_batch_size=False)
+    y = layers.data("y", [16, 1], append_batch_size=False)
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor()
+exe.run(startup)
+rng = np.random.RandomState(0)
+xa = rng.rand(16, 8).astype(np.float32)
+ya = xa.sum(1, keepdims=True).astype(np.float32)
+for _ in range(3):
+    exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[loss])
+from paddle_tpu.telemetry import debugz
+
+assert debugz.armed(), "PADDLE_DEBUGZ_PORT did not arm the server"
+port = debugz._server.server_address[1]
+scrape = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+assert "# TYPE executor_steps_total counter" in scrape
+steps = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/steps", timeout=5).read().decode())
+assert steps and steps[-1]["step"] >= steps[0]["step"]
+print(f"debugz OK: /metrics scraped ({len(scrape.splitlines())} lines), "
+      f"{len(steps)} step records on /steps")
+PY
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""proftop — per-op device-time attribution for Program IR graphs
+(telemetry/cost.py; the `top` for one XLA-compiled training step).
+
+Two modes:
+
+  --model <name>   build the bench model (proglint's model-builder
+                   plumbing), train a few profiled steps on the local
+                   backend under FLAGS_op_profile, and print the joined
+                   cost report: top-K ops by device time, per-op-type /
+                   per-layer rollups, attribution coverage, and the
+                   measured-MFU gauge cross-checked against bench.py's
+                   model-formula flops.
+  --trace_dir D    aggregate an EXISTING xplane trace (any jax profiler
+                   dump) by HLO instruction; pass --hlo <file> (the
+                   optimized HLO text, e.g. Executor.aot_step(...)
+                   .as_text()) to additionally join op scopes.
+
+Examples:
+
+    python tools/proftop.py --model resnet50
+    python tools/proftop.py --model bert --steps 5 --topk 10 --json
+    python tools/proftop.py --trace_dir /tmp/prof --hlo step.hlo.txt
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS_DIR))  # repo root: paddle_tpu
+if _TOOLS_DIR not in sys.path:  # tools/: proglint (in-process importers)
+    sys.path.insert(0, _TOOLS_DIR)
+
+from proglint import MODELS, build_bench_model  # noqa: E402 — path above
+
+
+def _random_feed(model, cfg, args):
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    if model.startswith("resnet"):
+        return {
+            "image": rng.rand(args.batch, 3, args.image_size,
+                              args.image_size).astype(np.float32),
+            "label": rng.randint(0, cfg.num_classes,
+                                 (args.batch, 1)).astype(np.int64),
+        }
+    from paddle_tpu.models.bert import random_pretrain_batch
+
+    return random_pretrain_batch(cfg, args.batch, args.seq, args.max_preds,
+                                 seed=0)
+
+
+def _formula_flops(model, cfg, args):
+    """bench.py's closed-form model flops per step (fwd+bwd) — the
+    cross-check input for the measured-MFU gauge."""
+    if model.startswith("resnet"):
+        from paddle_tpu.models.resnet import resnet_step_flops
+
+        return resnet_step_flops(cfg, args.batch, args.image_size)
+    import bench
+
+    return bench._bert_step_flops(cfg, args.batch, args.seq)
+
+
+def _profile_model(args):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.telemetry import cost
+
+    main, startup, feeds, loss, cfg = build_bench_model(
+        args.model, args.batch, args.image_size, args.seq, args.max_preds)
+    with fluid.program_guard(main, startup):
+        if args.model.startswith("resnet"):
+            opt = fluid.optimizer.MomentumOptimizer(
+                learning_rate=0.1, momentum=0.9)
+        else:
+            opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = _random_feed(args.model, cfg, args)
+    return cost.profile_executor_run(
+        exe, main, feed, [loss], steps=args.steps,
+        formula_flops_per_step=_formula_flops(args.model, cfg, args),
+        model=args.model)
+
+
+def _aggregate_trace(args):
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.telemetry import cost
+
+    events = profiler.xplane_op_events(args.trace_dir)
+    hlo_text = ""
+    if args.hlo:
+        with open(args.hlo) as f:
+            hlo_text = f.read()
+    return cost.build_cost_report(events, hlo_text, steps=args.steps,
+                                  model=None), events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proftop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model", help=f"bench model to build and profile: "
+                     f"{', '.join(MODELS)}")
+    src.add_argument("--trace_dir", help="existing xplane trace dir to "
+                     "aggregate (jax profiler dump)")
+    ap.add_argument("--hlo", help="optimized HLO text file to join op "
+                    "scopes from (with --trace_dir)")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="profiled steps (--model) / steps the trace "
+                    "covers (--trace_dir; scales per-step numbers)")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--max-preds", type=int, default=8)
+    ap.add_argument("--topk", type=int, default=20)
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object (the full report) on stdout")
+    args = ap.parse_args(argv)
+
+    if args.model:
+        report = _profile_model(args)
+    else:
+        report, events = _aggregate_trace(args)
+        if not args.hlo:
+            # no HLO join: the honest output is the raw instruction table
+            rows = sorted(((n, e["dur_ps"] / 1e9, e["count"])
+                           for n, e in events.items()),
+                          key=lambda r: -r[1])
+            if args.json:
+                print(json.dumps({"instructions": [
+                    {"name": n, "device_ms": round(ms, 3), "count": c}
+                    for n, ms, c in rows[:args.topk]]}))
+            else:
+                print(f"{'instruction':<50}{'ms':>10}{'count':>8}")
+                for n, ms, c in rows[:args.topk]:
+                    print(f"{n[:49]:<50}{ms:>10.3f}{c:>8}")
+            return 0 if rows else 1
+
+    if args.json:
+        print(json.dumps(report.to_json(args.topk)))
+    else:
+        print(report.format_table(args.topk))
+    if not report.rows:
+        print("proftop: no attributed op events (is the trace empty, or "
+              "was the step traced without FLAGS_op_profile?)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,36 +25,46 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 RESNETS = ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152")
+MODELS = RESNETS + ("bert",)
 
 
-def _build_model(args):
-    """Returns [(label, program, live_out)] for the requested model.
-    Tiny default shapes: lint coverage depends on graph STRUCTURE, not
-    batch size, and CI wants this cheap."""
+def build_bench_model(model: str, batch: int = 2, image_size: int = 64,
+                      seq: int = 64, max_preds: int = 8):
+    """Build one bench model's train graph (shared model-builder
+    plumbing: proglint lints it, proftop profiles it). Returns
+    (main, startup, feeds, loss, cfg). Tiny default shapes: lint/profile
+    coverage depends on graph STRUCTURE, not batch size, and CI wants
+    this cheap."""
     import paddle_tpu.fluid as fluid
 
-    if args.model in RESNETS:
+    if model in RESNETS:
         from paddle_tpu.models.resnet import (
             ResNetConfig,
             build_resnet_train_program,
         )
 
-        cfg = getattr(ResNetConfig, args.model)()
+        cfg = getattr(ResNetConfig, model)()
         main, startup, feeds, loss = build_resnet_train_program(
-            cfg, args.batch, args.image_size, fluid.Program(),
-            fluid.Program())
-    elif args.model == "bert":
+            cfg, batch, image_size, fluid.Program(), fluid.Program())
+    elif model == "bert":
         from paddle_tpu.models.bert import (
             BertConfig,
             build_bert_pretrain_program,
         )
 
+        cfg = BertConfig()
         main, startup, feeds, loss = build_bert_pretrain_program(
-            BertConfig(), args.batch, args.seq, args.max_preds)
+            cfg, batch, seq, max_preds)
     else:
         raise SystemExit(
-            f"unknown --model {args.model!r} (choose from "
-            f"{', '.join(RESNETS + ('bert',))})")
+            f"unknown --model {model!r} (choose from {', '.join(MODELS)})")
+    return main, startup, feeds, loss, cfg
+
+
+def _build_model(args):
+    """Returns [(label, program, live_out)] for the requested model."""
+    main, startup, feeds, loss, _cfg = build_bench_model(
+        args.model, args.batch, args.image_size, args.seq, args.max_preds)
 
     if args.fuse:
         from paddle_tpu.fluid.fusion_pass import apply_conv_bn_fusion
