@@ -77,7 +77,10 @@ def _maybe_op_profile(exe, program, data, loss, formula_flops_per_step,
     under FLAGS_op_profile and report the measured-MFU gauge + per-op
     attribution coverage in the bench row (telemetry/cost.py; the full
     report lands on the debugz /proftop endpoint and in the registry).
-    Off = empty dict, the timed loop untouched."""
+    The full CostReport is also persisted beside the BENCH_*.json rows
+    as bench_artifacts/proftop_<model>_rNN.json (NN = next free round),
+    so per-op cost history accumulates across rounds for regression
+    diffing. Off = empty dict, the timed loop untouched."""
     if os.environ.get("BENCH_OP_PROFILE", "0") != "1":
         return {}
     from paddle_tpu.telemetry import cost
@@ -86,10 +89,40 @@ def _maybe_op_profile(exe, program, data, loss, formula_flops_per_step,
         exe, program, data, [loss],
         steps=int(os.environ.get("BENCH_OP_PROFILE_STEPS", "3")),
         formula_flops_per_step=formula_flops_per_step, model=model)
+    _persist_cost_report(rep, model)
     return {
         "measured_mfu": rep.measured_mfu,
         "op_profile_coverage": round(rep.coverage, 4),
     }
+
+
+def _persist_cost_report(rep, model) -> None:
+    """Write the CostReport to bench_artifacts/proftop_<model>_rNN.json
+    (atomic; NN picks up where the existing history leaves off —
+    `diff`-able per-op cost rows across bench rounds). BENCH_ARTIFACTS
+    overrides the directory; failures never fail the bench."""
+    import glob
+    import re
+
+    try:
+        art_dir = os.environ.get("BENCH_ARTIFACTS") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_artifacts")
+        os.makedirs(art_dir, exist_ok=True)
+        taken = []
+        for p in glob.glob(os.path.join(art_dir, f"proftop_{model}_r*.json")):
+            m = re.search(r"_r(\d+)\.json$", p)
+            if m:
+                taken.append(int(m.group(1)))
+        path = os.path.join(
+            art_dir, f"proftop_{model}_r{max(taken, default=0) + 1:02d}.json")
+        blob = json.dumps(rep.to_json(), indent=1)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        print(f"# proftop report persisted: {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — history is best-effort
+        print(f"# proftop report persist failed: {e}", file=sys.stderr)
 
 
 def _emit_result(result: dict) -> None:

@@ -28,6 +28,19 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
             kill    server side: os._exit(1) the pserver process once it
                     has handled <nth> RPCs in total (method filter still
                     applies): exercises supervision + snapshot recovery
+            slow    server side, REPEATING: every <nth>-th handled RPC
+                    matching the verb sleeps <arg> MILLISECONDS before
+                    being served — deterministic tail-latency injection
+                    (the hedged-read drill: a slow primary must lose the
+                    race to a backup hedge)
+            partition  server side, LATCHING: once this server has
+                    handled <nth> RPCs it enters a partitioned state —
+                    still reachable (reads, pings) but REJECTING
+                    replication traffic (`replicate` forwards), so a
+                    backup replica goes stale exactly the way a
+                    primary<->backup network partition makes it. The
+                    <method> field names the pserver tag to partition
+                    ("ps1") or "*" for any
             crash   phase side: os._exit(1) at the Nth arrival at a
                     named code phase (crash_point(phase) call sites; the
                     <method> field names the phase). Checkpoint commit
@@ -46,6 +59,14 @@ client issues and kills the pserver after it has handled 40 RPCs.
 
 Counting is per-process and per-rule, so the schedule is a pure function
 of the RPC sequence — reruns inject the same faults at the same points.
+Supervised pserver RESPAWNS get PADDLE_PS_FAULT_SPEC cleared by the
+launcher: a kill rule means "kill this server once", not "kill every
+incarnation from its own RPC-count zero".
+
+Process scoping: PADDLE_PS_FAULT_TAGS (comma-separated) arms the layer
+only in processes whose PADDLE_PS_RANK_TAG ("ps0") or trainer id
+("trainer1") is listed — so a replication drill can kill ONE pserver of
+a replicated pair instead of every process that shares the env.
 """
 from __future__ import annotations
 
@@ -55,9 +76,10 @@ import time
 from typing import List, Optional
 
 ENV_SPEC = "PADDLE_PS_FAULT_SPEC"
+ENV_TAGS = "PADDLE_PS_FAULT_TAGS"
 
 _CLIENT_ACTIONS = ("drop", "refuse", "delay")
-_SERVER_ACTIONS = ("kill",)
+_SERVER_ACTIONS = ("kill", "slow", "partition")
 _PHASE_ACTIONS = ("crash",)
 
 
@@ -135,6 +157,7 @@ class FaultInjector:
         self._rules = parse_spec(spec)
         self._lock = threading.Lock()
         self._server_calls = 0
+        self.partitioned = False  # latched by a fired `partition` rule
 
     def _take(self, site_actions, method: str) -> List[_Rule]:
         """Advance matching rules' counters; return the rules firing NOW."""
@@ -148,6 +171,22 @@ class FaultInjector:
                 r.count += 1
                 if r.count == r.nth:
                     r.fired = True
+                    firing.append(r)
+        return firing
+
+    def _take_every(self, site_actions, method: str) -> List[_Rule]:
+        """REPEATING variant (`slow`): fires on every nth-th match —
+        count % nth == 0 — and never spends the rule, so 1/nth of the
+        matching calls see the fault (a deterministic latency tail)."""
+        firing = []
+        with self._lock:
+            for r in self._rules:
+                if r.action not in site_actions:
+                    continue
+                if not r.matches(method):
+                    continue
+                r.count += 1
+                if r.count % r.nth == 0:
                     firing.append(r)
         return firing
 
@@ -172,6 +211,23 @@ class FaultInjector:
             os.write(2, (f"[faults] killing pserver pid {os.getpid()} "
                          f"(rule kill:{r.method}:{r.nth})\n").encode())
             os._exit(1)
+        for r in self._take_every(("slow",), method):
+            time.sleep(r.arg / 1000.0)  # arg is MILLISECONDS
+        # partition rules match the server's TAG, not the RPC verb, and
+        # count every handled RPC; once fired the injector latches
+        tag = os.environ.get("PADDLE_PS_RANK_TAG", "")
+        for r in self._take(("partition",), tag):
+            os.write(2, (f"[faults] partitioning pserver {tag or '?'} pid "
+                         f"{os.getpid()} (rule partition:{r.method}:"
+                         f"{r.nth}): reachable but rejecting replication"
+                         f"\n").encode())
+            with self._lock:
+                self.partitioned = True
+
+    def blocks_replication(self) -> bool:
+        """True once a `partition` rule fired: this server must reject
+        `replicate` forwards (reachable-but-stale backup)."""
+        return self.partitioned
 
     # -- phase side ------------------------------------------------------
     def at_phase(self, phase: str) -> None:
@@ -198,6 +254,16 @@ def injector() -> Optional[FaultInjector]:
     spec = os.environ.get(ENV_SPEC, "")
     if not spec.strip():
         return None
+    tags = os.environ.get(ENV_TAGS, "").strip()
+    if tags:
+        # scoped arming: only processes named in PADDLE_PS_FAULT_TAGS
+        # ("ps0", "trainer1") see the schedule — a replicated drill can
+        # fault ONE replica of a pair
+        mine = {os.environ.get("PADDLE_PS_RANK_TAG") or "",
+                "trainer" + os.environ.get("PADDLE_TRAINER_ID", "")}
+        wanted = {t.strip() for t in tags.split(",") if t.strip()}
+        if not (wanted & mine):
+            return None
     global _injector
     with _injector_lock:
         if _injector is None or _injector.spec != spec:

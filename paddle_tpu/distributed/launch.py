@@ -167,6 +167,26 @@ def _parse_args(argv):
         "> 0 (supervision without snapshots would restart pservers "
         "EMPTY), else 0 (off)",
     )
+    p.add_argument(
+        "--ps_snapshot_mode", default=None,
+        choices=[None, "full", "incremental"],
+        help="pserver snapshot format: 'full' rewrites every table each "
+        "tick (the default); 'incremental' writes a periodic base plus "
+        "checksummed dirty-row delta files — O(touched rows) per tick, "
+        "which makes sub-second --ps_snapshot_secs viable on multi-GB "
+        "tables. Default: PADDLE_PS_SNAPSHOT_MODE if set, else full",
+    )
+    p.add_argument(
+        "--ps_replication", type=int, default=None,
+        help="replication factor R for hosted PS tables: each row "
+        "partition gets a primary pserver plus R-1 prefix-consistent "
+        "backups on distinct pservers (needs --server_num >= R). "
+        "Trainers fail over to a backup when a primary dies — no "
+        "respawn-wait — and hedge slow reads to backups; the supervisor "
+        "respawn then rejoins via anti-entropy resync. Default: "
+        "PADDLE_PS_REPLICATION if set, else 1 (today's unreplicated "
+        "data plane)",
+    )
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -178,7 +198,8 @@ def _spawn_pserver(idx: int, host: str, port: int,
                    snapshot_secs: float = 0.0,
                    preload_snapshots: bool = False,
                    heartbeat_dir: Optional[str] = None,
-                   log_mode: str = "w") -> subprocess.Popen:
+                   log_mode: str = "w",
+                   clear_fault_spec: bool = False) -> subprocess.Popen:
     """Fork one pserver child and wait for its bound-port banner; the
     caller learns the bound port via proc.ps_bound_port. Snapshots live
     in a PER-SERVER subdir of snapshot_root — each server hosts its own
@@ -189,6 +210,12 @@ def _spawn_pserver(idx: int, host: str, port: int,
     env = dict(os.environ)
     env["PADDLE_TRAINING_ROLE"] = "PSERVER"
     env["PADDLE_PS_RANK_TAG"] = f"ps{idx}"
+    if clear_fault_spec:
+        # a RESPAWNED pserver must not replay the deterministic fault
+        # schedule from RPC-count zero — a `kill:*:N` drill means "kill
+        # this server once", not "kill every incarnation", which would
+        # burn the whole restart budget on one rule
+        env.pop("PADDLE_PS_FAULT_SPEC", None)
     if heartbeat_dir:
         env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
     snap = os.path.join(snapshot_root, f"ps{idx}") if snapshot_root else None
@@ -346,7 +373,8 @@ class PServerSupervisor:
                     snapshot_root=self.snapshot_dir,
                     snapshot_secs=self.snapshot_secs,
                     preload_snapshots=True,
-                    heartbeat_dir=self.heartbeat_dir, log_mode="a")
+                    heartbeat_dir=self.heartbeat_dir, log_mode="a",
+                    clear_fault_spec=True)
             except RuntimeError as e:
                 print(f"[launch] pserver {p.idx} respawn failed: {e}; "
                       f"aborting the job", file=sys.stderr)
@@ -561,6 +589,20 @@ def launch(argv=None) -> int:
     snapshot_dir = None
     own_snapshot_dir = False
     adopt_snapshots = False
+    if args.ps_snapshot_mode:
+        # pservers inherit it through _spawn_pserver's env copy
+        os.environ["PADDLE_PS_SNAPSHOT_MODE"] = args.ps_snapshot_mode
+    if args.ps_replication is not None:
+        if args.ps_replication > 1 and not (args.server_num >= args.ps_replication
+                                            or args.servers):
+            print(f"[launch] --ps_replication {args.ps_replication} needs "
+                  f"at least that many pservers (--server_num)",
+                  file=sys.stderr)
+            return 2
+        # trainers inherit it through start_local_trainers' env copy;
+        # RemoteTable reads it as the default replication factor
+        os.environ["PADDLE_PS_REPLICATION"] = str(args.ps_replication)
+
     try:
         if args.server_num or args.servers:
             if snapshot_secs > 0:

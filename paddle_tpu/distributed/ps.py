@@ -71,6 +71,11 @@ class ShardedHostTable:
         self._shards: List[np.ndarray] = []
         self._accum: List[Optional[np.ndarray]] = []
         self._locks = [threading.Lock() for _ in range(self.num_shards)]
+        # dirty-row tracking (incremental snapshots): per-shard LOCAL row
+        # indices touched by push_* since the last drain_dirty(). Always
+        # on — a set-update per push is noise next to the scatter itself,
+        # and the snapshotter decides whether to use it
+        self._dirty: List[set] = [set() for _ in range(self.num_shards)]
         for s in range(self.num_shards):
             n = (self.rows - s + self.num_shards - 1) // self.num_shards
             self._shards.append(rng.normal(0.0, std, (n, self.dim)).astype(self.dtype))
@@ -122,6 +127,7 @@ class ShardedHostTable:
                 self._shards[s][local[m]] = (
                     self._shards[s][local[m]].astype(np.float32) + acc[m]
                 ).astype(self.dtype)
+                self._dirty[s].update(local[m].tolist())
 
     def push_gradients(self, ids, grads) -> None:
         """Apply the server-side optimizer for the touched rows. Repeated
@@ -150,6 +156,7 @@ class ShardedHostTable:
                 self._shards[s][rows] = (
                     self._shards[s][rows].astype(np.float32) - lr * g
                 ).astype(self.dtype)
+                self._dirty[s].update(rows.tolist())
 
     # -- introspection / checkpoint --------------------------------------
     def nbytes(self) -> int:
@@ -190,6 +197,55 @@ class ShardedHostTable:
         ]
         self.optimizer = state.get("optimizer", self.optimizer)
         self.learning_rate = float(state.get("learning_rate", self.learning_rate))
+        # the loaded state IS the new baseline: nothing is dirty vs it
+        self._dirty = [set() for _ in range(self.num_shards)]
+
+    # -- incremental snapshots (dirty-row deltas) -------------------------
+    def dirty_rows(self) -> int:
+        """Rows touched since the last drain (across shards, local idx)."""
+        return sum(len(d) for d in self._dirty)
+
+    def drain_dirty(self) -> dict:
+        """Capture-and-clear the dirty rows as a VALUE delta: per shard,
+        the touched local row indices with their current values (+ the
+        adagrad accumulator rows when present). Copies happen under the
+        shard locks, like state_dict, so no torn row is ever captured.
+        Value deltas are idempotent — replaying one on top of a newer
+        base is last-write-wins, which makes the base/delta race in the
+        snapshotter safe by construction."""
+        out = {"shards": {}, "rows": 0}
+        for s in range(self.num_shards):
+            with self._locks[s]:
+                if not self._dirty[s]:
+                    continue
+                rows = np.fromiter(sorted(self._dirty[s]), np.int64,
+                                   len(self._dirty[s]))
+                out["shards"][s] = {
+                    "rows": rows,
+                    "values": self._shards[s][rows].copy(),
+                    "accum": (None if self._accum[s] is None
+                              else self._accum[s][rows].copy()),
+                }
+                out["rows"] += int(rows.shape[0])
+                self._dirty[s].clear()
+        return out
+
+    def apply_dirty_delta(self, delta: dict) -> int:
+        """Scatter a drain_dirty() delta back into the shards (restore
+        path: base snapshot + delta chain). Does NOT re-dirty the rows —
+        a restored state is the new clean baseline."""
+        n = 0
+        for s, ent in delta.get("shards", {}).items():
+            s = int(s)
+            rows = np.asarray(ent["rows"], np.int64)
+            with self._locks[s]:
+                self._shards[s][rows] = np.asarray(
+                    ent["values"], self.dtype)
+                if ent.get("accum") is not None and self._accum[s] is not None:
+                    self._accum[s][rows] = np.asarray(
+                        ent["accum"], np.float32)
+            n += int(rows.shape[0])
+        return n
 
 
 class GeoSGDClient:
@@ -293,7 +349,8 @@ class GeoSGDClient:
 
 
 def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
-                 num_trainers: Optional[int] = None, endpoints=None, **kw):
+                 num_trainers: Optional[int] = None, endpoints=None,
+                 replication: Optional[int] = None, **kw):
     """mode: "sync" — per-step gradient push with a server-side barrier
     across trainers (reference DistributeTranspiler sync_mode); "async"
     — per-step push applied on arrival (Downpour); "geo" — local
@@ -305,13 +362,22 @@ def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
     the pserver process(es), shared by every trainer (ps_server.py).
     Without either, the table is in-process (single trainer / tests).
     In-process "sync" and "async" behave identically (there is no peer
-    to barrier with)."""
+    to barrier with).
+
+    replication (default PADDLE_PS_REPLICATION, else 1): hosted tables
+    only — each row partition gets a primary pserver plus R-1 backup
+    replicas on distinct pservers (ps_server.RemoteTable docs: fast
+    failover, hedged pulls). 1 = today's unreplicated behavior;
+    in-process tables ignore it (there is no second process to hold a
+    replica)."""
     import os as _os
 
     from . import ps_server as _net
 
     if num_trainers is None:
         num_trainers = int(_os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if replication is None:
+        replication = int(_os.environ.get("PADDLE_PS_REPLICATION", 1) or 1)
     with _lock:
         if name in _tables:
             raise ValueError(f"table {name!r} already exists")
@@ -324,6 +390,7 @@ def create_table(name, shape, mode: str = "sync", geo_sync_steps: int = 100,
                 name, shape, endpoints,
                 sync_trainers=num_trainers if mode == "sync" else 0,
                 trainer_id=int(_os.environ.get("PADDLE_TRAINER_ID", 0)),
+                replication=replication,
                 **kw)
         else:
             t = ShardedHostTable(name, shape, **kw)

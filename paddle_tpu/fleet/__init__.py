@@ -88,7 +88,10 @@ def ps_stats(table_name: Optional[str] = None) -> dict:
     """PS data-plane telemetry through the idempotent `stats` verb
     (ISSUE 4): per-verb latency summaries, retry / replay-dedup
     counters and bytes in/out from each pserver process, plus per-table
-    traffic counters.
+    traffic counters. Replicated tables (PADDLE_PS_REPLICATION > 1) add
+    a "replication" section — factor plus each partition's replica
+    roles, epochs, last-applied seqs and lag (ISSUE 7), the same view
+    debugz /statusz serves as ps_replication.
 
     table_name names one registered table; None reports every table
     this process created. Hosted tables (RemoteTable) fan the verb out

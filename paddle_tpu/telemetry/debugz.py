@@ -84,6 +84,22 @@ def _statusz() -> dict:
         out["steps"] = {"completed": n, "avg_step_s": avg}
     except Exception:  # noqa: BLE001
         out["steps"] = None
+    try:
+        # replicated PS tables: per-partition role/epoch/seq/lag (the
+        # client-side view of failovers and backup health)
+        from ..distributed import ps as _ps
+
+        reps = {}
+        for name, t in list(_ps._tables.items()):
+            status = getattr(t, "replica_status", None) or getattr(
+                getattr(t, "server", None), "replica_status", None)
+            if callable(status):
+                rows = status()
+                if rows:
+                    reps[name] = rows
+        out["ps_replication"] = reps or None
+    except Exception:  # noqa: BLE001
+        out["ps_replication"] = None
     return out
 
 

@@ -25,7 +25,8 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.distributed import ps
 from paddle_tpu.fluid import layers
 
-GLOBAL_B, DIM, NCLS, ROWS, STEPS, KILL_STEP = 32, 16, 7, 5_000, 12, 4
+GLOBAL_B, DIM, NCLS, ROWS, KILL_STEP = 32, 16, 7, 5_000, 4
+STEPS = int(os.environ.get("PS_TEST_STEPS", 12))
 
 
 def main():
@@ -86,10 +87,36 @@ def main():
 
     trace_dir = os.environ.get("PADDLE_DIST_TRACE_DIR", ".")
     dense = table.to_dense()
+    # replication drill observability: hedging/failover counters and the
+    # gather tail latency as THIS trainer saw them (additive keys; the
+    # pre-replication drills ignore them)
+    from paddle_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    hedges_issued = sum(
+        reg.counter("ps_client_hedges_issued_total", verb=v).value
+        for v in ("gather", "stats"))
+    hedges_won = sum(
+        reg.counter("ps_client_hedges_won_total", verb=v).value
+        for v in ("gather", "stats"))
     with open(os.path.join(trace_dir, f"trace.{rank}.json"), "w") as f:
         json.dump({"losses": losses,
                    "table_sum": float(np.float64(dense.sum())),
-                   "table_touched": dense[np.unique(all_ids)][:4].tolist()},
+                   "table_touched": dense[np.unique(all_ids)][:4].tolist(),
+                   "hedges_issued": hedges_issued,
+                   "hedges_won": hedges_won,
+                   "failovers": reg.counter(
+                       "ps_client_failovers_total").value,
+                   # effective = what the training loop waited (hedging
+                   # included); falls back to the raw per-RPC histogram
+                   # in unreplicated runs where no hedged path exists
+                   "gather_p95_ms": (
+                       reg.histogram("ps_client_effective_read_ms",
+                                     verb="gather").quantile(0.95)
+                       if reg.histogram("ps_client_effective_read_ms",
+                                        verb="gather").count
+                       else reg.histogram("ps_client_rpc_ms",
+                                          verb="gather").quantile(0.95))},
                   f)
     return 0
 

@@ -84,6 +84,28 @@ def test_string_rank_tags_for_pservers(tmp_path):
     assert mon.stale_ranks(now=mon._t0 + 60.0, ranks=["ps0"]) == ["ps0"]
 
 
+def test_pserver_tag_through_failover_and_respawn(tmp_path):
+    """Replicated failover timeline through the monitor's eyes (ISSUE 7):
+    ps0 dies (stamps stop) -> flagged stale; the supervisor respawns it
+    under the SAME tag (launch.PServer.tag is identity, not incarnation)
+    and its fresh stamp clears the flag — so supervision keeps watching
+    the respawned-and-rejoining replica without any re-registration.
+    Meanwhile the surviving replica's cadence is never disturbed."""
+    mon = HeartBeatMonitor(str(tmp_path), ["ps0", "ps1"], timeout=1.0,
+                           startup_grace=2.0)
+    _stamp(tmp_path, "ps0", mtime=mon._t0 + 3.0)
+    _stamp(tmp_path, "ps1", mtime=mon._t0 + 3.0)
+    assert mon.stale_ranks(now=mon._t0 + 3.5) == []
+    # ps0 is killed (the drill's primary): its stamps stop, ps1 keeps on
+    _stamp(tmp_path, "ps1", mtime=mon._t0 + 6.0)
+    assert mon.stale_ranks(now=mon._t0 + 6.5) == ["ps0"]
+    # supervised respawn: same tag, fresh stamp — clean again, no new
+    # monitor needed while the replica catches up and rejoins
+    _stamp(tmp_path, "ps0", mtime=mon._t0 + 7.0)
+    _stamp(tmp_path, "ps1", mtime=mon._t0 + 7.0)
+    assert mon.stale_ranks(now=mon._t0 + 7.5) == []
+
+
 def test_worker_stamps_atomically_and_stop_is_idempotent(tmp_path):
     w = HeartBeatWorker(str(tmp_path), 3, interval=0.05)
     assert w.start() is w

@@ -450,6 +450,144 @@ def test_chaos_connection_drops_match_no_fault_loss(tmp_path):
     np.testing.assert_allclose(t0["table_sum"], ref["table_sum"], rtol=1e-5)
 
 
+# ---------------------------------------------------------------------------
+# RPC deadline + replication fault rules (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def test_call_deadline_bounds_wall_time_not_attempts(monkeypatch):
+    """PADDLE_PS_CALL_DEADLINE_SECS: with a deadline set, the retry loop
+    gives up at the DEADLINE even though the attempt budget is nowhere
+    near spent — the property failover latency depends on."""
+    monkeypatch.setattr(ps_server, "RPC_MAX_RETRIES", 10_000_000)
+    monkeypatch.setattr(ps_server, "RPC_BACKOFF_BASE", 0.01)
+    conn = ps_server._Conn(f"127.0.0.1:{_free_port()}", deadline=0.5)
+    t0 = time.time()
+    with pytest.raises(ConnectionError, match="deadline"):
+        conn.call("ping")
+    elapsed = time.time() - t0
+    assert elapsed < 3.0, f"deadline did not bound wall time: {elapsed}s"
+
+
+def test_call_deadline_off_keeps_attempt_bound(monkeypatch):
+    """Deadline unset (the R=1 default): exactly the old attempt-count
+    behavior, same terminal message."""
+    monkeypatch.setattr(ps_server, "RPC_MAX_RETRIES", 2)
+    monkeypatch.setattr(ps_server, "RPC_BACKOFF_BASE", 0.01)
+    conn = ps_server._Conn(f"127.0.0.1:{_free_port()}", deadline=0)
+    with pytest.raises(ConnectionError, match="after 3 attempts"):
+        conn.call("ping")
+
+
+def test_slow_rule_fires_every_nth():
+    """`slow` is REPEATING: every nth matching call sleeps arg ms —
+    a deterministic latency tail, not a one-shot."""
+    inj = faults.FaultInjector("slow:gather:2:30")
+    times = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        inj.on_server_call("gather")
+        times.append(time.perf_counter() - t0)
+    slow = [t > 0.02 for t in times]
+    assert slow == [False, True, False, True, False, True], times
+    inj.on_server_call("push_gradients")  # other verbs unaffected
+
+
+def test_partition_rule_latches_and_blocks_replication(monkeypatch):
+    """`partition:<tag>:<nth>`: after this server handles nth RPCs it
+    latches into a reachable-but-stale state — blocks_replication()
+    stays True — and only fires on the server whose tag matches."""
+    monkeypatch.setenv("PADDLE_PS_RANK_TAG", "ps1")
+    inj = faults.FaultInjector("partition:ps1:3")
+    for _ in range(2):
+        inj.on_server_call("gather")
+        assert not inj.blocks_replication()
+    inj.on_server_call("push_gradients")
+    assert inj.blocks_replication()
+    inj.on_server_call("gather")
+    assert inj.blocks_replication()  # latched
+    # a different tag never fires
+    monkeypatch.setenv("PADDLE_PS_RANK_TAG", "ps0")
+    inj2 = faults.FaultInjector("partition:ps1:1")
+    inj2.on_server_call("gather")
+    assert not inj2.blocks_replication()
+
+
+def test_fault_tags_scope_the_injector(monkeypatch):
+    """PADDLE_PS_FAULT_TAGS arms the layer only in the named processes
+    (kill ONE replica of a pair instead of both)."""
+    monkeypatch.setenv(faults.ENV_SPEC, "drop:gather:1")
+    monkeypatch.setenv(faults.ENV_TAGS, "ps0")
+    fl.set_flags({"FLAGS_ps_fault_injection": True})
+    try:
+        monkeypatch.setenv("PADDLE_PS_RANK_TAG", "ps1")
+        faults.reset()
+        assert faults.injector() is None  # not my tag
+        monkeypatch.setenv("PADDLE_PS_RANK_TAG", "ps0")
+        faults.reset()
+        assert faults.injector() is not None
+        monkeypatch.delenv("PADDLE_PS_RANK_TAG")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv(faults.ENV_TAGS, "trainer1")
+        faults.reset()
+        assert faults.injector() is not None  # trainer tags work too
+    finally:
+        fl.set_flags({"FLAGS_ps_fault_injection": False})
+        faults.reset()
+
+
+def test_stale_epoch_write_from_deposed_primary_rejected():
+    """The seq/epoch fence (ISSUE 7 satellite): a deposed primary's
+    forwarded write — stale generation — is REJECTED by the backup's
+    epoch check, and the deposed server latches stale so clients
+    re-route instead of reading a diverged copy."""
+    srv = ps_server.PSServer()
+    key = "d@p0"
+    spec = {"name": "d", "shape": (20, 4), "num_shards": 2,
+            "optimizer": "sgd", "learning_rate": 0.5, "seed": 1,
+            "partition": 0, "replicas": []}
+    srv.create_table(dict(spec))
+    ids = np.arange(4, dtype=np.int64)
+    g = np.ones((4, 4), np.float32)
+    # the replica is promoted at epoch 2 (a failover happened)
+    srv.promote(key, epoch=2, backups=[])
+    before = srv.tables[key].to_dense().copy()
+    # a deposed primary still forwarding at epoch 1 must bounce
+    with pytest.raises(RuntimeError, match="StaleEpoch"):
+        srv.replicate(key, epoch=1, seq=1, op="push_gradients",
+                      ids=ids, payload=g)
+    np.testing.assert_array_equal(srv.tables[key].to_dense(), before)
+    # a CURRENT-epoch forward with a stale seq is acked-not-reapplied
+    srv.replicas[key].role = "backup"
+    srv.replicas[key].seq = 5
+    out = srv.replicate(key, epoch=2, seq=3, op="push_gradients",
+                        ids=ids, payload=g)
+    assert out == {"seq": 5}
+    np.testing.assert_array_equal(srv.tables[key].to_dense(), before)
+    # and a seq GAP demands resync instead of silently applying
+    with pytest.raises(RuntimeError, match="ReplicaGap"):
+        srv.replicate(key, epoch=2, seq=9, op="push_gradients",
+                      ids=ids, payload=g)
+
+
+def test_deposed_primary_refuses_clients_until_resync():
+    """Once a primary learns it was deposed (stale latch), client verbs
+    bounce with StalePrimaryError — no reads of a diverged copy."""
+    srv = ps_server.PSServer()
+    key = "d2@p0"
+    spec = {"name": "d2", "shape": (20, 4), "num_shards": 2,
+            "optimizer": "sgd", "learning_rate": 0.5, "seed": 1,
+            "partition": 0, "replicas": []}
+    srv.create_table(dict(spec))
+    srv.promote(key, epoch=0, backups=[])
+    srv.replicas[key].stale = True  # deposed (forward was epoch-rejected)
+    with pytest.raises(ps_server.StalePrimaryError):
+        srv.push_gradients("d2", np.arange(2, dtype=np.int64),
+                           np.ones((2, 4), np.float32), partition=0)
+    with pytest.raises(ps_server.StalePrimaryError):
+        srv.gather("d2", np.arange(2, dtype=np.int64), partition=0)
+
+
 @pytest.mark.slow
 def test_chaos_pserver_kill_recovers_from_snapshot(tmp_path):
     """Acceptance (b): the pserver is killed mid-run (deterministic kill

@@ -28,6 +28,16 @@ echo "== PS chaos smoke (deterministic fault injection) =="
 # snapshot preload (tests/test_ps_faults.py, the @slow process drills)
 python -m pytest tests/test_ps_faults.py -q -m slow
 
+echo "== PS replication drills (R=2 failover + hedging) =="
+# ISSUE 7 acceptance: kill ONE pserver of a replicated pair mid-run —
+# trainers fail over to the backups with NO respawn-wait and the loss
+# trace is BIT-identical to the no-fault run; and an injected per-verb
+# latency tail on one replica is absorbed by backup hedges (hedges won
+# > 0, gather p95 back under the injected tail). The R=1 default paths
+# are covered byte-for-byte by the tier-1 unit tests above
+# (tests/test_ps_replication.py, tests/test_ps_faults.py)
+python -m pytest tests/test_ps_replication.py -q -m slow
+
 echo "== parallel heavy parity (slow lane: ring/pipeline/SP + breadth) =="
 # heavy parametrizations / breadth sweeps run here so tier-1's
 # 'not slow' pass stays inside its wall-clock budget. NOT included:
