@@ -593,12 +593,17 @@ def launch(argv=None) -> int:
         # pservers inherit it through _spawn_pserver's env copy
         os.environ["PADDLE_PS_SNAPSHOT_MODE"] = args.ps_snapshot_mode
     if args.ps_replication is not None:
-        if args.ps_replication > 1 and not (args.server_num >= args.ps_replication
-                                            or args.servers):
-            print(f"[launch] --ps_replication {args.ps_replication} needs "
-                  f"at least that many pservers (--server_num)",
-                  file=sys.stderr)
-            return 2
+        if args.ps_replication > 1:
+            if args.servers:
+                n_ps = len([e for e in args.servers.split(",")
+                            if e.strip()])
+            else:
+                n_ps = args.server_num
+            if n_ps < args.ps_replication:
+                print(f"[launch] --ps_replication {args.ps_replication} "
+                      f"needs at least that many pservers, got {n_ps} "
+                      f"(--server_num / --servers)", file=sys.stderr)
+                return 2
         # trainers inherit it through start_local_trainers' env copy;
         # RemoteTable reads it as the default replication factor
         os.environ["PADDLE_PS_REPLICATION"] = str(args.ps_replication)

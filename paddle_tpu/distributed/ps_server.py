@@ -641,6 +641,14 @@ class PSServer:
                 raise RuntimeError(
                     f"StaleEpoch: replicate for {key!r} carries epoch "
                     f"{epoch} < current {rs.epoch} (deposed primary)")
+            if rs.stale:
+                # a deposed replica's content and seq are untrustworthy;
+                # acking against the inflated seq would silently skip
+                # real entries — refuse until resync repairs it (the
+                # forwarding primary drops us; rejoin drives resync)
+                raise RuntimeError(
+                    f"ReplicaGap: {key!r} was deposed and awaits "
+                    f"resync; forward {seq} refused")
             if epoch > rs.epoch:
                 rs.epoch = int(epoch)
                 if rs.role != "backup":
@@ -766,8 +774,13 @@ class PSServer:
                     f"fetch_replica_state: {key!r} is {rs.role}, not "
                     f"primary")
             have_seq = int(have_seq)
-            covered = (have_seq >= rs.seq) or (
-                rs.log and rs.log[0][0] <= have_seq + 1)
+            # have_seq < 0 is an explicit full-transfer demand: a
+            # deposed replica's local seq counts writes the cluster
+            # never accepted, so "covered" computed from it would hand
+            # back an empty tail and leave its divergence in place
+            covered = have_seq >= 0 and (
+                (have_seq >= rs.seq)
+                or (rs.log and rs.log[0][0] <= have_seq + 1))
             if covered:
                 out = {"tail": [e for e in rs.log if e[0] > have_seq]}
                 _REG.counter("ps_server_resyncs_total", mode="tail").inc()
@@ -800,17 +813,29 @@ class PSServer:
             conn = _Conn(primary, deadline=max(FORWARD_DEADLINE, 5.0),
                          io_timeout=max(FORWARD_DEADLINE, 5.0) + 5.0)
             try:
+                # a deposed replica applied writes the cluster never
+                # accepted: its seq is inflated and its same-numbered
+                # log entries may DIFFER from the new primary's, so the
+                # seq must not seed anti-entropy — demand a full state
+                # transfer (have_seq=-1) instead of a tail
+                have = -1 if rs.stale else rs.seq
                 out = conn.call("fetch_replica_state", key=key,
-                                backup=self_endpoint, have_seq=rs.seq)
+                                backup=self_endpoint, have_seq=have)
             finally:
                 conn.close()
             if "state" in out:
                 table.load_state_dict(out["state"])
+                # entries from the deposed incarnation must not survive
+                # into a future promotion's tail service
+                rs.log.clear()
                 mode = "full"
             else:
                 for seq, op, ids, payload, dedup in out["tail"]:
                     self._apply_forward(key, table, op, ids, payload)
                     self._absorb_dedup(key, dedup)
+                    # keep the ring contiguous through rs.seq, so a
+                    # later promotion serves gap-free tails
+                    rs.log.append((seq, op, ids, payload, dedup))
                 mode = "tail"
             rs.seq = int(out["seq"])
             rs.epoch = int(out["epoch"])
@@ -879,7 +904,7 @@ class PSServer:
                           (time.perf_counter() - t0) * 1e3)
             return 0
         token = object()
-        merged = None  # (rows, apply_ms) when THIS call merged the round
+        merged = None  # (ids, grads, peer tokens) when THIS call merges
         with st.cond:
             if retry and step <= st.last_applied:
                 # replay of a round that merged before the reply was
@@ -899,19 +924,14 @@ class PSServer:
                 # duplicate-id float accumulation is order-identical
                 ids_m = np.concatenate([buf[t][0] for t in sorted(buf)])
                 g_m = np.concatenate([buf[t][1] for t in sorted(buf)])
-                t0 = time.perf_counter()
-                g_scaled = g_m / st.num
-                self._apply_replicated(
-                    key, lambda: table.push_gradients(ids_m, g_scaled),
-                    "push_gradients", ids_m, g_scaled,
-                    {"sync_step": step})
-                merged = (len(ids_m), (time.perf_counter() - t0) * 1e3)
-                for t in buf:
-                    st.done.add(buf[t][2])
-                st.done.discard(token)  # the merger does not wait
+                # claim the round (dedup high-water + buffer removal)
+                # BEFORE applying below, so a racing replay can never
+                # trigger a second merge; peers are released only AFTER
+                # the apply lands
+                peers = [v[2] for v in buf.values() if v[2] is not token]
                 st.last_applied = max(st.last_applied, step)
                 del st.rounds[step]
-                st.cond.notify_all()
+                merged = (ids_m, g_m / st.num, peers)
             elif st.cond.wait_for(lambda: token in st.done or st.reset,
                                   timeout=SYNC_TIMEOUT):
                 if token in st.done:
@@ -933,9 +953,27 @@ class PSServer:
                     f"trainers pushed table {name!r} round {step} — a "
                     f"peer trainer likely died")
         if merged is not None:
+            ids_m, g_scaled, peers = merged
+            t0 = time.perf_counter()
+            # applied OUTSIDE st.cond: _apply_replicated takes rs.lock,
+            # and the replication paths (replicate, resync,
+            # fetch_replica_state) take rs.lock THEN st.cond — holding
+            # st.cond across the apply inverts that order and can
+            # deadlock a primary that is merging a round while a peer
+            # forwards to it during a role-transition race. On apply
+            # failure (e.g. this primary was deposed mid-forward) the
+            # peers are NOT released: they time out, surface the error,
+            # and the clients re-drive the round at the new primary.
+            self._apply_replicated(
+                key, lambda: table.push_gradients(ids_m, g_scaled),
+                "push_gradients", ids_m, g_scaled, {"sync_step": step})
+            apply_ms = (time.perf_counter() - t0) * 1e3
+            with st.cond:
+                st.done.update(peers)
+                st.cond.notify_all()
             # emitted outside the barrier lock: sink I/O must never
             # extend the round's critical section
-            _emit_ps_step(name, "sync", step, merged[0], merged[1])
+            _emit_ps_step(name, "sync", step, len(ids_m), apply_ms)
         return 0
 
     def push_delta(self, name, ids, deltas, trainer_id=0, seq=-1,
@@ -1092,10 +1130,17 @@ class PSServer:
             gens = dict(self.gens)
         n = 0
         for key, t in items:
-            state = t.state_dict()
             rs = self.replicas.get(key)
-            if rs is not None:
+            if rs is None:
+                state = t.state_dict()
+            else:
+                # one critical section: replicated writes apply under
+                # rs.lock (_apply_replicated / replicate), so capturing
+                # state AND seq inside it yields a consistent cut — a
+                # seq ahead of the state would make a restore+resync
+                # skip replaying writes the snapshot doesn't contain
                 with rs.lock:
+                    state = t.state_dict()
                     state["replica_meta"] = {"seq": rs.seq,
                                              "epoch": rs.epoch}
             _atomic_write(os.path.join(self.snapshot_dir, f"{key}.pkl"),
@@ -1132,16 +1177,24 @@ class PSServer:
         doomed: List[str] = []  # superseded chain files, removed last
         for key, t in items:
             rs = self.replicas.get(key)
-            meta = None
-            if rs is not None:
-                with rs.lock:
-                    meta = {"seq": rs.seq, "epoch": rs.epoch}
+
+            def cut(capture, _rs=rs):
+                """Capture table state and replica seq in ONE rs.lock
+                critical section (writes apply under rs.lock): seq ahead
+                of the state loses resync-tail updates, state ahead of
+                seq re-applies non-idempotent push_gradients."""
+                if _rs is None:
+                    return capture(), None
+                with _rs.lock:
+                    return capture(), {"seq": _rs.seq,
+                                       "epoch": _rs.epoch}
+
             ent = self._snap_chain.get(key)
             if ent is None or len(ent["deltas"]) >= max(
                     1, SNAPSHOT_COMPACT_EVERY):
                 # compaction / first base: everything dirty is folded in
-                t.drain_dirty()
-                state = t.state_dict()
+                state, meta = cut(
+                    lambda: (t.drain_dirty(), t.state_dict())[1])
                 if meta:
                     state["replica_meta"] = meta
                 blob = pickle.dumps(state,
@@ -1161,7 +1214,7 @@ class PSServer:
                              kind="base").inc(len(blob))
                 wrote += 1
             else:
-                delta = t.drain_dirty()
+                delta, meta = cut(t.drain_dirty)
                 if delta["rows"] == 0:
                     continue  # bytes per tick scale with touched rows
                 if meta:

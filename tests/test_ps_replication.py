@@ -317,6 +317,98 @@ def test_fetch_replica_state_tail_vs_full():
         list(srv.replicas[key].log)[-1:], maxlen=4)
     out = srv.fetch_replica_state(key, have_seq=1)
     assert "state" in out and "tail" not in out
+    # have_seq < 0 is the stale replica's explicit full-transfer demand:
+    # its local seq counts writes the cluster never accepted, so even a
+    # ring-covered value must not be trusted
+    out = srv.fetch_replica_state(key, have_seq=-1)
+    assert "state" in out and "tail" not in out
+
+
+def test_launch_validates_replication_against_endpoint_count(tmp_path):
+    """--ps_replication R must fail AT LAUNCH when fewer than R pserver
+    endpoints are supplied — whether counted from --server_num or an
+    explicit --servers list — instead of surfacing later as a
+    RemoteTable ValueError inside every trainer."""
+    script = tmp_path / "noop.py"
+    script.write_text("pass\n")
+    for extra in (["--server_num", "1"], ["--servers", "127.0.0.1:1"]):
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             *extra, "--ps_replication", "2", str(script)],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 2, (r.stdout, r.stderr)
+        assert "needs at least that many pservers" in r.stderr
+
+
+def test_deposed_primary_divergence_forces_full_resync(fast_failover):
+    """Regression: a primary that applied a client write BEFORE its
+    forward was epoch-rejected (deposed mid-failover race) holds a
+    divergent row under a seq that matches the new primary's — same
+    number, different content. Anti-entropy must not trust that seq
+    ('covered' would hand back an empty tail and the replica would
+    rejoin 'clean' while still divergent): the stale replica demands a
+    FULL state transfer and comes back bit-identical."""
+    a, b = _Srv(), _Srv()
+    try:
+        kw = dict(num_shards=2, optimizer="sgd", learning_rate=0.5,
+                  seed=11)
+        remote = ps_server.RemoteTable("dv", (20, 4), [a.ep, b.ep],
+                                       replication=2, **kw)
+        ids = np.arange(0, 20, 2, dtype=np.int64)  # partition-0 rows
+        lids = ids // 2  # their LOCAL rows, for direct server calls
+        remote.push_gradients(ids, np.ones((10, 4), np.float32))
+        key = "dv@p0"
+        assert a.ps.replicas[key].role == "primary"
+        assert b.ps.replicas[key].seq == a.ps.replicas[key].seq
+
+        # a peer trainer failed partition 0 over: b is primary at epoch
+        # 1 and applies the cluster's REAL next round
+        cb = ps_server._Conn(b.ep)
+        cb.call("promote", name="dv", partition=0, epoch=1, backups=[])
+        cb.call("push_gradients", name="dv", ids=lids,
+                grads=np.full((10, 4), 2.0, np.float32), partition=0,
+                trainer_id=1, step=101)
+
+        # a second trainer, its routing behind, writes to the OLD
+        # primary: the apply lands locally, the forward to b is epoch-
+        # rejected, and the deposed server latches stale — now holding
+        # the SAME seq as the new primary but different row content
+        ca = ps_server._Conn(a.ep, deadline=5.0)
+        with pytest.raises(ps_server.StalePrimaryError):
+            ca.call("push_gradients", name="dv", ids=lids,
+                    grads=np.full((10, 4), -3.0, np.float32),
+                    partition=0, trainer_id=2, step=101)
+        rs_a = a.ps.replicas[key]
+        assert rs_a.stale
+        assert rs_a.seq == b.ps.replicas[key].seq
+        assert not np.array_equal(a.ps.tables[key].to_dense(),
+                                  b.ps.tables[key].to_dense())
+
+        # anti-entropy from the stale replica MUST be a full transfer
+        # (a seq-tail read as 'covered' would repair nothing)
+        out = ca.call("resync", name="dv", partition=0, primary=b.ep,
+                      self_endpoint=a.ep)
+        assert out["mode"] == "full"
+        np.testing.assert_array_equal(a.ps.tables[key].to_dense(),
+                                      b.ps.tables[key].to_dense())
+        assert not rs_a.stale and rs_a.role == "backup"
+
+        # the repaired backup is re-enrolled in the forward set and
+        # tracks the primary bit for bit again
+        cb.call("push_gradients", name="dv", ids=lids,
+                grads=np.ones((10, 4), np.float32), partition=0,
+                trainer_id=1, step=102)
+        np.testing.assert_array_equal(a.ps.tables[key].to_dense(),
+                                      b.ps.tables[key].to_dense())
+        cb.close()
+        ca.close()
+        remote.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.kill()
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------
