@@ -1,0 +1,584 @@
+"""Lease-based job control plane (ISSUE 8).
+
+The launcher hosts a tiny membership service — the `Coordinator` — and
+every process in the job (trainers AND pservers) holds a renewable
+lease on its membership. Heartbeat stamps become lease renewals: the
+same JSON step payload trainers already stamp to the heartbeat file
+rides each `renew` RPC, and pservers renew with their per-partition
+replica summary (role / epoch / seq). Liveness decisions then live in
+ONE place instead of being split between file mtimes and client retry
+loops:
+
+  trainers  — a lease that expires past the member's per-rank retry
+              budget EVICTS the member: the coordinator bumps the
+              membership epoch and the launcher restarts the surviving
+              ranks at the reduced world size from the last checkpoint
+              (elastic resize) instead of burning the whole restart
+              budget on a permanently-lost host.
+  pservers  — the coordinator is the lease-based primary elector the
+              client-driven failover path (ps_server.RemoteTable) could
+              not be: when a partition primary's lease expires, the
+              coordinator promotes the best caught-up backup DIRECTLY
+              (promote RPC, epoch fenced) — no client traffic needed.
+              Clients discover the new primary through the
+              StaleEpoch/NotPrimary bounce they already handle.
+
+Transport: the `_TCPServer` / `_Handler` / `_Conn` stack from
+ps_server.py, unchanged — the Coordinator just implements
+`handle(method, kwargs)` + `shutdown_event` like PSServer does, so RPC
+retries, deterministic fault injection (faults.py: `lease_expire`,
+`netsplit` rules) and per-verb telemetry come for free.
+
+Split-brain guard: every renewal carries the member's view of the
+membership epoch (PADDLE_MEMBERSHIP_EPOCH, exported by the launcher at
+spawn). A renewal from a FUTURE epoch means a newer coordinator exists
+and THIS one is stale — the renewal is recorded but does not refresh
+the lease, and the coordinator stops trusting its own membership view
+for that member (heartbeat.HeartBeatMonitor applies the same rule to
+file stamps).
+
+Env contract:
+  PADDLE_COORDINATOR_ENDPOINT  host:port of the launcher's coordinator
+  PADDLE_LEASE_SECS            lease duration (launch.py --lease_secs)
+  PADDLE_MEMBERSHIP_EPOCH      the member's membership-epoch view
+  PADDLE_TRAINER_TAG           stable identity ("trainer2") across
+                               resizes — budgets key on it, not on the
+                               re-numbered rank
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..telemetry import get_registry
+
+_REG = get_registry()
+
+ENV_ENDPOINT = "PADDLE_COORDINATOR_ENDPOINT"
+ENV_LEASE_SECS = "PADDLE_LEASE_SECS"
+ENV_EPOCH = "PADDLE_MEMBERSHIP_EPOCH"
+ENV_TAG = "PADDLE_TRAINER_TAG"
+
+# a lease is EXPIRED once this many lease periods pass without a
+# renewal (the "within 2 lease periods" promotion bound)
+EXPIRE_PERIODS = float(os.environ.get("PADDLE_LEASE_EXPIRE_PERIODS", 2.0))
+
+
+def lease_secs_from_env() -> float:
+    try:
+        return float(os.environ.get(ENV_LEASE_SECS, 0) or 0)
+    except ValueError:
+        return 0.0
+
+
+def membership_epoch_from_env() -> int:
+    try:
+        return int(os.environ.get(ENV_EPOCH, 0) or 0)
+    except ValueError:
+        return 0
+
+
+def member_tag() -> str:
+    """This process's stable membership identity: the launcher-exported
+    tag survives resizes (ranks are re-numbered, tags are not)."""
+    tag = os.environ.get(ENV_TAG)
+    if tag:
+        return tag
+    ps = os.environ.get("PADDLE_PS_RANK_TAG")
+    if ps:
+        return ps
+    return f"trainer{os.environ.get('PADDLE_TRAINER_ID', 0)}"
+
+
+class _Member:
+    __slots__ = ("tag", "kind", "endpoint", "expires", "payload",
+                 "failures", "alive", "evicted", "expired_reported",
+                 "stale_reported", "last_renew")
+
+    def __init__(self, tag: str, kind: str, endpoint: Optional[str],
+                 expires: float):
+        self.tag = tag
+        self.kind = kind
+        self.endpoint = endpoint
+        self.expires = expires
+        self.payload: Optional[dict] = None
+        self.failures = 0
+        self.alive = True
+        self.evicted = False
+        self.expired_reported = False  # one lease_expired event per lapse
+        self.stale_reported = False  # one stale_coordinator event
+        self.last_renew = 0.0
+
+    def status(self, now: float) -> dict:
+        return {
+            "kind": self.kind, "endpoint": self.endpoint,
+            "alive": self.alive, "evicted": self.evicted,
+            "failures": self.failures,
+            "lease_remaining_s": round(self.expires - now, 3),
+            "payload": self.payload,
+        }
+
+
+class Coordinator:
+    """Membership + lease table. Hosted in the LAUNCHER process: the
+    launcher calls the methods directly (it is the consumer of events);
+    remote members reach the same object through serve() + the
+    ps_server RPC transport. All state is guarded by one lock — verbs
+    are tiny and never block on I/O except `sweep`'s promote RPCs,
+    which run outside the lock."""
+
+    def __init__(self, lease_secs: float = 5.0, retries_per_rank: int = 0,
+                 expire_periods: float = EXPIRE_PERIODS,
+                 startup_grace: Optional[float] = None):
+        self.lease_secs = float(lease_secs)
+        self.retries_per_rank = int(retries_per_rank)
+        self.expire_periods = float(expire_periods)
+        # first expiry deadline after register: imports + first XLA
+        # compile legitimately exceed a lease period (same reasoning as
+        # HeartBeatMonitor.startup_grace)
+        self.startup_grace = (
+            float(startup_grace) if startup_grace is not None
+            else max(self.lease_secs * 10.0,
+                     self.lease_secs * self.expire_periods))
+        self.epoch = 0
+        self.members: Dict[str, _Member] = {}
+        self.events: deque = deque(maxlen=512)
+        self.lock = threading.RLock()
+        self.shutdown_event = threading.Event()  # _Handler contract
+
+    # -- internals -------------------------------------------------------
+    def _event(self, **ev) -> None:
+        ev.setdefault("ts", time.time())
+        self.events.append(ev)
+
+    def _deadline(self, now: float) -> float:
+        return now + self.lease_secs * self.expire_periods
+
+    def _get(self, tag: str, kind: str = "trainer",
+             endpoint: Optional[str] = None,
+             now: Optional[float] = None) -> _Member:
+        now = time.time() if now is None else now
+        m = self.members.get(tag)
+        if m is None:
+            m = self.members[tag] = _Member(
+                tag, kind, endpoint, now + self.startup_grace)
+        return m
+
+    # -- verbs (also called directly by the launcher) --------------------
+    def register(self, tag: str, kind: str = "trainer",
+                 endpoint: Optional[str] = None, payload: Optional[dict] = None,
+                 epoch: Optional[int] = None, now: Optional[float] = None):
+        """(Re)grant a lease. Registration is identity-stable: a
+        respawned process re-registers under its old tag and keeps its
+        failure count (budgets outlive incarnations). An EVICTED tag is
+        told so — the member must not keep working."""
+        now = time.time() if now is None else now
+        with self.lock:
+            m = self._get(tag, kind, endpoint, now)
+            m.kind = kind
+            if endpoint:
+                m.endpoint = endpoint
+            if payload is not None:
+                m.payload = dict(payload)
+            if m.evicted:
+                return {"epoch": self.epoch, "lease_secs": self.lease_secs,
+                        "evicted": True}
+            m.alive = True
+            m.expired_reported = False
+            # fresh registrations get the startup grace, renewals the
+            # plain lease window — registration IS process (re)birth
+            m.expires = now + max(self.startup_grace,
+                                  self.lease_secs * self.expire_periods)
+            _REG.counter("coordinator_registrations_total",
+                         kind=kind).inc()
+            return {"epoch": self.epoch, "lease_secs": self.lease_secs,
+                    "evicted": False}
+
+    def renew(self, tag: str, payload: Optional[dict] = None,
+              epoch: Optional[int] = None, now: Optional[float] = None):
+        """One lease renewal — the heartbeat stamp as an RPC. The
+        payload is stored verbatim (step/avg_step_s for trainers,
+        partition replica summaries for pservers). A renewal claiming a
+        FUTURE membership epoch does NOT refresh the lease: a newer
+        coordinator owns that member and this one is stale
+        (split-brain guard)."""
+        now = time.time() if now is None else now
+        ep = membership_epoch_from_env() if epoch is None else int(epoch)
+        with self.lock:
+            m = self._get(tag, now=now)
+            if payload is not None:
+                m.payload = dict(payload)
+            if m.evicted:
+                _REG.counter("coordinator_evicted_renewals_total").inc()
+                return {"epoch": self.epoch, "evicted": True}
+            if ep > self.epoch:
+                _REG.counter("coordinator_stale_renewals_total").inc()
+                if not m.stale_reported:
+                    m.stale_reported = True
+                    self._event(event="stale_coordinator", tag=tag,
+                                member_epoch=ep, epoch=self.epoch)
+                return {"epoch": self.epoch, "evicted": False,
+                        "stale_coordinator": True}
+            m.alive = True
+            m.expired_reported = False
+            m.last_renew = now
+            m.expires = self._deadline(now)
+            _REG.counter("coordinator_renewals_total", kind=m.kind).inc()
+            return {"epoch": self.epoch, "evicted": False}
+
+    def membership(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        with self.lock:
+            trainers = [t for t, m in self.members.items()
+                        if m.kind == "trainer" and not m.evicted]
+            return {
+                "epoch": self.epoch,
+                "lease_secs": self.lease_secs,
+                "retries_per_rank": self.retries_per_rank,
+                "world_size": len(trainers),
+                "members": {t: m.status(now)
+                            for t, m in sorted(self.members.items())},
+            }
+
+    def report_failure(self, tag: str, reason: str = "") -> dict:
+        """The launcher observed a failure (nonzero exit, stale
+        heartbeat, expired lease, straggler ejection) for `tag`. The
+        coordinator owns the budget: within the per-rank budget the
+        member may be restarted; past it the member is EVICTED and the
+        membership epoch bumps — the elastic-resize signal."""
+        with self.lock:
+            m = self._get(tag)
+            m.alive = False
+            m.failures += 1
+            evicted = m.failures > self.retries_per_rank
+            if evicted and not m.evicted:
+                m.evicted = True
+                self.epoch += 1
+                _REG.counter("coordinator_evictions_total").inc()
+                self._event(event="member_evicted", tag=tag, reason=reason,
+                            failures=m.failures, epoch=self.epoch)
+            elif not evicted:
+                self._event(event="member_failed", tag=tag, reason=reason,
+                            failures=m.failures,
+                            retries_left=self.retries_per_rank - m.failures)
+            return {"evicted": m.evicted, "epoch": self.epoch,
+                    "failures": m.failures,
+                    "retries_left": max(
+                        0, self.retries_per_rank - m.failures)}
+
+    def expired_tags(self, now: Optional[float] = None,
+                     kind: Optional[str] = None) -> List[str]:
+        now = time.time() if now is None else now
+        with self.lock:
+            return [t for t, m in self.members.items()
+                    if m.alive and not m.evicted and now > m.expires
+                    and (kind is None or m.kind == kind)]
+
+    def drain_events(self) -> List[dict]:
+        with self.lock:
+            out, self.events = list(self.events), deque(maxlen=512)
+            return out
+
+    # -- lease sweep + pserver primary election --------------------------
+    def sweep(self, now: Optional[float] = None) -> List[dict]:
+        """One supervision tick: find expired leases, emit one
+        `lease_expired` event per lapse, and for every expired PSERVER
+        that held partition primaries, elect + promote a caught-up
+        backup (the ROADMAP "promote without a client in the loop"
+        path). Returns the events raised by THIS tick. The launcher
+        calls this on its watch cadence; tests drive it with an
+        explicit `now`."""
+        now = time.time() if now is None else now
+        raised: List[dict] = []
+        elect: List[_Member] = []
+        with self.lock:
+            for tag, m in self.members.items():
+                if m.evicted or not m.alive or now <= m.expires:
+                    continue
+                if m.expired_reported:
+                    continue
+                m.expired_reported = True
+                ev = {"event": "lease_expired", "tag": tag, "kind": m.kind,
+                      "overdue_s": round(now - m.expires, 3)}
+                self._event(**ev)
+                raised.append(ev)
+                _REG.counter("coordinator_lease_expiries_total",
+                             kind=m.kind).inc()
+                if m.kind == "pserver":
+                    m.alive = False  # stops being an election candidate
+                    elect.append(m)
+        for dead in elect:
+            raised.extend(self._elect_primaries(dead))
+        return raised
+
+    def _partition_view(self, key: str):
+        """(candidates, epochs, backups) for one partition key from the
+        latest renewal payloads — candidates are caught-up live backups,
+        epochs every epoch seen, backups the live replica endpoints."""
+        cands, epochs, backups = [], [0], []
+        with self.lock:
+            for m in self.members.values():
+                if m.kind != "pserver":
+                    continue
+                st = ((m.payload or {}).get("partitions") or {}).get(key)
+                if st is None:
+                    continue
+                epochs.append(int(st.get("epoch", 0)))
+                if not m.alive or m.evicted or not m.endpoint:
+                    continue
+                backups.append(m.endpoint)
+                if st.get("role") == "backup" and not st.get("stale"):
+                    cands.append((int(st.get("epoch", 0)),
+                                  int(st.get("seq", 0)), m))
+        return cands, epochs, backups
+
+    def _elect_primaries(self, dead: _Member) -> List[dict]:
+        """Promote a backup for every partition the dead pserver led.
+        Runs OUTSIDE the coordinator lock (promote is a real RPC)."""
+        parts = (dead.payload or {}).get("partitions") or {}
+        raised: List[dict] = []
+        for key, st in sorted(parts.items()):
+            if st.get("role") != "primary":
+                continue
+            cands, epochs, backups = self._partition_view(key)
+            if not cands:
+                ev = {"event": "ps_promotion_skipped", "key": key,
+                      "from": dead.tag, "reason": "no caught-up backup"}
+                with self.lock:
+                    self._event(**ev)
+                raised.append(ev)
+                continue
+            cands.sort()
+            _, seq, target = cands[-1]
+            new_epoch = max(epochs) + 1
+            name, _, part = key.rpartition("@p")
+            try:
+                from .ps_server import _Conn
+
+                conn = _Conn(target.endpoint, deadline=5.0, io_timeout=10.0)
+                try:
+                    conn.call("promote", name=name, partition=int(part),
+                              epoch=new_epoch,
+                              backups=[b for b in backups
+                                       if b != target.endpoint])
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — election must not
+                # take the launcher down; the next sweep retries nothing
+                # (the client-driven failover path still exists)
+                ev = {"event": "ps_promotion_failed", "key": key,
+                      "from": dead.tag, "to": target.tag,
+                      "error": f"{type(e).__name__}: {e}"}
+                with self.lock:
+                    self._event(**ev)
+                raised.append(ev)
+                continue
+            _REG.counter("coordinator_ps_promotions_total").inc()
+            ev = {"event": "ps_promoted", "key": key, "from": dead.tag,
+                  "to": target.tag, "epoch": new_epoch, "seq": seq}
+            with self.lock:
+                self._event(**ev)
+                # reflect the grant locally so a repeated sweep (the
+                # dead server stays dead) does not re-promote; the next
+                # real renewal from the target carries the truth anyway
+                tparts = (target.payload or {}).setdefault("partitions", {})
+                tparts.setdefault(key, {})["role"] = "primary"
+                tparts[key]["epoch"] = new_epoch
+                dparts = (dead.payload or {}).get("partitions") or {}
+                if key in dparts:
+                    dparts[key]["role"] = None
+            raised.append(ev)
+        return raised
+
+    # -- RPC dispatch (ps_server._Handler contract) ----------------------
+    def handle(self, method: str, kwargs: dict):
+        from . import faults
+
+        inj = faults.injector()
+        if inj is not None:
+            inj.on_server_call(method)
+        if method == "ping":
+            return "pong"
+        if method == "register":
+            return self.register(
+                kwargs["tag"], kwargs.get("kind", "trainer"),
+                kwargs.get("endpoint"), kwargs.get("payload"),
+                kwargs.get("epoch"))
+        if method == "renew":
+            return self.renew(kwargs["tag"], kwargs.get("payload"),
+                              kwargs.get("epoch"))
+        if method == "membership":
+            return self.membership()
+        if method == "report_failure":
+            return self.report_failure(kwargs["tag"],
+                                       kwargs.get("reason", ""))
+        if method == "sweep":
+            return self.sweep(kwargs.get("now"))
+        if method == "events":
+            return self.drain_events()
+        if method == "shutdown":
+            self.shutdown_event.set()
+            return 0
+        raise ValueError(f"unknown coordinator method {method!r}")
+
+
+def serve_coordinator(coord: Coordinator, host: str = "127.0.0.1",
+                      port: int = 0):
+    """Host `coord` over the ps_server TCP transport (daemon thread).
+    Returns (server, "host:port"). The launcher exports the endpoint as
+    PADDLE_COORDINATOR_ENDPOINT so members can renew."""
+    from .ps_server import _Handler, _TCPServer
+
+    srv = _TCPServer((host, port), _Handler)
+    srv.ps = coord  # type: ignore[attr-defined] — _Handler contract
+    threading.Thread(target=srv.serve_forever,
+                     kwargs={"poll_interval": 0.1}, daemon=True,
+                     name="paddle-tpu-coordinator").start()
+    return srv, f"{host}:{srv.server_address[1]}"
+
+
+def stop_coordinator(srv) -> None:
+    try:
+        srv.shutdown()
+        srv.close_all_connections()
+        srv.server_close()
+    except Exception:  # noqa: BLE001 — teardown best-effort
+        pass
+
+
+# ---------------------------------------------------------------------------
+# member side
+# ---------------------------------------------------------------------------
+
+
+class CoordinatorClient:
+    """Thin member-side client: register once, renew on a cadence. All
+    RPCs ride ps_server._Conn (retries, deadline, telemetry), and every
+    renewal consults faults.injector() so a `lease_expire:<tag>:<nth>`
+    rule can swallow renewals deterministically (the lease-expiry
+    drill) without touching the process's real liveness."""
+
+    def __init__(self, endpoint: str, tag: Optional[str] = None,
+                 kind: str = "trainer", self_endpoint: Optional[str] = None,
+                 deadline: float = 3.0):
+        from .ps_server import _Conn
+
+        self.endpoint = endpoint
+        self.tag = tag or member_tag()
+        self.kind = kind
+        self.self_endpoint = self_endpoint
+        self._conn = _Conn(endpoint, deadline=deadline,
+                           io_timeout=deadline + 10.0)
+
+    def register(self, payload: Optional[dict] = None) -> dict:
+        return self._conn.call(
+            "register", tag=self.tag, kind=self.kind,
+            endpoint=self.self_endpoint, payload=payload,
+            epoch=membership_epoch_from_env())
+
+    def renew(self, payload: Optional[dict] = None) -> dict:
+        from . import faults
+
+        inj = faults.injector()
+        if inj is not None and inj.on_lease_renew():
+            # swallowed client-side: the coordinator never sees it, the
+            # lease runs out — exactly what a silently-dead host does
+            _REG.counter("coordinator_client_renewals_suppressed_total").inc()
+            return {"suppressed": True}
+        return self._conn.call(
+            "renew", tag=self.tag, payload=payload,
+            epoch=membership_epoch_from_env())
+
+    def membership(self) -> dict:
+        return self._conn.call("membership")
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class LeaseWorker:
+    """Daemon renewal thread for processes without a heartbeat worker
+    cadence of their own (pservers; lease-only trainers). Registration
+    + renewals never raise — a flapping coordinator must not take a
+    healthy member down."""
+
+    def __init__(self, client: CoordinatorClient, interval: float,
+                 payload_fn: Optional[Callable[[], dict]] = None):
+        self.client = client
+        self.interval = max(0.05, float(interval))
+        self.payload_fn = payload_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _payload(self) -> Optional[dict]:
+        if self.payload_fn is None:
+            return None
+        try:
+            return self.payload_fn()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def start(self) -> "LeaseWorker":
+        if self._thread is not None:
+            return self
+        try:
+            self.client.register(payload=self._payload())
+        except Exception:  # noqa: BLE001 — renewals retry registration
+            pass
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.client.renew(payload=self._payload())
+                except Exception:  # noqa: BLE001 — keep renewing
+                    continue
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True,
+            name=f"paddle-tpu-lease-{self.client.tag}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self.client.close()
+
+
+def maybe_start_lease_worker(kind: str, tag: Optional[str] = None,
+                             self_endpoint: Optional[str] = None,
+                             payload_fn: Optional[Callable[[], dict]] = None,
+                             ) -> Optional[LeaseWorker]:
+    """Start lease renewals when the launcher armed the control plane
+    (PADDLE_COORDINATOR_ENDPOINT + PADDLE_LEASE_SECS); no-op (two env
+    reads) otherwise. Renewal cadence is lease_secs/3 so a healthy
+    member always lands well inside the expiry window."""
+    endpoint = os.environ.get(ENV_ENDPOINT)
+    lease = lease_secs_from_env()
+    if not endpoint or lease <= 0:
+        return None
+    client = CoordinatorClient(endpoint, tag=tag, kind=kind,
+                               self_endpoint=self_endpoint)
+    return LeaseWorker(client, interval=lease / 3.0,
+                       payload_fn=payload_fn).start()
+
+
+def query_membership(timeout: float = 2.0) -> Optional[dict]:
+    """The coordinator's membership table, or None when no control
+    plane is armed / reachable (status pages must never crash)."""
+    endpoint = os.environ.get(ENV_ENDPOINT)
+    if not endpoint:
+        return None
+    try:
+        client = CoordinatorClient(endpoint, deadline=timeout)
+        try:
+            return client.membership()
+        finally:
+            client.close()
+    except Exception:  # noqa: BLE001
+        return None

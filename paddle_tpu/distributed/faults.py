@@ -49,6 +49,22 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     "ckpt_before_commit" (step dir in place, manifest —
                     the commit point — not yet written): exercises the
                     torn-checkpoint fallback in fluid/checkpoint.py
+            lease_expire  member side, LATCHING: once this process has
+                    attempted <nth> coordinator lease renewals, ALL
+                    further renewals are swallowed client-side (the
+                    coordinator never sees them and the lease runs out
+                    exactly like a silently-dead host's). The <method>
+                    field names the process tag to starve ("trainer1",
+                    "ps0") or "*"; the process itself keeps running —
+                    that is the point: lease expiry, not process death
+            netsplit  member side, WINDOWED: once this process has
+                    issued <nth> outgoing RPCs, ALL outgoing RPCs are
+                    dropped (FaultError before send) for <arg>
+                    MILLISECONDS, then the split heals — one side of a
+                    network partition, deterministically. Lease
+                    renewals ride the same client path, so a long
+                    enough window also expires the member's lease. The
+                    <method> field names the process tag or "*"
     method  an RPC verb name (gather, push_gradients, ...), a phase
             name (crash rules), or "*"
     nth     1-based index of the matching call AT THE INJECTION SITE;
@@ -81,6 +97,20 @@ ENV_TAGS = "PADDLE_PS_FAULT_TAGS"
 _CLIENT_ACTIONS = ("drop", "refuse", "delay")
 _SERVER_ACTIONS = ("kill", "slow", "partition")
 _PHASE_ACTIONS = ("crash",)
+# rules whose <method> field names a PROCESS TAG, not an RPC verb
+_TAG_ACTIONS = ("lease_expire", "netsplit")
+
+
+def _process_tags() -> set:
+    """The identities this process answers to for tag-matched rules:
+    its pserver tag ("ps0"), its launcher-stable trainer tag
+    ("trainer2", PADDLE_TRAINER_TAG), and the rank-derived fallback."""
+    tags = {os.environ.get("PADDLE_PS_RANK_TAG") or "",
+            os.environ.get("PADDLE_TRAINER_TAG") or "",
+            "trainer" + os.environ.get("PADDLE_TRAINER_ID", "")}
+    tags.discard("")
+    tags.discard("trainer")
+    return tags
 
 
 class FaultError(ConnectionError):
@@ -119,7 +149,8 @@ def parse_spec(spec: str) -> List[_Rule]:
             raise ValueError(
                 f"bad fault rule {raw!r}: want action:method:nth[:arg]")
         action, method, nth = parts[0], parts[1], parts[2]
-        known = _CLIENT_ACTIONS + _SERVER_ACTIONS + _PHASE_ACTIONS
+        known = (_CLIENT_ACTIONS + _SERVER_ACTIONS + _PHASE_ACTIONS
+                 + _TAG_ACTIONS)
         if action not in known:
             raise ValueError(
                 f"bad fault rule {raw!r}: unknown action {action!r} "
@@ -131,6 +162,10 @@ def parse_spec(spec: str) -> List[_Rule]:
         if n < 1:
             raise ValueError(f"bad fault rule {raw!r}: nth is 1-based")
         arg = float(parts[3]) if len(parts) == 4 else 0.0
+        if action == "netsplit" and arg <= 0:
+            raise ValueError(
+                f"bad fault rule {raw!r}: netsplit needs a window — "
+                f"netsplit:<tag>:<nth>:<ms>")
         rules.append(_Rule(action, method, n, arg))
     return rules
 
@@ -158,6 +193,8 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._server_calls = 0
         self.partitioned = False  # latched by a fired `partition` rule
+        self.lease_blocked = False  # latched by a fired `lease_expire`
+        self.netsplit_until = 0.0  # wall time the split heals
 
     def _take(self, site_actions, method: str) -> List[_Rule]:
         """Advance matching rules' counters; return the rules firing NOW."""
@@ -190,8 +227,42 @@ class FaultInjector:
                     firing.append(r)
         return firing
 
+    def _take_tagged(self, action: str) -> List[_Rule]:
+        """Advance rules whose <method> field names one of THIS
+        process's tags (or "*") — each rule counted at most once per
+        arrival even when several tags match."""
+        tags = _process_tags()
+        firing = []
+        with self._lock:
+            for r in self._rules:
+                if r.action != action or r.fired:
+                    continue
+                if not (r.method == "*" or r.method in tags):
+                    continue
+                r.count += 1
+                if r.count == r.nth:
+                    r.fired = True
+                    firing.append(r)
+        return firing
+
     # -- client side -----------------------------------------------------
     def before_send(self, method: str) -> None:
+        # netsplit rules count every outgoing RPC from a tagged process;
+        # firing opens a drop window during which ALL sends fail the way
+        # a severed link fails them (the renewal path included)
+        now = time.time()
+        for r in self._take_tagged("netsplit"):
+            with self._lock:
+                self.netsplit_until = max(self.netsplit_until,
+                                          now + r.arg / 1000.0)
+            os.write(2, (f"[faults] netsplit: pid {os.getpid()} dropping "
+                         f"all RPCs for {r.arg:.0f}ms (rule netsplit:"
+                         f"{r.method}:{r.nth})\n").encode())
+        if now < self.netsplit_until:
+            raise FaultError(
+                f"fault injection: netsplit — {method!r} RPC dropped "
+                f"({self.netsplit_until - now:.3f}s until the window "
+                f"heals)")
         for r in self._take(("refuse", "delay"), method):
             if r.action == "delay":
                 time.sleep(r.arg)
@@ -228,6 +299,21 @@ class FaultInjector:
         """True once a `partition` rule fired: this server must reject
         `replicate` forwards (reachable-but-stale backup)."""
         return self.partitioned
+
+    # -- lease side ------------------------------------------------------
+    def on_lease_renew(self) -> bool:
+        """Counts one coordinator lease-renewal ATTEMPT from this
+        process; True once a matching `lease_expire` rule has latched —
+        the caller (CoordinatorClient.renew) then swallows the renewal
+        so the lease expires while the process stays alive."""
+        for r in self._take_tagged("lease_expire"):
+            os.write(2, (f"[faults] lease_expire: pid {os.getpid()} "
+                         f"swallowing all lease renewals from now on "
+                         f"(rule lease_expire:{r.method}:{r.nth})\n"
+                         ).encode())
+            with self._lock:
+                self.lease_blocked = True
+        return self.lease_blocked
 
     # -- phase side ------------------------------------------------------
     def at_phase(self, phase: str) -> None:

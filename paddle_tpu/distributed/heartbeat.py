@@ -66,17 +66,30 @@ def read_stamp(directory: str, rank: Rank) -> Optional[dict]:
 
 class HeartBeatWorker:
     """Daemon thread stamping this process's heartbeat file (trainers
-    stamp their integer rank; pservers stamp a string tag)."""
+    stamp their integer rank; pservers stamp a string tag). Stamps
+    carry the member's membership-epoch view (PADDLE_MEMBERSHIP_EPOCH)
+    when the launcher exported one, and `renew_cb` — when the job
+    control plane is armed — turns every stamp into a coordinator
+    lease renewal carrying the same payload (coordinator.py)."""
 
-    def __init__(self, directory: str, rank: Rank, interval: float = 1.0):
+    def __init__(self, directory: str, rank: Rank, interval: float = 1.0,
+                 renew_cb=None):
         self.path = _stamp_path(directory, rank)
         self.interval = interval
+        self.renew_cb = renew_cb
+        try:
+            self.epoch = int(os.environ.get("PADDLE_MEMBERSHIP_EPOCH", 0)
+                             or 0)
+        except ValueError:
+            self.epoch = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(directory, exist_ok=True)
 
     def _beat(self):
         stamp = {"t": time.time()}
+        if self.epoch:
+            stamp["epoch"] = self.epoch
         if _step_provider is not None:
             try:
                 step, avg = _step_provider()
@@ -89,6 +102,11 @@ class HeartBeatWorker:
         with open(tmp, "w") as f:
             f.write(json.dumps(stamp))
         os.replace(tmp, self.path)  # atomic: monitor never reads a torn file
+        if self.renew_cb is not None:
+            try:
+                self.renew_cb(stamp)
+            except Exception:  # noqa: BLE001 — a flapping coordinator
+                pass  # must never kill the liveness thread
 
     def start(self):
         if self._thread is not None:
@@ -110,16 +128,38 @@ class HeartBeatWorker:
             self._thread = None
 
 
-def start_heartbeat(interval: float = 1.0) -> Optional[HeartBeatWorker]:
+def start_heartbeat(interval: float = 1.0):
     """Trainer-side entry: start stamping if the launcher enabled
     heartbeats (PADDLE_HEARTBEAT_DIR set); no-op otherwise. Called by
     parallel.env.init_parallel_env so launched trainers get liveness
-    reporting without code changes."""
+    reporting without code changes.
+
+    When the job control plane is armed (PADDLE_COORDINATOR_ENDPOINT +
+    PADDLE_LEASE_SECS), every stamp doubles as a coordinator lease
+    renewal; with a coordinator but no heartbeat dir, a pure
+    lease-renewal worker runs instead — either way the trainer's lease
+    stays live without code changes."""
     directory = os.environ.get(ENV_DIR)
+    from . import coordinator as coord_mod
+
+    endpoint = os.environ.get(coord_mod.ENV_ENDPOINT)
+    lease = coord_mod.lease_secs_from_env()
+    renew_cb = None
+    if endpoint and lease > 0:
+        if not directory:
+            # lease-only liveness: no shared filesystem needed
+            return coord_mod.maybe_start_lease_worker(kind="trainer")
+        client = coord_mod.CoordinatorClient(endpoint, kind="trainer")
+        try:
+            client.register()
+        except Exception:  # noqa: BLE001 — renewals keep trying
+            pass
+        renew_cb = client.renew
     if not directory:
         return None
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
-    return HeartBeatWorker(directory, rank, interval).start()
+    return HeartBeatWorker(directory, rank, interval,
+                           renew_cb=renew_cb).start()
 
 
 class StragglerMonitor:
@@ -166,7 +206,8 @@ class HeartBeatMonitor:
     """
 
     def __init__(self, directory: str, ranks: List[Rank], timeout: float,
-                 startup_grace: Optional[float] = None):
+                 startup_grace: Optional[float] = None,
+                 epoch: Optional[int] = None):
         self.directory = directory
         self.ranks = list(ranks)
         self.timeout = timeout
@@ -175,6 +216,11 @@ class HeartBeatMonitor:
             else float(os.environ.get("PADDLE_HEARTBEAT_STARTUP_GRACE",
                                       30 * timeout))
         )
+        # split-brain guard: when this monitor knows its membership
+        # epoch, a stamp claiming a FUTURE epoch is not proof of life —
+        # the stamper answers to a NEWER coordinator, so this (stale)
+        # supervisor must not keep making liveness calls on its basis
+        self.epoch = epoch
         self._t0 = time.time()
 
     def stale_ranks(self, now: Optional[float] = None,
@@ -195,6 +241,11 @@ class HeartBeatMonitor:
                 if now - self._t0 > self.startup_grace:
                     stale.append(r)
                 continue
+            if self.epoch is not None:
+                stamp = read_stamp(self.directory, r)
+                if stamp and int(stamp.get("epoch", 0)) > self.epoch:
+                    stale.append(r)  # future-epoch stamp: we are stale
+                    continue
             if now - mtime > self.timeout:
                 stale.append(r)
         return stale

@@ -53,9 +53,13 @@ from typing import List, Optional
 
 
 class Trainer:
-    def __init__(self, rank: int, endpoint: str):
+    def __init__(self, rank: int, endpoint: str, tag: Optional[str] = None):
         self.rank = rank
         self.endpoint = endpoint
+        # stable membership identity: ranks are RE-NUMBERED when an
+        # elastic resize shrinks the world, tags are not — per-rank
+        # restart budgets and the coordinator's lease table key on tags
+        self.tag = tag if tag is not None else f"trainer{rank}"
         self.proc: Optional[subprocess.Popen] = None
         self.log = None
 
@@ -101,11 +105,46 @@ def _parse_args(argv):
     p.add_argument("--log_dir", default=None)
     p.add_argument(
         "--elastic_retries", type=int, default=0,
-        help="restart the local trainer group up to N times after a "
-        "failure (trainers resume from their own checkpoints; "
-        "PADDLE_ELASTIC_RESTART carries the attempt number), and "
-        "restart a dead pserver up to N times (snapshot recovery). 0 = "
-        "reference behavior: fail fast (utils.py:407)",
+        help="JOB-LEVEL cap on trainer-group restarts (trainers resume "
+        "from their own checkpoints; PADDLE_ELASTIC_RESTART carries the "
+        "attempt number), and restart budget for dead pservers "
+        "(snapshot recovery). 0 = reference behavior: fail fast "
+        "(utils.py:407) — unless --elastic_retries_per_rank arms the "
+        "control plane on its own",
+    )
+    p.add_argument(
+        "--elastic_retries_per_rank", type=int, default=None,
+        help="PER-RANK restart budget (default: = --elastic_retries). "
+        "A rank that fails MORE times than its budget is EVICTED from "
+        "the membership instead of burning the job: the coordinator "
+        "bumps the membership epoch and the surviving ranks restart "
+        "from the last checkpoint at the REDUCED world size (elastic "
+        "resize; needs PADDLE_ELASTIC_RESHARD-aware checkpoints). A "
+        "permanently-lost host therefore costs its own budget, not the "
+        "whole fleet's",
+    )
+    p.add_argument(
+        "--min_world_size", type=int, default=1,
+        help="abort instead of resizing below this many trainers",
+    )
+    p.add_argument(
+        "--lease_secs", type=float, default=None,
+        help="arm the lease-based job control plane "
+        "(distributed/coordinator.py): the launcher hosts a membership "
+        "coordinator, heartbeat stamps become lease renewals "
+        "(PADDLE_COORDINATOR_ENDPOINT / PADDLE_LEASE_SECS exported to "
+        "every child), a trainer lease expired for 2 periods is "
+        "treated like a hang (kill + per-rank budget), and an expired "
+        "PSERVER primary lease promotes a caught-up backup directly — "
+        "no client in the loop. Default: PADDLE_LEASE_SECS if set, "
+        "else off",
+    )
+    p.add_argument(
+        "--straggler_eject_factor", type=float, default=0.0,
+        help="EJECT (kill + per-rank budget, reason 'straggler "
+        "ejection') a trainer whose step time exceeds this multiple of "
+        "the median across ranks — the enforcement sibling of the "
+        "diagnosis-only --straggler_factor. 0 = off",
     )
     p.add_argument(
         "--sigterm_grace", type=float, default=30.0,
@@ -429,11 +468,15 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
                          script_args: List[str], log_dir: Optional[str],
                          restart_count: int = 0,
                          heartbeat_dir: Optional[str] = None,
-                         debugz_base_port: Optional[int] = None):
+                         debugz_base_port: Optional[int] = None,
+                         membership_epoch: int = 0):
     """Fork this node's trainers with the env protocol (reference
     utils.start_local_trainers:340). debugz_base_port arms each rank's
     introspection server on base + rank (deterministic: operators and
-    scrape configs can address any rank's /metrics without discovery)."""
+    scrape configs can address any rank's /metrics without discovery).
+    PADDLE_TRAINER_TAG carries the stable membership identity and
+    PADDLE_MEMBERSHIP_EPOCH the coordinator's membership epoch — both
+    survive resizes where the rank numbering does not."""
     endpoints = ",".join(t.endpoint for t in cluster)
     local = [t for t in cluster if t.endpoint.split(":")[0] == node_ip]
     if log_dir:
@@ -446,6 +489,8 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
             PADDLE_TRAINER_ENDPOINTS=endpoints,
             PADDLE_CURRENT_ENDPOINT=t.endpoint,
             PADDLE_ELASTIC_RESTART=str(restart_count),
+            PADDLE_TRAINER_TAG=t.tag,
+            PADDLE_MEMBERSHIP_EPOCH=str(membership_epoch),
         )
         if debugz_base_port is not None:
             env["PADDLE_DEBUGZ_PORT"] = str(debugz_base_port + t.rank)
@@ -482,7 +527,8 @@ def terminate_local_trainers(trainers: List[Trainer]):
 def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                          monitor=None, ps_supervisor=None,
                          grace: Optional[SigtermGrace] = None,
-                         straggler=None) -> int:
+                         straggler=None, failure: Optional[dict] = None,
+                         coordinator=None, straggler_eject=False) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
     aborts the whole local group (reference watch_local_trainers:407:
@@ -492,7 +538,23 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
     with when the restart budget is gone. Under a SIGTERM `grace` the
     watcher waits for the (already signaled) trainers to finish their
     final checkpoints, terminating stragglers when the grace window
-    expires, and reports 128+SIGTERM. Returns the job's exit code."""
+    expires, and reports 128+SIGTERM. Returns the job's exit code.
+
+    `failure` (out-param dict) receives {"trainer", "tag", "reason"}
+    for the trainer whose death ended the watch — the attempts loop
+    charges the right PER-RANK budget and names the culprit in the
+    restart line. `coordinator` (coordinator.Coordinator) is swept on
+    the poll cadence: an expired TRAINER lease is treated like a hang
+    (kill + reason "lease expired"), and expired PSERVER primary
+    leases trigger backup promotion inside the sweep. With
+    `straggler_eject`, a straggler event kills the dragging rank
+    (reason "straggler ejection") instead of only logging it."""
+
+    def _fail(t: Optional[Trainer], reason: str) -> None:
+        if failure is not None and t is not None:
+            failure.update(trainer=t, tag=t.tag, rank=t.rank,
+                           reason=reason)
+
     try:
         while True:
             if grace is not None and grace.requested.is_set():
@@ -510,10 +572,12 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                     alive = True
                 elif rc != 0:
                     print(
-                        f"[launch] trainer {t.rank} ({t.endpoint}) exited "
-                        f"with {rc}; aborting the job",
+                        f"[launch] trainer {t.rank} ({t.tag}, "
+                        f"{t.endpoint}) exited with {rc}; aborting the "
+                        f"job",
                         file=sys.stderr,
                     )
+                    _fail(t, f"nonzero exit (code {rc})")
                     terminate_local_trainers(trainers)
                     return rc
             if not alive:
@@ -528,15 +592,50 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                         f"aborting the group",
                         file=sys.stderr,
                     )
+                    culprit = next((t for t in trainers
+                                    if t.rank in stale), None)
+                    _fail(culprit, "heartbeat stale (hang)")
                     terminate_local_trainers(trainers)
                     return 124  # timeout-style exit code
+            if coordinator is not None:
+                # lease plane: sweep expiries (and pserver primary
+                # elections) on the watch cadence, then react to
+                # expired TRAINER leases exactly like stale heartbeats
+                events = coordinator.sweep()
+                running_tags = {t.tag: t for t in trainers
+                                if t.proc.poll() is None}
+                for ev in events:
+                    if (ev.get("event") == "lease_expired"
+                            and ev.get("kind") == "trainer"
+                            and ev.get("tag") in running_tags):
+                        t = running_tags[ev["tag"]]
+                        print(f"[launch] trainer {t.rank} ({t.tag}) "
+                              f"lease expired ({ev.get('overdue_s')}s "
+                              f"overdue — renewals stopped); killing "
+                              f"the group", file=sys.stderr)
+                        _fail(t, "lease expired (no renewals)")
+                        terminate_local_trainers(trainers)
+                        return 124
             if straggler is not None:
-                # diagnosis only: one structured JSON line per episode
-                # (heartbeat.StragglerMonitor); the job keeps running
+                # one structured JSON line per episode
+                # (heartbeat.StragglerMonitor); diagnosis by default,
+                # ejection when the eject factor armed this watch
                 from ..telemetry.straggler import format_event
 
                 for ev in straggler.poll():
                     print(format_event(ev), file=sys.stderr, flush=True)
+                    if straggler_eject:
+                        culprit = next(
+                            (t for t in trainers
+                             if str(t.rank) == str(ev.get("rank"))
+                             and t.proc.poll() is None), None)
+                        if culprit is not None:
+                            print(f"[launch] trainer {culprit.rank} "
+                                  f"({culprit.tag}) ejected as a "
+                                  f"straggler", file=sys.stderr)
+                            _fail(culprit, "straggler ejection")
+                            terminate_local_trainers(trainers)
+                            return 124
             if ps_supervisor is not None:
                 rc = ps_supervisor.check()
                 if rc is not None:
@@ -554,11 +653,22 @@ def launch(argv=None) -> int:
     node_ip = args.node_ip or ips[0]
     cluster = get_cluster(ips, args.nproc_per_node, args.started_port)
 
+    # lease plane (--lease_secs / PADDLE_LEASE_SECS): the launcher hosts
+    # the membership coordinator and every child renews a lease on it
+    lease_secs = args.lease_secs
+    if lease_secs is None:
+        try:
+            lease_secs = float(os.environ.get("PADDLE_LEASE_SECS", 0) or 0)
+        except ValueError:
+            lease_secs = 0.0
+
     heartbeat_dir = None
     own_heartbeat_dir = False
-    # straggler detection rides the same heartbeat channel (stamps carry
-    # step counts), so either flag provisions the directory
-    if args.heartbeat_timeout > 0 or args.straggler_factor > 0:
+    # straggler detection and lease renewals ride the same heartbeat
+    # channel (stamps carry step counts and double as renewals), so any
+    # of these flags provisions the directory
+    if (args.heartbeat_timeout > 0 or args.straggler_factor > 0
+            or args.straggler_eject_factor > 0 or lease_secs > 0):
         heartbeat_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
         if not heartbeat_dir:
             import tempfile
@@ -583,6 +693,26 @@ def launch(argv=None) -> int:
 
     grace = SigtermGrace(args.sigterm_grace)
     grace.install()
+
+    # the job control plane: the coordinator owns membership, epochs and
+    # per-rank budgets whenever elastic supervision is on; it is SERVED
+    # over TCP (lease renewals) only when --lease_secs arms leases
+    from .coordinator import Coordinator, serve_coordinator, stop_coordinator
+
+    per_rank = (args.elastic_retries_per_rank
+                if args.elastic_retries_per_rank is not None
+                else args.elastic_retries)
+    coord = Coordinator(lease_secs=lease_secs or 5.0,
+                        retries_per_rank=per_rank)
+    coord_server = None
+    if lease_secs > 0:
+        coord_server, coord_ep = serve_coordinator(coord)
+        # children inherit both through the spawn env copies
+        os.environ["PADDLE_COORDINATOR_ENDPOINT"] = coord_ep
+        os.environ["PADDLE_LEASE_SECS"] = str(lease_secs)
+        print(f"[launch] job coordinator on {coord_ep} (lease "
+              f"{lease_secs}s, per-rank budget {per_rank})",
+              file=sys.stderr)
 
     pservers: List[PServer] = []
     ps_supervisor = None
@@ -642,7 +772,8 @@ def launch(argv=None) -> int:
                     heartbeat_dir=heartbeat_dir,
                     heartbeat_timeout=args.heartbeat_timeout)
         rc = _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
-                              ps_supervisor, grace)
+                              ps_supervisor, grace, coord=coord,
+                              lease_armed=lease_secs > 0)
         if args.trace_dir:
             from ..telemetry.timeline import merge_traces
 
@@ -653,6 +784,8 @@ def launch(argv=None) -> int:
         return rc
     finally:
         terminate_pservers(pservers)
+        if coord_server is not None:
+            stop_coordinator(coord_server)
         if own_heartbeat_dir:
             import shutil
 
@@ -664,7 +797,20 @@ def launch(argv=None) -> int:
 
 
 def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
-                     ps_supervisor=None, grace=None) -> int:
+                     ps_supervisor=None, grace=None, coord=None,
+                     lease_armed=False) -> int:
+    """Supervision loop with per-rank budgets and elastic resize.
+
+    Failure accounting lives in the coordinator: every group-ending
+    trainer failure (nonzero exit, stale heartbeat, expired lease,
+    straggler ejection) is charged to THAT member's per-rank budget
+    (coordinator.report_failure). Within budget, the group restarts at
+    the same world size (the sync-PS barrier demands a group restart
+    either way); past budget the member is EVICTED — the membership
+    epoch bumps and the survivors restart at world-1 from the last
+    checkpoint (PADDLE_ELASTIC_RESHARD=1 is exported so their
+    CheckpointManagers accept the resized resume). --elastic_retries
+    stays the JOB-LEVEL restart cap."""
     debugz_base = args.debugz_port
     if debugz_base is None:
         raw = os.environ.get("PADDLE_DEBUGZ_PORT")
@@ -673,49 +819,125 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                 debugz_base = int(raw)
             except ValueError:
                 debugz_base = None
+    elastic_enabled = (args.elastic_retries > 0
+                       or args.elastic_retries_per_rank is not None)
+    # job-level cap: --elastic_retries when given; with only per-rank
+    # budgets, a generous derived bound (every rank exhausting its own
+    # budget plus its eviction restart)
+    per_rank = (args.elastic_retries_per_rank
+                if args.elastic_retries_per_rank is not None
+                else args.elastic_retries)
+    job_cap = (args.elastic_retries if args.elastic_retries > 0
+               else (per_rank + 1) * len(cluster))
+    trainers = list(cluster)  # survivors, re-ranked on resize
     attempt = 0
+    epoch = coord.epoch if coord is not None else 0
     while True:
         local = start_local_trainers(
-            cluster, node_ip, args.training_script, args.training_script_args,
-            args.log_dir, restart_count=attempt, heartbeat_dir=heartbeat_dir,
-            debugz_base_port=debugz_base,
+            trainers, node_ip, args.training_script,
+            args.training_script_args, args.log_dir, restart_count=attempt,
+            heartbeat_dir=heartbeat_dir, debugz_base_port=debugz_base,
+            membership_epoch=epoch,
         )
         if not local:
             print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
             return 2
         if grace is not None:
             grace.trainers = local
+        if coord is not None and lease_armed:
+            for t in local:
+                coord.register(t.tag, kind="trainer", endpoint=t.endpoint)
         monitor = None
         if heartbeat_dir and args.heartbeat_timeout > 0:
             from .heartbeat import HeartBeatMonitor
 
             # created AFTER spawn: a fresh monitor ignores stamps older
             # than itself, so leftovers from a previous attempt/job in a
-            # reused shared dir never read as hangs
+            # reused shared dir never read as hangs; it knows the
+            # membership epoch so a future-epoch stamp (a member owned
+            # by a NEWER coordinator) is never read as proof of life
             monitor = HeartBeatMonitor(
-                heartbeat_dir, [t.rank for t in local], args.heartbeat_timeout
+                heartbeat_dir, [t.rank for t in local],
+                args.heartbeat_timeout, epoch=epoch,
             )
         straggler = None
-        if heartbeat_dir and args.straggler_factor > 0:
+        eject = args.straggler_eject_factor > 0
+        if heartbeat_dir and (args.straggler_factor > 0 or eject):
             from .heartbeat import StragglerMonitor
 
             straggler = StragglerMonitor(
                 heartbeat_dir, [t.rank for t in local],
-                factor=args.straggler_factor)
-        rc = watch_local_trainers(local, monitor=monitor,
-                                  ps_supervisor=ps_supervisor, grace=grace,
-                                  straggler=straggler)
-        if (rc == 0 or attempt >= args.elastic_retries
+                factor=(args.straggler_eject_factor
+                        if eject else args.straggler_factor))
+        failure: dict = {}
+        rc = watch_local_trainers(
+            local, monitor=monitor, ps_supervisor=ps_supervisor,
+            grace=grace, straggler=straggler, failure=failure,
+            coordinator=coord if lease_armed else None,
+            straggler_eject=eject)
+        if (rc == 0
                 or rc == 128 + signal.SIGINT
                 or rc == 128 + signal.SIGTERM  # whole-job preemption
-                or (ps_supervisor is not None and ps_supervisor.aborted)):
+                or (ps_supervisor is not None and ps_supervisor.aborted)
+                or not elastic_enabled):
+            return rc
+        # charge the failure to the culprit's per-rank budget; the
+        # coordinator decides restart-in-place vs evict-and-resize
+        tag = failure.get("tag", local[0].tag)
+        rank = failure.get("rank", "?")
+        reason = failure.get("reason", f"exit code {rc}")
+        resized = False
+        if coord is not None:
+            verdict = coord.report_failure(tag, reason)
+            if verdict["evicted"]:
+                new_world = len(trainers) - 1
+                if new_world < max(1, args.min_world_size):
+                    print(f"[launch] {tag} (rank {rank}) exhausted its "
+                          f"per-rank budget ({reason}) and the job "
+                          f"cannot resize below "
+                          f"--min_world_size={args.min_world_size}; "
+                          f"aborting", file=sys.stderr)
+                    return rc
+                if len(ips) > 1:
+                    print(f"[launch] {tag} (rank {rank}) exhausted its "
+                          f"per-rank budget ({reason}); elastic resize "
+                          f"is single-node only — aborting",
+                          file=sys.stderr)
+                    return rc
+                survivors = [t for t in trainers if t.tag != tag]
+                # re-rank 0..W-1 but keep each survivor's stable tag
+                # (and endpoint — ports are identity on CPU fleets)
+                trainers = [Trainer(i, t.endpoint, tag=t.tag)
+                            for i, t in enumerate(survivors)]
+                epoch = verdict["epoch"]
+                resized = True
+        if attempt >= job_cap:
+            print(f"[launch] {tag} (rank {rank}) failed ({reason}) and "
+                  f"the job-level restart cap ({job_cap}) is exhausted; "
+                  f"aborting", file=sys.stderr)
             return rc
         attempt += 1
-        print(
-            f"[launch] elastic restart {attempt}/{args.elastic_retries} "
-            f"after exit code {rc} (trainers resume from checkpoint)",
-            file=sys.stderr,
-        )
+        if resized:
+            # elastic resize: survivors re-shard their checkpoints
+            # (CheckpointManager world-size gate) and the sync-PS
+            # barrier adopts the new trainer_num via the generation bump
+            os.environ["PADDLE_ELASTIC_RESHARD"] = "1"
+            print(
+                f"[launch] elastic restart {attempt}/{job_cap}: {tag} "
+                f"(rank {rank}) evicted after {reason}; membership "
+                f"epoch {epoch}, resizing to world_size="
+                f"{len(trainers)} (survivors resume from checkpoint, "
+                f"re-sharded)",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"[launch] elastic restart {attempt}/{job_cap}: {tag} "
+                f"(rank {rank}) died ({reason}); group restarts at "
+                f"world_size={len(trainers)} (trainers resume from "
+                f"checkpoint)",
+                file=sys.stderr,
+            )
         if heartbeat_dir:
             # drop stale stamps so the new group starts with a clean slate
             from .heartbeat import _stamp_path

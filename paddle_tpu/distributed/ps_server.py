@@ -422,22 +422,41 @@ class PSServer:
         gen = int(spec.pop("generation", 0))
         name = spec["name"]
         key = _table_key(name, spec.get("partition"))
+
+        def identity(s: dict) -> dict:
+            # sync_trainers (and the replica endpoint list) are
+            # MEMBERSHIP state, not table identity: an elastic resize
+            # re-creates the table at a new world size under a bumped
+            # generation, and the rows must carry over
+            return {k: v for k, v in s.items()
+                    if k not in ("sync_trainers", "replicas")}
+
         with self.lock:
             if key in self.tables:
-                if spec != self.specs[key]:
-                    raise ValueError(
-                        f"table {key!r} already exists with a different "
-                        f"spec: {self.specs[key]} vs {spec}")
                 if gen > self.gens.get(key, 0):
+                    if identity(spec) != identity(self.specs[key]):
+                        raise ValueError(
+                            f"table {key!r} already exists with a "
+                            f"different spec: {self.specs[key]} vs {spec}")
                     # elastic restart: the new group must never share
                     # barrier state (half-filled rounds, applied marks,
-                    # step high-water) with the dead one
+                    # step high-water) with the dead one; its
+                    # sync_trainers is the NEW world size, so the merge
+                    # denominator (dp-mean) tracks the resize
                     old = self.sync[key]
-                    self.sync[key] = _SyncState(old.num)
+                    self.sync[key] = _SyncState(
+                        int(spec.get("sync_trainers", old.num)))
+                    self.specs[key] = dict(spec)
                     self.gens[key] = gen
                     with old.cond:
                         old.reset = True
                         old.cond.notify_all()
+                elif spec != self.specs[key]:
+                    raise ValueError(
+                        f"table {key!r} already exists with a different "
+                        f"spec: {self.specs[key]} vs {spec} (a changed "
+                        f"sync_trainers needs a bumped generation — the "
+                        f"elastic-resize handshake)")
                 return {"rows": self.tables[key].rows,
                         "dim": self.tables[key].dim}
             kw = {k: v for k, v in spec.items()
@@ -869,6 +888,18 @@ class PSServer:
             self._table_by_key(key)
             return {"role": None, "epoch": 0, "seq": 0, "stale": False}
         return rs.status()
+
+    def replica_summary(self) -> Dict[str, dict]:
+        """Compact {partition_key: {role, epoch, seq, stale}} across
+        every hosted replicated partition — the payload this server's
+        coordinator lease renewals carry, so the control plane can
+        elect a caught-up backup when a primary's lease expires."""
+        out = {}
+        for key, rs in list(self.replicas.items()):
+            with rs.lock:
+                out[key] = {"role": rs.role, "epoch": rs.epoch,
+                            "seq": rs.seq, "stale": rs.stale}
+        return out
 
     # -- data verbs -------------------------------------------------------
 
@@ -1385,6 +1416,24 @@ def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
         from .heartbeat import HeartBeatWorker
 
         hb = HeartBeatWorker(hb_dir, hb_tag).start()
+    # job control plane (coordinator.py): renew a membership lease
+    # carrying the per-partition replica summary, so an expired primary
+    # lease lets the coordinator promote a backup with no client in the
+    # loop. No-op (two env reads) when the launcher didn't arm leases.
+    lease_worker = None
+    bound_host, bound_port = srv.server_address[0], srv.server_address[1]
+    if bound_host in ("0.0.0.0", ""):
+        bound_host = "127.0.0.1"
+    try:
+        from . import coordinator as _coord
+
+        lease_worker = _coord.maybe_start_lease_worker(
+            kind="pserver", tag=hb_tag,
+            self_endpoint=f"{bound_host}:{bound_port}",
+            payload_fn=lambda: {"partitions": srv.ps.replica_summary()})
+    except Exception as e:  # noqa: BLE001 — leases are advisory here
+        print(f"[ps_server] lease worker failed to start: {e}",
+              file=sys.stderr, flush=True)
     if ready_cb is not None:
         ready_cb(srv.server_address)
     if srv.ps.adopted_manifest is not None:
@@ -1400,6 +1449,8 @@ def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
     finally:
         if hb is not None:
             hb.stop()
+        if lease_worker is not None:
+            lease_worker.stop()
         srv.close_all_connections()
         srv.server_close()
         try:
@@ -1841,7 +1892,6 @@ class RemoteTable:
             chain = self._chain[p]
             if chain[self._primary_idx[p]] != dead_j:
                 return  # another thread already failed this partition over
-            _REG.counter("ps_client_failovers_total").inc()
             best = None
             for idx, j in enumerate(chain):
                 if j == dead_j:
@@ -1858,6 +1908,26 @@ class RemoteTable:
                     f"{self.endpoints[dead_j]} is unreachable and no "
                     f"live replica remains")
             rank, idx, st = best
+            if (st.get("role") == "primary"
+                    and int(st.get("epoch", 0)) > self._pepoch[p]):
+                # a control plane (coordinator lease elector) or a peer
+                # trainer already promoted this replica at a newer
+                # epoch — adopt the claim instead of deposing it with a
+                # redundant epoch bump; adoption is not a client-driven
+                # failover, so it gets its own counter
+                _REG.counter("ps_client_primary_adoptions_total").inc()
+                self._pepoch[p] = int(st.get("epoch", 0))
+                self._primary_idx[p] = idx
+                print(f"[ps_client] pserver {self.endpoints[dead_j]} "
+                      f"unreachable for table {self.name!r} partition "
+                      f"{p}; adopting already-promoted primary "
+                      f"{self.endpoints[chain[idx]]} (epoch "
+                      f"{self._pepoch[p]})", file=sys.stderr, flush=True)
+                for p2 in range(self._n):
+                    if dead_j in self._chain[p2]:
+                        self._schedule_rejoin(p2, dead_j)
+                return
+            _REG.counter("ps_client_failovers_total").inc()
             new_epoch = max(self._pepoch[p], rank[1]) + 1
             backups = [self.endpoints[j] for j in chain
                        if j not in (dead_j, chain[idx])]

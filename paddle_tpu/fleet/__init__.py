@@ -76,6 +76,16 @@ def init_server(model_dir: Optional[str] = None,
     _fleet_state["ps_snapshot_secs"] = snapshot_secs
 
 
+def membership() -> Optional[dict]:
+    """The job control plane's membership table (ISSUE 8): epoch, world
+    size, and each member's lease state, straight from the launcher's
+    coordinator (PADDLE_COORDINATOR_ENDPOINT). None when no control
+    plane is armed — single-process runs and lease-less launches."""
+    from ..distributed import coordinator
+
+    return coordinator.query_membership()
+
+
 def ps_snapshot_manifest(dirname: str) -> Optional[dict]:
     """Parsed manifest.json of a PS snapshot directory (snapshot epoch,
     generation, tables), or None for absent/pre-manifest dirs."""

@@ -85,6 +85,30 @@ class Preempted(RuntimeError):
     `sys.exit(PREEMPTED_EXIT_CODE)` so the supervisor respawns you."""
 
 
+class WorldSizeMismatchError(RuntimeError):
+    """The checkpoint was written by a job at a different world size
+    and elastic re-shard is disabled: resuming it blind would silently
+    misalign every rank's data shard. Re-split the data positions
+    across the new dp group and restore(allow_reshard=True), or set
+    PADDLE_ELASTIC_RESHARD=1 (the launcher's elastic-resize restarts
+    do)."""
+
+
+def _reshard_allowed_from_env() -> bool:
+    return os.environ.get("PADDLE_ELASTIC_RESHARD", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def _world_size_from_env() -> Optional[int]:
+    raw = os.environ.get("PADDLE_TRAINERS_NUM")
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # preemption signal plumbing
 # ---------------------------------------------------------------------------
@@ -199,11 +223,16 @@ class CheckpointManager:
     whole scope is checkpointed (and PS tables are skipped)."""
 
     def __init__(self, root: str, keep_last_n: int = 3, program=None,
-                 scope=None):
+                 scope=None, world_size: Optional[int] = None):
         self.root = os.path.abspath(root)
         self.keep_last_n = max(1, int(keep_last_n))
         self.program = program
         self.scope = scope
+        # elastic contract: manifests record the dp world size that
+        # wrote them (default: the launcher env); restore refuses a
+        # mismatch unless the caller opted into re-sharding
+        self.world_size = (int(world_size) if world_size is not None
+                           else _world_size_from_env())
         os.makedirs(self.root, exist_ok=True)
 
     # -- layout ----------------------------------------------------------
@@ -332,6 +361,10 @@ class CheckpointManager:
                     os.environ.get("PADDLE_ELASTIC_RESTART", "0") or 0),
             },
         }
+        if self.world_size is not None:
+            manifest["world_size"] = int(self.world_size)
+            manifest["membership_epoch"] = int(
+                os.environ.get("PADDLE_MEMBERSHIP_EPOCH", "0") or 0)
         # THE commit point: tmp + os.replace makes the manifest appear
         # atomically; before this line the directory reads as torn
         _atomic_write_bytes(os.path.join(final, MANIFEST),
@@ -375,16 +408,28 @@ class CheckpointManager:
 
     # -- restore ---------------------------------------------------------
     def restore(self, step: Optional[int] = None, program=None,
-                scope=None) -> Optional[dict]:
+                scope=None, allow_reshard: Optional[bool] = None,
+                ) -> Optional[dict]:
         """Restore the given step, or the newest checkpoint that passes
         full verification — a torn or corrupted newer directory is
         skipped with a warning, never trusted. Returns
-        {"step", "extra", "manifest"} or None when no valid checkpoint
-        exists. On success the scope holds the checkpointed persistables
-        and RNG key, and any PS tables the program references are rolled
-        back to their checkpointed state."""
+        {"step", "extra", "manifest", "world_size"} or None when no
+        valid checkpoint exists. On success the scope holds the
+        checkpointed persistables and RNG key, and any PS tables the
+        program references are rolled back to their checkpointed state.
+
+        Elastic gate: a manifest written at a DIFFERENT world size is
+        refused (WorldSizeMismatchError — never a silent fallback, the
+        older checkpoints have the same world size) unless
+        `allow_reshard` (default: PADDLE_ELASTIC_RESHARD env) is true;
+        then the caller owns re-splitting its data positions across the
+        new dp group and the returned "world_size" says what to re-split
+        FROM. Pre-elastic manifests carry no world size and skip the
+        check."""
         program = program if program is not None else self.program
         scope = scope if scope is not None else (self.scope or global_scope())
+        if allow_reshard is None:
+            allow_reshard = _reshard_allowed_from_env()
         candidates = [step] if step is not None else \
             list(reversed(self.steps()))
         for s in candidates:
@@ -395,8 +440,21 @@ class CheckpointManager:
                     f"back to the previous checkpoint",
                     RuntimeWarning, stacklevel=2)
                 continue
+            m = self.manifest(s)
+            ckpt_ws = (m or {}).get("world_size")
+            if (ckpt_ws is not None and self.world_size is not None
+                    and int(ckpt_ws) != int(self.world_size)
+                    and not allow_reshard):
+                raise WorldSizeMismatchError(
+                    f"checkpoint ckpt-{s:08d} was written by a world of "
+                    f"{ckpt_ws} trainers but this job runs "
+                    f"{self.world_size}; elastic re-shard is disabled — "
+                    f"re-split the data positions and pass "
+                    f"allow_reshard=True (or PADDLE_ELASTIC_RESHARD=1)")
             try:
-                return self._load(s, program, scope)
+                out = self._load(s, program, scope)
+                out["world_size"] = ckpt_ws
+                return out
             except Exception as e:  # corrupt despite checksums: skip it
                 warnings.warn(
                     f"checkpoint ckpt-{s:08d} failed to load ({e}); "

@@ -231,6 +231,7 @@ class Model:
         checkpoint_freq=0,
         checkpoint_keep=3,
         resume=False,
+        reshard=None,
     ):
         """reference hapi fit:1119, plus the preemption-safe layer
         (fluid/checkpoint.py):
@@ -259,6 +260,16 @@ class Model:
                          last checkpoint and re-trains from there (one
                          rollback without an intervening good step —
                          then the error propagates).
+        reshard          elastic resume across a world-size change
+                         (launcher resize): None defaults to
+                         PADDLE_ELASTIC_RESHARD. False (and env unset):
+                         a checkpoint from a different world size is
+                         REFUSED (checkpoint.WorldSizeMismatchError).
+                         True: resume proceeds and the mid-epoch
+                         position is re-split — the per-rank step is
+                         scaled by old_world/new_world so the global
+                         sample offset carries over (exact when the
+                         global batch divides both world sizes).
         """
         from ..fluid import checkpoint as ckpt_mod
         from ..fluid.flags import flag
@@ -290,7 +301,7 @@ class Model:
 
         epoch, resume_step, pending_losses, global_step = 0, 0, [], 0
         if mgr is not None and resume:
-            st = mgr.restore()
+            st = mgr.restore(allow_reshard=reshard)
             if st is not None:
                 ex = st["extra"]
                 epoch = int(ex.get("epoch", 0))
@@ -299,6 +310,32 @@ class Model:
                 history = {k: list(v)
                            for k, v in ex.get("history", history).items()}
                 global_step = int(ex.get("global_step", 0))
+                ckpt_ws = st.get("world_size")
+                if (ckpt_ws and mgr.world_size
+                        and int(ckpt_ws) != int(mgr.world_size)):
+                    # elastic resize: preserve the GLOBAL sample offset
+                    # by scaling the per-rank position; the per-rank
+                    # loss history from the old split is not comparable
+                    # to the new shard, so the epoch restarts its
+                    # running-mean bookkeeping at the re-split point
+                    import warnings as _warnings
+
+                    scaled = (resume_step * int(ckpt_ws)) // int(
+                        mgr.world_size)
+                    if (resume_step * int(ckpt_ws)) % int(mgr.world_size):
+                        _warnings.warn(
+                            f"elastic resume: per-rank step "
+                            f"{resume_step}x{ckpt_ws} does not divide "
+                            f"the new world {mgr.world_size}; rounding "
+                            f"the resume position down", RuntimeWarning,
+                            stacklevel=2)
+                    _warnings.warn(
+                        f"elastic resume: checkpoint world size "
+                        f"{ckpt_ws} -> {mgr.world_size}; resuming epoch "
+                        f"{epoch} at re-split step {scaled} (was "
+                        f"{resume_step})", RuntimeWarning, stacklevel=2)
+                    resume_step = scaled
+                    pending_losses = []
 
         def _position(step, losses):
             return {"epoch": epoch, "step": step,
@@ -341,7 +378,7 @@ class Model:
                                 or sig == last_rollback_sig):
                             raise
                         last_rollback_sig = sig
-                        st = mgr.restore()
+                        st = mgr.restore(allow_reshard=reshard)
                         ex = st["extra"]
                         epoch = int(ex.get("epoch", 0))
                         resume_step = int(ex.get("step", 0))

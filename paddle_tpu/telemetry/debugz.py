@@ -6,11 +6,19 @@ sidecar, no log scraping:
 
   /metrics   Prometheus text exposition of the process registry
              (point a scraper at it, or curl it)
-  /statusz   build + flags + mesh + step summary (JSON)
+  /statusz   build + flags + mesh + step summary + (when the job
+             control plane is armed) the coordinator's membership
+             table (JSON)
   /steps     recent per-step breakdown records (JSON list; the same
              schema the PADDLE_METRICS_PATH JSONL sink writes)
   /proftop   last per-op cost report built in this process (JSON;
              404-shaped {} until telemetry.cost builds one)
+  /flagz     GET: the runtime-mutable flag whitelist + every flag's
+             current value. POST {"name": ..., "value": ...}: flip one
+             whitelisted flag live (FLAGS_check_numerics and friends;
+             PADDLE_* knobs set the env for next-use readers), with an
+             audit record in the metrics sink and a registry counter —
+             non-whitelisted names are 403, never silently applied
   /healthz   "ok" — liveness for orchestration probes
 
 Arming: PADDLE_DEBUGZ_PORT=<port> starts the server on first executor
@@ -32,6 +40,20 @@ import threading
 from typing import Optional
 
 ENV_PORT = "PADDLE_DEBUGZ_PORT"
+
+# /flagz mutation whitelist — runtime knobs that are SAFE to flip on a
+# live trainer: guards and diagnostics, never anything that changes the
+# numerics of committed steps. FLAGS_* route through fluid.flags;
+# PADDLE_* entries are env-backed knobs read at next use.
+FLAGZ_MUTABLE = (
+    "FLAGS_check_numerics",
+    "FLAGS_check_numerics_max_bad_steps",
+    "FLAGS_check_nan_inf",
+    "FLAGS_benchmark",
+    "FLAGS_enable_unused_var_check",
+    "PADDLE_STRAGGLER_FACTOR",
+    "PADDLE_LOG_VERBOSITY",
+)
 
 _server = None
 _checked = False
@@ -100,7 +122,71 @@ def _statusz() -> dict:
         out["ps_replication"] = reps or None
     except Exception:  # noqa: BLE001
         out["ps_replication"] = None
+    try:
+        # job control plane (ISSUE 8): the coordinator's membership
+        # table — epoch, world size, per-member lease state — when the
+        # launcher armed leases; None otherwise
+        from ..distributed import coordinator as _coord
+
+        out["membership"] = _coord.query_membership(timeout=1.0)
+    except Exception:  # noqa: BLE001
+        out["membership"] = None
     return out
+
+
+def _flagz_state() -> dict:
+    from ..fluid import flags as fl
+
+    current = {}
+    for name in FLAGZ_MUTABLE:
+        if name.startswith("FLAGS_"):
+            current[name] = fl._values.get(name)
+        else:
+            current[name] = os.environ.get(name)
+    return {"mutable": list(FLAGZ_MUTABLE), "values": current}
+
+
+def _flagz_post(body: bytes):
+    """(status, content_type, body) for POST /flagz. One mutation per
+    request: {"name": <whitelisted knob>, "value": <new value>}."""
+    import json as _json
+
+    from ..fluid import flags as fl
+    from . import sink as _sink
+    from .registry import get_registry
+
+    try:
+        req = _json.loads(body.decode() or "{}")
+        name, value = req["name"], req["value"]
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        return (400, "application/json", _json.dumps(
+            {"error": f"bad request: {type(e).__name__}: {e}; want "
+                      f'{{"name": ..., "value": ...}}'}).encode())
+    if name not in FLAGZ_MUTABLE:
+        return (403, "application/json", _json.dumps(
+            {"error": f"{name!r} is not runtime-mutable",
+             "mutable": list(FLAGZ_MUTABLE)}).encode())
+    if name.startswith("FLAGS_"):
+        old = fl._values.get(name)
+        try:
+            fl.set_flags({name: value})
+        except (ValueError, TypeError) as e:
+            return (400, "application/json", _json.dumps(
+                {"error": f"cannot set {name}: {e}"}).encode())
+        new = fl._values.get(name)
+    else:
+        old = os.environ.get(name)
+        os.environ[name] = str(value)
+        new = str(value)
+    # the audit trail: one JSONL record (when the sink is armed) + a
+    # counter either way, so a scrape shows that flags were touched
+    get_registry().counter("debugz_flagz_mutations_total",
+                           help="runtime flag mutations via POST /flagz",
+                           flag=name).inc()
+    _sink.emit({"kind": "flagz_audit", "flag": name,
+                "old": old, "new": new})
+    return (200, "application/json", _json.dumps(
+        {"ok": True, "flag": name, "old": old, "new": new}).encode())
 
 
 def _route(path: str):
@@ -134,10 +220,13 @@ def _route(path: str):
                                 "or telemetry.cost.profile_executor_run)"
                                 }).encode())
         return 200, "application/json", json.dumps(rep.to_json()).encode()
+    if path == "/flagz":
+        return (200, "application/json",
+                json.dumps(_flagz_state()).encode())
     if path in ("", "/", "/index.html"):
         return (200, "text/plain; charset=utf-8",
                 b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
-                b"/healthz\n")
+                b"/flagz /healthz\n")
     return 404, "text/plain; charset=utf-8", b"not found\n"
 
 
@@ -165,6 +254,25 @@ def serve(port: Optional[int] = None, host: str = "0.0.0.0"):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = self.rfile.read(n) if n else b""
+                    path = self.path.split("?")[0]
+                    if path == "/flagz":
+                        status, ctype, out = _flagz_post(body)
+                    else:
+                        status, ctype = 404, "text/plain; charset=utf-8"
+                        out = b"not found\n"
+                except Exception as e:  # noqa: BLE001
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    out = f"debugz error: {type(e).__name__}: {e}\n".encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
 
             def log_message(self, fmt, *args):  # quiet by default
                 pass

@@ -1,0 +1,135 @@
+"""Worker for tests/test_elastic.py kill-one-of-four drill: sync-PS
+training whose post-resize loss trace must be BIT-identical to a clean
+dp=W' run resumed from the same checkpoint.
+
+The job trains a PS-hosted table with plain least squares against a
+deterministic target. Data sharding is GLOBAL: step g consumes global
+samples [g*GLOBAL_B, (g+1)*GLOBAL_B), and rank r of W takes the r-th
+contiguous slice of that global batch — so ANY world size W that
+divides GLOBAL_B re-splits the same sample positions exactly, which is
+what makes an elastic resize comparable to a from-scratch run at the
+new dp degree. The sync-PS barrier merges per-rank gradient means in
+trainer order scaled 1/W (dp-mean), so equal slices make the merged
+update the global-batch mean at every W.
+
+Checkpoints ride the real CheckpointManager: rank 0 commits
+{global_step, table state} every CKPT_FREQ steps; on (re)start every
+rank restores the newest valid checkpoint (the world-size gate applies
+— a resized resume needs PADDLE_ELASTIC_RESHARD=1, which the
+launcher's resize restart exports), rank 0 rolls the PS table back to
+the checkpointed state, and a marker file releases the other ranks.
+
+Env knobs:
+  ELASTIC_TEST_DIR       checkpoint root (shared)
+  ELASTIC_TEST_TRACE_DIR per-tag jsonl traces: trace.<tag>.jsonl, one
+                         {"gs", "loss", "w", "rank"} line per step
+                         (append across incarnations; a replayed step
+                         appears twice — consumers keep the LAST line
+                         per (gs, tag))
+  ELASTIC_TEST_DIE_TAG   stable tag ("trainer2") that dies…
+  ELASTIC_TEST_DIE_AT    …right after global step DIE_AT-1 completes,
+                         in EVERY incarnation (permanently-lost host)
+  ELASTIC_TEST_STEPS     total global steps (default 12)
+  ELASTIC_TEST_CKPT_FREQ checkpoint every N global steps (default 2)
+  ELASTIC_TEST_RESTORE_STEP  parity runs: restore exactly this step
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed import ps
+from paddle_tpu.fluid import checkpoint as ckpt_mod
+from paddle_tpu.fluid import executor as executor_mod
+
+GLOBAL_B, DIM, ROWS = 12, 4, 60
+LR = 0.5
+
+
+def _target(ids: np.ndarray) -> np.ndarray:
+    """Deterministic regression target per row id."""
+    base = (ids[:, None].astype(np.float32) + 1.0) / ROWS
+    scale = np.arange(1, DIM + 1, dtype=np.float32)[None, :]
+    return np.sin(base * scale).astype(np.float32)
+
+
+def main() -> int:
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    tag = os.environ.get("PADDLE_TRAINER_TAG", f"trainer{rank}")
+    gen = int(os.environ.get("PADDLE_ELASTIC_RESTART", 0))
+    root = os.environ["ELASTIC_TEST_DIR"]
+    trace_dir = os.environ["ELASTIC_TEST_TRACE_DIR"]
+    die_tag = os.environ.get("ELASTIC_TEST_DIE_TAG", "")
+    die_at = int(os.environ.get("ELASTIC_TEST_DIE_AT", 0))
+    steps = int(os.environ.get("ELASTIC_TEST_STEPS", 12))
+    freq = int(os.environ.get("ELASTIC_TEST_CKPT_FREQ", 2))
+    restore_step = os.environ.get("ELASTIC_TEST_RESTORE_STEP")
+
+    assert GLOBAL_B % world == 0, (GLOBAL_B, world)
+    per = GLOBAL_B // world
+
+    table = ps.create_table("elastic_table", shape=(ROWS, DIM),
+                            mode="sync", num_shards=2, optimizer="sgd",
+                            learning_rate=LR, seed=7)
+
+    # every rank uses its own scope so the manager never touches jax
+    # state it does not own; the training state that matters (the PS
+    # table) rides extra_state
+    mgr = ckpt_mod.CheckpointManager(
+        root, keep_last_n=50, program=None,
+        scope=executor_mod.Scope())
+    marker = os.path.join(root, f"restored.gen{gen}.w{world}")
+    g0 = 0
+    if rank == 0:
+        st = mgr.restore(step=int(restore_step) if restore_step else None)
+        if st is not None:
+            g0 = int(st["extra"]["global_step"])
+            table.load_state_dict(st["extra"]["table"])
+        with open(marker + ".tmp", "w") as f:
+            f.write(str(g0))
+        os.replace(marker + ".tmp", marker)
+    else:
+        deadline = time.time() + 60
+        while not os.path.exists(marker):
+            if time.time() > deadline:
+                print(f"[elastic_worker] rank {rank}: restore marker "
+                      f"never appeared", file=sys.stderr)
+                return 4
+            time.sleep(0.05)
+        with open(marker) as f:
+            g0 = int(f.read().strip())
+
+    rng = np.random.RandomState(0)
+    all_ids = rng.randint(0, ROWS, (steps * GLOBAL_B,)).astype(np.int64)
+
+    trace_path = os.path.join(trace_dir, f"trace.{tag}.jsonl")
+    for g in range(g0, steps):
+        batch = all_ids[g * GLOBAL_B:(g + 1) * GLOBAL_B]
+        my = batch[rank * per:(rank + 1) * per]
+        emb = table.gather(my)
+        tgt = _target(my)
+        diff = emb - tgt
+        loss = float(np.float64((diff * diff).mean()))
+        grad = (2.0 / (per * DIM)) * diff  # d(mean sq err)/d emb
+        table.push_gradients(my, grad.astype(np.float32))
+        with open(trace_path, "a") as f:
+            f.write(json.dumps({"gs": g, "loss": loss, "w": world,
+                                "rank": rank}) + "\n")
+            f.flush()
+        if tag == die_tag and g + 1 == die_at:
+            os._exit(9)  # the permanently-lost host: dies EVERY time
+        if rank == 0 and (g + 1) % freq == 0:
+            # the sync barrier guarantees no peer is mid-round here:
+            # round g merged before our push returned, and round g+1
+            # cannot merge until we push it — state_dict() is a clean
+            # post-step-g cut
+            mgr.save(g + 1, extra_state={"global_step": g + 1,
+                                         "table": table.state_dict()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ stamps left by a previous attempt in a reused directory must be ignored
 by a fresh monitor. All time arithmetic is driven through the explicit
 `now=` parameter so no test sleeps.
 """
+import json
 import os
 import time
 
@@ -14,10 +15,13 @@ from paddle_tpu.distributed.heartbeat import (
     HeartBeatMonitor, HeartBeatWorker, _stamp_path)
 
 
-def _stamp(directory, rank, mtime=None):
+def _stamp(directory, rank, mtime=None, payload=None):
     p = _stamp_path(str(directory), rank)
     with open(p, "w") as f:
-        f.write(repr(time.time()))
+        if payload is None:
+            f.write(repr(time.time()))
+        else:
+            f.write(json.dumps(dict({"t": time.time()}, **payload)))
     if mtime is not None:
         os.utime(p, (mtime, mtime))
     return p
@@ -104,6 +108,47 @@ def test_pserver_tag_through_failover_and_respawn(tmp_path):
     _stamp(tmp_path, "ps0", mtime=mon._t0 + 7.0)
     _stamp(tmp_path, "ps1", mtime=mon._t0 + 7.0)
     assert mon.stale_ranks(now=mon._t0 + 7.5) == []
+
+
+def test_future_epoch_stamp_reads_as_stale(tmp_path):
+    """Stale-coordinator split-brain guard (ISSUE 8): a FRESH stamp
+    whose payload claims a FUTURE membership epoch is not proof of life
+    to an epoch-aware monitor — the stamper answers to a newer
+    coordinator, so this supervisor's membership view is stale and it
+    must not keep making liveness calls on that member's behalf."""
+    mon = HeartBeatMonitor(str(tmp_path), [0, 1], timeout=5.0,
+                           startup_grace=100.0, epoch=1)
+    # rank 0 stamps at the monitor's own epoch: alive
+    _stamp(tmp_path, 0, mtime=mon._t0 + 1.0, payload={"epoch": 1})
+    # rank 1 stamps from membership epoch 3 — a newer coordinator owns
+    # it; despite being perfectly fresh the stamp reads as STALE
+    _stamp(tmp_path, 1, mtime=mon._t0 + 1.0, payload={"epoch": 3})
+    assert mon.stale_ranks(now=mon._t0 + 1.5) == [1]
+    # past epochs (and epoch-less legacy stamps) are trusted normally
+    _stamp(tmp_path, 1, mtime=mon._t0 + 2.0, payload={"epoch": 0})
+    assert mon.stale_ranks(now=mon._t0 + 2.5) == []
+
+
+def test_epoch_unaware_monitor_ignores_epochs(tmp_path):
+    """Without an epoch (the pre-control-plane default), fresh stamps
+    are fresh no matter what epoch they claim — bit-compatible with the
+    old monitor."""
+    mon = HeartBeatMonitor(str(tmp_path), [0], timeout=5.0,
+                           startup_grace=100.0)
+    _stamp(tmp_path, 0, mtime=mon._t0 + 1.0, payload={"epoch": 99})
+    assert mon.stale_ranks(now=mon._t0 + 1.5) == []
+
+
+def test_worker_stamps_carry_membership_epoch(tmp_path, monkeypatch):
+    """Launched workers stamp their PADDLE_MEMBERSHIP_EPOCH so the
+    launcher-side monitor (and any human reading the file) can apply
+    the split-brain rule."""
+    monkeypatch.setenv("PADDLE_MEMBERSHIP_EPOCH", "2")
+    w = HeartBeatWorker(str(tmp_path), 0, interval=30.0)
+    w._beat()
+    with open(_stamp_path(str(tmp_path), 0)) as f:
+        stamp = json.load(f)
+    assert stamp["epoch"] == 2 and "t" in stamp
 
 
 def test_worker_stamps_atomically_and_stop_is_idempotent(tmp_path):

@@ -38,6 +38,16 @@ echo "== PS replication drills (R=2 failover + hedging) =="
 # (tests/test_ps_replication.py, tests/test_ps_faults.py)
 python -m pytest tests/test_ps_replication.py -q -m slow
 
+echo "== elastic resize drill (kill-one-of-four -> dp=3 bit-parity) =="
+# ISSUE 8 acceptance: a dp=4 job loses one trainer PERMANENTLY; the
+# coordinator-backed launcher evicts it after its per-rank budget,
+# bumps the membership epoch and restarts the survivors at dp=3 from
+# the last checkpoint — and the post-resize loss trace must be
+# BIT-identical to a clean dp=3 run resumed from the same checkpoint
+# step. The fast coordinator/lease/flagz/world-size unit tests run in
+# tier-1 above (tests/test_elastic.py)
+python -m pytest tests/test_elastic.py -q -m slow
+
 echo "== parallel heavy parity (slow lane: ring/pipeline/SP + breadth) =="
 # heavy parametrizations / breadth sweeps run here so tier-1's
 # 'not slow' pass stays inside its wall-clock budget. NOT included:
