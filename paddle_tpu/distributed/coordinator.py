@@ -488,9 +488,17 @@ class CoordinatorClient:
             # lease runs out — exactly what a silently-dead host does
             _REG.counter("coordinator_client_renewals_suppressed_total").inc()
             return {"suppressed": True}
-        return self._conn.call(
+        out = self._conn.call(
             "renew", tag=self.tag, payload=payload,
             epoch=membership_epoch_from_env())
+        if isinstance(out, dict) and out.get("evicted"):
+            # lease-expiry eviction: this member is out of the job —
+            # dump the flight record NOW, while the spans that led here
+            # are still in the ring (no-op unless tracing is armed)
+            from ..telemetry import tracing
+
+            tracing.flight_dump("lease_evicted")
+        return out
 
     def membership(self) -> dict:
         return self._conn.call("membership")

@@ -25,6 +25,13 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     the request never reaches the server: exercises the
                     plain retry path
             delay   client side: sleep <arg> seconds before sending
+            stall   client side, REPEATING: every <nth>-th outgoing RPC
+                    matching the verb sleeps <arg> MILLISECONDS before
+                    sending — the client-side sibling of `slow`, used
+                    with PADDLE_PS_FAULT_TAGS to make ONE trainer's
+                    verb deterministically late (the step-tracing
+                    critical-path drill: the stalled rank must be the
+                    one the merged trace blames)
             kill    server side: os._exit(1) the pserver process once it
                     has handled <nth> RPCs in total (method filter still
                     applies): exercises supervision + snapshot recovery
@@ -94,7 +101,7 @@ from typing import List, Optional
 ENV_SPEC = "PADDLE_PS_FAULT_SPEC"
 ENV_TAGS = "PADDLE_PS_FAULT_TAGS"
 
-_CLIENT_ACTIONS = ("drop", "refuse", "delay")
+_CLIENT_ACTIONS = ("drop", "refuse", "delay", "stall")
 _SERVER_ACTIONS = ("kill", "slow", "partition")
 _PHASE_ACTIONS = ("crash",)
 # rules whose <method> field names a PROCESS TAG, not an RPC verb
@@ -166,6 +173,10 @@ def parse_spec(spec: str) -> List[_Rule]:
             raise ValueError(
                 f"bad fault rule {raw!r}: netsplit needs a window — "
                 f"netsplit:<tag>:<nth>:<ms>")
+        if action == "stall" and arg <= 0:
+            raise ValueError(
+                f"bad fault rule {raw!r}: stall needs a duration — "
+                f"stall:<verb>:<nth>:<ms>")
         rules.append(_Rule(action, method, n, arg))
     return rules
 
@@ -263,6 +274,8 @@ class FaultInjector:
                 f"fault injection: netsplit — {method!r} RPC dropped "
                 f"({self.netsplit_until - now:.3f}s until the window "
                 f"heals)")
+        for r in self._take_every(("stall",), method):
+            time.sleep(r.arg / 1000.0)  # arg is MILLISECONDS, repeating
         for r in self._take(("refuse", "delay"), method):
             if r.action == "delay":
                 time.sleep(r.arg)
@@ -274,6 +287,19 @@ class FaultInjector:
     def drop_after_send(self, method: str) -> bool:
         return bool(self._take(("drop",), method))
 
+    @staticmethod
+    def _flight(reason: str) -> None:
+        """Best-effort flight-recorder dump before an os._exit — the
+        atexit/excepthook triggers never run for a hard death, so the
+        kill/crash rules dump the span ring themselves. No-op unless
+        PADDLE_TRACING + PADDLE_TRACE_DIR are armed."""
+        try:
+            from ..telemetry import tracing
+
+            tracing.flight_dump(reason)
+        except Exception:  # noqa: BLE001 — the death must still happen
+            pass
+
     # -- server side -----------------------------------------------------
     def on_server_call(self, method: str) -> None:
         for r in self._take(("kill",), method):
@@ -281,6 +307,7 @@ class FaultInjector:
             # must recover from exactly this
             os.write(2, (f"[faults] killing pserver pid {os.getpid()} "
                          f"(rule kill:{r.method}:{r.nth})\n").encode())
+            self._flight("kill")
             os._exit(1)
         for r in self._take_every(("slow",), method):
             time.sleep(r.arg / 1000.0)  # arg is MILLISECONDS
@@ -323,6 +350,7 @@ class FaultInjector:
             os.write(2, (f"[faults] crashing pid {os.getpid()} at phase "
                          f"{phase!r} (rule crash:{r.method}:{r.nth})\n"
                          ).encode())
+            self._flight(f"crash:{phase}")
             os._exit(1)
 
 

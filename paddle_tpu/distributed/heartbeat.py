@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple, Union
 
+from ..telemetry import tracing as _tracing
+
 ENV_DIR = "PADDLE_HEARTBEAT_DIR"
 
 # a "rank" is an int trainer rank or a string tag (pservers stamp as
@@ -98,6 +100,12 @@ class HeartBeatWorker:
                     stamp["avg_step_s"] = round(avg, 6)
             except Exception:  # noqa: BLE001 — liveness must never die
                 pass
+        # the latest step's trace_id (PADDLE_TRACING): straggler episode
+        # events cite it, so tracetop can be pointed straight at the
+        # culprit's step trace; absent when tracing is off
+        tid = _tracing.last_step_trace_id()
+        if tid is not None:
+            stamp["trace_id"] = tid
         tmp = f"{self.path}.tmp"
         with open(tmp, "w") as f:
             f.write(json.dumps(stamp))
@@ -182,14 +190,25 @@ class StragglerMonitor:
         self.ranks = list(ranks)
         kw = {} if min_steps is None else {"min_steps": min_steps}
         self.detector = StragglerDetector(factor=factor, **kw)
+        # rank -> latest step trace_id seen in its stamps (PADDLE_TRACING
+        # ride-along): a straggler episode names the culprit's trace so
+        # tracetop can be pointed straight at the evidence
+        self._last_trace: dict = {}
 
     def poll(self) -> List[dict]:
         for r in self.ranks:
             stamp = read_stamp(self.directory, r)
             if stamp is None or "step" not in stamp:
                 continue
+            if stamp.get("trace_id"):
+                self._last_trace[r] = stamp["trace_id"]
             self.detector.observe(r, int(stamp["step"]), float(stamp["t"]))
-        return self.detector.events()
+        events = self.detector.events()
+        for ev in events:
+            tid = self._last_trace.get(ev.get("rank"))
+            if tid is not None:
+                ev["trace_id"] = tid
+        return events
 
 
 class HeartBeatMonitor:

@@ -170,11 +170,15 @@ def _parse_args(argv):
     )
     p.add_argument(
         "--trace_dir", default=None,
-        help="collect per-rank chrome traces: trainers record host "
-        "spans (PADDLE_TRACE_DIR contract, fluid/profiler.py) and dump "
-        "trace.<rank>.json here at exit; after the job the launcher "
-        "merges them into <trace_dir>/timeline.json (pid=rank — open "
-        "in Perfetto / chrome://tracing)",
+        help="collect per-process traces: trainers record host spans "
+        "(PADDLE_TRACE_DIR contract, fluid/profiler.py) and dump "
+        "trace.<rank>.json here at exit; causal step tracing "
+        "(telemetry/tracing.py) is armed in every child — pservers and "
+        "the coordinator dump span lanes + flightrec.<tag>.json flight "
+        "records here too (tools/tracetop.py merges those into per-round "
+        "critical paths). After the job the launcher merges everything "
+        "into <trace_dir>/timeline.json (pid=rank — open in Perfetto / "
+        "chrome://tracing)",
     )
     p.add_argument(
         "--debugz_port", type=int, default=None,
@@ -681,6 +685,11 @@ def launch(argv=None) -> int:
         # trainers inherit it via start_local_trainers' env copy and
         # auto-dump per-rank traces (profiler.maybe_start_trace_collection)
         os.environ["PADDLE_TRACE_DIR"] = args.trace_dir
+        # --trace_dir is an explicit observability opt-in: arm causal
+        # span tracing (telemetry/tracing.py) in every child AND this
+        # launcher (the coordinator's lane) unless the operator pinned
+        # it off; the flight recorder then dumps per-process spans here
+        os.environ.setdefault("PADDLE_TRACING", "1")
 
     # snapshot interval: explicit flag > env > supervision-implied default
     snapshot_secs = args.ps_snapshot_secs
@@ -775,6 +784,22 @@ def launch(argv=None) -> int:
                               ps_supervisor, grace, coord=coord,
                               lease_armed=lease_secs > 0)
         if args.trace_dir:
+            # pservers dump their span timelines on SIGTERM — stop them
+            # BEFORE the merge so timeline.json spans the whole job
+            # (trainer ranks + pserver + coordinator lanes)
+            terminate_pservers(pservers)
+            pservers = []
+            try:
+                from ..telemetry import tracing as _tracing
+
+                # the coordinator serves inside THIS process: its
+                # renewal/election spans live in the launcher's ring
+                _tracing.dump_chrome(directory=args.trace_dir,
+                                     tag="coord")
+                _tracing.flight_dump("exit", directory=args.trace_dir,
+                                     tag="coord")
+            except Exception:  # noqa: BLE001 — merge anyway
+                pass
             from ..telemetry.timeline import merge_traces
 
             merged = merge_traces(args.trace_dir)

@@ -79,6 +79,7 @@ from . import faults
 from .ps import ShardedHostTable
 from ..telemetry import BYTE_BUCKETS, get_registry
 from ..telemetry import sink as _metrics_sink
+from ..telemetry import tracing as _tracing
 
 _LEN = struct.Struct(">Q")
 
@@ -963,26 +964,35 @@ class PSServer:
                 st.last_applied = max(st.last_applied, step)
                 del st.rounds[step]
                 merged = (ids_m, g_m / st.num, peers)
-            elif st.cond.wait_for(lambda: token in st.done or st.reset,
-                                  timeout=SYNC_TIMEOUT):
-                if token in st.done:
-                    st.done.discard(token)  # each waiter prunes its own
-                else:
-                    # generation bump while we waited: our group is dead
-                    raise RuntimeError(
-                        f"sync-PS round abandoned: the trainer group "
-                        f"restarted while table {name!r} round {step} "
-                        f"was waiting for peers")
+                # this arrival RELEASED the barrier: the causal evidence
+                # tracetop's critical path cites for the round
+                _tracing.annotate(released_round=step)
             else:
-                # drop our contribution so the round can't half-fire if
-                # this trainer is restarted and retries
-                if step in st.rounds:
-                    st.rounds[step].pop(trainer_id, None)
-                raise RuntimeError(
-                    f"sync-PS barrier timed out after {SYNC_TIMEOUT}s: "
-                    f"only {len(st.rounds.get(step, {}))}/{st.num} "
-                    f"trainers pushed table {name!r} round {step} — a "
-                    f"peer trainer likely died")
+                with _tracing.span("barrier_wait",
+                                   attrs={"table": name, "round": step,
+                                          "trainer": trainer_id}):
+                    woke = st.cond.wait_for(
+                        lambda: token in st.done or st.reset,
+                        timeout=SYNC_TIMEOUT)
+                if woke:
+                    if token in st.done:
+                        st.done.discard(token)  # each waiter prunes its own
+                    else:
+                        # generation bump while we waited: group is dead
+                        raise RuntimeError(
+                            f"sync-PS round abandoned: the trainer group "
+                            f"restarted while table {name!r} round {step} "
+                            f"was waiting for peers")
+                else:
+                    # drop our contribution so the round can't half-fire
+                    # if this trainer is restarted and retries
+                    if step in st.rounds:
+                        st.rounds[step].pop(trainer_id, None)
+                    raise RuntimeError(
+                        f"sync-PS barrier timed out after {SYNC_TIMEOUT}s: "
+                        f"only {len(st.rounds.get(step, {}))}/{st.num} "
+                        f"trainers pushed table {name!r} round {step} — a "
+                        f"peer trainer likely died")
         if merged is not None:
             ids_m, g_scaled, peers = merged
             t0 = time.perf_counter()
@@ -995,9 +1005,12 @@ class PSServer:
             # failure (e.g. this primary was deposed mid-forward) the
             # peers are NOT released: they time out, surface the error,
             # and the clients re-drive the round at the new primary.
-            self._apply_replicated(
-                key, lambda: table.push_gradients(ids_m, g_scaled),
-                "push_gradients", ids_m, g_scaled, {"sync_step": step})
+            with _tracing.span("apply", attrs={"table": name,
+                                               "round": step,
+                                               "rows": int(len(ids_m))}):
+                self._apply_replicated(
+                    key, lambda: table.push_gradients(ids_m, g_scaled),
+                    "push_gradients", ids_m, g_scaled, {"sync_step": step})
             apply_ms = (time.perf_counter() - t0) * 1e3
             with st.cond:
                 st.done.update(peers)
@@ -1308,10 +1321,41 @@ class PSServer:
 def server_telemetry() -> dict:
     """This process's ps_server_* registry slice, JSON-ready — the
     payload of the `stats` verb. Histograms dump as summaries
-    (count/sum/min/max/avg); the Prometheus exposition carries full
+    (count/sum/min/max/avg, plus the slowest-sample trace exemplar when
+    tracing stamped one); the Prometheus exposition carries full
     buckets for scrapers."""
     snap = _REG.snapshot()
     return {k: v for k, v in snap.items() if k.startswith("ps_server_")}
+
+
+def client_telemetry() -> dict:
+    """The ps_client_* slice of THIS process's registry — per-verb
+    latency histograms (exemplars included), retry/failover/hedge
+    counters. RemoteTable.stats() attaches it so one stats() call shows
+    both ends of the data plane."""
+    snap = _REG.snapshot()
+    return {k: v for k, v in snap.items() if k.startswith("ps_client_")}
+
+
+def _server_span_attrs(method: str, kwargs: dict) -> dict:
+    """Small, always-picklable span attributes for a server-side verb:
+    enough identity for tracetop to group sync rounds and name culprits
+    without ever copying a payload array."""
+    attrs = {"verb": method}
+    for k, out in (("name", "table"), ("key", "table"), ("tag", "tag"),
+                   ("partition", "partition"), ("trainer_id", "trainer"),
+                   ("epoch", "epoch")):
+        v = kwargs.get(k)
+        if v is not None:
+            attrs[out] = v
+    # one `round` key for whatever the verb calls its sequence number
+    for k in ("step", "seq"):
+        if kwargs.get(k) is not None:
+            attrs["round"] = kwargs[k]
+            break
+    if kwargs.get("retry"):
+        attrs["retry"] = True
+    return attrs
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -1324,23 +1368,36 @@ class _Handler(socketserver.BaseRequestHandler):
                 (method, kwargs), n_in = _recv_msg_sized(self.request)
             except (ConnectionError, EOFError):
                 return
+            # trace context (ISSUE 9): popped BEFORE dispatch so verbs
+            # never see it; a traced client against an untraced server
+            # costs this one dict op and nothing else
+            trace_hdr = kwargs.pop("_trace", None) \
+                if isinstance(kwargs, dict) else None
             # counted at ARRIVAL, not after the reply: an RPC whose
             # client vanished mid-round-trip was still handled and must
             # show in the books deterministically
             _REG.counter("ps_server_rpc_total", verb=method).inc()
             _REG.counter("ps_server_bytes_in_total", verb=method).inc(n_in)
             t0 = time.perf_counter()
-            try:
-                result = srv.handle(method, kwargs)
-                reply = (True, result)
-            except BaseException as e:  # noqa: BLE001 — ship to client
-                _REG.counter("ps_server_errors_total", verb=method).inc()
-                reply = (False, f"{type(e).__name__}: {e}")
+            with _tracing.server_span(
+                    f"server:{method}", trace_hdr,
+                    attrs=(_server_span_attrs(method, kwargs)
+                           if _tracing.enabled() else None)) as ssp:
+                try:
+                    result = srv.handle(method, kwargs)
+                    reply = (True, result)
+                except BaseException as e:  # noqa: BLE001 — ship to client
+                    _REG.counter("ps_server_errors_total",
+                                 verb=method).inc()
+                    reply = (False, f"{type(e).__name__}: {e}")
+                    if ssp is not None:
+                        ssp.status = f"error:{type(e).__name__}"
             _REG.histogram("ps_server_rpc_ms",
                            help="server-side verb handling latency "
                                 "(sync pushes include the barrier wait)",
                            verb=method).observe(
-                (time.perf_counter() - t0) * 1e3)
+                (time.perf_counter() - t0) * 1e3,
+                trace_id=(ssp.trace_id if ssp is not None else None))
             try:
                 n_out = _send_msg(self.request, reply)
             except OSError:
@@ -1401,6 +1458,16 @@ def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
         snapshot_secs = float(
             os.environ.get("PADDLE_PS_SNAPSHOT_SECS", 0) or 0)
     _arm_metrics_sink()
+    # step tracing (ISSUE 9): arm the flight-recorder triggers (SIGTERM,
+    # crash, exit) and the span push exporter; both are no-ops unless
+    # PADDLE_TRACING / PADDLE_TRACES_PUSH_URL armed them
+    _tracing.maybe_install_hooks()
+    try:
+        from ..telemetry import export as _export
+
+        _export.maybe_start_traces()
+    except Exception:  # noqa: BLE001 — telemetry must not stop serving
+        pass
     srv = _TCPServer((host, port), _Handler)
     srv.ps = PSServer(preload_dir=preload_dir,  # type: ignore[attr-defined]
                       snapshot_dir=snapshot_dir,
@@ -1458,6 +1525,10 @@ def serve(port: int = 0, host: str = "0.0.0.0", ready_cb=None,
         except Exception as e:
             print(f"[ps_server] final snapshot failed: {e}",
                   file=sys.stderr, flush=True)
+        # clean-exit span dump: flightrec.<tag>.json for tracetop plus
+        # trace.<tag>.json so the launcher's timeline merge gets a
+        # pserver lane (SIGTERM/crash paths dump via the hooks above)
+        _tracing.shutdown_dump()
 
 
 def main(argv=None) -> int:
@@ -1545,6 +1616,25 @@ class _Conn:
         return s
 
     def call(self, method: str, **kwargs):
+        # causal tracing (ISSUE 9): one client span for the whole RPC,
+        # a child span per attempt (its id rides the payload as the
+        # `_trace` traceparent so the server's handling parents under
+        # THAT attempt) and per backoff sleep. Tracing off: rpc_span is
+        # None, every guard below is one is-None check, and kwargs gains
+        # no key — the wire bytes are bit-identical.
+        rpc_span = _tracing.begin(
+            f"rpc:{method}", kind="client",
+            attrs={"peer": self.endpoint, "verb": method})
+        try:
+            return self._call_traced(rpc_span, method, kwargs)
+        except BaseException as e:
+            if rpc_span is not None:
+                rpc_span.status = f"error:{type(e).__name__}"
+            raise
+        finally:
+            _tracing.finish(rpc_span)
+
+    def _call_traced(self, rpc_span, method: str, kwargs: dict):
         inj = faults.injector()
         last_err: Optional[BaseException] = None
         t_rpc = time.perf_counter()
@@ -1564,12 +1654,20 @@ class _Conn:
                     if remaining <= 0:
                         break
                     back = min(back, remaining)
+                bo_span = _tracing.begin("backoff", parent=rpc_span,
+                                         attrs={"after_attempt": attempt})
                 time.sleep(back)
+                _tracing.finish(bo_span)
             s = None
+            att_span = _tracing.begin(f"attempt:{method}", kind="client",
+                                      parent=rpc_span,
+                                      attrs={"n": attempt + 1})
+            if att_span is not None:
+                kwargs["_trace"] = _tracing.header_for(att_span)
             try:
                 s = self._checkout()
                 if inj is not None:
-                    inj.before_send(method)  # refuse/delay/slow rules
+                    inj.before_send(method)  # refuse/delay/stall rules
                 sent_bytes += _send_msg(s, (method, kwargs))
                 if inj is not None and inj.drop_after_send(method):
                     raise faults.FaultError(
@@ -1580,6 +1678,8 @@ class _Conn:
             except (OSError, EOFError) as e:
                 # includes ConnectionError, socket.timeout, refused
                 # connects while a supervised pserver restarts
+                _tracing.finish(att_span,
+                                status=f"transport:{type(e).__name__}")
                 if s is not None:
                     try:
                         s.close()
@@ -1598,20 +1698,27 @@ class _Conn:
                     break
                 continue
             except BaseException:
+                _tracing.finish(att_span, status="error")
                 if s is not None:
                     try:
                         s.close()
                     except OSError:
                         pass
                 raise
+            _tracing.finish(att_span,
+                            status=None if ok else "app_error")
             with self._lock:
                 self._free.append(s)
             # per-verb client telemetry: wall latency INCLUDING backoff
-            # (what the training step actually waited), retries, bytes
+            # (what the training step actually waited), retries, bytes;
+            # the trace_id rides as the histogram's slowest-sample
+            # exemplar, so a latency scrape names a trace to pull
             _REG.histogram("ps_client_rpc_ms",
                            help="client RPC wall latency incl. retries",
                            verb=method).observe(
-                (time.perf_counter() - t_rpc) * 1e3)
+                (time.perf_counter() - t_rpc) * 1e3,
+                trace_id=(rpc_span.trace_id if rpc_span is not None
+                          else None))
             _REG.counter("ps_client_rpc_total", verb=method).inc()
             if attempt:
                 _REG.counter("ps_client_retries_total",
@@ -2090,8 +2197,11 @@ class RemoteTable:
         if hist.count < self._hedge_min or len(chain) < 2:
             return self._replica_call(p, method, kwargs)
         delay_s = max(hist.quantile(self._hedge_q) / 1e3, 1e-3)
-        fut = self._hedge_pool.submit(
-            self._replica_call, p, method, dict(kwargs))
+        # _tracing.bound: the pool thread re-binds THIS thread's span
+        # context, so the primary attempt, the hedge, and the winner all
+        # share one trace (identity function when tracing is off)
+        fut = self._hedge_pool.submit(_tracing.bound(
+            lambda: self._replica_call(p, method, dict(kwargs))))
         try:
             return fut.result(timeout=delay_s)
         except _fut.TimeoutError:
@@ -2100,8 +2210,14 @@ class RemoteTable:
                      help="backup-directed hedges for slow reads",
                      verb=method).inc()
         backup_j = chain[(self._primary_idx[p] + 1) % len(chain)]
-        hedge = self._hedge_pool.submit(
-            self._conn_call, backup_j, p, method, dict(kwargs))
+
+        def _hedge_exec():
+            with _tracing.span(f"hedge:{method}",
+                               attrs={"partition": p,
+                                      "peer": self.endpoints[backup_j]}):
+                return self._conn_call(backup_j, p, method, dict(kwargs))
+
+        hedge = self._hedge_pool.submit(_tracing.bound(_hedge_exec))
         pending = {fut: "primary", hedge: "hedge"}
         last_err = None
         while pending:
@@ -2119,11 +2235,13 @@ class RemoteTable:
         raise last_err
 
     def _fanout(self, thunks):
-        """Run one thunk per server, overlapped when a pool exists."""
+        """Run one thunk per server, overlapped when a pool exists.
+        Thunks carry the caller's trace context into the pool threads
+        (tracing.bound is identity when the layer is off)."""
         if self._pool is None:
             return [t() for t in thunks]
         return [f.result() for f in
-                [self._pool.submit(t) for t in thunks]]
+                [self._pool.submit(_tracing.bound(t)) for t in thunks]]
 
     # -- serving ---------------------------------------------------------
     def gather(self, ids) -> np.ndarray:
@@ -2187,8 +2305,11 @@ class RemoteTable:
         slice under "servers" (the idempotent `stats` verb). Replicated
         tables add a "replication" section: factor plus each partition's
         replica roles/epochs/seqs — the operator's view of failovers,
-        lag, and dropped backups."""
-        agg = {"push_calls": 0, "pushed_bytes": 0, "servers": []}
+        lag, and dropped backups. "client" is THIS process's ps_client_*
+        slice (verb latency histograms with trace-exemplars, retry and
+        hedge counters) so one call shows both ends of the data plane."""
+        agg = {"push_calls": 0, "pushed_bytes": 0, "servers": [],
+               "client": client_telemetry()}
         for s in range(self._n):
             st = self._call(s, "stats", name=self.name)
             agg["push_calls"] += st["push_calls"]

@@ -290,9 +290,16 @@ class CheckpointManager:
         import time as _time
 
         from . import monitor
+        from ..telemetry import tracing
 
         t0 = _time.perf_counter()
-        out = self._save_impl(step, extra_state, program, scope)
+        # the save span joins the LAST step's trace (saves run between
+        # steps, after the step span closed) so tracetop shows the
+        # checkpoint hop on the same causal timeline; no-op tracing-off
+        with tracing.span("checkpoint_save",
+                          parent=tracing.last_step_ctx(),
+                          attrs={"step": int(step)}):
+            out = self._save_impl(step, extra_state, program, scope)
         # telemetry: checkpoint time is part of the step-time story
         # (attached to the next committed step record + its histogram)
         monitor.observe_checkpoint_save((_time.perf_counter() - t0) * 1e3)

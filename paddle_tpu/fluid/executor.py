@@ -25,6 +25,7 @@ from . import framework, monitor
 from .dtypes import convert_dtype
 from .profiler import RecordEvent
 from ..ops import registry
+from ..telemetry import tracing as _tracing
 
 
 class Scope:
@@ -106,14 +107,19 @@ class Executor:
     ):
         # step telemetry (fluid/monitor.py): rec is None unless
         # PADDLE_METRICS_PATH armed the JSONL sink — the flag-off hot
-        # path pays one attribute read here and nothing below
+        # path pays one attribute read here and nothing below. The step
+        # span (PADDLE_TRACING) is the ROOT of this step's causal trace:
+        # data-wait/compile/device/fetch children below, plus every PS
+        # RPC the step issues from this thread, share its trace_id, and
+        # the kind="step" record carries it (tracetop joins on it).
         rec = monitor.begin_step()
-        try:
-            out = self._run_impl(program, feed, fetch_list, scope,
-                                 return_numpy, rec)
-        except BaseException:
-            monitor.abandon_step()
-            raise
+        with _tracing.step_span():
+            try:
+                out = self._run_impl(program, feed, fetch_list, scope,
+                                     return_numpy, rec)
+            except BaseException:
+                monitor.abandon_step()
+                raise
         monitor.commit_step(rec)
         return out
 
@@ -136,7 +142,8 @@ class Executor:
         block = program.global_block()
 
         t_feed = _time.perf_counter() if rec is not None else 0.0
-        feed_arrays = self._prepare_feed(block, feed)
+        with _tracing.span("data_wait"):
+            feed_arrays = self._prepare_feed(block, feed)
         if rec is not None:
             rec.data_wait_ms += (_time.perf_counter() - t_feed) * 1e3
         from .flags import flag
@@ -195,7 +202,7 @@ class Executor:
                     )
         bench = flag("FLAGS_benchmark")
         t_dev = _time.perf_counter() if rec is not None else 0.0
-        with RecordEvent("Executor::run"):
+        with RecordEvent("Executor::run"), _tracing.span("device"):
             fetches, new_state, new_key = compiled.fn(
                 feed_arrays, donated, kept, scope._rng_key
             )
@@ -227,6 +234,11 @@ class Executor:
             if bad is not None:
                 from .checkpoint import BadStepError
 
+                # flight recorder: the spans that led to the bad step
+                # are evidence — dump them BEFORE the raise unwinds
+                # (no-op unless PADDLE_TRACING + PADDLE_TRACE_DIR)
+                _tracing.annotate(bad_step=bad)
+                _tracing.flight_dump("bad_step")
                 raise BadStepError(
                     f"FLAGS_check_numerics: {bad}; step NOT committed "
                     f"(parameters, optimizer state and RNG unchanged)")
@@ -246,7 +258,7 @@ class Executor:
 
             jax.block_until_ready(fetches)
         if return_numpy:
-            with RecordEvent("Executor::fetch"):
+            with RecordEvent("Executor::fetch"), _tracing.span("fetch"):
                 t_f = _time.perf_counter() if rec is not None else 0.0
                 out = [np.asarray(f) for f in fetches]
                 if rec is not None:
@@ -336,7 +348,8 @@ class Executor:
             import time as _time
 
             t0 = _time.perf_counter()
-            with RecordEvent("Executor::compile"):
+            with RecordEvent("Executor::compile"), \
+                    _tracing.span("compile", attrs={"retrace": retrace}):
                 compiled = self._compile(
                     program, block, sorted(feed_arrays), fetch_names, scope,
                     donate=not no_donate,

@@ -90,7 +90,19 @@ def _arm_aux() -> None:
         if debugz.maybe_serve() is not None:
             _keep_recent = True
         export.maybe_start()
+        export.maybe_start_traces()
     except Exception:  # noqa: BLE001 — introspection never fails a step
+        pass
+    try:
+        from ..telemetry import tracing
+
+        if tracing.enabled():
+            # tracing rides the step loop too: keep the /steps ring (the
+            # flight recorder dumps it next to the span ring) and arm
+            # the SIGTERM/crash/exit dump hooks
+            _keep_recent = True
+            tracing.maybe_install_hooks()
+    except Exception:  # noqa: BLE001
         pass
 
 
@@ -277,6 +289,17 @@ def commit_step(rec: Optional[StepRecord]) -> None:
         "retraces": _counter("executor_retraces_total").value,
         "peak_hbm_bytes": peak,
     }
+    try:
+        # join the step's causal trace (PADDLE_TRACING): the record and
+        # the span ring now cite each other; key absent when tracing is
+        # off, so the documented schema is unchanged by default
+        from ..telemetry import tracing
+
+        tid = tracing.last_step_trace_id()
+        if tid is not None:
+            payload["trace_id"] = tid
+    except Exception:  # noqa: BLE001
+        pass
     if _keep_recent:
         with _lock:
             _recent_steps.append(dict(payload, ts=round(time.time(), 6)))

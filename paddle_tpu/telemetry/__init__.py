@@ -15,7 +15,11 @@ Layering:
   telemetry.debugz     introspection HTTP server (PADDLE_DEBUGZ_PORT):
                        /metrics /statusz /steps /proftop /healthz
   telemetry.export     periodic push exporter (PADDLE_METRICS_PUSH_URL):
-                       OTLP-shaped snapshot() JSON or pushgateway text
+                       OTLP-shaped snapshot() JSON or pushgateway text;
+                       span batches too (PADDLE_TRACES_PUSH_URL)
+  telemetry.tracing    causal span propagation across the RPC plane
+                       (PADDLE_TRACING): trace_id/span_id per hop,
+                       bounded span ring, flight recorder, /tracez
   fluid/monitor.py     the executor-facing step-time breakdown built on
                        the registry + sink
 
@@ -25,7 +29,7 @@ imports jax/protobuf inside functions for the same reason.
 """
 from __future__ import annotations
 
-from . import cost, debugz, export, sink, straggler, timeline  # noqa: F401
+from . import cost, debugz, export, sink, straggler, timeline, tracing  # noqa: F401
 from .registry import (  # noqa: F401
     BYTE_BUCKETS,
     DEFAULT_MS_BUCKETS,

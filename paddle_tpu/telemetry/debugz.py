@@ -13,6 +13,9 @@ sidecar, no log scraping:
              schema the PADDLE_METRICS_PATH JSONL sink writes)
   /proftop   last per-op cost report built in this process (JSON;
              404-shaped {} until telemetry.cost builds one)
+  /tracez    recent causal traces from the span ring (PADDLE_TRACING),
+             slowest-first with per-hop durations — the live view of
+             what the flight recorder would dump (JSON)
   /flagz     GET: the runtime-mutable flag whitelist + every flag's
              current value. POST {"name": ..., "value": ...}: flip one
              whitelisted flag live (FLAGS_check_numerics and friends;
@@ -220,13 +223,18 @@ def _route(path: str):
                                 "or telemetry.cost.profile_executor_run)"
                                 }).encode())
         return 200, "application/json", json.dumps(rep.to_json()).encode()
+    if path == "/tracez":
+        from . import tracing
+
+        return (200, "application/json",
+                json.dumps(tracing.tracez(), default=str).encode())
     if path == "/flagz":
         return (200, "application/json",
                 json.dumps(_flagz_state()).encode())
     if path in ("", "/", "/index.html"):
         return (200, "text/plain; charset=utf-8",
                 b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
-                b"/flagz /healthz\n")
+                b"/tracez /flagz /healthz\n")
     return 404, "text/plain; charset=utf-8", b"not found\n"
 
 

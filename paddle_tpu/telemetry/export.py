@@ -19,6 +19,14 @@ interval pushes fresh state, so a dead collector costs bounded work and
 zero unbounded queueing. Flag-off (env unset) = zero network, zero
 threads, one env read per process.
 
+Span batches (ISSUE 9): PADDLE_TRACES_PUSH_URL arms a SECOND exporter
+instance pushing OTLP-trace-shaped JSON (resourceSpans/scopeSpans with
+traceId/spanId/parentSpanId and unix-nano timestamps) drained from the
+tracing ring since the last successful cursor — same bounded-retry
+sender, same drop-and-count contract (PADDLE_TRACES_PUSH_SECS /
+_RETRIES). Env unset = zero network; tracing off = the batch is always
+empty and no POST is issued.
+
 stdlib-only (urllib) by design: the pserver and launcher can push too.
 """
 from __future__ import annotations
@@ -37,19 +45,28 @@ ENV_SECS = "PADDLE_METRICS_PUSH_SECS"
 ENV_RETRIES = "PADDLE_METRICS_PUSH_RETRIES"
 ENV_FORMAT = "PADDLE_METRICS_PUSH_FORMAT"
 
+ENV_TRACES_URL = "PADDLE_TRACES_PUSH_URL"
+ENV_TRACES_SECS = "PADDLE_TRACES_PUSH_SECS"
+ENV_TRACES_RETRIES = "PADDLE_TRACES_PUSH_RETRIES"
+
 _exporter: Optional["PushExporter"] = None
 _checked = False
+_trace_exporter: Optional["PushExporter"] = None
+_trace_checked = False
 _lock = threading.Lock()
 
 
 class PushExporter:
     """Daemon-thread periodic pusher. start() is idempotent; flush()
     pushes one sample synchronously (tests and atexit-style final
-    pushes)."""
+    pushes). body_fn overrides the payload builder (the span exporter
+    plugs its OTLP-trace batches in; returning None skips the POST —
+    nothing new to ship this interval)."""
 
     def __init__(self, url: str, interval_s: float = 15.0,
                  retries: int = 3, fmt: Optional[str] = None,
-                 timeout_s: float = 5.0, backoff_s: float = 0.2):
+                 timeout_s: float = 5.0, backoff_s: float = 0.2,
+                 body_fn=None, counter_prefix: str = "metrics"):
         self.url = url
         self.interval_s = max(0.05, float(interval_s))
         self.retries = max(1, int(retries))
@@ -58,17 +75,22 @@ class PushExporter:
         if fmt is None:
             fmt = "prom" if "/metrics/job" in url else "json"
         self.fmt = fmt
+        self.body_fn = body_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         reg = get_registry()
         self._pushed = reg.counter(
-            "metrics_push_total", "successful metrics pushes")
+            f"{counter_prefix}_push_total",
+            f"successful {counter_prefix} pushes")
         self._failed = reg.counter(
-            "metrics_push_failures_total",
-            "metrics samples dropped after the bounded retry budget")
+            f"{counter_prefix}_push_failures_total",
+            f"{counter_prefix} samples dropped after the bounded "
+            f"retry budget")
 
     # -- payload ---------------------------------------------------------
     def _body(self):
+        if self.body_fn is not None:
+            return self.body_fn()
         if self.fmt == "prom":
             return (get_registry().to_prometheus().encode(),
                     "text/plain; version=0.0.4; charset=utf-8")
@@ -96,8 +118,13 @@ class PushExporter:
 
     def flush(self) -> bool:
         """Push one sample now; True on delivery, False when the retry
-        budget is exhausted (the sample is dropped and counted)."""
-        body, ctype = self._body()
+        budget is exhausted (the sample is dropped and counted). A
+        body_fn returning None means nothing to ship — no POST, still
+        True."""
+        built = self._body()
+        if built is None:
+            return True
+        body, ctype = built
         for attempt in range(self.retries):
             try:
                 self._post_once(body, ctype)
@@ -175,11 +202,129 @@ def active() -> Optional[PushExporter]:
     return _exporter
 
 
+# ---------------------------------------------------------------------------
+# span batches (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def spans_to_otlp(spans, resource: Optional[dict] = None) -> dict:
+    """Ring-format span dicts -> OTLP/JSON trace shape (resourceSpans /
+    scopeSpans; ids hex, times unix-nano, attrs as key/value pairs) —
+    what an OTLP-JSON collector ingests."""
+    def attr(k, v):
+        if isinstance(v, bool):
+            return {"key": k, "value": {"boolValue": v}}
+        if isinstance(v, int):
+            return {"key": k, "value": {"intValue": str(v)}}
+        if isinstance(v, float):
+            return {"key": k, "value": {"doubleValue": v}}
+        return {"key": k, "value": {"stringValue": str(v)}}
+
+    res = {
+        "job": os.environ.get("PADDLE_JOB_NAME", "paddle_tpu"),
+        "rank": os.environ.get("PADDLE_TRAINER_ID"),
+        "role": os.environ.get("PADDLE_TRAINING_ROLE"),
+        "pid": os.getpid(),
+    }
+    res.update(resource or {})
+    otlp_spans = []
+    for s in spans:
+        start_ns = int(s["ts"] * 1e9)
+        span = {
+            "traceId": s["trace"],
+            "spanId": s["span"],
+            "name": s["name"],
+            "kind": s.get("kind", "internal"),
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(start_ns + int(s["dur_ms"] * 1e6)),
+            "attributes": [attr(k, v)
+                           for k, v in (s.get("attrs") or {}).items()],
+            "status": {"code": ("STATUS_CODE_OK"
+                                if s.get("status", "ok") == "ok"
+                                else "STATUS_CODE_ERROR"),
+                       "message": s.get("status", "ok")},
+        }
+        if s.get("parent"):
+            span["parentSpanId"] = s["parent"]
+        otlp_spans.append(span)
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [attr(k, v) for k, v in res.items()
+                                        if v is not None]},
+            "scopeSpans": [{
+                "scope": {"name": "paddle_tpu.telemetry.tracing"},
+                "spans": otlp_spans,
+            }],
+        }],
+    }
+
+
+def _traces_body_fn():
+    """Stateful payload builder: drains spans recorded since the last
+    build. The cursor advances at BUILD time — a batch the retry budget
+    then drops is gone (bounded loss, matching the metrics contract)."""
+    state = {"seq": 0}
+
+    def body():
+        from . import tracing
+
+        spans, state["seq"] = tracing.export_batch(state["seq"])
+        if not spans:
+            return None  # nothing new: skip the POST entirely
+        return (json.dumps(spans_to_otlp(spans)).encode(),
+                "application/json")
+
+    return body
+
+
+def start_traces(url: str, **kwargs) -> PushExporter:
+    """Explicit span-exporter start (tests / programmatic)."""
+    global _trace_exporter, _trace_checked
+    with _lock:
+        if _trace_exporter is not None:
+            _trace_exporter.stop()
+        _trace_exporter = PushExporter(
+            url, body_fn=_traces_body_fn(), counter_prefix="traces",
+            **kwargs).start()
+        _trace_checked = True
+        return _trace_exporter
+
+
+def maybe_start_traces() -> Optional[PushExporter]:
+    """Arm span pushing from PADDLE_TRACES_PUSH_URL; resolved once per
+    process. Unset = None, zero network, and never another env read."""
+    global _trace_exporter, _trace_checked
+    if _trace_checked:
+        return _trace_exporter
+    with _lock:
+        if _trace_checked:
+            return _trace_exporter
+        _trace_checked = True
+        url = os.environ.get(ENV_TRACES_URL)
+        if not url:
+            return None
+        _trace_exporter = PushExporter(
+            url,
+            interval_s=float(os.environ.get(ENV_TRACES_SECS, "15") or 15),
+            retries=int(os.environ.get(ENV_TRACES_RETRIES, "3") or 3),
+            body_fn=_traces_body_fn(), counter_prefix="traces",
+        ).start()
+        return _trace_exporter
+
+
+def active_traces() -> Optional[PushExporter]:
+    return _trace_exporter
+
+
 def stop():
-    """Tests: tear down and allow re-arming."""
-    global _exporter, _checked
+    """Tests: tear down and allow re-arming (both exporters)."""
+    global _exporter, _checked, _trace_exporter, _trace_checked
     with _lock:
         if _exporter is not None:
             _exporter.stop()
         _exporter = None
         _checked = False
+        if _trace_exporter is not None:
+            _trace_exporter.stop()
+        _trace_exporter = None
+        _trace_checked = False

@@ -15,6 +15,7 @@ unless PADDLE_METRICS_PATH is set.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # default latency buckets (ms): sub-ms host ops through multi-minute
@@ -82,9 +83,19 @@ class Gauge:
 class Histogram:
     """Fixed-boundary histogram: per-bucket counts (non-cumulative
     internally; the exposition emits Prometheus cumulative `le`
-    buckets), plus sum/count/min/max for cheap summaries."""
+    buckets), plus sum/count/min/max for cheap summaries.
 
-    __slots__ = ("buckets", "counts", "sum", "count", "min", "max", "_lock")
+    Exemplar (ISSUE 9): observe(v, trace_id=...) remembers the trace of
+    the sample currently sitting in the TOP occupied bucket (the running
+    max), so a scrape of a latency histogram hands the operator a
+    trace_id to feed straight into tools/tracetop.py. Surfaced in the
+    OpenMetrics `# {trace_id="..."} v ts` exemplar syntax on the
+    matching _bucket line, and in summary()/snapshot(). Callers that
+    never pass a trace_id (tracing off) leave the exposition and the
+    summary byte-identical to the pre-exemplar format."""
+
+    __slots__ = ("buckets", "counts", "sum", "count", "min", "max",
+                 "exemplar", "_lock")
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
         self.buckets = tuple(float(b) for b in buckets)
@@ -95,9 +106,10 @@ class Histogram:
         self.count = 0
         self.min = None
         self.max = None
+        self.exemplar: Optional[dict] = None
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         v = float(v)
         lo, hi = 0, len(self.buckets)
         while lo < hi:  # first bucket boundary >= v
@@ -112,19 +124,27 @@ class Histogram:
             self.count += 1
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            if trace_id is not None and (
+                    self.exemplar is None or v >= self.exemplar["value"]):
+                self.exemplar = {"trace_id": str(trace_id),
+                                 "value": v,
+                                 "ts": round(time.time(), 3)}
 
     def summary(self) -> dict:
         """count/sum/min/max/avg. An EMPTY histogram reports zeros, not
         Nones — consumers (debugz pages, exporters, report arithmetic)
         must never have to None-guard a summary field."""
         with self._lock:
-            return {
+            out = {
                 "count": self.count,
                 "sum": round(self.sum, 6),
                 "min": self.min if self.min is not None else 0.0,
                 "max": self.max if self.max is not None else 0.0,
                 "avg": round(self.sum / self.count, 6) if self.count else 0.0,
             }
+            if self.exemplar is not None:
+                out["exemplar"] = dict(self.exemplar)
+            return out
 
     def quantile(self, q: float) -> float:
         """Bucket-boundary estimate of the q-quantile (upper boundary of
@@ -227,13 +247,25 @@ class MetricsRegistry:
                     continue
                 with m._lock:
                     counts, total, s = list(m.counts), m.count, m.sum
+                    ex = dict(m.exemplar) if m.exemplar else None
                 acc = 0
                 for b, c in zip(m.buckets, counts):
                     acc += c
                     lk = labelkey + (("le", f"{b:g}"),)
-                    lines.append(f"{name}_bucket{_fmt_labels(lk)} {acc}")
+                    line = f"{name}_bucket{_fmt_labels(lk)} {acc}"
+                    if ex is not None and ex["value"] <= b:
+                        # OpenMetrics exemplar on the bucket holding the
+                        # slowest traced sample; emitted once
+                        line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                                 f'{ex["value"]:g} {ex["ts"]}')
+                        ex = None
+                    lines.append(line)
                 lk = labelkey + (("le", "+Inf"),)
-                lines.append(f"{name}_bucket{_fmt_labels(lk)} {total}")
+                line = f"{name}_bucket{_fmt_labels(lk)} {total}"
+                if ex is not None:  # landed in the overflow bucket
+                    line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                             f'{ex["value"]:g} {ex["ts"]}')
+                lines.append(line)
                 lines.append(f"{name}_sum{_fmt_labels(labelkey)} {s}")
                 lines.append(f"{name}_count{_fmt_labels(labelkey)} {total}")
         return "\n".join(lines) + ("\n" if lines else "")

@@ -9,7 +9,9 @@ tools and tests parse it):
                   {"step": int monotone per process, "data_wait_ms",
                    "compile_ms", "device_ms", "fetch_ms", "ckpt_save_ms",
                    "cache_hit": bool, "retraces": int cumulative,
-                   "peak_hbm_bytes": int}
+                   "peak_hbm_bytes": int}; under PADDLE_TRACING the
+                  record additionally carries "trace_id" — the step's
+                  root span in the tracing ring (telemetry/tracing.py)
   kind="bench"    one bench.py result row (same keys as its stdout JSON)
   kind="train_epoch"  hapi MetricsLogger epoch summary
   kind="ps_step"  one APPLIED pserver update (distributed/ps_server.py;

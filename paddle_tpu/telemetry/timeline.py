@@ -7,6 +7,12 @@ way tools/timeline.py did for the reference's per-trainer profiles:
 rank r's events land under pid = r * PID_STRIDE + original_pid, with a
 process_name metadata row naming the rank, so Perfetto shows one
 swimlane group per rank (host track + device tracks side by side).
+
+Non-trainer processes get DETERMINISTIC pid bases too (ISSUE 9): the
+pserver span dumps ("ps0", "ps1", ...) land above the trainer ranks and
+the launcher-hosted coordinator ("coord") above those, so timeline.json
+spans the whole job — the same pid scheme tools/tracetop.py labels its
+merged causal traces with.
 """
 from __future__ import annotations
 
@@ -14,13 +20,38 @@ import glob
 import json
 import os
 import re
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # per-rank pid namespace: profiler.py uses pid 0 for host and 1+ per
 # device plane, far below this stride
 PID_STRIDE = 100
 
+# non-trainer process lanes: pservers ride above any plausible trainer
+# rank, the coordinator above the pservers, unknown string tags last
+PS_PID_BASE = 10_000
+COORD_PID_BASE = 20_000
+OTHER_PID_BASE = 30_000
+
 TRACE_NAME_RE = re.compile(r"trace\.(?P<rank>\w+)\.json$")
+_PS_TAG_RE = re.compile(r"ps(\d+)$")
+
+
+def process_pid_base(rank) -> Tuple[int, str]:
+    """(pid base, display label) for a per-process trace tag — trainer
+    ranks by number, pserver tags and the coordinator deterministically
+    above them. Shared by merge_traces and tools/tracetop.py so both
+    views name processes identically."""
+    try:
+        return int(rank) * PID_STRIDE, f"rank {rank}"
+    except (TypeError, ValueError):
+        pass
+    m = _PS_TAG_RE.fullmatch(str(rank))
+    if m:
+        return (PS_PID_BASE + int(m.group(1))) * PID_STRIDE, str(rank)
+    if str(rank) in ("coord", "coordinator"):
+        return COORD_PID_BASE * PID_STRIDE, "coordinator"
+    return ((OTHER_PID_BASE + abs(hash(str(rank))) % 1000) * PID_STRIDE,
+            str(rank))
 
 
 def rank_trace_path(directory: str, rank) -> str:
@@ -55,12 +86,7 @@ def merge_traces(directory: str, out_path: Optional[str] = None) -> Optional[str
         except (OSError, ValueError) as e:
             print(f"[telemetry] skipping unreadable trace {path}: {e}")
             continue
-        try:
-            base = int(rank) * PID_STRIDE
-            label = f"rank {rank}"
-        except ValueError:  # string tags (ps0) ride above the trainers
-            base = (10_000 + abs(hash(rank)) % 1000) * PID_STRIDE
-            label = str(rank)
+        base, label = process_pid_base(rank)
         seen_pids = set()
         for ev in events:
             ev = dict(ev)

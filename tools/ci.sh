@@ -109,6 +109,16 @@ assert any(r["cache_hit"] for r in steps[2:]), "steady state should hit the cach
 print(f"telemetry smoke OK: {len(steps)} step records, monotone, schema complete")
 PY
 
+echo "== step-trace drill (causal spans -> critical-path attribution) =="
+# ISSUE 9 acceptance: a 2-trainer sync job with a deterministic 400ms
+# stall injected on ONE trainer's push_gradients — the merged trace's
+# per-round critical path must attribute >= 400ms to the correct
+# (rank, verb) hop, the whole-job timeline must gain pserver +
+# coordinator lanes, and PADDLE_TRACING unset must leave wire bytes and
+# the loss trace bit-identical (tests/test_tracing.py; the fast
+# propagation/parentage/exemplar/tracetop units run in tier-1 above)
+python -m pytest tests/test_tracing.py -q -m slow
+
 echo "== proglint (static program verification over bench models) =="
 # ISSUE 5 acceptance: the bench-model programs — forward, +backward,
 # +conv_bn_fusion — must carry ZERO error-severity findings (dangling

@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""tracetop: merge per-process span dumps into causal traces and
+attribute each sync round's critical path (ISSUE 9).
+
+Input: a directory of `flightrec.<tag>.json` flight-recorder dumps
+(written by telemetry/tracing.py — on SIGTERM/crash/exit per process,
+or live via debugz /tracez). The launcher's --trace_dir leaves one per
+trainer rank, per pserver, and one for the coordinator.
+
+What it does:
+
+  merge          all processes' spans, keyed by the wire-propagated
+                 trace_id — one trainer step's trace spans trainer ->
+                 primary -> backup -> coordinator. Process labels reuse
+                 the timeline merger's pid scheme (telemetry/timeline.
+                 process_pid_base) so Perfetto lanes and tracetop rows
+                 name processes identically.
+  sync rounds    every server-side push span carries (table, round,
+                 trainer) attributes and the barrier releaser is marked
+                 (released_round); per round tracetop reconstructs WHO
+                 held the barrier (last arrival), for how long (arrival
+                 spread), what each peer paid (barrier_wait), and where
+                 the released round's time went (handle/apply/replicate
+                 forwards) — per-round culprit attribution the
+                 straggler detector can cite instead of inferring from
+                 heartbeat medians.
+  slowest traces a tracez-style listing across processes (--traces).
+
+Usage:
+  python tools/tracetop.py <trace_dir>              # per-round report
+  python tools/tracetop.py <trace_dir> --json       # machine-readable
+  python tools/tracetop.py <trace_dir> --traces 10  # slowest traces
+  python tools/tracetop.py <trace_dir> --table emb  # filter by table
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.telemetry.timeline import process_pid_base  # noqa: E402
+
+# server-side verbs that participate in a sync/push round
+_PUSH_SPANS = ("server:push_gradients", "server:push_delta")
+
+
+def load_dumps(directory: str) -> List[dict]:
+    """Every parseable flightrec.<tag>.json in `directory` (unreadable
+    files are skipped with a warning — a torn dump from a crashing
+    process must not cost the survivors' report)."""
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "flightrec.*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"[tracetop] skipping unreadable dump {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if isinstance(d, dict) and isinstance(d.get("spans"), list):
+            dumps.append(d)
+    return dumps
+
+
+def merged_spans(dumps: List[dict]) -> List[dict]:
+    """All spans across dumps, each stamped with its dump's process tag
+    (the span's own `proc` wins when present)."""
+    out = []
+    for d in dumps:
+        tag = d.get("process", "?")
+        for s in d["spans"]:
+            s = dict(s)
+            s.setdefault("proc", tag)
+            out.append(s)
+    out.sort(key=lambda s: s.get("ts", 0.0))
+    return out
+
+
+def _index(spans: List[dict]):
+    by_id: Dict[str, dict] = {}
+    children: Dict[str, List[dict]] = {}
+    for s in spans:
+        if s.get("span"):
+            by_id[s["span"]] = s
+        if s.get("parent"):
+            children.setdefault(s["parent"], []).append(s)
+    return by_id, children
+
+
+def _child(children, span, name) -> Optional[dict]:
+    for c in children.get(span["span"], ()):
+        if c["name"] == name:
+            return c
+    return None
+
+
+def _client_hop(by_id, push_span) -> dict:
+    """Walk the server push span back to the trainer's client spans:
+    parent is the attempt span, whose parent is the rpc span — the
+    client-side wall time (retries + backoff included) for this hop."""
+    out = {"client_ms": None, "attempts": None, "backoff_ms": None}
+    att = by_id.get(push_span.get("parent") or "")
+    if att is None:
+        return out
+    rpc = by_id.get(att.get("parent") or "")
+    if rpc is None:
+        return out
+    out["client_ms"] = rpc.get("dur_ms")
+    sib = [s for s in by_id.values() if s.get("parent") == rpc["span"]]
+    out["attempts"] = sum(1 for s in sib
+                          if s["name"].startswith("attempt:"))
+    out["backoff_ms"] = round(sum(s.get("dur_ms", 0.0) for s in sib
+                                  if s["name"] == "backoff"), 3)
+    return out
+
+
+def sync_rounds(spans: List[dict],
+                table: Optional[str] = None) -> List[dict]:
+    """Group server-side push spans into rounds and reconstruct each
+    round's critical path. Returns one dict per (table, round, serving
+    process), sorted by (table, round)."""
+    by_id, children = _index(spans)
+    groups: Dict[tuple, List[dict]] = {}
+    for s in spans:
+        if s["name"] not in _PUSH_SPANS:
+            continue
+        attrs = s.get("attrs") or {}
+        if "round" not in attrs:
+            continue
+        tbl = attrs.get("table", "?")
+        if table is not None and tbl != table:
+            continue
+        groups.setdefault((str(tbl), int(attrs["round"]),
+                           s.get("proc", "?")), []).append(s)
+    rounds = []
+    for (tbl, rnd, proc), pushes in sorted(groups.items()):
+        pushes.sort(key=lambda s: s["ts"])
+        t_first = pushes[0]["ts"]
+        hops = []
+        releaser = None
+        for p in pushes:
+            attrs = p.get("attrs") or {}
+            wait = _child(children, p, "barrier_wait")
+            apply_sp = _child(children, p, "apply")
+            hop = {
+                "trainer": attrs.get("trainer"),
+                "verb": p["name"].split(":", 1)[1],
+                "arrival_offset_ms": round((p["ts"] - t_first) * 1e3, 3),
+                "handle_ms": p.get("dur_ms"),
+                "wait_ms": (wait.get("dur_ms") if wait else 0.0),
+                "apply_ms": (apply_sp.get("dur_ms") if apply_sp else None),
+                "released": attrs.get("released_round") == rnd
+                            or (attrs.get("released_round") is not None
+                                and int(attrs["released_round"]) == rnd),
+                "trace": p.get("trace"),
+                "retry": bool(attrs.get("retry")),
+            }
+            hop.update(_client_hop(by_id, p))
+            # replication forwards issued while applying this round
+            fw = apply_sp or p
+            hop["forwards"] = [
+                {"peer": (c.get("attrs") or {}).get("peer"),
+                 "ms": c.get("dur_ms")}
+                for c in children.get(fw["span"], ())
+                if c["name"] == "rpc:replicate"]
+            hops.append(hop)
+            if hop["released"]:
+                releaser = hop
+        if releaser is None:  # releaser mark missing: last arrival wins
+            releaser = hops[-1]
+        rounds.append({
+            "table": tbl, "round": rnd, "server": proc,
+            "world": len(hops), "hops": hops,
+            "culprit": {
+                "trainer": releaser["trainer"],
+                "verb": releaser["verb"],
+                "critical_ms": releaser["arrival_offset_ms"],
+                "trace": releaser["trace"],
+            },
+            "peer_wait_ms": round(max((h["wait_ms"] or 0.0)
+                                      for h in hops), 3),
+        })
+    return rounds
+
+
+def slowest_traces(spans: List[dict], topk: int = 10) -> List[dict]:
+    """Cross-process tracez: whole traces ranked by end-to-end span."""
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    out = []
+    for tid, ss in by_trace.items():
+        ss.sort(key=lambda s: s["ts"])
+        t0 = min(s["ts"] for s in ss)
+        t1 = max(s["ts"] + s.get("dur_ms", 0.0) / 1e3 for s in ss)
+        ids = {s["span"] for s in ss}
+        roots = [s for s in ss if not s.get("parent")
+                 or s["parent"] not in ids]
+        out.append({"trace": tid, "dur_ms": round((t1 - t0) * 1e3, 3),
+                    "root": roots[0]["name"] if roots else ss[0]["name"],
+                    "procs": sorted({s.get("proc", "?") for s in ss}),
+                    "n_spans": len(ss), "spans": ss})
+    out.sort(key=lambda t: -t["dur_ms"])
+    return out[:topk]
+
+
+def _label(proc) -> str:
+    return process_pid_base(proc)[1]
+
+
+def format_round(r: dict) -> str:
+    c = r["culprit"]
+    head = (f"round {r['round']:>4} table={r['table']} "
+            f"server={_label(r['server'])}: barrier released by "
+            f"trainer {c['trainer']} ({c['verb']}) "
+            f"+{c['critical_ms']:.1f}ms after first arrival; "
+            f"peers waited {r['peer_wait_ms']:.1f}ms "
+            f"[trace {str(c['trace'])[:16]}]")
+    lines = [head]
+    for h in sorted(r["hops"], key=lambda h: h["arrival_offset_ms"]):
+        extra = ""
+        if h.get("client_ms") is not None:
+            extra += f" client={h['client_ms']:.1f}ms"
+            if h.get("attempts") and h["attempts"] > 1:
+                extra += (f" ({h['attempts']} attempts,"
+                          f" backoff {h['backoff_ms']:.1f}ms)")
+        if h.get("apply_ms") is not None:
+            extra += f" apply={h['apply_ms']:.1f}ms"
+        for fw in h.get("forwards", ()):
+            extra += f" replicate->{fw['peer']}={fw['ms']:.1f}ms"
+        mark = "*" if h["released"] else " "
+        lines.append(
+            f"  {mark} trainer {h['trainer']}: "
+            f"arrival +{h['arrival_offset_ms']:.1f}ms "
+            f"wait={h['wait_ms'] or 0.0:.1f}ms "
+            f"handle={h['handle_ms']:.1f}ms{extra}")
+    return "\n".join(lines)
+
+
+def format_trace(t: dict) -> str:
+    head = (f"trace {t['trace'][:16]} root={t['root']} "
+            f"{t['dur_ms']:.1f}ms over {t['n_spans']} spans "
+            f"({', '.join(_label(p) for p in t['procs'])})")
+    lines = [head]
+    for s in t["spans"]:
+        lines.append(f"    {_label(s.get('proc', '?')):>12} "
+                     f"{s['name']:<28} {s.get('dur_ms', 0.0):9.2f}ms "
+                     f"{s.get('status', 'ok')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tracetop",
+        description="merge flight-recorder span dumps; attribute each "
+                    "sync round's critical path")
+    p.add_argument("trace_dir", help="directory of flightrec.<tag>.json "
+                                     "dumps (launch.py --trace_dir)")
+    p.add_argument("--table", default=None,
+                   help="only rounds of this table")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--traces", type=int, default=0, metavar="K",
+                   help="also list the K slowest whole traces")
+    p.add_argument("--topk", type=int, default=0,
+                   help="only the K worst rounds (by critical_ms)")
+    args = p.parse_args(argv)
+
+    dumps = load_dumps(args.trace_dir)
+    if not dumps:
+        print(f"[tracetop] no flightrec.*.json dumps in "
+              f"{args.trace_dir!r} — run with PADDLE_TRACING=1 and "
+              f"PADDLE_TRACE_DIR (launch.py --trace_dir arms both)",
+              file=sys.stderr)
+        return 1
+    spans = merged_spans(dumps)
+    rounds = sync_rounds(spans, table=args.table)
+    if args.topk:
+        rounds = sorted(rounds,
+                        key=lambda r: -r["culprit"]["critical_ms"]
+                        )[:args.topk]
+    if args.json:
+        out = {"processes": sorted({d.get("process", "?")
+                                    for d in dumps}),
+               "n_spans": len(spans), "rounds": rounds}
+        if args.traces:
+            out["slowest_traces"] = slowest_traces(spans, args.traces)
+        json.dump(out, sys.stdout, default=str)
+        print()
+        return 0
+    print(f"[tracetop] {len(dumps)} process dumps "
+          f"({', '.join(sorted(_label(d.get('process', '?')) for d in dumps))}), "
+          f"{len(spans)} spans, {len(rounds)} sync rounds")
+    for r in rounds:
+        print(format_round(r))
+    if args.traces:
+        print(f"\nslowest {args.traces} traces:")
+        for t in slowest_traces(spans, args.traces):
+            print(format_trace(t))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
