@@ -122,13 +122,112 @@ class _Member:
         }
 
 
+class CkptBarrier:
+    """Commit barrier for sharded checkpoints (fluid/checkpoint.py):
+    every rank reports its landed shard manifest (`ckpt_shard_commit`)
+    and rank 0 polls `ckpt_status` until all world_size shards are in,
+    THEN writes the global manifest — the single commit point that
+    makes a partially-saved step invisible to every restore. Implements
+    the `_Handler` contract, so it serves standalone over the ps_server
+    TCP transport (the launcher hosts one for every multi-rank job) or
+    rides a `Coordinator`'s port when the lease plane is armed.
+
+    State is bounded: only the newest _KEEP steps are remembered — a
+    report for a long-gone step can only come from a rank so far behind
+    that its job already failed."""
+
+    _KEEP = 32
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        # step -> {"world": int, "shards": {rank: info}}
+        self.steps: Dict[int, dict] = {}
+        self.shutdown_event = threading.Event()  # _Handler contract
+
+    def shard_commit(self, step: int, rank: int, world_size: int,
+                     info: Optional[dict] = None) -> dict:
+        with self.cond:
+            ent = self.steps.setdefault(
+                int(step), {"world": int(world_size), "shards": {}})
+            ent["world"] = int(world_size)
+            ent["shards"][int(rank)] = dict(info or {})
+            while len(self.steps) > self._KEEP:
+                self.steps.pop(min(self.steps))
+            self.cond.notify_all()
+            _REG.counter("ckpt_barrier_reports_total").inc()
+            return {"complete": len(ent["shards"]) >= ent["world"]}
+
+    def status(self, step: int) -> dict:
+        with self.cond:
+            ent = self.steps.get(int(step)) or {"world": 0, "shards": {}}
+            return {"world": ent["world"],
+                    "shards": {r: dict(i)
+                               for r, i in ent["shards"].items()},
+                    "complete": (ent["world"] > 0
+                                 and len(ent["shards"]) >= ent["world"])}
+
+    def wait_full(self, step: int, world_size: int,
+                  timeout: float) -> dict:
+        """Block until all `world_size` shards reported (in-process
+        callers; remote rank 0 polls `status` instead so no handler
+        thread sits in a long wait)."""
+        deadline = time.monotonic() + float(timeout)
+        with self.cond:
+            while True:
+                ent = self.steps.get(int(step))
+                if ent is not None and \
+                        len(ent["shards"]) >= int(world_size):
+                    return {"complete": True,
+                            "shards": {r: dict(i)
+                                       for r, i in ent["shards"].items()}}
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return {"complete": False,
+                            "shards": {r: dict(i) for r, i in
+                                       (ent or {"shards": {}})
+                                       ["shards"].items()}}
+                self.cond.wait(min(left, 0.2))
+
+    def handle(self, method: str, kwargs: dict):
+        if method == "ping":
+            return "pong"
+        if method == "ckpt_shard_commit":
+            return self.shard_commit(kwargs["step"], kwargs["rank"],
+                                     kwargs["world_size"],
+                                     kwargs.get("info"))
+        if method == "ckpt_status":
+            return self.status(kwargs["step"])
+        if method == "shutdown":
+            self.shutdown_event.set()
+            return 0
+        raise ValueError(f"unknown ckpt-barrier method {method!r}")
+
+
+def serve_ckpt_barrier(barrier: CkptBarrier, host: str = "127.0.0.1",
+                       port: int = 0):
+    """Host `barrier` over the ps_server TCP transport (daemon thread).
+    Returns (server, "host:port"); the launcher exports the endpoint as
+    PADDLE_CKPT_BARRIER_ENDPOINT so sharded checkpoint writers can
+    reach the commit barrier."""
+    from .ps_server import _Handler, _TCPServer
+
+    srv = _TCPServer((host, port), _Handler)
+    srv.ps = barrier  # type: ignore[attr-defined] — _Handler contract
+    threading.Thread(target=srv.serve_forever,
+                     kwargs={"poll_interval": 0.1}, daemon=True,
+                     name="paddle-tpu-ckpt-barrier").start()
+    return srv, f"{host}:{srv.server_address[1]}"
+
+
 class Coordinator:
     """Membership + lease table. Hosted in the LAUNCHER process: the
     launcher calls the methods directly (it is the consumer of events);
     remote members reach the same object through serve() + the
     ps_server RPC transport. All state is guarded by one lock — verbs
     are tiny and never block on I/O except `sweep`'s promote RPCs,
-    which run outside the lock."""
+    which run outside the lock. Also carries the sharded-checkpoint
+    commit barrier (`ckpt_*` verbs delegate to an owned CkptBarrier),
+    so a lease-armed job's barrier shares the coordinator's port."""
 
     def __init__(self, lease_secs: float = 5.0, retries_per_rank: int = 0,
                  expire_periods: float = EXPIRE_PERIODS,
@@ -148,6 +247,7 @@ class Coordinator:
         self.events: deque = deque(maxlen=512)
         self.lock = threading.RLock()
         self.shutdown_event = threading.Event()  # _Handler contract
+        self.ckpt_barrier = CkptBarrier()
 
     # -- internals -------------------------------------------------------
     def _event(self, **ev) -> None:
@@ -402,6 +502,9 @@ class Coordinator:
             inj.on_server_call(method)
         if method == "ping":
             return "pong"
+        if method.startswith("ckpt_"):
+            # sharded-checkpoint commit barrier rides the same port
+            return self.ckpt_barrier.handle(method, kwargs)
         if method == "register":
             return self.register(
                 kwargs["tag"], kwargs.get("kind", "trainer"),

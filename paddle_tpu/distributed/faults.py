@@ -52,10 +52,38 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     named code phase (crash_point(phase) call sites; the
                     <method> field names the phase). Checkpoint commit
                     phases: "ckpt_tmp_written" (content files written,
-                    step dir not yet renamed into place) and
+                    step dir not yet renamed into place),
                     "ckpt_before_commit" (step dir in place, manifest —
-                    the commit point — not yet written): exercises the
-                    torn-checkpoint fallback in fluid/checkpoint.py
+                    the commit point — not yet written),
+                    "ckpt_manifest_tmp_written" (manifest tmp file
+                    written, os.replace — the rename — not yet issued),
+                    "ckpt_writer" (inside the async background writer
+                    thread, before it touches the disk),
+                    "ckpt_shard_committed" (a rank's shard manifest
+                    landed, its commit-barrier report not yet sent) and
+                    "ckpt_before_global_commit" (every shard confirmed,
+                    global manifest not yet written): exercises the
+                    torn-checkpoint fallback and the sharded
+                    global-commit protocol in fluid/checkpoint.py
+            io_err  phase side: raise OSError(EIO) at the Nth arrival at
+                    a named WRITE phase (io_point(phase) call sites:
+                    "ckpt_content", "ckpt_manifest",
+                    "ckpt_global_manifest") — a disk I/O error at that
+                    exact write; the save fails loudly and the commit
+                    protocol must leave the previous checkpoint intact
+            short_write  phase side: the Nth write at the matching phase
+                    lands TRUNCATED (half the intended bytes) while the
+                    writer believes it succeeded — the silent partial
+                    write a power loss or a lying disk produces. A short
+                    content file makes checksum verification fail
+                    (corrupt, restore falls back); a short manifest is
+                    unparseable (torn by definition)
+            diskfull  phase side, LATCHING: from the Nth arrival at the
+                    matching phase on, EVERY io_point write phase in
+                    this process raises OSError(ENOSPC) — the disk is
+                    full for everyone, not just one file. Saves keep
+                    failing until the process restarts (or the operator
+                    frees space, e.g. `ckpt_doctor --gc`)
             lease_expire  member side, LATCHING: once this process has
                     attempted <nth> coordinator lease renewals, ALL
                     further renewals are swallowed client-side (the
@@ -104,6 +132,9 @@ ENV_TAGS = "PADDLE_PS_FAULT_TAGS"
 _CLIENT_ACTIONS = ("drop", "refuse", "delay", "stall")
 _SERVER_ACTIONS = ("kill", "slow", "partition")
 _PHASE_ACTIONS = ("crash",)
+# disk-fault rules: fire at named WRITE phases (io_point call sites in
+# the checkpoint commit protocol)
+_IO_ACTIONS = ("io_err", "short_write", "diskfull")
 # rules whose <method> field names a PROCESS TAG, not an RPC verb
 _TAG_ACTIONS = ("lease_expire", "netsplit")
 
@@ -157,7 +188,7 @@ def parse_spec(spec: str) -> List[_Rule]:
                 f"bad fault rule {raw!r}: want action:method:nth[:arg]")
         action, method, nth = parts[0], parts[1], parts[2]
         known = (_CLIENT_ACTIONS + _SERVER_ACTIONS + _PHASE_ACTIONS
-                 + _TAG_ACTIONS)
+                 + _IO_ACTIONS + _TAG_ACTIONS)
         if action not in known:
             raise ValueError(
                 f"bad fault rule {raw!r}: unknown action {action!r} "
@@ -206,6 +237,7 @@ class FaultInjector:
         self.partitioned = False  # latched by a fired `partition` rule
         self.lease_blocked = False  # latched by a fired `lease_expire`
         self.netsplit_until = 0.0  # wall time the split heals
+        self.disk_full = False  # latched by a fired `diskfull` rule
 
     def _take(self, site_actions, method: str) -> List[_Rule]:
         """Advance matching rules' counters; return the rules firing NOW."""
@@ -342,6 +374,35 @@ class FaultInjector:
                 self.lease_blocked = True
         return self.lease_blocked
 
+    # -- disk-fault side -------------------------------------------------
+    def at_io_phase(self, phase: str) -> bool:
+        """Consulted at named checkpoint WRITE phases (io_point call
+        sites). Raises OSError for `io_err` (one EIO at the Nth match)
+        and `diskfull` (ENOSPC from the Nth match ON — latched: a full
+        disk fails every later write too); returns True when a
+        `short_write` rule fired and the caller must truncate the bytes
+        it is about to write."""
+        import errno
+
+        for r in self._take(("diskfull",), phase):
+            os.write(2, (f"[faults] disk full from phase {phase!r} on "
+                         f"(rule diskfull:{r.method}:{r.nth})\n").encode())
+            with self._lock:
+                self.disk_full = True
+        if self.disk_full:
+            raise OSError(errno.ENOSPC,
+                          f"fault injection: no space left on device "
+                          f"(phase {phase!r})")
+        for r in self._take(("io_err",), phase):
+            raise OSError(errno.EIO,
+                          f"fault injection: I/O error at phase "
+                          f"{phase!r} (rule io_err:{r.method}:{r.nth})")
+        short = bool(self._take(("short_write",), phase))
+        if short:
+            os.write(2, (f"[faults] short write at phase {phase!r}\n"
+                         ).encode())
+        return short
+
     # -- phase side ------------------------------------------------------
     def at_phase(self, phase: str) -> None:
         for r in self._take(("crash",), phase):
@@ -393,6 +454,17 @@ def crash_point(phase: str) -> None:
     inj = injector()
     if inj is not None:
         inj.at_phase(phase)
+
+
+def io_point(phase: str) -> bool:
+    """Deterministic disk-fault site at a named write phase: may raise
+    OSError (`io_err`, `diskfull`); returns True when the caller must
+    simulate a short write (truncate the bytes). One flag read when the
+    layer is off."""
+    inj = injector()
+    if inj is None:
+        return False
+    return inj.at_io_phase(phase)
 
 
 def reset() -> None:

@@ -723,6 +723,23 @@ def launch(argv=None) -> int:
               f"{lease_secs}s, per-rank budget {per_rank})",
               file=sys.stderr)
 
+    # sharded-checkpoint commit barrier (fluid/checkpoint.py): every
+    # multi-rank job gets one — it costs a daemon thread and only
+    # matters once PADDLE_CKPT_SHARDED arms sharded saves in the
+    # trainers. Lease-armed jobs reach it through the coordinator's
+    # port (ckpt_* verbs delegate); otherwise the coordinator's barrier
+    # object is served standalone
+    ckpt_barrier_server = None
+    if len(cluster) > 1:
+        if coord_server is not None:
+            os.environ["PADDLE_CKPT_BARRIER_ENDPOINT"] = coord_ep
+        else:
+            from .coordinator import serve_ckpt_barrier
+
+            ckpt_barrier_server, bar_ep = serve_ckpt_barrier(
+                coord.ckpt_barrier)
+            os.environ["PADDLE_CKPT_BARRIER_ENDPOINT"] = bar_ep
+
     pservers: List[PServer] = []
     ps_supervisor = None
     snapshot_dir = None
@@ -811,6 +828,8 @@ def launch(argv=None) -> int:
         terminate_pservers(pservers)
         if coord_server is not None:
             stop_coordinator(coord_server)
+        if ckpt_barrier_server is not None:
+            stop_coordinator(ckpt_barrier_server)  # same teardown shape
         if own_heartbeat_dir:
             import shutil
 

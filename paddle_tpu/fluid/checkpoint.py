@@ -1,4 +1,5 @@
-"""Preemption-safe checkpointing: atomic, manifest-verified, resumable.
+"""Preemption-safe checkpointing: atomic, manifest-verified, resumable,
+asynchronous and sharded.
 
 Parity surface: the reference's answer to trainer preemption is
 `fluid/io.py` save/load plus a manual restart — a SIGTERM between
@@ -9,10 +10,12 @@ directories committed atomically, verified by checksum on load, with
 automatic fallback to the newest *valid* checkpoint when the latest was
 torn by a crash.
 
-Commit protocol (CheckpointManager.save):
+Commit protocol (CheckpointManager.save, single-writer layout):
 
   1. all content files (scope persistables, RNG state, reader position,
      PS-table snapshots) are written into `<root>/.tmp-ckpt-<step>-<pid>`
+     (each fsynced, then the directory — power-loss durability;
+     PADDLE_CKPT_FSYNC=0 opts out)
   2. the tmp dir is renamed to `<root>/ckpt-<step>` — visible but NOT
      yet a checkpoint: a directory without a manifest is torn by
      definition and every reader skips it
@@ -22,10 +25,43 @@ Commit protocol (CheckpointManager.save):
      the newest valid one; a kill during 3 leaves either no manifest or
      the complete manifest, never a torn one.
 
-`distributed/faults.py` crash rules (`crash:ckpt_tmp_written:1`,
-`crash:ckpt_before_commit:1`) kill the process deterministically between
-these phases so tests/test_checkpoint.py PROVES torn-checkpoint recovery
-instead of hoping for it.
+Async saves (`PADDLE_CKPT_ASYNC=1` or `save(async_=True)`): the step
+loop pays only for the SNAPSHOT — a device→host copy of the scope
+persistables, the RNG key, the extra state and the PS-table state dicts,
+captured at the step boundary under the same guard semantics as a sync
+save — and serialization + sha256 + the two-phase commit run on a
+bounded background writer thread. The queue has depth 1 with coalescing:
+a new save supersedes a still-queued one (the writer always commits the
+NEWEST snapshot it was handed), so the step loop never blocks behind a
+slow disk. Writer exceptions latch and re-raise at the next save() /
+drain(); SIGTERM-driven final saves go through the synchronous path
+(which waits out any in-flight write first) and an atexit hook drains
+the queue, so the final checkpoint is never lost.
+
+Sharded jobs (`PADDLE_CKPT_SHARDED=1` with world_size > 1): every rank
+writes its own `rank<k>/` shard dir (contents + per-shard manifest,
+committed exactly like a single-writer checkpoint) under the SAME
+step dir, then reports the shard-manifest sha256 to a commit barrier —
+the launcher-hosted `CkptBarrier` over the ps_server RPC transport
+(PADDLE_CKPT_BARRIER_ENDPOINT), or a shared-filesystem poll when no
+barrier is armed. Rank 0 waits for every rank's report and only then
+commits `global_manifest.json` (step, world_size, membership_epoch,
+per-shard manifest sha256s) — THE global commit point. `restore()` only
+considers steps with a complete global manifest, so a crash between two
+ranks' shard commits leaves a checkpoint that is INVISIBLE by
+construction (and GC'd as torn once a newer step commits).
+
+`distributed/faults.py` rules drill every phase deterministically:
+`crash:<phase>:<nth>` kills at `ckpt_tmp_written`, `ckpt_before_commit`,
+`ckpt_manifest_tmp_written` (mid manifest rename), `ckpt_writer` (inside
+the async writer thread), `ckpt_shard_committed` (post-shard,
+pre-barrier-report) and `ckpt_before_global_commit`; `io_err:<phase>`,
+`short_write:<phase>` and `diskfull:<phase>` inject disk faults at the
+`ckpt_content`, `ckpt_manifest` and `ckpt_global_manifest` write phases
+so tests/test_checkpoint*.py PROVE torn/corrupt-checkpoint recovery
+instead of hoping for it. `tools/ckpt_doctor.py` is the offline fsck:
+verify manifests + checksums across shards, report and GC torn/corrupt/
+orphaned dirs, repair a corrupt PS-table shard from a live replica.
 
 What a checkpoint holds: every persistable of the program (parameters,
 optimizer moments, LR, AMP loss-scale state — all scope-resident), the
@@ -37,12 +73,14 @@ references (same `<table>.pkl` state_dict format as
 `fleet.init_server(model_dir)` / ps_server snapshots), tagged with the
 trainer group's generation.
 
-One writer per root directory: multi-trainer jobs checkpoint to
-per-rank roots (or rank 0 only) — concurrent writers to one root race
-on retention, not on the commit itself.
+One writer per root directory in single-writer mode; in sharded mode
+one writer per `rank<k>/` shard and rank 0 owns the global commit and
+retention.
 """
 from __future__ import annotations
 
+import atexit
+import copy
 import hashlib
 import json
 import os
@@ -50,20 +88,33 @@ import pickle
 import re
 import shutil
 import signal
+import sys
 import threading
+import time
 import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from . import framework
+from . import io as io_lib
 from .executor import global_scope
-from .io import (_atomic_write_bytes, _persistable_names, _ps_table_names,
-                 _save_ps_tables)
+from .io import _atomic_write_bytes, _persistable_names, _ps_table_names
+from ..telemetry import get_registry
+
+_REG = get_registry()
 
 MANIFEST = "manifest.json"
+GLOBAL_MANIFEST = "global_manifest.json"
 MANIFEST_FORMAT = 1
 _DIR_RE = re.compile(r"^ckpt-(\d+)$")
+_TMP_RE = re.compile(r"^\.tmp-ckpt-(\d+)-(?:r\d+-)?(\d+)$")
+
+ENV_ASYNC = "PADDLE_CKPT_ASYNC"
+ENV_SHARDED = "PADDLE_CKPT_SHARDED"
+ENV_BARRIER = "PADDLE_CKPT_BARRIER_ENDPOINT"
+ENV_BARRIER_TIMEOUT = "PADDLE_CKPT_BARRIER_TIMEOUT"
+ENV_DRAIN_TIMEOUT = "PADDLE_CKPT_DRAIN_TIMEOUT"
 
 # sysexits EX_TEMPFAIL: the conventional "retry me" code — a preempted
 # trainer exits with it after its final checkpoint, and the launcher's
@@ -94,9 +145,32 @@ class WorldSizeMismatchError(RuntimeError):
     do)."""
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint save could not commit (disk fault, barrier
+    timeout). The on-disk state is still consistent: restore() falls
+    back to the newest fully-committed step."""
+
+
+class CheckpointWriterError(CheckpointError):
+    """A background (async) checkpoint write failed. The error latched
+    in the writer and re-raises here — at the save/drain AFTER the
+    failure — so the step loop learns about it at the next step
+    boundary instead of from a silent gap in the checkpoint chain."""
+
+
+class CommitBarrierError(CheckpointError):
+    """Rank 0 gave up waiting for every rank's shard-commit report:
+    the step's checkpoint stays torn (no global manifest) and restore()
+    keeps serving the previous fully-committed step."""
+
+
+def _env_true(name: str, default: str = "") -> bool:
+    return os.environ.get(name, default).lower() in ("1", "true", "yes",
+                                                     "on")
+
+
 def _reshard_allowed_from_env() -> bool:
-    return os.environ.get("PADDLE_ELASTIC_RESHARD", "").lower() in (
-        "1", "true", "yes", "on")
+    return _env_true("PADDLE_ELASTIC_RESHARD")
 
 
 def _world_size_from_env() -> Optional[int]:
@@ -107,6 +181,20 @@ def _world_size_from_env() -> Optional[int]:
         return int(raw)
     except ValueError:
         return None
+
+
+def _membership_epoch() -> int:
+    try:
+        return int(os.environ.get("PADDLE_MEMBERSHIP_EPOCH", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default) or default)
+    except ValueError:
+        return default
 
 
 # ---------------------------------------------------------------------------
@@ -194,7 +282,7 @@ def _restore_rng(state: Optional[dict]):
 
 
 # ---------------------------------------------------------------------------
-# manager
+# fault-injection shims (one flag read each when the layer is off)
 # ---------------------------------------------------------------------------
 
 
@@ -214,16 +302,289 @@ def _crash_point(phase: str) -> None:
     faults.crash_point(phase)
 
 
+def _io_point(phase: str) -> bool:
+    """Deterministic disk-fault site: may raise OSError (io_err /
+    diskfull rules); True = simulate a short write (truncate)."""
+    from ..distributed import faults
+
+    return faults.io_point(phase)
+
+
+def _write_content(path: str, blob: bytes, phase: str = "ckpt_content",
+                   ) -> None:
+    """One checkpoint content file: fault-injectable, fsynced before the
+    directory it lives in is renamed into place (the manifest commit
+    must never point at bytes still sitting in a volatile cache)."""
+    short = _io_point(phase)
+    data = blob[: len(blob) // 2] if short else blob
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        if io_lib._fsync_enabled():
+            os.fsync(f.fileno())
+    _REG.counter("ckpt_bytes_written_total",
+                 help="checkpoint bytes written (content + manifests)"
+                 ).inc(len(data))
+
+
+def _files_meta(blobs: Dict[str, bytes]) -> Dict[str, dict]:
+    """Manifest `files` map computed from the INTENDED bytes — a short
+    or bit-flipped write on disk then fails verification instead of
+    being checksummed into legitimacy."""
+    return {rel: {"sha256": hashlib.sha256(blobs[rel]).hexdigest(),
+                  "bytes": len(blobs[rel])}
+            for rel in sorted(blobs)}
+
+
+# ---------------------------------------------------------------------------
+# snapshot job + bounded async writer
+# ---------------------------------------------------------------------------
+
+
+class _Snapshot:
+    """Everything a checkpoint commit needs, captured at the step
+    boundary: host copies of the arrays, the RNG state, the caller's
+    extra state and the PS tables' state dicts. Hand it to the writer
+    and the live scope is free to move on."""
+
+    __slots__ = ("step", "arrays", "rng", "extra", "ps_states",
+                 "snap_global_step", "save_ctx", "async_")
+
+    def __init__(self, step: int, arrays: dict, rng, extra: dict,
+                 ps_states: dict):
+        self.step = int(step)
+        self.arrays = arrays
+        self.rng = rng
+        self.extra = extra
+        self.ps_states = ps_states
+        self.snap_global_step = 0
+        self.save_ctx: Optional[Tuple[str, str]] = None
+        self.async_ = False
+
+
+class _AsyncWriter:
+    """Depth-1 coalescing write queue + one daemon writer thread.
+
+    submit() replaces any still-queued snapshot (the newest snapshot
+    wins — checkpoints are idempotent restart points, not a log), so
+    the step loop can save at any frequency without ever queueing
+    behind the disk. A writer exception LATCHES: the next
+    save()/drain() on the owning manager re-raises it as
+    CheckpointWriterError."""
+
+    def __init__(self, mgr: "CheckpointManager"):
+        self.mgr = mgr
+        self.cond = threading.Condition()
+        self.pending: Optional[_Snapshot] = None
+        self.active: Optional[_Snapshot] = None
+        self.error: Optional[BaseException] = None
+        self.closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _depth_locked(self) -> None:
+        d = ((1 if self.pending is not None else 0)
+             + (1 if self.active is not None else 0))
+        _REG.gauge("ckpt_queue_depth",
+                   help="async checkpoint snapshots queued + in flight"
+                   ).set(d)
+
+    def submit(self, job: _Snapshot) -> None:
+        with self.cond:
+            if self.pending is not None:
+                _REG.counter(
+                    "ckpt_async_superseded_total",
+                    help="queued async snapshots replaced by a newer "
+                         "save before the writer picked them up").inc()
+            self.pending = job
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="paddle-tpu-ckpt-writer")
+                self._thread.start()
+            self._depth_locked()
+            self.cond.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self.cond:
+                while self.pending is None and not self.closed:
+                    self.cond.wait()
+                if self.pending is None:
+                    return
+                job, self.pending = self.pending, None
+                self.active = job
+                self._depth_locked()
+            try:
+                _crash_point("ckpt_writer")
+                self.mgr._write_snapshot(job)
+            except BaseException as e:  # noqa: BLE001 — latch + surface
+                with self.cond:
+                    if self.error is None:
+                        self.error = e
+                _REG.counter("ckpt_writer_errors_total",
+                             help="async checkpoint writes that failed"
+                             ).inc()
+                try:
+                    from ..telemetry import tracing
+
+                    tracing.flight_dump("ckpt_writer_error")
+                except Exception:  # noqa: BLE001
+                    pass
+            finally:
+                with self.cond:
+                    self.active = None
+                    self._depth_locked()
+                    self.cond.notify_all()
+
+    def cancel_pending(self) -> None:
+        """Drop a still-queued snapshot (a synchronous save is about to
+        write something at least as new)."""
+        with self.cond:
+            if self.pending is not None:
+                _REG.counter("ckpt_async_superseded_total").inc()
+                self.pending = None
+                self._depth_locked()
+
+    def wait_idle(self, timeout: float) -> bool:
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        with self.cond:
+            while self.pending is not None or self.active is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self.cond.wait(min(left, 0.5))
+        return True
+
+    def take_error(self) -> Optional[BaseException]:
+        with self.cond:
+            err, self.error = self.error, None
+        return err
+
+
+# ---------------------------------------------------------------------------
+# commit-barrier handles (sharded global commit)
+# ---------------------------------------------------------------------------
+
+
+class _LocalBarrier:
+    """Direct in-process handle on a coordinator.CkptBarrier (tests,
+    and the launcher process itself)."""
+
+    def __init__(self, barrier):
+        self.barrier = barrier
+
+    def shard_commit(self, step, rank, world, info) -> None:
+        self.barrier.shard_commit(step=int(step), rank=int(rank),
+                                  world_size=int(world), info=info)
+
+    def wait_full(self, step, world, timeout) -> Optional[dict]:
+        out = self.barrier.wait_full(step=int(step),
+                                     world_size=int(world),
+                                     timeout=float(timeout))
+        if not out.get("complete"):
+            return None
+        return {int(r): dict(i) for r, i in out["shards"].items()}
+
+
+class _RPCBarrier:
+    """Commit barrier over the ps_server RPC transport (the launcher
+    hosts coordinator.CkptBarrier and exports
+    PADDLE_CKPT_BARRIER_ENDPOINT). Rank 0 POLLS ckpt_status instead of
+    holding a handler thread in a long blocking wait."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._conn = None
+
+    def _c(self):
+        if self._conn is None:
+            from ..distributed.ps_server import _Conn
+
+            self._conn = _Conn(self.endpoint, deadline=10.0,
+                               io_timeout=30.0)
+        return self._conn
+
+    def shard_commit(self, step, rank, world, info) -> None:
+        self._c().call("ckpt_shard_commit", step=int(step), rank=int(rank),
+                       world_size=int(world), info=info)
+
+    def wait_full(self, step, world, timeout) -> Optional[dict]:
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            out = self._c().call("ckpt_status", step=int(step))
+            shards = {int(r): dict(i)
+                      for r, i in (out.get("shards") or {}).items()}
+            if len(shards) >= int(world):
+                return shards
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(0.1)
+
+
+class _FSBarrier:
+    """Shared-filesystem fallback when no barrier endpoint is armed: a
+    landed, parseable shard manifest IS the rank's commit report; rank 0
+    polls for every rank's and derives the manifest sha256s itself."""
+
+    def __init__(self, mgr: "CheckpointManager"):
+        self.mgr = mgr
+
+    def shard_commit(self, step, rank, world, info) -> None:
+        pass  # the shard manifest on the shared FS is the report
+
+    def wait_full(self, step, world, timeout) -> Optional[dict]:
+        deadline = time.monotonic() + float(timeout)
+        stepdir = self.mgr._dir(step)
+        while True:
+            shards: Optional[dict] = {}
+            for r in range(int(world)):
+                p = os.path.join(stepdir, f"rank{r}", MANIFEST)
+                try:
+                    with open(p, "rb") as f:
+                        blob = f.read()
+                    m = json.loads(blob.decode())
+                    if m.get("format") != MANIFEST_FORMAT:
+                        raise ValueError("format")
+                except (OSError, ValueError):
+                    shards = None
+                    break
+                shards[r] = {
+                    "manifest_sha256": hashlib.sha256(blob).hexdigest()}
+            if shards is not None:
+                return shards
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# manager
+# ---------------------------------------------------------------------------
+
+
 class CheckpointManager:
     """Step-numbered atomic checkpoints with retention and verified,
-    fall-back-to-newest-valid restore.
+    fall-back-to-newest-valid restore; optional async background writes
+    and sharded multi-rank layouts with a single global commit point.
 
     program/scope given at construction are the defaults for save() and
     restore(); both can be overridden per call. With program=None the
-    whole scope is checkpointed (and PS tables are skipped)."""
+    whole scope is checkpointed (and PS tables are skipped).
+
+    async_save (default: PADDLE_CKPT_ASYNC) hands serialization + the
+    two-phase commit to a background writer; sharded (default:
+    PADDLE_CKPT_SHARDED, only with world_size > 1) writes `rank<k>/`
+    shard dirs and gates restore on rank 0's global_manifest.json.
+    `barrier` injects an in-process coordinator.CkptBarrier (tests);
+    production ranks reach the launcher's over
+    PADDLE_CKPT_BARRIER_ENDPOINT, falling back to shared-FS polling."""
 
     def __init__(self, root: str, keep_last_n: int = 3, program=None,
-                 scope=None, world_size: Optional[int] = None):
+                 scope=None, world_size: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 sharded: Optional[bool] = None,
+                 async_save: Optional[bool] = None,
+                 barrier=None):
         self.root = os.path.abspath(root)
         self.keep_last_n = max(1, int(keep_last_n))
         self.program = program
@@ -233,11 +594,29 @@ class CheckpointManager:
         # mismatch unless the caller opted into re-sharding
         self.world_size = (int(world_size) if world_size is not None
                            else _world_size_from_env())
+        self.rank = (int(rank) if rank is not None
+                     else int(os.environ.get("PADDLE_TRAINER_ID", "0")
+                              or 0))
+        if sharded is None:
+            sharded = _env_true(ENV_SHARDED) and (self.world_size or 1) > 1
+        self.sharded = bool(sharded)
+        if async_save is None:
+            async_save = _env_true(ENV_ASYNC)
+        self.async_save = bool(async_save)
+        self.barrier = barrier
+        self._bar_handle = None
+        self._async: Optional[_AsyncWriter] = None
         os.makedirs(self.root, exist_ok=True)
 
     # -- layout ----------------------------------------------------------
     def _dir(self, step: int) -> str:
         return os.path.join(self.root, f"ckpt-{int(step):08d}")
+
+    def _data_dir(self, step: int) -> str:
+        """Where THIS writer's content lives: the step dir itself, or
+        this rank's shard dir under it."""
+        d = self._dir(step)
+        return os.path.join(d, f"rank{self.rank}") if self.sharded else d
 
     def _scan(self) -> List[Tuple[int, str]]:
         out = []
@@ -248,32 +627,42 @@ class CheckpointManager:
         return sorted(out)
 
     def manifest(self, step: int) -> Optional[dict]:
-        """Parsed manifest of a COMMITTED checkpoint, else None (missing
-        or unparseable manifest == torn == not a checkpoint)."""
+        """Parsed manifest of a COMMITTED checkpoint — this rank's shard
+        manifest in sharded mode — else None (missing or unparseable
+        manifest == torn == not a checkpoint)."""
         try:
-            with open(os.path.join(self._dir(step), MANIFEST)) as f:
+            with open(os.path.join(self._data_dir(step), MANIFEST)) as f:
+                m = json.load(f)
+            return m if m.get("format") == MANIFEST_FORMAT else None
+        except (OSError, ValueError):
+            return None
+
+    def global_manifest(self, step: int) -> Optional[dict]:
+        """Parsed global manifest of a sharded checkpoint (None = torn,
+        absent, or a non-sharded layout)."""
+        try:
+            with open(os.path.join(self._dir(step), GLOBAL_MANIFEST)) as f:
                 m = json.load(f)
             return m if m.get("format") == MANIFEST_FORMAT else None
         except (OSError, ValueError):
             return None
 
     def steps(self) -> List[int]:
-        """Steps with a committed manifest, ascending (cheap check: the
-        manifest's presence is the commit; verify() adds checksums)."""
+        """COMMITTED steps, ascending. The commit marker is the manifest
+        — the GLOBAL manifest for sharded layouts, so a step some ranks
+        finished and others did not is not a checkpoint at all."""
+        if self.sharded:
+            return [s for s, _ in self._scan()
+                    if self.global_manifest(s) is not None]
         return [s for s, _ in self._scan() if self.manifest(s) is not None]
 
     def latest_step(self) -> Optional[int]:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def verify(self, step: int) -> bool:
-        """Full integrity check: manifest present and every listed file
-        exists with matching size and sha256."""
-        m = self.manifest(step)
-        if m is None:
-            return False
-        d = self._dir(step)
-        for rel, meta in m["files"].items():
+    @staticmethod
+    def _verify_files(d: str, files: Dict[str, dict]) -> bool:
+        for rel, meta in files.items():
             p = os.path.join(d, rel)
             try:
                 if os.path.getsize(p) != meta["bytes"]:
@@ -284,29 +673,150 @@ class CheckpointManager:
                 return False
         return True
 
+    def verify(self, step: int) -> bool:
+        """Full integrity check: manifest present and every listed file
+        exists with matching size and sha256. Sharded: the global
+        manifest must list world_size shards whose manifest files hash
+        to the recorded sha256s, and THIS rank's shard contents are
+        checksummed in full (tools/ckpt_doctor.py cross-checks every
+        shard's contents offline)."""
+        if self.sharded:
+            gm = self.global_manifest(step)
+            if gm is None:
+                return False
+            shards = gm.get("shards") or {}
+            if len(shards) != int(gm.get("world_size") or 0):
+                return False
+            d = self._dir(step)
+            for rname, info in shards.items():
+                p = os.path.join(d, rname, MANIFEST)
+                try:
+                    with open(p, "rb") as f:
+                        blob = f.read()
+                except OSError:
+                    return False
+                if hashlib.sha256(blob).hexdigest() != \
+                        info.get("manifest_sha256"):
+                    return False
+        m = self.manifest(step)
+        if m is None:
+            return False
+        return self._verify_files(self._data_dir(step), m["files"])
+
+    # -- async plumbing --------------------------------------------------
+    def _writer(self) -> _AsyncWriter:
+        if self._async is None:
+            self._async = _AsyncWriter(self)
+            # drain on interpreter exit: the last async save must land
+            # even when the caller never reaches a drain point
+            atexit.register(self._atexit_drain)
+        return self._async
+
+    def _drain_timeout(self) -> float:
+        return _float_env(ENV_DRAIN_TIMEOUT, 120.0)
+
+    def _barrier_timeout(self) -> float:
+        return _float_env(ENV_BARRIER_TIMEOUT, 120.0)
+
+    def raise_if_async_failed(self) -> None:
+        """Surface a latched background-writer failure (no-op when the
+        writer never ran or never failed). Training loops call this at
+        the step boundary; save() and drain() call it themselves."""
+        w = self._async
+        if w is None:
+            return
+        err = w.take_error()
+        if err is not None:
+            raise CheckpointWriterError(
+                f"async checkpoint write failed: "
+                f"{type(err).__name__}: {err}") from err
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued/in-flight async save is durably
+        committed; re-raises a latched writer error. The preemption and
+        atexit paths run through here so the final checkpoint is never
+        lost."""
+        w = self._async
+        if w is not None:
+            if not w.wait_idle(timeout if timeout is not None
+                               else self._drain_timeout()):
+                raise CheckpointError(
+                    "timed out draining the async checkpoint writer")
+        self.raise_if_async_failed()
+
+    def _atexit_drain(self) -> None:
+        w = self._async
+        if w is None:
+            return
+        w.wait_idle(self._drain_timeout())
+        err = w.take_error()
+        if err is not None:  # exiting: report, don't raise
+            print(f"[checkpoint] async writer failed at exit: "
+                  f"{type(err).__name__}: {err}", file=sys.stderr)
+
+    def _barrier_handle(self):
+        if self._bar_handle is None:
+            if self.barrier is not None:
+                self._bar_handle = _LocalBarrier(self.barrier)
+            elif os.environ.get(ENV_BARRIER):
+                self._bar_handle = _RPCBarrier(os.environ[ENV_BARRIER])
+            else:
+                self._bar_handle = _FSBarrier(self)
+        return self._bar_handle
+
     # -- save ------------------------------------------------------------
     def save(self, step: int, extra_state: Optional[dict] = None,
-             program=None, scope=None) -> str:
-        import time as _time
-
+             program=None, scope=None,
+             async_: Optional[bool] = None) -> str:
+        """Checkpoint `step`. async_ None defaults to the manager's
+        async_save (PADDLE_CKPT_ASYNC); async saves return after the
+        SNAPSHOT with the path the writer will commit to. async_=False
+        forces a synchronous commit — the preemption/final-save path —
+        after superseding any queued snapshot and waiting out an
+        in-flight write (two writers never interleave). A latched
+        background failure from an earlier async save re-raises HERE,
+        before anything new is captured."""
         from . import monitor
         from ..telemetry import tracing
 
-        t0 = _time.perf_counter()
+        self.raise_if_async_failed()
+        if async_ is None:
+            async_ = self.async_save
+        t0 = time.perf_counter()
         # the save span joins the LAST step's trace (saves run between
         # steps, after the step span closed) so tracetop shows the
         # checkpoint hop on the same causal timeline; no-op tracing-off
         with tracing.span("checkpoint_save",
                           parent=tracing.last_step_ctx(),
-                          attrs={"step": int(step)}):
-            out = self._save_impl(step, extra_state, program, scope)
-        # telemetry: checkpoint time is part of the step-time story
-        # (attached to the next committed step record + its histogram)
-        monitor.observe_checkpoint_save((_time.perf_counter() - t0) * 1e3)
+                          attrs={"step": int(step)}) as sp:
+            job = self._snapshot(step, extra_state, program, scope,
+                                 deep=bool(async_))
+            job.async_ = bool(async_)
+            if sp is not None:
+                job.save_ctx = (sp.trace_id, sp.span_id)
+            if async_:
+                self._writer().submit(job)
+                out = self._data_dir(step)
+            else:
+                w = self._async
+                if w is not None:
+                    w.cancel_pending()
+                    w.wait_idle(self._drain_timeout())
+                out = self._write_snapshot(job)
+        # telemetry: the step loop's share of checkpoint time (snapshot
+        # only, for async saves) lands on the next committed step record
+        monitor.observe_checkpoint_save((time.perf_counter() - t0) * 1e3)
         return out
 
-    def _save_impl(self, step: int, extra_state: Optional[dict] = None,
-                   program=None, scope=None) -> str:
+    def _snapshot(self, step: int, extra_state: Optional[dict],
+                  program, scope, deep: bool) -> _Snapshot:
+        """Capture a consistent host snapshot at the step boundary:
+        device→host copies of the persistables, the RNG state, the extra
+        state and the PS tables' state dicts. `deep` (async) decouples
+        every buffer from the live scope — the next step may donate or
+        overwrite device memory while the writer serializes."""
+        from . import monitor
+
         program = program if program is not None else self.program
         scope = scope if scope is not None else (self.scope or global_scope())
 
@@ -315,32 +825,121 @@ class CheckpointManager:
                      if scope.find_var(n) is not None]
         else:
             names = [n for n, v in scope.vars.items() if v is not None]
-        arrays = {n: np.asarray(scope.find_var(n)) for n in names}
+        arrays = {}
+        for n in names:
+            a = np.asarray(scope.find_var(n))
+            arrays[n] = np.array(a, copy=True) if deep else a
 
-        tmp = os.path.join(self.root, f".tmp-ckpt-{int(step):08d}-{os.getpid()}")
+        rng = _rng_state(scope._rng_key)
+        if deep and rng is not None and isinstance(rng.get("data"),
+                                                  np.ndarray):
+            rng = dict(rng, data=rng["data"].copy())
+        extra = (copy.deepcopy(dict(extra_state or {})) if deep
+                 else dict(extra_state or {}))
+
+        ps_states: Dict[str, Any] = {}
+        if program is not None:
+            from ..distributed import ps
+
+            for name in _ps_table_names(program):
+                try:
+                    t = ps.get_table(name)
+                except KeyError:
+                    # surface NOW, not at the far-away restore: loading
+                    # this "successful" checkpoint would fail on the
+                    # missing .pkl
+                    warnings.warn(
+                        f"save: program references PS table {name!r} but "
+                        f"no such table is registered in this process — "
+                        f"the checkpoint will NOT contain it and "
+                        f"load_persistables will reject it. create_table "
+                        f"before saving (or drop the lookup op)",
+                        RuntimeWarning, stacklevel=4)
+                    continue
+                # state_dict deep-copies under the table locks: the
+                # snapshot is consistent even while pushes continue
+                ps_states[name] = t.state_dict()
+
+        job = _Snapshot(step, arrays, rng, extra, ps_states)
+        job.snap_global_step = monitor.global_step()
+        return job
+
+    def _write_snapshot(self, job: _Snapshot) -> str:
+        """Serialize + checksum + two-phase commit (runs inline for sync
+        saves, on the writer thread for async ones)."""
+        from . import monitor
+        from ..telemetry import tracing
+
+        t0 = time.perf_counter()
+        blobs = {
+            "state.pkl": pickle.dumps({"arrays": job.arrays},
+                                      protocol=pickle.HIGHEST_PROTOCOL),
+            "rng.pkl": pickle.dumps(job.rng,
+                                    protocol=pickle.HIGHEST_PROTOCOL),
+            "extra.pkl": pickle.dumps(job.extra,
+                                      protocol=pickle.HIGHEST_PROTOCOL),
+        }
+        for name, st in sorted(job.ps_states.items()):
+            # default protocol: the exact bytes fleet.init_server /
+            # ps_server snapshot preload already reads
+            blobs[f"{name}.pkl"] = pickle.dumps(st)
+        # the write span parents under the save span that captured the
+        # snapshot — /tracez and tracetop show the async write hanging
+        # off its step's checkpoint_save even though it runs later, on
+        # another thread
+        with tracing.child_span("checkpoint_write", job.save_ctx,
+                                attrs={"step": job.step,
+                                       "mode": ("async" if job.async_
+                                                else "sync")}):
+            if self.sharded:
+                out = self._write_shard(job, blobs)
+            else:
+                out = self._write_single(job, blobs)
+        _REG.histogram("checkpoint_write_ms",
+                       help="serialize+commit durations (writer side)"
+                       ).observe((time.perf_counter() - t0) * 1e3)
+        lag = max(0, monitor.global_step() - job.snap_global_step)
+        _REG.gauge("ckpt_save_lag_steps",
+                   help="steps the loop advanced while the last "
+                        "checkpoint was being written").set(lag)
+        _REG.gauge("ckpt_save_lag_steps_peak",
+                   help="high-water of ckpt_save_lag_steps").set_max(lag)
+        return out
+
+    def _ps_section(self, job: _Snapshot) -> dict:
+        return {
+            "tables": sorted(job.ps_states),
+            "generation": int(
+                os.environ.get("PADDLE_ELASTIC_RESTART", "0") or 0),
+        }
+
+    def _commit_manifest(self, path: str, manifest: dict, io_phase: str,
+                         crash_phase: str = "ckpt_manifest_tmp_written",
+                         ) -> str:
+        """THE commit point: tmp + os.replace makes the manifest appear
+        atomically; before this the directory reads as torn. Returns the
+        sha256 of the INTENDED manifest bytes (what the global manifest
+        records for a shard)."""
+        blob = json.dumps(manifest, indent=1).encode()
+        short = _io_point(io_phase)
+        data = blob[: len(blob) // 2] if short else blob
+        _atomic_write_bytes(path, data, crash_phase=crash_phase)
+        _REG.counter("ckpt_bytes_written_total",
+                     help="checkpoint bytes written (content + manifests)"
+                     ).inc(len(data))
+        return hashlib.sha256(blob).hexdigest()
+
+    def _write_single(self, job: _Snapshot, blobs: Dict[str, bytes]) -> str:
+        step = job.step
+        tmp = os.path.join(self.root,
+                           f".tmp-ckpt-{step:08d}-{os.getpid()}")
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         try:
-            _atomic_write_bytes(
-                os.path.join(tmp, "state.pkl"),
-                pickle.dumps({"arrays": arrays},
-                             protocol=pickle.HIGHEST_PROTOCOL))
-            _atomic_write_bytes(
-                os.path.join(tmp, "rng.pkl"),
-                pickle.dumps(_rng_state(scope._rng_key),
-                             protocol=pickle.HIGHEST_PROTOCOL))
-            _atomic_write_bytes(
-                os.path.join(tmp, "extra.pkl"),
-                pickle.dumps(dict(extra_state or {}),
-                             protocol=pickle.HIGHEST_PROTOCOL))
-            ps_tables: List[str] = []
-            if program is not None and _ps_table_names(program):
-                _save_ps_tables(tmp, program)
-                ps_tables = [f[:-4] for f in os.listdir(tmp)
-                             if f.endswith(".pkl")
-                             and f not in ("state.pkl", "rng.pkl",
-                                           "extra.pkl")]
+            for rel in sorted(blobs):
+                _write_content(os.path.join(tmp, rel), blobs[rel])
+            io_lib._fsync_dir(tmp)
             _crash_point("ckpt_tmp_written")
 
             final = self._dir(step)
@@ -350,68 +949,126 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        self._fsync_dir(self.root)
+        io_lib._fsync_dir(self.root)
         _crash_point("ckpt_before_commit")
 
-        files = {}
-        for rel in sorted(os.listdir(final)):
-            p = os.path.join(final, rel)
-            files[rel] = {"sha256": _sha256(p),
-                          "bytes": os.path.getsize(p)}
         manifest = {
             "format": MANIFEST_FORMAT,
-            "step": int(step),
-            "files": files,
-            "ps": {
-                "tables": sorted(ps_tables),
-                "generation": int(
-                    os.environ.get("PADDLE_ELASTIC_RESTART", "0") or 0),
-            },
+            "step": step,
+            "files": _files_meta(blobs),
+            "ps": self._ps_section(job),
         }
         if self.world_size is not None:
             manifest["world_size"] = int(self.world_size)
-            manifest["membership_epoch"] = int(
-                os.environ.get("PADDLE_MEMBERSHIP_EPOCH", "0") or 0)
-        # THE commit point: tmp + os.replace makes the manifest appear
-        # atomically; before this line the directory reads as torn
-        _atomic_write_bytes(os.path.join(final, MANIFEST),
-                            json.dumps(manifest, indent=1).encode())
-        self._fsync_dir(final)
+            manifest["membership_epoch"] = _membership_epoch()
+        self._commit_manifest(os.path.join(final, MANIFEST), manifest,
+                              "ckpt_manifest")
         self._retain()
         return final
 
-    @staticmethod
-    def _fsync_dir(path: str) -> None:
+    def _write_shard(self, job: _Snapshot, blobs: Dict[str, bytes]) -> str:
+        """Sharded commit: shard contents + shard manifest exactly like
+        a single-writer checkpoint, then the commit barrier, then (rank
+        0 only) the global manifest — the ONLY marker restore trusts."""
+        step = job.step
+        stepdir = self._dir(step)
+        os.makedirs(stepdir, exist_ok=True)
+        tmp = os.path.join(
+            self.root, f".tmp-ckpt-{step:08d}-r{self.rank}-{os.getpid()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
         try:
-            fd = os.open(path, os.O_RDONLY)
-            try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-        except OSError:  # platforms without dir fsync
-            pass
+            for rel in sorted(blobs):
+                _write_content(os.path.join(tmp, rel), blobs[rel])
+            io_lib._fsync_dir(tmp)
+            _crash_point("ckpt_tmp_written")
+
+            final = os.path.join(stepdir, f"rank{self.rank}")
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        io_lib._fsync_dir(stepdir)
+        _crash_point("ckpt_before_commit")
+
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": step,
+            "rank": int(self.rank),
+            "files": _files_meta(blobs),
+            "ps": self._ps_section(job),
+        }
+        man_sha = self._commit_manifest(os.path.join(final, MANIFEST),
+                                        manifest, "ckpt_manifest")
+        # the shard is committed but INVISIBLE: without the global
+        # manifest no restore anywhere considers this step
+        _crash_point("ckpt_shard_committed")
+
+        world = int(self.world_size or 1)
+        barrier = self._barrier_handle()
+        barrier.shard_commit(step, int(self.rank), world,
+                             {"manifest_sha256": man_sha})
+        if int(self.rank) != 0:
+            return final
+
+        shards = barrier.wait_full(step, world, self._barrier_timeout())
+        if shards is None:
+            raise CommitBarrierError(
+                f"commit barrier for step {step} incomplete after "
+                f"{self._barrier_timeout():.0f}s — the step stays torn "
+                f"(no global manifest); restore() keeps serving the "
+                f"previous fully-committed step")
+        _crash_point("ckpt_before_global_commit")
+        gm = {
+            "format": MANIFEST_FORMAT,
+            "step": step,
+            "world_size": world,
+            "membership_epoch": _membership_epoch(),
+            "shards": {f"rank{r}": dict(info)
+                       for r, info in sorted(shards.items())},
+        }
+        self._commit_manifest(os.path.join(stepdir, GLOBAL_MANIFEST), gm,
+                              "ckpt_global_manifest",
+                              crash_phase="ckpt_global_manifest_tmp_written")
+        self._retain()
+        return final
 
     def _retain(self) -> None:
-        """Keep the newest keep_last_n COMMITTED checkpoints; everything
-        (torn dirs and stale tmp dirs included) older than the oldest
-        kept one is garbage. Torn dirs NEWER than the oldest kept
-        checkpoint are left alone — restore() skips them anyway and the
-        next save at that step overwrites them."""
+        """Keep the newest keep_last_n COMMITTED checkpoints. Retention
+        counts ONLY committed steps — torn dirs never consume a slot and
+        the newest valid checkpoint is never deleted no matter how many
+        newer torn dirs exist. Torn dirs BELOW the newest committed step
+        can never complete (a newer commit exists) and are GC'd; a torn
+        dir at/above it may be a save in flight and is left for the next
+        save at that step (or tools/ckpt_doctor.py --gc) to clear. In
+        sharded mode rank 0 owns retention."""
+        if self.sharded and int(self.rank) != 0:
+            return
         valid = self.steps()
         if not valid:
             return
         kept = valid[-self.keep_last_n:]
         cutoff = kept[0]
+        newest = valid[-1]
         for s, path in self._scan():
-            if s < cutoff and s not in kept:
+            if s in kept:
+                continue
+            if s < cutoff:
+                shutil.rmtree(path, ignore_errors=True)
+            elif s < newest and s not in valid:
+                _REG.counter("ckpt_torn_gcd_total",
+                             help="torn (never-committed) checkpoint "
+                                  "dirs garbage-collected").inc()
                 shutil.rmtree(path, ignore_errors=True)
         for name in os.listdir(self.root):
-            if name.startswith(".tmp-ckpt-"):
-                m = re.match(r"^\.tmp-ckpt-(\d+)-(\d+)$", name)
-                if m and (int(m.group(1)) < cutoff
-                          or int(m.group(2)) != os.getpid()):
-                    shutil.rmtree(os.path.join(self.root, name),
-                                  ignore_errors=True)
+            m = _TMP_RE.match(name)
+            if m and (int(m.group(1)) < cutoff
+                      or int(m.group(2)) != os.getpid()):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
 
     # -- restore ---------------------------------------------------------
     def restore(self, step: Optional[int] = None, program=None,
@@ -419,7 +1076,8 @@ class CheckpointManager:
                 ) -> Optional[dict]:
         """Restore the given step, or the newest checkpoint that passes
         full verification — a torn or corrupted newer directory is
-        skipped with a warning, never trusted. Returns
+        skipped with a warning, never trusted. A sharded step without a
+        complete global manifest is invisible by construction. Returns
         {"step", "extra", "manifest", "world_size"} or None when no
         valid checkpoint exists. On success the scope holds the
         checkpointed persistables and RNG key, and any PS tables the
@@ -447,8 +1105,9 @@ class CheckpointManager:
                     f"back to the previous checkpoint",
                     RuntimeWarning, stacklevel=2)
                 continue
-            m = self.manifest(s)
-            ckpt_ws = (m or {}).get("world_size")
+            src = self.global_manifest(s) if self.sharded \
+                else self.manifest(s)
+            ckpt_ws = (src or {}).get("world_size")
             if (ckpt_ws is not None and self.world_size is not None
                     and int(ckpt_ws) != int(self.world_size)
                     and not allow_reshard):
@@ -471,7 +1130,7 @@ class CheckpointManager:
     def _load(self, step: int, program, scope) -> dict:
         import jax.numpy as jnp
 
-        d = self._dir(step)
+        d = self._data_dir(step)
         with open(os.path.join(d, "state.pkl"), "rb") as f:
             state = pickle.load(f)
         with open(os.path.join(d, "rng.pkl"), "rb") as f:
@@ -503,4 +1162,7 @@ class CheckpointManager:
                 continue
             with open(path, "rb") as f:
                 table.load_state_dict(pickle.load(f))
-        return {"step": int(step), "extra": extra, "manifest": manifest}
+        out = {"step": int(step), "extra": extra, "manifest": manifest}
+        if self.sharded:
+            out["global_manifest"] = self.global_manifest(step)
+        return out

@@ -842,8 +842,16 @@ class Executor:
                 if step < consumed:  # replaying up to the restored position
                     step += 1
                     continue
+                if mgr is not None:
+                    # surface a latched async-writer failure at the
+                    # step boundary (fluid/checkpoint.py error latch)
+                    mgr.raise_if_async_failed()
                 if mgr is not None and ckpt_mod.preemption_requested():
-                    mgr.save(step, extra_state={"consumed_batches": step})
+                    # final checkpoint is synchronous: supersede any
+                    # queued async snapshot, wait out an in-flight
+                    # write, commit before exiting
+                    mgr.save(step, extra_state={"consumed_batches": step},
+                             async_=False)
                     raise ckpt_mod.Preempted(
                         f"preemption requested: checkpointed at batch "
                         f"{step} in {checkpoint_dir!r}")
@@ -882,6 +890,10 @@ class Executor:
                         and step % checkpoint_freq == 0):
                     mgr.save(step, extra_state={"consumed_batches": step})
             if not rolled_back:
+                if mgr is not None:
+                    # return with the checkpoints ON DISK (drain any
+                    # queued/in-flight async write, surface failures)
+                    mgr.drain()
                 return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

@@ -356,9 +356,18 @@ class Model:
             pending_losses, resume_step = [], 0
             rolled_back = False
             while step < len(batches):
+                if mgr is not None:
+                    # a failed background (async) checkpoint write
+                    # latched in the writer — surface it at the step
+                    # boundary, not from a silent gap in the chain
+                    mgr.raise_if_async_failed()
                 if mgr is not None and ckpt_mod.preemption_requested():
+                    # final checkpoint is SYNCHRONOUS: it supersedes any
+                    # queued async snapshot, waits out an in-flight
+                    # write, and commits before the process exits
                     mgr.save(global_step,
-                             extra_state=_position(step, losses))
+                             extra_state=_position(step, losses),
+                             async_=False)
                     raise ckpt_mod.Preempted(
                         f"preemption requested: checkpointed at global "
                         f"step {global_step} in {checkpoint_dir!r}")
@@ -420,6 +429,10 @@ class Model:
                 stop = True
             epoch += 1
         cbks.on_train_end()
+        if mgr is not None:
+            # fit returns with its checkpoints ON DISK: wait out any
+            # queued/in-flight async write (and surface its failure)
+            mgr.drain()
         return history
 
     def evaluate(self, eval_data, batch_size=32, log_freq=10, verbose=2,

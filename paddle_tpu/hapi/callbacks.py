@@ -102,10 +102,17 @@ class ModelCheckpoint(Callback):
     (fluid/checkpoint.py): step-numbered atomic checkpoint dirs under
     save_dir with only the newest N retained, loadable with
     Model.fit(resume=...). keep_last_n=None keeps the legacy behavior
-    for epoch saves (Model.save to save_dir/epoch_<n>, unbounded)."""
+    for epoch saves (Model.save to save_dir/epoch_<n>, unbounded).
+
+    async_save: hand serialization + commit to the manager's background
+    writer so the step loop only pays the snapshot cost (None = the
+    manager's default, i.e. PADDLE_CKPT_ASYNC). on_train_end drains any
+    queued/in-flight write, so a finished fit leaves its checkpoints on
+    disk either way."""
 
     def __init__(self, save_freq=1, save_dir="checkpoints",
-                 save_freq_unit="epoch", keep_last_n=None):
+                 save_freq_unit="epoch", keep_last_n=None,
+                 async_save=None):
         if save_freq_unit not in ("epoch", "step"):
             raise ValueError(
                 f"save_freq_unit must be 'epoch' or 'step', got "
@@ -116,6 +123,7 @@ class ModelCheckpoint(Callback):
         self.save_dir = save_dir
         self.save_freq_unit = save_freq_unit
         self.keep_last_n = keep_last_n
+        self.async_save = async_save
         self._gstep = 0
         self._epoch = 0
 
@@ -135,7 +143,8 @@ class ModelCheckpoint(Callback):
             self._manager().save(
                 self._gstep,
                 extra_state={"epoch": self._epoch,
-                             "global_step": self._gstep})
+                             "global_step": self._gstep},
+                async_=self.async_save)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_freq_unit == "epoch" and (epoch + 1) % self.save_freq == 0:
@@ -143,12 +152,19 @@ class ModelCheckpoint(Callback):
                 self._manager().save(
                     self._gstep,
                     extra_state={"epoch": epoch + 1,
-                                 "global_step": self._gstep})
+                                 "global_step": self._gstep},
+                    async_=self.async_save)
             else:
                 import os
 
                 self.model.save(os.path.join(self.save_dir, f"epoch_{epoch}"))
         return False
+
+    def on_train_end(self):
+        if self.keep_last_n is not None and getattr(self, "model", None):
+            # a finished fit leaves its checkpoints ON DISK: drain any
+            # queued/in-flight async write (and surface its failure)
+            self._manager().drain()
 
 
 class MetricsLogger(Callback):

@@ -79,6 +79,13 @@ class Gauge:
     def dec(self, n: float = 1.0) -> None:
         self.value -= n
 
+    def set_max(self, v: float) -> None:
+        """High-water update: keep the larger of the current and new
+        value (e.g. peak checkpoint save lag)."""
+        v = float(v)
+        if v > self.value:
+            self.value = v
+
 
 class Histogram:
     """Fixed-boundary histogram: per-bucket counts (non-cumulative

@@ -256,6 +256,19 @@ def bound(fn: Callable) -> Callable:
     return wrapper
 
 
+def child_span(name: str, ctx: Optional[Tuple[str, str]],
+               attrs: Optional[dict] = None,
+               kind: str = "internal") -> _SpanScope:
+    """Open a span parented under a CAPTURED (trace_id, span_id) context
+    from any thread — the async checkpoint writer records its
+    `checkpoint_write` span under the step's `checkpoint_save` span this
+    way, even though the write runs later on the writer thread. A None
+    context roots a fresh trace; tracing off = no-op scope."""
+    if not enabled():
+        return _SpanScope(None)
+    return _SpanScope(begin(name, kind=kind, parent=ctx, attrs=attrs))
+
+
 def annotate(**attrs) -> None:
     """Set attributes on the innermost active SPAN (contexts re-bound
     from another thread are skipped — they are not ours to mutate)."""

@@ -1,0 +1,88 @@
+"""Worker for the sharded-checkpoint drills in
+tests/test_checkpoint_async.py: a small Model.fit job with dropout (the
+RNG stream matters) checkpointing SHARDED (PADDLE_CKPT_SHARDED=1 under
+the launcher: every rank writes rank<k>/ shards, rank 0 commits the
+global manifest behind the launcher-hosted commit barrier). Every rank
+trains IDENTICAL data, so each rank's concatenated per-step loss trace
+must equal a clean single-process run's — the drill kills rank 1
+between its shard commit and the global commit and asserts exactly
+that after the relaunch.
+
+Env knobs:
+  CKPT_TEST_DIR    shared checkpoint root (fit checkpoint_dir, resume=True)
+  CKPT_TEST_TRACE  trace path PREFIX; this rank appends to
+                   <prefix>.<rank> (the file survives restarts, so the
+                   concatenation of attempts IS the rank's loss trace)
+  CKPT_TEST_CKPT_FREQ  checkpoint every N steps (default 4)
+
+Relaunched attempts (PADDLE_ELASTIC_RESTART > 0) — and any attempt in a
+FRESH launch over an old root — clear PADDLE_PS_FAULT_SPEC first, so a
+one-shot crash rule means "kill that save once", not "kill it every
+incarnation".
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+BATCH, NSAMP, EPOCHS = 8, 64, 3
+STEPS_PER_EPOCH = NSAMP // BATCH
+
+
+def main():
+    if int(os.environ.get("PADDLE_ELASTIC_RESTART", 0)) > 0:
+        os.environ.pop("PADDLE_PS_FAULT_SPEC", None)
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import checkpoint as ckpt
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.hapi import Callback, Input, Model
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    ckpt_dir = os.environ["CKPT_TEST_DIR"]
+    trace = os.environ["CKPT_TEST_TRACE"] + f".{rank}"
+    freq = int(os.environ.get("CKPT_TEST_CKPT_FREQ", 4))
+
+    def _net(x):
+        h = layers.fc(x, 16, act="relu")
+        h = layers.dropout(h, dropout_prob=0.3)
+        return layers.fc(h, 1)
+
+    class TraceRecorder(Callback):
+        def __init__(self):
+            self._epoch = 0
+
+        def on_epoch_begin(self, epoch):
+            self._epoch = epoch
+
+        def on_batch_end(self, mode, step, logs=None):
+            if mode != "train":
+                return
+            with open(trace, "a") as f:
+                f.write(json.dumps(
+                    {"gs": self._epoch * STEPS_PER_EPOCH + step,
+                     "loss": (logs or {}).get("loss")}) + "\n")
+                f.flush()
+
+    rng = np.random.RandomState(0)  # IDENTICAL data on every rank
+    X = rng.randn(NSAMP, 4).astype(np.float32)
+    Y = rng.randn(NSAMP, 1).astype(np.float32)
+
+    model = Model(_net, Input("x", [BATCH, 4]), Input("y", [BATCH, 1]))
+    model.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2),
+        lambda p, y: layers.mean(layers.square_error_cost(p, y)),
+    )
+    try:
+        model.fit((X, Y), batch_size=BATCH, epochs=EPOCHS, verbose=0,
+                  shuffle=True, checkpoint_dir=ckpt_dir,
+                  checkpoint_freq=freq, resume=True,
+                  callbacks=[TraceRecorder()])
+    except ckpt.Preempted:
+        sys.exit(ckpt.PREEMPTED_EXIT_CODE)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,46 @@ def test_restore_falls_back_past_torn_and_corrupt(tmp_path):
                                   np.full(3, 1.0, np.float32))
 
 
+def test_retention_counts_only_committed_and_gcs_torn(tmp_path):
+    """Regression (ISSUE 10 satellite): keep_last_n counts COMMITTED
+    checkpoints only — torn dirs interleaved into the retention window
+    never consume a slot, never shield older steps, and are GC'd once a
+    newer step commits; the newest valid checkpoint survives no matter
+    how many newer torn dirs exist."""
+    scope = fluid.executor.Scope()
+    scope.set_var("w", np.zeros(3, np.float32))
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2, scope=scope)
+    mgr.save(1)
+    mgr.save(2)
+    # interleave a torn dir INSIDE the retention window and add newer
+    # torn debris above it (a crashed save that never committed)
+    os.makedirs(tmp_path / "ckpt-00000003")
+    (tmp_path / "ckpt-00000003" / "state.pkl").write_bytes(b"partial")
+    os.makedirs(tmp_path / "ckpt-00000005")
+    mgr.save(4)
+    # committed: [1,2,4] -> kept [2,4]; torn 3 (below newest commit 4)
+    # GC'd; torn 5 (ABOVE the newest commit: possibly in flight) kept
+    assert mgr.steps() == [2, 4]
+    assert not (tmp_path / "ckpt-00000003").exists()
+    assert (tmp_path / "ckpt-00000005").exists()
+    assert mgr.verify(2) and mgr.verify(4)
+    # the torn newer dir never outranks the newest valid one
+    st = mgr.restore()
+    assert st["step"] == 4
+
+    # keep_last_n=1 with ONLY torn dirs above: the single valid
+    # checkpoint is never deleted
+    mgr2 = CheckpointManager(str(tmp_path), keep_last_n=1, scope=scope)
+    mgr2.save(6)
+    os.makedirs(tmp_path / "ckpt-00000007")
+    os.makedirs(tmp_path / "ckpt-00000008")
+    mgr2.save(9)
+    assert mgr2.steps() == [9]
+    assert not (tmp_path / "ckpt-00000007").exists()
+    assert not (tmp_path / "ckpt-00000008").exists()
+    assert mgr2.restore()["step"] == 9
+
+
 def test_restore_empty_dir_returns_none(tmp_path):
     mgr = CheckpointManager(str(tmp_path), scope=fluid.executor.Scope())
     assert mgr.restore() is None

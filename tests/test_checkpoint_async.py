@@ -1,0 +1,916 @@
+"""Async + sharded checkpointing (ISSUE 10: crash-consistent global
+commit, disk-fault drills, checkpoint doctor).
+
+  async layer   — depth-1 coalescing writer queue (a newer save
+                  supersedes a queued one), snapshot-cost-only save
+                  latency with a deliberately slowed writer, writer
+                  error latch re-raised at the next save/drain,
+                  sync-vs-async byte identity on disk, preemption
+                  drains the queue, fit resume bit-identity
+  fault layer   — io_err / short_write / diskfull at every write phase
+                  and crash rules at the writer/manifest-rename phases:
+                  restore() must always fall back to the newest fully
+                  committed step
+  sharded layer — per-rank shard manifests + rank-0 global manifest
+                  behind the commit barrier (in-process, RPC transport
+                  and shared-FS fallback); a partial commit is
+                  invisible and GC'd as torn
+  doctor        — tools/ckpt_doctor.py verify / --gc / --repair (PS
+                  table from a live replica via fetch_replica_state)
+  process layer — (slow) 2-rank launcher drill: kill rank 1 between
+                  shard commit and global commit, restore picks the
+                  previous global step, ckpt_doctor --gc removes the
+                  torn one, the relaunched job resumes bit-identically
+"""
+import hashlib
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import faults
+from paddle_tpu.distributed.coordinator import (CkptBarrier,
+                                                serve_ckpt_barrier)
+from paddle_tpu.fluid import checkpoint as ckpt
+from paddle_tpu.fluid import flags as fl
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.checkpoint import (CheckpointManager,
+                                         CheckpointWriterError,
+                                         CommitBarrierError,
+                                         WorldSizeMismatchError)
+from paddle_tpu.hapi import Input, Model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import ckpt_doctor  # noqa: E402
+
+SHARD_WORKER = os.path.join(REPO, "tests", "dist_ckpt_shard_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _scope_with(w):
+    scope = fluid.executor.Scope()
+    scope.set_var("w", np.asarray(w, np.float32))
+    return scope
+
+
+def _tree_bytes(root):
+    """{relpath: file bytes} for a directory tree."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            out[os.path.relpath(p, root)] = open(p, "rb").read()
+    return out
+
+
+def _net(x):
+    h = layers.fc(x, 16, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)  # RNG restore must matter
+    return layers.fc(h, 1)
+
+
+def _make_model():
+    m = Model(_net, Input("x", [8, 4]), Input("y", [8, 1]))
+    m.prepare(
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2),
+        lambda p, y: layers.mean(layers.square_error_cost(p, y)),
+    )
+    return m
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 4).astype(np.float32),
+            rng.randn(n, 1).astype(np.float32))
+
+
+class _FaultCtl:
+    def __init__(self, monkeypatch):
+        self._mp = monkeypatch
+
+    def arm(self, spec):
+        fl.set_flags({"FLAGS_ps_fault_injection": True})
+        self._mp.setenv("PADDLE_PS_FAULT_SPEC", spec)
+        faults.reset()
+
+    def disarm(self):
+        self._mp.setenv("PADDLE_PS_FAULT_SPEC", "")
+        faults.reset()
+
+    def __call__(self, spec):
+        self.arm(spec)
+
+
+@pytest.fixture
+def fault_spec(monkeypatch):
+    """Arm a deterministic fault spec mid-test (counters start at the
+    arming, not at process start)."""
+    ctl = _FaultCtl(monkeypatch)
+    yield ctl
+    fl.set_flags({"FLAGS_ps_fault_injection": False})
+    faults.reset()
+
+
+def _wait_writer_busy(mgr, timeout=5.0):
+    """Block until the async writer DEQUEUED the current job (its slot
+    is active and the queue is empty) — the deterministic setup point
+    for supersede tests."""
+    w = mgr._async
+    deadline = time.monotonic() + timeout
+    while True:
+        with w.cond:
+            if w.active is not None and w.pending is None:
+                return
+        assert time.monotonic() < deadline, "writer never picked up job"
+        time.sleep(0.005)
+
+
+@pytest.fixture(autouse=True)
+def _clear_preemption():
+    ckpt.clear_preemption()
+    yield
+    ckpt.clear_preemption()
+
+
+def _slow_writer(monkeypatch, delay, gate=None):
+    """Slow the serializer+commit path: _write_snapshot sleeps (or
+    blocks on `gate`) before doing the real write."""
+    orig = CheckpointManager._write_snapshot
+
+    def slowed(self, job):
+        if gate is not None:
+            assert gate.wait(30), "writer gate never opened"
+        if delay:
+            time.sleep(delay)
+        return orig(self, job)
+
+    monkeypatch.setattr(CheckpointManager, "_write_snapshot", slowed)
+    return orig
+
+
+# ---------------------------------------------------------------------------
+# async writer
+# ---------------------------------------------------------------------------
+
+
+def test_async_save_returns_at_snapshot_cost(tmp_path, monkeypatch):
+    """Acceptance: with a deliberately slowed serializer the step loop
+    pays only the snapshot — save() returns in a fraction of the write
+    time, and the checkpoint still commits on drain."""
+    _slow_writer(monkeypatch, delay=0.6)
+    scope = _scope_with(np.arange(64))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    t0 = time.perf_counter()
+    mgr.save(1, extra_state={"mark": 1}, async_=True)
+    dt = time.perf_counter() - t0
+    assert dt < 0.3, f"async save blocked {dt:.3f}s behind a 0.6s writer"
+    assert mgr.latest_step() is None  # not committed yet
+    mgr.drain()
+    assert mgr.latest_step() == 1 and mgr.verify(1)
+    st = mgr.restore()
+    assert st["step"] == 1 and st["extra"]["mark"] == 1
+
+
+def test_async_supersede_coalesces_queued_saves(tmp_path, monkeypatch):
+    """Queue depth 1: while the writer is busy, later saves replace the
+    queued snapshot — the writer commits the first and the NEWEST, never
+    the middle ones."""
+    gate = threading.Event()
+    _slow_writer(monkeypatch, delay=0, gate=gate)
+    scope = _scope_with(np.zeros(8))
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10, scope=scope)
+    scope.set_var("w", np.full(8, 1.0, np.float32))
+    mgr.save(1, async_=True)
+    _wait_writer_busy(mgr)  # save 1 is in flight (blocked at the gate)
+    for s in range(2, 6):
+        scope.set_var("w", np.full(8, float(s), np.float32))
+        mgr.save(s, async_=True)
+    gate.set()
+    mgr.drain()
+    # save 1 was in flight; 2..4 were superseded in the queue by 5
+    assert mgr.steps() == [1, 5]
+    st = mgr.restore()
+    assert st["step"] == 5
+    np.testing.assert_array_equal(np.asarray(scope.find_var("w")),
+                                  np.full(8, 5.0, np.float32))
+
+
+def test_async_snapshot_decoupled_from_live_scope(tmp_path, monkeypatch):
+    """The snapshot captured at save() time is what commits, even when
+    the scope mutates while the writer is stalled."""
+    gate = threading.Event()
+    _slow_writer(monkeypatch, delay=0, gate=gate)
+    scope = _scope_with(np.full(4, 1.0, np.float32))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1, async_=True)
+    scope.set_var("w", np.full(4, 9.0, np.float32))  # post-snapshot step
+    gate.set()
+    mgr.drain()
+    fresh = fluid.executor.Scope()
+    CheckpointManager(str(tmp_path), scope=fresh).restore()
+    np.testing.assert_array_equal(np.asarray(fresh.find_var("w")),
+                                  np.full(4, 1.0, np.float32))
+
+
+def test_async_and_sync_saves_byte_identical(tmp_path):
+    """PADDLE_CKPT_ASYNC changes WHEN bytes hit the disk, never WHICH
+    bytes: the committed trees are identical file for file."""
+    w = np.arange(32, dtype=np.float32) * 0.5
+    s_sync, s_async = _scope_with(w), _scope_with(w)
+    m_sync = CheckpointManager(str(tmp_path / "sync"), scope=s_sync)
+    m_async = CheckpointManager(str(tmp_path / "async"), scope=s_async)
+    m_sync.save(3, extra_state={"epoch": 1})
+    m_async.save(3, extra_state={"epoch": 1}, async_=True)
+    m_async.drain()
+    assert _tree_bytes(tmp_path / "sync") == _tree_bytes(tmp_path / "async")
+
+
+def test_writer_exception_latches_and_reraises_at_next_save(tmp_path,
+                                                            monkeypatch):
+    boom = OSError("disk detached")
+
+    def failing(self, job):
+        raise boom
+
+    monkeypatch.setattr(CheckpointManager, "_write_snapshot", failing)
+    scope = _scope_with(np.ones(4))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1, async_=True)  # returns; failure latches in the writer
+    assert mgr._async.wait_idle(10)  # the failing job has run
+    with pytest.raises(CheckpointWriterError, match="disk detached"):
+        mgr.save(2, async_=True)
+    # the latch is one-shot: once surfaced, the manager works again
+    monkeypatch.undo()
+    mgr.save(3, async_=True)
+    mgr.drain()
+    assert mgr.latest_step() == 3
+
+
+def test_writer_exception_reraises_at_drain(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        CheckpointManager, "_write_snapshot",
+        lambda self, job: (_ for _ in ()).throw(OSError("enospc")))
+    mgr = CheckpointManager(str(tmp_path), scope=_scope_with(np.ones(2)))
+    mgr.save(1, async_=True)
+    with pytest.raises(CheckpointWriterError):
+        mgr.drain()
+
+
+def test_sync_save_supersedes_queued_and_waits_inflight(tmp_path,
+                                                        monkeypatch):
+    """The preemption path: a FINAL synchronous save cancels a queued
+    async snapshot, waits out the in-flight write, then commits — the
+    newest state always lands."""
+    gate = threading.Event()
+    _slow_writer(monkeypatch, delay=0, gate=gate)
+    scope = _scope_with(np.full(4, 1.0, np.float32))
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10, scope=scope)
+    mgr.save(1, async_=True)
+    _wait_writer_busy(mgr)                         # writer picked it up
+    scope.set_var("w", np.full(4, 2.0, np.float32))
+    mgr.save(2, async_=True)                       # queued
+    scope.set_var("w", np.full(4, 3.0, np.float32))
+
+    def release():
+        time.sleep(0.2)
+        gate.set()
+
+    threading.Thread(target=release, daemon=True).start()
+    mgr.save(3, async_=False)  # final: supersedes 2, waits for 1
+    assert mgr.steps() == [1, 3]
+    assert mgr.verify(3)
+
+
+def test_fit_async_preempt_resume_trace_bit_identical(tmp_path,
+                                                      monkeypatch):
+    """Acceptance: async checkpointing + preemption + resume reproduce
+    the uninterrupted run bit for bit (the final save is synchronous,
+    so the preemption point is never lost)."""
+    monkeypatch.setenv("PADDLE_CKPT_ASYNC", "1")
+    X, Y = _data(64)
+    m_ref = _make_model()
+    h_ref = m_ref.fit((X, Y), batch_size=8, epochs=3, verbose=0)
+
+    class PreemptAt:
+        def __init__(self, at):
+            self.at, self.n = at, 0
+
+        def set_model(self, model):
+            pass
+
+        def on_train_begin(self):
+            pass
+
+        def on_train_end(self):
+            pass
+
+        def on_epoch_begin(self, epoch):
+            pass
+
+        def on_epoch_end(self, epoch, logs=None):
+            return False
+
+        def on_batch_begin(self, mode, step):
+            pass
+
+        def on_batch_end(self, mode, step, logs=None):
+            if mode == "train":
+                self.n += 1
+                if self.n == self.at:
+                    ckpt.request_preemption()
+
+    m_int = _make_model()
+    with pytest.raises(ckpt.Preempted):
+        m_int.fit((X, Y), batch_size=8, epochs=3, verbose=0,
+                  checkpoint_dir=str(tmp_path), checkpoint_freq=3,
+                  callbacks=[PreemptAt(13)])
+    ckpt.clear_preemption()
+    # the final (synchronous) checkpoint is the newest committed step
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.verify(mgr.latest_step())
+
+    m_res = _make_model()
+    h_res = m_res.fit((X, Y), batch_size=8, epochs=3, verbose=0,
+                      checkpoint_dir=str(tmp_path), resume=True)
+    assert h_ref["loss"] == h_res["loss"]
+    for k, v in m_ref.parameters().items():
+        np.testing.assert_array_equal(v, m_res.parameters()[k])
+
+
+def test_fsync_opt_out_env(tmp_path, monkeypatch):
+    """PADDLE_CKPT_FSYNC=0 skips the durability fsyncs (test-speed
+    knob); the committed bytes are identical either way."""
+    from paddle_tpu.fluid import io as io_lib
+
+    w = np.arange(8, dtype=np.float32)
+    m_on = CheckpointManager(str(tmp_path / "on"), scope=_scope_with(w))
+    m_on.save(1)
+    monkeypatch.setenv("PADDLE_CKPT_FSYNC", "0")
+    assert not io_lib._fsync_enabled()
+    m_off = CheckpointManager(str(tmp_path / "off"), scope=_scope_with(w))
+    m_off.save(1)
+    assert m_off.verify(1)
+    assert _tree_bytes(tmp_path / "on") == _tree_bytes(tmp_path / "off")
+
+
+# ---------------------------------------------------------------------------
+# disk-fault injection (in-process: io_err / short_write / diskfull)
+# ---------------------------------------------------------------------------
+
+
+def test_io_err_sync_save_fails_previous_survives(tmp_path, fault_spec):
+    scope = _scope_with(np.full(4, 1.0, np.float32))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1)
+    fault_spec("io_err:ckpt_content:1")
+    scope.set_var("w", np.full(4, 2.0, np.float32))
+    with pytest.raises(OSError, match="I/O error"):
+        mgr.save(2)
+    assert mgr.steps() == [1]
+    fresh = fluid.executor.Scope()
+    st = CheckpointManager(str(tmp_path), scope=fresh).restore()
+    assert st["step"] == 1
+    np.testing.assert_array_equal(np.asarray(fresh.find_var("w")),
+                                  np.full(4, 1.0, np.float32))
+    # after the (one-shot) fault, the same step commits fine
+    mgr.save(2)
+    assert mgr.verify(2)
+
+
+def test_io_err_async_latches(tmp_path, fault_spec):
+    scope = _scope_with(np.ones(4))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1)
+    fault_spec("io_err:ckpt_content:1")
+    mgr.save(2, async_=True)
+    with pytest.raises(CheckpointWriterError, match="I/O error"):
+        mgr.drain()
+    assert mgr.steps() == [1]
+
+
+def test_short_write_content_detected_as_corrupt(tmp_path, fault_spec):
+    """A truncated content file the writer never noticed: the manifest
+    records the INTENDED sha256, so verification fails and restore falls
+    back — the lying write can't forge a valid checkpoint."""
+    scope = _scope_with(np.full(4, 1.0, np.float32))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1)
+    fault_spec("short_write:ckpt_content:1")
+    scope.set_var("w", np.full(4, 2.0, np.float32))
+    mgr.save(2)  # "succeeds" — the fault is silent by design
+    assert mgr.steps() == [1, 2]  # committed...
+    assert not mgr.verify(2)      # ...but not trusted
+    fresh = fluid.executor.Scope()
+    with pytest.warns(RuntimeWarning):
+        st = CheckpointManager(str(tmp_path), scope=fresh).restore()
+    assert st["step"] == 1
+    rep = ckpt_doctor.scan_root(str(tmp_path))
+    by_step = {e["step"]: e for e in rep["steps"]}
+    assert by_step[2]["status"] == "corrupt"
+    assert rep["newest_valid"] == 1
+
+
+def test_short_write_manifest_is_torn(tmp_path, fault_spec):
+    scope = _scope_with(np.ones(4))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1)
+    fault_spec("short_write:ckpt_manifest:1")
+    mgr.save(2)
+    # a truncated manifest is unparseable == no manifest == torn
+    assert mgr.steps() == [1]
+    rep = ckpt_doctor.scan_root(str(tmp_path))
+    assert {e["step"]: e["status"] for e in rep["steps"]}[2] == "torn"
+
+
+def test_diskfull_latches_until_reset(tmp_path, fault_spec):
+    import errno
+
+    scope = _scope_with(np.ones(4))
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    mgr.save(1)
+    fault_spec("diskfull:ckpt_content:1")
+    with pytest.raises(OSError) as ei:
+        mgr.save(2)
+    assert ei.value.errno == errno.ENOSPC
+    with pytest.raises(OSError):  # latched: the disk stays full
+        mgr.save(3)
+    assert mgr.steps() == [1]
+    fault_spec.disarm()  # "space freed"
+    mgr.save(4)
+    assert mgr.verify(4)
+
+
+# ---------------------------------------------------------------------------
+# crash matrix (subprocess: writer thread, manifest rename)
+# ---------------------------------------------------------------------------
+
+_CRASH_SCRIPT = """
+import os, sys
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.checkpoint import CheckpointManager
+
+root = sys.argv[1]
+use_async = os.environ.get("PADDLE_CKPT_ASYNC") == "1"
+scope = fluid.global_scope()
+scope.set_var("w", np.full(4, 1.0, np.float32))
+mgr = CheckpointManager(root, keep_last_n=3, scope=scope)
+mgr.save(1)                      # commits: crash rules have nth=2
+if use_async:
+    mgr.drain()
+scope.set_var("w", np.full(4, 2.0, np.float32))
+mgr.save(2)                      # crash rule fires inside here...
+mgr.drain()                      # ...or inside the writer drain
+print("UNREACHABLE")             # the crash is os._exit(1)
+"""
+
+
+@pytest.mark.slow  # subprocess-per-phase: runs in the CI drill lane
+@pytest.mark.parametrize("phase,async_", [
+    ("ckpt_manifest_tmp_written", "0"),  # mid manifest rename
+    ("ckpt_writer", "1"),                # inside the writer thread
+    ("ckpt_tmp_written", "1"),           # async mid-shard write
+])
+def test_crash_matrix_restores_previous_step(tmp_path, phase, async_):
+    """Acceptance: a kill at EVERY commit phase — including inside the
+    async writer thread and mid manifest-rename — leaves restore()
+    selecting the newest fully-committed step. (The sync-path
+    tmp-written / before-commit phases stay in tier-1 via
+    test_checkpoint.py's crash-injection test.)"""
+    script = tmp_path / "crasher.py"
+    script.write_text(textwrap.dedent(_CRASH_SCRIPT))
+    root = tmp_path / "ckpts"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               FLAGS_ps_fault_injection="1", PADDLE_CKPT_ASYNC=async_)
+    env["PADDLE_PS_FAULT_SPEC"] = f"crash:{phase}:2"
+    r = subprocess.run([sys.executable, str(script), str(root)], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert "crashing pid" in r.stderr and phase in r.stderr
+
+    scope = fluid.executor.Scope()
+    mgr = CheckpointManager(str(root), scope=scope)
+    assert mgr.steps() == [1]  # step 2 never committed
+    st = mgr.restore()
+    assert st["step"] == 1
+    np.testing.assert_array_equal(np.asarray(scope.find_var("w")),
+                                  np.full(4, 1.0, np.float32))
+    # the torn debris is overwritable: a post-restart save at 2 commits
+    scope.set_var("w", np.full(4, 5.0, np.float32))
+    mgr.save(2)
+    assert mgr.verify(2) and mgr.latest_step() == 2
+
+
+# ---------------------------------------------------------------------------
+# sharded global commit
+# ---------------------------------------------------------------------------
+
+
+def _shard_mgr(root, rank, barrier=None, world=2, **kw):
+    scope = _scope_with(np.full(4, 10.0 + rank, np.float32))
+    mgr = CheckpointManager(str(root), scope=scope, world_size=world,
+                            rank=rank, sharded=True, barrier=barrier,
+                            **kw)
+    return mgr, scope
+
+
+def _save_both(root, step, barrier=None, stagger=0.0, **kw):
+    """Two ranks of one sharded job saving `step` (rank 1 on a thread:
+    rank 0 blocks in the commit barrier until rank 1's shard lands)."""
+    m0, s0 = _shard_mgr(root, 0, barrier, **kw)
+    m1, s1 = _shard_mgr(root, 1, barrier, **kw)
+    errs = []
+
+    def r1():
+        if stagger:
+            time.sleep(stagger)
+        try:
+            m1.save(step, extra_state={"rank": 1})
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=r1, daemon=True)
+    t.start()
+    m0.save(step, extra_state={"rank": 0})
+    t.join(30)
+    assert not errs, errs
+    return m0, m1
+
+
+def test_sharded_global_commit_and_per_rank_restore(tmp_path):
+    barrier = CkptBarrier()
+    m0, m1 = _save_both(tmp_path, 4, barrier)
+    for m in (m0, m1):
+        assert m.steps() == [4]
+        assert m.verify(4)
+    gm = m0.global_manifest(4)
+    assert gm["world_size"] == 2
+    assert set(gm["shards"]) == {"rank0", "rank1"}
+    # the recorded shard sha256s are the actual manifest files' hashes
+    for rname, info in gm["shards"].items():
+        blob = open(tmp_path / "ckpt-00000004" / rname /
+                    "manifest.json", "rb").read()
+        assert hashlib.sha256(blob).hexdigest() == info["manifest_sha256"]
+    # each rank restores ITS shard
+    for rank, m in ((0, m0), (1, m1)):
+        fresh = fluid.executor.Scope()
+        st = CheckpointManager(str(tmp_path), scope=fresh, world_size=2,
+                               rank=rank, sharded=True).restore()
+        assert st["step"] == 4 and st["extra"]["rank"] == rank
+        np.testing.assert_array_equal(
+            np.asarray(fresh.find_var("w")),
+            np.full(4, 10.0 + rank, np.float32))
+
+
+def test_sharded_partial_commit_is_invisible_and_torn(tmp_path,
+                                                      monkeypatch):
+    barrier = CkptBarrier()
+    _save_both(tmp_path, 2, barrier)  # step 2 fully committed
+    monkeypatch.setenv("PADDLE_CKPT_BARRIER_TIMEOUT", "0.5")
+    m0, _ = _shard_mgr(tmp_path, 0, barrier)
+    # rank 1 never saves step 3: rank 0's shard lands, the barrier
+    # times out, the global manifest is never written
+    with pytest.raises(CommitBarrierError):
+        m0.save(3)
+    assert m0.steps() == [2]
+    assert (tmp_path / "ckpt-00000003" / "rank0" / "manifest.json").exists()
+    assert not (tmp_path / "ckpt-00000003" / "global_manifest.json").exists()
+    fresh = fluid.executor.Scope()
+    st = CheckpointManager(str(tmp_path), scope=fresh, world_size=2,
+                           rank=0, sharded=True).restore()
+    assert st["step"] == 2
+    # the doctor reports the partial step as torn and GCs it
+    rep = ckpt_doctor.scan_root(str(tmp_path))
+    assert {e["step"]: e["status"] for e in rep["steps"]}[3] == "torn"
+    removed = ckpt_doctor.gc_root(str(tmp_path), rep)
+    assert str(tmp_path / "ckpt-00000003") in removed
+    assert not (tmp_path / "ckpt-00000003").exists()
+    assert (tmp_path / "ckpt-00000002").exists()
+
+
+def test_sharded_fs_barrier_fallback(tmp_path, monkeypatch):
+    """No barrier object, no endpoint: rank 0 discovers the other
+    shard's manifest over the shared filesystem."""
+    monkeypatch.delenv("PADDLE_CKPT_BARRIER_ENDPOINT", raising=False)
+    m0, m1 = _save_both(tmp_path, 7, barrier=None, stagger=0.3)
+    assert m0.verify(7) and m1.verify(7)
+    gm = m0.global_manifest(7)
+    assert set(gm["shards"]) == {"rank0", "rank1"}
+
+
+def test_sharded_rpc_barrier_over_transport(tmp_path, monkeypatch):
+    """The production path: the commit barrier served over the
+    ps_server RPC transport (what the launcher hosts)."""
+    barrier = CkptBarrier()
+    srv, ep = serve_ckpt_barrier(barrier)
+    try:
+        monkeypatch.setenv("PADDLE_CKPT_BARRIER_ENDPOINT", ep)
+        m0, m1 = _save_both(tmp_path, 5, barrier=None)
+        assert m0.verify(5) and m1.verify(5)
+        assert m0.global_manifest(5)["world_size"] == 2
+    finally:
+        from paddle_tpu.distributed.coordinator import stop_coordinator
+
+        stop_coordinator(srv)
+
+
+def test_sharded_async_commit(tmp_path):
+    """Async + sharded compose: the barrier wait runs on the writer
+    thread, never in the step loop."""
+    barrier = CkptBarrier()
+    m0, s0 = _shard_mgr(tmp_path, 0, barrier, async_save=True)
+    m1, s1 = _shard_mgr(tmp_path, 1, barrier, async_save=True)
+    t0 = time.perf_counter()
+    m0.save(6)  # returns immediately: rank 1 hasn't even saved yet
+    assert time.perf_counter() - t0 < 1.0
+    m1.save(6)
+    m1.drain()
+    m0.drain()
+    assert m0.verify(6) and m1.verify(6)
+
+
+def test_sharded_world_size_gate(tmp_path):
+    _save_both(tmp_path, 2, CkptBarrier())
+    fresh = fluid.executor.Scope()
+    mgr = CheckpointManager(str(tmp_path), scope=fresh, world_size=3,
+                            rank=0, sharded=True)
+    with pytest.raises(WorldSizeMismatchError):
+        mgr.restore()
+    st = mgr.restore(allow_reshard=True)
+    assert st["step"] == 2 and st["world_size"] == 2
+
+
+def test_sharded_retention_rank0_owns_gc(tmp_path):
+    barrier = CkptBarrier()
+    for s in (1, 2, 3, 4):
+        _save_both(tmp_path, s, barrier, keep_last_n=2)
+    m0 = CheckpointManager(str(tmp_path), world_size=2, rank=0,
+                           sharded=True)
+    assert m0.steps() == [3, 4]
+    assert sorted(os.listdir(tmp_path)) == ["ckpt-00000003",
+                                            "ckpt-00000004"]
+
+
+# ---------------------------------------------------------------------------
+# doctor: sharded orphans + PS-table repair from a live replica
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_sharded_orphan_shard_gc(tmp_path):
+    _save_both(tmp_path, 2, CkptBarrier())
+    orphan = tmp_path / "ckpt-00000002" / "rank7"
+    os.makedirs(orphan)
+    (orphan / "junk.pkl").write_bytes(b"x")
+    rep = ckpt_doctor.scan_root(str(tmp_path))
+    entry = {e["step"]: e for e in rep["steps"]}[2]
+    assert entry["status"] == "ok"
+    assert [os.path.basename(p) for p in entry["orphan_shards"]] == ["rank7"]
+    removed = ckpt_doctor.gc_root(str(tmp_path), rep)
+    assert str(orphan) in removed
+    assert not orphan.exists()
+    # the committed shards are untouched
+    m0 = CheckpointManager(str(tmp_path), world_size=2, rank=0,
+                           sharded=True)
+    assert m0.verify(2)
+
+
+def _serve_ps(srv):
+    from paddle_tpu.distributed.ps_server import _Handler, _TCPServer
+
+    tcp = _TCPServer(("127.0.0.1", 0), _Handler)
+    tcp.ps = srv
+    threading.Thread(target=tcp.serve_forever,
+                     kwargs={"poll_interval": 0.1}, daemon=True).start()
+    return tcp, f"127.0.0.1:{tcp.server_address[1]}"
+
+
+def test_doctor_repairs_corrupt_table_from_live_replica(tmp_path):
+    """A corrupt `<table>.pkl` shard is rebuilt from the partition
+    primaries via the existing fetch_replica_state path (R>=2)."""
+    from paddle_tpu.distributed import ps_server
+
+    srv0, srv1 = ps_server.PSServer(), ps_server.PSServer()
+    tcp0, ep0 = _serve_ps(srv0)
+    tcp1, ep1 = _serve_ps(srv1)
+    try:
+        eps = [ep0, ep1]
+        # partition p lives primary on server p, backup on the other
+        for p, (prim, back) in enumerate(((srv0, srv1), (srv1, srv0))):
+            spec = {"name": "emb", "shape": (8, 4), "seed": 3,
+                    "sync_trainers": 0, "generation": 0,
+                    "partition": p, "replicas": eps}
+            prim.create_table(dict(spec))
+            back.create_table(dict(spec))
+            prim.promote(f"emb@p{p}", epoch=1, backups=[eps[1 - p]])
+            prim.tables[f"emb@p{p}"].push_gradients(
+                np.arange(4, dtype=np.int64),
+                np.full((4, 4), 0.1 * (p + 1), np.float32))
+        states = [srv0.tables["emb@p0"].state_dict(),
+                  srv1.tables["emb@p1"].state_dict()]
+
+        # a committed checkpoint whose emb.pkl matches the live tables
+        d = tmp_path / "ckpt-00000003"
+        os.makedirs(d)
+        blobs = {
+            "state.pkl": pickle.dumps({"arrays": {}}),
+            "rng.pkl": pickle.dumps(None),
+            "extra.pkl": pickle.dumps({}),
+            "emb.pkl": pickle.dumps({"servers": states}),
+        }
+        for rel, blob in blobs.items():
+            (d / rel).write_bytes(blob)
+        manifest = {
+            "format": 1, "step": 3,
+            "files": {rel: {"sha256": hashlib.sha256(b).hexdigest(),
+                            "bytes": len(b)}
+                      for rel, b in sorted(blobs.items())},
+            "ps": {"tables": ["emb"], "generation": 0},
+        }
+        (d / "manifest.json").write_text(json.dumps(manifest, indent=1))
+        assert ckpt_doctor.scan_root(str(tmp_path))["newest_valid"] == 3
+
+        # bit-rot the table shard
+        blob = bytearray(blobs["emb.pkl"])
+        blob[len(blob) // 2] ^= 0xFF
+        (d / "emb.pkl").write_bytes(bytes(blob))
+        rep = ckpt_doctor.scan_root(str(tmp_path))
+        entry = rep["steps"][0]
+        assert entry["status"] == "corrupt"
+        assert entry["problems"] == [{"kind": "checksum",
+                                      "file": "emb.pkl"}]
+
+        repaired = ckpt_doctor.repair_root(str(tmp_path), eps, rep)
+        assert repaired == [str(d / "emb.pkl")]
+        rep2 = ckpt_doctor.scan_root(str(tmp_path))
+        assert rep2["steps"][0]["status"] == "ok"
+        with open(d / "emb.pkl", "rb") as f:
+            fixed = pickle.load(f)
+        for p in range(2):
+            for a, b in zip(fixed["servers"][p]["shards"],
+                            states[p]["shards"]):
+                np.testing.assert_array_equal(a, b)
+    finally:
+        for tcp in (tcp0, tcp1):
+            try:
+                tcp.shutdown()
+                tcp.close_all_connections()
+                tcp.server_close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# telemetry: gauges + the async checkpoint_write span
+# ---------------------------------------------------------------------------
+
+
+def test_ckpt_telemetry_gauges_and_counters(tmp_path):
+    from paddle_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    mgr = CheckpointManager(str(tmp_path), scope=_scope_with(np.ones(8)))
+    before = reg.counter("ckpt_bytes_written_total").value
+    mgr.save(1, async_=True)
+    mgr.drain()
+    assert reg.counter("ckpt_bytes_written_total").value > before
+    assert reg.gauge("ckpt_queue_depth").value == 0  # drained
+    assert reg.histogram("checkpoint_write_ms").summary()["count"] >= 1
+
+
+def test_checkpoint_write_span_parented_under_save(tmp_path, monkeypatch):
+    from paddle_tpu.telemetry import tracing
+
+    monkeypatch.setenv("PADDLE_TRACING", "1")
+    tracing._reset_for_tests()
+    try:
+        mgr = CheckpointManager(str(tmp_path),
+                                scope=_scope_with(np.ones(4)))
+        mgr.save(1, async_=True)
+        mgr.drain()
+        spans = tracing.finished_spans()
+        saves = [s for s in spans if s["name"] == "checkpoint_save"]
+        writes = [s for s in spans if s["name"] == "checkpoint_write"]
+        assert saves and writes
+        # the async write span joins the save's trace, parented under it
+        assert writes[-1]["parent"] == saves[-1]["span"]
+        assert writes[-1]["trace"] == saves[-1]["trace"]
+        assert writes[-1]["attrs"]["mode"] == "async"
+    finally:
+        monkeypatch.delenv("PADDLE_TRACING")
+        tracing._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# process layer — slow 2-rank sharded drill (kill between shard commit
+# and global commit)
+# ---------------------------------------------------------------------------
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    for k in ("PADDLE_PSERVERS_IP_PORT_LIST", "PADDLE_TRAINERS_NUM",
+              "PADDLE_PS_FAULT_SPEC", "FLAGS_ps_fault_injection",
+              "PADDLE_ELASTIC_RESTART", "PADDLE_CKPT_SHARDED",
+              "PADDLE_CKPT_ASYNC", "PADDLE_CKPT_BARRIER_ENDPOINT",
+              "PADDLE_PS_FAULT_TAGS", "PADDLE_TRAINER_ID"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.update(extra or {})
+    return env
+
+
+def _read_trace(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+@pytest.mark.slow
+def test_sharded_drill_kill_rank1_between_shard_and_global_commit(
+        tmp_path):
+    """Acceptance (CI lane): rank 1 dies between its shard commit and
+    the global commit — the step stays torn, restore picks the previous
+    global step, `ckpt_doctor --gc` removes the torn dir, and the
+    relaunched job resumes to a loss trace bit-identical to an
+    uninterrupted run's."""
+    # reference: one uninterrupted single-process run (both ranks train
+    # the same data, so each rank's trace must equal this)
+    ref = {
+        "CKPT_TEST_DIR": str(tmp_path / "ref_ck"),
+        "CKPT_TEST_TRACE": str(tmp_path / "ref_trace"),
+    }
+    r = subprocess.run([sys.executable, "-u", SHARD_WORKER],
+                       env=_env(ref), capture_output=True, text=True,
+                       timeout=300, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    ref_trace = {e["gs"]: e["loss"]
+                 for e in _read_trace(ref["CKPT_TEST_TRACE"] + ".0")}
+
+    root = str(tmp_path / "ck")
+    drill = {
+        "CKPT_TEST_DIR": root,
+        "CKPT_TEST_TRACE": str(tmp_path / "trace"),
+        "PADDLE_CKPT_SHARDED": "1",
+        "PADDLE_CKPT_BARRIER_TIMEOUT": "5",
+        "FLAGS_ps_fault_injection": "1",
+        # rank 1's SECOND save dies after its shard manifest landed,
+        # before the barrier report — the exact pre-global-commit window
+        "PADDLE_PS_FAULT_SPEC": "crash:ckpt_shard_committed:2",
+        "PADDLE_PS_FAULT_TAGS": "trainer1",
+    }
+    args = [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2",
+            "--log_dir", str(tmp_path / "logs"), SHARD_WORKER]
+    r = subprocess.run(args, env=_env(drill), capture_output=True,
+                       text=True, timeout=600, cwd=REPO)
+    assert r.returncode != 0, "rank-1 kill must abort the first attempt"
+
+    # the interrupted step is torn (shard manifests, no global
+    # manifest); restore falls back to the previous global step
+    mgr = CheckpointManager(root, world_size=2, rank=0, sharded=True)
+    committed = mgr.steps()
+    assert committed, "first global commit should have landed"
+    rep = ckpt_doctor.scan_root(root)
+    torn = [e for e in rep["steps"] if e["status"] == "torn"]
+    assert torn, "the killed save must leave a torn step dir"
+    assert all(e["step"] > max(committed) for e in torn)
+    assert rep["newest_valid"] == max(committed)
+
+    # the doctor GCs the torn dir (CLI form, like an operator would)
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "ckpt_doctor.py"),
+                        root, "--gc"], env=_env(), capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    for e in torn:
+        assert not os.path.exists(e["path"])
+
+    # relaunch without the fault: resumes from the last global step and
+    # finishes; every rank's concatenated trace equals the reference
+    resume = {k: v for k, v in drill.items()
+              if not k.startswith(("PADDLE_PS_FAULT",
+                                   "FLAGS_ps_fault"))}
+    r = subprocess.run(args, env=_env(resume), capture_output=True,
+                       text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    for rank in (0, 1):
+        by_gs = {}
+        for e in _read_trace(f"{tmp_path}/trace.{rank}"):
+            if e["gs"] in by_gs:  # a replayed step must replay EXACTLY
+                assert by_gs[e["gs"]] == e["loss"], (rank, e)
+            by_gs[e["gs"]] = e["loss"]
+        assert by_gs == ref_trace, f"rank {rank} trace diverged"

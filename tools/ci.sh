@@ -66,6 +66,16 @@ echo "== preemption drill (SIGTERM mid-training -> resume, exact trace) =="
 # (tests/test_checkpoint.py, the @slow process drills)
 python -m pytest tests/test_checkpoint.py -q -m slow
 
+echo "== async/sharded checkpoint drill (kill rank 1 pre-global-commit) =="
+# ISSUE 10 acceptance: a 2-rank sharded-checkpoint job loses rank 1
+# between its shard commit and the global commit — the step must stay
+# TORN (invisible to restore, which serves the previous global step),
+# `ckpt_doctor --gc` must remove the torn dir, and the relaunched job
+# must resume to a loss trace bit-identical to an uninterrupted run's.
+# The fast async/coalesce/fault-matrix/doctor units run in tier-1 above
+# (tests/test_checkpoint_async.py)
+python -m pytest tests/test_checkpoint_async.py -q -m slow
+
 echo "== telemetry smoke (3-step CPU train, JSONL schema + monotone steps) =="
 # ISSUE 4 acceptance: a metrics-armed run must emit one kind="step"
 # record per executor step with the breakdown keys, monotone in step;

@@ -1,0 +1,389 @@
+#!/usr/bin/env python
+"""ckpt_doctor — fsck for CheckpointManager roots (fluid/checkpoint.py).
+
+Walks a checkpoint root, verifies the manifest chain of every step —
+single-writer manifests, and for sharded layouts the global manifest,
+every shard's manifest sha256, and every content file's size + sha256 —
+and classifies each step dir:
+
+  OK       fully committed and every checksum matches
+  TORN     never committed: no (global) manifest — a crash between the
+           content writes and the commit point, or between two ranks'
+           shard commits. Invisible to restore() by construction.
+  CORRUPT  committed but failing verification (bit rot, short write):
+           restore() skips it with a warning.
+
+plus ORPHANS: stray `.tmp-ckpt-*` work dirs and `rank<k>/` shard dirs a
+global manifest does not list (leftovers of an elastic resize or a
+superseded save).
+
+  --gc      remove torn dirs, orphans, and corrupt dirs that a newer or
+            equal OK step supersedes (the newest data on disk is never
+            deleted, even when it is corrupt — repair it instead)
+  --repair  re-fetch a corrupt PS-table shard (`<table>.pkl`) from a
+            live replica (replication R>=2) via the primary's
+            `fetch_replica_state` RPC, rewrite the file, and re-commit
+            the manifest (and global-manifest shard sha) around it
+  --json    machine-readable report
+
+Endpoints for --repair come from --endpoints or
+PADDLE_PSERVERS_IP_PORT_LIST. Exit status: 0 when every remaining step
+is OK, 1 otherwise.
+
+Run it offline (no writer active on the root): --gc removing a torn dir
+that an in-flight save is still building would erase work in progress.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import pickle
+import re
+import shutil
+import sys
+from typing import Dict, List, Optional
+
+MANIFEST = "manifest.json"
+GLOBAL_MANIFEST = "global_manifest.json"
+MANIFEST_FORMAT = 1
+_DIR_RE = re.compile(r"^ckpt-(\d+)$")
+_TMP_RE = re.compile(r"^\.tmp-ckpt-(\d+)-(?:r\d+-)?(\d+)$")
+_RANK_RE = re.compile(r"^rank(\d+)$")
+# core content files a repair must never synthesize from a PS replica
+_CORE_FILES = ("state.pkl", "rng.pkl", "extra.pkl")
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _read_manifest(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        return m if m.get("format") == MANIFEST_FORMAT else None
+    except (OSError, ValueError):
+        return None
+
+
+def _atomic_write(path: str, blob: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _check_files(d: str, files: Dict[str, dict], rel_prefix: str,
+                 problems: List[dict]) -> None:
+    for rel, meta in sorted(files.items()):
+        p = os.path.join(d, rel)
+        label = rel_prefix + rel
+        if not os.path.exists(p):
+            problems.append({"kind": "missing", "file": label})
+            continue
+        if os.path.getsize(p) != meta["bytes"]:
+            problems.append({"kind": "size", "file": label})
+            continue
+        if _sha256_file(p) != meta["sha256"]:
+            problems.append({"kind": "checksum", "file": label})
+
+
+def _scan_step(path: str, step: int) -> dict:
+    entry = {"step": step, "path": path, "sharded": False,
+             "status": "ok", "problems": [], "orphan_shards": []}
+    problems: List[dict] = entry["problems"]
+
+    gm = _read_manifest(os.path.join(path, GLOBAL_MANIFEST))
+    rank_dirs = sorted(n for n in os.listdir(path) if _RANK_RE.match(n))
+    if gm is not None:
+        entry["sharded"] = True
+        shards = gm.get("shards") or {}
+        if len(shards) != int(gm.get("world_size") or 0):
+            problems.append({"kind": "shard_count",
+                             "file": GLOBAL_MANIFEST})
+        for rname in sorted(shards):
+            info = shards[rname]
+            man_path = os.path.join(path, rname, MANIFEST)
+            try:
+                with open(man_path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                problems.append({"kind": "missing",
+                                 "file": f"{rname}/{MANIFEST}"})
+                continue
+            if hashlib.sha256(blob).hexdigest() != \
+                    info.get("manifest_sha256"):
+                problems.append({"kind": "manifest_sha",
+                                 "file": f"{rname}/{MANIFEST}"})
+                continue
+            m = _read_manifest(man_path)
+            if m is None:
+                problems.append({"kind": "unparseable",
+                                 "file": f"{rname}/{MANIFEST}"})
+                continue
+            _check_files(os.path.join(path, rname), m.get("files", {}),
+                         f"{rname}/", problems)
+        entry["orphan_shards"] = [
+            os.path.join(path, n) for n in rank_dirs
+            if n not in shards]
+        entry["status"] = "corrupt" if problems else "ok"
+        return entry
+
+    if rank_dirs:
+        # sharded layout without a global manifest: torn by definition
+        entry["sharded"] = True
+        entry["status"] = "torn"
+        return entry
+
+    m = _read_manifest(os.path.join(path, MANIFEST))
+    if m is None:
+        entry["status"] = "torn"
+        return entry
+    _check_files(path, m.get("files", {}), "", problems)
+    entry["status"] = "corrupt" if problems else "ok"
+    return entry
+
+
+def scan_root(root: str) -> dict:
+    """Classify every step dir + orphan under `root`."""
+    root = os.path.abspath(root)
+    steps, orphans = [], []
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        m = _DIR_RE.match(name)
+        if m:
+            steps.append(_scan_step(path, int(m.group(1))))
+        elif _TMP_RE.match(name):
+            orphans.append(path)
+    ok = [e["step"] for e in steps if e["status"] == "ok"]
+    return {"root": root, "steps": steps, "orphans": orphans,
+            "newest_valid": max(ok) if ok else None}
+
+
+def gc_root(root: str, report: Optional[dict] = None) -> List[str]:
+    """Remove torn dirs, orphans (tmp work dirs + unlisted shard dirs),
+    and corrupt dirs superseded by a >= OK step. The newest data on
+    disk survives: a corrupt step NEWER than every OK step is reported,
+    not deleted — --repair it."""
+    report = report if report is not None else scan_root(root)
+    newest_ok = report["newest_valid"]
+    removed: List[str] = []
+    for e in report["steps"]:
+        if e["status"] == "torn":
+            shutil.rmtree(e["path"], ignore_errors=True)
+            removed.append(e["path"])
+        elif e["status"] == "corrupt" and newest_ok is not None \
+                and e["step"] <= newest_ok:
+            shutil.rmtree(e["path"], ignore_errors=True)
+            removed.append(e["path"])
+        else:
+            for p in e["orphan_shards"]:
+                shutil.rmtree(p, ignore_errors=True)
+                removed.append(p)
+    for p in report["orphans"]:
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# repair: corrupt PS-table shard <- live replica (fetch_replica_state)
+# ---------------------------------------------------------------------------
+
+
+def _fetch_table_state(name: str, endpoints: List[str]):
+    """Pull every partition's state from the cluster: for partition p,
+    ask each endpoint for `fetch_replica_state(name@p<p>, have_seq=-1)`
+    — the explicit full-transfer demand the anti-entropy rejoin path
+    uses — until one answers as that partition's primary. Returns the
+    per-partition state list, or None when any partition has no live
+    primary."""
+    from paddle_tpu.distributed.ps_server import _Conn
+
+    states = []
+    for p in range(len(endpoints)):
+        got = None
+        for ep in endpoints:
+            try:
+                conn = _Conn(ep, deadline=5.0, io_timeout=10.0)
+                try:
+                    out = conn.call("fetch_replica_state",
+                                    key=f"{name}@p{p}", have_seq=-1)
+                finally:
+                    conn.close()
+            except Exception:  # noqa: BLE001 — not primary / dead: next
+                continue
+            if isinstance(out, dict) and "state" in out:
+                got = out["state"]
+                break
+        if got is None:
+            return None
+        states.append(got)
+    return states
+
+
+def _recommit_manifest(step_path: str, shard_rel: Optional[str],
+                       manifest: dict) -> None:
+    """Rewrite a (shard) manifest atomically; for sharded layouts also
+    update the global manifest's recorded shard sha256 — the repaired
+    checkpoint must verify end to end."""
+    d = os.path.join(step_path, shard_rel) if shard_rel else step_path
+    blob = json.dumps(manifest, indent=1).encode()
+    _atomic_write(os.path.join(d, MANIFEST), blob)
+    if shard_rel:
+        gm_path = os.path.join(step_path, GLOBAL_MANIFEST)
+        gm = _read_manifest(gm_path)
+        if gm is not None and shard_rel in (gm.get("shards") or {}):
+            gm["shards"][shard_rel]["manifest_sha256"] = \
+                hashlib.sha256(blob).hexdigest()
+            _atomic_write(gm_path, json.dumps(gm, indent=1).encode())
+
+
+def repair_root(root: str, endpoints: List[str],
+                report: Optional[dict] = None) -> List[str]:
+    """Repair corrupt `<table>.pkl` shards from live replicas. Only
+    PS-table files are repairable this way — scope state (state.pkl,
+    rng.pkl, extra.pkl) exists nowhere else. Returns repaired paths."""
+    report = report if report is not None else scan_root(root)
+    repaired: List[str] = []
+    for e in report["steps"]:
+        if e["status"] != "corrupt":
+            continue
+        for prob in list(e["problems"]):
+            rel = prob.get("file", "")
+            base = os.path.basename(rel)
+            if not base.endswith(".pkl") or base in _CORE_FILES:
+                continue
+            name = base[:-4]
+            states = _fetch_table_state(name, endpoints)
+            if states is None:
+                print(f"[ckpt_doctor] no live primary answered for "
+                      f"table {name!r}; cannot repair {rel}",
+                      file=sys.stderr)
+                continue
+            path = os.path.join(e["path"], rel)
+            shard_rel = os.path.dirname(rel) or None
+            man_dir = os.path.join(e["path"], shard_rel) \
+                if shard_rel else e["path"]
+            manifest = _read_manifest(os.path.join(man_dir, MANIFEST))
+            if manifest is None or rel.split("/")[-1] not in \
+                    manifest.get("files", {}):
+                continue
+            # preserve the checkpoint's on-disk format: a trainer-side
+            # RemoteTable state is {"servers": [...]}; a local table's
+            # is the bare state dict (only meaningful with 1 partition)
+            try:
+                with open(path, "rb") as f:
+                    orig = pickle.load(f)
+                servers_fmt = isinstance(orig, dict) and "servers" in orig
+            except Exception:  # noqa: BLE001 — torn pickle
+                servers_fmt = len(endpoints) > 1
+            state = {"servers": states} if servers_fmt else states[0]
+            blob = pickle.dumps(state)
+            _atomic_write(path, blob)
+            manifest["files"][base] = {
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "bytes": len(blob)}
+            _recommit_manifest(e["path"], shard_rel, manifest)
+            repaired.append(path)
+            print(f"[ckpt_doctor] repaired {rel} in "
+                  f"{os.path.basename(e['path'])} from a live replica",
+                  file=sys.stderr)
+    return repaired
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _print_report(report: dict) -> None:
+    print(f"ckpt_doctor: {report['root']}")
+    for e in report["steps"]:
+        tag = e["status"].upper()
+        extra = ""
+        if e["sharded"]:
+            gm = _read_manifest(os.path.join(e["path"], GLOBAL_MANIFEST))
+            n = len((gm or {}).get("shards") or {})
+            extra = f" (sharded, {n} shards)" if gm else " (sharded)"
+        print(f"  {os.path.basename(e['path'])}  {tag:8s}{extra}")
+        for prob in e["problems"]:
+            print(f"    {prob['kind']}: {prob['file']}")
+        for p in e["orphan_shards"]:
+            print(f"    orphan shard: {os.path.basename(p)}")
+    for p in report["orphans"]:
+        print(f"  orphan: {os.path.basename(p)}")
+    nv = report["newest_valid"]
+    print(f"newest valid: "
+          f"{('ckpt-%08d' % nv) if nv is not None else 'NONE'}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt_doctor",
+        description="verify / gc / repair CheckpointManager roots")
+    ap.add_argument("root", help="checkpoint root directory")
+    ap.add_argument("--gc", action="store_true",
+                    help="remove torn/orphaned dirs and superseded "
+                         "corrupt ones")
+    ap.add_argument("--repair", action="store_true",
+                    help="re-fetch corrupt PS-table shards from live "
+                         "replicas (needs --endpoints or "
+                         "PADDLE_PSERVERS_IP_PORT_LIST)")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated pserver endpoints for --repair")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.root):
+        print(f"ckpt_doctor: {args.root!r} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    report = scan_root(args.root)
+    actions = {}
+    if args.repair:
+        eps = [e.strip() for e in
+               (args.endpoints
+                or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+                ).split(",") if e.strip()]
+        if not eps:
+            print("ckpt_doctor: --repair needs --endpoints or "
+                  "PADDLE_PSERVERS_IP_PORT_LIST", file=sys.stderr)
+            return 2
+        actions["repaired"] = repair_root(args.root, eps, report)
+        report = scan_root(args.root)  # re-verify after repair
+    if args.gc:
+        actions["removed"] = gc_root(args.root, report)
+        report = scan_root(args.root)
+
+    if args.as_json:
+        print(json.dumps(dict(report, **actions), indent=1))
+    else:
+        _print_report(report)
+        for k, paths in actions.items():
+            for p in paths:
+                print(f"{k}: {p}")
+
+    bad = [e for e in report["steps"] if e["status"] != "ok"
+           or e["orphan_shards"]]
+    return 1 if (bad or report["orphans"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
