@@ -125,6 +125,34 @@ def _persist_cost_report(rep, model) -> None:
         print(f"# proftop report persist failed: {e}", file=sys.stderr)
 
 
+def _memory_fields(exe, program, data, loss, hbm_model_bytes=None):
+    """BENCH_r06+ rows record memory alongside MFU (ISSUE 11):
+    `peak_hbm_bytes` — XLA's buffer-assignment peak for the compiled
+    step (measured bytes, the raw form of the existing peak_hbm_gb) —
+    and `hbm_model_bytes` — params + optimizer state from the static
+    live-range attribution (telemetry/memory.py), i.e. the resident
+    floor a bigger batch cannot shrink. Best-effort: {} on backends
+    that cannot report."""
+    out = {}
+    try:
+        ma = exe.memory_analysis(program, feed=data, fetch_list=[loss])
+        out["peak_hbm_bytes"] = int(ma["peak_bytes"])
+    except Exception:  # noqa: BLE001 — diagnostics must not fail the bench
+        pass
+    try:
+        if hbm_model_bytes is None:
+            from paddle_tpu.telemetry import memory as _mem
+
+            rep = _mem.build_memory_report(
+                program, feed_shapes=data, fetch_names=[loss.name],
+                publish=False)
+            hbm_model_bytes = rep.static.model_bytes
+        out["hbm_model_bytes"] = int(hbm_model_bytes)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def _emit_result(result: dict) -> None:
     """Print THE one JSON result line (the bench contract) and publish
     the same row through the unified telemetry layer — a gauge per
@@ -136,7 +164,8 @@ def _emit_result(result: dict) -> None:
 
     reg = telemetry.get_registry()
     metric = str(result.get("metric", "bench"))
-    for key in ("value", "mfu", "peak_hbm_gb", "vs_baseline"):
+    for key in ("value", "mfu", "peak_hbm_gb", "peak_hbm_bytes",
+                "hbm_model_bytes", "vs_baseline"):
         v = result.get(key)
         if isinstance(v, (int, float)):
             reg.gauge(f"bench_{key}", metric=metric).set(v)
@@ -201,6 +230,7 @@ def bench_resnet(depth=50):
         "steps": steps,
         "amp_bf16": use_amp,
         "conv_bn_fusion": use_fusion,
+        **_memory_fields(exe, m, data, loss),
         **_maybe_op_profile(exe, m, data, loss, formula_flops,
                             f"resnet{depth}"),
     })
@@ -333,7 +363,9 @@ def main():
 
     batch = int(os.environ.get("BENCH_BATCH", 64))
     seq = int(os.environ.get("BENCH_SEQ", 512))
-    max_preds = 76
+    # 76 is the tracked-config value (s512); clamp for short --smoke
+    # sequences — more masked predictions than tokens cannot gather
+    max_preds = min(76, seq // 2)
     steps = int(os.environ.get("BENCH_STEPS", 30))
     use_amp = os.environ.get("BENCH_AMP", "1") == "1"
 
@@ -351,7 +383,8 @@ def main():
         "remat": out["remat"],
         "peak_hbm_gb": out["peak_hbm_gb"],
     }
-    for k in ("measured_mfu", "op_profile_coverage"):
+    for k in ("measured_mfu", "op_profile_coverage", "peak_hbm_bytes",
+              "hbm_model_bytes"):
         if k in out:
             result[k] = out[k]
     # long-context guard row (VERDICT r3: the s4096 config regressed with
@@ -442,12 +475,16 @@ def _run_bert(batch, seq, max_preds, steps, use_amp):
         k for k in ("remat_ffn", "remat_qkv", "remat_layer")
         if getattr(cfg, k)
     ) or "none"
+    mem_fields = _memory_fields(exe, m, data, loss)
+    if peak_gb is not None and "peak_hbm_bytes" not in mem_fields:
+        mem_fields["peak_hbm_bytes"] = int(peak_gb * 2**30)
     return {
         "tokens_per_sec": round(batch * seq * steps / dt, 1),
         "mfu": round(mfu, 4),
         "remat": remat_desc,
         "peak_hbm_gb": peak_gb if peak_gb is not None
         else _peak_hbm_gb(exe, m, data, loss),
+        **mem_fields,
         **_maybe_op_profile(exe, m, data, loss, formula_flops, "bert"),
     }
 

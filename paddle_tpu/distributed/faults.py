@@ -65,6 +65,12 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     global manifest not yet written): exercises the
                     torn-checkpoint fallback and the sharded
                     global-commit protocol in fluid/checkpoint.py
+            oom     phase side: raise a simulated RESOURCE_EXHAUSTED at
+                    the Nth arrival at a named executor memory phase
+                    ("compile", "run" — oom_point() call sites in
+                    fluid/executor.py), driving the OOM-doctor drill
+                    (telemetry/memory.py) deterministically on backends
+                    that never genuinely exhaust HBM
             io_err  phase side: raise OSError(EIO) at the Nth arrival at
                     a named WRITE phase (io_point(phase) call sites:
                     "ckpt_content", "ckpt_manifest",
@@ -131,7 +137,7 @@ ENV_TAGS = "PADDLE_PS_FAULT_TAGS"
 
 _CLIENT_ACTIONS = ("drop", "refuse", "delay", "stall")
 _SERVER_ACTIONS = ("kill", "slow", "partition")
-_PHASE_ACTIONS = ("crash",)
+_PHASE_ACTIONS = ("crash", "oom")
 # disk-fault rules: fire at named WRITE phases (io_point call sites in
 # the checkpoint commit protocol)
 _IO_ACTIONS = ("io_err", "short_write", "diskfull")
@@ -155,6 +161,13 @@ class FaultError(ConnectionError):
     """Raised by client-side `refuse`/`drop` rules; a subclass of
     ConnectionError so it flows through the exact retry path a real
     transport fault would take."""
+
+
+class SimulatedOOM(RuntimeError):
+    """Raised by `oom:<phase>:<nth>` rules: a deterministic stand-in
+    for the allocator's RESOURCE_EXHAUSTED (the message carries the
+    marker, so telemetry.memory.is_oom routes it through the exact OOM-
+    doctor path a real out-of-memory would take)."""
 
 
 class _Rule:
@@ -403,6 +416,21 @@ class FaultInjector:
                          ).encode())
         return short
 
+    # -- memory side -----------------------------------------------------
+    def at_oom_phase(self, phase: str) -> None:
+        """Consulted at the executor's named memory phases ("compile",
+        "run"): an `oom:<phase>:<nth>` rule raises a SimulatedOOM — a
+        message-compatible stand-in for the allocator's
+        RESOURCE_EXHAUSTED, so the OOM-doctor drill is deterministic on
+        backends (CPU) that never actually run out."""
+        for r in self._take(("oom",), phase):
+            os.write(2, (f"[faults] simulated OOM at phase {phase!r} "
+                         f"(rule oom:{r.method}:{r.nth})\n").encode())
+            raise SimulatedOOM(
+                f"RESOURCE_EXHAUSTED: fault injection — simulated HBM "
+                f"out of memory at phase {phase!r} "
+                f"(rule oom:{r.method}:{r.nth})")
+
     # -- phase side ------------------------------------------------------
     def at_phase(self, phase: str) -> None:
         for r in self._take(("crash",), phase):
@@ -454,6 +482,17 @@ def crash_point(phase: str) -> None:
     inj = injector()
     if inj is not None:
         inj.at_phase(phase)
+
+
+def oom_point(phase: str) -> None:
+    """Deterministic simulated-OOM site at the executor's named memory
+    phases ("compile", "run"): raises SimulatedOOM when an armed
+    `oom:<phase>:<nth>` rule matches — the OOM-doctor drill's trigger
+    on backends that never genuinely exhaust memory. One flag read when
+    the layer is off."""
+    inj = injector()
+    if inj is not None:
+        inj.at_oom_phase(phase)
 
 
 def io_point(phase: str) -> bool:

@@ -162,6 +162,30 @@ class ShardedHostTable:
     def nbytes(self) -> int:
         return sum(sh.nbytes for sh in self._shards)
 
+    def memory_stats(self) -> dict:
+        """Resident-memory accounting (ISSUE 11 satellite): the
+        capacity-planning row behind `stats`/`fleet.ps_stats()` and the
+        debugz /statusz ps_memory section. rows x row-width for the
+        value shards, plus the adagrad accumulator when present; the
+        dirty-row set is the incremental-snapshot overhead."""
+        shard_bytes = int(sum(sh.nbytes for sh in self._shards))
+        accum_bytes = int(sum(a.nbytes for a in self._accum
+                              if a is not None))
+        # CPython set-of-int overhead is roughly 32B/entry + the ints;
+        # an estimate is all capacity planning needs
+        dirty = sum(len(d) for d in self._dirty)
+        return {
+            "rows": self.rows,
+            "dim": self.dim,
+            "dtype": str(self.dtype),
+            "num_shards": self.num_shards,
+            "shard_bytes": shard_bytes,
+            "accum_bytes": accum_bytes,
+            "dirty_rows": dirty,
+            "dirty_overhead_bytes": dirty * 64,
+            "resident_bytes": shard_bytes + accum_bytes + dirty * 64,
+        }
+
     def to_dense(self) -> np.ndarray:
         """Materialize the full table (tests/checkpoints only — defeats
         the purpose in a real run)."""

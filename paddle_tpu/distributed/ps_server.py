@@ -324,6 +324,25 @@ class _SyncState:
         self.reset = False  # generation bumped: stale waiters fail fast
 
 
+def _payload_nbytes(obj) -> int:
+    """Recursive resident-byte estimate for RPC payload shapes (numpy
+    arrays dominate; containers add their members). Used by the replog
+    ring and table memory accounting — an estimate, not an audit."""
+    if obj is None:
+        return 0
+    nb = getattr(obj, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(obj, (list, tuple, set)):
+        return sum(_payload_nbytes(x) for x in obj)
+    if isinstance(obj, dict):
+        return sum(_payload_nbytes(k) + _payload_nbytes(v)
+                   for k, v in obj.items())
+    if isinstance(obj, (bytes, bytearray, str)):
+        return len(obj)
+    return 8  # ints/floats/bools: pointer-ish
+
+
 class _ReplicaState:
     """Per-hosted-partition replication state (only exists for tables
     created with a `partition` in their spec, i.e. R>1).
@@ -360,6 +379,14 @@ class _ReplicaState:
                 "backups": list(self.backups),
                 "dropped": dict(self.dropped),
             }
+
+    def log_bytes(self) -> int:
+        """Estimated resident bytes of the replication log ring — part
+        of the table's memory accounting (ISSUE 11): each entry holds
+        the applied write's ids + payload arrays until the ring evicts
+        it, which on a hot table is REPLOG_KEEP rounds of traffic."""
+        with self.lock:
+            return sum(_payload_nbytes(e) for e in self.log)
 
 
 class PSServer:
@@ -1101,8 +1128,12 @@ class PSServer:
             # (when a name is given) + this server process's telemetry
             # registry slice — per-verb latency histogram summaries,
             # retry/replay-dedup counters, bytes in/out; replicated
-            # partitions add their role/epoch/seq/backup-lag state
-            out = {"server": server_telemetry()}
+            # partitions add their role/epoch/seq/backup-lag state.
+            # `memory` (ISSUE 11) is this process's per-hosted-table
+            # resident-byte accounting — rows x row width + optimizer
+            # accumulators + the replication log ring
+            out = {"server": server_telemetry(),
+                   "memory": self.memory_stats()}
             name = kwargs.get("name")
             if name:
                 key = _table_key(name, part)
@@ -1144,6 +1175,29 @@ class PSServer:
             self.shutdown_event.set()
             return 0
         raise ValueError(f"unknown PS method {method!r}")
+
+    def memory_stats(self) -> dict:
+        """Per-hosted-table-key resident bytes (ISSUE 11 satellite):
+        value shards + optimizer accumulators + dirty-set overhead, and
+        for replicated partitions the replication log ring — the
+        pserver-process capacity-planning row the `stats` verb carries
+        and fleet.ps_stats() / debugz /statusz surface."""
+        with self.lock:
+            items = list(self.tables.items())
+            reps = dict(self.replicas)
+        out = {}
+        total = 0
+        for key, t in items:
+            row = t.memory_stats()
+            rs = reps.get(key)
+            if rs is not None:
+                row["replog_bytes"] = rs.log_bytes()
+                row["replog_entries"] = len(rs.log)
+                row["resident_bytes"] += row["replog_bytes"]
+            total += row["resident_bytes"]
+            out[key] = row
+        out["total_resident_bytes"] = total
+        return out
 
     # -- snapshots --------------------------------------------------------
 
@@ -2310,15 +2364,41 @@ class RemoteTable:
         hedge counters) so one call shows both ends of the data plane."""
         agg = {"push_calls": 0, "pushed_bytes": 0, "servers": [],
                "client": client_telemetry()}
+        parts: dict = {}
         for s in range(self._n):
             st = self._call(s, "stats", name=self.name)
             agg["push_calls"] += st["push_calls"]
             agg["pushed_bytes"] += st["pushed_bytes"]
             agg["servers"].append(st.get("server", {}))
+            # per-partition resident bytes (ISSUE 11): this table's key
+            # slice of the answering server's memory accounting (a
+            # pserver may host other tables — only ours counts), one
+            # row per partition KEY (replica copies are partition-
+            # identical by construction, so dedup by key is exact for
+            # the value shards and an estimate for the replog ring)
+            for key, row in (st.get("memory") or {}).items():
+                if key == self.name or str(key).startswith(
+                        self.name + "@p"):
+                    parts[key] = row
+        resident = sum(int(r.get("resident_bytes", 0))
+                       for r in parts.values())
+        agg["memory"] = {
+            "partitions": parts,
+            "resident_bytes": resident,
+            # cluster-wide estimate: every partition keeps R copies
+            "replicated_resident_bytes": resident
+            * max(1, self.replication),
+        }
         if self.replication > 1:
             agg["replication"] = {"factor": self.replication,
                                   "partitions": self.replica_status()}
         return agg
+
+    def memory_stats(self) -> dict:
+        """Aggregated resident-byte accounting for this table across
+        its pservers (the `stats` verb's memory section filtered to
+        this table's partitions) — the debugz /statusz ps_memory row."""
+        return self.stats()["memory"]
 
     def replica_status(self) -> List[dict]:
         """Per-partition replica states (role, epoch, last-applied seq,

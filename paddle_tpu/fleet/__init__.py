@@ -101,7 +101,10 @@ def ps_stats(table_name: Optional[str] = None) -> dict:
     traffic counters. Replicated tables (PADDLE_PS_REPLICATION > 1) add
     a "replication" section — factor plus each partition's replica
     roles, epochs, last-applied seqs and lag (ISSUE 7), the same view
-    debugz /statusz serves as ps_replication.
+    debugz /statusz serves as ps_replication. Every table also carries
+    a "memory" section (ISSUE 11): per-partition resident bytes
+    (rows x row width + optimizer accumulators + the replication log
+    ring) — the capacity-planning signal /statusz serves as ps_memory.
 
     table_name names one registered table; None reports every table
     this process created. Hosted tables (RemoteTable) fan the verb out
@@ -119,9 +122,12 @@ def ps_stats(table_name: Optional[str] = None) -> dict:
         if hasattr(target, "stats"):
             out[n] = target.stats()
         else:  # in-process ShardedHostTable
+            mem = target.memory_stats()
             out[n] = {"push_calls": target.push_calls,
                       "pushed_bytes": target.pushed_bytes,
-                      "servers": []}
+                      "servers": [],
+                      "memory": {"partitions": {n: mem},
+                                 "resident_bytes": mem["resident_bytes"]}}
     return out
 
 

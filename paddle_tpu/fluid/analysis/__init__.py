@@ -33,6 +33,12 @@ Check catalog (registered name -> module):
   grad-integrity, grad-shape-mirror                   analysis/gradcheck.py
   subblock-persistable-write, subblock-rng            analysis/structure.py
   device-stage                                        analysis/structure.py
+
+Beyond the checks, the package hosts the static LIVE-RANGE pass
+(analysis/liverange.py, ISSUE 11): first-def/last-use and byte size per
+Variable, peak simultaneous-bytes estimate with donation awareness, and
+the params/optimizer-state/gradients/feeds/activations categorization
+that telemetry/memory.py, the OOM doctor and tools/memtop.py consume.
 """
 from .core import (  # noqa: F401
     ERROR,
@@ -51,6 +57,11 @@ from .core import (  # noqa: F401
     walk_blocks,
 )
 from .sandwich import pass_sandwich  # noqa: F401
+from .liverange import (  # noqa: F401
+    BufferInfo,
+    LiveRangeAnalysis,
+    analyze_live_ranges,
+)
 
 # importing the check modules registers their checks with core
 from . import dataflow, gradcheck, structure, typecheck  # noqa: F401,E402

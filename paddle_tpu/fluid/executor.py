@@ -203,9 +203,25 @@ class Executor:
         bench = flag("FLAGS_benchmark")
         t_dev = _time.perf_counter() if rec is not None else 0.0
         with RecordEvent("Executor::run"), _tracing.span("device"):
-            fetches, new_state, new_key = compiled.fn(
-                feed_arrays, donated, kept, scope._rng_key
-            )
+            try:
+                from ..distributed.faults import oom_point
+
+                oom_point("run")
+                fetches, new_state, new_key = compiled.fn(
+                    feed_arrays, donated, kept, scope._rng_key
+                )
+            except Exception as e:
+                from ..telemetry import memory as _memory
+
+                if not isinstance(e, _memory.HBMOOMError) \
+                        and _memory.is_oom(e):
+                    # allocator OOM mid-step (jit compiles lazily, so a
+                    # first-call compile OOM lands here too): the OOM
+                    # doctor dumps the memory flight-record and raises
+                    # with the culprit buffer + what-ifs attached
+                    _memory.raise_oom(program, feed_arrays, phase="run",
+                                      error=e)
+                raise
             if rec is not None and bench:
                 # honest device time needs a fence; gated on the same
                 # FLAGS_benchmark that already syncs below, so telemetry
@@ -345,15 +361,38 @@ class Executor:
             # list, flag toggle) — the shape-instability tax telemetry
             # counts separately from first compiles
             retrace = any(k[0] == program._serial for k in self._cache)
+            # memory observability (ISSUE 11): FLAGS_mem_profile runs
+            # the static live-range pass and publishes /memz + gauges;
+            # PADDLE_HBM_BUDGET_BYTES gates the static estimate BEFORE
+            # paying (or failing) the XLA compile. Flag-off + env-unset
+            # cost: one flag read + one env read on a cache miss.
+            from ..telemetry import memory as _memory
+
+            _memory.on_compile(program, feed_arrays, fetch_names)
             import time as _time
 
             t0 = _time.perf_counter()
-            with RecordEvent("Executor::compile"), \
-                    _tracing.span("compile", attrs={"retrace": retrace}):
-                compiled = self._compile(
-                    program, block, sorted(feed_arrays), fetch_names, scope,
-                    donate=not no_donate,
-                )
+            try:
+                with RecordEvent("Executor::compile"), \
+                        _tracing.span("compile",
+                                      attrs={"retrace": retrace}):
+                    from ..distributed.faults import oom_point
+
+                    oom_point("compile")
+                    compiled = self._compile(
+                        program, block, sorted(feed_arrays), fetch_names,
+                        scope, donate=not no_donate,
+                    )
+            except _memory.HBMOOMError:
+                raise
+            except Exception as e:
+                if _memory.is_oom(e):
+                    # OOM doctor: XLA refused at buffer assignment —
+                    # dump the memory flight-record naming the largest
+                    # live buffers + what-ifs, then raise enriched
+                    _memory.raise_oom(program, feed_arrays,
+                                      phase="compile", error=e)
+                raise
             monitor.record_compile((_time.perf_counter() - t0) * 1e3,
                                    retrace)
             self._cache[key] = compiled

@@ -101,6 +101,20 @@ _DEFS: Dict[str, tuple] = {
                "to Program IR ops (+ user callstacks). The flag is part "
                "of the compile-cache key; off = the traced computation is "
                "bit-identical to a build without the layer"),
+    "FLAGS_mem_profile": (
+        False, "per-op HBM attribution (telemetry/memory.py): on every "
+               "compile-cache miss the static live-range pass "
+               "(fluid/analysis/liverange.py) computes per-variable "
+               "byte sizes, first-def/last-use ranges and the peak "
+               "simultaneous-bytes estimate, publishes the "
+               "hbm_* gauges and the debugz /memz report, and emits a "
+               "kind=\"mem_report\" sink record. Host-only analysis — "
+               "NOT in the compile-cache key (the traced computation is "
+               "unchanged); off = one flag read per compile miss and "
+               "step records / wire bytes / loss trace are "
+               "bit-identical. The OOM doctor and the "
+               "PADDLE_HBM_BUDGET_BYTES gate work independently of "
+               "this flag; tools/memtop.py is the CLI"),
     "FLAGS_dataloader_require_spawn": (
         False, "fluid/dataloader: raise instead of warning when worker "
                "args are unpicklable and the loader would fall back to "

@@ -20,8 +20,10 @@ executor's seams:
   fetch_ms       device->host conversion of the fetch list
   ckpt_save_ms   CheckpointManager.save durations (attached to the next
                  committed step record)
-  peak_hbm_bytes device allocator high-water (jax memory_stats; 0 where
-                 the backend reports none, e.g. CPU)
+  peak_hbm_bytes device allocator high-water (jax memory_stats), the
+                 MAX across all local devices — per-device values land
+                 in the device_peak_hbm_bytes{device=...} gauges and
+                 debugz /memz; 0 where the backend reports none (CPU)
 
 Cost contract: with PADDLE_METRICS_PATH unset nothing here touches the
 filesystem or fences the device; the always-on residue is a handful of
@@ -201,19 +203,45 @@ def timed_iter(iterable):
         yield v
 
 
-def peak_hbm_bytes() -> int:
-    """Device allocator high-water mark (jax memory_stats). 0 when the
-    backend reports nothing (CPU)."""
+def device_memory_stats() -> list:
+    """Per-LOCAL-device allocator stats: one dict per device with the
+    high-water mark, current usage and the allocator limit where the
+    backend reports them (TPU; CPU reports nothing and yields zeros).
+    The multi-chip truth behind peak_hbm_bytes — a mesh spanning >1
+    local chip has one high-water PER DEVICE, and "does it fit" is a
+    per-device question (debugz /memz serves this list live)."""
+    out = []
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats()
-        if stats:
-            return int(stats.get("peak_bytes_in_use")
-                       or stats.get("bytes_in_use") or 0)
+        for i, d in enumerate(jax.local_devices()):
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — backend may not report
+                stats = {}
+            out.append({
+                "device": i,
+                "kind": getattr(d, "device_kind", "?"),
+                "peak_bytes": int(stats.get("peak_bytes_in_use")
+                                  or stats.get("bytes_in_use") or 0),
+                "bytes_in_use": int(stats.get("bytes_in_use") or 0),
+                "bytes_limit": int(stats.get("bytes_limit") or 0),
+            })
     except Exception:  # noqa: BLE001 — diagnostics never fail the step
         pass
-    return 0
+    return out
+
+
+def peak_hbm_bytes() -> int:
+    """Device allocator high-water mark — the MAX across all local
+    devices (jax memory_stats). The old scalar name and schema are kept
+    for compatibility; before ISSUE 11 this read local_devices()[0]
+    only, which under-reported the moment a mesh spanned >1 chip
+    (device 0 is not necessarily the fullest). 0 when the backend
+    reports nothing (CPU). Per-device values: device_memory_stats()
+    and the device_peak_hbm_bytes{device=...} gauges."""
+    stats = device_memory_stats()
+    return max((d["peak_bytes"] for d in stats), default=0)
 
 
 def mark_step() -> int:
@@ -267,9 +295,17 @@ def commit_step(rec: Optional[StepRecord]) -> None:
         rec.ckpt_save_ms += _pending_ckpt_save_ms
         _pending_data_wait_ms = 0.0
         _pending_ckpt_save_ms = 0.0
-    peak = peak_hbm_bytes()
+    devs = device_memory_stats()
+    peak = max((d["peak_bytes"] for d in devs), default=0)
+    # the legacy scalar keeps its name (schema compatibility) but is now
+    # the MAX across local devices; per-device gauges carry the split
     _reg.gauge("peak_hbm_bytes",
-               help="device allocator high-water (bytes)").set(peak)
+               help="device allocator high-water (bytes, max over local "
+                    "devices)").set(peak)
+    for d in devs:
+        _reg.gauge("device_peak_hbm_bytes",
+                   help="per-device allocator high-water (bytes)",
+                   device=str(d["device"])).set(d["peak_bytes"])
     _reg.histogram("executor_device_ms",
                    help="compiled step call (fenced iff FLAGS_benchmark)"
                    ).observe(rec.device_ms)

@@ -12,8 +12,13 @@ Layering:
   telemetry.cost       per-op device-time attribution: xplane events
                        joined back to Program IR ops via FLAGS_op_profile
                        named scopes; CostReport + measured-MFU gauge
+  telemetry.memory     per-op / per-variable HBM attribution: the static
+                       live-range pass (fluid/analysis/liverange.py)
+                       joined against XLA's buffer assignment; /memz,
+                       the OOM doctor, memtop (FLAGS_mem_profile,
+                       PADDLE_HBM_BUDGET_BYTES)
   telemetry.debugz     introspection HTTP server (PADDLE_DEBUGZ_PORT):
-                       /metrics /statusz /steps /proftop /healthz
+                       /metrics /statusz /steps /proftop /memz /healthz
   telemetry.export     periodic push exporter (PADDLE_METRICS_PUSH_URL):
                        OTLP-shaped snapshot() JSON or pushgateway text;
                        span batches too (PADDLE_TRACES_PUSH_URL)
@@ -29,7 +34,16 @@ imports jax/protobuf inside functions for the same reason.
 """
 from __future__ import annotations
 
-from . import cost, debugz, export, sink, straggler, timeline, tracing  # noqa: F401
+from . import (  # noqa: F401
+    cost,
+    debugz,
+    export,
+    memory,
+    sink,
+    straggler,
+    timeline,
+    tracing,
+)
 from .registry import (  # noqa: F401
     BYTE_BUCKETS,
     DEFAULT_MS_BUCKETS,

@@ -13,6 +13,12 @@ sidecar, no log scraping:
              schema the PADDLE_METRICS_PATH JSONL sink writes)
   /proftop   last per-op cost report built in this process (JSON;
              404-shaped {} until telemetry.cost builds one)
+  /memz      memory observability (ISSUE 11): live per-device allocator
+             stats always, plus — once FLAGS_mem_profile (or memtop /
+             the bench hook) has built one — the last memory report:
+             per-category breakdown (params / optimizer_state /
+             gradients / feeds / activations), top-K buffers with user
+             callstacks, static-vs-measured peak, what-ifs (JSON)
   /tracez    recent causal traces from the span ring (PADDLE_TRACING),
              slowest-first with per-hop durations — the live view of
              what the flight recorder would dump (JSON)
@@ -52,6 +58,7 @@ FLAGZ_MUTABLE = (
     "FLAGS_check_numerics",
     "FLAGS_check_numerics_max_bad_steps",
     "FLAGS_check_nan_inf",
+    "FLAGS_mem_profile",
     "FLAGS_benchmark",
     "FLAGS_enable_unused_var_check",
     "PADDLE_STRAGGLER_FACTOR",
@@ -125,6 +132,22 @@ def _statusz() -> dict:
         out["ps_replication"] = reps or None
     except Exception:  # noqa: BLE001
         out["ps_replication"] = None
+    try:
+        # PS table memory (ISSUE 11 satellite): per-table resident bytes
+        # — the capacity-planning row. Hosted tables fan the `stats`
+        # verb out to their pservers; in-process tables report locally.
+        from ..distributed import ps as _ps
+
+        mem = {}
+        for name, t in list(_ps._tables.items()):
+            target = t if hasattr(t, "memory_stats") else getattr(
+                t, "server", None)
+            ms = getattr(target, "memory_stats", None)
+            if callable(ms):
+                mem[name] = ms()
+        out["ps_memory"] = mem or None
+    except Exception:  # noqa: BLE001
+        out["ps_memory"] = None
     try:
         # job control plane (ISSUE 8): the coordinator's membership
         # table — epoch, world size, per-member lease state — when the
@@ -223,6 +246,11 @@ def _route(path: str):
                                 "or telemetry.cost.profile_executor_run)"
                                 }).encode())
         return 200, "application/json", json.dumps(rep.to_json()).encode()
+    if path == "/memz":
+        from . import memory
+
+        return (200, "application/json",
+                json.dumps(memory.memz(), default=str).encode())
     if path == "/tracez":
         from . import tracing
 
@@ -234,7 +262,7 @@ def _route(path: str):
     if path in ("", "/", "/index.html"):
         return (200, "text/plain; charset=utf-8",
                 b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
-                b"/tracez /flagz /healthz\n")
+                b"/memz /tracez /flagz /healthz\n")
     return 404, "text/plain; charset=utf-8", b"not found\n"
 
 

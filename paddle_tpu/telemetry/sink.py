@@ -19,6 +19,12 @@ tools and tests parse it):
                   `ps` tag in the filename):
                   {"table": str, "mode": "sync"|"async"|"delta",
                    "step": int round/seq, "rows": int, "apply_ms": float}
+  kind="mem_report"  one static memory attribution (telemetry/memory.py,
+                  emitted per compile-cache miss under FLAGS_mem_profile
+                  and by explicit memtop/bench joins):
+                  {"model": str|null, "static_peak_bytes": int,
+                   "measured_peak_bytes": int|null, "model_bytes": int,
+                   "coverage": float|null, "categories": {category: int}}
 
 The sink is OFF (every emit a no-op costing one attribute read) unless
 PADDLE_METRICS_PATH is set or enable(path) is called — the flag-off hot

@@ -249,6 +249,9 @@ def test_op_profile_on_same_numerics():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~21s: the single heaviest tier-1 test, and ci.sh's
+# proftop smoke already asserts the same coverage/callstack/MFU bars on
+# resnet50 AND bert through this CLI — wall-time triage (870s gate)
 def test_proftop_cli_resnet18(capsys):
     proftop = _load_tool("proftop")
     rc = proftop.main(["--model", "resnet18", "--steps", "2",

@@ -140,6 +140,10 @@ JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50 --fuse --backward
 JAX_PLATFORMS=cpu python tools/proglint.py --model bert --backward
 
 echo "== proftop smoke (per-op device-time attribution + debugz) =="
+# slow-lane proftop/memtop CLI drills (wall-time triage: the resnet18
+# CLI tests are the heaviest in their suites and their acceptance bars
+# re-run below on resnet50 + bert anyway)
+python -m pytest tests/test_proftop.py -q -m slow
 # ISSUE 6 acceptance: a 3-step profiled CPU train (FLAGS_op_profile
 # named scopes -> xplane join) must attribute >=90% of device-op time
 # to named op scopes on BOTH bench models, every reported row must
@@ -203,6 +207,94 @@ steps = json.loads(urllib.request.urlopen(
 assert steps and steps[-1]["step"] >= steps[0]["step"]
 print(f"debugz OK: /metrics scraped ({len(scrape.splitlines())} lines), "
       f"{len(steps)} step records on /steps")
+PY
+
+echo "== memtop smoke (per-op HBM attribution + budget gate) =="
+# OOM-doctor subprocess drill (slow lane): a 1KB PADDLE_HBM_BUDGET_BYTES
+# must make the compile-time gate refuse the step and leave a memrec
+# flight-record naming the culprit buffer's owning op and user layer
+python -m pytest tests/test_memtop.py -q -m slow
+# ISSUE 11 acceptance: the measured join must attribute >=90% of XLA's
+# reported peak bytes to IR ops / named state with user callstacks, and
+# the static estimate must agree with the measured peak within the
+# documented tolerance; the --budget gate must round-trip (generous
+# budget -> rc 0, absurd budget -> rc 2 naming the overflow)
+JAX_PLATFORMS=cpu python tools/memtop.py --model resnet50 \
+  --image-size 32 --json > /tmp/ci_memtop_resnet50.json
+python - <<'PY'
+import json
+
+rep = json.load(open("/tmp/ci_memtop_resnet50.json"))
+assert rep["model"] == "resnet50"
+assert rep["coverage"] >= 0.9, rep["coverage"]
+assert rep["measured_peak_bytes"] > 0 and rep["static_peak_bytes"] > 0
+assert 0.3 <= rep["static_over_measured"] <= 3.0, rep["static_over_measured"]
+assert rep["buffers"], "no sized buffers"
+for row in rep["buffers"]:
+    assert row["bytes"] > 0 and row["layer"], (row["name"], "no callstack")
+cats = rep["categories"]
+assert cats["params"] > 0 and cats["gradients"] > 0
+print(f"memtop resnet50: coverage {rep['coverage']:.3f}, "
+      f"static/measured {rep['static_over_measured']:.2f}x, "
+      f"{len(rep['buffers'])} buffers")
+PY
+JAX_PLATFORMS=cpu python tools/memtop.py --model bert --static-only \
+  --budget 64000000000 --json > /dev/null \
+  || { echo "memtop: generous budget must pass"; exit 1; }
+if JAX_PLATFORMS=cpu python tools/memtop.py --model bert --static-only \
+  --budget 1000 --json > /tmp/ci_memtop_budget.json; then
+  echo "memtop: 1KB budget must exit nonzero"; exit 1
+fi
+python - <<'PY'
+import json
+
+rep = json.load(open("/tmp/ci_memtop_budget.json"))
+assert rep["over_budget"] is True and rep["budget_bytes"] == 1000
+print("memtop budget gate OK (rc 2, over_budget flagged)")
+PY
+# FLAGS_mem_profile end-to-end: a 3-step profiled resnet50 train must
+# publish the hbm_* gauges and one kind="mem_report" JSONL record per
+# compiled program, leaving the step-record schema untouched
+rm -f /tmp/ci_memprof.jsonl
+PADDLE_METRICS_PATH=/tmp/ci_memprof.jsonl FLAGS_mem_profile=1 \
+  JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+
+sys.path.insert(0, "tools")
+import numpy as np
+from proglint import build_bench_model
+
+import paddle_tpu.fluid as fluid
+
+main, startup, feeds, loss, cfg = build_bench_model(
+    "resnet50", 2, 32)
+with fluid.program_guard(main, startup):
+    fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.1, momentum=0.9).minimize(loss)
+exe = fluid.Executor()
+exe.run(startup)
+rng = np.random.RandomState(0)
+feed = {"image": rng.rand(2, 3, 32, 32).astype(np.float32),
+        "label": rng.randint(0, cfg.num_classes, (2, 1)).astype(np.int64)}
+for _ in range(3):
+    exe.run(main, feed=feed, fetch_list=[loss])
+from paddle_tpu.telemetry import get_registry
+
+assert get_registry().gauge("hbm_static_peak_bytes").value > 0
+assert get_registry().gauge("hbm_model_bytes").value > 0
+PY
+python - <<'PY'
+import json
+
+recs = [json.loads(l) for l in open("/tmp/ci_memprof.jsonl")]
+mems = [r for r in recs if r["kind"] == "mem_report"]
+steps = [r for r in recs if r["kind"] == "step"]
+assert mems, "FLAGS_mem_profile produced no mem_report record"
+assert mems[-1]["static_peak_bytes"] > 0
+assert mems[-1]["categories"]["params"] > 0
+assert steps and all("peak_hbm_bytes" in r for r in steps)
+print(f"mem_profile smoke OK: {len(mems)} mem_report record(s), "
+      f"step schema intact over {len(steps)} steps")
 PY
 
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
