@@ -67,6 +67,17 @@ class OptimizerWithMixedPrecision:
         if self._use_dynamic_loss_scaling:
             self._good_steps = persist(unique_name.generate("good_steps"), 0.0)
             self._bad_steps = persist(unique_name.generate("bad_steps"), 0.0)
+            # numerics observability (ISSUE 12): the scale var already
+            # rides the step's state outputs, so growth/backoff events
+            # become countable host-side without any graph change —
+            # numerics_amp_scale_{growths,backoffs}_total counters +
+            # kind="numerics" amp_scale sink records with step numbers
+            from ...telemetry import numerics as _numerics
+
+            _numerics.register_amp_scale(
+                self._loss_scaling.name,
+                good_name=self._good_steps.name,
+                bad_name=self._bad_steps.name)
 
     def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None, callbacks=None):
         program = loss.block.program
@@ -125,11 +136,19 @@ class OptimizerWithMixedPrecision:
                 found_inf = layers.logical_or(found_inf, bad)
                 new_pgs.append((p, g32))
             keep = layers.cast(layers.logical_not(found_inf), "float32")
+            zero = layers.fill_constant([1], "float32", 0.0)
             final = []
             for p, g in new_pgs:
                 if g is None:
                     final.append((p, g))
                     continue
+                # zero-on-overflow must SELECT, not multiply: inf * 0
+                # is NaN, so the old keep-multiply poisoned the params
+                # with NaN on the very overflow step it meant to skip
+                # (found while unifying the bad-step guard, ISSUE 12).
+                # where() drops the non-finite entries first; the keep
+                # factor then kills the rest of the overflowed step.
+                g = layers.where(layers.isfinite_v2(g), g, zero)
                 g = layers.elementwise_mul(g, layers.elementwise_mul(inv, keep))
                 final.append((p, g))
             if self._use_dynamic_loss_scaling:
@@ -169,6 +188,37 @@ class OptimizerWithMixedPrecision:
         )
         new_scale = layers.elementwise_mul(self._loss_scaling, factor)
         layers.assign(new_scale, self._loss_scaling)
+        from ...fluid.flags import flag as _flag
+
+        if _flag("FLAGS_check_numerics"):
+            # unified bad-step guard (ISSUE 12): FLAGS_check_numerics
+            # used to watch fp32 grads only while AMP kept its own
+            # zero-and-shrink protocol with no terminal condition. Here
+            # an overflow step that pushes the scale BELOW the floor
+            # (FLAGS_check_numerics_amp_scale_floor) means backoff is
+            # EXHAUSTED — the model produces non-finite values at any
+            # scale — so a check_numerics_bad_amp_* guard var trips,
+            # Executor.run raises BadStepError and the NaN-provenance
+            # doctor dumps a numrec for the AMP run too. Transient
+            # overflows (scale still above the floor) keep AMP's skip
+            # semantics: the guard stays 0 and training continues.
+            from ...fluid import unique_name as _un
+            from ...fluid.initializer import ConstantInitializer as _CI
+
+            floor = float(_flag("FLAGS_check_numerics_amp_scale_floor"))
+            floor_c = layers.fill_constant([1], "float32", floor)
+            exhausted = layers.logical_and(
+                found_inf, layers.less_than(new_scale, floor_c))
+            name = _un.generate("check_numerics_bad_amp")
+            main_block = framework.default_main_program().global_block()
+            guard = main_block.create_var(
+                name=name, shape=(1,), dtype="float32",
+                persistable=True, stop_gradient=True)
+            sblock = framework.default_startup_program().global_block()
+            sv = sblock.create_var(
+                name=name, shape=(1,), dtype="float32", persistable=True)
+            _CI(0.0)(sv, sblock)
+            layers.assign(layers.cast(exhausted, "float32"), guard)
         # reset counters when they fire
         layers.assign(
             layers.elementwise_mul(new_good, layers.scale(grow, scale=-1.0, bias=1.0)),

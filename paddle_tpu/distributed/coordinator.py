@@ -248,6 +248,14 @@ class Coordinator:
         self.lock = threading.RLock()
         self.shutdown_event = threading.Event()  # _Handler contract
         self.ckpt_barrier = CkptBarrier()
+        # cross-replica SDC detection (ISSUE 12): dp ranks publish
+        # params+merged-grad fingerprints every PADDLE_SDC_CHECK_EVERY
+        # steps; the table compares checksums and names the
+        # odd-rank-out (telemetry/numerics.py FingerprintTable)
+        from ..telemetry.numerics import FingerprintTable
+
+        self.fingerprints = FingerprintTable()
+        self._sdc_evicted: set = set()
 
     # -- internals -------------------------------------------------------
     def _event(self, **ev) -> None:
@@ -381,6 +389,42 @@ class Coordinator:
         with self.lock:
             out, self.events = list(self.events), deque(maxlen=512)
             return out
+
+    # -- cross-replica SDC detection (ISSUE 12) --------------------------
+    def numerics_report(self, tag: str, step: int, fingerprint: dict,
+                        world_size: int = 0) -> dict:
+        """One rank's params+merged-grad fingerprint for `step`. On a
+        checksum mismatch across ranks: one structured `divergence`
+        event naming the odd-rank-out, a counter, and — when
+        PADDLE_SDC_EVICT is set in the coordinator's process — the
+        corrupted rank is routed to the elastic eviction path exactly
+        like a host whose lease expired past its budget."""
+        out = self.fingerprints.record(step, tag, fingerprint,
+                                       world_size)
+        ev = out.get("event")
+        if ev is not None and not any(
+                e.get("event") == "divergence"
+                and e.get("step") == ev["step"]
+                for e in self.events):
+            with self.lock:
+                self._event(**ev)
+            _REG.counter("coordinator_sdc_divergence_total",
+                         help="SDC divergence events raised").inc()
+        if ev is not None and os.environ.get(
+                "PADDLE_SDC_EVICT", "") not in ("", "0", "false"):
+            for odd in ev.get("odd_rank_out") or []:
+                if odd in self._sdc_evicted:
+                    continue
+                self._sdc_evicted.add(odd)
+                # past-budget failure report = eviction + epoch bump:
+                # the launcher's next watch tick restarts the
+                # survivors without the corrupted rank
+                for _ in range(self.retries_per_rank + 1):
+                    self.report_failure(odd, reason="sdc_divergence")
+        return out
+
+    def numerics_status(self) -> dict:
+        return self.fingerprints.status()
 
     # -- lease sweep + pserver primary election --------------------------
     def sweep(self, now: Optional[float] = None) -> List[dict]:
@@ -518,6 +562,12 @@ class Coordinator:
         if method == "report_failure":
             return self.report_failure(kwargs["tag"],
                                        kwargs.get("reason", ""))
+        if method == "numerics_report":
+            return self.numerics_report(
+                kwargs["tag"], kwargs["step"], kwargs["fingerprint"],
+                kwargs.get("world_size", 0))
+        if method == "numerics_status":
+            return self.numerics_status()
         if method == "sweep":
             return self.sweep(kwargs.get("now"))
         if method == "events":
@@ -605,6 +655,17 @@ class CoordinatorClient:
 
     def membership(self) -> dict:
         return self._conn.call("membership")
+
+    def numerics_report(self, step: int, fingerprint: dict,
+                        world_size: int = 0) -> dict:
+        """Publish one SDC fingerprint (telemetry/numerics.SDCReporter
+        drives this on the PADDLE_SDC_CHECK_EVERY cadence)."""
+        return self._conn.call(
+            "numerics_report", tag=self.tag, step=step,
+            fingerprint=fingerprint, world_size=world_size)
+
+    def numerics_status(self) -> dict:
+        return self._conn.call("numerics_status")
 
     def close(self) -> None:
         self._conn.close()

@@ -65,6 +65,18 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     global manifest not yet written): exercises the
                     torn-checkpoint fallback and the sharded
                     global-commit protocol in fluid/checkpoint.py
+            bitflip phase side, DATA-corrupting: at the Nth arrival at a
+                    named data phase (bitflip_point(phase, array) call
+                    sites: "push_grad" in the PS client push path,
+                    "sdc_apply" in the dp merged-grad apply path of the
+                    SDC drill worker) flip ONE BIT of one element of
+                    the array flowing through — the deterministic
+                    stand-in for a silent data corruption (cosmic ray,
+                    bad DIMM, wrong FMA). The optional <arg> is the
+                    flat element index to corrupt (default 0). Combine
+                    with PADDLE_PS_FAULT_TAGS to corrupt exactly one
+                    dp rank: the cross-replica SDC detector
+                    (telemetry/numerics.py) must name that rank
             oom     phase side: raise a simulated RESOURCE_EXHAUSTED at
                     the Nth arrival at a named executor memory phase
                     ("compile", "run" — oom_point() call sites in
@@ -138,6 +150,9 @@ ENV_TAGS = "PADDLE_PS_FAULT_TAGS"
 _CLIENT_ACTIONS = ("drop", "refuse", "delay", "stall")
 _SERVER_ACTIONS = ("kill", "slow", "partition")
 _PHASE_ACTIONS = ("crash", "oom")
+# data-corruption rules: fire at named DATA phases (bitflip_point call
+# sites) and perturb the array flowing through instead of failing
+_DATA_ACTIONS = ("bitflip",)
 # disk-fault rules: fire at named WRITE phases (io_point call sites in
 # the checkpoint commit protocol)
 _IO_ACTIONS = ("io_err", "short_write", "diskfull")
@@ -201,7 +216,7 @@ def parse_spec(spec: str) -> List[_Rule]:
                 f"bad fault rule {raw!r}: want action:method:nth[:arg]")
         action, method, nth = parts[0], parts[1], parts[2]
         known = (_CLIENT_ACTIONS + _SERVER_ACTIONS + _PHASE_ACTIONS
-                 + _IO_ACTIONS + _TAG_ACTIONS)
+                 + _IO_ACTIONS + _TAG_ACTIONS + _DATA_ACTIONS)
         if action not in known:
             raise ValueError(
                 f"bad fault rule {raw!r}: unknown action {action!r} "
@@ -416,6 +431,42 @@ class FaultInjector:
                          ).encode())
         return short
 
+    # -- data-corruption side ----------------------------------------------
+    def at_bitflip_phase(self, phase: str, array):
+        """Consulted at named DATA phases (bitflip_point call sites):
+        a `bitflip:<phase>:<nth>[:<elem>]` rule returns a COPY of the
+        array with one bit of one element flipped (float32/float64: the
+        high exponent bit, so the corruption is loud in any norm;
+        integer dtypes: the low bit). No matching rule: the array is
+        returned untouched, same object."""
+        rules = self._take(("bitflip",), phase)
+        if not rules:
+            return array
+        import numpy as np
+
+        a = np.array(array, copy=True)
+        flat = a.reshape(-1)
+        for r in rules:
+            if flat.size == 0:
+                continue
+            idx = int(r.arg) % flat.size
+            if a.dtype == np.float32:
+                u = flat.view(np.uint32)
+                u[idx] ^= np.uint32(1 << 30)
+            elif a.dtype == np.float64:
+                u = flat.view(np.uint64)
+                u[idx] ^= np.uint64(1 << 62)
+            else:
+                # any other dtype: flip the low bit of the element's
+                # first byte through the raw view
+                b = a.view(np.uint8).reshape(a.size, a.itemsize)
+                b[idx, 0] ^= np.uint8(1)
+            os.write(2, (f"[faults] bitflip at phase {phase!r}: "
+                         f"element {idx} corrupted in pid "
+                         f"{os.getpid()} (rule bitflip:{r.method}:"
+                         f"{r.nth})\n").encode())
+        return a
+
     # -- memory side -----------------------------------------------------
     def at_oom_phase(self, phase: str) -> None:
         """Consulted at the executor's named memory phases ("compile",
@@ -493,6 +544,18 @@ def oom_point(phase: str) -> None:
     inj = injector()
     if inj is not None:
         inj.at_oom_phase(phase)
+
+
+def bitflip_point(phase: str, array):
+    """Deterministic data-corruption site at a named data phase: a
+    matching `bitflip:<phase>:<nth>[:<elem>]` rule returns a copy of
+    `array` with one bit of one element flipped; otherwise the array
+    passes through untouched. One flag read when the layer is off —
+    the data plane pays nothing in production."""
+    inj = injector()
+    if inj is None:
+        return array
+    return inj.at_bitflip_phase(phase, array)
 
 
 def io_point(phase: str) -> bool:

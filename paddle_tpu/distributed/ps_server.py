@@ -2317,6 +2317,12 @@ class RemoteTable:
     def push_gradients(self, ids, grads) -> None:
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        # SDC drill site (telemetry/numerics.py): a bitflip:push_grad
+        # rule corrupts one value of THIS rank's outgoing gradient —
+        # flag-off the array passes through untouched (one flag read)
+        from .faults import bitflip_point
+
+        grads = bitflip_point("push_grad", grads)
         with self._step_lock:
             step = self._step
             self._step += 1

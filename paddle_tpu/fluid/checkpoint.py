@@ -127,7 +127,18 @@ class BadStepError(FloatingPointError):
     gradients (or, for programs without the in-graph guard, non-finite
     updated state). The Executor raises this BEFORE committing anything
     to the scope, so the caller can skip the step — parameters,
-    optimizer state and the RNG key are exactly as before the step."""
+    optimizer state and the RNG key are exactly as before the step.
+
+    When the NaN-provenance doctor ran (telemetry/numerics.py, the
+    default), `report` carries the provenance dict — the FIRST
+    non-finite producer's op index/type, user-layer callstack, operand
+    stats and the sampled grad-norm history — and `dump_path` the
+    numrec.<tag>.json flight-record it was written to."""
+
+    def __init__(self, message: str, report=None, dump_path=None):
+        super().__init__(message)
+        self.report = report or {}
+        self.dump_path = dump_path
 
 
 class Preempted(RuntimeError):

@@ -86,6 +86,17 @@ class GradientClipByGlobalNorm(GradientClipBase):
         )
         gnorm = block.create_var(name=f"@GLOBAL_NORM_SQRT@{self.group_name}", shape=(1,))
         block.append_op(type="sqrt", inputs={"X": [total]}, outputs={"Out": [gnorm]})
+        from .flags import flag as _flag
+
+        if _flag("FLAGS_tensor_stats"):
+            # numerics observability (ISSUE 12): the global norm is
+            # already computed here — persist it instead of discarding
+            # it (grad_global_norm gauge + clip-trigger accounting at
+            # the sample cadence). Flag-off: bit-identical build.
+            from ..telemetry import numerics as _numerics
+
+            _numerics.install_global_norm_stat(
+                gnorm, self.clip_norm, self.group_name)
         # scale = clip_norm / max(global_norm, clip_norm)
         denom = block.create_var(name=f"@GN_DENOM@{self.group_name}", shape=(1,))
         block.append_op(

@@ -25,6 +25,7 @@ from . import framework, monitor
 from .dtypes import convert_dtype
 from .profiler import RecordEvent
 from ..ops import registry
+from ..telemetry import numerics as _numerics
 from ..telemetry import tracing as _tracing
 
 
@@ -255,9 +256,27 @@ class Executor:
                 # (no-op unless PADDLE_TRACING + PADDLE_TRACE_DIR)
                 _tracing.annotate(bad_step=bad)
                 _tracing.flight_dump("bad_step")
+                # NaN-provenance doctor: the scope is still exactly
+                # pre-step, so the failed step can be replayed eagerly
+                # and bisected to its FIRST non-finite producer; the
+                # numrec dump + report ride the BadStepError
+                report, dump = _numerics.maybe_run_doctor(
+                    program, feed_arrays, scope, reason=bad)
+                detail = ""
+                if report and report.get("provenance") == "op":
+                    uf = report.get("user_frame")
+                    detail = (
+                        f"; first non-finite producer: "
+                        f"op#{report['op_index']} "
+                        f"[{report['op_type']}] -> "
+                        f"{report['output_var']!r}"
+                        + (f" at {uf[0]}:{uf[1]}" if uf else ""))
+                if dump:
+                    detail += f"; numerics flight-record: {dump}"
                 raise BadStepError(
                     f"FLAGS_check_numerics: {bad}; step NOT committed "
-                    f"(parameters, optimizer state and RNG unchanged)")
+                    f"(parameters, optimizer state and RNG unchanged)"
+                    f"{detail}", report=report, dump_path=dump)
         if check_nan:
             # reference FLAGS_check_nan_inf scans every op output
             # (operator.cc:1020); with whole-block XLA compilation the
@@ -269,6 +288,10 @@ class Executor:
         scope._rng_key = new_key
         for n, v in new_state.items():
             scope.set_var(n, v)
+        # numerics observability (ISSUE 12): sampled stat-var reads, AMP
+        # scale transitions, SDC fingerprint publishing. Unarmed cost:
+        # two attribute reads (the bit-identity contract)
+        _numerics.on_step_commit(program, new_state)
         if bench:
             import jax
 
@@ -298,6 +321,10 @@ class Executor:
         if guard_vals:
             for n, v in guard_vals.items():
                 if bool(jnp.any(jnp.asarray(v) != 0)):
+                    if n.startswith("check_numerics_bad_amp"):
+                        return (f"AMP loss-scale backoff exhausted: "
+                                f"overflow below the scale floor "
+                                f"(guard {n!r})")
                     return f"non-finite gradient detected (guard {n!r})"
             return None
         for n, v in new_state.items():
@@ -433,7 +460,8 @@ class Executor:
         # toggling it back off must return to the scope-free executable)
         return (program._serial, program._version, feed_sig, fetch_names,
                 no_donate, flag("FLAGS_enable_unused_var_check"),
-                flag("FLAGS_program_verify"), flag("FLAGS_op_profile"))
+                flag("FLAGS_program_verify"), flag("FLAGS_op_profile"),
+                flag("FLAGS_tensor_stats"))
 
     def _prepare_feed(self, block, feed):
         import jax

@@ -75,6 +75,32 @@ _DEFS: Dict[str, tuple] = {
            "the newest valid checkpoint (or re-raises when no "
            "CheckpointManager is active). Only read when "
            "FLAGS_check_numerics is on"),
+    "FLAGS_tensor_stats": (
+        False, "in-graph tensor statistics (telemetry/numerics.py): "
+               "graph construction (Optimizer.apply_gradients, "
+               "fluid/clip.py global-norm clip) appends one "
+               "tensor_stats reduction per watched variable — "
+               "per-layer gradients, parameters, the clip global norm "
+               "— into persistable numstat__* vars that ride the "
+               "step's state outputs; the host samples them every "
+               "PADDLE_NUMERICS_EVERY steps into kind=\"numerics\" "
+               "sink records, numerics_* gauges and the /numericz "
+               "history ring (tools/numtop.py is the CLI). The flag "
+               "rides the Executor compile-cache key; off = no stat "
+               "vars or ops are built and the program, loss trace and "
+               "step-record schema are bit-identical to a build "
+               "without the layer"),
+    "FLAGS_check_numerics_amp_scale_floor": (
+        1.0, "unified AMP path for the bad-step guard: with "
+             "FLAGS_check_numerics on, an fp16 dynamic-loss-scaling "
+             "overflow that would push the scale BELOW this floor "
+             "(backoff exhausted — the model is producing non-finite "
+             "values at any scale) trips a check_numerics_bad_amp_* "
+             "guard var, so the Executor raises BadStepError and the "
+             "NaN-provenance doctor dumps a numrec for AMP runs too. "
+             "Transient overflows (scale still above the floor) keep "
+             "AMP's zero-and-shrink skip semantics. Only read when "
+             "FLAGS_check_numerics is on"),
     "FLAGS_program_verify": (
         False, "fluid/analysis static verifier: Executor._ensure_compiled "
                "verifies every program on compile-cache miss (raising "

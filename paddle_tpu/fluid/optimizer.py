@@ -133,6 +133,14 @@ class Optimizer:
         params_grads = append_regularization_ops(params_grads, self.regularization)
         from .flags import flag as _flag
 
+        if _flag("FLAGS_tensor_stats"):
+            # numerics observability (ISSUE 12): one in-graph stats
+            # reduction per applied gradient + parameter, AFTER clip +
+            # regularization so the series shows what the update op
+            # actually consumed. Flag-off: no ops, bit-identical build.
+            from ..telemetry import numerics as _numerics
+
+            _numerics.install_grad_stats(params_grads)
         if _flag("FLAGS_check_numerics"):
             self._append_check_numerics_guard(params_grads)
         self._create_global_learning_rate()

@@ -417,3 +417,25 @@ def tree_conv(ctx, ins, attrs):
     out = jnp.einsum("buvk,bvi,ikof->buof", coeffs,
                      nodes.astype(jnp.float32), w.astype(jnp.float32))
     return {"Out": [out.astype(nodes.dtype)]}
+
+
+@register("tensor_stats", stop_gradient=True, no_vjp_grad=True)
+def tensor_stats(ctx, ins, attrs):
+    """Numerics observability reduction (telemetry/numerics.py,
+    FLAGS_tensor_stats): one pass over X producing the (4,) float32
+    vector [nan_count, inf_count, max_abs_finite, l2_finite]. Emitted
+    next to the op that produced X, so XLA fuses it into the step and
+    the host only pays the sampled device->host read of the stat var.
+    max/l2 run over the FINITE elements (a single Inf must not flatten
+    the rest of the series to Inf)."""
+    x = ins["X"][0]
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    nan_ct = jnp.sum(jnp.isnan(xf)).astype(jnp.float32)
+    inf_ct = jnp.sum(jnp.isinf(xf)).astype(jnp.float32)
+    safe = jnp.where(finite, xf, 0.0)
+    max_abs = jnp.max(jnp.abs(safe)) if xf.size else jnp.float32(0.0)
+    l2 = jnp.sqrt(jnp.sum(jnp.square(safe)))
+    return {"Out": [jnp.stack([nan_ct, inf_ct,
+                               jnp.asarray(max_abs, jnp.float32),
+                               l2])]}

@@ -257,9 +257,15 @@ def _fwd_key_from_grad(op):
     )), _attrs_sig(op.attrs))
 
 
-def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
+def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any],
+             on_op=None) -> Dict[str, Any]:
     """Trace a list of framework Operators into JAX values. `env` maps var
     name -> value and is mutated in place (op outputs land there).
+
+    on_op: optional per-op probe called as on_op(op_idx, op, outs) AFTER
+    the op's outputs land in env — the numerics doctor's instrumented
+    eager replay hangs its finiteness checks here (telemetry/numerics.
+    bisect_first_nonfinite). None (the default) costs nothing.
 
     Primal reuse: forward ops whose generic grad op appears later in the
     list are emitted under jax.vjp ONCE; the grad op consumes the stored
@@ -337,6 +343,8 @@ def emit_ops(ctx: EmitContext, ops, env: Dict[str, Any]) -> Dict[str, Any]:
                 continue
             for n, v in zip(names, vals):
                 env[n] = v
+        if on_op is not None:
+            on_op(op_idx, op, outs)
     return env
 
 

@@ -25,6 +25,13 @@ Layering:
   telemetry.tracing    causal span propagation across the RPC plane
                        (PADDLE_TRACING): trace_id/span_id per hop,
                        bounded span ring, flight recorder, /tracez
+  telemetry.numerics   training numerics: in-graph tensor stats
+                       (FLAGS_tensor_stats -> numstat__* vars sampled
+                       every PADDLE_NUMERICS_EVERY steps), the
+                       NaN-provenance doctor (numrec dumps behind
+                       BadStepError), cross-replica SDC fingerprints
+                       (PADDLE_SDC_CHECK_EVERY via the coordinator),
+                       /numericz, tools/numtop.py
   fluid/monitor.py     the executor-facing step-time breakdown built on
                        the registry + sink
 
@@ -39,6 +46,7 @@ from . import (  # noqa: F401
     debugz,
     export,
     memory,
+    numerics,
     sink,
     straggler,
     timeline,

@@ -19,6 +19,13 @@ sidecar, no log scraping:
              per-category breakdown (params / optimizer_state /
              gradients / feeds / activations), top-K buffers with user
              callstacks, static-vs-measured peak, what-ifs (JSON)
+  /numericz  training numerics (ISSUE 12): FLAGS_tensor_stats state,
+             the watch roster (per-layer gradients / params / clip
+             global norm), the recent sampled stat series (nan/inf
+             counts, max-abs, l2 per watch), AMP loss-scale state, the
+             last NaN-provenance doctor report, and the local SDC
+             reporting cadence (JSON; the authoritative divergence
+             table lives on the coordinator's numerics_status verb)
   /tracez    recent causal traces from the span ring (PADDLE_TRACING),
              slowest-first with per-hop durations — the live view of
              what the flight recorder would dump (JSON)
@@ -58,6 +65,7 @@ FLAGZ_MUTABLE = (
     "FLAGS_check_numerics",
     "FLAGS_check_numerics_max_bad_steps",
     "FLAGS_check_nan_inf",
+    "FLAGS_tensor_stats",
     "FLAGS_mem_profile",
     "FLAGS_benchmark",
     "FLAGS_enable_unused_var_check",
@@ -251,6 +259,11 @@ def _route(path: str):
 
         return (200, "application/json",
                 json.dumps(memory.memz(), default=str).encode())
+    if path == "/numericz":
+        from . import numerics
+
+        return (200, "application/json",
+                json.dumps(numerics.numericz(), default=str).encode())
     if path == "/tracez":
         from . import tracing
 
@@ -262,7 +275,7 @@ def _route(path: str):
     if path in ("", "/", "/index.html"):
         return (200, "text/plain; charset=utf-8",
                 b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
-                b"/memz /tracez /flagz /healthz\n")
+                b"/memz /numericz /tracez /flagz /healthz\n")
     return 404, "text/plain; charset=utf-8", b"not found\n"
 
 

@@ -1,0 +1,890 @@
+"""Training numerics observability (ISSUE 12).
+
+The observability stack answers "where did the TIME go" (cost.py) and
+"where did the MEMORY go" (memory.py); this module answers "where did
+the NUMBERS go" — the question behind every NaN'd run, every AMP
+loss-scale collapse, and every silently-corrupted replica:
+
+  1. In-graph tensor stats (FLAGS_tensor_stats): graph construction
+     (Optimizer.apply_gradients, fluid/clip.py, the AMP decorator)
+     appends one cheap `tensor_stats` reduction per watched variable —
+     per-layer gradients, parameters, the gradient-clip global norm —
+     writing [nan_count, inf_count, max_abs, l2] into persistable
+     `numstat__*` vars that ride the step's state outputs. XLA fuses
+     the reductions into the step program; the host reads them every
+     PADDLE_NUMERICS_EVERY steps (the only per-step cost is that
+     sampled device->host copy) and publishes kind="numerics" sink
+     records, numerics_* gauges, and a bounded in-process history ring
+     (the per-layer grad-norm series the doctor and /numericz serve).
+  2. NaN-provenance doctor: when the FLAGS_check_numerics bad-step
+     guard fires, the Executor hands the un-committed step here; the
+     doctor re-runs the SAME ops eagerly (same feed, same scope state,
+     same RNG key) with a per-op finiteness probe and bisects to the
+     FIRST op that produced a non-finite value — naming its IR op
+     index, type, the PR-5 user-layer callstack, its operand stats and
+     the grad-norm history leading in. The report dumps atomically to
+     PADDLE_TRACE_DIR/numrec.<tag>.json (the PR-9 flight-recorder
+     path; no PADDLE_TRACING needed) and rides the BadStepError.
+  3. Cross-replica SDC detection: every PADDLE_SDC_CHECK_EVERY steps
+     each dp rank publishes a params+merged-grad fingerprint (l2 norm
+     + crc32 checksum) to the job coordinator (`numerics_report` verb
+     over the PS RPC transport). Replicated dp state must be
+     BIT-identical across ranks, so a checksum mismatch is a silent
+     data corruption: the coordinator emits a structured `divergence`
+     event naming the odd-rank-out (majority vote; with two ranks the
+     publisher's self-consistency bit arbitrates), every rank that
+     sees the verdict dumps its flight record, and PADDLE_SDC_EVICT=1
+     routes the corrupted rank to the elastic eviction path. Drilled
+     deterministically with the `bitflip:<phase>:<nth>` fault rule
+     (distributed/faults.py), which flips one bit of one gradient
+     value on one tagged rank.
+
+Cost contract (the established flag-off bar): FLAGS_tensor_stats unset
+means NO stat vars or ops are built (programs are bit-identical to a
+build without this module — asserted by test), the flag rides the
+Executor compile-cache key, and the step path pays one flag read plus
+one attribute read. SDC publishing is off unless PADDLE_SDC_CHECK_EVERY
+is set AND the coordinator endpoint is armed; the doctor only ever runs
+on the failure path (opt out: PADDLE_NUMERICS_DOCTOR=0).
+
+Everything heavier than stdlib+numpy (jax, fluid) is imported inside
+functions: the coordinator/launcher import this module without an
+accelerator runtime (the FingerprintTable is stdlib-only).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .registry import get_registry
+
+STAT_PREFIX = "numstat__"
+
+ENV_EVERY = "PADDLE_NUMERICS_EVERY"
+ENV_HISTORY = "PADDLE_NUMERICS_HISTORY"
+ENV_DOCTOR = "PADDLE_NUMERICS_DOCTOR"
+ENV_SDC_EVERY = "PADDLE_SDC_CHECK_EVERY"
+ENV_SDC_EVICT = "PADDLE_SDC_EVICT"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default) or default)
+    except ValueError:
+        return default
+
+
+def stats_every() -> int:
+    """Sample cadence for the host-side read of the in-graph stat vars
+    (default: every step while the flag is armed)."""
+    return max(1, _env_int(ENV_EVERY, 1))
+
+
+def doctor_enabled() -> bool:
+    return os.environ.get(ENV_DOCTOR, "1") not in ("0", "false", "")
+
+
+# ---------------------------------------------------------------------------
+# graph-build side: watch installation (FLAGS_tensor_stats)
+# ---------------------------------------------------------------------------
+
+
+def stats_enabled() -> bool:
+    from ..fluid.flags import flag
+
+    return bool(flag("FLAGS_tensor_stats"))
+
+
+def _ensure_persistable(name: str, shape) -> Any:
+    """Create the persistable stat var in the CURRENT default main +
+    startup programs (the same placement contract as the check_numerics
+    guard and the AMP scaling state — callers run under program_guard)."""
+    from ..fluid import framework
+    from ..fluid.initializer import ConstantInitializer
+
+    main_block = framework.default_main_program().global_block()
+    v = main_block.create_var(name=name, shape=tuple(shape),
+                              dtype="float32", persistable=True,
+                              stop_gradient=True)
+    sblock = framework.default_startup_program().global_block()
+    sv = sblock.create_var(name=name, shape=tuple(shape),
+                           dtype="float32", persistable=True)
+    ConstantInitializer(0.0)(sv, sblock)
+    return v
+
+
+def _register_watch(program, stat_name: str, kind: str, var_name: str,
+                    label: str, **extra) -> None:
+    watches = program.__dict__.setdefault("_numerics_watch", {})
+    watches[stat_name] = dict(kind=kind, var=var_name, label=label,
+                              **extra)
+
+
+def watch_variable(var, kind: str, label: Optional[str] = None):
+    """Append a `tensor_stats` reduction over `var` into a persistable
+    `numstat__*` var and register it on the program. Returns the stat
+    var. Caller context must hold program_guard over var's program."""
+    from ..fluid import unique_name
+
+    program = var.block.program
+    block = program.global_block()
+    stat_name = unique_name.generate(f"{STAT_PREFIX}{kind}")
+    stat = _ensure_persistable(stat_name, (4,))
+    op = block.append_op(
+        type="tensor_stats",
+        inputs={"X": [var]},
+        outputs={"Out": [stat]},
+    )
+    # the stat op inherits the watched var's build-site callstack so
+    # diagnostics point at the user layer, not at this module
+    from ..fluid.framework import OP_CALLSTACK_ATTR
+
+    src = getattr(var, "op", None)
+    if src is not None and src.attrs.get(OP_CALLSTACK_ATTR):
+        op.attrs[OP_CALLSTACK_ATTR] = src.attrs[OP_CALLSTACK_ATTR]
+    _register_watch(program, stat_name, kind, var.name,
+                    label or var.name)
+    return stat
+
+
+def install_grad_stats(params_grads) -> None:
+    """FLAGS_tensor_stats hook in Optimizer.apply_gradients: one stat
+    reduction per applied gradient (labeled by its parameter — the
+    per-LAYER series) and one per parameter. Runs after clip +
+    regularization so the watched gradient is the one the update op
+    actually consumes."""
+    if not stats_enabled():
+        return
+    for p, g in params_grads:
+        if g is None:
+            continue
+        watch_variable(g, "grad", label=p.name)
+        watch_variable(p, "param", label=p.name)
+
+
+def install_global_norm_stat(gnorm_var, clip_norm: float,
+                             group: str) -> None:
+    """FLAGS_tensor_stats hook in GradientClipByGlobalNorm: persist the
+    already-computed global gradient norm instead of discarding it
+    (grad_global_norm gauge + clip-trigger accounting at the sample
+    cadence)."""
+    if not stats_enabled():
+        return
+    from ..fluid import layers, unique_name
+
+    program = gnorm_var.block.program
+    stat_name = unique_name.generate(f"{STAT_PREFIX}clip_gnorm")
+    stat = _ensure_persistable(stat_name, (1,))
+    layers.assign(gnorm_var, stat)
+    _register_watch(program, stat_name, "clip_gnorm", gnorm_var.name,
+                    f"global_norm@{group}", clip_norm=float(clip_norm))
+
+
+# AMP dynamic loss scaling: the scale var already exists (flag-off
+# programs included), so observing it needs no graph change — the
+# decorator registers the var names and the step hook reads them.
+_amp_states: Dict[str, dict] = {}
+_amp_lock = threading.Lock()
+
+
+def register_amp_scale(scale_name: str, good_name: Optional[str] = None,
+                       bad_name: Optional[str] = None) -> None:
+    """Called by the AMP decorator when dynamic loss scaling is armed:
+    scale growth/backoff becomes countable host-side."""
+    with _amp_lock:
+        _amp_states[scale_name] = {"good": good_name, "bad": bad_name,
+                                   "last": None}
+
+
+# ---------------------------------------------------------------------------
+# host side: sampling, history, step hook
+# ---------------------------------------------------------------------------
+
+_history: deque = deque(maxlen=max(8, _env_int(ENV_HISTORY, 128)))
+_history_lock = threading.Lock()
+_stat_step = 0
+_last_sample: Optional[dict] = None
+_last_watches: Dict[str, dict] = {}  # roster of the last sampled program
+
+
+def history() -> List[dict]:
+    with _history_lock:
+        return list(_history)
+
+
+def last_sample() -> Optional[dict]:
+    return _last_sample
+
+
+def _sdc_every() -> int:
+    return _env_int(ENV_SDC_EVERY, 0)
+
+
+_exec_reporter = None
+_exec_reporter_failed = False
+
+
+def on_step_commit(program, new_state: Dict[str, Any]) -> None:
+    """Called by Executor.run after a step's state is committed to the
+    scope. Flag-off AND nothing registered: two attribute reads, no
+    allocation (the bit-identity contract). Armed: sample the in-graph
+    stat vars every PADDLE_NUMERICS_EVERY steps, count AMP loss-scale
+    growth/backoff transitions, and publish the SDC fingerprint every
+    PADDLE_SDC_CHECK_EVERY steps."""
+    watches = getattr(program, "_numerics_watch", None)
+    if not watches and not _amp_states:
+        if not _sdc_every():
+            return
+    global _stat_step
+    reg = get_registry()
+    if watches and stats_enabled():
+        _stat_step += 1
+        if _stat_step % stats_every() == 0:
+            try:
+                _sample_stats(watches, new_state, reg)
+            except Exception:  # noqa: BLE001 — diagnostics never fail
+                pass           # the step that just trained fine
+    if _amp_states:
+        try:
+            _sample_amp(new_state, reg)
+        except Exception:  # noqa: BLE001
+            pass
+    k = _sdc_every()
+    if k:
+        try:
+            _maybe_publish_fingerprint(new_state, k)
+        except Exception:  # noqa: BLE001 — a flapping coordinator must
+            pass           # not take the trainer down
+
+
+def _sample_stats(watches: Dict[str, dict], new_state, reg) -> None:
+    import numpy as np
+
+    from . import sink
+
+    sample: Dict[str, dict] = {}
+    nonfinite = 0
+    max_abs_grad = 0.0
+    grad_sq = 0.0
+    for stat_name, meta in watches.items():
+        v = new_state.get(stat_name)
+        if v is None:
+            continue
+        a = np.asarray(v, dtype=np.float64).reshape(-1)
+        if meta["kind"] == "clip_gnorm":
+            gn = float(a[0])
+            row = {"kind": meta["kind"], "value": gn,
+                   "clip_norm": meta.get("clip_norm")}
+            reg.gauge("grad_global_norm",
+                      help="gradient-clip global norm (sampled)").set(gn)
+            if meta.get("clip_norm") and gn > meta["clip_norm"]:
+                row["clipped"] = True
+                reg.counter(
+                    "numerics_clip_triggered_total",
+                    help="sampled steps where the global norm exceeded "
+                         "clip_norm (clipping actually fired)").inc()
+        else:
+            row = {"kind": meta["kind"], "nan": int(a[0]),
+                   "inf": int(a[1]), "max_abs": float(a[2]),
+                   "l2": float(a[3])}
+            if row["nan"] or row["inf"]:
+                nonfinite += 1
+            if meta["kind"] == "grad":
+                max_abs_grad = max(max_abs_grad, row["max_abs"])
+                grad_sq += row["l2"] ** 2
+        sample[meta["label"] if meta["kind"] != "param"
+               else f"param:{meta['label']}"] = row
+    if not sample:
+        return
+    global _last_sample, _last_watches
+    _last_watches = dict(watches)
+    record = {"kind": "numerics", "event": "stats", "step": _stat_step,
+              "watch": sample}
+    _last_sample = record
+    with _history_lock:
+        _history.append(record)
+    reg.gauge("numerics_nonfinite_watches",
+              help="watched tensors holding NaN/Inf at the last sample"
+              ).set(nonfinite)
+    reg.gauge("numerics_max_abs_grad",
+              help="max |g| over watched gradients (sampled)"
+              ).set(max_abs_grad)
+    reg.gauge("numerics_grad_l2_total",
+              help="l2 norm over ALL watched gradients (sampled)"
+              ).set(math.sqrt(grad_sq))
+    reg.counter("numerics_samples_total",
+                help="host-side stat samples taken").inc()
+    sink.emit(record)
+
+
+def _sample_amp(new_state, reg) -> None:
+    import numpy as np
+
+    from . import sink
+    from ..fluid import monitor
+
+    with _amp_lock:
+        items = list(_amp_states.items())
+    for scale_name, st in items:
+        v = new_state.get(scale_name)
+        if v is None:
+            continue
+        s = float(np.asarray(v).reshape(-1)[0])
+        last = st["last"]
+        st["last"] = s
+        reg.gauge("numerics_amp_loss_scale",
+                  help="current AMP dynamic loss scale").set(s)
+        if last is None or s == last:
+            continue
+        change = "growth" if s > last else "backoff"
+        reg.counter(f"numerics_amp_scale_{change}s_total",
+                    help=f"AMP loss-scale {change} events").inc()
+        sink.emit({"kind": "numerics", "event": "amp_scale",
+                   "step": monitor.global_step(), "change": change,
+                   "old": last, "new": s, "scale_var": scale_name})
+
+
+def _maybe_publish_fingerprint(new_state, k: int) -> None:
+    """Executor-path SDC publishing: every k committed steps fingerprint
+    the float state (params + optimizer moments + the merged-grad stat
+    vars when FLAGS_tensor_stats is armed) and report it. Lazily builds
+    one process-wide reporter; unreachable coordinator disables it for
+    the process rather than stalling every k-th step."""
+    global _exec_reporter, _exec_reporter_failed
+    if _exec_reporter_failed:
+        return
+    from ..fluid import monitor
+
+    step = monitor.global_step()
+    if step % k:
+        return
+    if _exec_reporter is None:
+        rep = SDCReporter()
+        if not rep.armed:
+            _exec_reporter_failed = True
+            return
+        _exec_reporter = rep
+    _exec_reporter.maybe_report(step, named_arrays=new_state)
+
+
+# ---------------------------------------------------------------------------
+# NaN-provenance doctor
+# ---------------------------------------------------------------------------
+
+
+class _FirstBadFound(Exception):
+    """Internal control flow: stop the instrumented replay at the first
+    non-finite producer."""
+
+
+def _array_stats(v) -> Optional[dict]:
+    import numpy as np
+
+    try:
+        a = np.asarray(v)
+    except Exception:  # noqa: BLE001
+        return None
+    if a.dtype.kind != "f":
+        return {"dtype": str(a.dtype), "shape": list(a.shape)}
+    finite = np.isfinite(a)
+    af = np.where(finite, a, 0.0).astype(np.float64)
+    return {
+        "dtype": str(a.dtype), "shape": list(a.shape),
+        "nan": int(np.isnan(a).sum()), "inf": int(np.isinf(a).sum()),
+        "max_abs": float(np.abs(af).max()) if a.size else 0.0,
+        "l2": float(np.sqrt(np.square(af).sum())),
+    }
+
+
+def _callstack_json(op) -> Tuple[Optional[list], Optional[list]]:
+    """(full callstack as [[file, line, fn], ...], user frame) for an
+    op's __op_callstack__ attr."""
+    from ..fluid.framework import OP_CALLSTACK_ATTR
+    from ..fluid.analysis import user_frame
+
+    cs = op.attrs.get(OP_CALLSTACK_ATTR) if op is not None else None
+    if not cs:
+        return None, None
+    uf = user_frame(cs)
+    return [list(f) for f in cs], (list(uf) if uf else None)
+
+
+def bisect_first_nonfinite(program, feed_arrays: Dict[str, Any],
+                           scope) -> Optional[dict]:
+    """The instrumented replay: re-run the block's ops EAGERLY (outside
+    jit) from the exact pre-step state — same feeds, same scope arrays,
+    same RNG key, so the functional RNG threading reproduces the step's
+    randomness — probing every op's outputs for NaN/Inf, and stop at
+    the FIRST producer. Returns the provenance dict, or None when the
+    replay stays finite (an XLA-fusion rounding edge the eager math
+    does not hit — reported honestly instead of guessing).
+
+    Mesh programs are not replayable on one host; callers gate on
+    program._mesh is None."""
+    import numpy as np
+
+    from ..ops import registry as op_registry
+
+    block = program.global_block()
+    ops = list(block.ops)
+    env: Dict[str, Any] = dict(feed_arrays)
+
+    # pre-step inputs: anything read before written comes from the scope
+    written = set(feed_arrays)
+    needed: List[str] = []
+    for op in ops:
+        for n in op.input_names():
+            if n not in written and n not in needed:
+                needed.append(n)
+        written.update(op.output_names())
+    for n in needed:
+        v = scope.find_var(n)
+        if v is None:
+            return None  # startup not run here; nothing to replay
+        env[n] = v
+
+    # bad INPUTS are a provenance answer of their own: the step did not
+    # produce the poison, the feed/state carried it in
+    for name, v in list(env.items()):
+        st = _array_stats(v)
+        if st and (st.get("nan") or st.get("inf")):
+            return {"provenance": "input", "var": name, "stats": st}
+
+    found: Dict[str, Any] = {}
+
+    def probe(op_idx, op, outs):
+        for slot, names in op.outputs.items():
+            vals = (outs or {}).get(slot)
+            if vals is None:
+                continue
+            for name, v in zip(names, vals):
+                if v is None or not hasattr(v, "dtype"):
+                    continue
+                if np.dtype(v.dtype).kind != "f":
+                    continue
+                st = _array_stats(v)
+                if st and (st["nan"] or st["inf"]):
+                    found.update(op_index=op_idx, slot=slot,
+                                 var=name, stats=st)
+                    raise _FirstBadFound()
+
+    ctx = op_registry.EmitContext(rng_key=scope._rng_key, mesh=None)
+    try:
+        op_registry.emit_ops(ctx, ops, env, on_op=probe)
+    except _FirstBadFound:
+        pass
+    if not found:
+        return None
+    op = ops[found["op_index"]]
+    callstack, uf = _callstack_json(op)
+    operands = []
+    for slot, names in op.inputs.items():
+        for name in names:
+            if name in env:
+                operands.append({"slot": slot, "var": name,
+                                 "stats": _array_stats(env[name])})
+    return {
+        "provenance": "op",
+        "op_index": found["op_index"],
+        "op_type": op.type,
+        "output_var": found["var"],
+        "output_slot": found["slot"],
+        "output_stats": found["stats"],
+        "operands": operands,
+        "callstack": callstack,
+        "user_frame": uf,
+    }
+
+
+def maybe_run_doctor(program, feed_arrays, scope, reason: str
+                     ) -> Tuple[Optional[dict], Optional[str]]:
+    """The bad-step guard's post-mortem: bisect the un-committed step
+    to its first non-finite producer, attach the sampled grad-norm
+    history leading in, dump numrec.<tag>.json through the flight-
+    recorder path, and return (report, dump_path). Never raises — a
+    broken doctor must not mask the BadStepError. Opt out with
+    PADDLE_NUMERICS_DOCTOR=0."""
+    if not doctor_enabled():
+        return None, None
+    reg = get_registry()
+    reg.counter("numerics_doctor_runs_total",
+                help="NaN-provenance doctor invocations").inc()
+    report: Dict[str, Any] = {
+        "format": 1,
+        "kind": "numrec",
+        "reason": reason,
+        "ts": round(time.time(), 6),
+        "pid": os.getpid(),
+        "grad_history": history()[-16:],
+    }
+    try:
+        if getattr(program, "_mesh", None) is not None:
+            report["bisect_skipped"] = "mesh program (not replayable " \
+                                       "on one host)"
+        else:
+            prov = bisect_first_nonfinite(program, feed_arrays, scope)
+            if prov is None:
+                report["bisect_skipped"] = \
+                    "replay stayed finite (fusion rounding edge?)"
+            else:
+                report.update(prov)
+    except Exception as e:  # noqa: BLE001 — doctor must not mask
+        report["bisect_error"] = f"{type(e).__name__}: {e}"
+    try:
+        from . import sink
+
+        rec = {"kind": "numerics", "event": "doctor", "reason": reason}
+        if report.get("provenance") == "op":
+            rec.update(op_index=report["op_index"],
+                       op_type=report["op_type"],
+                       output_var=report["output_var"])
+        sink.emit(rec)
+    except Exception:  # noqa: BLE001
+        pass
+    path = dump_numrec(report)
+    global _last_doctor
+    _last_doctor = report
+    try:
+        from . import tracing
+
+        if report.get("provenance") == "op":
+            tracing.annotate(numerics_op=f"op{report['op_index']}:"
+                                         f"{report['op_type']}")
+    except Exception:  # noqa: BLE001
+        pass
+    return report, path
+
+
+_last_doctor: Optional[dict] = None
+
+
+def last_doctor_report() -> Optional[dict]:
+    return _last_doctor
+
+
+def dump_numrec(payload: dict, directory: Optional[str] = None
+                ) -> Optional[str]:
+    """Atomically write the numerics flight-record next to the tracing
+    and memory flight recorders: PADDLE_TRACE_DIR/numrec.<tag>.json.
+    Like memrec, this does NOT require PADDLE_TRACING — a NaN
+    post-mortem is useful without causal tracing armed. None when no
+    directory is configured or the disk refuses."""
+    from . import tracing
+
+    directory = directory or os.environ.get(tracing.ENV_DIR)
+    if not directory:
+        return None
+    path = os.path.join(directory,
+                        f"numrec.{tracing.process_tag()}.json")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        tracing._atomic_write(path, json.dumps(payload).encode())
+    except OSError:
+        return None
+    return path
+
+
+# ---------------------------------------------------------------------------
+# cross-replica SDC detection
+# ---------------------------------------------------------------------------
+
+
+def fingerprint_arrays(named: Dict[str, Any]) -> dict:
+    """Deterministic fingerprint of a name->array mapping: crc32 over
+    (name, bytes) in sorted-name order + the float l2 norm. Replicated
+    dp state is bit-identical across ranks, so equal state means equal
+    fingerprints and a mismatch is evidence of corruption."""
+    import numpy as np
+
+    crc = 0
+    sq = 0.0
+    n = 0
+    for name in sorted(named):
+        a = np.asarray(named[name])
+        if a.dtype.kind not in "fiu":
+            continue
+        crc = zlib.crc32(str(name).encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+        if a.dtype.kind == "f":
+            af = a.astype(np.float64)
+            sq += float(np.square(np.where(np.isfinite(af), af, 0.0)
+                                  ).sum())
+            n += int(a.size)
+    return {"crc": crc & 0xFFFFFFFF, "norm": math.sqrt(sq), "n": n}
+
+
+class FingerprintTable:
+    """Coordinator-side detector (stdlib-only: hosted by the launcher).
+
+    Ranks report (step, tag, fingerprint) every K steps; once two or
+    more reports exist for a step the checksums are compared:
+
+      all equal        -> agreement, nothing to say
+      strict majority  -> the minority ranks are the odd-rank-out
+      tie (2 ranks)    -> the publisher's self-consistency bit
+                          arbitrates: a rank that reports
+                          consistent=False (its applied merged-grad
+                          checksum no longer matches the checksum it
+                          derived from the shared PS state — the
+                          in-flight corruption window) indicts itself;
+                          with no such bit the event is flagged
+                          ambiguous and names every diverged rank
+
+    Divergence LATCHES: every later report (any step) gets the latest
+    event back, so all ranks learn the verdict — and flight-dump —
+    within one reporting period."""
+
+    _KEEP = 64
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # step -> {tag: fingerprint}
+        self.steps: Dict[int, Dict[str, dict]] = {}
+        self.world: Dict[int, int] = {}  # step -> expected reports
+        self.events: List[dict] = []
+        self.last_event: Optional[dict] = None
+
+    def record(self, step: int, tag: str, fingerprint: dict,
+               world_size: int = 0) -> dict:
+        step = int(step)
+        with self.lock:
+            ent = self.steps.setdefault(step, {})
+            ent[str(tag)] = dict(fingerprint or {})
+            self.world[step] = max(self.world.get(step, 0),
+                                   int(world_size or 0))
+            while len(self.steps) > self._KEEP:
+                s = min(self.steps)
+                self.steps.pop(s)
+                self.world.pop(s, None)
+            event = self._check_locked(step)
+            out: Dict[str, Any] = {
+                "step": step,
+                "reports": len(ent),
+                "diverged": self.last_event is not None,
+            }
+            if event is not None:
+                out["event"] = event
+            elif self.last_event is not None:
+                out["event"] = self.last_event
+            return out
+
+    def _check_locked(self, step: int) -> Optional[dict]:
+        reports = self.steps.get(step) or {}
+        # the verdict waits for every expected rank (a 2-of-3 mismatch
+        # may still resolve to a strict majority); unknown world sizes
+        # compare as soon as two reports exist
+        if len(reports) < max(2, self.world.get(step, 0)):
+            return None
+        groups: Dict[int, List[str]] = {}
+        for tag, fp in reports.items():
+            groups.setdefault(int(fp.get("crc", -1)), []).append(tag)
+        if len(groups) == 1:
+            return None
+        if any(e["step"] == step for e in self.events):
+            return next(e for e in self.events if e["step"] == step)
+        sizes = sorted((len(t) for t in groups.values()), reverse=True)
+        if len(sizes) > 1 and sizes[0] > sizes[1]:
+            majority = max(groups.values(), key=len)
+            odd = sorted(t for ts in groups.values() for t in ts
+                         if ts is not majority)
+            method = "majority"
+        else:
+            odd = sorted(t for t, fp in reports.items()
+                         if fp.get("consistent") is False)
+            if odd:
+                method = "self_check"
+            else:
+                odd = sorted(reports)
+                method = "ambiguous"
+        event = {
+            "event": "divergence",
+            "step": step,
+            "odd_rank_out": odd,
+            "method": method,
+            "groups": {f"{crc:#010x}": sorted(tags)
+                       for crc, tags in groups.items()},
+            "norms": {t: fp.get("norm") for t, fp in reports.items()},
+            "ts": round(time.time(), 6),
+        }
+        self.events.append(event)
+        self.last_event = event
+        return event
+
+    def status(self) -> dict:
+        with self.lock:
+            return {
+                "steps": {s: {t: dict(fp) for t, fp in ent.items()}
+                          for s, ent in sorted(self.steps.items())},
+                "events": [dict(e) for e in self.events],
+                "diverged": self.last_event is not None,
+            }
+
+
+class SDCReporter:
+    """Trainer-side publisher: fingerprint the replicated state every K
+    steps and report it through the coordinator transport. On a
+    divergence verdict: counter + kind="numerics" divergence record +
+    flight dump (the "flight-dumps all ranks" leg — every rank sees the
+    latched verdict within one reporting period)."""
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 tag: Optional[str] = None,
+                 world_size: Optional[int] = None,
+                 every: Optional[int] = None):
+        self.endpoint = endpoint or os.environ.get(
+            "PADDLE_COORDINATOR_ENDPOINT")
+        self.every = every if every is not None else _sdc_every()
+        if world_size is None:
+            world_size = _env_int("PADDLE_TRAINERS_NUM", 0)
+        self.world_size = int(world_size)
+        if tag is None:
+            from ..distributed import coordinator as coord
+
+            tag = coord.member_tag()
+        self.tag = tag
+        self._client = None
+        self._dumped = False
+        self.armed = bool(self.endpoint and self.every > 0)
+
+    def _conn(self):
+        if self._client is None:
+            from ..distributed import coordinator as coord
+
+            self._client = coord.CoordinatorClient(
+                self.endpoint, tag=self.tag, kind="trainer")
+        return self._client
+
+    def maybe_report(self, step: int,
+                     named_arrays: Optional[Dict[str, Any]] = None,
+                     fingerprint: Optional[dict] = None,
+                     consistent: Optional[bool] = None
+                     ) -> Optional[dict]:
+        """Report when armed and step is on the K-cadence; returns the
+        coordinator verdict (or None when skipped)."""
+        if not self.armed or (self.every and step % self.every):
+            return None
+        fp = dict(fingerprint) if fingerprint is not None \
+            else fingerprint_arrays(named_arrays or {})
+        if consistent is not None:
+            fp["consistent"] = bool(consistent)
+        get_registry().counter("numerics_sdc_reports_total",
+                               help="SDC fingerprints published").inc()
+        out = self._conn().numerics_report(step, fp, self.world_size)
+        if isinstance(out, dict) and out.get("diverged"):
+            self._on_divergence(step, out.get("event") or {})
+        return out
+
+    def _on_divergence(self, step: int, ev: dict) -> None:
+        get_registry().counter("numerics_sdc_divergence_total",
+                               help="divergence verdicts received").inc()
+        from . import sink, tracing
+
+        sink.emit({"kind": "numerics", "event": "divergence",
+                   "step": step,
+                   "odd_rank_out": ev.get("odd_rank_out"),
+                   "method": ev.get("method"),
+                   "detected_step": ev.get("step")})
+        if not self._dumped:
+            self._dumped = True
+            tracing.annotate(
+                sdc_odd_rank_out=",".join(ev.get("odd_rank_out") or []))
+            tracing.flight_dump("sdc_divergence")
+
+    def poll_verdict(self, step: int, timeout: float = 10.0
+                     ) -> Optional[dict]:
+        """Wait (bounded) until every rank's fingerprint for `step` has
+        landed on the coordinator, then return the divergence verdict —
+        the detector-side stand-in for the dp sync barrier that
+        lock-steps real ranks. Triggers the same divergence handling
+        (counter + record + flight dump) maybe_report does, so a rank
+        running AHEAD of a slow peer still learns the verdict within
+        its reporting period."""
+        if not self.armed:
+            return None
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                st = self._conn().numerics_status()
+            except Exception:  # noqa: BLE001 — coordinator flap
+                st = None
+            if isinstance(st, dict):
+                reports = (st.get("steps") or {}).get(step) or {}
+                done = (self.world_size
+                        and len(reports) >= self.world_size)
+                if st.get("diverged"):
+                    ev = (st.get("events") or [{}])[-1]
+                    self._on_divergence(step, ev)
+                    return {"diverged": True, "event": ev}
+                if done:
+                    return {"diverged": False}
+            if time.monotonic() > deadline:
+                return {"diverged": False, "timeout": True}
+            time.sleep(0.05)
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+# ---------------------------------------------------------------------------
+# debugz /numericz
+# ---------------------------------------------------------------------------
+
+
+def numericz(limit: int = 32) -> dict:
+    """The /numericz payload: flag state + watch roster + recent sampled
+    series + AMP scale state + the last doctor report + the local SDC
+    view (reporting cadence; the authoritative divergence table lives on
+    the coordinator's `numerics_status` verb)."""
+    from ..fluid.flags import flag
+
+    # prefer the roster of the program that actually SAMPLED last (a
+    # user's program_guard-built program is usually not the default)
+    watches = dict(_last_watches)
+    if not watches:
+        try:
+            from ..fluid import framework
+
+            watches = dict(getattr(framework.default_main_program(),
+                                   "_numerics_watch", None) or {})
+        except Exception:  # noqa: BLE001 — report pages never crash
+            pass
+    with _amp_lock:
+        amp = {name: {"last_scale": st["last"]}
+               for name, st in _amp_states.items()}
+    return {
+        "enabled": bool(flag("FLAGS_tensor_stats")),
+        "every": stats_every(),
+        "sdc_every": _sdc_every() or None,
+        "watches": watches,
+        "history": history()[-limit:],
+        "amp": amp or None,
+        "doctor": _last_doctor,
+    }
+
+
+def _reset_for_tests() -> None:
+    global _stat_step, _last_sample, _last_doctor
+    global _exec_reporter, _exec_reporter_failed
+    _last_watches.clear()
+    with _history_lock:
+        _history.clear()
+    with _amp_lock:
+        _amp_states.clear()
+    _stat_step = 0
+    _last_sample = None
+    _last_doctor = None
+    if _exec_reporter is not None:
+        try:
+            _exec_reporter.close()
+        except Exception:  # noqa: BLE001
+            pass
+    _exec_reporter = None
+    _exec_reporter_failed = False

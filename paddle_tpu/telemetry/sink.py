@@ -19,6 +19,25 @@ tools and tests parse it):
                   `ps` tag in the filename):
                   {"table": str, "mode": "sync"|"async"|"delta",
                    "step": int round/seq, "rows": int, "apply_ms": float}
+  kind="numerics" training numerics (telemetry/numerics.py), split by
+                  "event":
+                  event="stats"      one sampled read of the in-graph
+                    stat vars (FLAGS_tensor_stats, every
+                    PADDLE_NUMERICS_EVERY steps): {"step": int sample
+                    counter, "watch": {label: {"kind":
+                    "grad"|"param"|"clip_gnorm", "nan": int,
+                    "inf": int, "max_abs": float, "l2": float} —
+                    clip_gnorm rows carry {"value", "clip_norm",
+                    "clipped"?} instead}}
+                  event="amp_scale"  one AMP dynamic-loss-scale
+                    transition: {"step", "change": "growth"|"backoff",
+                    "old", "new", "scale_var"}
+                  event="doctor"     the NaN-provenance doctor ran:
+                    {"reason", "op_index"?, "op_type"?, "output_var"?}
+                    (full report: the numrec.<tag>.json dump)
+                  event="divergence" a cross-replica SDC verdict
+                    reached this rank: {"step", "odd_rank_out",
+                    "method", "detected_step"}
   kind="mem_report"  one static memory attribution (telemetry/memory.py,
                   emitted per compile-cache miss under FLAGS_mem_profile
                   and by explicit memtop/bench joins):

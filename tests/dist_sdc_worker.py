@@ -1,0 +1,93 @@
+"""Worker for tests/test_numerics.py cross-replica SDC drill: W dp
+ranks hold bit-identical replicated state (params + merged gradient),
+one rank suffers an injected single-bit corruption
+(`bitflip:sdc_apply:<nth>` on its PADDLE_PS_FAULT_TAGS tag), and the
+coordinator-hosted FingerprintTable must name exactly that rank within
+one PADDLE_SDC_CHECK_EVERY reporting period.
+
+The dp model here is redundant-compute data parallelism: every rank
+computes the gradient of the SAME global batch (identical data stream,
+identical math), so the "merged" gradient is bit-identical across
+ranks by construction — the invariant real dp sync (PS merge /
+allreduce) also guarantees, and exactly what the fingerprint checksum
+verifies. Each step the rank:
+
+  1. derives the merged gradient and checksums it (the reference crc),
+  2. passes it through faults.bitflip_point("sdc_apply", ...) — the
+     deterministic stand-in for a corrupted DIMM / wrong FMA between
+     receipt and apply,
+  3. applies it, and tracks a STICKY self-consistency bit (once an
+     applied gradient's checksum differed from its derived checksum,
+     the replica can no longer vouch for itself),
+  4. every K steps publishes {params, merged_grad} fingerprint +
+     consistency to the coordinator via telemetry.numerics.SDCReporter.
+
+Env knobs:
+  SDC_TEST_STEPS   total steps (default 8)
+  SDC_TEST_OUT     per-rank JSONL verdict trace directory
+  PADDLE_SDC_CHECK_EVERY, PADDLE_COORDINATOR_ENDPOINT,
+  PADDLE_TRAINER_ID/_TAG/TRAINERS_NUM, fault spec envs — see the test
+"""
+import json
+import os
+import sys
+import zlib
+
+import numpy as np
+
+from paddle_tpu.distributed import faults
+from paddle_tpu.telemetry import numerics
+
+DIM = (8, 4)
+LR = 0.2
+
+
+def main() -> int:
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 2))
+    tag = os.environ.get("PADDLE_TRAINER_TAG", f"trainer{rank}")
+    steps = int(os.environ.get("SDC_TEST_STEPS", 8))
+    out_dir = os.environ.get("SDC_TEST_OUT")
+
+    params = np.asarray(
+        np.random.RandomState(42).randn(*DIM), np.float32)
+    reporter = numerics.SDCReporter(tag=tag, world_size=world)
+    assert reporter.armed, "coordinator endpoint / K cadence not armed"
+
+    data_rng = np.random.RandomState(0)  # identical stream on all ranks
+    consistent = True  # sticky: once corrupted, never vouched-for again
+    trace = []
+    for step in range(1, steps + 1):
+        target = np.asarray(data_rng.randn(*DIM), np.float32)
+        # gradient of mean((params - target)^2) over the global batch —
+        # the dp-merged gradient, bit-identical on every rank
+        merged = np.asarray(
+            2.0 / params.size * (params - target), np.float32)
+        ref_crc = zlib.crc32(merged.tobytes())
+        applied = faults.bitflip_point("sdc_apply", merged)
+        if zlib.crc32(np.ascontiguousarray(applied).tobytes()) != ref_crc:
+            consistent = False
+        params = params - LR * applied
+        verdict = reporter.maybe_report(
+            step, {"params": params, "merged_grad": applied},
+            consistent=consistent)
+        if verdict is not None:
+            # real dp ranks are lock-stepped by the sync barrier; the
+            # drill emulates that by waiting for the peer's fingerprint
+            # before moving on, so every rank sees the verdict
+            verdict = reporter.poll_verdict(step, timeout=30.0) or verdict
+            trace.append({"step": step,
+                          "diverged": bool(verdict.get("diverged")),
+                          "odd": (verdict.get("event") or {}).get(
+                              "odd_rank_out")})
+    reporter.close()
+    if out_dir:
+        path = os.path.join(out_dir, f"sdc.{tag}.jsonl")
+        with open(path, "w") as f:
+            for line in trace:
+                f.write(json.dumps(line) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,787 @@
+"""Training numerics observability (ISSUE 12): the in-graph tensor-stat
+layer, the NaN-provenance doctor, AMP loss-scale telemetry + the
+unified bad-step guard, gradient-clip observability, cross-replica SDC
+detection, /numericz, and the numtop CLI.
+
+Layers under test:
+  ops/misc_ops.py                  the tensor_stats reduction emitter
+  telemetry/numerics.py            watch install, sampling, history,
+                                   doctor bisection, fingerprints,
+                                   FingerprintTable, SDCReporter
+  fluid/optimizer.py + clip.py     FLAGS_tensor_stats build hooks
+  fluid/executor.py                cache key, step hook, doctor call
+  contrib/mixed_precision          scale growth/backoff events, the
+                                   where()-select overflow-skip fix,
+                                   the backoff-exhausted guard
+  distributed/faults.py            bitflip:<phase>:<nth> rule
+  distributed/coordinator.py       numerics_report/status verbs + the
+                                   eviction routing
+  tools/numtop.py                  CLI end to end
+
+The 2-process bitflip drill (ISSUE 12 acceptance: bitflip on 1 of 2 dp
+ranks is detected, the divergence event names the corrupted rank within
+K steps, all ranks flight-dump, the rank is evicted) runs in the slow
+lane (tools/ci.sh numerics drill).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.contrib import mixed_precision as mp
+from paddle_tpu.distributed import faults
+from paddle_tpu.distributed.coordinator import (
+    Coordinator, serve_coordinator, stop_coordinator,
+)
+from paddle_tpu.fluid import layers, monitor
+from paddle_tpu.fluid import flags as fl
+from paddle_tpu.fluid.checkpoint import BadStepError
+from paddle_tpu.telemetry import debugz, get_registry, numerics, sink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_sdc_worker.py")
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _numerics_off():
+    yield
+    fl.set_flags({"FLAGS_tensor_stats": False,
+                  "FLAGS_check_numerics": False,
+                  "FLAGS_check_numerics_amp_scale_floor": 1.0})
+    numerics._reset_for_tests()
+    monitor.reset_for_tests()
+    faults.reset()
+    sink.disable()
+
+
+def _linear_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        p = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(8, 4).astype(np.float32),
+            rng.randn(8, 1).astype(np.float32))
+
+
+def _train(main, startup, loss, feeds, scope=None):
+    exe = fluid.Executor()
+    scope = scope or fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = [float(np.asarray(
+            exe.run(main, feed=f, fetch_list=[loss])[0]).reshape(-1)[0])
+            for f in feeds]
+    return out, scope
+
+
+# ---------------------------------------------------------------------------
+# tensor_stats op
+# ---------------------------------------------------------------------------
+
+
+def test_tensor_stats_emitter_matches_numpy():
+    from paddle_tpu.ops import registry as ops_registry
+
+    x = np.array([[1.0, -3.0, np.nan], [np.inf, 0.5, -np.inf]],
+                 np.float32)
+    ctx = ops_registry.EmitContext()
+    out = np.asarray(ops_registry.get("tensor_stats").emit(
+        ctx, {"X": [x]}, {})["Out"][0])
+    assert out.shape == (4,) and out.dtype == np.float32
+    nan_ct, inf_ct, max_abs, l2 = out
+    assert nan_ct == 1 and inf_ct == 2
+    # max/l2 over the FINITE elements only
+    assert max_abs == pytest.approx(3.0)
+    assert l2 == pytest.approx(np.sqrt(1 + 9 + 0.25), rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# flag-off bit-identity + flag-on parity (the established contract)
+# ---------------------------------------------------------------------------
+
+
+def test_flag_off_builds_no_stat_ops_or_vars():
+    main, _, _ = _linear_program()
+    assert not [v.name for v in main.list_vars()
+                if v.name.startswith(numerics.STAT_PREFIX)]
+    assert not [op for op in main.global_block().ops
+                if op.type == "tensor_stats"]
+    assert getattr(main, "_numerics_watch", None) is None
+
+
+def test_flag_on_watches_and_loss_trace_bit_identical():
+    """The stat reductions are pure readers: the flag-on loss trace is
+    BIT-identical to the flag-off one, and toggling the flag is in the
+    compile-cache key."""
+    xb, yb = _data()
+    feeds = [{"x": xb, "y": yb}] * 4
+    main_off, st_off, loss_off = _linear_program()
+    trace_off, _ = _train(main_off, st_off, loss_off, feeds)
+
+    fl.set_flags({"FLAGS_tensor_stats": True})
+    main_on, st_on, loss_on = _linear_program()
+    watches = getattr(main_on, "_numerics_watch", None)
+    assert watches, "flag-on build must register watches"
+    kinds = {m["kind"] for m in watches.values()}
+    assert {"grad", "param"} <= kinds
+    # one grad + one param watch per parameter
+    n_params = len(main_on.all_parameters())
+    assert len([m for m in watches.values()
+                if m["kind"] == "grad"]) == n_params
+    trace_on, _ = _train(main_on, st_on, loss_on, feeds)
+    assert trace_on == trace_off
+
+
+def test_step_record_schema_unchanged_by_flag(tmp_path):
+    """kind="step" records keep their exact schema with the flag on;
+    the numerics series rides its own kind="numerics" records."""
+    path = str(tmp_path / "m.jsonl")
+    sink.enable(path)
+    fl.set_flags({"FLAGS_tensor_stats": True})
+    main, startup, loss = _linear_program()
+    xb, yb = _data()
+    _train(main, startup, loss, [{"x": xb, "y": yb}] * 3)
+    sink.disable()
+    recs = [json.loads(l) for l in open(path)]
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert steps
+    need = {"kind", "step", "data_wait_ms", "compile_ms", "device_ms",
+            "fetch_ms", "ckpt_save_ms", "cache_hit", "fenced",
+            "retraces", "peak_hbm_bytes", "ts", "rank"}
+    for r in steps:
+        assert need == set(r), f"step schema drifted: {sorted(r)}"
+    nums = [r for r in recs if r["kind"] == "numerics"]
+    assert nums, "flag-on armed run must emit numerics records"
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+
+def test_stats_sampled_every_n_steps(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_NUMERICS_EVERY", "2")
+    path = str(tmp_path / "m.jsonl")
+    sink.enable(path)
+    fl.set_flags({"FLAGS_tensor_stats": True})
+    main, startup, loss = _linear_program()
+    xb, yb = _data()
+    _train(main, startup, loss, [{"x": xb, "y": yb}] * 6)
+    sink.disable()
+    recs = [json.loads(l) for l in open(path)
+            if json.loads(l)["kind"] == "numerics"]
+    stats = [r for r in recs if r["event"] == "stats"]
+    assert len(stats) == 3  # 6 steps / every 2
+    watch = stats[-1]["watch"]
+    grads = {k: v for k, v in watch.items() if v["kind"] == "grad"}
+    assert grads and all(
+        v["nan"] == 0 and v["inf"] == 0 and v["l2"] >= 0
+        for v in grads.values())
+    # history ring + gauges agree
+    assert numerics.history()
+    assert get_registry().gauge("numerics_grad_l2_total").value >= 0
+
+
+def test_sampled_stats_overhead_bound():
+    """The stat layer must stay cheap: fused in-graph reductions + one
+    sampled host read. Median per-step wall time with the flag armed is
+    bounded at 5x the flag-off median (generous: CI noise dominates at
+    this model size; the point is catching an accidental per-step
+    device sync or per-op host work)."""
+    xb, yb = _data()
+    feeds = [{"x": xb, "y": yb}] * 24
+
+    def run(flag):
+        fl.set_flags({"FLAGS_tensor_stats": flag})
+        main, startup, loss = _linear_program()
+        exe = fluid.Executor()
+        scope = fluid.executor.Scope()
+        times = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for i, f in enumerate(feeds):
+                t0 = time.perf_counter()
+                exe.run(main, feed=f, fetch_list=[loss])
+                if i >= 4:  # skip compile + warmup
+                    times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    base = run(False)
+    armed = run(True)
+    assert armed <= base * 5 + 2e-3, (armed, base)
+
+
+# ---------------------------------------------------------------------------
+# NaN-provenance doctor
+# ---------------------------------------------------------------------------
+
+
+def _overflow_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        from paddle_tpu.fluid.analysis import user_frame
+
+        h = layers.scale(x, scale=1e30)
+        h = layers.elementwise_mul(h, h)  # -> Inf HERE (first producer)
+        bad_line = user_frame(h.op.attrs["__op_callstack__"])[1]
+        p = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    ops = list(main.global_block().ops)
+    mul_idx = [i for i, op in enumerate(ops)
+               if op.type == "elementwise_mul"][0]
+    return main, startup, loss, mul_idx, bad_line
+
+
+def test_doctor_attributes_exact_op_and_callstack(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    fl.set_flags({"FLAGS_check_numerics": True,
+                  "FLAGS_tensor_stats": True})
+    main, startup, loss, mul_idx, bad_line = _overflow_program()
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(BadStepError) as ei:
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    e = ei.value
+    r = e.report
+    # the exact IR op + the user layer call that built it
+    assert r["provenance"] == "op"
+    assert r["op_index"] == mul_idx
+    assert r["op_type"] == "elementwise_mul"
+    assert r["output_stats"]["inf"] > 0
+    uf = r["user_frame"]
+    assert uf and uf[0] == os.path.abspath(__file__) and uf[1] == bad_line
+    assert any(op["stats"]["inf"] == 0 and op["stats"]["nan"] == 0
+               for op in r["operands"]), "operands were finite"
+    assert "first non-finite producer" in str(e)
+    # the numrec flight-record landed and parses
+    assert e.dump_path and os.path.exists(e.dump_path)
+    dumped = json.load(open(e.dump_path))
+    assert dumped["op_index"] == mul_idx
+    assert dumped["kind"] == "numrec"
+    assert os.path.basename(e.dump_path).startswith("numrec.")
+
+
+def test_doctor_names_poisoned_input(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    fl.set_flags({"FLAGS_check_numerics": True})
+    main, startup, loss = _linear_program()
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    bad = xb.copy()
+    bad[0, 0] = np.nan
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        with pytest.raises(BadStepError) as ei:
+            exe.run(main, feed={"x": bad, "y": yb}, fetch_list=[loss])
+    assert ei.value.report["provenance"] == "input"
+    assert ei.value.report["var"] == "x"
+
+
+def test_doctor_opt_out(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_NUMERICS_DOCTOR", "0")
+    fl.set_flags({"FLAGS_check_numerics": True})
+    main, startup, loss, _, _ = _overflow_program()
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(BadStepError) as ei:
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    assert not ei.value.report and ei.value.dump_path is None
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith("numrec")]
+
+
+def test_doctor_grad_history_rides_report(tmp_path, monkeypatch):
+    """The sampled per-layer grad-norm series leading INTO the bad step
+    is part of the numrec evidence."""
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    fl.set_flags({"FLAGS_tensor_stats": True,
+                  "FLAGS_check_numerics": True})
+    main, startup, loss = _linear_program()
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    bad = xb.copy()
+    bad[0, 0] = np.inf
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        with pytest.raises(BadStepError) as ei:
+            exe.run(main, feed={"x": bad, "y": yb}, fetch_list=[loss])
+    hist = ei.value.report["grad_history"]
+    assert len(hist) == 3
+    assert all(h["event"] == "stats" for h in hist)
+
+
+# ---------------------------------------------------------------------------
+# AMP: scale telemetry, overflow-skip fix, unified guard
+# ---------------------------------------------------------------------------
+
+
+def _amp_program(init=4.0, incr_every=1000, decr_every=1,
+                 decr_ratio=0.5):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        p = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        opt = mp.decorate(
+            fluid.optimizer.SGDOptimizer(learning_rate=0.01),
+            use_bf16=False, init_loss_scaling=init,
+            incr_every_n_steps=incr_every,
+            decr_every_n_nan_or_inf=decr_every, decr_ratio=decr_ratio)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+_BIG = (np.ones((8, 4)) * 1e20).astype(np.float32)  # Inf after fp16 cast
+
+
+def test_amp_scale_growth_and_backoff_events(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink.enable(path)
+    get_registry().reset()
+    main, startup, loss = _amp_program(init=4.0, incr_every=2)
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(4):  # 2 growths at incr_every=2
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        exe.run(main, feed={"x": _BIG, "y": yb}, fetch_list=[loss])
+    sink.disable()
+    reg = get_registry()
+    assert reg.counter("numerics_amp_scale_growths_total").value == 2
+    assert reg.counter("numerics_amp_scale_backoffs_total").value == 1
+    assert reg.gauge("numerics_amp_loss_scale").value == 8.0
+    recs = [json.loads(l) for l in open(path)
+            if '"amp_scale"' in l]
+    assert [r["change"] for r in recs] == ["growth", "growth",
+                                           "backoff"]
+    # events carry step numbers and the concrete scale transition
+    assert all(isinstance(r["step"], int) and r["old"] != r["new"]
+               for r in recs)
+
+
+def test_amp_overflow_step_skips_without_poisoning_params():
+    """Regression for the where()-select fix: the old keep-multiply
+    zeroing computed inf * 0 = NaN, so the overflow step it meant to
+    SKIP poisoned the parameters instead."""
+    main, startup, loss = _amp_program(init=4.0)
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        before = {p.name: np.asarray(scope.find_var(p.name)).copy()
+                  for p in main.all_parameters()}
+        exe.run(main, feed={"x": _BIG, "y": yb}, fetch_list=[loss])
+        for n, v in before.items():
+            got = np.asarray(scope.find_var(n))
+            assert np.isfinite(got).all(), f"{n} poisoned"
+            np.testing.assert_array_equal(got, v)  # skipped = unchanged
+        out = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        assert np.isfinite(out[0]).all()
+
+
+def test_amp_transient_overflow_keeps_skip_semantics_under_guard():
+    """FLAGS_check_numerics + AMP: a transient overflow (scale still
+    above the floor) must NOT raise — AMP's zero-and-shrink skip owns
+    it; the fp32 guard sees the zeroed (finite) grads."""
+    fl.set_flags({"FLAGS_check_numerics": True})
+    main, startup, loss = _amp_program(init=1024.0)
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        exe.run(main, feed={"x": _BIG, "y": yb}, fetch_list=[loss])
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+
+
+def test_amp_backoff_exhausted_trips_unified_guard(tmp_path,
+                                                   monkeypatch):
+    """ISSUE 12 satellite: an AMP overflow that pushes the scale below
+    the floor (backoff exhausted) raises BadStepError THROUGH the same
+    doctor path as the fp32 guard — numrec dump included."""
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    fl.set_flags({"FLAGS_check_numerics": True})
+    main, startup, loss = _amp_program(init=1.5, decr_ratio=0.5)
+    assert [v.name for v in main.list_vars()
+            if v.name.startswith("check_numerics_bad_amp")]
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    _, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(BadStepError) as ei:
+            for _ in range(4):
+                exe.run(main, feed={"x": _BIG, "y": yb},
+                        fetch_list=[loss])
+    assert "backoff exhausted" in str(ei.value)
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+    assert ei.value.report.get("provenance") == "op"
+
+
+def test_amp_guard_flag_off_builds_nothing():
+    main, _, _ = _amp_program()
+    assert not [v.name for v in main.list_vars()
+                if v.name.startswith("check_numerics_bad")]
+
+
+# ---------------------------------------------------------------------------
+# gradient-clip observability
+# ---------------------------------------------------------------------------
+
+
+def test_clip_global_norm_gauge_and_trigger_counter(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink.enable(path)
+    get_registry().reset()
+    fl.set_flags({"FLAGS_tensor_stats": True})
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        p = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        clip = fluid.clip.GradientClipByGlobalNorm(clip_norm=1e-3)
+        fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, grad_clip=clip).minimize(loss)
+    watches = getattr(main, "_numerics_watch", {})
+    gn = [m for m in watches.values() if m["kind"] == "clip_gnorm"]
+    assert len(gn) == 1 and gn[0]["clip_norm"] == pytest.approx(1e-3)
+    xb, yb = _data()
+    _train(main, startup, loss, [{"x": xb, "y": yb}] * 2)
+    sink.disable()
+    reg = get_registry()
+    # a random-init regression's global grad norm dwarfs 1e-3: the
+    # gauge carries the real norm and the trigger counter fired
+    assert reg.gauge("grad_global_norm").value > 1e-3
+    assert reg.counter("numerics_clip_triggered_total").value == 2
+    recs = [json.loads(l) for l in open(path)
+            if '"numerics"' in l]
+    rows = [row for r in recs if r.get("event") == "stats"
+            for row in r["watch"].values()
+            if row["kind"] == "clip_gnorm"]
+    assert rows and all(row["clipped"] for row in rows)
+
+
+def test_clip_flag_off_discards_norm_as_before():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        clip = fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0)
+        fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, grad_clip=clip).minimize(loss)
+    assert not [v.name for v in main.list_vars()
+                if v.name.startswith(numerics.STAT_PREFIX)]
+
+
+# ---------------------------------------------------------------------------
+# SDC: fingerprints + detector + bitflip rule
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_determinism_and_bit_sensitivity():
+    a = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+         "m": np.ones(5, np.float32)}
+    f1 = numerics.fingerprint_arrays(a)
+    f2 = numerics.fingerprint_arrays({k: v.copy() for k, v in a.items()})
+    assert f1 == f2
+    b = {k: v.copy() for k, v in a.items()}
+    b["w"].reshape(-1).view(np.uint32)[3] ^= 1  # one low bit
+    f3 = numerics.fingerprint_arrays(b)
+    assert f3["crc"] != f1["crc"]
+    assert f1["norm"] == pytest.approx(
+        float(np.sqrt(np.square(np.arange(12)).sum() + 5)))
+
+
+def test_fingerprint_table_majority_names_odd_rank_out():
+    t = numerics.FingerprintTable()
+    good = {"crc": 111, "norm": 1.0}
+    t.record(4, "trainer0", good, world_size=3)
+    t.record(4, "trainer1", {"crc": 999, "norm": 5.0}, world_size=3)
+    out = t.record(4, "trainer2", good, world_size=3)
+    ev = out["event"]
+    assert out["diverged"] and ev["odd_rank_out"] == ["trainer1"]
+    assert ev["method"] == "majority" and ev["step"] == 4
+
+
+def test_fingerprint_table_two_rank_tie_uses_self_consistency():
+    t = numerics.FingerprintTable()
+    t.record(2, "trainer0", {"crc": 1, "norm": 1.0,
+                             "consistent": True}, world_size=2)
+    out = t.record(2, "trainer1", {"crc": 2, "norm": 9.0,
+                                   "consistent": False}, world_size=2)
+    ev = out["event"]
+    assert ev["odd_rank_out"] == ["trainer1"]
+    assert ev["method"] == "self_check"
+
+
+def test_fingerprint_table_agreement_and_latching():
+    t = numerics.FingerprintTable()
+    fp = {"crc": 7, "norm": 1.0}
+    assert not t.record(2, "a", fp, 2)["diverged"]
+    assert not t.record(2, "b", fp, 2)["diverged"]
+    assert t.status()["events"] == []
+    t.record(4, "a", {"crc": 7, "norm": 1.0}, 2)
+    t.record(4, "b", {"crc": 8, "norm": 1.0, "consistent": False}, 2)
+    # LATCHED: a later clean-looking single report still hears about it
+    out = t.record(6, "a", {"crc": 9, "norm": 1.0}, 2)
+    assert out["diverged"] and out["event"]["step"] == 4
+
+
+def test_bitflip_rule_flips_exactly_one_element(monkeypatch):
+    monkeypatch.setenv("PADDLE_PS_FAULT_SPEC", "bitflip:myphase:2:5")
+    fl.set_flags({"FLAGS_ps_fault_injection": True})
+    faults.reset()
+    try:
+        a = np.ones(8, np.float32)
+        same = faults.bitflip_point("myphase", a)
+        assert same is a  # 1st arrival: untouched, same object
+        flipped = faults.bitflip_point("myphase", a)
+        assert flipped is not a
+        diff = np.nonzero(flipped != a)[0]
+        assert list(diff) == [5]
+        assert np.isfinite(a).all()
+        # one-shot: the rule is spent
+        assert faults.bitflip_point("myphase", a) is a
+        # wrong phase never fires
+        assert faults.bitflip_point("other", a) is a
+    finally:
+        fl.set_flags({"FLAGS_ps_fault_injection": False})
+        faults.reset()
+
+
+def test_coordinator_numerics_verbs_and_eviction(monkeypatch):
+    monkeypatch.setenv("PADDLE_SDC_EVICT", "1")
+    coord = Coordinator(lease_secs=5.0, retries_per_rank=1)
+    coord.register("trainer0")
+    coord.register("trainer1")
+    good = {"crc": 10, "norm": 1.0, "consistent": True}
+    bad = {"crc": 20, "norm": 9.0, "consistent": False}
+    assert not coord.numerics_report("trainer0", 2, good, 2)["diverged"]
+    out = coord.numerics_report("trainer1", 2, bad, 2)
+    assert out["diverged"]
+    assert out["event"]["odd_rank_out"] == ["trainer1"]
+    evs = coord.drain_events()
+    assert any(e.get("event") == "divergence" for e in evs)
+    assert any(e.get("event") == "member_evicted"
+               and e["tag"] == "trainer1" for e in evs)
+    assert coord.members["trainer1"].evicted
+    assert coord.numerics_status()["diverged"]
+
+
+def test_executor_path_publishes_fingerprints(monkeypatch):
+    """PADDLE_SDC_CHECK_EVERY + the coordinator endpoint make the
+    Executor itself publish state fingerprints every K steps."""
+    coord = Coordinator(lease_secs=5.0)
+    srv, ep = serve_coordinator(coord)
+    try:
+        monkeypatch.setenv("PADDLE_COORDINATOR_ENDPOINT", ep)
+        monkeypatch.setenv("PADDLE_SDC_CHECK_EVERY", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        numerics._reset_for_tests()
+        main, startup, loss = _linear_program()
+        xb, yb = _data()
+        _train(main, startup, loss, [{"x": xb, "y": yb}] * 4)
+        st = coord.numerics_status()
+        assert st["steps"], "no fingerprints reached the coordinator"
+        assert not st["diverged"]  # one rank cannot diverge
+        for reports in st["steps"].values():
+            (fp,) = reports.values()
+            assert fp["crc"] >= 0 and fp["norm"] > 0
+    finally:
+        stop_coordinator(srv)
+
+
+@pytest.mark.slow
+def test_bitflip_drill_two_ranks_names_corrupted_rank(tmp_path,
+                                                      monkeypatch):
+    """ISSUE 12 acceptance: 2 dp ranks, bitflip:sdc_apply:3 on rank 1
+    only — the divergence event must name trainer1 within K steps of
+    the flip, every rank must flight-dump, and PADDLE_SDC_EVICT must
+    route trainer1 to the elastic eviction path."""
+    K, flip_step = 2, 3
+    out_dir = tmp_path / "out"
+    trace_dir = tmp_path / "traces"
+    out_dir.mkdir()
+    trace_dir.mkdir()
+    monkeypatch.setenv("PADDLE_SDC_EVICT", "1")
+    coord = Coordinator(lease_secs=10.0, retries_per_rank=0)
+    srv, ep = serve_coordinator(coord)
+    try:
+        base = dict(
+            os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+            PADDLE_COORDINATOR_ENDPOINT=ep,
+            PADDLE_SDC_CHECK_EVERY=str(K), SDC_TEST_STEPS="8",
+            SDC_TEST_OUT=str(out_dir), PADDLE_TRACING="1",
+            PADDLE_TRACE_DIR=str(trace_dir),
+            FLAGS_ps_fault_injection="1",
+            PADDLE_PS_FAULT_SPEC=f"bitflip:sdc_apply:{flip_step}",
+            PADDLE_PS_FAULT_TAGS="trainer1", PADDLE_TRAINERS_NUM="2")
+        procs = []
+        for r in range(2):
+            env = dict(base, PADDLE_TRAINER_ID=str(r),
+                       PADDLE_TRAINER_TAG=f"trainer{r}")
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err
+        evs = coord.drain_events()
+        div = [e for e in evs if e.get("event") == "divergence"]
+        assert div, f"no divergence event in {evs}"
+        first = div[0]
+        # the corrupted rank is NAMED, within K steps of the flip
+        assert first["odd_rank_out"] == ["trainer1"]
+        assert flip_step <= first["step"] <= flip_step + K
+        # all ranks flight-dumped
+        dumps = sorted(f for f in os.listdir(trace_dir)
+                       if f.startswith("flightrec"))
+        assert dumps == ["flightrec.trainer0.json",
+                         "flightrec.trainer1.json"]
+        for f in dumps:
+            rec = json.load(open(trace_dir / f))
+            assert "sdc_divergence" in rec["reasons"]
+        # eviction routed through the elastic path
+        assert any(e.get("event") == "member_evicted"
+                   and e["tag"] == "trainer1" for e in evs)
+        # the UNCORRUPTED rank saw the verdict too (its own trace)
+        t0 = [json.loads(l) for l in
+              open(out_dir / "sdc.trainer0.jsonl")]
+        assert any(v["diverged"] and v["odd"] == ["trainer1"]
+                   for v in t0)
+    finally:
+        stop_coordinator(srv)
+
+
+# ---------------------------------------------------------------------------
+# /numericz + numtop CLI
+# ---------------------------------------------------------------------------
+
+
+def test_numericz_scrape(tmp_path, monkeypatch):
+    fl.set_flags({"FLAGS_tensor_stats": True})
+    debugz.stop()
+    srv = debugz.serve(port=0, host="127.0.0.1")
+    try:
+        # build into the DEFAULT programs: /numericz reads the default
+        # main program's watch roster (conftest gives each test fresh
+        # defaults)
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        xb, yb = _data()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        for _ in range(2):
+            exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+        port = srv.server_address[1]
+        page = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/numericz", timeout=5
+        ).read().decode())
+        assert page["enabled"] is True
+        assert page["watches"], "watch roster missing"
+        assert page["history"], "sampled history missing"
+        assert page["history"][-1]["event"] == "stats"
+        # the index page names the endpoint
+        root = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5).read().decode()
+        assert "/numericz" in root
+    finally:
+        debugz.stop()
+
+
+def test_numtop_metrics_mode(tmp_path, capsys):
+    path = str(tmp_path / "m.jsonl")
+    sink.enable(path)
+    fl.set_flags({"FLAGS_tensor_stats": True})
+    main, startup, loss = _linear_program()
+    xb, yb = _data()
+    _train(main, startup, loss, [{"x": xb, "y": yb}] * 3)
+    sink.disable()
+    numtop = _load_tool("numtop")
+    assert numtop.main(["--metrics", path, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["watches"]
+    grads = {k: v for k, v in out["watches"].items()
+             if v["kind"] == "grad"}
+    assert grads and all(w["samples"] == 3 and w["max_l2"] >= 0
+                         for w in grads.values())
+    # table mode renders and filters
+    assert numtop.main(["--metrics", path, "--series",
+                        "--watch", "fc_0"]) == 0
+    text = capsys.readouterr().out
+    assert "fc_0" in text and "watched series" in text
+
+
+def test_numtop_doctor_mode(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    fl.set_flags({"FLAGS_check_numerics": True})
+    main, startup, loss, mul_idx, _ = _overflow_program()
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    xb, yb = _data()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(BadStepError) as ei:
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    numtop = _load_tool("numtop")
+    assert numtop.main(["--doctor", ei.value.dump_path]) == 0
+    text = capsys.readouterr().out
+    assert f"op#{mul_idx}" in text and "elementwise_mul" in text
+    assert "user layer" in text
+
+
+def test_numtop_empty_file_exits_one(tmp_path, capsys):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    numtop = _load_tool("numtop")
+    assert numtop.main(["--metrics", str(path)]) == 1

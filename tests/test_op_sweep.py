@@ -2981,6 +2981,10 @@ def _nonzero_static():
 # ---------------------------------------------------------------------------
 
 EXEMPT = {
+    # numerics-observability reduction (ISSUE 12): emitter checked
+    # against numpy (nan/inf counts, finite max-abs/l2) in
+    # tests/test_numerics.py::test_tensor_stats_emitter_matches_numpy
+    "tensor_stats": "test_numerics.py",
     # collectives need a mesh + axis env; numerics are checked against
     # numpy on an 8-device virtual mesh in tests/test_collectives.py
     "c_allgather": "test_collectives.py",

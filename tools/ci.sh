@@ -297,6 +297,68 @@ print(f"mem_profile smoke OK: {len(mems)} mem_report record(s), "
       f"step schema intact over {len(steps)} steps")
 PY
 
+echo "== numerics lane (tensor stats + NaN doctor + SDC bitflip drill) =="
+# ISSUE 12 acceptance drills, slow lane: the 2-process bitflip drill
+# (one corrupted dp rank must be NAMED by the divergence event within
+# K steps, all ranks flight-dump, the rank is evicted) runs here; the
+# fast doctor/AMP/clip/fingerprint units run in tier-1 above
+python -m pytest tests/test_numerics.py -q -m slow
+# 3-step stats-armed train: kind="numerics" records present with the
+# per-layer stat keys AND the kind="step" schema intact
+rm -f /tmp/ci_numerics.jsonl
+PADDLE_METRICS_PATH=/tmp/ci_numerics.jsonl FLAGS_tensor_stats=1 \
+  JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = layers.data("x", [16, 8], append_batch_size=False)
+    y = layers.data("y", [16, 1], append_batch_size=False)
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor()
+exe.run(startup)
+rng = np.random.RandomState(0)
+xa = rng.rand(16, 8).astype(np.float32)
+ya = xa.sum(1, keepdims=True).astype(np.float32)
+for _ in range(3):
+    exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[loss])
+PY
+python - <<'PY'
+import json
+
+recs = [json.loads(l) for l in open("/tmp/ci_numerics.jsonl")]
+stats = [r for r in recs if r["kind"] == "numerics"
+         and r.get("event") == "stats"]
+steps = [r for r in recs if r["kind"] == "step"]
+assert len(stats) == 3, f"expected 3 sampled stat records, got {len(stats)}"
+grads = {k: v for k, v in stats[-1]["watch"].items()
+         if v["kind"] == "grad"}
+assert grads, "no per-layer gradient watches"
+for label, row in grads.items():
+    assert {"nan", "inf", "max_abs", "l2"} <= set(row), (label, row)
+    assert row["nan"] == 0 and row["inf"] == 0
+need = {"step", "data_wait_ms", "compile_ms", "device_ms", "cache_hit",
+        "ckpt_save_ms", "peak_hbm_bytes", "retraces", "ts", "rank"}
+for r in steps:
+    assert need <= set(r), f"step record missing {need - set(r)}"
+print(f"numerics smoke OK: {len(stats)} stat records over "
+      f"{len(grads)} gradient watches, step schema intact")
+PY
+# numtop smoke: the CLI must render the series the train just wrote
+JAX_PLATFORMS=cpu python tools/numtop.py --metrics /tmp/ci_numerics.jsonl \
+  --json > /tmp/ci_numtop.json
+python - <<'PY'
+import json
+
+rep = json.load(open("/tmp/ci_numtop.json"))
+grads = {k: v for k, v in rep["watches"].items() if v["kind"] == "grad"}
+assert grads and all(w["samples"] == 3 for w in grads.values()), rep
+print(f"numtop smoke OK: {len(rep['watches'])} watched series")
+PY
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""numtop — training-numerics series + NaN-doctor report viewer
+(telemetry/numerics.py; the numerics-side sibling of proftop/memtop).
+
+Two input modes:
+
+  --metrics <file.jsonl>   parse a PADDLE_METRICS_PATH sink file and
+                           render the kind="numerics" records: the
+                           per-watch stat series (per-layer gradient
+                           l2 / max-abs / nan+inf counts over the
+                           sampled steps), AMP loss-scale transitions,
+                           and any SDC divergence verdicts
+  --doctor <numrec.json>   pretty-print a NaN-provenance flight-record
+                           (the numrec.<tag>.json the bad-step guard
+                           dumps): first non-finite producer, user
+                           layer, operand stats, grad-norm history
+
+`--series` additionally prints the raw per-step rows for every watch
+(default: one summary row per watch); `--json` emits one JSON object.
+
+Examples:
+
+    python tools/numtop.py --metrics /tmp/metrics.jsonl
+    python tools/numtop.py --metrics /tmp/metrics.jsonl --series --watch fc_0
+    python tools/numtop.py --doctor /tmp/traces/numrec.trainer0.json
+    python tools/numtop.py --metrics /tmp/metrics.jsonl --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_numerics_records(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a killed writer
+            if rec.get("kind") == "numerics":
+                out.append(rec)
+    return out
+
+
+def build_series(records: List[dict]) -> dict:
+    """{watch_label: {"kind", "steps": [...], "rows": [stat dict ...]}}
+    from the event="stats" records, plus amp + divergence lists."""
+    series: Dict[str, dict] = {}
+    amp = []
+    divergences = []
+    doctors = []
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "stats":
+            for label, row in (rec.get("watch") or {}).items():
+                ent = series.setdefault(
+                    label, {"kind": row.get("kind"), "steps": [],
+                            "rows": []})
+                ent["steps"].append(rec.get("step"))
+                ent["rows"].append(row)
+        elif ev == "amp_scale":
+            amp.append(rec)
+        elif ev == "divergence":
+            divergences.append(rec)
+        elif ev == "doctor":
+            doctors.append(rec)
+    return {"series": series, "amp": amp, "divergences": divergences,
+            "doctors": doctors}
+
+
+def summarize_watch(ent: dict) -> dict:
+    rows = ent["rows"]
+    if ent.get("kind") == "clip_gnorm":
+        vals = [r.get("value", 0.0) for r in rows]
+        return {"kind": "clip_gnorm", "samples": len(rows),
+                "last": vals[-1] if vals else 0.0,
+                "max": max(vals) if vals else 0.0,
+                "clipped": sum(1 for r in rows if r.get("clipped"))}
+    return {
+        "kind": ent.get("kind"), "samples": len(rows),
+        "last_l2": rows[-1].get("l2", 0.0) if rows else 0.0,
+        "max_l2": max((r.get("l2", 0.0) for r in rows), default=0.0),
+        "max_abs": max((r.get("max_abs", 0.0) for r in rows),
+                       default=0.0),
+        "nan_steps": sum(1 for r in rows if r.get("nan")),
+        "inf_steps": sum(1 for r in rows if r.get("inf")),
+    }
+
+
+def format_metrics(data: dict, series: bool, watch: str,
+                   topk: int) -> str:
+    lines = []
+    items = [(label, ent) for label, ent in data["series"].items()
+             if watch in label]
+    # grads first (the series people page in for), then by max l2
+    items.sort(key=lambda kv: (kv[1].get("kind") != "grad",
+                               -summarize_watch(kv[1]).get(
+                                   "max_l2", summarize_watch(kv[1]).get(
+                                       "max", 0.0))))
+    lines.append(f"numtop: {len(items)} watched series"
+                 + (f" matching {watch!r}" if watch else ""))
+    lines.append(f"{'watch':<38}{'kind':>11}{'n':>5}{'last l2':>12}"
+                 f"{'max l2':>12}{'max|x|':>12}{'nan':>5}{'inf':>5}")
+    for label, ent in items[:topk]:
+        s = summarize_watch(ent)
+        if s["kind"] == "clip_gnorm":
+            lines.append(f"{label[:37]:<38}{s['kind']:>11}"
+                         f"{s['samples']:>5}{s['last']:>12.4g}"
+                         f"{s['max']:>12.4g}{'-':>12}"
+                         f"{'-':>5}{s['clipped']:>5}")
+            continue
+        lines.append(f"{label[:37]:<38}{s['kind']:>11}{s['samples']:>5}"
+                     f"{s['last_l2']:>12.4g}{s['max_l2']:>12.4g}"
+                     f"{s['max_abs']:>12.4g}{s['nan_steps']:>5}"
+                     f"{s['inf_steps']:>5}")
+    if series:
+        for label, ent in items[:topk]:
+            lines.append(f"-- {label} --")
+            for step, row in zip(ent["steps"], ent["rows"]):
+                lines.append(f"  step {step}: {json.dumps(row)}")
+    if data["amp"]:
+        lines.append("-- AMP loss-scale events --")
+        for rec in data["amp"]:
+            lines.append(f"  step {rec.get('step')}: "
+                         f"{rec.get('change')} "
+                         f"{rec.get('old')} -> {rec.get('new')}")
+    if data["divergences"]:
+        lines.append("-- SDC divergence verdicts --")
+        for rec in data["divergences"]:
+            lines.append(
+                f"  step {rec.get('detected_step')}: odd-rank-out "
+                f"{rec.get('odd_rank_out')} "
+                f"(method {rec.get('method')})")
+    if data["doctors"]:
+        lines.append("-- NaN-doctor runs --")
+        for rec in data["doctors"]:
+            where = (f"op#{rec['op_index']} [{rec.get('op_type')}] -> "
+                     f"{rec.get('output_var')!r}"
+                     if rec.get("op_index") is not None else "(no op "
+                     "attributed)")
+            lines.append(f"  {rec.get('reason')}: {where}")
+    return "\n".join(lines)
+
+
+def format_doctor(rec: dict) -> str:
+    lines = [f"numrec: {rec.get('reason', '?')}"]
+    if rec.get("provenance") == "op":
+        lines.append(f"first non-finite producer: op#{rec['op_index']} "
+                     f"[{rec['op_type']}] -> {rec['output_var']!r} "
+                     f"(slot {rec.get('output_slot')})")
+        uf = rec.get("user_frame")
+        if uf:
+            lines.append(f"user layer: {uf[0]}:{uf[1]} in {uf[2]}")
+        st = rec.get("output_stats") or {}
+        lines.append(f"output: nan={st.get('nan')} inf={st.get('inf')} "
+                     f"max|x|={st.get('max_abs')} l2={st.get('l2')}")
+        lines.append("operands:")
+        for op in rec.get("operands") or []:
+            s = op.get("stats") or {}
+            lines.append(
+                f"  {op.get('slot')}:{op.get('var')} "
+                f"nan={s.get('nan')} inf={s.get('inf')} "
+                f"max|x|={s.get('max_abs')} l2={s.get('l2')}")
+    elif rec.get("provenance") == "input":
+        s = rec.get("stats") or {}
+        lines.append(f"poisoned INPUT {rec.get('var')!r}: "
+                     f"nan={s.get('nan')} inf={s.get('inf')} — the "
+                     f"step did not produce the non-finite values, the "
+                     f"feed/state carried them in")
+    else:
+        lines.append(f"bisection: "
+                     f"{rec.get('bisect_skipped') or rec.get('bisect_error') or '?'}")
+    hist = rec.get("grad_history") or []
+    if hist:
+        lines.append(f"grad-norm history leading in "
+                     f"({len(hist)} samples):")
+        for h in hist[-8:]:
+            grads = {label: round(row.get('l2', 0.0), 6)
+                     for label, row in (h.get("watch") or {}).items()
+                     if row.get("kind") == "grad"}
+            lines.append(f"  step {h.get('step')}: {json.dumps(grads)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="numtop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--metrics",
+                    help="PADDLE_METRICS_PATH JSONL file to render")
+    ap.add_argument("--doctor",
+                    help="numrec.<tag>.json NaN flight-record to render")
+    ap.add_argument("--watch", default="",
+                    help="substring filter over watch labels")
+    ap.add_argument("--series", action="store_true",
+                    help="print the raw per-step rows too")
+    ap.add_argument("--topk", type=int, default=30)
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object on stdout")
+    args = ap.parse_args(argv)
+    if bool(args.metrics) == bool(args.doctor):
+        ap.error("exactly one of --metrics / --doctor is required")
+
+    if args.doctor:
+        rec = json.load(open(args.doctor))
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            print(format_doctor(rec))
+        return 0
+
+    records = load_numerics_records(args.metrics)
+    data = build_series(records)
+    if args.json:
+        out = {
+            "watches": {label: dict(summarize_watch(ent),
+                                    steps=ent["steps"],
+                                    rows=ent["rows"])
+                        for label, ent in data["series"].items()
+                        if args.watch in label},
+            "amp": data["amp"],
+            "divergences": data["divergences"],
+            "doctors": data["doctors"],
+        }
+        print(json.dumps(out))
+    else:
+        print(format_metrics(data, args.series, args.watch, args.topk))
+    if not records:
+        print("numtop: no kind=\"numerics\" records found (run with "
+              "FLAGS_tensor_stats=1 and PADDLE_METRICS_PATH set)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
