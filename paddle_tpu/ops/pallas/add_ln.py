@@ -31,22 +31,52 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...tuning import feasible as _feas
 from .flash_attention import _identity, _interpret, _to_lanes, _to_sublanes
 
-_LN_VMEM_BUDGET = 10 * 1024 * 1024
+# single source shared with the autotuner's feasibility gate
+_LN_VMEM_BUDGET = _feas.LN_VMEM_BUDGET
+
+_ROW_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8)
 
 
-def _pick_rows(r, h):
-    """Largest row block that tiles r under the VMEM budget (x, y, out
-    blocks double-buffered bf16 + ~4 f32 temporaries per row block)."""
-    for cand in (1024, 512, 256, 128, 64, 32, 16, 8):
-        if r % cand == 0 and cand * h * (3 * 2 * 2 + 4 * 4) <= _LN_VMEM_BUDGET:
+def default_ln_rows(r, h):
+    """THE hand-picked row-block chooser (the autotune cache-miss
+    fallback): largest row block that tiles r under the VMEM budget
+    (x, y, out blocks double-buffered bf16 + ~4 f32 temporaries per
+    row block). None when nothing tiles."""
+    for cand in _ROW_CANDIDATES:
+        if r % cand == 0 and _feas.ln_vmem_bytes(cand, h) <= _LN_VMEM_BUDGET:
             return cand
     return None
 
 
+_pick_rows = default_ln_rows  # historical name
+
+
+def _resolve_ln_rows(r, h, dtype):
+    """Row block for one kernel launch: FLAGS_kernel_autotune cache
+    entry (validated against divisibility + the VMEM budget) or the
+    hand-picked default. fwd and bwd resolve through the same entry, so
+    the saved [1, R] stats always re-block consistently."""
+    from ... import tuning
+
+    key = {"r": r, "h": h, "dtype": str(dtype)}
+    cfg = tuning.maybe_lookup("add_ln", key)
+    if cfg:
+        try:
+            rows = int(cfg.get("block_rows", 0))
+        except (TypeError, ValueError):
+            rows = 0
+        ok, _why = _feas.ln_rows_ok(r, h, rows)
+        if ok:
+            return rows
+        tuning.note_choice("add_ln", key, None, "default")
+    return default_ln_rows(r, h)
+
+
 def ln_shapes_ok(r, h) -> bool:
-    return h % 128 == 0 and _pick_rows(r, h) is not None
+    return h % 128 == 0 and default_ln_rows(r, h) is not None
 
 
 def _fwd_kernel(*refs, eps, has_y, br):
@@ -105,7 +135,7 @@ def _bwd_kernel(*refs, has_y, br):
 
 def _ln_fwd(x, y, scale, shift, *, eps):
     r, h = x.shape
-    br = _pick_rows(r, h)
+    br = _resolve_ln_rows(r, h, x.dtype)
     has_y = y is not None
     row_spec = pl.BlockSpec((br, h), lambda i: (i, 0), memory_space=pltpu.VMEM)
     vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM)
@@ -129,7 +159,7 @@ def _ln_fwd(x, y, scale, shift, *, eps):
 
 def _ln_bwd(x, y, scale, mean, rstd, g, *, eps):
     r, h = x.shape
-    br = _pick_rows(r, h)
+    br = _resolve_ln_rows(r, h, x.dtype)
     has_y = y is not None
     row_spec = pl.BlockSpec((br, h), lambda i: (i, 0), memory_space=pltpu.VMEM)
     vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM)
@@ -213,9 +243,12 @@ def fused_add_ln(x, y, scale, shift, eps=1e-5):
     for d in shape[:-1]:
         r *= d
     if not ln_shapes_ok(r, h):
-        raise ValueError(
-            f"fused_add_ln: rows={r}, hidden={h} not tileable (gate with "
-            f"fused_ln_dispatch_ok)")
+        raise _feas.NoFeasibleConfig(
+            "add_ln", {"r": r, "h": h},
+            [({"block_rows": c}, _feas.ln_rows_ok(r, h, c)[1])
+             for c in _ROW_CANDIDATES],
+            detail=("hidden dim must be a multiple of 128"
+                    if h % 128 else "gate with fused_ln_dispatch_ok"))
     core = _make_core(float(eps), y is not None)
     out = core(
         x.reshape(r, h),

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from ... import compat as _compat
+from ...tuning import feasible as _feas
 from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -77,7 +78,10 @@ def _pick_block(s):
     for cand in (512, 256, 128):
         if s % cand == 0:
             return cand
-    raise ValueError(f"seq {s} not a multiple of {MIN_BLOCK}")
+    raise _feas.NoFeasibleConfig(
+        "flash", {"s": s},
+        [({"block": c}, f"{s} % {c} != 0") for c in (512, 256, 128)],
+        detail=f"seq must be a multiple of {MIN_BLOCK}")
 
 
 def _scan_groups(bh, env_var, fits):
@@ -1371,8 +1375,12 @@ def _make_fwd_bsh_kernel(*, sm_scale, causal, dropout_prob, has_bias,
     return kernel
 
 
-def _pick_block_bsh(s, skv, h, bwd=False, sync_bwd=False):
-    """BSH kernels tolerate bigger tiles than the streamed BHSD path
+def default_bsh_block(s, skv, h, bwd=False, sync_bwd=False):
+    """THE hand-picked BSH tile chooser (the autotune cache-miss
+    fallback — tuning/search.py replaces it per shape when a measured
+    winner exists; see _resolve_bsh_blocks).
+
+    BSH kernels tolerate bigger tiles than the streamed BHSD path
     (whole-sequence VMEM residency is already the design): at S>=4096 a
     1024 tile measured 0.4266 vs 0.4240 MFU (BERT-base s4096/b8, v5e) —
     fewer block iterations amortize the per-block softmax epilogue.
@@ -1404,14 +1412,55 @@ def _pick_block_bsh(s, skv, h, bwd=False, sync_bwd=False):
     return _pick_block(s)
 
 
+_pick_block_bsh = default_bsh_block  # historical name (round-5 sweeps)
+
+
+def _resolve_bsh_blocks(sq, skv, h, dtype, *, bwd=False, sync_bwd=False):
+    """(bq, bk, vmem_limit_bytes) for one BSH kernel launch.
+
+    Precedence: PADDLE_FLASH_BLOCK env override (hand sweeps) >
+    FLAGS_kernel_autotune cache entry > default_bsh_block heuristic.
+    One cache entry serves fwd AND bwd (in-kernel PRNG dropout must
+    regenerate identical per-block masks, which requires identical
+    tiles), so a cached config is validated against BOTH footprint
+    models before it is trusted; an invalid or missing entry falls back
+    to the hand-picked chooser — no behavior cliff."""
+    import os
+
+    key = {"sq": sq, "skv": skv, "h": h, "dtype": str(dtype)}
+    if not int(os.environ.get("PADDLE_FLASH_BLOCK", "0")):
+        from ... import tuning
+
+        cfg = tuning.maybe_lookup("flash_bsh", key)
+        if cfg:
+            try:
+                bq = int(cfg.get("bq", 0))
+                bk = int(cfg.get("bk", 0))
+                limit = (int(cfg["vmem_limit_mb"]) * 2**20
+                         if cfg.get("vmem_limit_mb") else _BSH_VMEM_LIMIT)
+            except (TypeError, ValueError):
+                bq = bk = 0
+                limit = _BSH_VMEM_LIMIT
+            ok, _why = _feas.flash_bsh_ok(sq, skv, h, bq, bk, limit=limit)
+            if ok:
+                return bq, bk, limit
+            # bad entry (edited by hand / stale shape): hand-picked path
+            tuning.note_choice("flash_bsh", key, None, "default")
+    return (
+        default_bsh_block(sq, skv, h, bwd=bwd, sync_bwd=sync_bwd),
+        default_bsh_block(skv, skv, h, bwd=bwd, sync_bwd=sync_bwd),
+        _BSH_VMEM_LIMIT,
+    )
+
+
 def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
                    causal, dropout_prob):
     b, sq, hdim = q.shape
     skv = k.shape[1]
     d = hdim // nh
     use_prng = dropout_prob > 0.0 and mask is None
-    bq = _pick_block_bsh(sq, skv, hdim, sync_bwd=use_prng)
-    bk = _pick_block_bsh(skv, skv, hdim, sync_bwd=use_prng)
+    bq, bk, vmem_limit = _resolve_bsh_blocks(
+        sq, skv, hdim, q.dtype, sync_bwd=use_prng)
     has_mask = mask is not None and dropout_prob > 0.0
     has_offsets = offsets is not None
     has_bias = bias is not None
@@ -1463,7 +1512,7 @@ def _flash_fwd_bsh(q, k, v, bias, mask, seed, offsets, *, sm_scale, nh,
             jax.ShapeDtypeStruct((b, nh, sq), jnp.float32),
         ],
         compiler_params=_compat.tpu_compiler_params(
-            vmem_limit_bytes=_BSH_VMEM_LIMIT),
+            vmem_limit_bytes=vmem_limit),
         interpret=_interpret(),
     )(*args)
     return o, lse
@@ -1604,9 +1653,9 @@ def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
     b, sq, hdim = q.shape
     skv = k.shape[1]
     d = hdim // nh
-    bq = _pick_block_bsh(sq, skv, hdim, bwd=True)
-    bk = _pick_block_bsh(skv, skv, hdim, bwd=True)
     use_prng = dropout_prob > 0.0 and mask is None
+    bq, bk, vmem_limit = _resolve_bsh_blocks(
+        sq, skv, hdim, q.dtype, bwd=True, sync_bwd=use_prng)
     has_mask = mask is not None and dropout_prob > 0.0
     has_offsets = offsets is not None
     has_bias = bias is not None
@@ -1661,7 +1710,7 @@ def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
             jax.ShapeDtypeStruct((b, skv, hdim), v.dtype),
         ],
         compiler_params=_compat.tpu_compiler_params(
-            vmem_limit_bytes=_BSH_VMEM_LIMIT),
+            vmem_limit_bytes=vmem_limit),
         interpret=_interpret(),
     )(*args)
     return dq.astype(q.dtype), dk, dv
@@ -1673,8 +1722,10 @@ def _flash_bwd_bsh(res, g, *, sm_scale, nh, causal, dropout_prob):
 # below what the hardware allows, so raise it for these calls. Past the
 # estimate below, dispatch falls back to the BHSD kernels (streamed
 # blocks, head-transposed layout) — and beyond single-chip HBM, shard
-# the sequence (ring attention over "sp") instead.
-_BSH_VMEM_LIMIT = 112 * 1024 * 1024
+# the sequence (ring attention over "sp") instead. The byte value lives
+# in tuning/feasible.py so the autotuner's feasibility gate and the
+# kernel can never disagree about the budget.
+_BSH_VMEM_LIMIT = _feas.BSH_VMEM_LIMIT
 
 
 def bsh_shapes_ok(sq, skv, h) -> bool:
@@ -1709,6 +1760,20 @@ def bsh_dispatch_ok(sq, skv, h, num_heads, bias=None, batch=None,
         return False
     return (bn == 1 and bq_ == 1 and bk_ == skv
             and (batch is None or bb == batch))
+
+
+def _bsh_mask_materialize(sq, skv, h, dtype) -> bool:
+    """The tuned dropout-mask axis (ISSUE 13): an autotune cache entry
+    with {'mask': 'materialize'} precomputes the [B, nh, Sq, Skv] keep
+    mask with the traced PRNG (one HBM-resident tensor read by both
+    passes; the search harness's HBM gate rejects it where it cannot
+    fit) instead of regenerating it from the in-kernel hardware PRNG.
+    Identical dropout MATH either way — only the mask's source moves."""
+    from ... import tuning
+
+    cfg = tuning.maybe_lookup(
+        "flash_bsh", {"sq": sq, "skv": skv, "h": h, "dtype": str(dtype)})
+    return bool(cfg) and cfg.get("mask") == "materialize"
 
 
 @functools.lru_cache(maxsize=256)
@@ -1775,7 +1840,12 @@ def flash_attention_bsh(q, k, v, bias=None, num_heads=None, sm_scale=None,
                 dtype=jnp.int32)
         else:
             raise ValueError("dropout needs dropout_key or dropout_seed")
-        if _interpret():
+        # mask source is a tuned axis: interpret mode (no hardware PRNG)
+        # and a cache entry saying {'mask': 'materialize'} both
+        # precompute the keep mask outside the kernel; the default
+        # regenerates it from the in-kernel PRNG with zero HBM traffic
+        if _interpret() or _bsh_mask_materialize(sq, k.shape[1], hdim,
+                                                 q.dtype):
             mkey = dropout_key if dropout_key is not None else (
                 jax.random.PRNGKey(seed[0]))
             mask = jax.random.bernoulli(

@@ -223,6 +223,31 @@ class CostReport:
     def top(self, k: int = 20) -> List[CostRow]:
         return sorted(self.rows, key=lambda r: -r.device_ms)[:k]
 
+    # -- programmatic per-op queries (ISSUE 13: the autotuner ranks a
+    # candidate by ITS OWN measured device time, not the whole step's) --
+    def rows_for(self, op_type: Optional[str] = None,
+                 op_index: Optional[int] = None) -> List[CostRow]:
+        """Attributed rows filtered by op type and/or Program IR op
+        index (None = don't filter on that axis)."""
+        out = []
+        for r in self.rows:
+            if op_type is not None and r.op_type != op_type:
+                continue
+            if op_index is not None and r.op_index != op_index:
+                continue
+            out.append(r)
+        return out
+
+    def device_ms_for(self, op_type: Optional[str] = None,
+                      op_index: Optional[int] = None,
+                      per_step: bool = True) -> float:
+        """Total attributed device time (ms) of the matching op scopes —
+        per profiled step by default, over the whole window with
+        per_step=False. 0.0 when nothing matched (caller decides whether
+        to fall back to wall latency)."""
+        total = sum(r.device_ms for r in self.rows_for(op_type, op_index))
+        return total / self.steps if per_step else total
+
     def to_json(self, topk: Optional[int] = None) -> dict:
         rows = self.top(topk) if topk else sorted(
             self.rows, key=lambda r: -r.device_ms)

@@ -1,0 +1,154 @@
+"""Pallas kernel autotuner (ISSUE 13): per-(kernel, shape, dtype, chip)
+config search with a persistent tuning cache.
+
+The three Pallas kernels (flash attention BSH, fused add+LayerNorm,
+fused conv+BN) consult this package at trace time behind
+FLAGS_kernel_autotune. Lookups resolve against the merged active cache
+(tuning/cache.py: repo defaults <- user cache <- $PADDLE_AUTOTUNE_CACHE)
+and every decision — cache hit or hand-picked fallback — is recorded so
+bench rows can report exactly which configs produced a number.
+
+Contracts the rest of the system relies on:
+  * flag OFF: no lookup runs, the kernels use their hand-picked
+    choosers — emitted programs are bit-identical to a build without
+    this package.
+  * flag ON + empty cache: `maybe_lookup` returns None and the kernels
+    fall back to the same hand-picked configs (no behavior cliff).
+  * the chosen-config surface rides the Executor compile-cache key via
+    `cache_signature()`, so editing the cache (or tuning.override in
+    tests/search) retraces instead of silently reusing a stale step.
+
+Search side: tuning/search.py (harness), tools/autotune.py (CLI),
+tools/op_bench.py (the shared single-op measurement fence).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+from .cache import (  # noqa: F401 — public API re-exports
+    CACHE_VERSION,
+    TuningCache,
+    canonical_key,
+    chip_kind,
+    default_cache_path,
+    load_active_cache,
+    user_cache_path,
+)
+from .feasible import NoFeasibleConfig  # noqa: F401
+
+_lock = threading.Lock()
+_active: Optional[TuningCache] = None
+_choices: Dict[str, Dict[str, Any]] = {}
+
+
+def enabled() -> bool:
+    """FLAGS_kernel_autotune. Imported lazily: tuning must be usable by
+    offline tools with no framework import."""
+    try:
+        from ..fluid.flags import flag
+    except Exception:  # noqa: BLE001 — standalone/offline use
+        return False
+    return bool(flag("FLAGS_kernel_autotune"))
+
+
+def active_cache() -> TuningCache:
+    """The merged cache for this process, loaded once (reload() after
+    editing cache files or env vars mid-process)."""
+    global _active
+    with _lock:
+        if _active is None:
+            _active = load_active_cache()
+        return _active
+
+
+def reload() -> TuningCache:
+    global _active
+    with _lock:
+        _active = None
+    return active_cache()
+
+
+def cache_fingerprint() -> str:
+    return active_cache().fingerprint()
+
+
+def cache_signature() -> Optional[str]:
+    """What the Executor folds into its compile-cache key: None when
+    the flag is off (key unchanged vs a build without this package),
+    else the active cache fingerprint."""
+    if not enabled():
+        return None
+    return cache_fingerprint()
+
+
+def maybe_lookup(kernel: str, key: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The kernels' trace-time hook: None when the flag is off or the
+    cache has no entry (callers then use their hand-picked chooser);
+    else a copy of the winning config dict. Every flag-on resolution is
+    recorded for `chosen_configs()` — callers that REJECT a returned
+    config (failed validation) should re-record via note_choice."""
+    if not enabled():
+        return None
+    ck = canonical_key(key)
+    entry = active_cache().get(kernel, ck)
+    if entry is None:
+        note_choice(kernel, ck, None, "default")
+        return None
+    cfg = entry.get("config")
+    if not isinstance(cfg, dict):
+        note_choice(kernel, ck, None, "default")
+        return None
+    note_choice(kernel, ck, dict(cfg), "cache")
+    return dict(cfg)
+
+
+def note_choice(kernel: str, key: Any, config: Optional[Dict[str, Any]],
+                source: str) -> None:
+    """Record the config actually used for (kernel, key) this process —
+    source 'cache' (tuned) or 'default' (hand-picked fallback)."""
+    ck = key if isinstance(key, str) else canonical_key(key)
+    with _lock:
+        _choices[f"{kernel}[{ck}]"] = {
+            "kernel": kernel, "key": ck, "config": config, "source": source,
+        }
+
+
+def chosen_configs() -> Dict[str, Dict[str, Any]]:
+    """Per-kernel chosen configs recorded during tracing — bench rows
+    persist this next to the autotune cache hash so perf numbers stay
+    reproducible."""
+    with _lock:
+        return {k: dict(v) for k, v in _choices.items()}
+
+
+def clear_choices() -> None:
+    with _lock:
+        _choices.clear()
+
+
+@contextlib.contextmanager
+def override(entries: Dict[str, Dict[str, Dict[str, Any]]],
+             chip: Optional[str] = None):
+    """Swap the active cache for a synthetic one ({kernel: {key:
+    entry}}) for the duration — the search harness measures each
+    candidate through EXACTLY the production lookup path this way, and
+    tests pin configs without touching disk. Entries may be either the
+    full {'config': {...}} schema or a bare config dict."""
+    global _active
+    norm: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for kernel, keys in entries.items():
+        norm[kernel] = {}
+        for key, entry in keys.items():
+            if "config" not in entry:
+                entry = {"config": dict(entry)}
+            norm[kernel][key] = entry
+    with _lock:
+        prev = _active
+        _active = TuningCache(chip or chip_kind(), norm)
+    try:
+        yield _active
+    finally:
+        with _lock:
+            _active = prev

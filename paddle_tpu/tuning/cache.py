@@ -1,0 +1,217 @@
+"""Persistent per-chip kernel-tuning cache (ISSUE 13).
+
+One versioned JSON file per chip kind holds the winning kernel configs
+the search harness (tuning/search.py, tools/autotune.py) measured for
+each (kernel, shape, dtype) key. The Pallas kernels consult the ACTIVE
+cache at trace time behind FLAGS_kernel_autotune; a missing entry falls
+back to the hand-picked heuristic, so an empty cache is behaviorally
+identical to the flag being off.
+
+Resolution order of the active cache (later layers override earlier):
+
+  1. in-repo defaults   paddle_tpu/tuning/defaults/<chip>.json
+                        (checked in — the v5e winners the round-3/5
+                        hand measurements already established)
+  2. user cache         $XDG_CACHE_HOME|~/.cache/paddle_tpu/autotune/<chip>.json
+                        (where `tools/autotune.py search` persists)
+  3. explicit override  $PADDLE_AUTOTUNE_CACHE (a file path — CI and
+                        tests pin the search to a scratch file)
+
+A file whose `version` does not match CACHE_VERSION or whose `chip`
+does not match the running chip is IGNORED (stale caches from another
+software rev or another accelerator must never supply configs), with a
+one-line stderr notice.
+
+Schema (canonical dump: sorted keys, indent 1, trailing newline — the
+byte-stable form the CI cache-reuse assertion compares):
+
+    {
+      "version": 1,
+      "chip": "v5e",
+      "entries": {
+        "<kernel>": {
+          "<canonical key>": {
+            "config": {...},        # what the kernel's resolver reads
+            "us": 123.4,            # objective at search time (optional)
+            "source": "op_profile"  # how it was measured (optional)
+          }
+        }
+      }
+    }
+
+stdlib-only on purpose: tools/autotune.py `show`/`diff` and the
+launcher-side consumers must work with no accelerator runtime.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+CACHE_VERSION = 1
+
+_DEFAULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "defaults")
+
+
+def canonical_key(key: Dict[str, Any]) -> str:
+    """Deterministic string form of a kernel lookup key: 'a=1,b=x'
+    sorted by field name. Values are rendered compactly (ints stay
+    ints, dtypes are str()'d) so the same logical key always produces
+    the same string."""
+    parts = []
+    for k in sorted(key):
+        v = key[k]
+        if isinstance(v, bool):
+            v = int(v)
+        elif not isinstance(v, (int, float, str)):
+            # dtype-likes: np.dtype has .name, scalar-type classes have
+            # __name__ — 'float32' either way, so jnp.float32,
+            # np.dtype('float32') and 'float32' all key identically
+            v = (getattr(v, "name", None) or getattr(v, "__name__", None)
+                 or str(v))
+        parts.append(f"{k}={v}")
+    return ",".join(parts)
+
+
+def chip_kind() -> str:
+    """Normalized chip family for cache naming ('v5e', 'v4', 'cpu',
+    ...). PADDLE_AUTOTUNE_CHIP overrides (tests, offline tooling);
+    without a usable jax backend the answer is 'cpu'."""
+    forced = os.environ.get("PADDLE_AUTOTUNE_CHIP")
+    if forced:
+        return forced
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — offline tooling has no backend
+        return "cpu"
+    for tag in ("v5 lite", "v5e"):
+        if tag in kind:
+            return "v5e"
+    for tag in ("v5p", "v6", "v4", "v3", "v2"):
+        if tag in kind:
+            return tag
+    if "tpu" in kind:
+        return kind.replace(" ", "_")
+    return "cpu"
+
+
+def user_cache_path(chip: Optional[str] = None) -> str:
+    """~/.cache/paddle_tpu/autotune/<chip>.json (XDG-aware) — where
+    `tools/autotune.py search` persists winners by default."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "paddle_tpu", "autotune",
+                        f"{chip or chip_kind()}.json")
+
+
+def default_cache_path(chip: Optional[str] = None) -> str:
+    """The file search results are WRITTEN to: $PADDLE_AUTOTUNE_CACHE
+    when set (CI / tests pin the whole search to one scratch file),
+    else the user cache."""
+    return os.environ.get("PADDLE_AUTOTUNE_CACHE") or user_cache_path(chip)
+
+
+def repo_default_path(chip: str) -> str:
+    return os.path.join(_DEFAULTS_DIR, f"{chip}.json")
+
+
+class TuningCache:
+    """In-memory view of one cache layer (or the merged active view)."""
+
+    def __init__(self, chip: str, entries: Optional[Dict] = None,
+                 path: Optional[str] = None):
+        self.chip = chip
+        self.entries: Dict[str, Dict[str, Dict[str, Any]]] = entries or {}
+        self.path = path
+
+    # -- access ---------------------------------------------------------
+    def get(self, kernel: str, key: str) -> Optional[Dict[str, Any]]:
+        return self.entries.get(kernel, {}).get(key)
+
+    def put(self, kernel: str, key: str, entry: Dict[str, Any]) -> None:
+        self.entries.setdefault(kernel, {})[key] = entry
+
+    def merge_from(self, other: "TuningCache") -> None:
+        """Overlay `other`'s entries on top of self (other wins)."""
+        for kernel, keys in other.entries.items():
+            for key, entry in keys.items():
+                self.put(kernel, key, entry)
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self.entries.values())
+
+    # -- persistence ----------------------------------------------------
+    def to_blob(self) -> str:
+        """THE canonical byte form (fingerprint + CI byte-identity both
+        hash/compare exactly this)."""
+        return json.dumps(
+            {"version": CACHE_VERSION, "chip": self.chip,
+             "entries": self.entries},
+            sort_keys=True, indent=1) + "\n"
+
+    def fingerprint(self) -> str:
+        return hashlib.sha256(self.to_blob().encode()).hexdigest()[:16]
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path or default_cache_path(self.chip)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_blob())
+        os.replace(tmp, path)
+        self.path = path
+        return path
+
+    @classmethod
+    def load(cls, path: str, expect_chip: Optional[str] = None,
+             ) -> Tuple[Optional["TuningCache"], Optional[str]]:
+        """(cache, None) on success; (None, reason) when the file is
+        absent, unreadable, from another cache version, or from another
+        chip — every rejection reason is a string the caller may
+        surface."""
+        if not os.path.exists(path):
+            return None, "absent"
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            return None, f"unreadable ({e})"
+        if not isinstance(raw, dict):
+            return None, "malformed (not an object)"
+        if raw.get("version") != CACHE_VERSION:
+            return None, (f"version mismatch (file {raw.get('version')!r}, "
+                          f"want {CACHE_VERSION})")
+        chip = raw.get("chip")
+        if expect_chip is not None and chip != expect_chip:
+            return None, f"chip mismatch (file {chip!r}, running {expect_chip!r})"
+        entries = raw.get("entries")
+        if not isinstance(entries, dict):
+            return None, "malformed (entries not an object)"
+        return cls(chip or (expect_chip or "cpu"), entries, path=path), None
+
+
+def load_active_cache(chip: Optional[str] = None,
+                      verbose: bool = False) -> TuningCache:
+    """Merge the cache layers for the running chip: repo defaults <-
+    user cache <- $PADDLE_AUTOTUNE_CACHE. Invalid layers are skipped
+    (version/chip mismatch = stale; never a hard error)."""
+    chip = chip or chip_kind()
+    merged = TuningCache(chip)
+    layers = [repo_default_path(chip), user_cache_path(chip)]
+    env = os.environ.get("PADDLE_AUTOTUNE_CACHE")
+    if env:
+        layers.append(env)
+    for path in layers:
+        cache, reason = TuningCache.load(path, expect_chip=chip)
+        if cache is None:
+            if verbose and reason != "absent":
+                print(f"# autotune cache {path} ignored: {reason}",
+                      file=sys.stderr)
+            continue
+        merged.merge_from(cache)
+    return merged

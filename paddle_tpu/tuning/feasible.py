@@ -1,0 +1,164 @@
+"""VMEM-footprint models — the feasibility gate candidates must pass
+BEFORE they are ever timed (a config that OOMs scoped VMEM wastes a
+compile + a device fault; rejecting it up front is free).
+
+These are the SAME models the kernels' hand-picked fallback choosers
+use (the kernel modules import the budgets and estimators from here so
+the two can never drift): calibrated on v5e against Mosaic's
+scoped-vmem report — see the per-function notes. All pure stdlib math;
+nothing here imports jax.
+
+The HBM side of the gate is tools/memtop.py --budget (the static
+live-range peak over the whole program); tuning/search.py applies it
+through the `hbm_gate` hook for candidates that add HBM-resident
+tensors (e.g. a materialized dropout mask).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+# scoped-VMEM budgets (bytes). The BSH flash kernels raise Mosaic's
+# scoped limit to 112MB of the 128MB/core (whole-sequence residency is
+# the design); the row-blocked kernels stay under the default ~16MB.
+BSH_VMEM_LIMIT = 112 * 1024 * 1024
+LN_VMEM_BUDGET = 10 * 1024 * 1024
+CONV_BN_VMEM_BUDGET = 12 * 1024 * 1024
+
+_DTYPE_BYTES = {
+    "float32": 4, "f32": 4, "bfloat16": 2, "bf16": 2, "float16": 2,
+    "f16": 2, "float64": 8,
+}
+
+
+def dtype_bytes(dtype: Any) -> int:
+    return _DTYPE_BYTES.get(str(dtype), 4)
+
+
+class NoFeasibleConfig(ValueError):
+    """No candidate configuration can serve this kernel shape.
+
+    Subclasses ValueError so pre-existing `except ValueError` dispatch
+    guards keep working; carries the candidates that were considered
+    and why each was rejected, so 'not tileable' errors name what was
+    actually tried instead of a bare complaint."""
+
+    def __init__(self, kernel: str, key: Dict[str, Any],
+                 tried: List[Tuple[Any, str]], detail: str = ""):
+        self.kernel = kernel
+        self.key = dict(key)
+        self.tried = list(tried)
+        head = f"{kernel}: no feasible kernel config for {key}"
+        if detail:
+            head += f" ({detail})"
+        if tried:
+            head += "; tried: " + "; ".join(
+                f"{cfg} -> {why}" for cfg, why in tried[:8])
+            if len(tried) > 8:
+                head += f"; ... {len(tried) - 8} more"
+        super().__init__(head)
+
+
+# ---------------------------------------------------------------------------
+# flash attention, BSH layout
+# ---------------------------------------------------------------------------
+
+
+def flash_bsh_fwd_vmem_bytes(sq: int, skv: int, h: int, bq: int,
+                             bk: int) -> int:
+    """Forward kernel footprint: k/v whole-sequence resident
+    (double-buffered, <=2B elems -> 8 B/elem), q/o blocks, plus the
+    per-tile f32 score temporaries (~40 B per bq*bk tile element — the
+    calibration that reproduces the '~40MB of 1024-tile temporaries'
+    v5e measurement in ops/pallas/flash_attention.py)."""
+    return 8 * skv * h + 8 * bq * h + 40 * bq * bk
+
+
+def flash_bsh_bwd_vmem_bytes(sq: int, skv: int, h: int, bq: int,
+                             bk: int) -> int:
+    """Backward kernel footprint: q/do double-buffered bf16 + the dq
+    f32 revisited accumulator (~12 B/elem of the full sq*h residency
+    — reproduces the measured 124MB at (s8192, h768, bq1024) vs the
+    112MB limit), k/v/dk/dv blocks, score temporaries."""
+    return 12 * sq * h + 8 * bk * h + 40 * bq * bk
+
+
+def flash_bsh_ok(sq: int, skv: int, h: int, bq: int, bk: int,
+                 *, limit: int = BSH_VMEM_LIMIT) -> Tuple[bool, str]:
+    """(feasible, reason). A config serves BOTH passes (PRNG dropout
+    must regenerate identical per-block masks in fwd and bwd), so both
+    footprints must fit."""
+    if bq < 128 or bk < 128:
+        return False, "block below the 128 tiling minimum"
+    if sq % bq or skv % bk:
+        return False, f"blocks ({bq},{bk}) do not tile (sq={sq}, skv={skv})"
+    f = flash_bsh_fwd_vmem_bytes(sq, skv, h, bq, bk)
+    if f > limit:
+        return False, f"fwd VMEM estimate {f} > {limit}"
+    b = flash_bsh_bwd_vmem_bytes(sq, skv, h, bq, bk)
+    if b > limit:
+        return False, f"bwd VMEM estimate {b} > {limit}"
+    return True, "ok"
+
+
+# ---------------------------------------------------------------------------
+# fused residual-add + LayerNorm
+# ---------------------------------------------------------------------------
+
+
+def ln_vmem_bytes(rows: int, h: int) -> int:
+    """x, y, out row blocks double-buffered bf16-worst + ~4 f32
+    temporaries per row element (the ops/pallas/add_ln.py model)."""
+    return rows * h * (3 * 2 * 2 + 4 * 4)
+
+
+def ln_rows_ok(r: int, h: int, rows: int,
+               *, budget: int = LN_VMEM_BUDGET) -> Tuple[bool, str]:
+    if rows < 1 or r % rows:
+        return False, f"row block {rows} does not tile r={r}"
+    est = ln_vmem_bytes(rows, h)
+    if est > budget:
+        return False, f"VMEM estimate {est} > {budget}"
+    return True, "ok"
+
+
+# ---------------------------------------------------------------------------
+# fused conv + batch-norm
+# ---------------------------------------------------------------------------
+
+
+def conv_bn_row_bytes(rows: int, width: int, bytes_per_row_unit: int) -> int:
+    """Row-blocked passes (1x1 matmul / normalize / backward sweeps):
+    in+out blocks double-buffered + the f32 accumulator, expressed as
+    bytes per row*width unit exactly as ops/pallas/conv_bn.py sizes
+    them."""
+    return rows * width * bytes_per_row_unit
+
+
+def conv_bn_rows_ok(r: int, width: int, rows: int, bytes_per_row_unit: int,
+                    *, budget: int = CONV_BN_VMEM_BUDGET) -> Tuple[bool, str]:
+    if rows < 1 or r % rows:
+        return False, f"row block {rows} does not tile r={r}"
+    est = conv_bn_row_bytes(rows, width, bytes_per_row_unit)
+    if est > budget:
+        return False, f"VMEM estimate {est} > {budget}"
+    return True, "ok"
+
+
+def conv_bn_s2d_per_image_bytes(hp: int, wp: int, c: int, o: int,
+                                kh: int, kw: int) -> int:
+    """Per-image footprint of the space-to-depth lowering of a stride-2
+    kxk conv: the phase image is [hp/2, wp/2, 4c], the filter becomes
+    ceil(k/2)^2 taps over 4c channels, outputs shrink to the strided
+    grid. Same cost model as conv_bn_shapes_ok's k>1 path, on the
+    transformed dims."""
+    hp2, wp2 = (hp + 1) // 2, (wp + 1) // 2
+    k2h, k2w = (kh + 1) // 2, (kw + 1) // 2
+    ho, wo = hp2 - k2h + 1, wp2 - k2w + 1
+    if ho <= 0 or wo <= 0:
+        return 1 << 62
+    return (
+        2 * 2 * hp2 * wp2 * 4 * c      # phase image block, double-buffered
+        + 2 * 2 * ho * wo * o          # y block
+        + 4 * ho * wo * o              # f32 accumulator
+        + 2 * k2h * k2w * 4 * c * o    # rearranged weights (resident)
+    )

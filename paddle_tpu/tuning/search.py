@@ -1,0 +1,154 @@
+"""The autotune search harness (ISSUE 13 tentpole).
+
+Per (kernel, shape, dtype, chip) target: enumerate candidate configs
+(tuning/configs.py), reject infeasible ones up front — the VMEM
+estimators already filtered enumeration; the HBM side applies the
+candidate's extra resident bytes against the budget (the same contract
+as `tools/memtop.py --budget`, and PADDLE_HBM_BUDGET_BYTES is honored
+as the default budget) — measure the survivors through an injected
+`measure` callable, and persist the winner in the per-chip cache.
+
+The measure callable owns the actual timing (tools/autotune.py wires
+the tools/op_bench.py single-op fence with the per-op device-time
+objective from telemetry/cost.py; tests inject a mocked timer), so the
+harness itself is pure and deterministic: winner selection is
+min((time, enumeration_index)) — ties break to the FIRST enumerated
+candidate, which configs.py orders largest-blocks-first.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .cache import TuningCache, canonical_key
+from .feasible import NoFeasibleConfig
+
+Config = Dict[str, Any]
+MeasureFn = Callable[["SearchTarget", Config], float]
+
+
+@dataclasses.dataclass
+class SearchTarget:
+    """One search unit: a kernel key, its candidate set, and whatever
+    the measure callable needs to build the single-op program."""
+
+    kernel: str
+    key: Dict[str, Any]
+    candidates: List[Config]
+    rejected: List[Tuple[Config, str]] = dataclasses.field(
+        default_factory=list)
+    spec: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # candidate -> extra HBM-resident bytes it introduces (e.g. a
+    # materialized dropout mask); None = no extra residency
+    hbm_bytes: Optional[Callable[[Config], int]] = None
+
+    @property
+    def canonical(self) -> str:
+        return canonical_key(self.key)
+
+
+@dataclasses.dataclass
+class SearchResult:
+    kernel: str
+    key: str
+    winner: Optional[Config]
+    us: Optional[float]
+    measured: List[Tuple[Config, float]]
+    rejected: List[Tuple[Config, str]]
+    cache_hit: bool
+
+    def to_json(self) -> dict:
+        return {
+            "kernel": self.kernel, "key": self.key, "winner": self.winner,
+            "us": self.us, "cache_hit": self.cache_hit,
+            "measured": [{"config": c, "us": round(u, 3)}
+                         for c, u in self.measured],
+            "rejected": [{"config": c, "reason": r}
+                         for c, r in self.rejected],
+        }
+
+
+def mock_measure(target: SearchTarget, config: Config) -> float:
+    """Deterministic pseudo-timer (tests, dry runs): a stable hash of
+    (kernel, key, config) — no backend, no noise, same winner on every
+    machine."""
+    blob = f"{target.kernel}|{target.canonical}|{canonical_key(config)}"
+    h = hashlib.sha256(blob.encode()).hexdigest()
+    return 100.0 + int(h[:8], 16) % 10_000 / 10.0
+
+
+class Searcher:
+    """Drives targets through measure() and persists winners."""
+
+    def __init__(self, cache: TuningCache, measure: MeasureFn,
+                 *, hbm_budget_bytes: Optional[int] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self.cache = cache
+        self.measure = measure
+        if hbm_budget_bytes is None:
+            env = os.environ.get("PADDLE_HBM_BUDGET_BYTES")
+            hbm_budget_bytes = int(env) if env else None
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.log = log or (lambda msg: print(msg, file=sys.stderr))
+
+    # -- gates ----------------------------------------------------------
+    def _hbm_gate(self, target: SearchTarget,
+                  ) -> Tuple[List[Config], List[Tuple[Config, str]]]:
+        if target.hbm_bytes is None or self.hbm_budget_bytes is None:
+            return list(target.candidates), []
+        ok: List[Config] = []
+        rejected: List[Tuple[Config, str]] = []
+        for cfg in target.candidates:
+            extra = target.hbm_bytes(cfg)
+            if extra > self.hbm_budget_bytes:
+                rejected.append(
+                    (cfg, f"HBM gate: extra {extra} B > budget "
+                          f"{self.hbm_budget_bytes} B"))
+            else:
+                ok.append(cfg)
+        return ok, rejected
+
+    # -- search ---------------------------------------------------------
+    def search(self, target: SearchTarget, force: bool = False,
+               ) -> SearchResult:
+        ck = target.canonical
+        existing = self.cache.get(target.kernel, ck)
+        if existing is not None and not force:
+            self.log(f"# autotune {target.kernel}[{ck}]: cache hit "
+                     f"-> {existing.get('config')}")
+            return SearchResult(
+                kernel=target.kernel, key=ck,
+                winner=existing.get("config"), us=existing.get("us"),
+                measured=[], rejected=[], cache_hit=True)
+
+        candidates, hbm_rejected = self._hbm_gate(target)
+        rejected = list(target.rejected) + hbm_rejected
+        if not candidates:
+            raise NoFeasibleConfig(target.kernel, target.key, rejected)
+
+        measured: List[Tuple[Config, float]] = []
+        for idx, cfg in enumerate(candidates):
+            us = float(self.measure(target, cfg))
+            measured.append((cfg, us))
+            self.log(f"# autotune {target.kernel}[{ck}] "
+                     f"{idx + 1}/{len(candidates)} {cfg} -> {us:.1f} us")
+        best_idx = min(range(len(measured)),
+                       key=lambda i: (measured[i][1], i))
+        winner, us = measured[best_idx]
+        self.cache.put(target.kernel, ck, {
+            "config": winner, "us": round(us, 3),
+            "source": getattr(self.measure, "source", "measured"),
+        })
+        self.log(f"# autotune {target.kernel}[{ck}]: winner {winner} "
+                 f"({us:.1f} us over {len(measured)} candidates, "
+                 f"{len(rejected)} rejected infeasible)")
+        return SearchResult(
+            kernel=target.kernel, key=ck, winner=winner, us=us,
+            measured=measured, rejected=rejected, cache_hit=False)
+
+    def search_all(self, targets: List[SearchTarget], force: bool = False,
+                   ) -> List[SearchResult]:
+        return [self.search(t, force=force) for t in targets]

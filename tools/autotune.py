@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""autotune — Pallas kernel config search over the persistent tuning
+cache (ISSUE 13; paddle_tpu/tuning is the library, this is the CLI).
+
+Subcommands:
+
+  search   enumerate candidate configs per target, reject infeasible
+           ones (VMEM footprint models + the HBM budget gate), measure
+           survivors through the tools/op_bench.py single-op fence
+           (FLAGS_benchmark timed loop; objective = the candidate op's
+           OWN attributed device time from telemetry/cost.py under
+           FLAGS_op_profile), and persist winners in the per-chip cache
+           ($PADDLE_AUTOTUNE_CACHE, else
+           ~/.cache/paddle_tpu/autotune/<chip>.json). Already-cached
+           keys are skipped (100% cache hit on a re-run) unless
+           --force.
+  show     print the merged active cache (repo defaults <- user cache
+           <- $PADDLE_AUTOTUNE_CACHE) or one explicit file.
+  diff     compare two cache files entry by entry.
+
+Examples:
+
+    # CI smoke: tiny shapes, CPU-interpret kernels, deterministic
+    PADDLE_AUTOTUNE_CACHE=/tmp/at.json python tools/autotune.py search --smoke
+
+    # tune flash attention at the bench long-context shape (on a TPU)
+    python tools/autotune.py search --flash 8:4096:4096:12:64 --dtype bfloat16
+
+    # tune a ResNet stage conv (kxk stride-2 enables the s2d axis)
+    python tools/autotune.py search --conv 8:56:56:64:128:3:3:2:2
+
+    python tools/autotune.py show
+    python tools/autotune.py diff old.json new.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS_DIR))  # repo root: paddle_tpu
+if _TOOLS_DIR not in sys.path:  # tools/: op_bench (in-process import)
+    sys.path.insert(0, _TOOLS_DIR)
+
+EXIT_NO_FEASIBLE = 2
+
+
+# ---------------------------------------------------------------------------
+# target builders: (kernel key, candidate set, one-op measurement spec)
+# ---------------------------------------------------------------------------
+
+
+def _flash_targets(spec: str, dtype: str):
+    """'b:sq:skv:nh:d[:dropout_prob]' -> one flash_bsh SearchTarget."""
+    from paddle_tpu.tuning import configs, search
+
+    parts = spec.split(":")
+    b, sq, skv, nh, d = (int(x) for x in parts[:5])
+    dropout = float(parts[5]) if len(parts) > 5 else 0.0
+    h = nh * d
+    cands, rejected = configs.flash_bsh_candidates(
+        sq, skv, h, dtype, dropout=dropout > 0.0)
+    attrs = {"num_heads": nh}
+    if dropout > 0.0:
+        attrs["dropout_prob"] = dropout
+
+    def hbm_bytes(cfg):
+        # the materialized dropout mask is the only axis that adds an
+        # HBM-resident tensor: [B, nh, Sq, Skv] uint8, read by fwd+bwd
+        return b * nh * sq * skv if cfg.get("mask") == "materialize" else 0
+
+    return [search.SearchTarget(
+        kernel="flash_bsh",
+        key={"sq": sq, "skv": skv, "h": h, "dtype": dtype},
+        candidates=cands, rejected=rejected,
+        spec={"op_type": "fused_multihead_attention",
+              "shapes": {"Q": (b, sq, h), "K": (b, skv, h),
+                         "V": (b, skv, h)},
+              "attrs": attrs, "out_slot": "Out", "dtype": dtype},
+        hbm_bytes=hbm_bytes,
+    )]
+
+
+def _ln_targets(spec: str, dtype: str):
+    """'r:h' -> one add_ln SearchTarget (layer_norm over the last axis
+    routes through the fused kernel when the gate passes)."""
+    from paddle_tpu.tuning import configs, search
+
+    r, h = (int(x) for x in spec.split(":"))
+    cands, rejected = configs.add_ln_candidates(r, h, dtype)
+    return [search.SearchTarget(
+        kernel="add_ln",
+        key={"r": r, "h": h, "dtype": dtype},
+        candidates=cands, rejected=rejected,
+        spec={"op_type": "layer_norm",
+              "shapes": {"X": (r, h), "Scale": (h,), "Bias": (h,)},
+              "attrs": {"begin_norm_axis": 1, "epsilon": 1e-5},
+              "out_slot": "Y", "dtype": dtype},
+    )]
+
+
+def _conv_targets(spec: str, dtype: str):
+    """'n:h:w:c:o:kh:kw:sh:sw[:pad]' -> conv_bn row-block targets (+ the
+    space-to-depth axis for kxk stride-2). pad: SAME (default) or
+    VALID."""
+    from paddle_tpu.ops.pallas import conv_bn as cb
+    from paddle_tpu.tuning import configs, search
+
+    parts = spec.split(":")
+    n, h, w, c, o, kh, kw, sh, sw = (int(x) for x in parts[:9])
+    pad = parts[9] if len(parts) > 9 else "SAME"
+    strides = (sh, sw)
+    pads = cb._resolve_pads(pad, h, w, kh, kw, strides)
+    hp = h + pads[0][0] + pads[0][1]
+    wp = w + pads[1][0] + pads[1][1]
+    ho = (hp - kh) // sh + 1
+    wo = (wp - kw) // sw + 1
+    r = n * ho * wo
+    op_spec = {
+        "op_type": "fused_conv_bn",
+        "shapes": {"Input": (n, h, w, c), "Filter": (o, c, kh, kw),
+                   "Scale": (o,), "Bias": (o,), "Mean": (o,),
+                   "Variance": (o,)},
+        "attrs": {"data_format": "NHWC", "padding_algorithm": pad,
+                  "strides": [sh, sw], "with_relu": 1},
+        "out_slot": "Y", "dtype": dtype,
+    }
+    targets = []
+    if (kh, kw) == (1, 1):
+        cands, rej = configs.conv_bn_candidates("mm", r, c + o, dtype)
+        targets.append(search.SearchTarget(
+            kernel="conv_bn",
+            key={"kind": "mm", "r": r, "w": c + o, "dtype": dtype},
+            candidates=cands, rejected=rej, spec=op_spec))
+    cands, rej = configs.conv_bn_candidates("apply", r, o, dtype)
+    targets.append(search.SearchTarget(
+        kernel="conv_bn",
+        key={"kind": "apply", "r": r, "w": o, "dtype": dtype},
+        candidates=cands, rejected=rej, spec=op_spec))
+    s2d_cands, s2d_rej = configs.conv_bn_s2d_candidates(
+        n, hp, wp, c, o, kh, kw, strides, dtype)
+    if s2d_cands:
+        targets.append(search.SearchTarget(
+            kernel="conv_bn_s2d",
+            key={"n": n, "h": h, "w": w, "c": c, "o": o, "kh": kh,
+                 "kw": kw, "sh": sh, "sw": sw, "dtype": dtype},
+            candidates=s2d_cands, rejected=s2d_rej, spec=op_spec))
+    return targets
+
+
+def _smoke_targets():
+    """Tiny CPU-interpret targets for the CI lane: every tunable kernel
+    exercised end to end through the REAL lookup + measurement path in
+    a couple of minutes."""
+    return (
+        _flash_targets("1:256:256:1:128", "float32")
+        + _ln_targets("128:128", "float32")
+        + _conv_targets("1:4:4:8:8:1:1:1:1", "float32")
+        + _conv_targets("1:9:9:8:8:3:3:2:2", "float32")
+    )
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+def _make_measure(objective: str, repeat: int, profile_steps: int):
+    """The searcher's measure callable: pin the candidate through
+    tuning.override (the production lookup path — the compile-cache key
+    carries the override fingerprint, so every candidate compiles
+    fresh), run the one-op program through op_bench's fence, return the
+    objective in microseconds."""
+    from paddle_tpu import tuning
+    from paddle_tpu.tuning.search import mock_measure
+
+    if objective == "mock":
+        return mock_measure
+
+    import op_bench
+
+    def measure(target, config):
+        with tuning.override(
+                {target.kernel: {target.canonical: {"config": config}}}):
+            row = op_bench.run_case(
+                repeat=repeat,
+                op_profile=objective == "device",
+                op_profile_steps=profile_steps,
+                **target.spec)
+        if objective == "device" and row.get("op_device_us"):
+            return float(row["op_device_us"])
+        # no attributable device events (backend limitations): fall
+        # back to the fenced wall latency so search still ranks
+        return float(row["latency_us"])
+
+    measure.source = f"op_bench:{objective}"
+    return measure
+
+
+def cmd_search(args) -> int:
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import tuning
+    from paddle_tpu.tuning.cache import TuningCache, chip_kind
+    from paddle_tpu.tuning.feasible import NoFeasibleConfig
+    from paddle_tpu.tuning.search import Searcher
+
+    targets = []
+    for spec in args.flash or []:
+        targets += _flash_targets(spec, args.dtype)
+    for spec in args.ln or []:
+        targets += _ln_targets(spec, args.dtype)
+    for spec in args.conv or []:
+        targets += _conv_targets(spec, args.dtype)
+    if args.smoke:
+        targets += _smoke_targets()
+    if not targets:
+        print("autotune search: no targets (use --flash/--ln/--conv or "
+              "--smoke)", file=sys.stderr)
+        return 1
+
+    if args.force_pallas or args.smoke:
+        # CPU/interpret smoke: pin the Pallas kernels so candidate
+        # configs actually flow through the lookup sites
+        from paddle_tpu.ops import attention
+
+        attention.FORCE_PALLAS = True
+    prev_flag = fluid.flags.get_flags(
+        "FLAGS_kernel_autotune")["FLAGS_kernel_autotune"]
+    fluid.flags.set_flags({"FLAGS_kernel_autotune": True})
+
+    chip = chip_kind()
+    path = args.cache or tuning.default_cache_path(chip)
+    cache, _reason = TuningCache.load(path, expect_chip=chip)
+    if cache is None:
+        cache = TuningCache(chip, path=path)
+
+    searcher = Searcher(
+        cache, _make_measure(args.measure, args.repeat,
+                             args.profile_steps),
+        hbm_budget_bytes=args.hbm_budget)
+    results = []
+    infeasible = 0
+    try:
+        for t in targets:
+            try:
+                results.append(searcher.search(t, force=args.force))
+            except NoFeasibleConfig as e:
+                infeasible += 1
+                print(f"# autotune: {e}", file=sys.stderr)
+    finally:
+        fluid.flags.set_flags({"FLAGS_kernel_autotune": prev_flag})
+    saved = cache.save(path)
+    hits = sum(1 for r in results if r.cache_hit)
+    summary = {
+        "cache": saved,
+        "chip": chip,
+        "fingerprint": cache.fingerprint(),
+        "targets": len(targets),
+        "searched": len(results) - hits,
+        "cache_hits": hits,
+        "infeasible": infeasible,
+        "results": [r.to_json() for r in results],
+    }
+    print(json.dumps(summary if args.json else {
+        k: v for k, v in summary.items() if k != "results"}))
+    if infeasible and not results:
+        return EXIT_NO_FEASIBLE
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# show / diff
+# ---------------------------------------------------------------------------
+
+
+def _load_for_show(path):
+    from paddle_tpu.tuning.cache import TuningCache, load_active_cache
+
+    if path:
+        cache, reason = TuningCache.load(path)
+        if cache is None:
+            raise SystemExit(f"autotune: cannot load {path}: {reason}")
+        return cache
+    return load_active_cache(verbose=True)
+
+
+def cmd_show(args) -> int:
+    cache = _load_for_show(args.cache)
+    if args.json:
+        print(cache.to_blob(), end="")
+        return 0
+    print(f"autotune cache: chip={cache.chip} entries={len(cache)} "
+          f"fingerprint={cache.fingerprint()}"
+          + (f" path={cache.path}" if cache.path else " (merged view)"))
+    for kernel in sorted(cache.entries):
+        for key, entry in sorted(cache.entries[kernel].items()):
+            us = entry.get("us")
+            src = entry.get("source", "?")
+            print(f"  {kernel:<12} {key:<44} -> {entry.get('config')}"
+                  + (f"  [{us} us]" if us is not None else "")
+                  + f"  ({src})")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from paddle_tpu.tuning.cache import TuningCache
+
+    out = {"added": [], "removed": [], "changed": [], "same": 0}
+    sides = []
+    for p in (args.a, args.b):
+        cache, reason = TuningCache.load(p)
+        if cache is None:
+            raise SystemExit(f"autotune: cannot load {p}: {reason}")
+        sides.append(cache)
+    a, b = sides
+    akeys = {(k, key) for k in a.entries for key in a.entries[k]}
+    bkeys = {(k, key) for k in b.entries for key in b.entries[k]}
+    for k, key in sorted(bkeys - akeys):
+        out["added"].append({"kernel": k, "key": key,
+                             "config": b.get(k, key).get("config")})
+    for k, key in sorted(akeys - bkeys):
+        out["removed"].append({"kernel": k, "key": key,
+                               "config": a.get(k, key).get("config")})
+    for k, key in sorted(akeys & bkeys):
+        ea, eb = a.get(k, key), b.get(k, key)
+        if ea.get("config") != eb.get("config"):
+            out["changed"].append({
+                "kernel": k, "key": key, "a": ea.get("config"),
+                "b": eb.get("config"), "a_us": ea.get("us"),
+                "b_us": eb.get("us")})
+        else:
+            out["same"] += 1
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for verb in ("added", "removed", "changed"):
+            for row in out[verb]:
+                if verb == "changed":
+                    print(f"~ {row['kernel']}[{row['key']}]: "
+                          f"{row['a']} -> {row['b']}")
+                else:
+                    sign = "+" if verb == "added" else "-"
+                    print(f"{sign} {row['kernel']}[{row['key']}]: "
+                          f"{row['config']}")
+        print(f"# {out['same']} identical, {len(out['added'])} added, "
+              f"{len(out['removed'])} removed, "
+              f"{len(out['changed'])} changed")
+    return 1 if (out["added"] or out["removed"] or out["changed"]) else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="autotune", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("search", help="measure candidates, persist winners")
+    sp.add_argument("--flash", action="append",
+                    help="b:sq:skv:nh:d[:dropout] flash_bsh target")
+    sp.add_argument("--ln", action="append", help="r:h add_ln target")
+    sp.add_argument("--conv", action="append",
+                    help="n:h:w:c:o:kh:kw:sh:sw[:pad] conv_bn target")
+    sp.add_argument("--smoke", action="store_true",
+                    help="built-in tiny CPU-interpret targets (CI lane)")
+    sp.add_argument("--dtype", default="float32")
+    sp.add_argument("--cache", help="cache file to read+write "
+                    "(default: $PADDLE_AUTOTUNE_CACHE or the user cache)")
+    sp.add_argument("--measure", choices=("device", "latency", "mock"),
+                    default="device",
+                    help="objective: per-op device time (default), "
+                    "fenced wall latency, or the deterministic mock")
+    sp.add_argument("--repeat", type=int, default=10)
+    sp.add_argument("--profile-steps", type=int, default=3)
+    sp.add_argument("--force", action="store_true",
+                    help="re-measure keys the cache already holds")
+    sp.add_argument("--force-pallas", action="store_true",
+                    help="pin the Pallas interpret kernels on CPU")
+    sp.add_argument("--hbm-budget", type=int,
+                    default=None, help="reject candidates whose extra "
+                    "HBM residency exceeds this many bytes (default: "
+                    "$PADDLE_HBM_BUDGET_BYTES; see also tools/memtop.py "
+                    "--budget for whole-program gating)")
+    sp.add_argument("--json", action="store_true",
+                    help="full per-candidate results on stdout")
+    sp.set_defaults(fn=cmd_search)
+
+    sh = sub.add_parser("show", help="print a cache (or the merged view)")
+    sh.add_argument("--cache", help="explicit cache file (default: the "
+                    "merged active view)")
+    sh.add_argument("--json", action="store_true")
+    sh.set_defaults(fn=cmd_show)
+
+    dp = sub.add_parser("diff", help="compare two cache files")
+    dp.add_argument("a")
+    dp.add_argument("b")
+    dp.add_argument("--json", action="store_true")
+    dp.set_defaults(fn=cmd_diff)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

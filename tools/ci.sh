@@ -359,6 +359,42 @@ assert grads and all(w["samples"] == 3 for w in grads.values()), rep
 print(f"numtop smoke OK: {len(rep['watches'])} watched series")
 PY
 
+echo "== autotune lane (CPU-interpret smoke search + cache reuse) =="
+# ISSUE 13 acceptance: a tiny-shape search over all three tunable
+# kernels (flash_bsh / add_ln / conv_bn incl. the s2d axis) must run
+# the REAL measurement path (op_bench fence + per-op device-time
+# objective, interpret-mode Pallas kernels) and produce a cache file;
+# the second run must be a 100% cache hit that leaves the file
+# byte-identical. Heavier shape sweeps stay manual (autotune.md).
+rm -f /tmp/ci_autotune.json
+PADDLE_AUTOTUNE_CACHE=/tmp/ci_autotune.json JAX_PLATFORMS=cpu \
+  python tools/autotune.py search --smoke --repeat 2 --profile-steps 2 \
+  2>/dev/null | tee /tmp/ci_autotune_run1.json
+cp /tmp/ci_autotune.json /tmp/ci_autotune.first
+PADDLE_AUTOTUNE_CACHE=/tmp/ci_autotune.json JAX_PLATFORMS=cpu \
+  python tools/autotune.py search --smoke --repeat 2 --profile-steps 2 \
+  2>/dev/null | tee /tmp/ci_autotune_run2.json
+cmp /tmp/ci_autotune.first /tmp/ci_autotune.json
+python - <<'PY'
+import json
+
+r1 = json.load(open("/tmp/ci_autotune_run1.json"))
+r2 = json.load(open("/tmp/ci_autotune_run2.json"))
+assert r1["searched"] == r1["targets"] > 0 and r1["infeasible"] == 0, r1
+assert r2["cache_hits"] == r2["targets"] and r2["searched"] == 0, r2
+assert r1["fingerprint"] == r2["fingerprint"]
+cache = json.load(open("/tmp/ci_autotune.json"))
+for kernel in ("flash_bsh", "add_ln", "conv_bn", "conv_bn_s2d"):
+    assert cache["entries"].get(kernel), f"no {kernel} entries"
+print(f"autotune lane OK: {r1['targets']} targets searched, second run "
+      f"100% cache hit, file byte-identical (chip={r1['chip']})")
+PY
+# show/diff must render the cache the search just wrote
+JAX_PLATFORMS=cpu python tools/autotune.py show \
+  --cache /tmp/ci_autotune.json | head -3
+JAX_PLATFORMS=cpu python tools/autotune.py diff \
+  /tmp/ci_autotune.first /tmp/ci_autotune.json
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json
