@@ -1,0 +1,104 @@
+"""Minimal serving smoke client (the Python face of the `infer` wire
+protocol the Go/R client READMEs document).
+
+Start a replica set first, e.g.:
+
+    python -m paddle_tpu.distributed.launch --serve --nproc_per_node 2 \
+        --started_port 8500 /path/to/saved_model
+
+then:
+
+    python examples/serving_client.py --endpoints 127.0.0.1:8500,127.0.0.1:8501
+
+The high-level path uses paddle_tpu.inference.InferenceClient (replica
+failover + hedging + typed Overloaded/DeadlineExceeded errors).
+--raw instead drives ONE raw socket by hand — the exact framing a
+non-Python client implements:
+
+    request :=  8-byte big-endian length  ||  pickle((verb, kwargs))
+    reply   :=  8-byte big-endian length  ||  pickle((ok, result))
+
+    verb "infer" kwargs: {"feed": {name: ndarray}, "deadline_ms": float}
+    ok=True  -> result = {"outputs": [ndarray...], "fetch_names": [...],
+                          "weight_epoch": int, "queue_ms": float}
+    ok=False -> result = "ErrorType: message" (strings starting with
+                "Overloaded"/"DeadlineExceeded" are deliberate serving
+                replies, not transport failures — do not blind-retry)
+"""
+from __future__ import annotations
+
+import argparse
+import pickle
+import socket
+import struct
+import sys
+
+import numpy as np
+
+_LEN = struct.Struct(">Q")
+
+
+def raw_infer(endpoint: str, feed: dict, deadline_ms: float = 5000.0):
+    """One infer over one raw socket — the framing reference."""
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=30.0) as s:
+        payload = pickle.dumps(
+            ("infer", {"feed": feed, "deadline_ms": deadline_ms}),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        s.sendall(_LEN.pack(len(payload)) + payload)
+        hdr = b""
+        while len(hdr) < _LEN.size:
+            hdr += s.recv(_LEN.size - len(hdr))
+        (n,) = _LEN.unpack(hdr)
+        buf = b""
+        while len(buf) < n:
+            buf += s.recv(n - len(buf))
+    ok, result = pickle.loads(buf)
+    if not ok:
+        raise RuntimeError(result)
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="serving smoke client")
+    p.add_argument("--endpoints", required=True,
+                   help="comma-separated replica host:port list")
+    p.add_argument("--rows", type=int, default=2)
+    p.add_argument("--deadline_ms", type=float, default=5000.0)
+    p.add_argument("--raw", action="store_true",
+                   help="drive one raw socket (framing reference) "
+                        "instead of InferenceClient")
+    args = p.parse_args(argv)
+    endpoints = [e.strip() for e in args.endpoints.split(",")
+                 if e.strip()]
+
+    from paddle_tpu.inference import InferenceClient
+
+    cli = InferenceClient(endpoints)
+    info = cli.model_info()
+    print("model:", info)
+    rng = np.random.RandomState(0)
+    feed = {}
+    for name, meta in info["feeds"].items():
+        shape = [d if d and d > 0 else 1 for d in (meta["shape"] or [1])]
+        shape[0] = args.rows
+        feed[name] = rng.rand(*shape).astype(meta["dtype"] or "float32")
+
+    if args.raw:
+        result = raw_infer(endpoints[0], feed,
+                           deadline_ms=args.deadline_ms)
+        print("raw infer ok: epoch", result["weight_epoch"],
+              "outputs", [np.shape(o) for o in result["outputs"]])
+        return 0
+    res = cli.infer(feed, deadline_ms=args.deadline_ms)
+    print(f"infer ok via {res.replica}: epoch {res.weight_epoch}, "
+          f"queue {res.queue_ms}ms, outputs "
+          f"{[o.shape for o in res.outputs]}")
+    for name, o in zip(res.fetch_names, res.outputs):
+        print(f"  {name}: head {np.asarray(o).reshape(-1)[:4]}")
+    cli.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -230,6 +230,18 @@ def _parse_args(argv):
         "PADDLE_PS_REPLICATION if set, else 1 (today's unreplicated "
         "data plane)",
     )
+    p.add_argument(
+        "--serve", action="store_true",
+        help="SERVING mode (paddle_tpu.inference.server): the "
+        "positional argument is a saved inference-model dir, and each "
+        "'trainer' slot runs one serving replica bound to its cluster "
+        "endpoint (started_port + rank). The whole supervision stack "
+        "applies unchanged — heartbeats, per-rank restart budgets, "
+        "elastic respawn, --lease_secs lease renewals (kind="
+        "'inference'), SIGTERM graceful drain — and extra args after "
+        "the model dir pass through to the server (--max_batch, "
+        "--queue_depth, ...)",
+    )
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -473,7 +485,9 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
                          restart_count: int = 0,
                          heartbeat_dir: Optional[str] = None,
                          debugz_base_port: Optional[int] = None,
-                         membership_epoch: int = 0):
+                         membership_epoch: int = 0,
+                         module: Optional[str] = None,
+                         only_tags=None):
     """Fork this node's trainers with the env protocol (reference
     utils.start_local_trainers:340). debugz_base_port arms each rank's
     introspection server on base + rank (deterministic: operators and
@@ -483,6 +497,10 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
     survive resizes where the rank numbering does not."""
     endpoints = ",".join(t.endpoint for t in cluster)
     local = [t for t in cluster if t.endpoint.split(":")[0] == node_ip]
+    if only_tags is not None:
+        # per-replica respawn (--serve): spawn ONLY the named members,
+        # with the env protocol still derived from the full cluster
+        local = [t for t in local if t.tag in only_tags]
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
     for t in local:
@@ -500,7 +518,13 @@ def start_local_trainers(cluster: List[Trainer], node_ip: str, script: str,
             env["PADDLE_DEBUGZ_PORT"] = str(debugz_base_port + t.rank)
         if heartbeat_dir:
             env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
-        cmd = [sys.executable, "-u", script] + list(script_args)
+        # module mode (launch --serve): run `-m <module>` instead of a
+        # script file — the serving replica binds its cluster endpoint's
+        # port via PADDLE_CURRENT_ENDPOINT
+        if module is not None:
+            cmd = [sys.executable, "-u", "-m", module] + list(script_args)
+        else:
+            cmd = [sys.executable, "-u", script] + list(script_args)
         if log_dir:
             mode = "a" if restart_count else "w"
             t.log = open(os.path.join(log_dir, f"workerlog.{t.rank}"), mode)
@@ -528,11 +552,58 @@ def terminate_local_trainers(trainers: List[Trainer]):
             t.log.close()
 
 
+class ServeRespawner:
+    """Per-replica supervision for launch --serve: serving replicas are
+    INDEPENDENT — one dying must never blip the rest of the fleet, so
+    (unlike sync training, where the barrier demands a group restart) a
+    dead replica is respawned IN PLACE on its original endpoint, budget
+    `--elastic_retries` per replica. Past budget the death falls through
+    to the group-abort path so the job still ends loudly."""
+
+    def __init__(self, cluster: List[Trainer], node_ip: str, script: str,
+                 script_args: List[str], log_dir: Optional[str],
+                 retries: int, heartbeat_dir: Optional[str] = None,
+                 debugz_base_port: Optional[int] = None,
+                 membership_epoch: int = 0,
+                 module: Optional[str] = None):
+        self.cluster = cluster
+        self.node_ip = node_ip
+        self.script = script
+        self.script_args = list(script_args)
+        self.log_dir = log_dir
+        self.retries = int(retries)
+        self.heartbeat_dir = heartbeat_dir
+        self.debugz_base_port = debugz_base_port
+        self.membership_epoch = membership_epoch
+        self.module = module
+        self._counts: dict = {}
+
+    def respawn(self, t: Trainer) -> bool:
+        n = self._counts.get(t.tag, 0)
+        if n >= self.retries:
+            return False
+        self._counts[t.tag] = n + 1
+        print(f"[launch] serving replica {t.rank} ({t.tag}, "
+              f"{t.endpoint}) died; respawning in place "
+              f"({n + 1}/{self.retries}); the rest of the fleet keeps "
+              f"serving", file=sys.stderr, flush=True)
+        start_local_trainers(
+            self.cluster, self.node_ip, self.script, self.script_args,
+            self.log_dir, restart_count=n + 1,
+            heartbeat_dir=self.heartbeat_dir,
+            debugz_base_port=self.debugz_base_port,
+            membership_epoch=self.membership_epoch, module=self.module,
+            only_tags={t.tag})
+        return True
+
+
 def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                          monitor=None, ps_supervisor=None,
                          grace: Optional[SigtermGrace] = None,
                          straggler=None, failure: Optional[dict] = None,
-                         coordinator=None, straggler_eject=False) -> int:
+                         coordinator=None, straggler_eject=False,
+                         serve_respawner: Optional[ServeRespawner] = None,
+                         ) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
     aborts the whole local group (reference watch_local_trainers:407:
@@ -575,6 +646,10 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                 if rc is None:
                     alive = True
                 elif rc != 0:
+                    if serve_respawner is not None \
+                            and serve_respawner.respawn(t):
+                        alive = True  # replaced in place; fleet serves on
+                        continue
                     print(
                         f"[launch] trainer {t.rank} ({t.tag}, "
                         f"{t.endpoint}) exited with {rc}; aborting the "
@@ -863,6 +938,17 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                 debugz_base = int(raw)
             except ValueError:
                 debugz_base = None
+    # serving mode: each rank is one inference replica; the positional
+    # arg is the model dir, extra args pass through to the server
+    serve_module = None
+    serve_args: List[str] = []
+    if getattr(args, "serve", False):
+        serve_module = "paddle_tpu.inference.server"
+        serve_args = (["--model_dir", args.training_script]
+                      + list(args.training_script_args))
+        print(f"[launch] serving replicas: "
+              f"{','.join(t.endpoint for t in cluster)}",
+              file=sys.stderr)
     elastic_enabled = (args.elastic_retries > 0
                        or args.elastic_retries_per_rank is not None)
     # job-level cap: --elastic_retries when given; with only per-rank
@@ -879,9 +965,10 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
     while True:
         local = start_local_trainers(
             trainers, node_ip, args.training_script,
-            args.training_script_args, args.log_dir, restart_count=attempt,
+            serve_args if serve_module else args.training_script_args,
+            args.log_dir, restart_count=attempt,
             heartbeat_dir=heartbeat_dir, debugz_base_port=debugz_base,
-            membership_epoch=epoch,
+            membership_epoch=epoch, module=serve_module,
         )
         if not local:
             print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
@@ -913,12 +1000,19 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                 heartbeat_dir, [t.rank for t in local],
                 factor=(args.straggler_eject_factor
                         if eject else args.straggler_factor))
+        serve_respawner = None
+        if serve_module is not None and elastic_enabled:
+            serve_respawner = ServeRespawner(
+                trainers, node_ip, args.training_script, serve_args,
+                args.log_dir, retries=per_rank,
+                heartbeat_dir=heartbeat_dir, debugz_base_port=debugz_base,
+                membership_epoch=epoch, module=serve_module)
         failure: dict = {}
         rc = watch_local_trainers(
             local, monitor=monitor, ps_supervisor=ps_supervisor,
             grace=grace, straggler=straggler, failure=failure,
             coordinator=coord if lease_armed else None,
-            straggler_eject=eject)
+            straggler_eject=eject, serve_respawner=serve_respawner)
         if (rc == 0
                 or rc == 128 + signal.SIGINT
                 or rc == 128 + signal.SIGTERM  # whole-job preemption

@@ -371,22 +371,32 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
         pad_id = int(decoder.end)
     step_outputs, step_ids = [], []
     length_acc = None
+    # a decoder that tracks its own finished rows (BeamSearchDecoder:
+    # finished beams only ever extend with (end_token, parent=self) at
+    # unchanged score) already emits well-formed outputs past the end
+    # token — masking them to zero here would corrupt the (token,
+    # parent) pairs gather_tree backtraces through
+    own_finished = bool(getattr(decoder, "tracks_own_finished", False))
     for t in range(int(max_step_num)):
         (out, ids), next_states, next_inputs, step_finished = decoder.step(
             t, inputs, states)
-        # freeze finished rows: keep emitting, mask below
         alive = _nn.scale(finished, scale=-1.0, bias=1.0)  # [B]
-        am = _nn.reshape(alive, [out.shape[0], 1])
-        out = _nn.elementwise_mul(out, am)
-        alive_ids = (
-            _nn.reshape(alive, [ids.shape[0]] + [1] * (len(ids.shape) - 1))
-            if len(ids.shape) > 1 else alive)
-        ids = _tensor.cast(
-            _nn.elementwise_add(
-                _nn.elementwise_mul(_tensor.cast(ids, "float32"), alive_ids),
-                _nn.scale(alive_ids, scale=-float(pad_id), bias=float(pad_id)),
-            ),
-            "int64")
+        if not own_finished:
+            # freeze finished rows: keep emitting, mask below
+            am = _nn.reshape(alive, [out.shape[0], 1])
+            out = _nn.elementwise_mul(out, am)
+            alive_ids = (
+                _nn.reshape(alive,
+                            [ids.shape[0]] + [1] * (len(ids.shape) - 1))
+                if len(ids.shape) > 1 else alive)
+            ids = _tensor.cast(
+                _nn.elementwise_add(
+                    _nn.elementwise_mul(
+                        _tensor.cast(ids, "float32"), alive_ids),
+                    _nn.scale(alive_ids, scale=-float(pad_id),
+                              bias=float(pad_id)),
+                ),
+                "int64")
         step_outputs.append(out)
         step_ids.append(ids)
         inputs, states = next_inputs, next_states
@@ -420,6 +430,12 @@ class BeamSearchDecoder(Decoder):
         self.output_fn = output_fn
         self.vocab = int(vocab_size)
 
+    @property
+    def tracks_own_finished(self):
+        # step() masks finished beams itself (end-token-only extension),
+        # so dynamic_decode must NOT zero the (token, parent) outputs
+        return True
+
     def initialize(self, initial_cell_states):
         b = initial_cell_states[0].shape[0]
         # tile states beam-wise: [B, ...] -> [B*W, ...]
@@ -435,14 +451,33 @@ class BeamSearchDecoder(Decoder):
         self._log_probs = _tensor.assign(
             np.tile(np.asarray([[0.0] + [-1e9] * (self.beam - 1)], "float32"),
                     (b, 1)).reshape(-1))  # only beam 0 alive at t=0
+        # finished mask threaded through step(): a finished beam's only
+        # viable continuation is end_token at its UNCHANGED cumulative
+        # score (reference BeamSearchDecoder._mask_probs) — without the
+        # mask a finished hypothesis keeps expanding with fresh tokens
+        # and the backtrace emits garbage after the first end_token
+        self._finished = finished
+        noend = np.full((1, self.vocab), -1e9, "float32")
+        noend[0, self.end] = 0.0
+        self._noend_mask = _tensor.assign(noend)
         return self.embed(start), states, finished
 
     def step(self, time, inputs, states):
         cell_out, cell_states = self.cell.call(inputs, states)
         logits = self.output_fn(cell_out)  # [B*W, V]
         logp = _nn.log_softmax(logits)
+        cum = _nn.reshape(self._log_probs, [self._batch * self.beam, 1])
+        total = _nn.elementwise_add(logp, cum)
+        # finished beams: every candidate except end_token is masked to
+        # -1e9 and end_token carries the beam's cumulative score
+        # unchanged, so when selected the beam re-emits (end, parent=
+        # self) — the gather_tree coherence contract
+        fin = _nn.reshape(self._finished, [self._batch * self.beam, 1])
+        alive_m = _nn.scale(fin, scale=-1.0, bias=1.0)
         total = _nn.elementwise_add(
-            logp, _nn.reshape(self._log_probs, [self._batch * self.beam, 1]))
+            _nn.elementwise_mul(total, alive_m),
+            _nn.elementwise_mul(
+                _nn.elementwise_add(cum, self._noend_mask), fin))
         # [B, W*V] -> top-W
         flat = _nn.reshape(total, [self._batch, self.beam * self.vocab])
         top_p, top_i = _nn.topk(flat, self.beam)
@@ -467,6 +502,7 @@ class BeamSearchDecoder(Decoder):
                 _tensor.fill_constant([self._batch * self.beam], "int64",
                                       self.end)),
             "float32")
+        self._finished = finished
         # outputs carry (token, parent) for gather_tree
         out = _nn.stack([token_flat,
                          _nn.reshape(parent, [self._batch * self.beam])], axis=1)

@@ -206,3 +206,30 @@ AnalysisPredictor = Predictor
 
 def create_paddle_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# ---------------------------------------------------------------------------
+# serving engine (ISSUE 14): program freezing + production serving over
+# the hardened PS RPC plane. Submodules import lazily inside functions
+# where they need jax; these names are the public surface.
+# ---------------------------------------------------------------------------
+from .freeze import FrozenModel, freeze_program, load_frozen  # noqa: F401,E402
+from .predictor import Predictor as ServingPredictor  # noqa: F401,E402
+from .predictor import shared_executor  # noqa: F401,E402
+from . import weight_sync  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # server/client pull in the distributed transport: lazy so `import
+    # paddle_tpu.inference` stays cheap for the file-based Predictor
+    if name in ("InferenceServer", "MicroBatcher", "Overloaded",
+                "DeadlineExceeded", "serve"):
+        from . import server as _server
+
+        return getattr(_server, name)
+    if name in ("InferenceClient", "InferResult", "OverloadedError",
+                "DeadlineExceededError"):
+        from . import client as _client
+
+        return getattr(_client, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,304 @@
+"""Inference client: replica-set failover + hedged requests over the
+hardened PS transport.
+
+`InferenceClient` talks to N serving replicas through `_Conn` (retries
+with backoff, per-RPC deadlines, fault injection, trace spans — the
+exact client the training data plane hardened). On top it adds:
+
+  failover  — a replica whose deadline-capped retry budget is exhausted
+              is marked down and the BEST live replica is promoted (the
+              RemoteTable `_failover` shape: probe every candidate's
+              `health`, rank by (not draining, weight_epoch, chain
+              order)); `infer` is idempotent, so the request replays on
+              the new replica — zero accepted requests lost. A rejoin
+              probe re-enables the dead endpoint once it answers again.
+  hedging   — after the infer latency histogram's quantile
+              (PADDLE_SERVE_HEDGE_QUANTILE, default p95) a hedge is
+              raced against another replica; first response wins — the
+              slow-tail drill's contract.
+  deadlines — `infer(deadline_ms=...)` rides the wire so the server's
+              admission control sheds what it cannot finish in time;
+              the client maps the explicit refusals onto typed errors
+              (OverloadedError / DeadlineExceededError) instead of
+              retrying a reply the server already made deliberately.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry import get_registry
+from ..telemetry import tracing as _tracing
+
+_REG = get_registry()
+
+HEDGE_QUANTILE = float(os.environ.get("PADDLE_SERVE_HEDGE_QUANTILE",
+                                      0.95) or 0)
+HEDGE_MIN_SAMPLES = int(os.environ.get("PADDLE_SERVE_HEDGE_MIN_SAMPLES",
+                                       16))
+CLIENT_DEADLINE = float(os.environ.get("PADDLE_SERVE_CLIENT_DEADLINE_SECS",
+                                       10.0))
+REJOIN_SECS = float(os.environ.get("PADDLE_SERVE_REJOIN_SECS", 60.0))
+
+
+class OverloadedError(RuntimeError):
+    """The server REFUSED admission (queue full / draining / projected
+    wait past the deadline). Deliberate load shedding — back off or try
+    a less loaded replica; blind retry against the same one is exactly
+    the retry storm admission control exists to prevent."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline expired before the server could serve it."""
+
+
+class InferResult:
+    __slots__ = ("outputs", "fetch_names", "weight_epoch", "replica",
+                 "queue_ms")
+
+    def __init__(self, reply: dict, replica: str):
+        self.outputs = [np.asarray(o) for o in reply["outputs"]]
+        self.fetch_names = list(reply.get("fetch_names") or [])
+        self.weight_epoch = int(reply.get("weight_epoch", 0))
+        self.queue_ms = float(reply.get("queue_ms", 0.0))
+        self.replica = replica
+
+    def __getitem__(self, i):
+        return self.outputs[i]
+
+
+def _map_app_error(e: RuntimeError) -> BaseException:
+    msg = str(e)
+    if "Overloaded" in msg:
+        return OverloadedError(msg)
+    if "DeadlineExceeded" in msg:
+        return DeadlineExceededError(msg)
+    return e
+
+
+class InferenceClient:
+    """Failover + hedging client over a serving replica set."""
+
+    def __init__(self, endpoints: Sequence[str],
+                 deadline_secs: Optional[float] = None,
+                 hedge_quantile: Optional[float] = None,
+                 hedge_min_samples: Optional[int] = None):
+        from ..distributed.ps_server import _Conn
+
+        if not endpoints:
+            raise ValueError("InferenceClient needs at least one endpoint")
+        self.endpoints = [str(e) for e in endpoints]
+        self._deadline = (CLIENT_DEADLINE if deadline_secs is None
+                          else float(deadline_secs))
+        # io_timeout past the deadline: a request parked in the server's
+        # batch queue is progress, not a dead peer
+        self._conns = [_Conn(e, deadline=self._deadline,
+                             io_timeout=self._deadline + 30.0)
+                       for e in self.endpoints]
+        self._primary = 0
+        self._down: Dict[int, float] = {}  # idx -> downed-at monotonic
+        self._lock = threading.RLock()
+        self._closed = threading.Event()  # stops rejoin probe threads
+        self._hedge_q = (HEDGE_QUANTILE if hedge_quantile is None
+                         else float(hedge_quantile))
+        self._hedge_min = (HEDGE_MIN_SAMPLES if hedge_min_samples is None
+                           else int(hedge_min_samples))
+        self._hedge_pool = None
+        if len(self.endpoints) > 1 and self._hedge_q > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._hedge_pool = ThreadPoolExecutor(
+                max_workers=max(4, 2 * len(self.endpoints)))
+
+    # -- routing ---------------------------------------------------------
+    def _probe(self, j: int) -> Optional[dict]:
+        from ..distributed.ps_server import _Conn
+
+        probe = _Conn(self.endpoints[j], deadline=2.0, io_timeout=10.0)
+        try:
+            return probe.call("health")
+        except Exception:  # noqa: BLE001 — a dead candidate scores None
+            return None
+        finally:
+            probe.close()
+
+    def _failover(self, dead_j: int) -> None:
+        """Promote the best live replica: serving (not draining) beats
+        draining, then highest weight_epoch (freshest model), then
+        list order. Mirrors RemoteTable._failover's promote-best-live."""
+        with self._lock:
+            if self._primary != dead_j:
+                return  # another thread already moved on
+            self._down[dead_j] = time.monotonic()
+            best = None
+            for j in range(len(self.endpoints)):
+                if j == dead_j:
+                    continue
+                h = self._probe(j)
+                if h is None:
+                    continue
+                rank = (0 if h.get("draining") else 1,
+                        int(h.get("weight_epoch", 0)), -j)
+                if best is None or rank > best[0]:
+                    best = (rank, j)
+            if best is None:
+                raise ConnectionError(
+                    f"all {len(self.endpoints)} serving replicas are "
+                    f"unreachable (last dead: "
+                    f"{self.endpoints[dead_j]})")
+            self._primary = best[1]
+            _REG.counter("serve_client_failovers_total").inc()
+            import sys
+
+            print(f"[serve_client] replica {self.endpoints[dead_j]} "
+                  f"unreachable; failing over to "
+                  f"{self.endpoints[best[1]]}", file=sys.stderr,
+                  flush=True)
+        self._schedule_rejoin(dead_j)
+
+    def _schedule_rejoin(self, dead_j: int) -> None:
+        def loop():
+            deadline = time.monotonic() + REJOIN_SECS
+            while time.monotonic() < deadline \
+                    and not self._closed.is_set():
+                if self._closed.wait(0.5):
+                    return  # client closed: stop probing immediately
+                if self._probe(dead_j) is not None:
+                    with self._lock:
+                        self._down.pop(dead_j, None)
+                    _REG.counter("serve_client_rejoins_total").inc()
+                    return
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"serve-rejoin-{dead_j}").start()
+
+    def _call(self, method: str, hops: int = 0, **kwargs):
+        with self._lock:
+            j = self._primary
+        try:
+            return self._conns[j].call(method, **kwargs)
+        except (OverloadedError, DeadlineExceededError):
+            raise
+        except ConnectionError:
+            if hops >= len(self.endpoints):
+                raise
+            self._failover(j)
+            return self._call(method, hops=hops + 1, **kwargs)
+        except RuntimeError as e:
+            raise _map_app_error(e) from None
+
+    # -- API -------------------------------------------------------------
+    def infer(self, feed: Dict[str, np.ndarray],
+              deadline_ms: Optional[float] = None) -> InferResult:
+        if deadline_ms is not None:
+            kwargs = {"feed": feed, "deadline_ms": float(deadline_ms)}
+        else:
+            kwargs = {"feed": feed}
+        t0 = time.perf_counter()
+        try:
+            if self._hedge_pool is not None:
+                reply, replica = self._hedged_infer(kwargs)
+            else:
+                reply = self._call("infer", **kwargs)
+                with self._lock:  # read AFTER: a failover moved routing
+                    replica = self.endpoints[self._primary]
+            return InferResult(reply, replica)
+        finally:
+            _REG.histogram(
+                "serve_client_infer_ms",
+                help="caller-observed infer latency (failover + "
+                     "hedging included)").observe(
+                (time.perf_counter() - t0) * 1e3)
+
+    def _hedged_infer(self, kwargs: dict):
+        """Race the primary against a second replica once the observed
+        latency quantile elapses (RemoteTable._hedged_call shape). The
+        infer verb is idempotent — a duplicate execution costs device
+        time, never correctness. Overloaded/DeadlineExceeded are
+        DELIBERATE replies: the race only ends early on success or when
+        both legs errored."""
+        from concurrent import futures as _fut
+
+        hist = _REG.histogram("ps_client_rpc_ms", verb="infer")
+        with self._lock:
+            j = self._primary
+        if hist.count < self._hedge_min or len(self.endpoints) < 2:
+            reply = self._call("infer", **kwargs)
+            return reply, self.endpoints[j]
+        delay_s = max(hist.quantile(self._hedge_q) / 1e3, 1e-3)
+        fut = self._hedge_pool.submit(_tracing.bound(
+            lambda: self._call("infer", **dict(kwargs))))
+        try:
+            return fut.result(timeout=delay_s), self.endpoints[j]
+        except _fut.TimeoutError:
+            pass
+        except RuntimeError:
+            raise
+        _REG.counter("serve_client_hedges_issued_total").inc()
+        with self._lock:
+            hedge_j = next(
+                (k for k in range(len(self.endpoints))
+                 if k != self._primary and k not in self._down),
+                (self._primary + 1) % len(self.endpoints))
+
+        def _hedge_exec():
+            with _tracing.span("hedge:infer",
+                               attrs={"peer": self.endpoints[hedge_j]}):
+                return self._conns[hedge_j].call("infer", **dict(kwargs))
+
+        hedge = self._hedge_pool.submit(_tracing.bound(_hedge_exec))
+        pending = {fut: self.endpoints[j], hedge: self.endpoints[hedge_j]}
+        last_err = None
+        while pending:
+            done, _ = _fut.wait(set(pending),
+                                return_when=_fut.FIRST_COMPLETED)
+            for f in done:
+                src = pending.pop(f)
+                err = f.exception()
+                if err is None:
+                    if f is hedge:
+                        _REG.counter(
+                            "serve_client_hedges_won_total").inc()
+                    return f.result(), src
+                last_err = err
+        if isinstance(last_err, RuntimeError):
+            raise _map_app_error(last_err)
+        raise last_err
+
+    def model_info(self) -> dict:
+        return self._call("model_info")
+
+    def health(self, replica: Optional[int] = None) -> dict:
+        if replica is not None:
+            return self._conns[replica].call("health")
+        return self._call("health")
+
+    def stats(self, all_replicas: bool = False):
+        if not all_replicas:
+            return self._call("stats")
+        out = []
+        for j, c in enumerate(self._conns):
+            try:
+                out.append({"endpoint": self.endpoints[j],
+                            **c.call("stats")})
+            except Exception as e:  # noqa: BLE001 — dead replica row
+                out.append({"endpoint": self.endpoints[j],
+                            "error": f"{type(e).__name__}: {e}"})
+        return out
+
+    def client_stats(self) -> dict:
+        """This process's serve_client_* + ps_client_* registry slice."""
+        snap = _REG.snapshot()
+        return {k: v for k, v in snap.items()
+                if k.startswith(("serve_client_", "ps_client_"))}
+
+    def close(self) -> None:
+        self._closed.set()  # rejoin probes must not outlive the client
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
+        for c in self._conns:
+            c.close()

@@ -1,0 +1,181 @@
+"""Program freezing: training Program -> self-contained inference model.
+
+The serving-side analog of the reference's `AnalysisPredictor` graph
+preparation (analysis_predictor.cc PrepareProgram + IR pass manager):
+
+  1. `clone(for_test=True)` — every op holding an `is_test` attr flips
+     to test mode (dropout off, batch_norm reads running stats);
+  2. backward slice from the fetch targets (fluid/io.py's inference
+     prune) — backward ops, optimizer update ops and feed-queue glue
+     all fall out because nothing downstream of the fetches needs them;
+  3. the conv+BN fold (fluid/fusion_pass.py): with `is_test=True` the
+     fused emitter folds the BN scale/shift into the conv weights — one
+     conv + bias add, no normalization pass ("Operator Fusion in XLA":
+     freezing-time rewrites are the cheap win);
+  4. dead-variable sweep: vars only the stripped ops touched (gradients,
+     optimizer moments, loss) leave block.vars so the frozen program
+     lints clean;
+  5. PR-5 pass sandwich: under FLAGS_program_verify the whole rewrite is
+     verified before/after, and a structural error the freeze introduced
+     raises attributed to it. `freeze_program` additionally runs one
+     unconditional verify of the RESULT — a frozen model ships to
+     serving replicas, so it is always worth one static check.
+
+The frozen weights are captured by VALUE into the FrozenModel's own
+scope (arrays are immutable; a training step replaces, never mutates),
+so serving is isolated from further training by construction — live
+weight adoption is explicit (weight_sync.py), never aliased.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .. import fluid
+from ..fluid import framework
+from ..fluid.analysis import ERROR, ProgramVerifyError, pass_sandwich, \
+    verify_program
+from ..fluid.executor import Scope
+from ..fluid.fusion_pass import apply_conv_bn_fusion
+from ..fluid.io import _prune_for_inference
+
+# feed-pipeline glue with no inference semantics: stripped even when a
+# fetch accidentally depends on one (none of these are registered
+# compute ops on the serving path)
+_FEED_QUEUE_OPS = ("read", "create_py_reader", "double_buffer",
+                   "queue_generator", "feed", "fetch")
+
+
+@dataclass
+class FrozenModel:
+    """A self-contained inference model: pruned `is_test` program +
+    captured weights. Everything a Predictor / InferenceServer needs."""
+
+    program: framework.Program
+    feed_names: List[str]
+    fetch_names: List[str]
+    param_names: List[str]
+    scope: Scope
+    fused_conv_bn: int = 0
+    meta: Dict[str, object] = field(default_factory=dict)
+
+    def model_info(self) -> dict:
+        """JSON-ready description (the `model_info` serving verb)."""
+        blk = self.program.global_block()
+
+        def var_meta(n):
+            v = blk._find_var_recursive(n)
+            return {"shape": list(v.shape) if v is not None and
+                    v.shape is not None else None,
+                    "dtype": str(v.dtype) if v is not None and
+                    v.dtype is not None else None}
+
+        return {
+            "feeds": {n: var_meta(n) for n in self.feed_names},
+            "fetches": {n: var_meta(n) for n in self.fetch_names},
+            "num_ops": len(blk.ops),
+            "num_params": len(self.param_names),
+            "fused_conv_bn": self.fused_conv_bn,
+            **self.meta,
+        }
+
+
+def _infer_feed_names(program) -> List[str]:
+    return [v.name for v in program.global_block().vars.values()
+            if getattr(v, "is_data", False)]
+
+
+def freeze_program(program, scope=None, feed_names: Optional[Sequence[str]]
+                   = None, fetch_list: Sequence = ()) -> FrozenModel:
+    """Clone `program` into a pruned `is_test` inference Program and
+    capture its weights from `scope` (default: the global scope).
+
+    fetch_list: Variables or names the model serves (required).
+    feed_names: defaults to the program's data vars.
+    """
+    if not fetch_list:
+        raise ValueError("freeze_program needs a non-empty fetch_list")
+    scope = scope or fluid.executor.global_scope()
+    fetch_names = [v.name if isinstance(v, framework.Variable) else str(v)
+                   for v in fetch_list]
+    if feed_names is None:
+        feed_names = _infer_feed_names(program)
+    feed_names = [str(n) for n in feed_names]
+    live_out = set(feed_names) | set(fetch_names)
+
+    with pass_sandwich(program, "freeze_program", live_out=live_out):
+        # clone(for_test=True) + backward slice: backward/optimizer ops
+        # and every var only they touched drop out of the op list here
+        frozen = _prune_for_inference(program, feed_names, fetch_names)
+    blk = frozen.global_block()
+    blk.ops = [op for op in blk.ops if op.type not in _FEED_QUEUE_OPS]
+
+    # conv+BN fold: is_test=True, so the fused emitter folds the BN into
+    # the conv weights (sandwiched itself under FLAGS_program_verify)
+    fused = apply_conv_bn_fusion(frozen)
+
+    # dead-variable sweep: the pruned op list no longer reads/writes the
+    # training-only vars (grads, moments, LR, loss) — leaving them in
+    # block.vars keeps stale Variable.op links and proglint noise
+    used = set(live_out)
+    for op in blk.ops:
+        used.update(op.input_names())
+        used.update(op.output_names())
+    for name in [n for n in blk.vars if n not in used]:
+        del blk.vars[name]
+    # rebuild last-writer links: surviving vars whose writer was pruned
+    # (params the optimizer updated, BN running stats) must not point at
+    # removed ops (proglint stale-last-writer)
+    for v in blk.vars.values():
+        v.op = None
+    for op in blk.ops:
+        for n in op.output_names():
+            v = blk._find_var_recursive(n)
+            if v is not None:
+                v.op = op
+    frozen._bump_version()
+
+    # a frozen model ships to serving replicas: always worth one verify
+    findings = verify_program(frozen, live_out=live_out)
+    errors = [f for f in findings if f.severity == ERROR]
+    if errors:
+        raise ProgramVerifyError(errors, where="freeze_program result")
+
+    # capture weights BY VALUE into the model's own scope: every
+    # persistable the frozen ops still read (params AND buffers — BN
+    # running stats, traced constants)
+    param_names = sorted(
+        v.name for v in frozen.list_vars()
+        if v.persistable and v.name in used and v.name not in feed_names)
+    fscope = Scope()
+    missing = []
+    for n in param_names:
+        val = scope.find_var(n)
+        if val is None:
+            missing.append(n)
+        else:
+            fscope.set_var(n, val)
+    if missing:
+        raise RuntimeError(
+            f"freeze_program: {len(missing)} persistable(s) are "
+            f"uninitialized in the scope (run the startup program "
+            f"first): {missing[:5]}")
+    return FrozenModel(program=frozen, feed_names=list(feed_names),
+                       fetch_names=fetch_names, param_names=param_names,
+                       scope=fscope, fused_conv_bn=fused)
+
+
+def load_frozen(model_dir: str, model_filename=None, params_filename=None,
+                ) -> FrozenModel:
+    """Freeze a saved inference model (fluid.io.save_inference_model
+    output) — the disk path serving replicas load from."""
+    exe = fluid.Executor()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            model_dir, exe, model_filename=model_filename,
+            params_filename=params_filename)
+    fm = freeze_program(prog, scope=scope, feed_names=feeds,
+                        fetch_list=fetches)
+    fm.meta["model_dir"] = model_dir
+    return fm

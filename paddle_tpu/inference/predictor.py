@@ -1,0 +1,105 @@
+"""Serving predictor over a FrozenModel.
+
+Compilation goes through the Executor's compile-program cache with THE
+training cache key (fluid/executor.py _cache_key: program serial +
+version + feed signature + fetch names + flags): every Predictor built
+from the same FrozenModel shares one module-level Executor, so the
+second instantiation — and every replica thread — hits the cached XLA
+executable instead of re-compiling (the reference AnalysisPredictor
+clone contract, without the scope aliasing).
+
+Weight adoption (`adopt_weights`) swaps parameter VALUES in the
+predictor's scope between runs — the compiled function reloads its
+non-donated inputs from the scope every call, so the next run serves
+the new weights with zero recompilation. The epoch fence around it
+lives in server.py's micro-batch scheduler; a bare Predictor is
+single-threaded by contract.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import fluid
+from ..fluid.executor import Scope
+from .freeze import FrozenModel
+
+# one process-wide executor => one compile cache across predictors and
+# replica worker threads (keyed like training's, so distinct programs /
+# shapes / flag states never collide)
+_shared_executor: Optional[fluid.Executor] = None
+_shared_lock = threading.Lock()
+
+
+def shared_executor() -> fluid.Executor:
+    global _shared_executor
+    with _shared_lock:
+        if _shared_executor is None:
+            _shared_executor = fluid.Executor()
+        return _shared_executor
+
+
+class Predictor:
+    """Run a FrozenModel: feed dict in, fetch arrays out."""
+
+    def __init__(self, frozen: FrozenModel,
+                 executor: Optional[fluid.Executor] = None,
+                 share_weights: bool = True):
+        self.frozen = frozen
+        self._exe = executor or shared_executor()
+        if share_weights:
+            # replicas of one model share the weight arrays (immutable);
+            # adopt_weights REPLACES entries, so sharing is never aliasing
+            self._scope = frozen.scope
+        else:
+            self._scope = Scope()
+            for n in frozen.param_names:
+                self._scope.set_var(n, frozen.scope.find_var(n))
+        self.weight_epoch = 0
+
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self.frozen.feed_names)
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self.frozen.fetch_names)
+
+    def run(self, feed: Dict[str, np.ndarray],
+            return_numpy: bool = True) -> List[np.ndarray]:
+        missing = [n for n in self.frozen.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"predictor feed missing inputs: {missing}")
+        extra = [n for n in feed if n not in self.frozen.feed_names]
+        if extra:
+            raise ValueError(f"predictor feed has unknown inputs: {extra}")
+        with fluid.scope_guard(self._scope):
+            return self._exe.run(
+                self.frozen.program, feed=dict(feed),
+                fetch_list=self.frozen.fetch_names,
+                return_numpy=return_numpy)
+
+    def adopt_weights(self, weights: Dict[str, np.ndarray],
+                      epoch: Optional[int] = None) -> int:
+        """Install fresh parameter values (a weight_sync delivery).
+        Unknown names are rejected loudly — a manifest drift between
+        trainer and replica must never half-apply. Returns the new
+        weight epoch. NOT thread-safe against a concurrent run(); the
+        serving scheduler calls it only between micro-batches."""
+        unknown = [n for n in weights if n not in self.frozen.param_names]
+        if unknown:
+            raise KeyError(
+                f"adopt_weights: {len(unknown)} names not in the frozen "
+                f"model: {unknown[:5]}")
+        for n, v in weights.items():
+            cur = self._scope.find_var(n)
+            if cur is not None and np.shape(cur) != np.shape(v):
+                raise ValueError(
+                    f"adopt_weights: shape mismatch for {n!r}: "
+                    f"{np.shape(cur)} vs {np.shape(v)}")
+            self._scope.set_var(n, np.ascontiguousarray(v))
+        self.weight_epoch = (self.weight_epoch + 1 if epoch is None
+                             else int(epoch))
+        return self.weight_epoch

@@ -1,0 +1,634 @@
+"""Inference serving engine over the hardened PS RPC plane.
+
+THE "millions of users" item (ROADMAP): an InferenceServer implements
+the ps_server `_Handler` contract (an object with `handle(method,
+kwargs)` + `shutdown_event` behind `_TCPServer`), so the entire
+production transport comes for free — client retries with backoff,
+per-RPC deadlines, hedged reads, per-verb latency histograms with
+trace exemplars, deterministic fault injection (drop/refuse/delay/
+slow/stall/kill), and per-request causal trace_id spans.
+
+Verbs: `infer`, `model_info`, `health`, `stats` (+ ping/shutdown).
+
+Robustness core — the micro-batching scheduler (`MicroBatcher`):
+
+  admission    — a BOUNDED queue. A request is REFUSED with an explicit
+                 `Overloaded` reply when (a) the queue is full, (b) the
+                 server is draining, or (c) the projected queue wait
+                 (depth x EWMA batch latency) already exceeds the
+                 request's remaining deadline — never silent queuing to
+                 death. Shed work costs the server ~nothing; accepted
+                 work is expected to meet its deadline (the overload
+                 drill's contract).
+  batching     — queued requests coalesce into one device batch
+                 (concatenated rows, padded to max_batch so the XLA
+                 compile cache holds ONE entry per model), outputs are
+                 sliced back per request.
+  deadlines    — the client's budget rides the request; a request whose
+                 deadline expired while queued gets an explicit
+                 `DeadlineExceeded` reply (counted) instead of burning
+                 device time.
+  drain        — SIGTERM stops admission ("Overloaded: draining"),
+                 finishes every in-flight request, then exits — the
+                 launcher's supervised restart finds no dropped work.
+  epoch fence  — fresh weights (weight_sync.py) are STAGED by the
+                 subscriber thread and installed by the scheduler
+                 BETWEEN micro-batches: every request is served
+                 entirely by one weight epoch, echoed in its reply.
+
+SLO accounting: serve_requests_total{outcome=served|shed|deadline_
+exceeded|error}, serve_request_ms / serve_batch_ms histograms (p50/p99
+via the registry), serve_queue_depth gauge, serve_weight_epoch gauge —
+all on the `stats` verb, debugz /statusz, and tools/servetop.py.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..telemetry import get_registry
+from ..telemetry import tracing as _tracing
+from .freeze import FrozenModel, load_frozen
+from .predictor import Predictor
+from . import weight_sync as _wsync
+
+_REG = get_registry()
+
+# serving latency buckets (ms): sub-ms cache hits through multi-second
+# cold compiles
+SERVE_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000,
+                 10000, 30000)
+
+DEFAULT_MAX_BATCH = int(os.environ.get("PADDLE_SERVE_MAX_BATCH", 8))
+DEFAULT_QUEUE_DEPTH = int(os.environ.get("PADDLE_SERVE_QUEUE_DEPTH", 64))
+
+# the process-wide active server (debugz /statusz serving row)
+_ACTIVE: Optional["InferenceServer"] = None
+
+
+class Overloaded(RuntimeError):
+    """Admission refused — queue full, draining, or the projected wait
+    exceeds the request deadline. The CLIENT's cue to back off or go to
+    another replica; the error string crosses the wire verbatim."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before its batch ran."""
+
+
+class _Pending:
+    """One admitted request riding the batch queue."""
+
+    __slots__ = ("feed", "rows", "deadline_t", "event", "outputs",
+                 "error", "weight_epoch", "t_admit")
+
+    def __init__(self, feed, rows, deadline_t):
+        self.feed = feed
+        self.rows = int(rows)
+        self.deadline_t = deadline_t  # monotonic seconds or None
+        self.event = threading.Event()
+        self.outputs: Optional[List[np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+        self.weight_epoch = 0
+        self.t_admit = time.monotonic()
+
+
+class MicroBatcher:
+    """Bounded admission queue + scheduler thread running the model."""
+
+    def __init__(self, predictor: Predictor, max_batch: int = 8,
+                 queue_depth: int = 64, batch_wait_ms: float = 2.0):
+        self.predictor = predictor
+        self.max_batch = max(1, int(max_batch))
+        self.queue_limit = max(1, int(queue_depth))
+        self.batch_wait_s = float(batch_wait_ms) / 1e3
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stopped = False
+        self._inflight = 0
+        # EWMA of device batch latency: the admission estimator. Seeded
+        # pessimistically until the first (compile-bearing) batch lands.
+        self._batch_ewma_s: Optional[float] = None
+        self._pending_weights = None  # (weights dict, version) staged
+        self._wlock = threading.Lock()
+        self.weight_epoch = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # -- admission -------------------------------------------------------
+    def _projected_wait_s(self, depth_rows: int) -> float:
+        """Queue wait estimate: batches ahead of us x EWMA batch time.
+        Unknown EWMA (nothing measured yet) estimates 0 — the first
+        requests must be admitted for the estimator to learn."""
+        if self._batch_ewma_s is None:
+            return 0.0
+        batches_ahead = -(-depth_rows // self.max_batch) + 1
+        return batches_ahead * self._batch_ewma_s
+
+    def submit(self, feed: Dict[str, np.ndarray],
+               deadline_ms: Optional[float] = None) -> _Pending:
+        # validate the feed BEFORE admission: a malformed request must
+        # bounce as ITS error, never enter a batch other requests share
+        want = list(self.predictor.feed_names)
+        missing = [n for n in want if n not in feed]
+        extra = [n for n in feed if n not in want]
+        if missing or extra:
+            raise ValueError(
+                f"infer feed mismatch: missing {missing}, unknown "
+                f"{extra} (model feeds: {want})")
+        rows = int(np.shape(next(iter(feed.values())))[0]) if feed else 0
+        if rows <= 0 or rows > self.max_batch:
+            raise ValueError(
+                f"infer batch must have 1..{self.max_batch} rows "
+                f"(got {rows}; raise --max_batch or split the request)")
+        deadline_t = (time.monotonic() + float(deadline_ms) / 1e3
+                      if deadline_ms else None)
+        with self._cond:
+            if self._draining or self._stopped:
+                _REG.counter("serve_requests_total",
+                             outcome="shed").inc()
+                raise Overloaded("Overloaded: server is draining")
+            depth_rows = sum(p.rows for p in self._q)
+            if len(self._q) >= self.queue_limit:
+                _REG.counter("serve_requests_total",
+                             outcome="shed").inc()
+                raise Overloaded(
+                    f"Overloaded: admission queue full "
+                    f"({len(self._q)}/{self.queue_limit})")
+            if deadline_t is not None:
+                wait = self._projected_wait_s(depth_rows + rows)
+                if time.monotonic() + wait >= deadline_t:
+                    _REG.counter("serve_requests_total",
+                                 outcome="shed").inc()
+                    raise Overloaded(
+                        f"Overloaded: projected queue wait "
+                        f"{wait * 1e3:.0f}ms exceeds the request "
+                        f"deadline ({float(deadline_ms):.0f}ms)")
+            p = _Pending(feed, rows, deadline_t)
+            self._q.append(p)
+            _REG.gauge("serve_queue_depth").set(len(self._q))
+            self._cond.notify_all()
+        return p
+
+    # -- weight fence ----------------------------------------------------
+    def stage_weights(self, weights: Dict[str, np.ndarray],
+                      version: int) -> None:
+        """Called from the subscriber thread; the SCHEDULER installs it
+        between micro-batches (the epoch fence). Last staged wins."""
+        with self._wlock:
+            self._pending_weights = (weights, int(version))
+        with self._cond:
+            self._cond.notify_all()
+
+    def _maybe_adopt_weights(self) -> None:
+        with self._wlock:
+            staged, self._pending_weights = self._pending_weights, None
+        if staged is None:
+            return
+        weights, version = staged
+        try:
+            self.predictor.adopt_weights(weights)
+        except Exception as e:  # noqa: BLE001 — a bad delivery (manifest
+            # drift, shape mismatch) must never kill the scheduler:
+            # serving continues on the CURRENT epoch's weights
+            _REG.counter("serve_weight_adopt_errors_total").inc()
+            import sys
+
+            print(f"[inference_server] weight adoption rejected "
+                  f"(version {version}): {e}; serving stays on epoch "
+                  f"{self.weight_epoch}", file=sys.stderr, flush=True)
+            return
+        self.weight_epoch += 1
+        _REG.gauge("serve_weight_epoch").set(self.weight_epoch)
+        _REG.counter("serve_weight_fences_total").inc()
+
+    # -- the scheduler ---------------------------------------------------
+    def _take_batch(self) -> List[_Pending]:
+        """Block until work exists, then coalesce up to max_batch rows.
+        A short batch_wait lets near-simultaneous requests share a
+        device run without adding real latency."""
+        with self._cond:
+            while not self._q and not self._stopped:
+                self._cond.wait(0.1)
+                if self._pending_weights is not None and not self._q:
+                    return []  # install promptly even when idle
+            if self._stopped and not self._q:
+                return []
+            if (sum(p.rows for p in self._q) < self.max_batch
+                    and not self._draining):
+                self._cond.wait(self.batch_wait_s)
+            batch, rows = [], 0
+            while self._q and rows + self._q[0].rows <= self.max_batch:
+                p = self._q.popleft()
+                batch.append(p)
+                rows += p.rows
+            self._inflight = len(batch)
+            _REG.gauge("serve_queue_depth").set(len(self._q))
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            # fence: adoption happens here, BETWEEN micro-batches — no
+            # request observes two epochs
+            self._maybe_adopt_weights()
+            if not batch:
+                with self._cond:
+                    if self._stopped and not self._q:
+                        return
+                continue
+            try:
+                self._run_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — the scheduler
+                # must NEVER die: whatever failed, the batch gets error
+                # replies and the next batch is served
+                for p in batch:
+                    if not p.event.is_set():
+                        p.error = e
+                        _REG.counter("serve_requests_total",
+                                     outcome="error").inc()
+                        p.event.set()
+            finally:
+                with self._cond:
+                    self._inflight = 0
+                    self._cond.notify_all()
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in batch:
+            if p.deadline_t is not None and now >= p.deadline_t:
+                # expired while queued: explicit reply, no device time
+                p.error = DeadlineExceeded(
+                    "DeadlineExceeded: request expired in the queue")
+                _REG.counter("serve_requests_total",
+                             outcome="deadline_exceeded").inc()
+                p.event.set()
+            else:
+                live.append(p)
+        if not live:
+            return
+        rows = sum(p.rows for p in live)
+        feed_names = self.predictor.feed_names
+        feed = {}
+        for n in feed_names:
+            parts = [np.asarray(p.feed[n]) for p in live]
+            cat = np.concatenate(parts, axis=0)
+            if rows < self.max_batch:
+                # pad to ONE compiled batch shape: the XLA compile cache
+                # holds a single entry per model, and padding rows are
+                # dead compute, not a retrace
+                pad = np.zeros((self.max_batch - rows,) + cat.shape[1:],
+                               cat.dtype)
+                cat = np.concatenate([cat, pad], axis=0)
+            feed[n] = cat
+        t0 = time.perf_counter()
+        try:
+            outs = self.predictor.run(feed)
+        except BaseException as e:  # noqa: BLE001 — reply, keep serving
+            for p in live:
+                p.error = e
+                _REG.counter("serve_requests_total",
+                             outcome="error").inc()
+                p.event.set()
+            return
+        dt = time.perf_counter() - t0
+        # EWMA the admission estimator ranks queue wait with
+        ewma = self._batch_ewma_s
+        self._batch_ewma_s = dt if ewma is None else 0.8 * ewma + 0.2 * dt
+        _REG.histogram("serve_batch_ms", help="device micro-batch "
+                       "latency", buckets=SERVE_BUCKETS).observe(dt * 1e3)
+        _REG.counter("serve_batches_total").inc()
+        _REG.counter("serve_batch_rows_total").inc(rows)
+        off = 0
+        for p in live:
+            sliced = []
+            for o in outs:
+                o = np.asarray(o)
+                if o.ndim >= 1 and o.shape[0] == self.max_batch:
+                    sliced.append(o[off:off + p.rows])
+                else:  # batch-independent output (scalar/global stat)
+                    sliced.append(o)
+            p.outputs = sliced
+            p.weight_epoch = self.weight_epoch
+            _REG.counter("serve_requests_total", outcome="served").inc()
+            _REG.histogram(
+                "serve_request_ms",
+                help="admission-to-reply serving latency",
+                buckets=SERVE_BUCKETS).observe(
+                (time.monotonic() - p.t_admit) * 1e3)
+            off += p.rows
+            p.event.set()
+
+    # -- drain / teardown ------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, finish in-flight + queued work. True when
+        the queue reached empty inside the timeout."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (self._q or self._inflight) and \
+                    time.monotonic() < deadline:
+                self._cond.wait(0.1)
+            drained = not self._q and not self._inflight
+        return drained
+
+    def stop(self) -> None:
+        self.drain(timeout=5.0)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        def counter(name, **labels):
+            return _REG.counter(name, **labels).value
+
+        req_h = _REG.histogram("serve_request_ms", buckets=SERVE_BUCKETS)
+        with self._cond:
+            depth, inflight = len(self._q), self._inflight
+        return {
+            "queue_depth": depth,
+            "queue_limit": self.queue_limit,
+            "inflight": inflight,
+            "max_batch": self.max_batch,
+            "draining": self._draining,
+            "weight_epoch": self.weight_epoch,
+            "batch_ewma_ms": (None if self._batch_ewma_s is None
+                              else round(self._batch_ewma_s * 1e3, 3)),
+            "served_total": counter("serve_requests_total",
+                                    outcome="served"),
+            "shed_total": counter("serve_requests_total", outcome="shed"),
+            "deadline_exceeded_total": counter(
+                "serve_requests_total", outcome="deadline_exceeded"),
+            "error_total": counter("serve_requests_total",
+                                   outcome="error"),
+            "batches_total": counter("serve_batches_total"),
+            "request_ms": req_h.summary(),
+            # the SLO numbers servetop renders (bucket-interpolated)
+            "p50_ms": round(req_h.quantile(0.50), 3),
+            "p99_ms": round(req_h.quantile(0.99), 3),
+            "batch_ms": _REG.histogram(
+                "serve_batch_ms", buckets=SERVE_BUCKETS).summary(),
+        }
+
+
+class InferenceServer:
+    """ps_server._Handler contract: serve a FrozenModel."""
+
+    def __init__(self, frozen: FrozenModel,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
+                 batch_wait_ms: float = 2.0,
+                 weight_subscribe: bool = True):
+        global _ACTIVE
+
+        self.frozen = frozen
+        self.predictor = Predictor(frozen)
+        self.batcher = MicroBatcher(self.predictor, max_batch=max_batch,
+                                    queue_depth=queue_depth,
+                                    batch_wait_ms=batch_wait_ms)
+        self.shutdown_event = threading.Event()  # _Handler contract
+        self.started_at = time.time()
+        self.subscriber = None
+        if weight_subscribe:
+            self.subscriber = _wsync.maybe_start_subscriber(
+                frozen, self.batcher.stage_weights)
+        _ACTIVE = self
+
+    # -- verbs -----------------------------------------------------------
+    def infer(self, feed: Dict[str, np.ndarray],
+              deadline_ms: Optional[float] = None) -> dict:
+        pending = self.batcher.submit(feed, deadline_ms=deadline_ms)
+        # the handler thread parks here while the scheduler batches;
+        # wait is bounded by the deadline (+ grace for the reply)
+        timeout = None
+        if pending.deadline_t is not None:
+            timeout = max(0.0, pending.deadline_t - time.monotonic()) + 30.0
+        if not pending.event.wait(timeout):
+            _REG.counter("serve_requests_total",
+                         outcome="deadline_exceeded").inc()
+            raise DeadlineExceeded(
+                "DeadlineExceeded: batch did not complete in time")
+        if pending.error is not None:
+            raise pending.error
+        return {
+            "outputs": pending.outputs,
+            "fetch_names": self.frozen.fetch_names,
+            "weight_epoch": pending.weight_epoch,
+            "queue_ms": round((time.monotonic() - pending.t_admit) * 1e3,
+                              3),
+        }
+
+    def health(self) -> dict:
+        return {
+            "ok": not self.batcher._draining,
+            "draining": self.batcher._draining,
+            "weight_epoch": self.batcher.weight_epoch,
+            "queue_depth": self.batcher.queue_depth(),
+            "uptime_s": round(time.time() - self.started_at, 3),
+        }
+
+    def stats(self) -> dict:
+        from ..distributed.ps_server import server_telemetry
+
+        return {
+            "serving": self.batcher.stats(),
+            "model": self.frozen.model_info(),
+            "server": server_telemetry(),
+            "weight_sync": {
+                "enabled": self.subscriber is not None,
+                "version": (self.subscriber.version
+                            if self.subscriber else None),
+            },
+        }
+
+    def handle(self, method: str, kwargs: dict):
+        from ..distributed import faults
+
+        inj = faults.injector()
+        if inj is not None:
+            # the PSServer.handle contract: deterministic server-side
+            # fault rules (slow/kill/partition) apply to serving verbs
+            # too — the slow-tail hedge drill and kill drills ride this
+            inj.on_server_call(method)
+        if method == "ping":
+            return "pong"
+        if method == "infer":
+            return self.infer(kwargs["feed"], kwargs.get("deadline_ms"))
+        if method == "model_info":
+            return self.frozen.model_info()
+        if method == "health":
+            return self.health()
+        if method == "stats":
+            return self.stats()
+        if method == "drain":
+            return {"drained": self.batcher.drain(
+                timeout=float(kwargs.get("timeout", 30.0)))}
+        if method == "shutdown":
+            self.begin_drain()
+            self.shutdown_event.set()
+            return 0
+        raise ValueError(f"unknown serving verb {method!r}")
+
+    # -- lifecycle -------------------------------------------------------
+    def begin_drain(self) -> None:
+        with self.batcher._cond:
+            self.batcher._draining = True
+            self.batcher._cond.notify_all()
+
+    def close(self) -> None:
+        global _ACTIVE
+
+        if self.subscriber is not None:
+            self.subscriber.stop()
+        self.batcher.stop()
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+
+def current_status() -> Optional[dict]:
+    """The active server's serving stats, or None — the debugz /statusz
+    serving row (cheap: one module global)."""
+    srv = _ACTIVE
+    if srv is None:
+        return None
+    try:
+        return srv.batcher.stats()
+    except Exception:  # noqa: BLE001 — status pages never crash
+        return None
+
+
+# ---------------------------------------------------------------------------
+# process entry (one serving replica)
+# ---------------------------------------------------------------------------
+
+
+def serve(frozen: FrozenModel, port: int = 0, host: str = "0.0.0.0",
+          ready_cb=None, max_batch: int = DEFAULT_MAX_BATCH,
+          queue_depth: int = DEFAULT_QUEUE_DEPTH,
+          drain_grace: float = 30.0):
+    """Run one serving replica (blocks). Mirrors ps_server.serve: the
+    same _TCPServer/_Handler transport, heartbeat + coordinator lease
+    integration, SIGTERM -> graceful drain -> exit 0."""
+    from ..distributed.ps_server import _Handler, _TCPServer
+
+    _tracing.maybe_install_hooks()
+    srv = _TCPServer((host, port), _Handler)
+    inf = InferenceServer(frozen, max_batch=max_batch,
+                          queue_depth=queue_depth)
+    srv.ps = inf  # type: ignore[attr-defined] — _Handler contract
+
+    # graceful drain: SIGTERM stops admission (new infers bounce with
+    # "Overloaded: draining"), in-flight + queued requests finish, then
+    # the event loop stops — zero accepted requests dropped
+    def _sigterm(signum, frame):
+        def _drain_and_exit():
+            print("[inference_server] SIGTERM: draining "
+                  f"(queue={inf.batcher.queue_depth()})",
+                  file=sys.stderr, flush=True)
+            inf.begin_drain()
+            inf.batcher.drain(timeout=drain_grace)
+            inf.shutdown_event.set()
+            srv.shutdown()
+
+        threading.Thread(target=_drain_and_exit, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (in-process tests drive drain directly)
+
+    hb = None
+    hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
+    hb_tag = os.environ.get("PADDLE_TRAINER_TAG") or os.environ.get(
+        "PADDLE_PS_RANK_TAG")
+    if hb_dir and hb_tag:
+        from ..distributed.heartbeat import HeartBeatWorker
+
+        hb = HeartBeatWorker(hb_dir, hb_tag).start()
+    bound_host, bound_port = srv.server_address[0], srv.server_address[1]
+    if bound_host in ("0.0.0.0", ""):
+        bound_host = "127.0.0.1"
+    lease_worker = None
+    try:
+        from ..distributed import coordinator as _coord
+
+        lease_worker = _coord.maybe_start_lease_worker(
+            kind="inference", tag=hb_tag,
+            self_endpoint=f"{bound_host}:{bound_port}",
+            payload_fn=lambda: {"serving": inf.batcher.stats()})
+    except Exception as e:  # noqa: BLE001 — leases are advisory here
+        print(f"[inference_server] lease worker failed to start: {e}",
+              file=sys.stderr, flush=True)
+    if ready_cb is not None:
+        ready_cb(srv.server_address)
+    try:
+        from ..telemetry import debugz as _debugz
+
+        _debugz.maybe_serve()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        srv.serve_forever(poll_interval=0.1)
+    finally:
+        if hb is not None:
+            hb.stop()
+        if lease_worker is not None:
+            lease_worker.stop()
+        srv.close_all_connections()
+        srv.server_close()
+        inf.close()
+        _tracing.shutdown_dump()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="paddle_tpu.inference.server")
+    p.add_argument("--model_dir", required=True,
+                   help="fluid.io.save_inference_model output dir")
+    p.add_argument("--port", type=int, default=None,
+                   help="default: the port of PADDLE_CURRENT_ENDPOINT "
+                        "(launch.py --serve), else an ephemeral port")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--max_batch", type=int, default=DEFAULT_MAX_BATCH)
+    p.add_argument("--queue_depth", type=int, default=DEFAULT_QUEUE_DEPTH)
+    p.add_argument("--drain_grace", type=float, default=float(
+        os.environ.get("PADDLE_SERVE_DRAIN_GRACE", 30.0)))
+    args = p.parse_args(argv)
+
+    port = args.port
+    if port is None:
+        ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        port = int(ep.rsplit(":", 1)[1]) if ":" in ep else 0
+
+    frozen = load_frozen(args.model_dir)
+
+    def ready(addr):
+        # the launcher/tests read this line to learn the bound port
+        print(f"[inference_server] listening on {addr[0]}:{addr[1]}",
+              flush=True)
+
+    serve(frozen, port=port, host=args.host, ready_cb=ready,
+          max_batch=args.max_batch, queue_depth=args.queue_depth,
+          drain_grace=args.drain_grace)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,6 +165,17 @@ def _statusz() -> dict:
         out["membership"] = _coord.query_membership(timeout=1.0)
     except Exception:  # noqa: BLE001
         out["membership"] = None
+    try:
+        # inference serving (ISSUE 14): the active replica's SLO row —
+        # queue depth, served/shed/deadline_exceeded, p50/p99, weight
+        # epoch; None when this process serves no model
+        import sys as _sys
+
+        _srv = _sys.modules.get("paddle_tpu.inference.server")
+        out["serving"] = (_srv.current_status()
+                          if _srv is not None else None)
+    except Exception:  # noqa: BLE001
+        out["serving"] = None
     return out
 
 

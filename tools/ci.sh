@@ -395,6 +395,20 @@ JAX_PLATFORMS=cpu python tools/autotune.py show \
 JAX_PLATFORMS=cpu python tools/autotune.py diff \
   /tmp/ci_autotune.first /tmp/ci_autotune.json
 
+echo "== serving lane (admission/failover/drain/hedge drills) =="
+# ISSUE 14 acceptance, slow lane: (1) overload burst — at 2x
+# sustainable offered load the server sheds with EXPLICIT Overloaded
+# replies, every accepted request meets its deadline, and served/shed
+# counters reconcile exactly with the client's view; (2) the
+# kill-one-of-two launch.py --serve drill — SIGKILL one replica
+# mid-stream, the client fails over with zero accepted requests lost,
+# the supervisor respawns it and the recovered replica rejoins serving
+# after re-adopting the current (live-synced) weights; (3) injected
+# `slow:infer` tail on one replica — the client hedge races the other
+# and wins; (4) SIGTERM graceful drain — stop admitting, finish
+# in-flight, exit 0. Fast freeze/scheduler/fence units run in tier-1.
+python -m pytest tests/test_serving.py -q -m slow
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""servetop — live serving-replica SLO view (the serving-side sibling
+of proftop/memtop/numtop).
+
+Scrapes each replica's `stats` verb over the PS RPC transport and
+renders the numbers an operator watches during an incident: QPS over
+the scrape window, shed rate, queue depth, p50/p99 request latency,
+micro-batch occupancy, and the weight epoch (is every replica serving
+the same model?).
+
+Examples:
+
+    python tools/servetop.py --endpoints 127.0.0.1:8500,127.0.0.1:8501
+    python tools/servetop.py --endpoints 127.0.0.1:8500 --watch 2
+    python tools/servetop.py --endpoints 127.0.0.1:8500 --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def scrape(endpoints: List[str], deadline: float = 5.0) -> List[dict]:
+    """One `stats` scrape per replica; unreachable replicas get an
+    error row instead of killing the page."""
+    from paddle_tpu.distributed.ps_server import _Conn
+
+    rows = []
+    for ep in endpoints:
+        conn = _Conn(ep, deadline=deadline, io_timeout=deadline + 5.0)
+        try:
+            st = conn.call("stats")
+            rows.append({"endpoint": ep, **st})
+        except Exception as e:  # noqa: BLE001 — dead replica is a row
+            rows.append({"endpoint": ep,
+                         "error": f"{type(e).__name__}: {e}"})
+        finally:
+            conn.close()
+    return rows
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{float(v):8.1f}"
+
+
+def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
+           window_s: Optional[float] = None) -> str:
+    """One table line per replica. QPS needs two scrapes (prev +
+    window); single-shot runs show cumulative totals instead."""
+    out = []
+    hdr = (f"{'ENDPOINT':22} {'QPS':>7} {'SERVED':>8} {'SHED':>7} "
+           f"{'DEADLN':>7} {'QDEPTH':>6} {'P50MS':>8} {'P99MS':>8} "
+           f"{'EPOCH':>6} {'DRAIN':>5}")
+    out.append(hdr)
+    for row in rows:
+        ep = row["endpoint"]
+        if "error" in row:
+            out.append(f"{ep:22} DOWN: {row['error']}")
+            continue
+        s = row.get("serving", {})
+        served = int(s.get("served_total", 0))
+        qps = ""
+        if prev is not None and window_s and ep in prev:
+            prev_served = int(
+                prev[ep].get("serving", {}).get("served_total", 0))
+            qps = f"{(served - prev_served) / window_s:7.1f}"
+        else:
+            qps = f"{'-':>7}"
+        out.append(
+            f"{ep:22} {qps} {served:8d} "
+            f"{int(s.get('shed_total', 0)):7d} "
+            f"{int(s.get('deadline_exceeded_total', 0)):7d} "
+            f"{int(s.get('queue_depth', 0)):6d} "
+            f"{_fmt_ms(s.get('p50_ms'))} {_fmt_ms(s.get('p99_ms'))} "
+            f"{int(s.get('weight_epoch', 0)):6d} "
+            f"{'yes' if s.get('draining') else 'no':>5}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="servetop", description=__doc__)
+    p.add_argument("--endpoints", required=True,
+                   help="comma-separated serving replica host:port list")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object (list of per-replica "
+                        "stats) instead of the table")
+    p.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                   help="re-scrape every SECS seconds (QPS computed "
+                        "over the window); ctrl-C to stop")
+    p.add_argument("--deadline", type=float, default=5.0,
+                   help="per-replica scrape RPC deadline (seconds)")
+    args = p.parse_args(argv)
+    endpoints = [e.strip() for e in args.endpoints.split(",")
+                 if e.strip()]
+    if not endpoints:
+        print("servetop: --endpoints is empty", file=sys.stderr)
+        return 2
+
+    rows = scrape(endpoints, deadline=args.deadline)
+    if args.json and not args.watch:
+        print(json.dumps(rows, default=str, indent=1))
+        return 0
+    print(render(rows))
+    if not args.watch:
+        return 0
+    prev = {r["endpoint"]: r for r in rows}
+    try:
+        while True:
+            time.sleep(args.watch)
+            rows = scrape(endpoints, deadline=args.deadline)
+            if args.json:
+                print(json.dumps(rows, default=str))
+            else:
+                print(render(rows, prev=prev, window_s=args.watch))
+            prev = {r["endpoint"]: r for r in rows}
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
