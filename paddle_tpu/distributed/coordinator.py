@@ -245,6 +245,10 @@ class Coordinator:
         self.epoch = 0
         self.members: Dict[str, _Member] = {}
         self.events: deque = deque(maxlen=512)
+        # fleet view (ISSUE 15): unlike `events` (drained by the
+        # launcher log), incidents are RETAINED — the /fleetz "worst
+        # badput incidents" table reads them on every scrape
+        self.incidents: deque = deque(maxlen=64)
         self.lock = threading.RLock()
         self.shutdown_event = threading.Event()  # _Handler contract
         self.ckpt_barrier = CkptBarrier()
@@ -257,10 +261,21 @@ class Coordinator:
         self.fingerprints = FingerprintTable()
         self._sdc_evicted: set = set()
 
+    # incident kinds worth keeping for the fleet view: anything that
+    # costs the job badput (deaths, evictions, expiries, stragglers,
+    # SDC verdicts, promotions)
+    INCIDENT_EVENTS = frozenset((
+        "member_failed", "member_evicted", "lease_expired", "straggler",
+        "stall", "divergence", "ps_promoted", "ps_promotion_failed",
+        "restart",
+    ))
+
     # -- internals -------------------------------------------------------
     def _event(self, **ev) -> None:
         ev.setdefault("ts", time.time())
         self.events.append(ev)
+        if ev.get("event") in self.INCIDENT_EVENTS:
+            self.incidents.append(dict(ev))
 
     def _deadline(self, now: float) -> float:
         return now + self.lease_secs * self.expire_periods
@@ -389,6 +404,57 @@ class Coordinator:
         with self.lock:
             out, self.events = list(self.events), deque(maxlen=512)
             return out
+
+    # -- fleet metrics aggregation (ISSUE 15) ----------------------------
+    def note_incident(self, ev: dict) -> dict:
+        """The launcher (or a tool) records one badput incident —
+        straggler stall episodes and restart windows land here so the
+        fleet view cites the same evidence goodtop stitches."""
+        ev = dict(ev)
+        ev.setdefault("event", "stall")
+        with self.lock:
+            self._event(**ev)
+        return {"ok": True}
+
+    def fleet_status(self) -> dict:
+        """The one-endpoint fleet rollup: per-rank rows merged from the
+        latest renewal payloads (step progress, goodput summaries),
+        job-level goodput ratio + badput-by-cause, and the retained
+        incident list — debugz /fleetz renders this verbatim."""
+        from ..telemetry import goodput as _goodput
+
+        now = time.time()
+        with self.lock:
+            payloads = {t: (dict(m.payload) if m.payload else None)
+                        for t, m in self.members.items()}
+            meta = {t: {"kind": m.kind, "alive": m.alive,
+                        "evicted": m.evicted,
+                        "lease_remaining_s": round(m.expires - now, 3)}
+                    for t, m in self.members.items()}
+            incidents = list(self.incidents)
+            epoch = self.epoch
+        merged = _goodput.merge_fleet(payloads)
+        for tag, row in merged["ranks"].items():
+            row.update(meta.get(tag, {}))
+        merged["epoch"] = epoch
+        merged["world_size"] = sum(
+            1 for m in meta.values()
+            if m["kind"] == "trainer" and not m["evicted"])
+        merged["incidents"] = sorted(
+            incidents, key=lambda e: e.get("ts", 0), reverse=True)
+        merged["ts"] = round(now, 6)
+        return merged
+
+    def fleet_metrics(self) -> str:
+        """Fleet-wide Prometheus exposition: every member's bounded
+        snapshot with a rank label, plus goodput rollup lines — ONE
+        scrape target instead of N per-rank /metrics pages."""
+        from ..telemetry import goodput as _goodput
+
+        with self.lock:
+            payloads = {t: (dict(m.payload) if m.payload else None)
+                        for t, m in self.members.items()}
+        return _goodput.fleet_prometheus(payloads)
 
     # -- cross-replica SDC detection (ISSUE 12) --------------------------
     def numerics_report(self, tag: str, step: int, fingerprint: dict,
@@ -562,6 +628,12 @@ class Coordinator:
         if method == "report_failure":
             return self.report_failure(kwargs["tag"],
                                        kwargs.get("reason", ""))
+        if method == "fleet_status":
+            return self.fleet_status()
+        if method == "fleet_metrics":
+            return self.fleet_metrics()
+        if method == "note_incident":
+            return self.note_incident(kwargs.get("incident") or {})
         if method == "numerics_report":
             return self.numerics_report(
                 kwargs["tag"], kwargs["step"], kwargs["fingerprint"],
@@ -667,6 +739,15 @@ class CoordinatorClient:
     def numerics_status(self) -> dict:
         return self._conn.call("numerics_status")
 
+    def fleet_status(self) -> dict:
+        return self._conn.call("fleet_status")
+
+    def fleet_metrics(self) -> str:
+        return self._conn.call("fleet_metrics")
+
+    def note_incident(self, incident: dict) -> dict:
+        return self._conn.call("note_incident", incident=incident)
+
     def close(self) -> None:
         self._conn.close()
 
@@ -686,12 +767,25 @@ class LeaseWorker:
         self._thread: Optional[threading.Thread] = None
 
     def _payload(self) -> Optional[dict]:
-        if self.payload_fn is None:
-            return None
+        out = None
+        if self.payload_fn is not None:
+            try:
+                out = self.payload_fn()
+            except Exception:  # noqa: BLE001
+                out = None
         try:
-            return self.payload_fn()
-        except Exception:  # noqa: BLE001
-            return None
+            # fleet aggregation (ISSUE 15): pservers and serving
+            # replicas ship the same bounded snapshot + ledger summary
+            # trainers ride on heartbeat renewals; off = unchanged
+            from ..telemetry import goodput as _goodput
+
+            extra = _goodput.fleet_payload()
+            if extra:
+                out = dict(out or {})
+                out.update(extra)
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+        return out
 
     def start(self) -> "LeaseWorker":
         if self._thread is not None:
@@ -743,13 +837,29 @@ def maybe_start_lease_worker(kind: str, tag: Optional[str] = None,
 def query_membership(timeout: float = 2.0) -> Optional[dict]:
     """The coordinator's membership table, or None when no control
     plane is armed / reachable (status pages must never crash)."""
+    return _query("membership", timeout)
+
+
+def query_fleet(timeout: float = 2.0) -> Optional[dict]:
+    """The coordinator's fleet rollup (debugz /fleetz), or None when no
+    control plane is armed / reachable."""
+    return _query("fleet_status", timeout)
+
+
+def query_fleet_metrics(timeout: float = 2.0) -> Optional[str]:
+    """The fleet-wide Prometheus exposition (debugz /fleetz/metrics),
+    or None when no control plane is armed / reachable."""
+    return _query("fleet_metrics", timeout)
+
+
+def _query(verb: str, timeout: float):
     endpoint = os.environ.get(ENV_ENDPOINT)
     if not endpoint:
         return None
     try:
         client = CoordinatorClient(endpoint, deadline=timeout)
         try:
-            return client.membership()
+            return client._conn.call(verb)
         finally:
             client.close()
     except Exception:  # noqa: BLE001

@@ -37,10 +37,21 @@ Rank = Union[int, str]
 # code changes — the launcher's straggler detection reads it back
 _step_provider: Optional[Callable[[], Tuple[int, Optional[float]]]] = None
 
+# extra stamp fields (ISSUE 15): fluid/monitor registers a provider
+# returning e.g. {"data_frac": 0.7} — the input-skew signal straggler
+# attribution reads back. None values are dropped, so an unarmed
+# telemetry layer leaves the stamp bytes unchanged
+_aux_provider: Optional[Callable[[], dict]] = None
+
 
 def set_step_provider(fn: Callable[[], Tuple[int, Optional[float]]]) -> None:
     global _step_provider
     _step_provider = fn
+
+
+def set_aux_provider(fn: Callable[[], dict]) -> None:
+    global _aux_provider
+    _aux_provider = fn
 
 
 def _stamp_path(directory: str, rank: Rank) -> str:
@@ -106,13 +117,34 @@ class HeartBeatWorker:
         tid = _tracing.last_step_trace_id()
         if tid is not None:
             stamp["trace_id"] = tid
+        if _aux_provider is not None:
+            try:
+                for k, v in (_aux_provider() or {}).items():
+                    if v is not None:
+                        stamp[k] = v
+            except Exception:  # noqa: BLE001 — liveness must never die
+                pass
         tmp = f"{self.path}.tmp"
         with open(tmp, "w") as f:
             f.write(json.dumps(stamp))
         os.replace(tmp, self.path)  # atomic: monitor never reads a torn file
         if self.renew_cb is not None:
             try:
-                self.renew_cb(stamp)
+                payload = stamp
+                # fleet aggregation (ISSUE 15): the renewal additionally
+                # carries a bounded registry snapshot + the goodput
+                # ledger summary when PADDLE_FLEET_METRICS armed it —
+                # off = the stamp rides unchanged (wire bytes identical)
+                try:
+                    from ..telemetry import goodput as _goodput
+
+                    extra = _goodput.fleet_payload()
+                    if extra:
+                        payload = dict(stamp)
+                        payload.update(extra)
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
+                self.renew_cb(payload)
             except Exception:  # noqa: BLE001 — a flapping coordinator
                 pass  # must never kill the liveness thread
 
@@ -194,6 +226,15 @@ class StragglerMonitor:
         # ride-along): a straggler episode names the culprit's trace so
         # tracetop can be pointed straight at the evidence
         self._last_trace: dict = {}
+        # rank -> latest data-wait fraction (ISSUE 15): a flagged rank
+        # whose input pipeline dominates its step time is data-starved,
+        # not compute-slow — the event says which
+        self._last_frac: dict = {}
+        try:
+            self.data_starved_frac = float(
+                os.environ.get("PADDLE_DATA_STARVED_FRAC", 0.5) or 0.5)
+        except ValueError:
+            self.data_starved_frac = 0.5
 
     def poll(self) -> List[dict]:
         for r in self.ranks:
@@ -202,12 +243,20 @@ class StragglerMonitor:
                 continue
             if stamp.get("trace_id"):
                 self._last_trace[r] = stamp["trace_id"]
+            if stamp.get("data_frac") is not None:
+                self._last_frac[r] = float(stamp["data_frac"])
             self.detector.observe(r, int(stamp["step"]), float(stamp["t"]))
         events = self.detector.events()
         for ev in events:
             tid = self._last_trace.get(ev.get("rank"))
             if tid is not None:
                 ev["trace_id"] = tid
+            frac = self._last_frac.get(ev.get("rank"))
+            if frac is not None:
+                ev["data_frac"] = frac
+                ev["cause"] = ("data_wait"
+                               if frac >= self.data_starved_frac
+                               else "compute")
         return events
 
 

@@ -181,6 +181,19 @@ def _parse_args(argv):
         "chrome://tracing)",
     )
     p.add_argument(
+        "--fleetz_port", type=int, default=None,
+        help="arm the FLEET goodput view (telemetry/goodput.py): "
+        "every child classifies its wall-clock into a goodput/badput "
+        "ledger (PADDLE_GOODPUT=1) and ships a bounded metrics "
+        "snapshot + ledger summary on each lease renewal "
+        "(PADDLE_FLEET_METRICS=1); the launcher serves debugz on THIS "
+        "port with /fleetz (per-rank rollup, job goodput %%, worst "
+        "incidents) and /fleetz/metrics (fleet-wide Prometheus "
+        "exposition, per-rank labels — scrape ONE endpoint instead of "
+        "N). Implies --lease_secs 5 when the lease plane is off. "
+        "Default: PADDLE_FLEETZ_PORT if set, else off",
+    )
+    p.add_argument(
         "--debugz_port", type=int, default=None,
         help="arm every trainer's live introspection server "
         "(telemetry/debugz.py: /metrics /statusz /steps /proftop "
@@ -603,6 +616,7 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                          straggler=None, failure: Optional[dict] = None,
                          coordinator=None, straggler_eject=False,
                          serve_respawner: Optional[ServeRespawner] = None,
+                         fleet_ledger=None, incident_coord=None,
                          ) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
@@ -703,6 +717,28 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
 
                 for ev in straggler.poll():
                     print(format_event(ev), file=sys.stderr, flush=True)
+                    # goodput (ISSUE 15): a straggler episode is badput
+                    # — one `stall` event in the launcher ledger (with
+                    # the culprit's step trace_id, the same hop tracetop
+                    # blames) and the coordinator's incident ring
+                    culprit_tag = next(
+                        (t.tag for t in trainers
+                         if str(t.rank) == str(ev.get("rank"))), None)
+                    stall_ev = {
+                        "event": "stall", "rank": ev.get("rank"),
+                        "tag": culprit_tag, "step": ev.get("step"),
+                        "excess_ms": ev.get("excess_ms"),
+                        "slowdown": ev.get("slowdown"),
+                        "cause": ev.get("cause", "compute"),
+                        "trace_id": ev.get("trace_id"),
+                    }
+                    if fleet_ledger is not None:
+                        fleet_ledger.event(**stall_ev)
+                    if incident_coord is not None:
+                        try:
+                            incident_coord.note_incident(stall_ev)
+                        except Exception:  # noqa: BLE001 — accounting
+                            pass
                     if straggler_eject:
                         culprit = next(
                             (t for t in trainers
@@ -740,6 +776,28 @@ def launch(argv=None) -> int:
             lease_secs = float(os.environ.get("PADDLE_LEASE_SECS", 0) or 0)
         except ValueError:
             lease_secs = 0.0
+
+    # fleet goodput view (--fleetz_port / PADDLE_FLEETZ_PORT): ledger in
+    # every child, bounded snapshots on renewals, one launcher-side
+    # scrape endpoint. Rides the lease plane — renewals ARE the push
+    # channel — so arming it arms leases too
+    fleetz_port = args.fleetz_port
+    if fleetz_port is None:
+        raw = os.environ.get("PADDLE_FLEETZ_PORT")
+        if raw:
+            try:
+                fleetz_port = int(raw)
+            except ValueError:
+                fleetz_port = None
+    if fleetz_port is not None:
+        if lease_secs <= 0:
+            lease_secs = 5.0
+            print("[launch] --fleetz_port arms the lease plane "
+                  "(renewals carry the fleet payloads); defaulting "
+                  "--lease_secs 5", file=sys.stderr)
+        # children inherit through the spawn env copies
+        os.environ["PADDLE_GOODPUT"] = "1"
+        os.environ["PADDLE_FLEET_METRICS"] = "1"
 
     heartbeat_dir = None
     own_heartbeat_dir = False
@@ -797,6 +855,59 @@ def launch(argv=None) -> int:
         print(f"[launch] job coordinator on {coord_ep} (lease "
               f"{lease_secs}s, per-rank budget {per_rank})",
               file=sys.stderr)
+
+    # goodput ledgers (PADDLE_GOODPUT, armed by --fleetz_port or set by
+    # the operator): children persist per-incarnation interval files and
+    # the launcher keeps a lifecycle ledger (restart detect/respawn
+    # timestamps, straggler stalls) goodtop stitches them with
+    fleet_ledger = None
+    fleet_exporter = None
+    goodput_armed = os.environ.get("PADDLE_GOODPUT", "") not in (
+        "", "0", "false")
+    if goodput_armed:
+        goodput_dir = (os.environ.get("PADDLE_GOODPUT_DIR")
+                       or os.environ.get("PADDLE_TRACE_DIR"))
+        if not goodput_dir and args.log_dir:
+            goodput_dir = os.path.join(args.log_dir, "goodput")
+        if goodput_dir:
+            os.makedirs(goodput_dir, exist_ok=True)
+            # children inherit it through the spawn env copies
+            os.environ["PADDLE_GOODPUT_DIR"] = goodput_dir
+            from ..telemetry.goodput import LauncherLedger
+
+            fleet_ledger = LauncherLedger(goodput_dir)
+            fleet_ledger.event(event="job_start", world=len(cluster),
+                               tags=[t.tag for t in cluster],
+                               lease_secs=lease_secs)
+    if fleetz_port is not None:
+        from ..telemetry import debugz as _debugz
+
+        try:
+            fleet_srv = _debugz.serve(fleetz_port)
+            print(f"[launch] fleet view on port "
+                  f"{fleet_srv.server_address[1]}: /fleetz (rollup), "
+                  f"/fleetz/metrics (one-endpoint Prometheus scrape)",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"[launch] could not bind --fleetz_port {fleetz_port}:"
+                  f" {e}; fleet view disabled", file=sys.stderr)
+        # fleet-wide push (ISSUE 15 satellite): ONE aggregated POST from
+        # the coordinator per interval instead of N per-rank pushes —
+        # the URL is consumed here so children never see it (per-rank
+        # mode unchanged when fleet aggregation is not armed)
+        push_url = os.environ.pop("PADDLE_METRICS_PUSH_URL", None)
+        if push_url:
+            from ..telemetry import export as _export
+
+            fleet_exporter = _export.start_fleet(
+                push_url, coord.fleet_status, coord.fleet_metrics,
+                interval_s=float(os.environ.get(
+                    "PADDLE_METRICS_PUSH_SECS", "15") or 15),
+                retries=int(os.environ.get(
+                    "PADDLE_METRICS_PUSH_RETRIES", "3") or 3))
+            print(f"[launch] fleet metrics push -> {push_url} "
+                  f"(aggregated; per-rank pushes suppressed)",
+                  file=sys.stderr)
 
     # sharded-checkpoint commit barrier (fluid/checkpoint.py): every
     # multi-rank job gets one — it costs a daemon thread and only
@@ -874,7 +985,8 @@ def launch(argv=None) -> int:
                     heartbeat_timeout=args.heartbeat_timeout)
         rc = _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                               ps_supervisor, grace, coord=coord,
-                              lease_armed=lease_secs > 0)
+                              lease_armed=lease_secs > 0,
+                              fleet_ledger=fleet_ledger)
         if args.trace_dir:
             # pservers dump their span timelines on SIGTERM — stop them
             # BEFORE the merge so timeline.json spans the whole job
@@ -901,6 +1013,11 @@ def launch(argv=None) -> int:
         return rc
     finally:
         terminate_pservers(pservers)
+        if fleet_exporter is not None:
+            try:
+                fleet_exporter.stop(final_flush=True)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         if coord_server is not None:
             stop_coordinator(coord_server)
         if ckpt_barrier_server is not None:
@@ -917,7 +1034,7 @@ def launch(argv=None) -> int:
 
 def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                      ps_supervisor=None, grace=None, coord=None,
-                     lease_armed=False) -> int:
+                     lease_armed=False, fleet_ledger=None) -> int:
     """Supervision loop with per-rank budgets and elastic resize.
 
     Failure accounting lives in the coordinator: every group-ending
@@ -962,6 +1079,11 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
     trainers = list(cluster)  # survivors, re-ranked on resize
     attempt = 0
     epoch = coord.epoch if coord is not None else 0
+    # goodput lifecycle (ISSUE 15): one `restart` event per group
+    # respawn, carrying detect_ts (watch noticed the death) and
+    # respawn_ts (replacement group spawned) — goodtop decomposes each
+    # cross-incarnation gap against these
+    pending_restart = None
     while True:
         local = start_local_trainers(
             trainers, node_ip, args.training_script,
@@ -970,6 +1092,14 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
             heartbeat_dir=heartbeat_dir, debugz_base_port=debugz_base,
             membership_epoch=epoch, module=serve_module,
         )
+        if pending_restart is not None:
+            pending_restart["respawn_ts"] = round(time.time(), 6)
+            if fleet_ledger is not None:
+                fleet_ledger.event(event="restart", **pending_restart)
+            if coord is not None:
+                coord.note_incident(
+                    dict(pending_restart, event="restart"))
+            pending_restart = None
         if not local:
             print(f"[launch] node_ip {node_ip} not in --ips {ips}", file=sys.stderr)
             return 2
@@ -1012,7 +1142,9 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
             local, monitor=monitor, ps_supervisor=ps_supervisor,
             grace=grace, straggler=straggler, failure=failure,
             coordinator=coord if lease_armed else None,
-            straggler_eject=eject, serve_respawner=serve_respawner)
+            straggler_eject=eject, serve_respawner=serve_respawner,
+            fleet_ledger=fleet_ledger, incident_coord=coord)
+        detect_ts = time.time()  # the watch just noticed the death
         if (rc == 0
                 or rc == 128 + signal.SIGINT
                 or rc == 128 + signal.SIGTERM  # whole-job preemption
@@ -1055,6 +1187,11 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                   f"aborting", file=sys.stderr)
             return rc
         attempt += 1
+        pending_restart = {
+            "tag": tag, "rank": rank, "reason": reason,
+            "detect_ts": round(detect_ts, 6), "attempt": attempt,
+            "world": len(trainers), "resized": resized,
+        }
         if resized:
             # elastic resize: survivors re-shard their checkpoints
             # (CheckpointManager world-size gate) and the sync-PS

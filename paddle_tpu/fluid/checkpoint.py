@@ -1129,8 +1129,18 @@ class CheckpointManager:
                     f"re-split the data positions and pass "
                     f"allow_reshard=True (or PADDLE_ELASTIC_RESHARD=1)")
             try:
+                t0 = time.perf_counter()
                 out = self._load(s, program, scope)
                 out["world_size"] = ckpt_ws
+                try:
+                    # goodput ledger (ISSUE 15): restore windows are
+                    # recovery cost, not idle (no-op unless armed)
+                    from ..telemetry import goodput as _goodput
+
+                    _goodput.on_restore(
+                        (time.perf_counter() - t0) * 1e3)
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
                 return out
             except Exception as e:  # corrupt despite checksums: skip it
                 warnings.warn(

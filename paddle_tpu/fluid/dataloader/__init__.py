@@ -319,8 +319,16 @@ class _MultiprocessIter:
                     ) from None
 
     def __iter__(self):
+        # prefetch-depth gauge (ISSUE 15): the reorder buffer holds the
+        # batches workers finished ahead of the consumer — 0 at a get
+        # means the consumer is starved by the worker pool
+        from ..reader import _queue_gauge
+
+        depth = _queue_gauge("mp")
         try:
             while self._next < len(self._batches):
+                if depth is not None:
+                    depth.set(len(self._pending))
                 while self._next not in self._pending:
                     tag, payload = self._get_result()
                     if tag == "error":

@@ -118,8 +118,18 @@ class Executor:
             try:
                 out = self._run_impl(program, feed, fetch_list, scope,
                                      return_numpy, rec)
-            except BaseException:
+            except BaseException as exc:
                 monitor.abandon_step()
+                try:
+                    # goodput ledger (ISSUE 15): an un-committed step's
+                    # window is badput — BadStepError means discarded
+                    # work (bad_step_replay), anything else a stall
+                    from ..telemetry import goodput as _goodput
+
+                    _goodput.on_abandoned_step(
+                        type(exc).__name__ == "BadStepError")
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
                 raise
         monitor.commit_step(rec)
         return out

@@ -20,6 +20,11 @@ executor's seams:
   fetch_ms       device->host conversion of the fetch list
   ckpt_save_ms   CheckpointManager.save durations (attached to the next
                  committed step record)
+  idle_ms        raw gap between the previous Executor.run return and
+                 this one's entry — the goodput ledger's idle signal
+                 (ISSUE 15). Iterator wait recorded by timed_iter in
+                 that gap also lands in data_wait_ms; the ledger
+                 classifies by residual so nothing double-counts
   peak_hbm_bytes device allocator high-water (jax memory_stats), the
                  MAX across all local devices — per-device values land
                  in the device_peak_hbm_bytes{device=...} gauges and
@@ -40,7 +45,7 @@ import threading
 import time
 from typing import Optional, Tuple
 
-from ..telemetry import get_registry, sink
+from ..telemetry import get_registry, goodput, sink
 
 _reg = get_registry()
 
@@ -68,6 +73,15 @@ _hb_registered = False
 _recent_steps = collections.deque(maxlen=128)
 _keep_recent = False
 _aux_armed = False
+
+# idle accounting (ISSUE 15): perf_counter at the end of the previous
+# Executor.run — the gap to the next begin_step is the step record's
+# idle_ms, the goodput ledger's idle signal
+_last_run_end: Optional[float] = None
+# rolling (data_wait_ms, wall_ms) per recent step: the data-starved
+# fraction heartbeat stamps carry for input-skew attribution
+_dw_window = collections.deque(maxlen=16)
+_last_commit_wall: Optional[float] = None
 
 
 def enabled() -> bool:
@@ -116,7 +130,7 @@ def recent_steps() -> list:
 
 class StepRecord:
     __slots__ = ("data_wait_ms", "compile_ms", "device_ms", "fetch_ms",
-                 "ckpt_save_ms", "cache_hit", "fenced")
+                 "ckpt_save_ms", "idle_ms", "cache_hit", "fenced")
 
     def __init__(self):
         self.data_wait_ms = 0.0
@@ -124,19 +138,28 @@ class StepRecord:
         self.device_ms = 0.0
         self.fetch_ms = 0.0
         self.ckpt_save_ms = 0.0
+        self.idle_ms = 0.0
         self.cache_hit = True
         self.fenced = False
 
 
 def begin_step() -> Optional[StepRecord]:
-    """Open a step record when a consumer exists (JSONL sink on, or the
-    debugz server armed — its /steps page reads the same records); None
-    otherwise. The record is thread-local so _ensure_compiled (called
-    deeper in the stack) can contribute compile numbers."""
+    """Open a step record when a consumer exists (JSONL sink on, the
+    debugz server armed — its /steps page reads the same records — or
+    the goodput ledger classifying wall-clock); None otherwise. The
+    record is thread-local so _ensure_compiled (called deeper in the
+    stack) can contribute compile numbers."""
     _arm_aux()
-    if not (sink.enabled() or _keep_recent):
+    if not (sink.enabled() or _keep_recent or goodput.enabled()):
         return None
     rec = StepRecord()
+    if _last_run_end is not None:
+        # raw gap between consecutive Executor.run calls. Iterator wait
+        # (timed_iter) happens inside this gap and ALSO lands in
+        # data_wait_ms — the goodput ledger classifies by residual, so
+        # a dataset loop's idle is the gap net of its data wait
+        rec.idle_ms = max(
+            0.0, (time.perf_counter() - _last_run_end) * 1e3)
     _tls.rec = rec
     return rec
 
@@ -147,7 +170,9 @@ def current_record() -> Optional[StepRecord]:
 
 def abandon_step() -> None:
     """Drop the open record (step raised; nothing committed)."""
+    global _last_run_end
     _tls.rec = None
+    _last_run_end = time.perf_counter()
 
 
 def record_compile(ms: float, retrace: bool) -> None:
@@ -260,6 +285,8 @@ def mark_step() -> int:
             from ..distributed import heartbeat
 
             heartbeat.set_step_provider(step_rate_sample)
+            heartbeat.set_aux_provider(
+                lambda: {"data_frac": data_wait_fraction()})
         except Exception:  # noqa: BLE001 — liveness channel is optional
             pass
     return step
@@ -282,11 +309,28 @@ def step_rate_sample() -> Tuple[int, Optional[float]]:
     return n, avg
 
 
+def data_wait_fraction() -> Optional[float]:
+    """Recent input-pipeline share of step wall time (0..1), or None
+    when no telemetry consumer is armed / no window yet. Rides the
+    heartbeat stamps (input-skew attribution: a straggler whose
+    data_frac is high is data-starved, not compute-slow)."""
+    if not (sink.enabled() or goodput.enabled() or _keep_recent):
+        return None
+    with _lock:
+        dw = sum(d for d, _ in _dw_window)
+        wall = sum(w for _, w in _dw_window)
+    if wall <= 0:
+        return None
+    return round(min(1.0, dw / wall), 4)
+
+
 def commit_step(rec: Optional[StepRecord]) -> None:
     """Close the step: always-on bookkeeping, plus the JSONL record and
     gauges when telemetry output is on."""
     global _pending_data_wait_ms, _pending_ckpt_save_ms
+    global _last_run_end, _last_commit_wall
     step = mark_step()
+    _last_run_end = time.perf_counter()
     if rec is None:
         return
     _tls.rec = None
@@ -320,11 +364,22 @@ def commit_step(rec: Optional[StepRecord]) -> None:
         "device_ms": round(rec.device_ms, 3),
         "fetch_ms": round(rec.fetch_ms, 3),
         "ckpt_save_ms": round(rec.ckpt_save_ms, 3),
+        "idle_ms": round(rec.idle_ms, 3),
         "cache_hit": rec.cache_hit,
         "fenced": rec.fenced,
         "retraces": _counter("executor_retraces_total").value,
         "peak_hbm_bytes": peak,
     }
+    # input-skew window (ISSUE 15): data-wait fraction of recent step
+    # wall — heartbeat stamps carry it so a data-starved straggler is
+    # named as such, not as a compute straggler
+    now_wall = time.time()
+    with _lock:
+        if _last_commit_wall is not None:
+            _dw_window.append((rec.data_wait_ms,
+                               max(0.0, (now_wall - _last_commit_wall)
+                                   * 1e3)))
+        _last_commit_wall = now_wall
     try:
         # join the step's causal trace (PADDLE_TRACING): the record and
         # the span ring now cite each other; key absent when tracing is
@@ -340,19 +395,28 @@ def commit_step(rec: Optional[StepRecord]) -> None:
         with _lock:
             _recent_steps.append(dict(payload, ts=round(time.time(), 6)))
     sink.emit(payload)
+    try:
+        # goodput ledger (ISSUE 15): classify the wall window ending at
+        # this commit. Unarmed cost: one cached bool read
+        goodput.on_step_commit(payload, now=now_wall)
+    except Exception:  # noqa: BLE001 — accounting never fails a step
+        pass
 
 
 def reset_for_tests() -> None:
     """Zero the per-process step state (unit tests only; the registry
     is reset separately via telemetry.get_registry().reset())."""
     global _step_count, _pending_data_wait_ms, _pending_ckpt_save_ms
-    global _aux_armed, _keep_recent
+    global _aux_armed, _keep_recent, _last_run_end, _last_commit_wall
     with _lock:
         _step_count = 0
         _recent.clear()
         _recent_steps.clear()
+        _dw_window.clear()
         _pending_data_wait_ms = 0.0
         _pending_ckpt_save_ms = 0.0
     _aux_armed = False
     _keep_recent = False
+    _last_run_end = None
+    _last_commit_wall = None
     _tls.rec = None

@@ -15,11 +15,87 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from . import framework
+
+# ---------------------------------------------------------------------------
+# data-pipeline instrumentation (ISSUE 15): break the opaque data_wait
+# scalar into stages. Armed only while a telemetry consumer exists
+# (PADDLE_METRICS_PATH sink or the PADDLE_GOODPUT ledger) — flag-off,
+# every loader path below costs one cached bool read per epoch and the
+# produced batches are bit-identical either way.
+#
+#   data_fetch_ms    pulling one item/batch from the user's reader or
+#                    indexing the dataset (the producer side)
+#   data_decode_ms   collate_fn over the fetched samples (DataLoader)
+#   data_batch_ms    stacking samples into batch arrays (_stack_samples)
+#   data_h2d_ms      host array materialization of the yielded batch
+#                    (np.asarray before the feed; the device transfer
+#                    itself is charged to the executor's data_wait)
+#   data_queue_depth prefetch queue depth sampled at each consumer get
+#                    (0 = the consumer is starved, the producer is the
+#                    bottleneck; capacity = producer ahead, healthy)
+# ---------------------------------------------------------------------------
+
+def _pipeline_armed() -> bool:
+    from ..telemetry import goodput, sink
+
+    return sink.enabled() or goodput.enabled()
+
+
+def _stage_obs() -> Optional[dict]:
+    """The per-stage histograms, or None when no consumer is armed.
+    Resolved from the registry per call (get-or-create dict lookups) so
+    a registry reset() never strands observations on orphaned metrics;
+    callers hold the returned dict for the whole epoch."""
+    if not _pipeline_armed():
+        return None
+    from ..telemetry import get_registry
+
+    reg = get_registry()
+    return dict(
+        fetch=reg.histogram(
+            "data_fetch_ms",
+            help="input pipeline: user reader / dataset fetch"),
+        decode=reg.histogram(
+            "data_decode_ms",
+            help="input pipeline: collate_fn (decode) time"),
+        batch=reg.histogram(
+            "data_batch_ms",
+            help="input pipeline: sample stacking into batches"),
+        h2d=reg.histogram(
+            "data_h2d_ms",
+            help="input pipeline: host batch-array materialization"),
+    )
+
+
+def _queue_gauge(loader: str):
+    """Prefetch queue-depth gauge for one loader flavor, or None."""
+    if not _pipeline_armed():
+        return None
+    from ..telemetry import get_registry
+
+    return get_registry().gauge(
+        "data_queue_depth",
+        help="prefetch queue depth at consumer get (0 = starved)",
+        loader=loader)
+
+
+def _timed_source(it, hist):
+    """Wrap an iterator so each next() lands in `hist` (fetch stage)."""
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        hist.observe((time.perf_counter() - t0) * 1e3)
+        yield item
 
 
 def _generator_producer(q, reader):
@@ -98,6 +174,8 @@ class GeneratorLoader:
         if self._use_multiprocess:
             yield from self._iter_multiprocess()
             return
+        obs = _stage_obs()
+        depth = _queue_gauge("generator")
         q: queue.Queue = queue.Queue(maxsize=self._capacity)
         err: List[BaseException] = []
         stop = threading.Event()
@@ -116,7 +194,12 @@ class GeneratorLoader:
 
         def worker():
             try:
-                for batch in self._batch_reader():
+                source = self._batch_reader()
+                if obs is not None:
+                    # fetch stage: each batch pulled from the user's
+                    # reader, timed in the producer thread
+                    source = _timed_source(source, obs["fetch"])
+                for batch in source:
                     if not _put(batch):
                         return
             except BaseException as e:  # noqa: BLE001 — re-raised on consumer
@@ -128,12 +211,17 @@ class GeneratorLoader:
         t.start()
         try:
             while True:
+                if depth is not None:
+                    depth.set(q.qsize())
                 item = q.get()
                 if item is _END:
                     if err:
                         raise err[0]
                     return
+                t0 = time.perf_counter() if obs is not None else 0.0
                 arrays = [np.asarray(a) for a in item]
+                if obs is not None:
+                    obs["h2d"].observe((time.perf_counter() - t0) * 1e3)
                 if self._return_list or not self._names:
                     yield arrays
                 else:
@@ -196,10 +284,11 @@ class GeneratorLoader:
             q.close()
 
 
-def _buffered_gen(gen, capacity=2):
+def _buffered_gen(gen, capacity=2, depth_gauge=None):
     """Background-thread prefetch (double buffering) with abandon-safe
     shutdown: a stop flag checked by the timed put releases the worker
-    when the consumer breaks early."""
+    when the consumer breaks early. `depth_gauge` (ISSUE 15) samples
+    the queue depth at every consumer get."""
     q: queue.Queue = queue.Queue(maxsize=capacity)
     err: List[BaseException] = []
     stop = threading.Event()
@@ -226,6 +315,8 @@ def _buffered_gen(gen, capacity=2):
     threading.Thread(target=worker, daemon=True).start()
     try:
         while True:
+            if depth_gauge is not None:
+                depth_gauge.set(q.qsize())
             item = q.get()
             if item is _END:
                 if err:
@@ -237,8 +328,14 @@ def _buffered_gen(gen, capacity=2):
 
 
 def _stack_samples(samples):
+    obs = _stage_obs()
+    t0 = time.perf_counter() if obs is not None else 0.0
     ncol = len(samples[0])
-    return [np.stack([np.asarray(s[i]) for s in samples]) for i in range(ncol)]
+    out = [np.stack([np.asarray(s[i]) for s in samples])
+           for i in range(ncol)]
+    if obs is not None:
+        obs["batch"].observe((time.perf_counter() - t0) * 1e3)
+    return out
 
 
 class DataLoader:
@@ -294,15 +391,27 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def _raw_batches(self):
+        obs = _stage_obs()
+
+        def _collate_timed(items):
+            if obs is None:
+                return self._collate(items)
+            t0 = time.perf_counter()
+            out = self._collate(items)
+            obs["decode"].observe((time.perf_counter() - t0) * 1e3)
+            return out
+
         if self._iterable_ds:
             buf = []
-            for sample in self._dataset:
+            source = (self._dataset if obs is None
+                      else _timed_source(self._dataset, obs["fetch"]))
+            for sample in source:
                 buf.append(sample)
                 if len(buf) == self._batch_size:
-                    yield self._collate(buf)
+                    yield _collate_timed(buf)
                     buf = []
             if buf and not self._drop_last:
-                yield self._collate(buf)
+                yield _collate_timed(buf)
             return
         batches = list(self._batch_sampler)
         if self._num_workers > 0:
@@ -315,14 +424,25 @@ class DataLoader:
             )
         else:
             for idx in batches:
-                yield self._collate([self._dataset[i] for i in idx])
+                if obs is None:
+                    yield self._collate([self._dataset[i] for i in idx])
+                    continue
+                t0 = time.perf_counter()
+                items = [self._dataset[i] for i in idx]
+                obs["fetch"].observe((time.perf_counter() - t0) * 1e3)
+                yield _collate_timed(items)
 
     def __iter__(self):
+        obs = _stage_obs()
         gen = self._raw_batches()
         if self._use_buffer and self._num_workers == 0:
-            gen = _buffered_gen(gen, capacity=2)
+            gen = _buffered_gen(gen, capacity=2,
+                                depth_gauge=_queue_gauge("dataloader"))
         for arrays in gen:
+            t0 = time.perf_counter() if obs is not None else 0.0
             arrays = [np.asarray(a) for a in arrays]
+            if obs is not None:
+                obs["h2d"].observe((time.perf_counter() - t0) * 1e3)
             yield arrays if self._return_list else dict(zip(self._names, arrays))
 
     @staticmethod

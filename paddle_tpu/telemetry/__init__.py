@@ -32,6 +32,13 @@ Layering:
                        BadStepError), cross-replica SDC fingerprints
                        (PADDLE_SDC_CHECK_EVERY via the coordinator),
                        /numericz, tools/numtop.py
+  telemetry.goodput    job-lifetime goodput/badput ledger: every rank
+                       wall-clock second classified into buckets
+                       (PADDLE_GOODPUT), per-incarnation JSONL files
+                       restarts stitch across, bounded fleet payloads
+                       on lease renewals (PADDLE_FLEET_METRICS), the
+                       coordinator-side merge behind debugz /fleetz,
+                       tools/goodtop.py
   fluid/monitor.py     the executor-facing step-time breakdown built on
                        the registry + sink
 
@@ -45,6 +52,7 @@ from . import (  # noqa: F401
     cost,
     debugz,
     export,
+    goodput,
     memory,
     numerics,
     sink,

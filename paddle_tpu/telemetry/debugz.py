@@ -29,6 +29,12 @@ sidecar, no log scraping:
   /tracez    recent causal traces from the span ring (PADDLE_TRACING),
              slowest-first with per-hop durations — the live view of
              what the flight recorder would dump (JSON)
+  /fleetz    fleet goodput rollup (ISSUE 15): per-rank rows merged
+             from lease-renewal payloads, job goodput ratio, badput by
+             cause, worst incidents (JSON; needs the job coordinator —
+             launch.py --fleetz_port serves it launcher-side), and
+             /fleetz/metrics — the fleet-wide Prometheus exposition
+             with per-rank labels (scrape ONE endpoint, not N)
   /flagz     GET: the runtime-mutable flag whitelist + every flag's
              current value. POST {"name": ..., "value": ...}: flip one
              whitelisted flag live (FLAGS_check_numerics and friends;
@@ -283,10 +289,37 @@ def _route(path: str):
     if path == "/flagz":
         return (200, "application/json",
                 json.dumps(_flagz_state()).encode())
+    if path == "/fleetz":
+        # fleet goodput rollup (ISSUE 15): one page for the whole job —
+        # per-rank rows, job goodput %, worst badput incidents. Served
+        # from the coordinator's merged renewal payloads; available on
+        # ANY process that knows PADDLE_COORDINATOR_ENDPOINT (the
+        # launcher serves it at --fleetz_port)
+        from ..distributed import coordinator as _coord
+
+        fleet = _coord.query_fleet(timeout=2.0)
+        if fleet is None:
+            return (404, "application/json", json.dumps(
+                {"error": "no job coordinator reachable; arm the "
+                          "control plane (launch.py --lease_secs / "
+                          "--fleetz_port) so renewals carry fleet "
+                          "payloads"}).encode())
+        return (200, "application/json",
+                json.dumps(fleet, default=str).encode())
+    if path == "/fleetz/metrics":
+        from ..distributed import coordinator as _coord
+
+        text = _coord.query_fleet_metrics(timeout=2.0)
+        if text is None:
+            return (404, "text/plain; charset=utf-8",
+                    b"no job coordinator reachable\n")
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                text.encode())
     if path in ("", "/", "/index.html"):
         return (200, "text/plain; charset=utf-8",
                 b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
-                b"/memz /numericz /tracez /flagz /healthz\n")
+                b"/memz /numericz /tracez /fleetz /fleetz/metrics "
+                b"/flagz /healthz\n")
     return 404, "text/plain; charset=utf-8", b"not found\n"
 
 

@@ -316,9 +316,69 @@ def active_traces() -> Optional[PushExporter]:
     return _trace_exporter
 
 
+# ---------------------------------------------------------------------------
+# fleet push (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+
+_fleet_exporter: Optional[PushExporter] = None
+
+
+def _fleet_body_fn(status_fn, metrics_fn=None):
+    """Payload builder for the launcher-side fleet exporter: ONE
+    aggregated snapshot — the coordinator's merged fleet rollup plus
+    (optionally) the fleet Prometheus text — instead of N per-rank
+    POSTs."""
+
+    def body():
+        fleet = status_fn()
+        if not fleet or not fleet.get("ranks"):
+            return None  # nothing renewed yet: skip the POST
+        payload = {
+            "resource": {
+                "job": os.environ.get("PADDLE_JOB_NAME", "paddle_tpu"),
+                "role": "launcher",
+                "pid": os.getpid(),
+            },
+            "ts": round(time.time(), 6),
+            "fleet": fleet,
+        }
+        if metrics_fn is not None:
+            try:
+                payload["exposition"] = metrics_fn()
+            except Exception:  # noqa: BLE001 — rollup still ships
+                pass
+        return json.dumps(payload, default=str).encode(), "application/json"
+
+    return body
+
+
+def start_fleet(url: str, status_fn, metrics_fn=None,
+                **kwargs) -> PushExporter:
+    """Launcher-side aggregated push: when PADDLE_METRICS_PUSH_URL is
+    set fleet-wide, launch.py calls this with the coordinator's
+    fleet_status/fleet_metrics and STRIPS the env from the children —
+    one coordinator POST per interval replaces N per-rank pushes
+    (per-rank mode is unchanged when fleet aggregation is not armed;
+    env unset = zero network, as today)."""
+    global _fleet_exporter
+    with _lock:
+        if _fleet_exporter is not None:
+            _fleet_exporter.stop()
+        _fleet_exporter = PushExporter(
+            url, body_fn=_fleet_body_fn(status_fn, metrics_fn),
+            counter_prefix="fleet_metrics", **kwargs).start()
+        return _fleet_exporter
+
+
+def active_fleet() -> Optional[PushExporter]:
+    return _fleet_exporter
+
+
 def stop():
-    """Tests: tear down and allow re-arming (both exporters)."""
+    """Tests: tear down and allow re-arming (all exporters)."""
     global _exporter, _checked, _trace_exporter, _trace_checked
+    global _fleet_exporter
     with _lock:
         if _exporter is not None:
             _exporter.stop()
@@ -328,3 +388,6 @@ def stop():
             _trace_exporter.stop()
         _trace_exporter = None
         _trace_checked = False
+        if _fleet_exporter is not None:
+            _fleet_exporter.stop()
+        _fleet_exporter = None

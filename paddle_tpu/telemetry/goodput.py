@@ -1,0 +1,747 @@
+"""Job-lifetime goodput/badput ledger + fleet-level aggregation (ISSUE 15).
+
+Every observability plane so far (per-op device time, causal traces,
+HBM attribution, numerics) is per-rank and per-incarnation. This module
+answers the question production operators actually ask — "what fraction
+of this job's wall-clock was productive training, and where did the
+rest go?" — across ranks, restarts and evictions:
+
+  ledger      each rank classifies EVERY wall-clock second of its life
+              into one of the BUCKETS below, derived from the step-time
+              breakdown fluid/monitor.py already measures. The window
+              between consecutive classification points is authoritative
+              (measured phase times are scaled down if they overlap it,
+              and the un-measured remainder is `idle`), so per-rank
+              totals always sum to wall time exactly — "unclassified
+              residual" exists only across process-death gaps, which
+              the stitcher (goodtop) classifies as `restart_recovery`.
+  persistence one JSONL file per incarnation —
+              `<PADDLE_GOODPUT_DIR|PADDLE_TRACE_DIR>/goodput.<tag>.<inc>.jsonl`
+              (inc = PADDLE_ELASTIC_RESTART) — appended line-at-a-time
+              like the metrics sink, so an eviction loses at most the
+              in-flight line and the JOB total survives as the sum over
+              incarnation files.
+  fleet       when PADDLE_FLEET_METRICS=1, every lease renewal
+              (heartbeat stamps / LeaseWorker payloads) carries a
+              BOUNDED registry snapshot + the ledger summary; the
+              launcher-hosted coordinator merges them (`merge_fleet`)
+              and serves one fleet-level scrape: debugz `/fleetz`
+              (JSON rollup) and `/fleetz/metrics` (Prometheus text with
+              per-rank labels) — operators scrape ONE endpoint, not N.
+
+Buckets:
+
+  productive_step   compiled step execution + fetch (the work)
+  data_wait         input pipeline: feed materialization + iterator wait
+  compile           trace + XLA compile (cache misses / retraces)
+  checkpoint_save   CheckpointManager.save windows
+  restart_recovery  detection -> respawn -> recompile -> replay after a
+                    death (restore() charges here rank-side; the
+                    cross-incarnation gap is stitched in by goodtop)
+  bad_step_replay   steps that raised BadStepError (work discarded)
+  stall             straggler episodes / failed steps (work happened,
+                    nothing committed)
+  idle              everything else (gaps between Executor.run calls)
+
+Env contract:
+
+  PADDLE_GOODPUT=1          arm the ledger (off = zero cost, no files,
+                            step records / wire bytes bit-identical)
+  PADDLE_GOODPUT_DIR        ledger directory (default PADDLE_TRACE_DIR;
+                            neither set = in-memory totals only)
+  PADDLE_GOODPUT_EVERY      kind="goodput" sink-record cadence (steps,
+                            default 20)
+  PADDLE_FLEET_METRICS=1    ride bounded snapshots + ledger summaries on
+                            lease renewals (fleet aggregation)
+  PADDLE_FLEET_METRICS_MAX  bounded-snapshot series cap (default 120)
+
+Module is stdlib-only: the launcher, coordinator and tools/goodtop.py
+import it without jax.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import sink as _sink
+from .registry import get_registry
+
+ENV_GATE = "PADDLE_GOODPUT"
+ENV_DIR = "PADDLE_GOODPUT_DIR"
+ENV_EVERY = "PADDLE_GOODPUT_EVERY"
+ENV_FLEET = "PADDLE_FLEET_METRICS"
+ENV_FLEET_MAX = "PADDLE_FLEET_METRICS_MAX"
+
+BUCKETS = (
+    "productive_step",
+    "data_wait",
+    "compile",
+    "checkpoint_save",
+    "restart_recovery",
+    "bad_step_replay",
+    "stall",
+    "idle",
+)
+
+# wall time of module import: recorded in the birth row so the stitcher
+# can see how much of the respawn gap was interpreter/jax import
+_IMPORT_TS = time.time()
+
+_enabled: Optional[bool] = None
+_fleet_enabled: Optional[bool] = None
+_ledger: Optional["GoodputLedger"] = None
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """PADDLE_GOODPUT gate, resolved once per process."""
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get(ENV_GATE, "") not in ("", "0", "false")
+    return _enabled
+
+
+def fleet_enabled() -> bool:
+    """PADDLE_FLEET_METRICS gate, resolved once per process."""
+    global _fleet_enabled
+    if _fleet_enabled is None:
+        _fleet_enabled = os.environ.get(ENV_FLEET, "") not in (
+            "", "0", "false")
+    return _fleet_enabled
+
+
+def _process_tag() -> str:
+    # the STABLE membership identity survives elastic resizes where the
+    # rank numbering does not — ledger files must keep accumulating
+    # under one tag across incarnations
+    t = os.environ.get("PADDLE_TRAINER_TAG")
+    if t:
+        return t
+    from . import tracing
+
+    return tracing.process_tag()
+
+
+class GoodputLedger:
+    """Per-process interval classifier + per-incarnation JSONL file.
+
+    The classification point is `_commit_window`: given the measured
+    phase milliseconds since the previous point, the wall window is
+    decomposed so the bucket totals sum to wall EXACTLY — measured
+    phases are scaled down when they overlap the window (async writers),
+    and the remainder lands in `residual_bucket` (normally `idle`)."""
+
+    def __init__(self, tag: Optional[str] = None,
+                 incarnation: Optional[int] = None,
+                 directory: Optional[str] = None,
+                 now: Optional[float] = None):
+        self.tag = tag or _process_tag()
+        if incarnation is None:
+            try:
+                incarnation = int(
+                    os.environ.get("PADDLE_ELASTIC_RESTART", 0) or 0)
+            except ValueError:
+                incarnation = 0
+        self.incarnation = int(incarnation)
+        if directory is None:
+            directory = (os.environ.get(ENV_DIR)
+                         or os.environ.get("PADDLE_TRACE_DIR"))
+        self.path = (os.path.join(
+            directory, f"goodput.{self.tag}.{self.incarnation}.jsonl")
+            if directory else None)
+        now = time.time() if now is None else now
+        self.t0 = now
+        self._last_ts = now
+        self.totals: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self.steps = 0
+        self._events = 0
+        try:
+            self._every = int(os.environ.get(ENV_EVERY, 20) or 20)
+        except ValueError:
+            self._every = 20
+        self._lock = threading.Lock()
+        self._f = None
+        self._write({"event": "birth", "tag": self.tag,
+                     "incarnation": self.incarnation, "pid": os.getpid(),
+                     "ts": round(now, 6),
+                     "import_ts": round(_IMPORT_TS, 6)})
+
+    # -- persistence -----------------------------------------------------
+    def _write(self, row: dict) -> None:
+        if self.path is None:
+            return
+        try:
+            if self._f is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(json.dumps(row) + "\n")
+        except OSError:
+            # a full disk must never fail a training step; totals and
+            # gauges keep accumulating in memory
+            self.path = None
+
+    # -- classification --------------------------------------------------
+    def _commit_window(self, measured: Dict[str, float],
+                       now: Optional[float] = None, event: str = "step",
+                       residual_bucket: str = "idle", **extra) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            wall = max(0.0, (now - self._last_ts) * 1e3)
+            t_start = self._last_ts
+            self._last_ts = now
+            buckets = {b: max(0.0, float(measured.get(b, 0.0)))
+                       for b in BUCKETS}
+            s = sum(buckets.values())
+            if s > wall:
+                if s > 0:
+                    # measured phases overlap the wall window (async
+                    # overlap / coarse timers): scale down so the
+                    # ledger stays wall-exact
+                    k = wall / s
+                    buckets = {b: v * k for b, v in buckets.items()}
+            else:
+                buckets[residual_bucket] += wall - s
+            for b, v in buckets.items():
+                self.totals[b] += v
+            if event == "step":
+                self.steps += 1
+            self._events += 1
+            row = {
+                "event": event,
+                "t0": round(t_start, 6),
+                "t1": round(now, 6),
+                "buckets": {b: round(v, 3)
+                            for b, v in buckets.items() if v > 0},
+            }
+            row.update(extra)
+            emit_summary = (self._events % self._every == 0)
+        self._write(row)
+        self._update_gauges()
+        if emit_summary:
+            _sink.emit(dict(self.summary(), kind="goodput",
+                            event="summary"))
+        return row
+
+    def _update_gauges(self) -> None:
+        reg = get_registry()
+        total = sum(self.totals.values())
+        prod = self.totals["productive_step"]
+        reg.gauge("goodput_ratio",
+                  help="productive fraction of classified wall-clock "
+                       "(job-lifetime goodput, this incarnation)").set(
+            prod / total if total > 0 else 0.0)
+        for b in BUCKETS:
+            if b == "productive_step":
+                continue
+            reg.gauge("badput_seconds_total",
+                      help="classified non-productive wall-clock by "
+                           "cause (seconds)",
+                      cause=b).set(round(self.totals[b] / 1e3, 3))
+
+    # -- entry points ----------------------------------------------------
+    def on_step_commit(self, payload: dict,
+                       now: Optional[float] = None) -> None:
+        """One committed Executor step: classify the window since the
+        previous point from the kind="step" breakdown."""
+        measured = {
+            "data_wait": payload.get("data_wait_ms", 0.0),
+            "compile": payload.get("compile_ms", 0.0),
+            "checkpoint_save": payload.get("ckpt_save_ms", 0.0),
+            "productive_step": (payload.get("device_ms", 0.0)
+                                + payload.get("fetch_ms", 0.0)),
+        }
+        self._commit_window(measured, now=now, event="step",
+                            step=payload.get("step"))
+
+    def on_abandoned_step(self, bad: bool,
+                          now: Optional[float] = None) -> None:
+        """A step raised without committing: BadStepError windows are
+        `bad_step_replay` (discarded work), any other failure `stall`."""
+        self._commit_window(
+            {}, now=now, event="bad_step" if bad else "failed_step",
+            residual_bucket="bad_step_replay" if bad else "stall")
+
+    def on_restore(self, ms: float, now: Optional[float] = None) -> None:
+        """CheckpointManager.restore window — recovery cost."""
+        self._commit_window({"restart_recovery": float(ms)}, now=now,
+                            event="restore")
+
+    def note_stall(self, ms: float, cause: str = "straggler",
+                   trace_id: Optional[str] = None,
+                   now: Optional[float] = None) -> None:
+        """An externally observed stall charged to this rank."""
+        extra = {"cause": cause}
+        if trace_id:
+            extra["trace_id"] = trace_id
+        self._commit_window({"stall": float(ms)}, now=now, event="stall",
+                            **extra)
+
+    # -- read side -------------------------------------------------------
+    def summary(self) -> dict:
+        with self._lock:
+            total = sum(self.totals.values())
+            prod = self.totals["productive_step"]
+            return {
+                "tag": self.tag,
+                "incarnation": self.incarnation,
+                "t0": round(self.t0, 6),
+                "t1": round(self._last_ts, 6),
+                "steps": self.steps,
+                "goodput_ratio": round(prod / total, 6) if total else None,
+                "buckets_ms": {b: round(v, 3)
+                               for b, v in self.totals.items()},
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+# ---------------------------------------------------------------------------
+# module-level hooks (the executor/monitor/checkpoint call these; every
+# one is a no-op costing one cached bool read when PADDLE_GOODPUT is off)
+# ---------------------------------------------------------------------------
+
+
+def get_ledger() -> Optional[GoodputLedger]:
+    global _ledger
+    if not enabled():
+        return None
+    if _ledger is None:
+        with _lock:
+            if _ledger is None:
+                _ledger = GoodputLedger()
+    return _ledger
+
+
+def on_step_commit(payload: dict, now: Optional[float] = None) -> None:
+    led = get_ledger()
+    if led is not None:
+        led.on_step_commit(payload, now=now)
+
+
+def on_abandoned_step(bad: bool, now: Optional[float] = None) -> None:
+    led = get_ledger()
+    if led is not None:
+        led.on_abandoned_step(bad, now=now)
+
+
+def on_restore(ms: float, now: Optional[float] = None) -> None:
+    led = get_ledger()
+    if led is not None:
+        led.on_restore(ms, now=now)
+
+
+def note_stall(ms: float, cause: str = "straggler",
+               trace_id: Optional[str] = None) -> None:
+    led = get_ledger()
+    if led is not None:
+        led.note_stall(ms, cause=cause, trace_id=trace_id)
+
+
+def summary() -> Optional[dict]:
+    led = get_ledger()
+    return led.summary() if led is not None else None
+
+
+def reset_for_tests() -> None:
+    global _enabled, _fleet_enabled, _ledger
+    with _lock:
+        if _ledger is not None:
+            _ledger.close()
+        _ledger = None
+    _enabled = None
+    _fleet_enabled = None
+
+
+# ---------------------------------------------------------------------------
+# fleet payload: what one rank ships on each lease renewal
+# ---------------------------------------------------------------------------
+
+
+def bounded_snapshot(max_series: Optional[int] = None) -> dict:
+    """Registry snapshot bounded to `max_series` series (deterministic:
+    names sorted, first N kept, the rest counted as `truncated`).
+    Histograms ship summaries only — the full buckets stay scrape-side."""
+    if max_series is None:
+        try:
+            max_series = int(os.environ.get(ENV_FLEET_MAX, 120) or 120)
+        except ValueError:
+            max_series = 120
+    snap = get_registry().snapshot()
+    out: Dict[str, dict] = {}
+    n = 0
+    truncated = 0
+    for name in sorted(snap):
+        ent = snap[name]
+        rows = []
+        for row in ent["series"]:
+            if n >= max_series:
+                truncated += 1
+                continue
+            n += 1
+            if ent["type"] == "histogram":
+                rows.append({"labels": row["labels"],
+                             "count": row["count"],
+                             "sum": row["sum"], "avg": row["avg"]})
+            else:
+                rows.append({"labels": row["labels"],
+                             "value": row["value"]})
+        if rows:
+            out[name] = {"type": ent["type"], "series": rows}
+    return {"series_limit": max_series, "truncated": truncated,
+            "metrics": out}
+
+
+def fleet_payload() -> Optional[dict]:
+    """The extra keys a lease renewal carries when fleet aggregation is
+    armed; None (payload unchanged, wire bytes bit-identical) otherwise."""
+    if not fleet_enabled():
+        return None
+    out: dict = {"metrics": bounded_snapshot()}
+    s = summary()
+    if s is not None:
+        out["goodput"] = s
+    return out
+
+
+# ---------------------------------------------------------------------------
+# coordinator-side merge (stdlib only — runs in the launcher)
+# ---------------------------------------------------------------------------
+
+
+def merge_fleet(members: Dict[str, Optional[dict]]) -> dict:
+    """Merge per-member renewal payloads into the fleet rollup: one row
+    per rank plus the job-level goodput ratio and badput-by-cause."""
+    ranks: Dict[str, dict] = {}
+    job_buckets = {b: 0.0 for b in BUCKETS}
+    for tag in sorted(members):
+        p = members[tag] or {}
+        g = p.get("goodput") or {}
+        row = {
+            "step": p.get("step"),
+            "avg_step_s": p.get("avg_step_s"),
+            "data_frac": p.get("data_frac"),
+            "incarnation": g.get("incarnation"),
+            "goodput_ratio": g.get("goodput_ratio"),
+            "buckets_ms": g.get("buckets_ms"),
+            "has_metrics": bool(p.get("metrics")),
+        }
+        ranks[tag] = row
+        for b, v in (g.get("buckets_ms") or {}).items():
+            if b in job_buckets:
+                job_buckets[b] += float(v)
+    total = sum(job_buckets.values())
+    prod = job_buckets["productive_step"]
+    worst = sorted(
+        ((b, v) for b, v in job_buckets.items()
+         if b != "productive_step" and v > 0),
+        key=lambda kv: -kv[1])
+    return {
+        "ranks": ranks,
+        "job": {
+            "total_ms": round(total, 3),
+            "goodput_ratio": round(prod / total, 6) if total else None,
+            "badput_ms": {b: round(v, 3) for b, v in worst},
+        },
+    }
+
+
+def fleet_prometheus(members: Dict[str, Optional[dict]]) -> str:
+    """One Prometheus text exposition for the whole fleet: every
+    member's bounded snapshot re-emitted with a `rank="<tag>"` label,
+    plus fleet-level goodput rollup lines — the single scrape target."""
+    # name -> (type, [(labelkey, value_lines...)])
+    by_name: Dict[str, dict] = {}
+    for tag in sorted(members):
+        p = members[tag] or {}
+        metrics = (p.get("metrics") or {}).get("metrics") or {}
+        for name in sorted(metrics):
+            ent = metrics[name]
+            slot = by_name.setdefault(name, {"type": ent["type"],
+                                             "samples": []})
+            for row in ent["series"]:
+                labels = dict(row.get("labels") or {})
+                labels["rank"] = tag
+                lab = "{" + ",".join(
+                    f'{k}="{_escape(v)}"'
+                    for k, v in sorted(labels.items())) + "}"
+                if ent["type"] == "histogram":
+                    slot["samples"].append(
+                        (f"{name}_sum{lab}", row.get("sum", 0)))
+                    slot["samples"].append(
+                        (f"{name}_count{lab}", row.get("count", 0)))
+                else:
+                    slot["samples"].append(
+                        (f"{name}{lab}", row.get("value", 0)))
+    lines: List[str] = []
+    for name in sorted(by_name):
+        ent = by_name[name]
+        kind = ("untyped" if ent["type"] == "histogram" else ent["type"])
+        lines.append(f"# TYPE {name} {kind}")
+        for sample, value in ent["samples"]:
+            lines.append(f"{sample} {value}")
+    merged = merge_fleet(members)
+    lines.append("# TYPE fleet_goodput_ratio gauge")
+    for tag, row in sorted(merged["ranks"].items()):
+        if row.get("goodput_ratio") is not None:
+            lines.append(
+                f'fleet_goodput_ratio{{rank="{_escape(tag)}"}} '
+                f'{row["goodput_ratio"]}')
+    job = merged["job"]
+    if job.get("goodput_ratio") is not None:
+        lines.append("# TYPE job_goodput_ratio gauge")
+        lines.append(f"job_goodput_ratio {job['goodput_ratio']}")
+    lines.append("# TYPE job_badput_seconds_total gauge")
+    for b, v in sorted(job.get("badput_ms", {}).items()):
+        lines.append(
+            f'job_badput_seconds_total{{cause="{_escape(b)}"}} '
+            f'{round(v / 1e3, 3)}')
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _escape(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+# ---------------------------------------------------------------------------
+# launcher-side lifecycle ledger (restart/stall events goodtop stitches)
+# ---------------------------------------------------------------------------
+
+
+LAUNCHER_FILE = "goodput.launcher.jsonl"
+
+
+class LauncherLedger:
+    """Append-only JSONL of job lifecycle events the launcher observes:
+    job_start, restart (detect_ts -> respawn_ts per death) and straggler
+    stall episodes — the cross-incarnation evidence goodtop joins with
+    the per-rank ledgers to decompose restart_recovery."""
+
+    def __init__(self, directory: str):
+        self.path = os.path.join(directory, LAUNCHER_FILE)
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def event(self, **row) -> None:
+        row.setdefault("ts", round(time.time(), 6))
+        try:
+            with self._lock, open(self.path, "a", buffering=1) as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass  # lifecycle bookkeeping must never kill the launcher
+
+
+# ---------------------------------------------------------------------------
+# offline load + restart stitching (tools/goodtop.py and tests)
+# ---------------------------------------------------------------------------
+
+
+def load_job(directory: str) -> dict:
+    """Parse every goodput.<tag>.<inc>.jsonl (+ the launcher ledger) in
+    `directory` into {"ranks": {tag: {inc: {...}}}, "launcher": [...]}"""
+    ranks: Dict[str, Dict[int, dict]] = {}
+    launcher: List[dict] = []
+    for name in sorted(os.listdir(directory)):
+        path = os.path.join(directory, name)
+        if name == LAUNCHER_FILE:
+            with open(path) as f:
+                launcher = [json.loads(ln) for ln in f if ln.strip()]
+            continue
+        if not (name.startswith("goodput.") and name.endswith(".jsonl")):
+            continue
+        stem = name[len("goodput."):-len(".jsonl")]
+        tag, _, inc_s = stem.rpartition(".")
+        try:
+            inc = int(inc_s)
+        except ValueError:
+            continue
+        rows: List[dict] = []
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln:
+                    try:
+                        rows.append(json.loads(ln))
+                    except ValueError:
+                        pass  # in-flight torn line (killed process)
+        birth = next((r for r in rows if r.get("event") == "birth"), None)
+        windows = [r for r in rows if "buckets" in r]
+        totals = {b: 0.0 for b in BUCKETS}
+        for w in windows:
+            for b, v in w["buckets"].items():
+                if b in totals:
+                    totals[b] += float(v)
+        steps = [w for w in windows if w.get("event") == "step"]
+        ckpt_steps = [w.get("step") for w in steps
+                      if w["buckets"].get("checkpoint_save", 0) > 0
+                      and w.get("step") is not None]
+        ranks.setdefault(tag, {})[inc] = {
+            "rows": rows,
+            "birth": birth,
+            "t0": (birth or {}).get("ts",
+                                    windows[0]["t0"] if windows else None),
+            "t1": windows[-1]["t1"] if windows else
+            (birth or {}).get("ts"),
+            "totals_ms": totals,
+            "n_steps": len(steps),
+            "last_step": max((w.get("step") for w in steps
+                              if w.get("step") is not None), default=None),
+            "last_ckpt_step": max(ckpt_steps, default=None),
+        }
+    return {"ranks": ranks, "launcher": launcher}
+
+
+def _match_restart(launcher: List[dict], death_ts: float,
+                   birth_ts: float) -> Optional[dict]:
+    """The launcher restart event covering [death_ts, birth_ts] — any
+    group restart in the window counts (a group restart respawns every
+    tag, not just the culprit's; the event's own `tag` names the
+    culprit)."""
+    best = None
+    for ev in launcher:
+        if ev.get("event") != "restart":
+            continue
+        det = ev.get("detect_ts")
+        if det is None:
+            continue
+        # allow one watch-poll of slack on both sides
+        if death_ts - 2.0 <= det <= birth_ts + 2.0:
+            if best is None or abs(det - death_ts) < abs(
+                    best["detect_ts"] - death_ts):
+                best = ev
+    return best
+
+
+def stitch_job(directory: str) -> dict:
+    """The job-lifetime view: per-rank and job totals with every
+    cross-incarnation gap classified as restart_recovery, each restart
+    incident decomposed into detection / respawn / recompile / replay,
+    and launcher-observed stall episodes cited. This is what
+    tools/goodtop.py renders."""
+    job = load_job(directory)
+    per_rank: Dict[str, dict] = {}
+    incidents: List[dict] = []
+    for tag, incs in sorted(job["ranks"].items()):
+        order = sorted(incs)
+        totals = {b: 0.0 for b in BUCKETS}
+        for inc in order:
+            for b, v in incs[inc]["totals_ms"].items():
+                totals[b] += v
+        wall_t0 = incs[order[0]]["t0"]
+        wall_t1 = incs[order[-1]]["t1"]
+        # stitch each gap between incarnation k and k+1
+        for a, b_ in zip(order, order[1:]):
+            prev, nxt = incs[a], incs[b_]
+            death = prev["t1"]
+            birth = nxt["t0"]
+            if death is None or birth is None:
+                continue
+            gap_ms = max(0.0, (birth - death) * 1e3)
+            totals["restart_recovery"] += gap_ms
+            ev = _match_restart(job["launcher"], death, birth)
+            detect_ts = (ev or {}).get("detect_ts")
+            respawn_ts = (ev or {}).get("respawn_ts")
+            detection_s = (max(0.0, detect_ts - death)
+                           if detect_ts is not None else None)
+            respawn_s = (max(0.0, birth - detect_ts)
+                         if detect_ts is not None else None)
+            # recompile: compile time up to and including the first
+            # productive step of the new incarnation
+            recompile_ms = 0.0
+            replay_ms = 0.0
+            replay_steps = 0
+            steps = [w for w in nxt["rows"]
+                     if w.get("event") == "step" and "buckets" in w]
+            for w in steps:
+                recompile_ms += w["buckets"].get("compile", 0.0)
+                if w["buckets"].get("productive_step", 0) > 0:
+                    break
+            if (prev["last_step"] is not None
+                    and prev["last_ckpt_step"] is not None):
+                replay_steps = max(
+                    0, prev["last_step"] - prev["last_ckpt_step"])
+                for w in steps[:replay_steps]:
+                    replay_ms += w["buckets"].get("productive_step", 0.0)
+            restore_ms = sum(
+                w["buckets"].get("restart_recovery", 0.0)
+                for w in nxt["rows"] if "buckets" in w)
+            incidents.append({
+                "kind": "restart",
+                "tag": tag,
+                "from_incarnation": a,
+                "to_incarnation": b_,
+                "death_ts": round(death, 6),
+                "birth_ts": round(birth, 6),
+                "gap_s": round(gap_ms / 1e3, 3),
+                "detection_s": (round(detection_s, 3)
+                                if detection_s is not None else None),
+                "respawn_s": (round(respawn_s, 3)
+                              if respawn_s is not None else None),
+                "recompile_s": round(recompile_ms / 1e3, 3),
+                "restore_s": round(restore_ms / 1e3, 3),
+                "replay_steps": replay_steps,
+                "replay_s": round(replay_ms / 1e3, 3),
+                "reason": (ev or {}).get("reason"),
+                "culprit": (ev or {}).get("tag"),
+            })
+        wall_ms = (max(0.0, (wall_t1 - wall_t0) * 1e3)
+                   if wall_t0 is not None and wall_t1 is not None else 0.0)
+        classified = sum(totals.values())
+        total_ms = classified
+        per_rank[tag] = {
+            "incarnations": len(order),
+            "wall_s": round(wall_ms / 1e3, 3),
+            "classified_s": round(classified / 1e3, 3),
+            "unclassified_s": round(
+                max(0.0, wall_ms - classified) / 1e3, 3),
+            "unclassified_frac": round(
+                max(0.0, wall_ms - classified) / wall_ms, 4)
+            if wall_ms > 0 else 0.0,
+            "goodput_ratio": round(
+                totals["productive_step"] / total_ms, 6)
+            if total_ms else None,
+            "buckets_s": {b: round(v / 1e3, 3)
+                          for b, v in totals.items()},
+            "n_steps": sum(incs[i]["n_steps"] for i in order),
+        }
+    # launcher stall episodes (straggler detector) are incidents too
+    for ev in job["launcher"]:
+        if ev.get("event") == "stall":
+            incidents.append(dict(ev, kind="stall"))
+    job_buckets = {b: 0.0 for b in BUCKETS}
+    for row in per_rank.values():
+        for b, v in row["buckets_s"].items():
+            job_buckets[b] += v
+    total_s = sum(job_buckets.values())
+    prod_s = job_buckets["productive_step"]
+    incidents.sort(
+        key=lambda i: (i["gap_s"] if i.get("gap_s") is not None
+                       else (i.get("excess_ms") or 0.0) / 1e3),
+        reverse=True)  # costliest first, one unit (seconds)
+    return {
+        "ranks": per_rank,
+        "incidents": incidents,
+        "job": {
+            "total_s": round(total_s, 3),
+            "goodput_ratio": round(prod_s / total_s, 6)
+            if total_s else None,
+            "badput_s": {b: round(v, 3)
+                         for b, v in sorted(job_buckets.items(),
+                                            key=lambda kv: -kv[1])
+                         if b != "productive_step" and v > 0},
+            "unclassified_frac": round(
+                sum(r["unclassified_s"] for r in per_rank.values())
+                / max(1e-9, sum(max(r["wall_s"], r["classified_s"])
+                                for r in per_rank.values())), 4)
+            if per_rank else 0.0,
+        },
+    }

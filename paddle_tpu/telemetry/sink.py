@@ -8,6 +8,10 @@ tools and tests parse it):
   kind="step"     step-time breakdown from fluid/monitor.py:
                   {"step": int monotone per process, "data_wait_ms",
                    "compile_ms", "device_ms", "fetch_ms", "ckpt_save_ms",
+                   "idle_ms": float gap between consecutive
+                   Executor.run calls (the goodput ledger's idle
+                   signal; iterator wait in that gap also lands in
+                   data_wait_ms — classification is by residual),
                    "cache_hit": bool, "retraces": int cumulative,
                    "peak_hbm_bytes": int}; under PADDLE_TRACING the
                   record additionally carries "trace_id" — the step's
@@ -38,6 +42,15 @@ tools and tests parse it):
                   event="divergence" a cross-replica SDC verdict
                     reached this rank: {"step", "odd_rank_out",
                     "method", "detected_step"}
+  kind="goodput"  goodput/badput ledger summary (telemetry/goodput.py,
+                  every PADDLE_GOODPUT_EVERY classification points when
+                  PADDLE_GOODPUT=1): {"event": "summary", "tag",
+                  "incarnation": int (PADDLE_ELASTIC_RESTART), "t0",
+                  "t1", "steps": int, "goodput_ratio": float|null,
+                  "buckets_ms": {bucket: cumulative ms for the eight
+                  goodput.BUCKETS}}; the authoritative per-interval
+                  rows live in goodput.<tag>.<incarnation>.jsonl under
+                  PADDLE_GOODPUT_DIR (default PADDLE_TRACE_DIR)
   kind="mem_report"  one static memory attribution (telemetry/memory.py,
                   emitted per compile-cache miss under FLAGS_mem_profile
                   and by explicit memtop/bench joins):

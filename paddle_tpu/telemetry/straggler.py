@@ -92,6 +92,9 @@ class StragglerDetector:
                     "step_time_ms": round(step_time * 1e3, 3),
                     "median_step_time_ms": round(med * 1e3, 3),
                     "slowdown": round(step_time / med, 2),
+                    # the per-step badput this episode costs vs peers —
+                    # what the goodput ledger charges as `stall`
+                    "excess_ms": round((step_time - med) * 1e3, 3),
                     "factor": self.factor,
                 })
         else:

@@ -1,0 +1,120 @@
+"""Worker for tests/test_goodput.py kill-one-of-two drill: a 2-rank
+launcher job under --fleetz_port where one tag dies once mid-run; the
+survivors' goodput ledgers + the launcher lifecycle ledger must let
+goodtop classify EVERY wall-clock second, with the restart window
+attributed `restart_recovery` and decomposed detection/respawn/
+recompile/replay.
+
+Each rank trains an independent tiny least-squares program (no
+collectives — a dead peer must not wedge the survivor; the launcher's
+group restart is the coupling) with real Executor steps, a real
+CheckpointManager (restore on respawn => replay accounting), and lease
+renewals carrying the fleet payloads (launch --fleetz_port arms
+PADDLE_GOODPUT / PADDLE_FLEET_METRICS + the heartbeat/lease channel).
+
+Env knobs:
+  GOODPUT_TEST_DIR       checkpoint root (per-tag subdirs)
+  GOODPUT_TEST_DIE_TAG   stable tag that dies once (incarnation 0 only)
+  GOODPUT_TEST_DIE_AT    ...right after this many local steps
+  GOODPUT_TEST_STEPS     total steps (default 10)
+  GOODPUT_TEST_CKPT_FREQ checkpoint every N steps (default 2)
+  GOODPUT_TEST_FLEETZ    launcher fleetz port: rank 0 scrapes
+                         /fleetz + /fleetz/metrics near the end of the
+                         run and saves them beside the checkpoints
+  GOODPUT_TEST_STEP_SLEEP per-step compute pad seconds (default 0.04)
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.distributed.heartbeat import start_heartbeat
+from paddle_tpu.fluid import checkpoint as ckpt_mod
+from paddle_tpu.fluid import layers
+
+
+def main() -> int:
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    tag = os.environ.get("PADDLE_TRAINER_TAG", f"trainer{rank}")
+    gen = int(os.environ.get("PADDLE_ELASTIC_RESTART", 0))
+    root = os.environ["GOODPUT_TEST_DIR"]
+    die_tag = os.environ.get("GOODPUT_TEST_DIE_TAG", "")
+    die_at = int(os.environ.get("GOODPUT_TEST_DIE_AT", 0))
+    steps = int(os.environ.get("GOODPUT_TEST_STEPS", 10))
+    freq = int(os.environ.get("GOODPUT_TEST_CKPT_FREQ", 2))
+    fleetz = os.environ.get("GOODPUT_TEST_FLEETZ")
+    pad = float(os.environ.get("GOODPUT_TEST_STEP_SLEEP", 0.04))
+
+    hb = start_heartbeat(interval=0.25)  # renewals carry fleet payloads
+
+    batch = 8
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = layers.data("x", [batch, 4], append_batch_size=False)
+        y = layers.data("y", [batch, 1], append_batch_size=False)
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    rng = np.random.RandomState(7 + rank)
+    xa = rng.rand(batch, 4).astype(np.float32)
+    ya = xa.sum(1, keepdims=True).astype(np.float32)
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        mgr = ckpt_mod.CheckpointManager(
+            os.path.join(root, tag), program=main_prog, scope=scope)
+        start = 0
+        if gen > 0:
+            out = mgr.restore(program=main_prog, scope=scope)
+            if out is not None:
+                start = int((out.get("extra") or {}).get("next_step", 0))
+        for step in range(start, steps):
+            exe.run(main_prog, feed={"x": xa, "y": ya},
+                    fetch_list=[loss])
+            time.sleep(pad)  # visible productive/idle structure
+            if (step + 1) % freq == 0:
+                mgr.save(step, extra_state={"next_step": step + 1})
+            if tag == die_tag and gen == 0 and step + 1 == die_at:
+                os._exit(17)  # hard death mid-job (no atexit, no drain)
+        if rank == 0 and fleetz:
+            _scrape_fleet(fleetz, root)
+        # one more renewal window so the coordinator holds the final
+        # ledger summary before the launcher tears everything down
+        time.sleep(0.6)
+    if hb is not None:
+        hb.stop()
+    return 0
+
+
+def _scrape_fleet(port: str, root: str) -> None:
+    """GET the launcher's /fleetz + /fleetz/metrics while the fleet is
+    alive; the test asserts on the saved copies after the job."""
+    for attempt in range(10):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/fleetz", timeout=2) as r:
+                fleet = json.loads(r.read().decode())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/fleetz/metrics",
+                    timeout=2) as r:
+                text = r.read().decode()
+            if len(fleet.get("ranks") or {}) >= 2:
+                with open(os.path.join(root, "fleetz.json"), "w") as f:
+                    json.dump(fleet, f)
+                with open(os.path.join(root, "fleetz_metrics.txt"),
+                          "w") as f:
+                    f.write(text)
+                return
+        except Exception:  # noqa: BLE001 — retry until renewals landed
+            pass
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

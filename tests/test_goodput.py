@@ -1,0 +1,655 @@
+"""Fleet-wide goodput accounting (ISSUE 15): interval classification,
+per-incarnation ledger persistence + restart stitching, renewal-payload
+aggregation over real TCP conns, the /fleetz scrape, data-pipeline
+per-stage timing + queue-depth gauge, straggler input-skew attribution,
+the goodtop CLI, flag-off bit-identity — and (slow) the kill-one-of-two
+launcher drill asserting the restart's badput is attributed
+`restart_recovery` and decomposed detection/respawn/recompile/replay."""
+import io
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+from paddle_tpu import fluid, telemetry  # noqa: E402
+from paddle_tpu.distributed import coordinator as coord_mod  # noqa: E402
+from paddle_tpu.fluid import layers, monitor  # noqa: E402
+from paddle_tpu.fluid.reader import DataLoader, GeneratorLoader  # noqa: E402
+from paddle_tpu.telemetry import goodput, sink as sink_mod  # noqa: E402
+
+WORKER = os.path.join(os.path.dirname(__file__), "dist_goodput_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    goodput.reset_for_tests()
+    yield
+    goodput.reset_for_tests()
+    telemetry.get_registry().reset()
+
+
+def _mk_ledger(tmp_path, tag="t0", inc=0, now=100.0):
+    return goodput.GoodputLedger(tag=tag, incarnation=inc,
+                                 directory=str(tmp_path), now=now)
+
+
+# ---------------------------------------------------------------------------
+# interval classification units
+# ---------------------------------------------------------------------------
+
+
+def test_classification_is_wall_exact(tmp_path):
+    """Bucket totals must sum to wall-clock EXACTLY: residual becomes
+    idle; over-measured phases are scaled down, never over-counted."""
+    led = _mk_ledger(tmp_path)
+    led.on_step_commit({"step": 0, "data_wait_ms": 100, "compile_ms": 500,
+                        "device_ms": 200, "fetch_ms": 50,
+                        "ckpt_save_ms": 0}, now=101.0)
+    led.on_step_commit({"step": 1, "data_wait_ms": 10, "compile_ms": 0,
+                        "device_ms": 200, "fetch_ms": 40,
+                        "ckpt_save_ms": 100}, now=101.5)
+    s = led.summary()
+    assert abs(sum(s["buckets_ms"].values()) - 1500.0) < 1e-6
+    assert s["buckets_ms"]["compile"] == 500.0
+    assert s["buckets_ms"]["checkpoint_save"] == 100.0
+    assert s["buckets_ms"]["productive_step"] == 490.0
+    assert s["buckets_ms"]["idle"] == 300.0  # residual, not payload
+    assert s["steps"] == 2
+
+
+def test_overmeasured_window_scales_never_exceeds_wall(tmp_path):
+    led = _mk_ledger(tmp_path)
+    # 2000ms of claimed phases inside a 1000ms wall window
+    led.on_step_commit({"step": 0, "data_wait_ms": 1000,
+                        "compile_ms": 0, "device_ms": 1000,
+                        "fetch_ms": 0, "ckpt_save_ms": 0}, now=101.0)
+    s = led.summary()
+    assert abs(sum(s["buckets_ms"].values()) - 1000.0) < 1e-6
+    assert s["buckets_ms"]["data_wait"] == 500.0
+    assert s["buckets_ms"]["productive_step"] == 500.0
+
+
+def test_abandon_restore_and_stall_buckets(tmp_path):
+    led = _mk_ledger(tmp_path)
+    led.on_abandoned_step(True, now=100.5)    # BadStepError window
+    led.on_abandoned_step(False, now=101.0)   # any other failure
+    led.on_restore(200.0, now=102.0)          # restore inside recovery
+    led.note_stall(300.0, cause="straggler", trace_id="aa",
+                   now=103.0)
+    b = led.summary()["buckets_ms"]
+    assert b["bad_step_replay"] == 500.0
+    assert b["stall"] == 800.0                # failed step + noted stall
+    assert b["restart_recovery"] == 200.0
+    assert abs(sum(b.values()) - 3000.0) < 1e-6
+    rows = [json.loads(ln) for ln in open(led.path)]
+    assert rows[0]["event"] == "birth"
+    stall = [r for r in rows if r.get("event") == "stall"]
+    assert stall and stall[0]["trace_id"] == "aa"
+
+
+def test_gauges_goodput_ratio_and_badput_by_cause(tmp_path):
+    led = _mk_ledger(tmp_path)
+    led.on_step_commit({"step": 0, "data_wait_ms": 250, "compile_ms": 0,
+                        "device_ms": 700, "fetch_ms": 50,
+                        "ckpt_save_ms": 0}, now=101.0)
+    reg = telemetry.get_registry()
+    assert reg.gauge("goodput_ratio").value == pytest.approx(0.75)
+    assert reg.gauge("badput_seconds_total",
+                     cause="data_wait").value == pytest.approx(0.25)
+
+
+def test_summary_sink_records_every_n(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_GOODPUT_EVERY", "2")
+    path = str(tmp_path / "m.jsonl")
+    sink_mod.enable(path)
+    try:
+        led = _mk_ledger(tmp_path)
+        for i in range(4):
+            led.on_step_commit({"step": i, "device_ms": 100},
+                               now=101.0 + i)
+    finally:
+        sink_mod.disable()
+    recs = [json.loads(ln) for ln in open(path)
+            if json.loads(ln).get("kind") == "goodput"]
+    assert len(recs) == 2
+    assert recs[-1]["event"] == "summary"
+    assert recs[-1]["buckets_ms"]["productive_step"] == pytest.approx(
+        400.0)
+    assert "goodput_ratio" in recs[-1]
+
+
+# ---------------------------------------------------------------------------
+# persistence + restart stitching across incarnations
+# ---------------------------------------------------------------------------
+
+
+def _two_incarnation_job(tmp_path, tag="trainer1"):
+    """Synthetic job: incarnation 0 trains to step 4 (ckpt at 2), dies
+    at t=112; launcher detects at 112.4, respawns at 112.9; incarnation
+    1 is born at 114 (imports), restores, recompiles, replays 2 steps
+    and finishes."""
+    led0 = goodput.GoodputLedger(tag=tag, incarnation=0,
+                                 directory=str(tmp_path), now=100.0)
+    t = 100.0
+    for i in range(5):
+        t += 4.0 if i == 0 else 2.0  # the compile step needs the room
+        led0.on_step_commit(
+            {"step": i, "device_ms": 1500, "data_wait_ms": 300,
+             "compile_ms": 2000 if i == 0 else 0,
+             "ckpt_save_ms": 200 if i == 2 else 0, "fetch_ms": 0},
+            now=t)  # dies here (t=112)
+    lau = goodput.LauncherLedger(str(tmp_path))
+    lau.event(event="job_start", world=2, ts=99.0)
+    lau.event(event="restart", tag=tag, rank=1,
+              reason="nonzero exit (code 17)", detect_ts=112.4,
+              respawn_ts=112.9, attempt=1, world=2, ts=112.9)
+    led1 = goodput.GoodputLedger(tag=tag, incarnation=1,
+                                 directory=str(tmp_path), now=114.0)
+    led1.on_restore(500.0, now=114.6)
+    t = 114.6
+    for i in range(4):  # steps 3..4 replayed (ckpt at 2, died at 4)
+        t += 4.0 if i == 0 else 2.0
+        led1.on_step_commit(
+            {"step": i, "device_ms": 1500, "data_wait_ms": 300,
+             "compile_ms": 2200 if i == 0 else 0, "fetch_ms": 0},
+            now=t)
+    led0.close()
+    led1.close()
+    return tmp_path
+
+
+def test_restart_stitch_totals_and_decomposition(tmp_path):
+    _two_incarnation_job(tmp_path)
+    view = goodput.stitch_job(str(tmp_path))
+    row = view["ranks"]["trainer1"]
+    assert row["incarnations"] == 2
+    # the ledger total is the SUM across incarnations PLUS the stitched
+    # gap (110 -> 112) classified restart_recovery
+    assert row["buckets_s"]["restart_recovery"] == pytest.approx(
+        2.0 + 0.5, abs=0.01)
+    # every second of [100, 120.6] classified: residual ~0
+    assert row["unclassified_frac"] < 0.001
+    (inc,) = [i for i in view["incidents"] if i.get("kind") == "restart"]
+    assert inc["tag"] == "trainer1"
+    # recovery interval spans the kill window, decomposed
+    assert inc["gap_s"] == pytest.approx(2.0, abs=0.01)
+    assert inc["detection_s"] == pytest.approx(0.4, abs=0.01)
+    assert inc["respawn_s"] == pytest.approx(1.6, abs=0.01)
+    assert inc["recompile_s"] == pytest.approx(2.2, abs=0.01)
+    assert inc["restore_s"] == pytest.approx(0.5, abs=0.01)
+    assert inc["replay_steps"] == 2
+    assert inc["replay_s"] > 0
+    assert inc["reason"] == "nonzero exit (code 17)"
+    assert view["job"]["goodput_ratio"] is not None
+    assert view["job"]["badput_s"]["restart_recovery"] > 0
+
+
+def test_stitch_survives_torn_tail_line(tmp_path):
+    _two_incarnation_job(tmp_path)
+    # a killed writer leaves a torn final line — the loader skips it
+    with open(tmp_path / "goodput.trainer1.0.jsonl", "a") as f:
+        f.write('{"event": "step", "t0": 110.0, "t1"')
+    view = goodput.stitch_job(str(tmp_path))
+    assert view["ranks"]["trainer1"]["incarnations"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet payload + coordinator aggregation over real TCP conns
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_payload_gated_and_bounded(monkeypatch):
+    assert goodput.fleet_payload() is None  # env off: renewals unchanged
+    monkeypatch.setenv("PADDLE_FLEET_METRICS", "1")
+    monkeypatch.setenv("PADDLE_GOODPUT", "1")
+    goodput.reset_for_tests()
+    reg = telemetry.get_registry()
+    for i in range(30):
+        reg.counter("test_fleet_counter", idx=str(i)).inc()
+    monkeypatch.setenv("PADDLE_FLEET_METRICS_MAX", "10")
+    p = goodput.fleet_payload()
+    assert p is not None and "metrics" in p
+    n = sum(len(e["series"]) for e in p["metrics"]["metrics"].values())
+    assert n == 10
+    assert p["metrics"]["truncated"] >= 20
+    assert "goodput" in p  # PADDLE_GOODPUT armed -> ledger summary rides
+
+
+def test_renewal_payload_aggregation_over_tcp(tmp_path, monkeypatch):
+    """Two clients renew with goodput payloads; fleet_status/
+    fleet_metrics over the REAL ps_server transport must serve the
+    merged rollup with per-rank labels."""
+    coord = coord_mod.Coordinator(lease_secs=5.0)
+    srv, ep = coord_mod.serve_coordinator(coord)
+    try:
+        payloads = {
+            "trainer0": {
+                "step": 10, "avg_step_s": 0.1, "data_frac": 0.1,
+                "goodput": {"incarnation": 0, "goodput_ratio": 0.8,
+                            "buckets_ms": {"productive_step": 800.0,
+                                           "data_wait": 200.0}},
+                "metrics": {"metrics": {"executor_steps_total": {
+                    "type": "counter",
+                    "series": [{"labels": {}, "value": 10}]}}},
+            },
+            "trainer1": {
+                "step": 9, "avg_step_s": 0.2, "data_frac": 0.7,
+                "goodput": {"incarnation": 1, "goodput_ratio": 0.5,
+                            "buckets_ms": {"productive_step": 500.0,
+                                           "data_wait": 500.0}},
+            },
+        }
+        for tag, p in payloads.items():
+            c = coord_mod.CoordinatorClient(ep, tag=tag)
+            c.register()
+            c.renew(payload=p)
+            c.close()
+        coord.note_incident({"event": "stall", "rank": 1,
+                             "tag": "trainer1", "excess_ms": 400.0,
+                             "cause": "data_wait", "trace_id": "tt"})
+        client = coord_mod.CoordinatorClient(ep, tag="probe")
+        try:
+            fleet = client.fleet_status()
+            text = client.fleet_metrics()
+        finally:
+            client.close()
+    finally:
+        coord_mod.stop_coordinator(srv)
+    assert set(fleet["ranks"]) >= {"trainer0", "trainer1"}
+    assert fleet["ranks"]["trainer1"]["goodput_ratio"] == 0.5
+    assert fleet["job"]["goodput_ratio"] == pytest.approx(1300 / 2000)
+    assert fleet["job"]["badput_ms"]["data_wait"] == pytest.approx(700.0)
+    assert any(i.get("event") == "stall" and i.get("trace_id") == "tt"
+               for i in fleet["incidents"])
+    # per-rank labels preserved in the one-endpoint exposition
+    assert 'executor_steps_total{rank="trainer0"} 10' in text
+    assert 'fleet_goodput_ratio{rank="trainer1"} 0.5' in text
+    assert "job_goodput_ratio" in text
+    assert 'job_badput_seconds_total{cause="data_wait"} 0.7' in text
+
+
+def test_fleetz_scrape_through_debugz(tmp_path, monkeypatch):
+    from paddle_tpu.telemetry import debugz
+
+    coord = coord_mod.Coordinator(lease_secs=5.0)
+    srv, ep = coord_mod.serve_coordinator(coord)
+    monkeypatch.setenv("PADDLE_COORDINATOR_ENDPOINT", ep)
+    c = coord_mod.CoordinatorClient(ep, tag="trainer0")
+    c.register()
+    c.renew(payload={"step": 3, "goodput": {
+        "goodput_ratio": 0.9,
+        "buckets_ms": {"productive_step": 900.0, "idle": 100.0}}})
+    c.close()
+    debugz.stop()
+    web = debugz.serve(port=0, host="127.0.0.1")
+    port = web.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleetz", timeout=5) as r:
+            fleet = json.loads(r.read().decode())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleetz/metrics",
+                timeout=5) as r:
+            text = r.read().decode()
+    finally:
+        debugz.stop()
+        coord_mod.stop_coordinator(srv)
+    assert fleet["ranks"]["trainer0"]["goodput_ratio"] == 0.9
+    assert fleet["job"]["goodput_ratio"] == pytest.approx(0.9)
+    assert 'fleet_goodput_ratio{rank="trainer0"} 0.9' in text
+
+
+def test_fleetz_404_without_coordinator(monkeypatch):
+    from paddle_tpu.telemetry import debugz
+
+    monkeypatch.delenv("PADDLE_COORDINATOR_ENDPOINT", raising=False)
+    debugz.stop()
+    web = debugz.serve(port=0, host="127.0.0.1")
+    port = web.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleetz", timeout=5)
+        assert ei.value.code == 404
+    finally:
+        debugz.stop()
+
+
+def test_fleet_push_one_aggregated_post(monkeypatch):
+    """export.start_fleet POSTs ONE aggregated snapshot per flush; an
+    empty fleet skips the POST entirely."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    import threading
+
+    from paddle_tpu.telemetry import export
+
+    hits = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            hits.append(json.loads(self.rfile.read(n).decode()))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/collect"
+    coord = coord_mod.Coordinator(lease_secs=5.0)
+    try:
+        exp = export.start_fleet(url, coord.fleet_status,
+                                 coord.fleet_metrics, interval_s=3600)
+        assert exp.flush() is True and hits == []  # no ranks: no POST
+        coord.register("trainer0", payload={"goodput": {
+            "goodput_ratio": 1.0,
+            "buckets_ms": {"productive_step": 100.0}}})
+        assert exp.flush() is True
+        assert len(hits) == 1
+        assert hits[0]["resource"]["role"] == "launcher"
+        assert "trainer0" in hits[0]["fleet"]["ranks"]
+        assert "exposition" in hits[0]
+    finally:
+        export.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# data-pipeline per-stage instrumentation
+# ---------------------------------------------------------------------------
+
+
+def test_dataloader_stage_timing_and_queue_depth(tmp_path):
+    sink_mod.enable(str(tmp_path / "m.jsonl"))
+    try:
+        data = [(np.full((4,), i, np.float32),
+                 np.full((1,), i, np.float32)) for i in range(16)]
+        loader = DataLoader(data, feed_list=["x", "y"], batch_size=4)
+        batches = list(loader)
+    finally:
+        sink_mod.disable()
+    assert len(batches) == 4
+    reg = telemetry.get_registry()
+    assert reg.histogram("data_fetch_ms").count >= 4
+    assert reg.histogram("data_decode_ms").count >= 4
+    assert reg.histogram("data_h2d_ms").count >= 4
+    # the buffered path sampled its prefetch queue depth
+    snap = reg.snapshot()
+    assert any(row["labels"].get("loader") == "dataloader"
+               for row in snap["data_queue_depth"]["series"])
+
+
+def test_generator_loader_stage_timing(tmp_path):
+    sink_mod.enable(str(tmp_path / "m.jsonl"))
+    try:
+        def sample_gen():
+            for i in range(8):
+                yield (np.full((4,), i, np.float32),)
+
+        loader = GeneratorLoader(feed_list=["x"], capacity=4)
+        loader.set_sample_generator(sample_gen, batch_size=4)
+        batches = list(loader)
+    finally:
+        sink_mod.disable()
+    assert len(batches) == 2
+    reg = telemetry.get_registry()
+    assert reg.histogram("data_fetch_ms").count >= 2   # producer pulls
+    assert reg.histogram("data_batch_ms").count >= 2   # sample stacking
+    assert reg.histogram("data_h2d_ms").count >= 2
+    assert reg.gauge("data_queue_depth", loader="generator").value >= 0
+
+
+def test_pipeline_off_means_no_series(tmp_path):
+    """No sink, no goodput: iterating allocates NO data_* series."""
+    telemetry.get_registry().reset()
+    data = [(np.zeros((4,), np.float32),) for _ in range(8)]
+    list(DataLoader(data, feed_list=["x"], batch_size=4))
+    snap = telemetry.get_registry().snapshot()
+    assert not any(n.startswith("data_") for n in snap)
+
+
+def test_straggler_event_names_data_starved_rank(tmp_path):
+    from paddle_tpu.distributed.heartbeat import StragglerMonitor
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+
+    def stamp(rank, step, t, frac):
+        with open(hb / f"heartbeat.{rank}", "w") as f:
+            json.dump({"t": t, "step": step, "data_frac": frac,
+                       "trace_id": f"tr{rank}"}, f)
+
+    mon = StragglerMonitor(str(hb), [0, 1], factor=2.0, min_steps=1)
+    events = []
+    for step in range(10):
+        stamp(0, step, 100.0 + step * 0.1, 0.05)
+        stamp(1, step // 2, 100.0 + (step // 2) * 0.9, 0.9)
+        events = mon.poll()
+        if events:
+            break
+    assert events, "straggler never flagged"
+    ev = events[0]
+    assert ev["rank"] == 1
+    assert ev["cause"] == "data_wait"      # starved, not compute-slow
+    assert ev["data_frac"] == 0.9
+    assert ev["trace_id"] == "tr1"
+    assert ev["excess_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# executor integration + flag-off bit-identity
+# ---------------------------------------------------------------------------
+
+
+def _tiny_train(steps=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], append_batch_size=False)
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        xa = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        ya = xa.sum(1, keepdims=True).astype(np.float32)
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"x": xa, "y": ya},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    return losses
+
+
+def test_executor_ledger_rows_and_idle_ms(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_GOODPUT", "1")
+    monkeypatch.setenv("PADDLE_GOODPUT_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_GOODPUT_EVERY", "1")
+    goodput.reset_for_tests()
+    monitor.reset_for_tests()
+    path = str(tmp_path / "m.jsonl")
+    sink_mod.enable(path)
+    try:
+        _tiny_train(steps=3)
+    finally:
+        sink_mod.disable()
+    led = goodput.get_ledger()
+    assert led is not None and led.path
+    rows = [json.loads(ln) for ln in open(led.path)]
+    steps = [r for r in rows if r.get("event") == "step"]
+    assert len(steps) == 4  # startup + 3 train steps
+    for r in steps:
+        assert abs(sum(r["buckets"].values())
+                   - (r["t1"] - r["t0"]) * 1e3) < 0.5
+    s = led.summary()
+    assert s["buckets_ms"]["compile"] > 0
+    assert s["buckets_ms"]["productive_step"] > 0
+    # step records gained idle_ms (the satellite) and kind="goodput"
+    # summaries ride the same sink
+    recs = [json.loads(ln) for ln in open(path)]
+    step_recs = [r for r in recs if r["kind"] == "step"]
+    assert all("idle_ms" in r for r in step_recs)
+    assert any(r["idle_ms"] >= 0 for r in step_recs)
+    assert any(r["kind"] == "goodput" for r in recs)
+    # input-skew sample available while armed
+    assert monitor.data_wait_fraction() is not None
+
+
+def test_flag_off_bit_identity(tmp_path, monkeypatch):
+    """PADDLE_GOODPUT off: no ledger file, no kind="goodput" records,
+    no goodput gauges — and the loss trace is bit-identical to the
+    armed run (pure observation, matching the house rule)."""
+    monkeypatch.delenv("PADDLE_GOODPUT", raising=False)
+    monkeypatch.delenv("PADDLE_FLEET_METRICS", raising=False)
+    monkeypatch.setenv("PADDLE_GOODPUT_DIR", str(tmp_path / "off"))
+    goodput.reset_for_tests()
+    monitor.reset_for_tests()
+    telemetry.get_registry().reset()
+    path = str(tmp_path / "off.jsonl")
+    sink_mod.enable(path)
+    try:
+        losses_off = _tiny_train(steps=3)
+    finally:
+        sink_mod.disable()
+    assert goodput.get_ledger() is None
+    assert not (tmp_path / "off").exists()
+    recs = [json.loads(ln) for ln in open(path)]
+    assert not any(r["kind"] == "goodput" for r in recs)
+    assert "goodput_ratio" not in telemetry.get_registry().snapshot()
+    assert goodput.fleet_payload() is None  # renewal wire unchanged
+
+    monkeypatch.setenv("PADDLE_GOODPUT", "1")
+    monkeypatch.setenv("PADDLE_GOODPUT_DIR", str(tmp_path / "on"))
+    goodput.reset_for_tests()
+    monitor.reset_for_tests()
+    losses_on = _tiny_train(steps=3)
+    assert losses_on == losses_off
+
+
+# ---------------------------------------------------------------------------
+# goodtop CLI
+# ---------------------------------------------------------------------------
+
+
+def test_goodtop_cli_json_and_tables(tmp_path, capsys):
+    import goodtop
+
+    _two_incarnation_job(tmp_path)
+    rc = goodtop.main([str(tmp_path), "--json"])
+    assert rc == 0
+    view = json.loads(capsys.readouterr().out)
+    assert view["ranks"]["trainer1"]["incarnations"] == 2
+    assert view["job"]["goodput_ratio"] is not None
+
+    rc = goodtop.main([str(tmp_path), "--by-rank", "--incidents"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "goodput" in out and "trainer1" in out
+    assert "restart_recovery" in out
+    assert "detection" in out and "replay" in out
+
+
+def test_goodtop_cli_empty_dir(tmp_path, capsys):
+    import goodtop
+
+    assert goodtop.main([str(tmp_path)]) == 1
+    assert "no goodput" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# slow: the kill-one-of-two launcher drill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_kill_one_of_two_drill_attributes_restart_recovery(tmp_path):
+    """ISSUE 15 acceptance: a 2-rank --fleetz_port job loses trainer1
+    once; afterwards goodtop must classify every wall-clock second
+    (unclassified residual < 2%), decompose the restart incident, and
+    the mid-job /fleetz scrape must have served BOTH ranks from one
+    endpoint."""
+    import socket
+
+    ckpt = tmp_path / "ckpt"
+    gp = tmp_path / "goodput"
+    logs = tmp_path / "logs"
+    ckpt.mkdir()
+    gp.mkdir()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    fleetz_port = s.getsockname()[1]
+    s.close()
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--log_dir", str(logs),
+           "--elastic_retries", "2", "--lease_secs", "1",
+           "--fleetz_port", str(fleetz_port), WORKER]
+    env = dict(os.environ, PYTHONPATH=REPO,
+               JAX_PLATFORMS="cpu",
+               PADDLE_GOODPUT_DIR=str(gp),
+               GOODPUT_TEST_DIR=str(ckpt),
+               GOODPUT_TEST_DIE_TAG="trainer1",
+               GOODPUT_TEST_DIE_AT="5",
+               GOODPUT_TEST_STEPS="10",
+               GOODPUT_TEST_CKPT_FREQ="2",
+               GOODPUT_TEST_FLEETZ=str(fleetz_port))
+    for k in ("PADDLE_GOODPUT", "PADDLE_FLEET_METRICS",
+              "PADDLE_ELASTIC_RESHARD"):
+        env.pop(k, None)
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, (r.returncode, r.stderr[-4000:])
+
+    # per-incarnation ledgers for both tags + the launcher ledger
+    names = sorted(os.listdir(gp))
+    for want in ("goodput.trainer0.0.jsonl", "goodput.trainer0.1.jsonl",
+                 "goodput.trainer1.0.jsonl", "goodput.trainer1.1.jsonl",
+                 "goodput.launcher.jsonl"):
+        assert want in names, (want, names)
+
+    view = goodput.stitch_job(str(gp))
+    # every wall-clock second classified
+    assert view["job"]["unclassified_frac"] < 0.02, view["job"]
+    assert view["job"]["badput_s"].get("restart_recovery", 0) > 0
+    restarts = [i for i in view["incidents"]
+                if i.get("kind") == "restart" and i["tag"] == "trainer1"]
+    assert restarts, view["incidents"]
+    inc = restarts[0]
+    # the launcher detected the death within ~1 heartbeat period of the
+    # rank's last classified activity, and the incident is decomposed
+    assert inc["detection_s"] is not None and inc["detection_s"] <= 1.5
+    assert inc["respawn_s"] is not None and inc["respawn_s"] > 0
+    assert inc["recompile_s"] > 0
+    assert inc["culprit"] == "trainer1"
+    assert "exit" in (inc["reason"] or "")
+
+    # the mid-job fleet scrape served both ranks from ONE endpoint
+    fleet = json.loads((ckpt / "fleetz.json").read_text())
+    assert {"trainer0", "trainer1"} <= set(fleet["ranks"])
+    assert fleet["ranks"]["trainer0"]["goodput_ratio"] is not None
+    text = (ckpt / "fleetz_metrics.txt").read_text()
+    assert 'rank="trainer0"' in text and 'rank="trainer1"' in text
+    assert "job_goodput_ratio" in text
+
+    # goodtop CLI sanity on the recorded drill
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "goodtop.py"), str(gp),
+         "--json"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    out = json.loads(r2.stdout)
+    assert out["job"]["goodput_ratio"] is not None

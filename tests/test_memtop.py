@@ -368,7 +368,7 @@ def test_memrec_requires_directory(monkeypatch):
 # ---------------------------------------------------------------------------
 
 STEP_KEYS = {"kind", "step", "data_wait_ms", "compile_ms", "device_ms",
-             "fetch_ms", "ckpt_save_ms", "cache_hit", "fenced",
+             "fetch_ms", "ckpt_save_ms", "idle_ms", "cache_hit", "fenced",
              "retraces", "peak_hbm_bytes", "ts", "rank"}
 
 

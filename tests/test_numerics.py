@@ -169,7 +169,7 @@ def test_step_record_schema_unchanged_by_flag(tmp_path):
     steps = [r for r in recs if r["kind"] == "step"]
     assert steps
     need = {"kind", "step", "data_wait_ms", "compile_ms", "device_ms",
-            "fetch_ms", "ckpt_save_ms", "cache_hit", "fenced",
+            "fetch_ms", "ckpt_save_ms", "idle_ms", "cache_hit", "fenced",
             "retraces", "peak_hbm_bytes", "ts", "rank"}
     for r in steps:
         assert need == set(r), f"step schema drifted: {sorted(r)}"

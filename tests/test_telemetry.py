@@ -131,7 +131,7 @@ def _tiny_step(steps=3, batch=8):
 
 
 STEP_KEYS = {"kind", "step", "ts", "rank", "data_wait_ms", "compile_ms",
-             "device_ms", "fetch_ms", "ckpt_save_ms", "cache_hit",
+             "device_ms", "fetch_ms", "ckpt_save_ms", "idle_ms", "cache_hit",
              "fenced", "retraces", "peak_hbm_bytes"}
 
 

@@ -359,6 +359,80 @@ assert grads and all(w["samples"] == 3 for w in grads.values()), rep
 print(f"numtop smoke OK: {len(rep['watches'])} watched series")
 PY
 
+echo "== goodput lane (ledger + fleet view + kill-one-of-two drill) =="
+# ISSUE 15 acceptance drills, slow lane: a 2-rank --fleetz_port job
+# loses one trainer mid-run — goodtop must classify EVERY wall-clock
+# second (unclassified residual < 2%), decompose the restart incident
+# into detection/respawn/recompile/replay, and the mid-job /fleetz
+# scrape must serve both ranks from ONE endpoint; the fast
+# classification/stitch/TCP-aggregation/reader-stage units run in
+# tier-1 above (tests/test_goodput.py)
+python -m pytest tests/test_goodput.py -q -m slow
+# 3-step goodput-armed train: ledger rows wall-exact, goodput records
+# in the sink, step schema (incl. the new idle_ms) intact, and
+# goodtop --json renders the job view
+rm -rf /tmp/ci_goodput; mkdir -p /tmp/ci_goodput
+rm -f /tmp/ci_goodput.jsonl
+PADDLE_METRICS_PATH=/tmp/ci_goodput.jsonl PADDLE_GOODPUT=1 \
+  PADDLE_GOODPUT_DIR=/tmp/ci_goodput PADDLE_GOODPUT_EVERY=1 \
+  JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = layers.data("x", [16, 8], append_batch_size=False)
+    y = layers.data("y", [16, 1], append_batch_size=False)
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor()
+exe.run(startup)
+rng = np.random.RandomState(0)
+xa = rng.rand(16, 8).astype(np.float32)
+ya = xa.sum(1, keepdims=True).astype(np.float32)
+for _ in range(3):
+    exe.run(main, feed={"x": xa, "y": ya}, fetch_list=[loss])
+PY
+python - <<'PY'
+import glob
+import json
+
+recs = [json.loads(l) for l in open("/tmp/ci_goodput.jsonl")]
+steps = [r for r in recs if r["kind"] == "step"]
+assert len(steps) >= 4, f"expected startup+3 step records, got {len(steps)}"
+need = {"step", "data_wait_ms", "compile_ms", "device_ms", "cache_hit",
+        "idle_ms", "ckpt_save_ms", "peak_hbm_bytes", "retraces", "ts",
+        "rank"}
+for r in steps:
+    assert need <= set(r), f"step record missing {need - set(r)}"
+gsum = [r for r in recs if r["kind"] == "goodput"]
+assert gsum, "no kind=goodput summary records in the sink"
+assert gsum[-1]["buckets_ms"]["productive_step"] > 0
+(ledger,) = glob.glob("/tmp/ci_goodput/goodput.*.jsonl")
+rows = [json.loads(l) for l in open(ledger)]
+assert rows[0]["event"] == "birth"
+for r in rows:
+    if "buckets" in r:  # every wall second classified, wall-exact
+        assert abs(sum(r["buckets"].values())
+                   - (r["t1"] - r["t0"]) * 1e3) < 0.5, r
+print(f"goodput smoke OK: {len(steps)} step records (idle_ms present), "
+      f"{len(gsum)} ledger summaries, wall-exact intervals in {ledger}")
+PY
+JAX_PLATFORMS=cpu python tools/goodtop.py /tmp/ci_goodput --json \
+  > /tmp/ci_goodtop.json
+python - <<'PY'
+import json
+
+view = json.load(open("/tmp/ci_goodtop.json"))
+assert view["ranks"], "goodtop found no ledgers"
+assert view["job"]["goodput_ratio"] is not None
+assert view["job"]["unclassified_frac"] < 0.02, view["job"]
+print(f"goodtop smoke OK: job goodput "
+      f"{100 * view['job']['goodput_ratio']:.1f}%, residual "
+      f"{100 * view['job']['unclassified_frac']:.2f}%")
+PY
+
 echo "== autotune lane (CPU-interpret smoke search + cache reuse) =="
 # ISSUE 13 acceptance: a tiny-shape search over all three tunable
 # kernels (flash_bsh / add_ln / conv_bn incl. the s2d axis) must run

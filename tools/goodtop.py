@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""goodtop — job-lifetime goodput/badput summary (telemetry/goodput.py;
+the fleet-side sibling of proftop/memtop/numtop/tracetop).
+
+Reads the per-incarnation goodput ledgers a PADDLE_GOODPUT=1 job wrote
+(`goodput.<tag>.<incarnation>.jsonl` under PADDLE_GOODPUT_DIR /
+PADDLE_TRACE_DIR, plus the launcher's `goodput.launcher.jsonl`
+lifecycle events) and renders the question the per-rank planes cannot
+answer: what fraction of the JOB's wall-clock was productive training,
+and where did the rest go — across every rank, restart and eviction.
+
+  default       job summary: goodput %, per-bucket seconds + share,
+                unclassified residual (must stay < 2%% on a healthy
+                stitch)
+  --by-rank     one row per rank tag (incarnations, steps, goodput %,
+                worst badput bucket)
+  --incidents   per-restart cost breakdown — each death decomposed into
+                detection / respawn / recompile / replay seconds (the
+                launcher ledger supplies detect/respawn timestamps) —
+                plus straggler stall episodes with the culprit's step
+                trace_id (feed it to tools/tracetop.py)
+  --json        the full stitched view as one JSON object
+
+Examples:
+
+    python tools/goodtop.py /tmp/job_traces
+    python tools/goodtop.py /tmp/job_traces --by-rank --incidents
+    python tools/goodtop.py --json            # dir from PADDLE_GOODPUT_DIR
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.telemetry import goodput  # noqa: E402
+
+BAR_W = 30
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{v:.2f}s"
+
+
+def _pct(part: float, total: float) -> str:
+    return f"{100.0 * part / total:5.1f}%" if total > 0 else "    -"
+
+
+def render_summary(view: dict, out) -> None:
+    job = view["job"]
+    total = job["total_s"]
+    ratio = job.get("goodput_ratio")
+    print("== goodtop: job-lifetime goodput ==", file=out)
+    print(f"ranks: {len(view['ranks'])}   classified wall: "
+          f"{total:.2f}s   goodput: "
+          f"{'-' if ratio is None else f'{100 * ratio:.1f}%'}   "
+          f"unclassified residual: "
+          f"{100 * job.get('unclassified_frac', 0):.2f}%", file=out)
+    buckets = {}
+    for row in view["ranks"].values():
+        for b, v in row["buckets_s"].items():
+            buckets[b] = buckets.get(b, 0.0) + v
+    print(f"{'bucket':<18} {'seconds':>10} {'share':>7}", file=out)
+    for b in goodput.BUCKETS:
+        v = buckets.get(b, 0.0)
+        if v <= 0 and b != "productive_step":
+            continue
+        bar = "#" * int(BAR_W * v / total) if total > 0 else ""
+        print(f"{b:<18} {v:>10.2f} {_pct(v, total):>7}  {bar}", file=out)
+
+
+def render_by_rank(view: dict, out) -> None:
+    print("\n== per-rank ==", file=out)
+    print(f"{'tag':<12} {'incs':>4} {'steps':>6} {'wall':>9} "
+          f"{'goodput':>8} {'worst badput':<24}", file=out)
+    for tag, row in sorted(view["ranks"].items()):
+        worst = sorted(
+            ((b, v) for b, v in row["buckets_s"].items()
+             if b != "productive_step" and v > 0),
+            key=lambda kv: -kv[1])
+        worst_s = (f"{worst[0][0]} ({worst[0][1]:.2f}s)"
+                   if worst else "-")
+        ratio = row.get("goodput_ratio")
+        print(f"{tag:<12} {row['incarnations']:>4} {row['n_steps']:>6} "
+              f"{row['wall_s']:>8.2f}s "
+              f"{'-' if ratio is None else f'{100 * ratio:6.1f}%':>8} "
+              f"{worst_s:<24}", file=out)
+
+
+def render_incidents(view: dict, out) -> None:
+    print("\n== incidents (costliest first) ==", file=out)
+    if not view["incidents"]:
+        print("(none)", file=out)
+        return
+    for inc in view["incidents"]:
+        if inc.get("kind") == "restart":
+            print(f"restart  {inc['tag']} inc{inc['from_incarnation']}->"
+                  f"inc{inc['to_incarnation']}  gap {inc['gap_s']:.2f}s"
+                  f"  reason: {inc.get('reason') or '?'}"
+                  + (f"  culprit: {inc['culprit']}"
+                     if inc.get("culprit") else ""), file=out)
+            print(f"         detection {_fmt_s(inc.get('detection_s'))}"
+                  f" -> respawn {_fmt_s(inc.get('respawn_s'))}"
+                  f" -> recompile {_fmt_s(inc.get('recompile_s'))}"
+                  f" (+restore {_fmt_s(inc.get('restore_s'))})"
+                  f" -> replay {_fmt_s(inc.get('replay_s'))}"
+                  f" ({inc.get('replay_steps', 0)} steps)", file=out)
+        elif inc.get("kind") == "stall":
+            print(f"stall    rank {inc.get('rank')}"
+                  f" ({inc.get('tag') or '?'})  step {inc.get('step')}"
+                  f"  +{(inc.get('excess_ms') or 0) / 1e3:.2f}s vs median"
+                  f"  cause: {inc.get('cause', '?')}"
+                  + (f"  trace: {inc['trace_id']}"
+                     if inc.get("trace_id") else ""), file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="goodtop", description="job-lifetime goodput summary")
+    p.add_argument("dir", nargs="?", default=None,
+                   help="ledger directory (default: PADDLE_GOODPUT_DIR "
+                        "or PADDLE_TRACE_DIR or .)")
+    p.add_argument("--by-rank", action="store_true")
+    p.add_argument("--incidents", action="store_true")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    directory = (args.dir or os.environ.get("PADDLE_GOODPUT_DIR")
+                 or os.environ.get("PADDLE_TRACE_DIR") or ".")
+    if not os.path.isdir(directory):
+        print(f"goodtop: no such directory: {directory}", file=sys.stderr)
+        return 2
+    view = goodput.stitch_job(directory)
+    if not view["ranks"]:
+        print(f"goodtop: no goodput.<tag>.<inc>.jsonl ledgers in "
+              f"{directory} (arm the job with PADDLE_GOODPUT=1 or "
+              f"launch.py --fleetz_port)", file=sys.stderr)
+        return 1
+    if args.as_json:
+        json.dump(view, sys.stdout, indent=1, default=str)
+        print()
+        return 0
+    render_summary(view, sys.stdout)
+    if args.by_rank:
+        render_by_rank(view, sys.stdout)
+    if args.incidents:
+        render_incidents(view, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
