@@ -255,6 +255,17 @@ def _parse_args(argv):
         "the model dir pass through to the server (--max_batch, "
         "--queue_depth, ...)",
     )
+    p.add_argument(
+        "--serve_kv_cache", choices=["0", "1"], default=None,
+        help="serving replicas: force the paged-KV generation path on "
+        "(1) or off (0, the r19 padded recompute baseline) — exported "
+        "as PADDLE_SERVE_KV_CACHE to every replica",
+    )
+    p.add_argument(
+        "--serve_kv_pages", type=int, default=None,
+        help="serving replicas: KV pool size in pages per replica "
+        "(PADDLE_SERVE_KV_PAGES; default sizes from the HBM budget)",
+    )
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -1063,6 +1074,13 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
         serve_module = "paddle_tpu.inference.server"
         serve_args = (["--model_dir", args.training_script]
                       + list(args.training_script_args))
+        # KV-pool knobs ride the env protocol into every replica (the
+        # same PADDLE_SERVE_* envs an operator would set by hand)
+        if getattr(args, "serve_kv_cache", None) is not None:
+            os.environ["PADDLE_SERVE_KV_CACHE"] = args.serve_kv_cache
+        if getattr(args, "serve_kv_pages", None) is not None:
+            os.environ["PADDLE_SERVE_KV_PAGES"] = str(
+                args.serve_kv_pages)
         print(f"[launch] serving replicas: "
               f"{','.join(t.endpoint for t in cluster)}",
               file=sys.stderr)

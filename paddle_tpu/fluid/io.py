@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -239,13 +239,21 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 # ---------------------------------------------------------------------------
 
 
-def _prune_for_inference(program, feed_names: List[str], fetch_vars) -> "framework.Program":
+def _prune_for_inference(program, feed_names: List[str], fetch_vars,
+                         state_vars: Sequence[str] = ()) -> "framework.Program":
     """Backward slice from fetch vars, like the reference's prune
-    (io.py:1164 save_inference_model -> Program._prune_with_input)."""
+    (io.py:1164 save_inference_model -> Program._prune_with_input).
+
+    state_vars: extra slice roots for state-carrying vars (decode-step
+    KV caches: read at an earlier op, written back at a later one).
+    Nothing downstream of the fetches needs the write-back op, so a
+    pure fetch-rooted slice would drop it and the frozen program would
+    stop carrying state across steps — seeding `needed` keeps the
+    writer chain live."""
     pruned = program.clone(for_test=True)
     block = pruned.global_block()
     fetch_names = {v.name if isinstance(v, framework.Variable) else str(v) for v in fetch_vars}
-    needed = set(fetch_names)
+    needed = set(fetch_names) | {str(n) for n in state_vars}
     keep: List[int] = []
     for i in range(len(block.ops) - 1, -1, -1):
         op = block.ops[i]

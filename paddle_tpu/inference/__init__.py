@@ -232,4 +232,16 @@ def __getattr__(name):
         from . import client as _client
 
         return getattr(_client, name)
+    if name in ("GenerationEngine", "GenRequest", "kv_cache_enabled"):
+        from . import engine as _engine
+
+        return getattr(_engine, name)
+    if name == "PagedKVPool":
+        from .kv_cache import PagedKVPool
+
+        return PagedKVPool
+    if name in ("TinyDecoderLM", "DecoderConfig"):
+        from . import decode_model as _dm
+
+        return getattr(_dm, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -269,6 +269,75 @@ class InferenceClient:
             raise _map_app_error(last_err)
         raise last_err
 
+    class GenerateResult:
+        __slots__ = ("tokens", "weight_epoch", "ttft_ms", "replica")
+
+        def __init__(self, reply: dict, replica: str):
+            self.tokens = list(reply["tokens"])
+            self.weight_epoch = int(reply.get("weight_epoch", 0))
+            self.ttft_ms = reply.get("ttft_ms")
+            self.replica = replica
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None) -> "GenerateResult":
+        """Blocking autoregressive generation on the primary replica.
+        Generation is NOT hedged: a duplicate run would burn KV pages
+        and decode slots on two replicas for one reply."""
+        kwargs = {"prompt": [int(t) for t in prompt],
+                  "max_new_tokens": int(max_new_tokens)}
+        if deadline_ms is not None:
+            kwargs["deadline_ms"] = float(deadline_ms)
+        if eos_id is not None:
+            kwargs["eos_id"] = int(eos_id)
+        t0 = time.perf_counter()
+        try:
+            reply = self._call("generate", **kwargs)
+            with self._lock:
+                replica = self.endpoints[self._primary]
+            return self.GenerateResult(reply, replica)
+        finally:
+            _REG.histogram(
+                "serve_client_generate_ms",
+                help="caller-observed generation latency").observe(
+                (time.perf_counter() - t0) * 1e3)
+
+    def generate_stream(self, prompt: Sequence[int],
+                        max_new_tokens: int = 16,
+                        deadline_ms: Optional[float] = None,
+                        eos_id: Optional[int] = None,
+                        poll_s: float = 0.01):
+        """Incremental generation: yields lists of new tokens as the
+        replica's decode loop produces them.  The PS transport is
+        one-shot request/reply, so streaming is poll-based: `generate`
+        with stream=True returns a stream id, `generate_poll` drains it.
+        The stream is pinned to one replica (KV state is replica-local);
+        a mid-stream replica death surfaces as the connection error."""
+        kwargs = {"prompt": [int(t) for t in prompt],
+                  "max_new_tokens": int(max_new_tokens), "stream": True}
+        if deadline_ms is not None:
+            kwargs["deadline_ms"] = float(deadline_ms)
+        if eos_id is not None:
+            kwargs["eos_id"] = int(eos_id)
+        with self._lock:
+            j = self._primary
+        sid = self._conns[j].call("generate", **kwargs)["stream_id"]
+        cursor = 0
+        while True:
+            try:
+                snap = self._conns[j].call("generate_poll",
+                                           stream_id=sid, cursor=cursor)
+            except RuntimeError as e:
+                raise _map_app_error(e) from None
+            if snap["tokens"]:
+                yield list(snap["tokens"])
+            cursor = int(snap["cursor"])
+            if snap["done"]:
+                if snap.get("error"):
+                    raise _map_app_error(RuntimeError(snap["error"]))
+                return
+            time.sleep(poll_s)
+
     def model_info(self) -> dict:
         return self._call("model_info")
 

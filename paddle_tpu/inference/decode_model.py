@@ -1,0 +1,304 @@
+"""A tiny autoregressive decoder LM with a paged KV decode path.
+
+The serving engine needs a model whose decode step is ONE fixed-shape
+compiled program (max batch slots x one token) reading/writing the
+paged KV pool.  The frozen-Program predictor can't express that today
+(its cache state lives in scope vars, not a shared pool), so the
+generation path runs this pure-jax decoder: embedding + learned
+positions + pre-LN transformer blocks + tied-nothing head, greedy
+argmax sampling.  Three entry points, all module-level jits so every
+engine/test with the same shapes shares compiles:
+
+* ``prefill``       — one request's prompt window attends over its
+  (page-gathered) cached context plus itself causally; returns the
+  next-token logits and the window's per-layer K/V for scattering into
+  pool pages.  Window length is bucketed to powers of two so prefix
+  cache hits shrink compile *and* compute.
+* ``decode_step``   — the continuous-batching inner loop: [slots] query
+  tokens, each attending over its page table via the paged-attention
+  op.  New K/V are scattered into the pool *before* attention (dead
+  slots write to trash page 0), so the step is a single pure program
+  with no cache merge.
+* ``recompute_step`` — the r19-style padded baseline: re-run the whole
+  dense prefix for every generated token (O(n^2) per sequence).  Kept
+  both as the ``PADDLE_SERVE_KV_CACHE=0`` fallback and as the oracle
+  the cached path is tested against.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pallas.paged_attention import paged_attention
+
+_LN_EPS = 1e-5
+_NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab: int = 64
+    d_model: int = 32
+    n_layers: int = 2
+    n_heads: int = 2
+    ffn: int = 64
+    max_seq: int = 64
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: DecoderConfig, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.02):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    p: Dict[str, np.ndarray] = {
+        "embed": w(cfg.vocab, cfg.d_model, scale=0.1),
+        "pos": w(cfg.max_seq, cfg.d_model, scale=0.1),
+        "lnf_g": np.ones(cfg.d_model, np.float32),
+        "lnf_b": np.zeros(cfg.d_model, np.float32),
+        "head": w(cfg.d_model, cfg.vocab, scale=0.1),
+    }
+    for i in range(cfg.n_layers):
+        p[f"l{i}.ln1_g"] = np.ones(cfg.d_model, np.float32)
+        p[f"l{i}.ln1_b"] = np.zeros(cfg.d_model, np.float32)
+        p[f"l{i}.ln2_g"] = np.ones(cfg.d_model, np.float32)
+        p[f"l{i}.ln2_b"] = np.zeros(cfg.d_model, np.float32)
+        for nm in ("wq", "wk", "wv", "wo"):
+            p[f"l{i}.{nm}"] = w(cfg.d_model, cfg.d_model)
+        p[f"l{i}.w1"] = w(cfg.d_model, cfg.ffn)
+        p[f"l{i}.b1"] = np.zeros(cfg.ffn, np.float32)
+        p[f"l{i}.w2"] = w(cfg.ffn, cfg.d_model)
+        p[f"l{i}.b2"] = np.zeros(cfg.d_model, np.float32)
+    return p
+
+
+def _ln(x, g, b):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + _LN_EPS) * g + b
+
+
+def _n_layers(params) -> int:
+    i = 0
+    while f"l{i}.wq" in params:
+        i += 1
+    return i
+
+
+def _qkv(params, i, h, n_heads):
+    d = h.shape[-1]
+    hd = d // n_heads
+    q = (h @ params[f"l{i}.wq"]).reshape(*h.shape[:-1], n_heads, hd)
+    k = (h @ params[f"l{i}.wk"]).reshape(*h.shape[:-1], n_heads, hd)
+    v = (h @ params[f"l{i}.wv"]).reshape(*h.shape[:-1], n_heads, hd)
+    return q, k, v
+
+
+def _mlp(params, i, x):
+    h = _ln(x, params[f"l{i}.ln2_g"], params[f"l{i}.ln2_b"])
+    h = jax.nn.gelu(h @ params[f"l{i}.w1"] + params[f"l{i}.b1"])
+    return x + h @ params[f"l{i}.w2"] + params[f"l{i}.b2"]
+
+
+# ---------------------------------------------------------------------------
+# prefill: one request window over gathered context
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_heads",))
+def prefill(params, tokens, start, ctx_k, ctx_v, n_valid, *, n_heads):
+    """One request's prompt window.
+
+    tokens:  [R] window token ids (padded past n_valid).
+    start:   scalar int32 — absolute position of tokens[0] (== number of
+             context positions reused from the prefix cache).
+    ctx_k/v: [L, C, H, hd] gathered cached context (C == max_seq rows;
+             only the first ``start`` are live).
+    n_valid: scalar int32 — live rows in the window (>= 1).
+
+    Returns (next_logits [V], next_token, k_win [L, R, H, hd], v_win).
+    """
+    r = tokens.shape[0]
+    c = ctx_k.shape[1]
+    hd = ctx_k.shape[-1]
+    scale = 1.0 / (hd ** 0.5)
+    pos = start + jnp.arange(r, dtype=jnp.int32)
+    x = params["embed"][tokens] + params["pos"][jnp.minimum(
+        pos, params["pos"].shape[0] - 1)]
+    ctx_live = jnp.arange(c, dtype=jnp.int32)[None, None, :] < start  # [1,1,C]
+    causal = (jnp.arange(r)[None, :, None]
+              >= jnp.arange(r)[None, None, :])                        # [1,R,R]
+    ks, vs = [], []
+    for i in range(_n_layers(params)):
+        h = _ln(x, params[f"l{i}.ln1_g"], params[f"l{i}.ln1_b"])
+        q, k, v = _qkv(params, i, h, n_heads)                # [R, H, hd]
+        s_ctx = jnp.einsum("rhd,chd->hrc", q, ctx_k[i]) * scale
+        s_win = jnp.einsum("rhd,shd->hrs", q, k) * scale
+        s = jnp.concatenate([
+            jnp.where(ctx_live, s_ctx, _NEG_INF),
+            jnp.where(causal, s_win, _NEG_INF),
+        ], axis=-1)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        out = (jnp.einsum("hrc,chd->rhd", p[..., :c], ctx_v[i])
+               + jnp.einsum("hrs,shd->rhd", p[..., c:], v))
+        x = x + out.reshape(r, -1) @ params[f"l{i}.wo"]
+        x = _mlp(params, i, x)
+        ks.append(k)
+        vs.append(v)
+    hfin = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = hfin[n_valid - 1] @ params["head"]
+    return (logits, jnp.argmax(logits).astype(jnp.int32),
+            jnp.stack(ks), jnp.stack(vs))
+
+
+@functools.partial(jax.jit, static_argnames=("page_size",))
+def gather_ctx(k_flat, v_flat, page_table, *, page_size):
+    """[L, N, H, hd] pool -> [L, maxp*page, H, hd] per-request context."""
+    flat = (page_table[:, None] * page_size
+            + jnp.arange(page_size, dtype=jnp.int32)[None, :]).reshape(-1)
+    return k_flat[:, flat], v_flat[:, flat]
+
+
+@jax.jit
+def scatter_kv(k_flat, v_flat, k_win, v_win, flat_idx):
+    """Write a prefill window's K/V into pool rows (trash rows = 0)."""
+    return (k_flat.at[:, flat_idx].set(k_win),
+            v_flat.at[:, flat_idx].set(v_win))
+
+
+@functools.partial(jax.jit, static_argnames=("page_size",))
+def copy_page(k_flat, v_flat, src_pid, dst_pid, *, page_size):
+    """COW payload copy: duplicate one physical page's rows."""
+    ksrc = jax.lax.dynamic_slice_in_dim(
+        k_flat, src_pid * page_size, page_size, axis=1)
+    vsrc = jax.lax.dynamic_slice_in_dim(
+        v_flat, src_pid * page_size, page_size, axis=1)
+    return (jax.lax.dynamic_update_slice_in_dim(
+                k_flat, ksrc, dst_pid * page_size, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(
+                v_flat, vsrc, dst_pid * page_size, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# decode step: the continuous-batching inner loop (ONE compiled shape)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "n_heads"))
+def decode_step(params, k_flat, v_flat, tokens, positions, page_table,
+                write_flat, *, page_size, n_heads):
+    """One token for every batch slot.
+
+    tokens/positions: [B] current token + its absolute position (dead
+    slots: token 0, position 0, write_flat 0 -> they read/write trash
+    page 0 and their outputs are ignored by the engine).
+    page_table: [B, maxp] physical page per logical page.
+    write_flat: [B] flat pool row for this step's K/V.
+
+    New K/V are written BEFORE attention, so lengths = position + 1 and
+    the token attends to itself through the pool — no cache merge.
+    """
+    b = tokens.shape[0]
+    n = k_flat.shape[1]
+    hd = k_flat.shape[-1]
+    lengths = positions.astype(jnp.int32) + 1
+    x = (params["embed"][tokens]
+         + params["pos"][jnp.minimum(positions,
+                                     params["pos"].shape[0] - 1)])
+    for i in range(_n_layers(params)):
+        h = _ln(x, params[f"l{i}.ln1_g"], params[f"l{i}.ln1_b"])
+        q, k, v = _qkv(params, i, h, n_heads)                # [B, H, hd]
+        k_flat = k_flat.at[i, write_flat].set(k)
+        v_flat = v_flat.at[i, write_flat].set(v)
+        k_pages = k_flat[i].reshape(n // page_size, page_size,
+                                    n_heads, hd)
+        v_pages = v_flat[i].reshape(n // page_size, page_size,
+                                    n_heads, hd)
+        out = paged_attention(q, k_pages, v_pages, page_table, lengths)
+        x = x + out.reshape(b, -1) @ params[f"l{i}.wo"]
+        x = _mlp(params, i, x)
+    hfin = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = hfin @ params["head"]
+    return (logits, jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            k_flat, v_flat)
+
+
+# ---------------------------------------------------------------------------
+# recompute baseline: dense re-prefill per generated token (r19 padding)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_heads",))
+def recompute_step(params, tokens, lengths, *, n_heads):
+    """Dense causal forward over fixed [B, S]; logits at lengths-1.
+    Dead slots pass lengths=1/zero tokens and ignore the output."""
+    b, s = tokens.shape
+    scale = None
+    pos = jnp.arange(s, dtype=jnp.int32)
+    x = (params["embed"][tokens]
+         + params["pos"][jnp.minimum(pos, params["pos"].shape[0] - 1)][None])
+    causal = (pos[None, :, None] >= pos[None, None, :])[None]  # [1,1,S,S]
+    for i in range(_n_layers(params)):
+        h = _ln(x, params[f"l{i}.ln1_g"], params[f"l{i}.ln1_b"])
+        q, k, v = _qkv(params, i, h, n_heads)                # [B, S, H, hd]
+        if scale is None:
+            scale = 1.0 / (q.shape[-1] ** 0.5)
+        sc = jnp.einsum("brhd,bshd->bhrs", q, k) * scale
+        sc = jnp.where(causal, sc, _NEG_INF)
+        m = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        out = jnp.einsum("bhrs,bshd->brhd", p, v)
+        x = x + out.reshape(b, s, -1) @ params[f"l{i}.wo"]
+        x = _mlp(params, i, x)
+    hfin = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits_all = hfin @ params["head"]                       # [B, S, V]
+    idx = jnp.maximum(lengths - 1, 0)
+    logits = jnp.take_along_axis(
+        logits_all, idx[:, None, None], axis=1)[:, 0]
+    return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def prefill_bucket(n: int, buckets_from: int = 8) -> int:
+    """Window lengths compile per padded bucket (powers of two)."""
+    b = buckets_from
+    while b < n:
+        b *= 2
+    return b
+
+
+class TinyDecoderLM:
+    """Config + device params + thin wrappers over the module jits."""
+
+    def __init__(self, cfg: DecoderConfig,
+                 params: Optional[Dict[str, np.ndarray]] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.params = jax.tree_util.tree_map(
+            jnp.asarray, params if params is not None
+            else init_params(cfg, seed))
+
+    def adopt(self, params: Dict[str, np.ndarray]) -> None:
+        """Swap weights (epoch-fenced by the engine); shapes must match."""
+        cur = self.params
+        for k, v in params.items():
+            if k not in cur:
+                raise KeyError(f"unknown param {k!r}")
+            if tuple(cur[k].shape) != tuple(np.shape(v)):
+                raise ValueError(
+                    f"shape mismatch for {k!r}: "
+                    f"{tuple(np.shape(v))} vs {tuple(cur[k].shape)}")
+        self.params = {**cur,
+                       **{k: jnp.asarray(v) for k, v in params.items()}}

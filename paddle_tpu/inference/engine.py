@@ -1,0 +1,602 @@
+"""Continuous-batching generation engine.
+
+Where the r19 MicroBatcher coalesces whole requests into padded
+micro-batches (every request enters and leaves together), this engine
+schedules at *iteration* granularity: a single decode loop runs ONE
+compiled step shape — ``max_slots`` batch slots x one token — and
+requests join free slots at step boundaries, retire mid-loop the moment
+they finish, and never force a retrace (slot occupancy changes the
+*data*, not the shape; dead slots read/write the KV pool's trash page).
+
+Modes, selected by ``PADDLE_SERVE_KV_CACHE`` (default on):
+
+* **paged** — prompts prefill once into pool pages (with page-granular
+  prefix-cache reuse), then every generated token is one fixed-shape
+  ``decode_step`` attending over cached pages: O(1) positions of new
+  work per token.
+* **recompute** — the r19-style padded baseline: the whole prefix is
+  re-run densely for every token (O(n) positions per token, O(n^2) per
+  sequence).  Kept for the flag-off escape hatch and as the oracle the
+  cached path is verified against.
+
+Deterministic work accounting (`prefill_positions` / `decode_positions`
+/ `recompute_positions`) lets tests assert the O(n)-per-sequence bound
+without relying on wall-clock.  Admission, shedding, deadline and
+epoch-fenced weight-swap semantics mirror server.MicroBatcher: the only
+legal weight swap point is between decode steps, `Overloaded` /
+`DeadlineExceeded` reply strings cross the RPC boundary verbatim, and
+shed/expired wall-time is charged to the goodput ledger's serving
+badput buckets.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import decode_model as dm
+from .kv_cache import PagedKVPool
+from .server import DeadlineExceeded, Overloaded
+
+ENV_KV_CACHE = "PADDLE_SERVE_KV_CACHE"
+ENV_MAX_SLOTS = "PADDLE_SERVE_MAX_SLOTS"
+
+_SERVE_BUCKETS = (1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                  5000, 10000)
+
+
+def kv_cache_enabled() -> bool:
+    return os.environ.get(ENV_KV_CACHE, "1") not in ("0", "false", "off")
+
+
+class GenRequest:
+    """One admitted generation request."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline_t",
+                 "event", "tokens", "error", "weight_epoch", "t_admit",
+                 "pages", "reuse", "pos", "cur_token", "slot",
+                 "rc_tokens", "rc_len", "t_first_token")
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 eos_id: Optional[int], deadline_t: Optional[float]):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline_t = deadline_t
+        self.event = threading.Event()
+        self.tokens: List[int] = []       # generated tokens (appended)
+        self.error: Optional[BaseException] = None
+        self.weight_epoch = 0
+        self.t_admit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.pages: List[int] = []        # paged mode: physical pages
+        self.reuse = 0                    # prompt tokens from prefix cache
+        self.pos = 0                      # abs position of cur_token
+        self.cur_token = 0
+        self.slot: Optional[int] = None
+        self.rc_tokens: Optional[np.ndarray] = None  # recompute mode
+        self.rc_len = 0
+
+    def snapshot(self, cursor: int = 0) -> dict:
+        """Streaming poll: tokens generated past ``cursor`` + liveness.
+        List append is atomic under the GIL; no lock needed."""
+        toks = self.tokens[cursor:]
+        return {
+            "tokens": list(toks),
+            "cursor": cursor + len(toks),
+            "done": self.event.is_set(),
+            "error": (f"{self.error}" if self.error is not None else None),
+            "weight_epoch": self.weight_epoch,
+        }
+
+
+class GenerationEngine:
+    """Iteration-level scheduler over a TinyDecoderLM + PagedKVPool."""
+
+    def __init__(self, model: dm.TinyDecoderLM, *,
+                 max_slots: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 n_pages: Optional[int] = None,
+                 queue_depth: int = 32,
+                 kv_cache: Optional[bool] = None,
+                 prefix_cache: bool = True,
+                 eos_id: Optional[int] = None,
+                 step_wait_s: float = 0.02):
+        self.model = model
+        cfg = model.cfg
+        self.max_seq = cfg.max_seq
+        self.max_slots = int(max_slots or os.environ.get(
+            ENV_MAX_SLOTS, 4))
+        self.queue_limit = max(1, int(queue_depth))
+        self.kv_cache = (kv_cache_enabled() if kv_cache is None
+                         else bool(kv_cache))
+        self.prefix_cache = bool(prefix_cache) and self.kv_cache
+        self.eos_id = eos_id
+        self.step_wait_s = float(step_wait_s)
+        self.pool: Optional[PagedKVPool] = None
+        if self.kv_cache:
+            self.pool = PagedKVPool.from_budget(
+                n_layers=cfg.n_layers, kv_heads=cfg.n_heads,
+                head_dim=cfg.head_dim, page_size=page_size,
+                n_pages=n_pages)
+            self.page_size = self.pool.page_size
+            self.maxp = -(-self.max_seq // self.page_size)
+        self._q: deque = deque()
+        self._slots: List[Optional[GenRequest]] = [None] * self.max_slots
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stopped = False
+        self._pending_weights = None
+        self._wlock = threading.Lock()
+        self.weight_epoch = 0
+        # deterministic work accounting (the O(n) proof in tests)
+        self.counters = {
+            "prefill_positions": 0,    # positions computed in prefills
+            "cached_positions": 0,     # positions reused from prefix cache
+            "decode_positions": 0,     # positions computed by decode steps
+            "recompute_positions": 0,  # positions re-run by the baseline
+            "tokens_out": 0,
+            "decode_steps": 0,
+            "served": 0, "shed": 0, "deadline_exceeded": 0, "evicted": 0,
+        }
+        self._t_start = time.monotonic()
+        self._step_ewma_s: Optional[float] = None
+        from ..telemetry import get_registry
+
+        self._reg = get_registry()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-genloop")
+        self._thread.start()
+
+    # -- admission -------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               deadline_ms: Optional[float] = None,
+               eos_id: Optional[int] = None) -> GenRequest:
+        prompt = [int(t) for t in prompt]
+        if not prompt or len(prompt) >= self.max_seq:
+            raise ValueError(
+                f"prompt must have 1..{self.max_seq - 1} tokens "
+                f"(got {len(prompt)})")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        deadline_t = (time.monotonic() + float(deadline_ms) / 1e3
+                      if deadline_ms else None)
+        req = GenRequest(prompt, int(max_new_tokens),
+                         self.eos_id if eos_id is None else int(eos_id),
+                         deadline_t)
+        with self._cond:
+            if self._draining or self._stopped:
+                self._shed(req, "Overloaded: server is draining")
+            if len(self._q) >= self.queue_limit:
+                self._shed(req, f"Overloaded: admission queue full "
+                                f"({len(self._q)}/{self.queue_limit})")
+            if self.pool is not None:
+                need = self._pages_needed(req)
+                if need > self.pool.capacity:
+                    self._shed(req, f"Overloaded: request needs {need} "
+                                    f"KV pages, pool capacity is "
+                                    f"{self.pool.capacity}")
+                # conservative fit gate (prefix sharing can only help):
+                # bounce work the pool cannot start promptly instead of
+                # queueing it behind capacity we don't have
+                if need > self.pool.available() and not self._will_free(
+                        need):
+                    self._shed(req, f"Overloaded: kv pool full ({need} "
+                                    f"pages needed, "
+                                    f"{self.pool.available()} available)")
+            self._q.append(req)
+            self._gauge("serve_gen_queue_depth").set(len(self._q))
+            self._cond.notify_all()
+        return req
+
+    def _will_free(self, need: int) -> bool:
+        """Pages active requests will return when they retire."""
+        freed = sum(len(r.pages) for r in self._slots if r is not None)
+        return self.pool.available() + freed >= need
+
+    def _shed(self, req: GenRequest, msg: str):
+        self._count("shed")
+        self._badput(req, "shed")
+        raise Overloaded(msg)
+
+    def _pages_needed(self, req: GenRequest) -> int:
+        total = min(len(req.prompt) + req.max_new_tokens, self.max_seq)
+        return -(-total // self.page_size)
+
+    # -- weight fence ----------------------------------------------------
+
+    def stage_weights(self, weights: Dict[str, np.ndarray],
+                      version: int) -> None:
+        """Same contract as MicroBatcher.stage_weights: the decode LOOP
+        installs staged weights between steps — the epoch fence."""
+        with self._wlock:
+            self._pending_weights = (weights, int(version))
+        with self._cond:
+            self._cond.notify_all()
+
+    def _maybe_adopt_weights(self) -> None:
+        with self._wlock:
+            staged, self._pending_weights = self._pending_weights, None
+        if staged is None:
+            return
+        weights, version = staged
+        try:
+            self.model.adopt(weights)
+        except Exception as e:  # noqa: BLE001 — a bad delivery must not
+            # kill the loop; serving stays on the current epoch
+            self._reg.counter("serve_weight_adopt_errors_total").inc()
+            import sys
+
+            print(f"[generation_engine] weight adoption rejected "
+                  f"(version {version}): {e}; staying on epoch "
+                  f"{self.weight_epoch}", file=sys.stderr, flush=True)
+            return
+        self.weight_epoch += 1
+        self._reg.gauge("serve_weight_epoch").set(self.weight_epoch)
+        self._reg.counter("serve_weight_fences_total").inc()
+
+    # -- the decode loop -------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stopped and not self._q and not any(self._slots):
+                    return
+                if not self._q and not any(self._slots) \
+                        and self._pending_weights is None:
+                    self._cond.wait(0.05)
+            try:
+                self._maybe_adopt_weights()  # fence: between steps only
+                self._expire_and_admit()
+                if any(s is not None for s in self._slots):
+                    self._step()
+                elif self._q:
+                    # queued work that can't start yet (pool/slots):
+                    # don't spin
+                    time.sleep(0.001)
+            except BaseException as e:  # noqa: BLE001 — the loop must
+                # never die: fail the implicated requests, keep serving
+                for i, r in enumerate(self._slots):
+                    if r is not None:
+                        self._finish(r, error=e, outcome="error")
+                        self._slots[i] = None
+
+    def _expire_and_admit(self) -> None:
+        now = time.monotonic()
+        # mid-decode deadline eviction: expired requests leave their
+        # slot immediately and their pages return to the pool
+        for i, r in enumerate(self._slots):
+            if r is not None and r.deadline_t is not None \
+                    and now >= r.deadline_t:
+                self._finish(r, error=DeadlineExceeded(
+                    "DeadlineExceeded: request expired mid-decode"),
+                    outcome="deadline_exceeded")
+                self._slots[i] = None
+                self.counters["evicted"] += 1
+        with self._cond:
+            queued = list(self._q)
+        for req in queued:
+            if req.deadline_t is not None and now >= req.deadline_t:
+                with self._cond:
+                    try:
+                        self._q.remove(req)
+                    except ValueError:
+                        continue
+                self._finish(req, error=DeadlineExceeded(
+                    "DeadlineExceeded: request expired in the queue"),
+                    outcome="deadline_exceeded")
+                continue
+            slot = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if slot is None:
+                break
+            if not self._try_admit(req, slot):
+                break  # pool can't fit it yet; keep FIFO order
+        self._gauge("serve_gen_queue_depth").set(len(self._q))
+
+    def _try_admit(self, req: GenRequest, slot: int) -> bool:
+        if self.pool is None:
+            self._admit_recompute(req, slot)
+        else:
+            matched, covered = ([], 0)
+            if self.prefix_cache:
+                matched, covered = self.pool.match_prefix(req.prompt)
+            # whole-page reuse only, and at least one prompt token must
+            # be computed so prefill has logits to sample from
+            reuse_pages = min(len(matched),
+                              (len(req.prompt) - 1) // self.page_size)
+            if reuse_pages < len(matched):
+                self.pool.free(matched[reuse_pages:])
+                matched = matched[:reuse_pages]
+            reuse = reuse_pages * self.page_size
+            try:
+                fresh = self.pool.alloc(self._pages_needed(req)
+                                        - reuse_pages)
+            except MemoryError:
+                self.pool.free(matched)
+                return False
+            req.pages = matched + fresh
+            req.reuse = reuse
+            self._prefill_paged(req, slot)
+        with self._cond:
+            try:
+                self._q.remove(req)
+            except ValueError:
+                pass
+        self._slots[slot] = req
+        req.slot = slot
+        if req.event.is_set():  # finished during prefill (eos/max_new)
+            self._slots[slot] = None
+        return True
+
+    # -- paged mode ------------------------------------------------------
+
+    def _table_row(self, req: GenRequest) -> np.ndarray:
+        row = np.zeros(self.maxp, np.int32)
+        row[:len(req.pages)] = req.pages
+        return row
+
+    def _prefill_paged(self, req: GenRequest, slot: int) -> None:
+        import jax.numpy as jnp
+
+        pool, psz = self.pool, self.page_size
+        n_valid = len(req.prompt) - req.reuse
+        r = min(dm.prefill_bucket(n_valid), self.max_seq)
+        window = np.zeros(r, np.int32)
+        window[:n_valid] = req.prompt[req.reuse:]
+        ctx_k, ctx_v = dm.gather_ctx(pool.k, pool.v,
+                                     jnp.asarray(self._table_row(req)),
+                                     page_size=psz)
+        t0 = time.perf_counter()
+        logits, tok, k_win, v_win = dm.prefill(
+            self.model.params, jnp.asarray(window),
+            jnp.int32(req.reuse), ctx_k, ctx_v, jnp.int32(n_valid),
+            n_heads=self.model.cfg.n_heads)
+        flat = np.zeros(r, np.int32)
+        for i in range(n_valid):
+            p_abs = req.reuse + i
+            flat[i] = req.pages[p_abs // psz] * psz + p_abs % psz
+        pool.set_arrays(*dm.scatter_kv(pool.k, pool.v, k_win, v_win,
+                                       jnp.asarray(flat)))
+        self._observe_ms("serve_prefill_ms", t0)
+        if self.prefix_cache:
+            pool.register_prefix(req.prompt,
+                                 req.pages[:len(req.prompt) // psz])
+        self.counters["prefill_positions"] += n_valid
+        self.counters["cached_positions"] += req.reuse
+        self._tok_counter("prefill").inc(n_valid)
+        req.pos = len(req.prompt)
+        self._emit(req, int(tok))
+
+    def _step_paged(self, active: List[GenRequest]) -> None:
+        import jax.numpy as jnp
+
+        pool, psz, b = self.pool, self.page_size, self.max_slots
+        tokens = np.zeros(b, np.int32)
+        positions = np.zeros(b, np.int32)
+        write_flat = np.zeros(b, np.int32)
+        table = np.zeros((b, self.maxp), np.int32)
+        for r in active:
+            pid = r.pages[r.pos // psz]
+            # COW safety: never write a shared/cached page in place
+            new_pid, needs_copy = pool.ensure_private(pid)
+            if needs_copy:
+                pool.set_arrays(*dm.copy_page(
+                    pool.k, pool.v, jnp.int32(pid), jnp.int32(new_pid),
+                    page_size=psz))
+                r.pages[r.pos // psz] = new_pid
+                pid = new_pid
+            tokens[r.slot] = r.cur_token
+            positions[r.slot] = r.pos
+            write_flat[r.slot] = pid * psz + r.pos % psz
+            table[r.slot, :len(r.pages)] = r.pages
+        t0 = time.perf_counter()
+        logits, nxt, k, v = dm.decode_step(
+            self.model.params, pool.k, pool.v, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(table),
+            jnp.asarray(write_flat), page_size=psz,
+            n_heads=self.model.cfg.n_heads)
+        pool.set_arrays(k, v)
+        nxt = np.asarray(nxt)
+        self._observe_ms("serve_decode_step_ms", t0)
+        self.counters["decode_steps"] += 1
+        self.counters["decode_positions"] += len(active)
+        self._tok_counter("decode").inc(len(active))
+        for r in active:
+            r.pos += 1
+            self._emit(r, int(nxt[r.slot]))
+
+    # -- recompute baseline (PADDLE_SERVE_KV_CACHE=0) --------------------
+
+    def _admit_recompute(self, req: GenRequest, slot: int) -> None:
+        req.rc_tokens = np.zeros(self.max_seq, np.int32)
+        req.rc_tokens[:len(req.prompt)] = req.prompt
+        req.rc_len = len(req.prompt)
+
+    def _step_recompute(self, active: List[GenRequest]) -> None:
+        import jax.numpy as jnp
+
+        b = self.max_slots
+        tokens = np.zeros((b, self.max_seq), np.int32)
+        lengths = np.ones(b, np.int32)
+        for r in active:
+            tokens[r.slot] = r.rc_tokens
+            lengths[r.slot] = r.rc_len
+        t0 = time.perf_counter()
+        logits, nxt = dm.recompute_step(
+            self.model.params, jnp.asarray(tokens),
+            jnp.asarray(lengths), n_heads=self.model.cfg.n_heads)
+        nxt = np.asarray(nxt)
+        self._observe_ms("serve_decode_step_ms", t0)
+        self.counters["decode_steps"] += 1
+        # the whole live prefix was re-run for ONE new token per slot —
+        # this counter is the measured O(n^2) the paged path removes
+        self.counters["recompute_positions"] += int(
+            sum(r.rc_len for r in active))
+        self._tok_counter("decode").inc(len(active))
+        for r in active:
+            tok = int(nxt[r.slot])
+            if r.rc_len < self.max_seq:
+                r.rc_tokens[r.rc_len] = tok
+            r.rc_len += 1
+            self._emit(r, tok)
+
+    # -- shared loop pieces ---------------------------------------------
+
+    def _step(self) -> None:
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            return
+        if self.pool is not None:
+            self._step_paged(active)
+        else:
+            self._step_recompute(active)
+        for i, r in enumerate(self._slots):
+            if r is not None and r.event.is_set():
+                self._slots[i] = None
+        if self.pool is not None:
+            self.pool.publish_gauges()
+
+    def _emit(self, req: GenRequest, tok: int) -> None:
+        """Append one generated token; retire on eos/max_new/capacity."""
+        if req.t_first_token is None:
+            req.t_first_token = time.monotonic()
+        req.tokens.append(tok)
+        self.counters["tokens_out"] += 1
+        done = (len(req.tokens) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id))
+        total = len(req.prompt) + len(req.tokens)
+        if not done and total >= self.max_seq:
+            done = True  # context capacity reached
+        if done:
+            self._finish(req, outcome="served")
+        else:
+            req.cur_token = tok
+
+    def _finish(self, req: GenRequest,
+                error: Optional[BaseException] = None,
+                outcome: str = "served") -> None:
+        if req.event.is_set():
+            return
+        if self.pool is not None and req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        req.error = error
+        req.weight_epoch = self.weight_epoch
+        self._count(outcome)
+        if outcome == "deadline_exceeded":
+            self._badput(req, "deadline")
+        self._observe_ms("serve_gen_request_ms",
+                         None, ms=(time.monotonic() - req.t_admit) * 1e3)
+        req.event.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- client side -----------------------------------------------------
+
+    def result(self, req: GenRequest,
+               timeout: Optional[float] = None) -> dict:
+        grace = 30.0
+        if timeout is None and req.deadline_t is not None:
+            timeout = max(0.0, req.deadline_t - time.monotonic()) + grace
+        if not req.event.wait(timeout):
+            raise DeadlineExceeded(
+                "DeadlineExceeded: generation did not complete in time")
+        if req.error is not None:
+            raise req.error
+        return {
+            "tokens": list(req.tokens),
+            "weight_epoch": req.weight_epoch,
+            "ttft_ms": (None if req.t_first_token is None else round(
+                (req.t_first_token - req.t_admit) * 1e3, 3)),
+        }
+
+    # -- lifecycle / observability ---------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (self._q or any(s is not None for s in self._slots)) \
+                    and time.monotonic() < deadline:
+                self._cond.wait(0.1)
+            return not self._q and not any(
+                s is not None for s in self._slots)
+
+    def stop(self) -> None:
+        self.drain(timeout=5.0)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        c = dict(self.counters)
+        dt = max(1e-9, time.monotonic() - self._t_start)
+        out = {
+            "mode": "paged" if self.pool is not None else "recompute",
+            "max_slots": self.max_slots,
+            "active_slots": sum(1 for s in self._slots if s is not None),
+            "queue_depth": len(self._q),
+            "draining": self._draining,
+            "weight_epoch": self.weight_epoch,
+            "tokens_total": c["tokens_out"],
+            "tokens_per_s": round(c["tokens_out"] / dt, 3),
+            "decode_steps": c["decode_steps"],
+            "prefill_positions_total": c["prefill_positions"],
+            "cached_positions_total": c["cached_positions"],
+            "decode_positions_total": c["decode_positions"],
+            "recompute_positions_total": c["recompute_positions"],
+            "served_total": c["served"],
+            "shed_total": c["shed"],
+            "deadline_exceeded_total": c["deadline_exceeded"],
+            "evicted_total": c["evicted"],
+            "step_ewma_ms": (None if self._step_ewma_s is None
+                             else round(self._step_ewma_s * 1e3, 3)),
+        }
+        if self.pool is not None:
+            out["kv_pool"] = self.pool.stats()
+        return out
+
+    # -- small helpers ---------------------------------------------------
+
+    def _count(self, outcome: str) -> None:
+        if outcome in self.counters:
+            self.counters[outcome] += 1
+        self._reg.counter("serve_gen_requests_total",
+                          outcome=outcome).inc()
+
+    def _tok_counter(self, phase: str):
+        return self._reg.counter(
+            "serve_tokens_total",
+            help="generated/prefilled token positions by phase",
+            phase=phase)
+
+    def _gauge(self, name: str):
+        return self._reg.gauge(name)
+
+    def _observe_ms(self, name: str, t0: Optional[float],
+                    ms: Optional[float] = None) -> None:
+        if ms is None:
+            ms = (time.perf_counter() - t0) * 1e3
+        if name == "serve_decode_step_ms":
+            s = ms / 1e3
+            self._step_ewma_s = (s if self._step_ewma_s is None
+                                 else 0.8 * self._step_ewma_s + 0.2 * s)
+        self._reg.histogram(name, buckets=_SERVE_BUCKETS).observe(ms)
+
+    def _badput(self, req: GenRequest, cause: str) -> None:
+        try:
+            from ..telemetry import goodput as _goodput
+
+            _goodput.note_serving_badput(
+                (time.monotonic() - req.t_admit) * 1e3, cause=cause)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass

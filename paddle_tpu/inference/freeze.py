@@ -85,6 +85,62 @@ def _infer_feed_names(program) -> List[str]:
             if getattr(v, "is_data", False)]
 
 
+def _detect_state_vars(program, feed_names: Sequence[str],
+                       fetch_names: Sequence[str]) -> List[str]:
+    """State-carrying cache vars of a decode program: persistable
+    non-Parameter vars that the INFERENCE slice reads at op index i
+    and some op writes back at index j >= i (the read-old / write-new
+    cross-step pattern — KV caches, rolling decode state).  The
+    executor round-trips such vars back into the scope after every
+    run, so the frozen program must keep their writer ops live even
+    though no fetch depends on them.
+
+    Two classes of read+written persistables must NOT be detected:
+
+    * BN batch statistics — read+written in TRAINING mode only;
+      detection runs on a for_test clone, where their writers are gone.
+    * Optimizer accumulators (Adam moments, beta-pow state) — read and
+      written, but only by backward/optimizer ops.  Restricting the
+      read side to vars the fetch-rooted slice actually needs keeps
+      them (and, transitively, the whole training graph they'd drag
+      back in) out.  The slice is iterated to a fixpoint because a kept
+      writer chain can itself read further state vars."""
+    test = program.clone(for_test=True)
+    blk = test.global_block()
+    first_read: Dict[str, int] = {}
+    last_write: Dict[str, int] = {}
+    for i, op in enumerate(blk.ops):
+        for n in op.input_names():
+            first_read.setdefault(n, i)
+        for n in op.output_names():
+            last_write[n] = i
+    feeds = set(feed_names)
+
+    state: set = set()
+    while True:
+        # the vars the fetch+state-rooted backward slice reads
+        needed = set(str(n) for n in fetch_names) | state
+        for i in range(len(blk.ops) - 1, -1, -1):
+            op = blk.ops[i]
+            if any(n in needed for n in op.output_names()):
+                needed.update(op.input_names())
+        new = set()
+        for n in needed - state:
+            if n in feeds:
+                continue
+            wi, ri = last_write.get(n), first_read.get(n)
+            if wi is None or ri is None or ri > wi:
+                continue
+            v = blk._find_var_recursive(n)
+            if v is None or not v.persistable \
+                    or isinstance(v, framework.Parameter):
+                continue
+            new.add(n)
+        if not new:
+            return sorted(state)
+        state |= new
+
+
 def freeze_program(program, scope=None, feed_names: Optional[Sequence[str]]
                    = None, fetch_list: Sequence = ()) -> FrozenModel:
     """Clone `program` into a pruned `is_test` inference Program and
@@ -101,12 +157,16 @@ def freeze_program(program, scope=None, feed_names: Optional[Sequence[str]]
     if feed_names is None:
         feed_names = _infer_feed_names(program)
     feed_names = [str(n) for n in feed_names]
-    live_out = set(feed_names) | set(fetch_names)
+    # state-carrying cache vars (decode programs): extra slice roots so
+    # their write-back ops survive the fetch-rooted backward slice
+    state_vars = _detect_state_vars(program, feed_names, fetch_names)
+    live_out = set(feed_names) | set(fetch_names) | set(state_vars)
 
     with pass_sandwich(program, "freeze_program", live_out=live_out):
         # clone(for_test=True) + backward slice: backward/optimizer ops
         # and every var only they touched drop out of the op list here
-        frozen = _prune_for_inference(program, feed_names, fetch_names)
+        frozen = _prune_for_inference(program, feed_names, fetch_names,
+                                      state_vars=state_vars)
     blk = frozen.global_block()
     blk.ops = [op for op in blk.ops if op.type not in _FEED_QUEUE_OPS]
 
@@ -162,7 +222,8 @@ def freeze_program(program, scope=None, feed_names: Optional[Sequence[str]]
             f"first): {missing[:5]}")
     return FrozenModel(program=frozen, feed_names=list(feed_names),
                        fetch_names=fetch_names, param_names=param_names,
-                       scope=fscope, fused_conv_bn=fused)
+                       scope=fscope, fused_conv_bn=fused,
+                       meta={"state_vars": state_vars})
 
 
 def load_frozen(model_dir: str, model_filename=None, params_filename=None,

@@ -74,6 +74,17 @@ DEFAULT_QUEUE_DEPTH = int(os.environ.get("PADDLE_SERVE_QUEUE_DEPTH", 64))
 _ACTIVE: Optional["InferenceServer"] = None
 
 
+def _note_serving_badput(ms: float, cause: str) -> None:
+    """Charge shed/expired request wall-time to the goodput ledger's
+    serving buckets (no-op when PADDLE_GOODPUT is off)."""
+    try:
+        from ..telemetry import goodput as _goodput
+
+        _goodput.note_serving_badput(ms, cause=cause)
+    except Exception:  # noqa: BLE001 — telemetry is best-effort
+        pass
+
+
 class Overloaded(RuntimeError):
     """Admission refused — queue full, draining, or the projected wait
     exceeds the request deadline. The CLIENT's cue to back off or go to
@@ -170,6 +181,7 @@ class MicroBatcher:
                 if time.monotonic() + wait >= deadline_t:
                     _REG.counter("serve_requests_total",
                                  outcome="shed").inc()
+                    _note_serving_badput(wait * 1e3, "shed")
                     raise Overloaded(
                         f"Overloaded: projected queue wait "
                         f"{wait * 1e3:.0f}ms exceeds the request "
@@ -273,6 +285,7 @@ class MicroBatcher:
                     "DeadlineExceeded: request expired in the queue")
                 _REG.counter("serve_requests_total",
                              outcome="deadline_exceeded").inc()
+                _note_serving_badput((now - p.t_admit) * 1e3, "deadline")
                 p.event.set()
             else:
                 live.append(p)
@@ -396,7 +409,8 @@ class InferenceServer:
                  max_batch: int = DEFAULT_MAX_BATCH,
                  queue_depth: int = DEFAULT_QUEUE_DEPTH,
                  batch_wait_ms: float = 2.0,
-                 weight_subscribe: bool = True):
+                 weight_subscribe: bool = True,
+                 engine=None):
         global _ACTIVE
 
         self.frozen = frozen
@@ -404,6 +418,13 @@ class InferenceServer:
         self.batcher = MicroBatcher(self.predictor, max_batch=max_batch,
                                     queue_depth=queue_depth,
                                     batch_wait_ms=batch_wait_ms)
+        # optional autoregressive path (engine.GenerationEngine): the
+        # `generate`/`generate_poll` verbs; the padded `infer` path
+        # above is untouched whether or not an engine is attached
+        self.engine = engine
+        self._streams: Dict[str, object] = {}
+        self._streams_lock = threading.Lock()
+        self._stream_seq = 0
         self.shutdown_event = threading.Event()  # _Handler contract
         self.started_at = time.time()
         self.subscriber = None
@@ -436,6 +457,40 @@ class InferenceServer:
                               3),
         }
 
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 stream: bool = False) -> dict:
+        """Autoregressive generation (requires an attached engine).
+
+        Blocking form returns the full token list; ``stream=True``
+        returns a ``stream_id`` the client polls with `generate_poll`
+        for incremental tokens (the PS RPC transport is one-shot
+        request/reply, so streaming is poll-based)."""
+        if self.engine is None:
+            raise ValueError("generation is not enabled on this replica "
+                             "(no decoder engine attached)")
+        req = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                 deadline_ms=deadline_ms, eos_id=eos_id)
+        if stream:
+            with self._streams_lock:
+                self._stream_seq += 1
+                sid = f"g{self._stream_seq}"
+                self._streams[sid] = req
+            return {"stream_id": sid}
+        return self.engine.result(req)
+
+    def generate_poll(self, stream_id: str, cursor: int = 0) -> dict:
+        with self._streams_lock:
+            req = self._streams.get(stream_id)
+        if req is None:
+            raise ValueError(f"unknown stream {stream_id!r}")
+        snap = req.snapshot(int(cursor))
+        if snap["done"]:
+            with self._streams_lock:
+                self._streams.pop(stream_id, None)
+        return snap
+
     def health(self) -> dict:
         return {
             "ok": not self.batcher._draining,
@@ -448,7 +503,7 @@ class InferenceServer:
     def stats(self) -> dict:
         from ..distributed.ps_server import server_telemetry
 
-        return {
+        out = {
             "serving": self.batcher.stats(),
             "model": self.frozen.model_info(),
             "server": server_telemetry(),
@@ -458,6 +513,9 @@ class InferenceServer:
                             if self.subscriber else None),
             },
         }
+        if self.engine is not None:
+            out["generation"] = self.engine.stats()
+        return out
 
     def handle(self, method: str, kwargs: dict):
         from ..distributed import faults
@@ -472,6 +530,16 @@ class InferenceServer:
             return "pong"
         if method == "infer":
             return self.infer(kwargs["feed"], kwargs.get("deadline_ms"))
+        if method == "generate":
+            return self.generate(
+                kwargs["prompt"],
+                max_new_tokens=int(kwargs.get("max_new_tokens", 16)),
+                deadline_ms=kwargs.get("deadline_ms"),
+                eos_id=kwargs.get("eos_id"),
+                stream=bool(kwargs.get("stream", False)))
+        if method == "generate_poll":
+            return self.generate_poll(kwargs["stream_id"],
+                                      int(kwargs.get("cursor", 0)))
         if method == "model_info":
             return self.frozen.model_info()
         if method == "health":
@@ -479,8 +547,11 @@ class InferenceServer:
         if method == "stats":
             return self.stats()
         if method == "drain":
-            return {"drained": self.batcher.drain(
-                timeout=float(kwargs.get("timeout", 30.0)))}
+            t = float(kwargs.get("timeout", 30.0))
+            drained = self.batcher.drain(timeout=t)
+            if self.engine is not None:
+                drained = self.engine.drain(timeout=t) and drained
+            return {"drained": drained}
         if method == "shutdown":
             self.begin_drain()
             self.shutdown_event.set()
@@ -492,6 +563,10 @@ class InferenceServer:
         with self.batcher._cond:
             self.batcher._draining = True
             self.batcher._cond.notify_all()
+        if self.engine is not None:
+            with self.engine._cond:
+                self.engine._draining = True
+                self.engine._cond.notify_all()
 
     def close(self) -> None:
         global _ACTIVE
@@ -499,6 +574,8 @@ class InferenceServer:
         if self.subscriber is not None:
             self.subscriber.stop()
         self.batcher.stop()
+        if self.engine is not None:
+            self.engine.stop()
         if _ACTIVE is self:
             _ACTIVE = None
 
@@ -520,10 +597,24 @@ def current_status() -> Optional[dict]:
 # ---------------------------------------------------------------------------
 
 
+def _maybe_build_engine():
+    """PADDLE_SERVE_GEN=1 attaches a generation engine to the replica
+    (the tiny decoder; real deployments construct their own engine and
+    pass it to InferenceServer).  Sized by the PADDLE_SERVE_KV_* envs."""
+    if os.environ.get("PADDLE_SERVE_GEN", "") in ("", "0", "false"):
+        return None
+    from . import decode_model as _dm
+    from .engine import GenerationEngine
+
+    cfg = _dm.DecoderConfig()
+    seed = int(os.environ.get("PADDLE_SERVE_GEN_SEED", "0"))
+    return GenerationEngine(_dm.TinyDecoderLM(cfg, seed=seed))
+
+
 def serve(frozen: FrozenModel, port: int = 0, host: str = "0.0.0.0",
           ready_cb=None, max_batch: int = DEFAULT_MAX_BATCH,
           queue_depth: int = DEFAULT_QUEUE_DEPTH,
-          drain_grace: float = 30.0):
+          drain_grace: float = 30.0, engine=None):
     """Run one serving replica (blocks). Mirrors ps_server.serve: the
     same _TCPServer/_Handler transport, heartbeat + coordinator lease
     integration, SIGTERM -> graceful drain -> exit 0."""
@@ -531,8 +622,10 @@ def serve(frozen: FrozenModel, port: int = 0, host: str = "0.0.0.0",
 
     _tracing.maybe_install_hooks()
     srv = _TCPServer((host, port), _Handler)
+    if engine is None:
+        engine = _maybe_build_engine()
     inf = InferenceServer(frozen, max_batch=max_batch,
-                          queue_depth=queue_depth)
+                          queue_depth=queue_depth, engine=engine)
     srv.ps = inf  # type: ignore[attr-defined] — _Handler contract
 
     # graceful drain: SIGTERM stops admission (new infers bounce with
@@ -545,6 +638,8 @@ def serve(frozen: FrozenModel, port: int = 0, host: str = "0.0.0.0",
                   file=sys.stderr, flush=True)
             inf.begin_drain()
             inf.batcher.drain(timeout=drain_grace)
+            if inf.engine is not None:
+                inf.engine.drain(timeout=drain_grace)
             inf.shutdown_event.set()
             srv.shutdown()
 

@@ -84,6 +84,11 @@ BUCKETS = (
     "bad_step_replay",
     "stall",
     "idle",
+    # serving replicas: wall-clock a request burned before being shed at
+    # admission / expiring mid-decode.  Same ledger, so merge_fleet and
+    # /fleetz attribute serving badput exactly like training badput.
+    "serve_shed",
+    "serve_deadline",
 )
 
 # wall time of module import: recorded in the birth row so the stitcher
@@ -191,6 +196,11 @@ class GoodputLedger:
                        residual_bucket: str = "idle", **extra) -> dict:
         now = time.time() if now is None else now
         with self._lock:
+            # a caller may capture `now` BEFORE this lazily-constructed
+            # ledger stamps its own birth (monitor.py takes now_wall,
+            # emits the step record, then commits here) — clamp so no
+            # row ever runs backwards and windows stay wall-exact
+            now = max(now, self._last_ts)
             wall = max(0.0, (now - self._last_ts) * 1e3)
             t_start = self._last_ts
             self._last_ts = now
@@ -281,6 +291,15 @@ class GoodputLedger:
         self._commit_window({"stall": float(ms)}, now=now, event="stall",
                             **extra)
 
+    def note_serving_badput(self, ms: float, cause: str,
+                            now: Optional[float] = None) -> None:
+        """Serving-side SLO badput: wall-clock a request spent in the
+        replica before being shed at admission (`cause="shed"`) or
+        expiring mid-decode (`cause="deadline"`)."""
+        bucket = "serve_deadline" if cause == "deadline" else "serve_shed"
+        self._commit_window({bucket: float(ms)}, now=now,
+                            event="serve_badput", cause=cause)
+
     # -- read side -------------------------------------------------------
     def summary(self) -> dict:
         with self._lock:
@@ -344,6 +363,12 @@ def note_stall(ms: float, cause: str = "straggler",
     led = get_ledger()
     if led is not None:
         led.note_stall(ms, cause=cause, trace_id=trace_id)
+
+
+def note_serving_badput(ms: float, cause: str) -> None:
+    led = get_ledger()
+    if led is not None:
+        led.note_serving_badput(ms, cause=cause)
 
 
 def summary() -> Optional[dict]:

@@ -57,7 +57,7 @@ import os
 import re
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from .registry import get_registry
 
@@ -677,6 +677,23 @@ def dump_memrec(payload: dict, directory: Optional[str] = None
 # ---------------------------------------------------------------------------
 
 
+#: extra /memz sections registered by subsystems that own big standing
+#: allocations (e.g. the serving KV pool) — name -> zero-arg callable
+#: returning a JSON-able dict.  A section that raises is reported as an
+#: error string instead of killing the page.
+_MEMZ_SECTIONS: Dict[str, Callable[[], dict]] = {}
+
+
+def register_memz_section(name: str, fn: Callable[[], dict]) -> None:
+    """Attach a named section to the /memz payload (idempotent: the
+    latest registration under a name wins)."""
+    _MEMZ_SECTIONS[name] = fn
+
+
+def unregister_memz_section(name: str) -> None:
+    _MEMZ_SECTIONS.pop(name, None)
+
+
 def memz(topk: int = 20) -> dict:
     """The /memz payload: last memory report (per-category breakdown,
     top-K buffers with callstacks) + LIVE per-device allocator stats —
@@ -691,9 +708,15 @@ def memz(topk: int = 20) -> dict:
     except Exception:  # noqa: BLE001 — report pages never crash
         pass
     rep = last_report()
-    return {
+    out = {
         "enabled": bool(flag("FLAGS_mem_profile")),
         "budget_bytes": hbm_budget_bytes(),
         "devices": devices,
         "report": rep.to_json(topk) if rep is not None else None,
     }
+    for name, fn in list(_MEMZ_SECTIONS.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 — report pages never crash
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out

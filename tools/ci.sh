@@ -483,6 +483,16 @@ echo "== serving lane (admission/failover/drain/hedge drills) =="
 # in-flight, exit 0. Fast freeze/scheduler/fence units run in tier-1.
 python -m pytest tests/test_serving.py -q -m slow
 
+echo "== autoregressive overload drill (paged KV vs padded recompute) =="
+# ISSUE 16 acceptance: the SAME autoregressive burst (shared 64-token
+# system prompt + unique tails, iteration-level continuous batching)
+# against the paged-KV engine and the r19-style padded recompute
+# baseline — the paged path must do strictly less model work (position
+# counters: O(n) vs O(n^2)), serve strictly MORE tokens/s, and shed
+# STRICTLY no more requests. Fast parity/pool/prefix/eviction units
+# run in tier-1 above (tests/test_kv_serving.py)
+python -m pytest tests/test_kv_serving.py -q -m slow
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json

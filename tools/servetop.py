@@ -6,7 +6,11 @@ Scrapes each replica's `stats` verb over the PS RPC transport and
 renders the numbers an operator watches during an incident: QPS over
 the scrape window, shed rate, queue depth, p50/p99 request latency,
 micro-batch occupancy, and the weight epoch (is every replica serving
-the same model?).
+the same model?).  Replicas with a generation engine attached also get
+TOK/S (generated tokens per second), DEC/PRE (decode-vs-prefill
+position split — the O(n) health check: decode should track tokens,
+not explode quadratically), KVRES (KV page-pool residency) and PFXHIT
+(prefix-cache page hit rate).
 
 Examples:
 
@@ -46,6 +50,34 @@ def _fmt_ms(v) -> str:
     return "-" if v is None else f"{float(v):8.1f}"
 
 
+def _gen_columns(row: dict, prev_row: Optional[dict],
+                 window_s: Optional[float]) -> str:
+    """The generation-engine columns: tokens/s (windowed when two
+    scrapes exist, else the replica's cumulative rate), the
+    decode-vs-prefill position split, KV-pool residency and prefix
+    cache hit rate.  Replicas without an engine render dashes."""
+    g = row.get("generation")
+    if not g:
+        return f"{'-':>7} {'-':>11} {'-':>6} {'-':>6}"
+    toks = int(g.get("tokens_total", 0))
+    if prev_row is not None and window_s:
+        prev_toks = int(
+            (prev_row.get("generation") or {}).get("tokens_total", 0))
+        tok_s = f"{(toks - prev_toks) / window_s:7.1f}"
+    else:
+        tok_s = f"{float(g.get('tokens_per_s', 0.0)):7.1f}"
+    dec = int(g.get("decode_positions_total", 0))
+    pre = int(g.get("prefill_positions_total", 0))
+    rec = int(g.get("recompute_positions_total", 0))
+    split = f"{dec + rec}/{pre}"
+    kv = g.get("kv_pool") or {}
+    resid = (f"{100.0 * float(kv.get('residency', 0.0)):5.1f}%"
+             if kv else f"{'-':>6}")
+    hit = (f"{100.0 * float(kv.get('prefix_hit_rate', 0.0)):5.1f}%"
+           if kv else f"{'-':>6}")
+    return f"{tok_s} {split:>11} {resid:>6} {hit:>6}"
+
+
 def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
            window_s: Optional[float] = None) -> str:
     """One table line per replica. QPS needs two scrapes (prev +
@@ -53,6 +85,7 @@ def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
     out = []
     hdr = (f"{'ENDPOINT':22} {'QPS':>7} {'SERVED':>8} {'SHED':>7} "
            f"{'DEADLN':>7} {'QDEPTH':>6} {'P50MS':>8} {'P99MS':>8} "
+           f"{'TOK/S':>7} {'DEC/PRE':>11} {'KVRES':>6} {'PFXHIT':>6} "
            f"{'EPOCH':>6} {'DRAIN':>5}")
     out.append(hdr)
     for row in rows:
@@ -61,6 +94,7 @@ def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
             out.append(f"{ep:22} DOWN: {row['error']}")
             continue
         s = row.get("serving", {})
+        g = row.get("generation") or {}
         served = int(s.get("served_total", 0))
         qps = ""
         if prev is not None and window_s and ep in prev:
@@ -69,12 +103,21 @@ def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
             qps = f"{(served - prev_served) / window_s:7.1f}"
         else:
             qps = f"{'-':>7}"
+        shed = (int(s.get("shed_total", 0))
+                + int(g.get("shed_total", 0)))
+        ddl = (int(s.get("deadline_exceeded_total", 0))
+               + int(g.get("deadline_exceeded_total", 0)))
+        qdepth = (int(s.get("queue_depth", 0))
+                  + int(g.get("queue_depth", 0)))
+        gen_cols = _gen_columns(
+            row, prev.get(ep) if prev is not None else None, window_s)
         out.append(
             f"{ep:22} {qps} {served:8d} "
-            f"{int(s.get('shed_total', 0)):7d} "
-            f"{int(s.get('deadline_exceeded_total', 0)):7d} "
-            f"{int(s.get('queue_depth', 0)):6d} "
+            f"{shed:7d} "
+            f"{ddl:7d} "
+            f"{qdepth:6d} "
             f"{_fmt_ms(s.get('p50_ms'))} {_fmt_ms(s.get('p99_ms'))} "
+            f"{gen_cols} "
             f"{int(s.get('weight_epoch', 0)):6d} "
             f"{'yes' if s.get('draining') else 'no':>5}")
     return "\n".join(out)
