@@ -146,6 +146,46 @@ def _autotune_fields():
     }
 
 
+def _goodput_snapshot():
+    """Stash the goodput ledger's per-bucket totals before the timed
+    loop; None when PADDLE_GOODPUT is off (the default — rows stay
+    bit-identical to before)."""
+    try:
+        from paddle_tpu.telemetry import goodput
+
+        led = goodput.get_ledger()
+        if led is None:
+            return None
+        return dict(led.summary()["buckets_ms"])
+    except Exception:  # noqa: BLE001 — diagnostics must not fail the bench
+        return None
+
+
+def _goodput_fields(before):
+    """BENCH_r17+ rows join the goodput ledger (PADDLE_GOODPUT=1): the
+    per-bucket badput DELTA accrued over the timed loop plus the
+    job-lifetime goodput ratio, so a perf row names the stalls and
+    preemptions it absorbed instead of averaging them away silently.
+    {} when the ledger is off."""
+    if before is None:
+        return {}
+    try:
+        from paddle_tpu.telemetry import goodput
+
+        led = goodput.get_ledger()
+        if led is None:
+            return {}
+        summ = led.summary()
+        after = summ["buckets_ms"]
+        delta = {b: round(after.get(b, 0.0) - before.get(b, 0.0), 3)
+                 for b in after
+                 if after.get(b, 0.0) - before.get(b, 0.0) > 1e-9}
+        return {"goodput_delta_ms": delta,
+                "goodput_ratio": summ.get("goodput_ratio")}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def _memory_fields(exe, program, data, loss, hbm_model_bytes=None):
     """BENCH_r06+ rows record memory alongside MFU (ISSUE 11):
     `peak_hbm_bytes` — XLA's buffer-assignment peak for the compiled
@@ -236,6 +276,7 @@ def bench_resnet(depth=50):
         "image": jax.device_put(rng.rand(batch, 3, size, size).astype(np.float32)),
         "label": jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int64)),
     }
+    gp0 = _goodput_snapshot()
     dt, _ = _timed_run(exe, m, data, loss, steps)
     imgs_per_sec = batch * steps / dt
     formula_flops = resnet_step_flops(cfg, batch, size)
@@ -253,6 +294,7 @@ def bench_resnet(depth=50):
         "conv_bn_fusion": use_fusion,
         **_memory_fields(exe, m, data, loss),
         **_autotune_fields(),
+        **_goodput_fields(gp0),
         **_maybe_op_profile(exe, m, data, loss, formula_flops,
                             f"resnet{depth}"),
     })
@@ -300,6 +342,7 @@ def bench_transformer():
     exe.run(st)
     data = {k: jax.device_put(np.asarray(v))
             for k, v in random_nmt_batch(cfg, batch, src_len, trg_len).items()}
+    gp0 = _goodput_snapshot()
     dt, _ = _timed_run(exe, m, data, loss, steps)
     tokens_per_sec = batch * (src_len + trg_len) * steps / dt
     mfu = (transformer_step_flops(cfg, batch, src_len, trg_len) * steps / dt
@@ -315,6 +358,7 @@ def bench_transformer():
         "trg_len": trg_len,
         "steps": steps,
         "amp_bf16": use_amp,
+        **_goodput_fields(gp0),
     })
 
 
@@ -497,6 +541,7 @@ def _run_bert(batch, seq, max_preds, steps, use_amp):
               f"{round(0.95 * limit / 2**30, 2)} GiB budget; escalating",
               file=sys.stderr)
 
+    gp0 = _goodput_snapshot()
     dt, _ = _timed_run(exe, m, data, loss, steps)
     formula_flops = _bert_step_flops(cfg, batch, seq)
     mfu = formula_flops * steps / dt / _peak_flops_per_chip()
@@ -515,6 +560,7 @@ def _run_bert(batch, seq, max_preds, steps, use_amp):
         else _peak_hbm_gb(exe, m, data, loss),
         **mem_fields,
         **_autotune_fields(),
+        **_goodput_fields(gp0),
         **_maybe_op_profile(exe, m, data, loss, formula_flops, "bert"),
     }
 

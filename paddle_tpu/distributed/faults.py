@@ -31,7 +31,10 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     with PADDLE_PS_FAULT_TAGS to make ONE trainer's
                     verb deterministically late (the step-tracing
                     critical-path drill: the stalled rank must be the
-                    one the merged trace blames)
+                    one the merged trace blames). The <method> field
+                    may also name a stall_point phase ("gen_decode_
+                    step" in the serving decode loop) — phase names and
+                    RPC verbs never collide
             kill    server side: os._exit(1) the pserver process once it
                     has handled <nth> RPCs in total (method filter still
                     applies): exercises supervision + snapshot recovery
@@ -64,7 +67,11 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     "ckpt_before_global_commit" (every shard confirmed,
                     global manifest not yet written): exercises the
                     torn-checkpoint fallback and the sharded
-                    global-commit protocol in fluid/checkpoint.py
+                    global-commit protocol in fluid/checkpoint.py.
+                    Serving phase: "gen_decode_step" (between decode
+                    steps in the generation engine's loop) kills a
+                    replica mid-decode — the crash-tolerant-generation
+                    drill's deterministic mid-stream death
             bitflip phase side, DATA-corrupting: at the Nth arrival at a
                     named data phase (bitflip_point(phase, array) call
                     sites: "push_grad" in the PS client push path,
@@ -493,6 +500,15 @@ class FaultInjector:
             self._flight(f"crash:{phase}")
             os._exit(1)
 
+    def at_stall_phase(self, phase: str) -> None:
+        """REPEATING delay at a named code phase (stall_point call
+        sites) — the phase-site sibling of the client-RPC `stall`
+        action: every nth-th arrival sleeps <arg> milliseconds. Phase
+        names and RPC verbs never collide, so one spec can stall a verb
+        and a phase independently."""
+        for r in self._take_every(("stall",), phase):
+            time.sleep((r.arg or 0) / 1000.0)
+
 
 _injector: Optional[FaultInjector] = None
 _injector_lock = threading.Lock()
@@ -533,6 +549,17 @@ def crash_point(phase: str) -> None:
     inj = injector()
     if inj is not None:
         inj.at_phase(phase)
+
+
+def stall_point(phase: str) -> None:
+    """Deterministic mid-phase delay site: a REPEATING
+    `stall:<phase>:<nth>:<ms>` rule sleeps at every nth-th arrival at
+    this phase — e.g. "gen_decode_step" in the serving decode loop
+    slows one replica's generation without killing it. One flag read
+    when the layer is off."""
+    inj = injector()
+    if inj is not None:
+        inj.at_stall_phase(phase)
 
 
 def oom_point(phase: str) -> None:

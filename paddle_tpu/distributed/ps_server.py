@@ -1632,8 +1632,9 @@ class _Conn:
     make the replay apply-once. Application errors the server REPLIED
     with are never retried — the RPC itself succeeded."""
 
-    # verbs whose replay the server dedups via (trainer_id, step|seq)
-    _MARK_RETRY = ("push_gradients", "push_delta")
+    # verbs whose replay the server dedups: (trainer_id, step|seq) on
+    # the PS plane, request_id on the serving plane's generate
+    _MARK_RETRY = ("push_gradients", "push_delta", "generate")
 
     def __init__(self, endpoint: str, deadline: Optional[float] = None,
                  max_attempts: Optional[int] = None,

@@ -21,12 +21,26 @@ exact client the training data plane hardened). On top it adds:
               the client maps the explicit refusals onto typed errors
               (OverloadedError / DeadlineExceededError) instead of
               retrying a reply the server already made deliberately.
+  resume    — `generate`/`generate_stream` survive a mid-request
+              replica death (r22): every generation carries a client-
+              stamped request id, so a retry against the SAME replica
+              reattaches to the in-flight stream (server-side dedup,
+              exactly-once) and a retry against a PROMOTED replica
+              re-issues as a resume — original prompt plus the tokens
+              already delivered become the new prefill prefix, and the
+              elapsed wall time rides along so failover never resets
+              SLO accounting. Greedy decode is deterministic, so within
+              one weight epoch the resumed tail is bit-identical to the
+              uninterrupted run; a cross-epoch resume is REFUSED by the
+              server and surfaces as ResumedOnNewWeightsError with the
+              partial tokens attached.
 """
 from __future__ import annotations
 
 import os
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -56,6 +70,20 @@ class DeadlineExceededError(RuntimeError):
     """The request's deadline expired before the server could serve it."""
 
 
+class ResumedOnNewWeightsError(RuntimeError):
+    """A generation resume landed on a replica serving a different
+    weight epoch than the one that produced the already-delivered
+    tokens. Splicing the tail on silently would hand the caller a
+    sequence no single model ever produced, so the server refuses and
+    the client surfaces the refusal typed. `.tokens` carries the
+    partial output delivered before the cut — the caller decides
+    whether to keep it or regenerate from scratch on the new weights."""
+
+    def __init__(self, msg: str, tokens: Optional[List[int]] = None):
+        super().__init__(msg)
+        self.tokens: List[int] = list(tokens or [])
+
+
 class InferResult:
     __slots__ = ("outputs", "fetch_names", "weight_epoch", "replica",
                  "queue_ms")
@@ -73,6 +101,8 @@ class InferResult:
 
 def _map_app_error(e: RuntimeError) -> BaseException:
     msg = str(e)
+    if "ResumedOnNewWeights" in msg:
+        return ResumedOnNewWeightsError(msg)
     if "Overloaded" in msg:
         return OverloadedError(msg)
     if "DeadlineExceeded" in msg:
@@ -270,32 +300,87 @@ class InferenceClient:
         raise last_err
 
     class GenerateResult:
-        __slots__ = ("tokens", "weight_epoch", "ttft_ms", "replica")
+        __slots__ = ("tokens", "weight_epoch", "ttft_ms", "replica",
+                     "resumed_from")
 
         def __init__(self, reply: dict, replica: str):
             self.tokens = list(reply["tokens"])
             self.weight_epoch = int(reply.get("weight_epoch", 0))
             self.ttft_ms = reply.get("ttft_ms")
             self.replica = replica
+            # >0: the run was spliced — this many leading tokens came
+            # from a previous attempt (failover / preemption resume)
+            self.resumed_from = int(reply.get("resumed_from", 0) or 0)
 
-    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
-                 deadline_ms: Optional[float] = None,
-                 eos_id: Optional[int] = None) -> "GenerateResult":
-        """Blocking autoregressive generation on the primary replica.
-        Generation is NOT hedged: a duplicate run would burn KV pages
-        and decode slots on two replicas for one reply."""
+    @staticmethod
+    def _gen_kwargs(prompt, max_new_tokens, deadline_ms, eos_id,
+                    temperature, top_k, seed) -> dict:
         kwargs = {"prompt": [int(t) for t in prompt],
-                  "max_new_tokens": int(max_new_tokens)}
+                  "max_new_tokens": int(max_new_tokens),
+                  "request_id": uuid.uuid4().hex}
         if deadline_ms is not None:
             kwargs["deadline_ms"] = float(deadline_ms)
         if eos_id is not None:
             kwargs["eos_id"] = int(eos_id)
+        if temperature is not None:
+            kwargs["temperature"] = float(temperature)
+            if top_k is not None:
+                kwargs["top_k"] = int(top_k)
+            # Sampling without a caller seed: draw one HERE so a
+            # failover resume replays the exact token sequence — the
+            # seed must be fixed before the first attempt, not per
+            # replica.
+            kwargs["seed"] = (int.from_bytes(os.urandom(4), "little")
+                              if seed is None else int(seed))
+        elif seed is not None:
+            kwargs["seed"] = int(seed)
+        return kwargs
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 seed: Optional[int] = None) -> "GenerateResult":
+        """Blocking autoregressive generation on the primary replica.
+        Generation is NOT hedged: a duplicate run would burn KV pages
+        and decode slots on two replicas for one reply. Instead every
+        call is stamped with a request id: a transport-level retry
+        against the same replica reattaches to the in-flight request
+        (server dedup — the model never runs twice), and a dead replica
+        is failed over with the retry marker and elapsed time carried
+        so the promoted replica charges the full request age against
+        the deadline."""
+        kwargs = self._gen_kwargs(prompt, max_new_tokens, deadline_ms,
+                                  eos_id, temperature, top_k, seed)
         t0 = time.perf_counter()
+        abs_deadline = (None if deadline_ms is None
+                        else t0 + float(deadline_ms) / 1e3)
+        hops = 0
         try:
-            reply = self._call("generate", **kwargs)
-            with self._lock:
-                replica = self.endpoints[self._primary]
-            return self.GenerateResult(reply, replica)
+            while True:
+                with self._lock:
+                    j = self._primary
+                try:
+                    reply = self._conns[j].call("generate", **kwargs)
+                except ConnectionError:
+                    if hops >= len(self.endpoints):
+                        raise
+                    hops += 1
+                    self._failover(j)
+                    # re-issue as a marked retry: the promoted replica
+                    # sees the original arrival age, not a fresh clock
+                    kwargs["retry"] = True
+                    kwargs["elapsed_ms"] = (time.perf_counter() - t0) * 1e3
+                    if abs_deadline is not None:
+                        kwargs["deadline_ms"] = max(
+                            (abs_deadline - time.perf_counter()) * 1e3, 1.0)
+                    continue
+                except RuntimeError as e:
+                    raise _map_app_error(e) from None
+                with self._lock:
+                    replica = self.endpoints[self._primary]
+                return self.GenerateResult(reply, replica)
         finally:
             _REG.histogram(
                 "serve_client_generate_ms",
@@ -306,37 +391,89 @@ class InferenceClient:
                         max_new_tokens: int = 16,
                         deadline_ms: Optional[float] = None,
                         eos_id: Optional[int] = None,
-                        poll_s: float = 0.01):
+                        poll_s: float = 0.01,
+                        temperature: Optional[float] = None,
+                        top_k: Optional[int] = None,
+                        seed: Optional[int] = None):
         """Incremental generation: yields lists of new tokens as the
         replica's decode loop produces them.  The PS transport is
         one-shot request/reply, so streaming is poll-based: `generate`
         with stream=True returns a stream id, `generate_poll` drains it.
-        The stream is pinned to one replica (KV state is replica-local);
-        a mid-stream replica death surfaces as the connection error."""
-        kwargs = {"prompt": [int(t) for t in prompt],
-                  "max_new_tokens": int(max_new_tokens), "stream": True}
-        if deadline_ms is not None:
-            kwargs["deadline_ms"] = float(deadline_ms)
-        if eos_id is not None:
-            kwargs["eos_id"] = int(eos_id)
-        with self._lock:
-            j = self._primary
-        sid = self._conns[j].call("generate", **kwargs)["stream_id"]
-        cursor = 0
-        while True:
+        KV state is replica-local, so a mid-stream replica death cannot
+        be retried blindly — instead the stream RESUMES (r22): the dead
+        replica is failed over and the generation re-issued with the
+        tokens already delivered as the new prefill prefix, the elapsed
+        time carried for SLO accounting, and the weight epoch that
+        produced the delivered tokens pinned via `expect_epoch`. Within
+        one epoch the resumed tail is bit-identical (greedy decode is
+        deterministic; sampling is counter-mode keyed on (seed, index));
+        across an epoch boundary the server refuses and the caller gets
+        ResumedOnNewWeightsError with the partial tokens attached."""
+        base = self._gen_kwargs(prompt, max_new_tokens, deadline_ms,
+                                eos_id, temperature, top_k, seed)
+        base["stream"] = True
+        t0 = time.perf_counter()
+        abs_deadline = (None if deadline_ms is None
+                        else t0 + float(deadline_ms) / 1e3)
+        delivered: List[int] = []
+        last_epoch: Optional[int] = None
+        hops = 0
+        while True:  # one iteration per (re)attach
+            with self._lock:
+                j = self._primary
+            kwargs = dict(base)
+            if hops:
+                kwargs["retry"] = True
+                kwargs["elapsed_ms"] = (time.perf_counter() - t0) * 1e3
+                if abs_deadline is not None:
+                    kwargs["deadline_ms"] = max(
+                        (abs_deadline - time.perf_counter()) * 1e3, 1.0)
+                if delivered:
+                    kwargs["resume_tokens"] = list(delivered)
+                    if last_epoch is not None:
+                        kwargs["expect_epoch"] = int(last_epoch)
             try:
-                snap = self._conns[j].call("generate_poll",
-                                           stream_id=sid, cursor=cursor)
+                sid = self._conns[j].call("generate",
+                                          **kwargs)["stream_id"]
+                # dedup reattach and resume both pre-seed the stream
+                # with everything already delivered: skip past it
+                cursor = len(delivered)
+                while True:
+                    snap = self._conns[j].call("generate_poll",
+                                               stream_id=sid,
+                                               cursor=cursor)
+                    if snap["tokens"]:
+                        chunk = list(snap["tokens"])
+                        delivered.extend(chunk)
+                        yield chunk
+                    cursor = int(snap["cursor"])
+                    last_epoch = int(snap.get("weight_epoch") or 0)
+                    if snap["done"]:
+                        if snap.get("error"):
+                            err = _map_app_error(
+                                RuntimeError(snap["error"]))
+                            if isinstance(err, ResumedOnNewWeightsError):
+                                err.tokens = list(delivered)
+                            raise err
+                        return
+                    time.sleep(poll_s)
+            except ConnectionError:
+                if hops >= len(self.endpoints):
+                    raise
+                hops += 1
+                self._failover(j)
+                if delivered:
+                    _REG.counter(
+                        "serve_client_stream_resumes_total").inc()
+                continue
+            except (OverloadedError, DeadlineExceededError,
+                    ResumedOnNewWeightsError):
+                raise
             except RuntimeError as e:
-                raise _map_app_error(e) from None
-            if snap["tokens"]:
-                yield list(snap["tokens"])
-            cursor = int(snap["cursor"])
-            if snap["done"]:
-                if snap.get("error"):
-                    raise _map_app_error(RuntimeError(snap["error"]))
-                return
-            time.sleep(poll_s)
+                err = _map_app_error(e)
+                if isinstance(err, ResumedOnNewWeightsError):
+                    err.tokens = list(delivered)
+                raise err from None
 
     def model_info(self) -> dict:
         return self._call("model_info")

@@ -27,6 +27,29 @@ legal weight swap point is between decode steps, `Overloaded` /
 `DeadlineExceeded` reply strings cross the RPC boundary verbatim, and
 shed/expired wall-time is charged to the goodput ledger's serving
 badput buckets.
+
+Crash tolerance (r22, gated on ``PADDLE_SERVE_RESUME``, default on):
+
+* **resume admission** — `submit(resume_tokens=...)` re-admits a
+  generation whose prefix (prompt + tokens already delivered) was
+  computed elsewhere: the prefix prefills as one window (page-granular
+  prefix-cache reuse makes the replayed prompt cheap), the SLO clock is
+  backdated by ``elapsed_ms`` so failover never resets deadline
+  accounting, and ``expect_epoch`` refuses a cross-epoch splice with
+  the typed `ResumedOnNewWeights`.  Resumes queue ahead of fresh
+  admissions — degrade by shedding new work before abandoning old work.
+* **preemption ladder** — when a fresh request cannot be placed, the
+  active request with the MOST remaining work is preempted (pages
+  freed, tokens kept, same GenRequest requeued through the resume
+  path) instead of the queue head deadline-starving.  A victim is only
+  taken when it has strictly more remaining work than the incoming
+  request, and resumes themselves never preempt — both rules together
+  make the ladder livelock-free.  Preempt/resume wall-time latches
+  into the goodput ledger's `serve_preempt`/`serve_resume` buckets.
+* **sampling** — temperature/top-k ride the single `_emit` choke point
+  (host-side, from the logits every step already returns); the
+  per-request seed and the token INDEX feed a counter-mode PRNG, so a
+  resumed sampled generation replays bit-identically.
 """
 from __future__ import annotations
 
@@ -38,9 +61,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..distributed import faults as _faults
 from . import decode_model as dm
 from .kv_cache import PagedKVPool
-from .server import DeadlineExceeded, Overloaded
+from .server import (DeadlineExceeded, Overloaded, ResumedOnNewWeights,
+                     resume_enabled)
 
 ENV_KV_CACHE = "PADDLE_SERVE_KV_CACHE"
 ENV_MAX_SLOTS = "PADDLE_SERVE_MAX_SLOTS"
@@ -53,33 +78,74 @@ def kv_cache_enabled() -> bool:
     return os.environ.get(ENV_KV_CACHE, "1") not in ("0", "false", "off")
 
 
+def _sample_token(logits: np.ndarray, temperature: float,
+                  top_k: Optional[int], seed: int, index: int) -> int:
+    """Deterministic temperature/top-k sampling at token ``index``.
+
+    Counter-mode: the PRNG is keyed on (seed, index), never on call
+    order or engine state — the token at index i depends only on the
+    prefix (via logits) and the request seed, which is exactly what
+    makes a resumed/preempted sampled generation replay the same
+    tokens the uninterrupted run produced."""
+    scores = np.asarray(logits, np.float64) / max(float(temperature),
+                                                  1e-6)
+    if top_k and 0 < int(top_k) < scores.size:
+        kth = np.partition(scores, -int(top_k))[-int(top_k)]
+        scores = np.where(scores >= kth, scores, -np.inf)
+    scores -= scores.max()
+    probs = np.exp(scores)
+    probs /= probs.sum()
+    rng = np.random.default_rng(
+        [int(seed) & 0xFFFFFFFF, int(index) & 0xFFFFFFFF])
+    return int(rng.choice(scores.size, p=probs))
+
+
 class GenRequest:
     """One admitted generation request."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline_t",
                  "event", "tokens", "error", "weight_epoch", "t_admit",
                  "pages", "reuse", "pos", "cur_token", "slot",
-                 "rc_tokens", "rc_len", "t_first_token")
+                 "rc_tokens", "rc_len", "t_first_token",
+                 "temperature", "top_k", "seed", "resumed_from",
+                 "expect_epoch", "is_resume", "t_preempt", "preempts")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
-                 eos_id: Optional[int], deadline_t: Optional[float]):
+                 eos_id: Optional[int], deadline_t: Optional[float],
+                 resume_tokens: Optional[List[int]] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 seed: Optional[int] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.deadline_t = deadline_t
         self.event = threading.Event()
-        self.tokens: List[int] = []       # generated tokens (appended)
+        # generated tokens (appended). A resume pre-seeds the tokens
+        # another replica already delivered — they are part of the
+        # prefill prefix, never re-counted as new output.
+        self.tokens: List[int] = list(resume_tokens or [])
+        self.resumed_from = len(self.tokens)
         self.error: Optional[BaseException] = None
         self.weight_epoch = 0
         self.t_admit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.pages: List[int] = []        # paged mode: physical pages
-        self.reuse = 0                    # prompt tokens from prefix cache
+        self.reuse = 0                    # prefix tokens from prefix cache
         self.pos = 0                      # abs position of cur_token
         self.cur_token = 0
         self.slot: Optional[int] = None
         self.rc_tokens: Optional[np.ndarray] = None  # recompute mode
         self.rc_len = 0
+        # sampling (None temperature => greedy argmax on device)
+        self.temperature = (float(temperature)
+                            if temperature else None)
+        self.top_k = int(top_k) if top_k else None
+        self.seed = int(seed) if seed is not None else 0
+        self.expect_epoch: Optional[int] = None
+        self.is_resume = resume_tokens is not None
+        self.t_preempt: Optional[float] = None
+        self.preempts = 0
 
     def snapshot(self, cursor: int = 0) -> dict:
         """Streaming poll: tokens generated past ``cursor`` + liveness.
@@ -126,6 +192,11 @@ class GenerationEngine:
             self.page_size = self.pool.page_size
             self.maxp = -(-self.max_seq // self.page_size)
         self._q: deque = deque()
+        # resumes (failover re-admissions + preemption victims) queue
+        # separately and admit FIRST: shed new work before abandoning
+        # old work
+        self._rq: deque = deque()
+        self.resume_on = resume_enabled()
         self._slots: List[Optional[GenRequest]] = [None] * self.max_slots
         self._cond = threading.Condition()
         self._draining = False
@@ -142,6 +213,11 @@ class GenerationEngine:
             "tokens_out": 0,
             "decode_steps": 0,
             "served": 0, "shed": 0, "deadline_exceeded": 0, "evicted": 0,
+            # preemption ladder: positions freed at preemption must be
+            # matched 1:1 by positions restored at resume prefill — the
+            # exact-token-accounting proof the drills assert
+            "preempted": 0, "resumed": 0,
+            "preempt_positions": 0, "resume_positions": 0,
         }
         self._t_start = time.monotonic()
         self._step_ewma_s: Optional[float] = None
@@ -156,7 +232,13 @@ class GenerationEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
-               eos_id: Optional[int] = None) -> GenRequest:
+               eos_id: Optional[int] = None,
+               resume_tokens: Optional[Sequence[int]] = None,
+               elapsed_ms: Optional[float] = None,
+               expect_epoch: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               seed: Optional[int] = None) -> GenRequest:
         prompt = [int(t) for t in prompt]
         if not prompt or len(prompt) >= self.max_seq:
             raise ValueError(
@@ -164,17 +246,45 @@ class GenerationEngine:
                 f"(got {len(prompt)})")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if resume_tokens is not None and not self.resume_on:
+            raise ValueError("generation resume is disabled "
+                             "(PADDLE_SERVE_RESUME=0)")
+        if expect_epoch is not None and int(expect_epoch) \
+                != self.weight_epoch:
+            raise ResumedOnNewWeights(
+                f"ResumedOnNewWeights: resume expected weight epoch "
+                f"{int(expect_epoch)}, this replica serves epoch "
+                f"{self.weight_epoch}")
+        resume_tokens = ([int(t) for t in resume_tokens]
+                         if resume_tokens is not None else None)
         deadline_t = (time.monotonic() + float(deadline_ms) / 1e3
                       if deadline_ms else None)
         req = GenRequest(prompt, int(max_new_tokens),
                          self.eos_id if eos_id is None else int(eos_id),
-                         deadline_t)
+                         deadline_t, resume_tokens=resume_tokens,
+                         temperature=temperature, top_k=top_k, seed=seed)
+        if elapsed_ms:
+            # carry the ORIGINAL arrival time across a failover: SLO
+            # accounting (request latency, badput charges) never resets
+            req.t_admit -= float(elapsed_ms) / 1e3
+        req.expect_epoch = (int(expect_epoch)
+                            if expect_epoch is not None else None)
+        if req.is_resume and (
+                len(req.tokens) >= req.max_new_tokens
+                or len(prompt) + len(req.tokens) >= self.max_seq
+                or (req.eos_id is not None and req.tokens
+                    and req.tokens[-1] == req.eos_id)):
+            # everything was already delivered — only the done marker
+            # was lost; finish without touching the model
+            self._finish(req, outcome="served")
+            return req
+        q = self._rq if req.is_resume else self._q
         with self._cond:
             if self._draining or self._stopped:
                 self._shed(req, "Overloaded: server is draining")
-            if len(self._q) >= self.queue_limit:
+            if len(q) >= self.queue_limit:
                 self._shed(req, f"Overloaded: admission queue full "
-                                f"({len(self._q)}/{self.queue_limit})")
+                                f"({len(q)}/{self.queue_limit})")
             if self.pool is not None:
                 need = self._pages_needed(req)
                 if need > self.pool.capacity:
@@ -189,7 +299,7 @@ class GenerationEngine:
                     self._shed(req, f"Overloaded: kv pool full ({need} "
                                     f"pages needed, "
                                     f"{self.pool.available()} available)")
-            self._q.append(req)
+            q.append(req)
             self._gauge("serve_gen_queue_depth").set(len(self._q))
             self._cond.notify_all()
         return req
@@ -239,15 +349,25 @@ class GenerationEngine:
         self.weight_epoch += 1
         self._reg.gauge("serve_weight_epoch").set(self.weight_epoch)
         self._reg.counter("serve_weight_fences_total").inc()
+        # every live request's tail now decodes under the new epoch —
+        # stream snapshots carry it so a client resuming elsewhere can
+        # state which epoch its expectation belongs to
+        with self._cond:
+            live = ([r for r in self._slots if r is not None]
+                    + list(self._q) + list(self._rq))
+        for r in live:
+            r.weight_epoch = self.weight_epoch
 
     # -- the decode loop -------------------------------------------------
 
     def _loop(self) -> None:
         while True:
             with self._cond:
-                if self._stopped and not self._q and not any(self._slots):
+                if self._stopped and not self._q and not self._rq \
+                        and not any(self._slots):
                     return
-                if not self._q and not any(self._slots) \
+                if not self._q and not self._rq \
+                        and not any(self._slots) \
                         and self._pending_weights is None:
                     self._cond.wait(0.05)
             try:
@@ -255,7 +375,7 @@ class GenerationEngine:
                 self._expire_and_admit()
                 if any(s is not None for s in self._slots):
                     self._step()
-                elif self._q:
+                elif self._q or self._rq:
                     # queued work that can't start yet (pool/slots):
                     # don't spin
                     time.sleep(0.001)
@@ -278,13 +398,21 @@ class GenerationEngine:
                     outcome="deadline_exceeded")
                 self._slots[i] = None
                 self.counters["evicted"] += 1
-        with self._cond:
-            queued = list(self._q)
-        for req in queued:
+        # resumes first (old work beats fresh admissions for pages),
+        # and they never preempt — freed pages flow to them by priority
+        self._admit_from(self._rq, now, allow_preempt=False)
+        self._admit_from(self._q, now,
+                         allow_preempt=self.resume_on)
+        self._gauge("serve_gen_queue_depth").set(len(self._q))
+        self._gauge("serve_gen_resume_queue_depth").set(len(self._rq))
+
+    def _admit_from(self, q: deque, now: float,
+                    allow_preempt: bool) -> None:
+        for req in list(q):
             if req.deadline_t is not None and now >= req.deadline_t:
                 with self._cond:
                     try:
-                        self._q.remove(req)
+                        q.remove(req)
                     except ValueError:
                         continue
                 self._finish(req, error=DeadlineExceeded(
@@ -296,20 +424,87 @@ class GenerationEngine:
             if slot is None:
                 break
             if not self._try_admit(req, slot):
-                break  # pool can't fit it yet; keep FIFO order
-        self._gauge("serve_gen_queue_depth").set(len(self._q))
+                # pool can't fit it: climb the preemption ladder once
+                # (fresh queue only), else keep FIFO order and wait
+                if not (allow_preempt and self._preempt_for(req)
+                        and self._try_admit(req, slot)):
+                    break
+
+    # -- preemption ladder (PADDLE_SERVE_RESUME gate) --------------------
+
+    def _preempt_for(self, incoming: GenRequest) -> bool:
+        """Free pages for ``incoming`` by preempting the active request
+        with the MOST remaining work — but only when it has strictly
+        more left than the incoming request (shorter job first), so the
+        preempted request can never bounce straight back and evict its
+        evictor: remaining work strictly decreases down the ladder."""
+        if self.pool is None or not self.resume_on:
+            return False
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            return False
+
+        def remaining(r: GenRequest) -> int:
+            return r.max_new_tokens - len(r.tokens)
+
+        victim = max(active, key=remaining)
+        if remaining(victim) <= remaining(incoming):
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, victim: GenRequest) -> None:
+        """Evict ``victim`` mid-decode WITHOUT failing it: pages return
+        to the pool (prompt pages usually park in the prefix cache, so
+        the re-prefill is bounded, not a restart), tokens-so-far stay
+        on the request, and the same GenRequest object requeues through
+        the resume path — waiters and stream pollers never notice."""
+        slot = victim.slot
+        self.counters["preempted"] += 1
+        self.counters["preempt_positions"] += (
+            len(victim.prompt) + len(victim.tokens))
+        self._reg.counter(
+            "serve_gen_preempted_total",
+            help="active generations preempted for KV pressure").inc()
+        if victim.pages:
+            self.pool.free(victim.pages)
+            victim.pages = []
+        victim.reuse = 0
+        victim.slot = None
+        victim.is_resume = True
+        victim.t_preempt = time.monotonic()
+        victim.preempts += 1
+        self._slots[slot] = None
+        with self._cond:
+            self._rq.append(victim)
 
     def _try_admit(self, req: GenRequest, slot: int) -> bool:
+        if req.expect_epoch is not None \
+                and req.expect_epoch != self.weight_epoch:
+            # a weight fence installed between submit and admission:
+            # refuse the cross-epoch splice before any prefill runs
+            self._dequeue(req)
+            self._finish(req, error=ResumedOnNewWeights(
+                f"ResumedOnNewWeights: resume expected weight epoch "
+                f"{req.expect_epoch}, this replica serves epoch "
+                f"{self.weight_epoch}"), outcome="error")
+            return True
+        req.weight_epoch = self.weight_epoch
+        # resume prefix: the prompt plus whatever tokens were already
+        # delivered (empty for fresh requests — prefix == prompt)
+        prefix = req.prompt + req.tokens
         if self.pool is None:
+            if req.is_resume:
+                self._note_resume(req, len(prefix))
             self._admit_recompute(req, slot)
         else:
             matched, covered = ([], 0)
             if self.prefix_cache:
-                matched, covered = self.pool.match_prefix(req.prompt)
-            # whole-page reuse only, and at least one prompt token must
+                matched, covered = self.pool.match_prefix(prefix)
+            # whole-page reuse only, and at least one prefix token must
             # be computed so prefill has logits to sample from
             reuse_pages = min(len(matched),
-                              (len(req.prompt) - 1) // self.page_size)
+                              (len(prefix) - 1) // self.page_size)
             if reuse_pages < len(matched):
                 self.pool.free(matched[reuse_pages:])
                 matched = matched[:reuse_pages]
@@ -322,17 +517,37 @@ class GenerationEngine:
                 return False
             req.pages = matched + fresh
             req.reuse = reuse
+            if req.is_resume:
+                self._note_resume(req, len(prefix))
             self._prefill_paged(req, slot)
-        with self._cond:
-            try:
-                self._q.remove(req)
-            except ValueError:
-                pass
+        self._dequeue(req)
+        req.is_resume = False
         self._slots[slot] = req
         req.slot = slot
         if req.event.is_set():  # finished during prefill (eos/max_new)
             self._slots[slot] = None
         return True
+
+    def _dequeue(self, req: GenRequest) -> None:
+        with self._cond:
+            for q in (self._q, self._rq):
+                try:
+                    q.remove(req)
+                except ValueError:
+                    pass
+
+    def _note_resume(self, req: GenRequest, prefix_len: int) -> None:
+        self.counters["resumed"] += 1
+        self.counters["resume_positions"] += prefix_len
+        self._reg.counter(
+            "serve_gen_resumed_total",
+            help="generations re-admitted from a supplied prefix "
+                 "(failover resumes + preemption victims)").inc()
+        if req.t_preempt is not None:
+            # off-device wall time between preemption and re-admission
+            self._badput_ms((time.monotonic() - req.t_preempt) * 1e3,
+                            "preempt")
+            req.t_preempt = None
 
     # -- paged mode ------------------------------------------------------
 
@@ -345,10 +560,15 @@ class GenerationEngine:
         import jax.numpy as jnp
 
         pool, psz = self.pool, self.page_size
-        n_valid = len(req.prompt) - req.reuse
+        # the prefill prefix is prompt + already-delivered tokens — for
+        # fresh requests that's just the prompt; for resumes the
+        # delivered tail rides the same window (and the prompt's pages
+        # usually come back from the prefix cache)
+        prefix = req.prompt + req.tokens
+        n_valid = len(prefix) - req.reuse
         r = min(dm.prefill_bucket(n_valid), self.max_seq)
         window = np.zeros(r, np.int32)
-        window[:n_valid] = req.prompt[req.reuse:]
+        window[:n_valid] = prefix[req.reuse:]
         ctx_k, ctx_v = dm.gather_ctx(pool.k, pool.v,
                                      jnp.asarray(self._table_row(req)),
                                      page_size=psz)
@@ -363,15 +583,18 @@ class GenerationEngine:
             flat[i] = req.pages[p_abs // psz] * psz + p_abs % psz
         pool.set_arrays(*dm.scatter_kv(pool.k, pool.v, k_win, v_win,
                                        jnp.asarray(flat)))
-        self._observe_ms("serve_prefill_ms", t0)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._observe_ms("serve_prefill_ms", None, ms=ms)
+        if req.is_resume:
+            # the bounded extra prefill a preemption/failover costs
+            self._badput_ms(ms, "resume")
         if self.prefix_cache:
-            pool.register_prefix(req.prompt,
-                                 req.pages[:len(req.prompt) // psz])
+            pool.register_prefix(prefix, req.pages[:len(prefix) // psz])
         self.counters["prefill_positions"] += n_valid
         self.counters["cached_positions"] += req.reuse
         self._tok_counter("prefill").inc(n_valid)
-        req.pos = len(req.prompt)
-        self._emit(req, int(tok))
+        req.pos = len(prefix)
+        self._emit(req, int(tok), logits_row=logits)
 
     def _step_paged(self, active: List[GenRequest]) -> None:
         import jax.numpy as jnp
@@ -403,20 +626,28 @@ class GenerationEngine:
             n_heads=self.model.cfg.n_heads)
         pool.set_arrays(k, v)
         nxt = np.asarray(nxt)
+        logits_np = (np.asarray(logits)
+                     if any(r.temperature for r in active) else None)
         self._observe_ms("serve_decode_step_ms", t0)
         self.counters["decode_steps"] += 1
         self.counters["decode_positions"] += len(active)
         self._tok_counter("decode").inc(len(active))
         for r in active:
             r.pos += 1
-            self._emit(r, int(nxt[r.slot]))
+            self._emit(r, int(nxt[r.slot]),
+                       logits_row=(None if logits_np is None
+                                   else logits_np[r.slot]))
 
     # -- recompute baseline (PADDLE_SERVE_KV_CACHE=0) --------------------
 
     def _admit_recompute(self, req: GenRequest, slot: int) -> None:
+        # resume prefix rides the dense buffer too: delivered tokens
+        # re-enter as context, the next decode step emits token
+        # len(req.tokens) — same replay contract as the paged path
+        seq = req.prompt + req.tokens
         req.rc_tokens = np.zeros(self.max_seq, np.int32)
-        req.rc_tokens[:len(req.prompt)] = req.prompt
-        req.rc_len = len(req.prompt)
+        req.rc_tokens[:len(seq)] = seq
+        req.rc_len = len(seq)
 
     def _step_recompute(self, active: List[GenRequest]) -> None:
         import jax.numpy as jnp
@@ -432,6 +663,8 @@ class GenerationEngine:
             self.model.params, jnp.asarray(tokens),
             jnp.asarray(lengths), n_heads=self.model.cfg.n_heads)
         nxt = np.asarray(nxt)
+        logits_np = (np.asarray(logits)
+                     if any(r.temperature for r in active) else None)
         self._observe_ms("serve_decode_step_ms", t0)
         self.counters["decode_steps"] += 1
         # the whole live prefix was re-run for ONE new token per slot —
@@ -440,7 +673,9 @@ class GenerationEngine:
             sum(r.rc_len for r in active))
         self._tok_counter("decode").inc(len(active))
         for r in active:
-            tok = int(nxt[r.slot])
+            tok = self._choose_token(
+                r, int(nxt[r.slot]),
+                None if logits_np is None else logits_np[r.slot])
             if r.rc_len < self.max_seq:
                 r.rc_tokens[r.rc_len] = tok
             r.rc_len += 1
@@ -452,6 +687,12 @@ class GenerationEngine:
         active = [r for r in self._slots if r is not None]
         if not active:
             return
+        # deterministic chaos sites: `stall:gen_decode_step:N:MS` delays
+        # and `crash:gen_decode_step:N` kills this replica mid-decode —
+        # the chaos drill's proof that in-flight generations survive a
+        # replica death at the worst possible moment
+        _faults.stall_point("gen_decode_step")
+        _faults.crash_point("gen_decode_step")
         if self.pool is not None:
             self._step_paged(active)
         else:
@@ -462,8 +703,19 @@ class GenerationEngine:
         if self.pool is not None:
             self.pool.publish_gauges()
 
-    def _emit(self, req: GenRequest, tok: int) -> None:
+    def _choose_token(self, req: GenRequest, argmax_tok: int,
+                      logits_row) -> int:
+        """THE sampling choke point: greedy requests keep the device
+        argmax untouched (bit-identical to r21); sampled requests draw
+        from the same logits with the (seed, index) counter PRNG."""
+        if not req.temperature or logits_row is None:
+            return argmax_tok
+        return _sample_token(logits_row, req.temperature, req.top_k,
+                             req.seed, len(req.tokens))
+
+    def _emit(self, req: GenRequest, tok: int, logits_row=None) -> None:
         """Append one generated token; retire on eos/max_new/capacity."""
+        tok = self._choose_token(req, tok, logits_row)
         if req.t_first_token is None:
             req.t_first_token = time.monotonic()
         req.tokens.append(tok)
@@ -514,6 +766,7 @@ class GenerationEngine:
             "weight_epoch": req.weight_epoch,
             "ttft_ms": (None if req.t_first_token is None else round(
                 (req.t_first_token - req.t_admit) * 1e3, 3)),
+            "resumed_from": req.resumed_from,
         }
 
     # -- lifecycle / observability ---------------------------------------
@@ -524,10 +777,11 @@ class GenerationEngine:
             self._cond.notify_all()
         deadline = time.monotonic() + timeout
         with self._cond:
-            while (self._q or any(s is not None for s in self._slots)) \
+            while (self._q or self._rq
+                   or any(s is not None for s in self._slots)) \
                     and time.monotonic() < deadline:
                 self._cond.wait(0.1)
-            return not self._q and not any(
+            return not self._q and not self._rq and not any(
                 s is not None for s in self._slots)
 
     def stop(self) -> None:
@@ -558,6 +812,12 @@ class GenerationEngine:
             "shed_total": c["shed"],
             "deadline_exceeded_total": c["deadline_exceeded"],
             "evicted_total": c["evicted"],
+            "preempted_total": c["preempted"],
+            "resumed_total": c["resumed"],
+            "preempt_positions_total": c["preempt_positions"],
+            "resume_positions_total": c["resume_positions"],
+            "resume_queue_depth": len(self._rq),
+            "resume_enabled": self.resume_on,
             "step_ewma_ms": (None if self._step_ewma_s is None
                              else round(self._step_ewma_s * 1e3, 3)),
         }
@@ -593,10 +853,12 @@ class GenerationEngine:
         self._reg.histogram(name, buckets=_SERVE_BUCKETS).observe(ms)
 
     def _badput(self, req: GenRequest, cause: str) -> None:
+        self._badput_ms((time.monotonic() - req.t_admit) * 1e3, cause)
+
+    def _badput_ms(self, ms: float, cause: str) -> None:
         try:
             from ..telemetry import goodput as _goodput
 
-            _goodput.note_serving_badput(
-                (time.monotonic() - req.t_admit) * 1e3, cause=cause)
+            _goodput.note_serving_badput(ms, cause=cause)
         except Exception:  # noqa: BLE001 — telemetry is best-effort
             pass

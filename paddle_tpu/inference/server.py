@@ -49,7 +49,7 @@ import signal
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -93,6 +93,28 @@ class Overloaded(RuntimeError):
 
 class DeadlineExceeded(RuntimeError):
     """The request's deadline expired before its batch ran."""
+
+
+class ResumedOnNewWeights(RuntimeError):
+    """A generation resume landed on a replica serving a different
+    weight epoch than the one the already-delivered tokens came from.
+    Splicing two models' tokens would be silent corruption; the client
+    gets this typed refusal (string crosses the wire verbatim) and
+    decides — retry from scratch, or surface the partial output."""
+
+
+# r22 crash-tolerant generation: PADDLE_SERVE_RESUME=0 disables the
+# resume/preempt/dedup machinery entirely — the engine sheds instead of
+# preempting and finished streams/replies are dropped on delivery,
+# byte-identical to the r21 behavior.
+ENV_RESUME = "PADDLE_SERVE_RESUME"
+# bound on the exactly-once dedup table and the retained finished
+# streams (oldest entries evicted first)
+DEDUP_MAX = int(os.environ.get("PADDLE_SERVE_DEDUP_MAX", 512))
+
+
+def resume_enabled() -> bool:
+    return os.environ.get(ENV_RESUME, "1") not in ("0", "false", "off")
 
 
 class _Pending:
@@ -425,6 +447,16 @@ class InferenceServer:
         self._streams: Dict[str, object] = {}
         self._streams_lock = threading.Lock()
         self._stream_seq = 0
+        # exactly-once generate (r22): request_id -> {req, stream_id,
+        # reply}. A marked-retry generate with a known id reattaches to
+        # the in-flight GenRequest or replays the finished reply — the
+        # model never runs twice for one id. Bounded LRU (DEDUP_MAX);
+        # the same bound retains finished streams so a retried
+        # generate_poll after an ambiguous failure replays the final
+        # snapshot instead of "unknown stream".
+        self._dedup: "OrderedDict[str, dict]" = OrderedDict()
+        self._done_streams: "OrderedDict[str, object]" = OrderedDict()
+        self._resume_on = resume_enabled()
         self.shutdown_event = threading.Event()  # _Handler contract
         self.started_at = time.time()
         self.subscriber = None
@@ -460,35 +492,93 @@ class InferenceServer:
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline_ms: Optional[float] = None,
                  eos_id: Optional[int] = None,
-                 stream: bool = False) -> dict:
+                 stream: bool = False,
+                 request_id: Optional[str] = None,
+                 retry: bool = False,
+                 resume_tokens: Optional[list] = None,
+                 elapsed_ms: Optional[float] = None,
+                 expect_epoch: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 seed: Optional[int] = None) -> dict:
         """Autoregressive generation (requires an attached engine).
 
         Blocking form returns the full token list; ``stream=True``
         returns a ``stream_id`` the client polls with `generate_poll`
         for incremental tokens (the PS RPC transport is one-shot
-        request/reply, so streaming is poll-based)."""
+        request/reply, so streaming is poll-based).
+
+        Exactly-once (r22): ``request_id`` + the transport's ``retry``
+        marker form the same dedup contract the PS data plane uses for
+        (trainer_id, step) — a marked retry whose id is already known
+        reattaches to the in-flight request or replays the finished
+        reply; the model never runs twice.  ``resume_tokens`` +
+        ``elapsed_ms`` + ``expect_epoch`` are the failover-resume state:
+        tokens already delivered become the new prefill prefix, the SLO
+        clock is backdated by elapsed_ms, and an epoch mismatch is
+        refused with the typed ResumedOnNewWeights string."""
         if self.engine is None:
             raise ValueError("generation is not enabled on this replica "
                              "(no decoder engine attached)")
+        rid = str(request_id) if request_id else None
+        if rid and retry and self._resume_on:
+            with self._streams_lock:
+                ent = self._dedup.get(rid)
+            if ent is not None:
+                _REG.counter(
+                    "serve_gen_dedup_hits_total",
+                    help="marked-retry generates that reattached or "
+                         "replayed instead of running twice").inc()
+                if ent.get("stream_id") is not None:
+                    return {"stream_id": ent["stream_id"]}
+                if ent.get("reply") is not None:
+                    return ent["reply"]
+                reply = self.engine.result(ent["req"])
+                ent["reply"] = reply
+                return reply
         req = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
-                                 deadline_ms=deadline_ms, eos_id=eos_id)
+                                 deadline_ms=deadline_ms, eos_id=eos_id,
+                                 resume_tokens=resume_tokens,
+                                 elapsed_ms=elapsed_ms,
+                                 expect_epoch=expect_epoch,
+                                 temperature=temperature, top_k=top_k,
+                                 seed=seed)
+        ent = None
+        if rid and self._resume_on:
+            ent = {"req": req, "stream_id": None, "reply": None}
+            with self._streams_lock:
+                self._dedup[rid] = ent
+                while len(self._dedup) > DEDUP_MAX:
+                    self._dedup.popitem(last=False)
         if stream:
             with self._streams_lock:
                 self._stream_seq += 1
                 sid = f"g{self._stream_seq}"
                 self._streams[sid] = req
+                if ent is not None:
+                    ent["stream_id"] = sid
             return {"stream_id": sid}
-        return self.engine.result(req)
+        reply = self.engine.result(req)
+        if ent is not None:
+            ent["reply"] = reply
+        return reply
 
     def generate_poll(self, stream_id: str, cursor: int = 0) -> dict:
         with self._streams_lock:
-            req = self._streams.get(stream_id)
+            req = (self._streams.get(stream_id)
+                   or self._done_streams.get(stream_id))
         if req is None:
             raise ValueError(f"unknown stream {stream_id!r}")
         snap = req.snapshot(int(cursor))
         if snap["done"]:
             with self._streams_lock:
-                self._streams.pop(stream_id, None)
+                live = self._streams.pop(stream_id, None)
+                if live is not None and self._resume_on:
+                    # retain (bounded) so a retried poll after an
+                    # ambiguous failure replays the final snapshot
+                    self._done_streams[stream_id] = live
+                    while len(self._done_streams) > DEDUP_MAX:
+                        self._done_streams.popitem(last=False)
         return snap
 
     def health(self) -> dict:
@@ -526,6 +616,13 @@ class InferenceServer:
             # fault rules (slow/kill/partition) apply to serving verbs
             # too — the slow-tail hedge drill and kill drills ride this
             inj.on_server_call(method)
+        if kwargs.get("retry"):
+            # transport marked this as a retry whose first attempt may
+            # have landed (the PS _MARK_RETRY contract) — counted so
+            # drills can prove the dedup table saw the replay
+            _REG.counter("serve_retry_received_total",
+                         help="RPCs carrying the ambiguous-retry marker",
+                         verb=method).inc()
         if method == "ping":
             return "pong"
         if method == "infer":
@@ -536,7 +633,15 @@ class InferenceServer:
                 max_new_tokens=int(kwargs.get("max_new_tokens", 16)),
                 deadline_ms=kwargs.get("deadline_ms"),
                 eos_id=kwargs.get("eos_id"),
-                stream=bool(kwargs.get("stream", False)))
+                stream=bool(kwargs.get("stream", False)),
+                request_id=kwargs.get("request_id"),
+                retry=bool(kwargs.get("retry", False)),
+                resume_tokens=kwargs.get("resume_tokens"),
+                elapsed_ms=kwargs.get("elapsed_ms"),
+                expect_epoch=kwargs.get("expect_epoch"),
+                temperature=kwargs.get("temperature"),
+                top_k=kwargs.get("top_k"),
+                seed=kwargs.get("seed"))
         if method == "generate_poll":
             return self.generate_poll(kwargs["stream_id"],
                                       int(kwargs.get("cursor", 0)))

@@ -89,6 +89,10 @@ BUCKETS = (
     # /fleetz attribute serving badput exactly like training badput.
     "serve_shed",
     "serve_deadline",
+    # r22 preemption ladder: time a preempted generation spent off the
+    # device waiting to re-admit, and the extra prefill the resume cost
+    "serve_preempt",
+    "serve_resume",
 )
 
 # wall time of module import: recorded in the birth row so the stitcher
@@ -294,9 +298,15 @@ class GoodputLedger:
     def note_serving_badput(self, ms: float, cause: str,
                             now: Optional[float] = None) -> None:
         """Serving-side SLO badput: wall-clock a request spent in the
-        replica before being shed at admission (`cause="shed"`) or
-        expiring mid-decode (`cause="deadline"`)."""
-        bucket = "serve_deadline" if cause == "deadline" else "serve_shed"
+        replica before being shed at admission (`cause="shed"`),
+        expiring mid-decode (`cause="deadline"`), waiting off-device
+        after a KV-pressure preemption (`cause="preempt"`), or
+        re-prefilling a resumed prefix (`cause="resume"`)."""
+        bucket = {
+            "deadline": "serve_deadline",
+            "preempt": "serve_preempt",
+            "resume": "serve_resume",
+        }.get(cause, "serve_shed")
         self._commit_window({bucket: float(ms)}, now=now,
                             event="serve_badput", cause=cause)
 

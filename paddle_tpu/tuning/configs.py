@@ -22,6 +22,10 @@ from . import feasible
 _FLASH_BLOCKS = (1024, 512, 256, 128)
 _LN_ROWS = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 _CONV_ROWS = (2048, 1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+# paged-attention page sizes: fewer grid steps (large pages) first; the
+# tuned page doubles as the KV pool page granularity, so small pages
+# trade kernel overhead for finer pool packing
+_PAGED_PAGES = (64, 32, 16, 8)
 
 Rejects = List[Tuple[Dict[str, Any], str]]
 
@@ -79,6 +83,24 @@ def conv_bn_candidates(kind: str, r: int, width: int,
     for rows in _CONV_ROWS:
         cfg = {"block_rows": rows}
         feas, why = feasible.conv_bn_rows_ok(r, width, rows, unit)
+        (ok if feas else rejects).append(cfg if feas else (cfg, why))
+    return ok, rejects
+
+
+def paged_attention_candidates(kv_heads: int, head_dim: int,
+                               dtype: str = "float32", max_seq: int = 0,
+                               ) -> Tuple[List[Dict[str, Any]], Rejects]:
+    """Page-size axis for the serving paged-attention kernel. One page
+    of KV streams through VMEM per grid step, so the page size is the
+    kernel's block size AND the pool's allocation granularity —
+    kv_cache.from_budget consults the tuned winner when no explicit
+    page size is configured."""
+    ok: List[Dict[str, Any]] = []
+    rejects: Rejects = []
+    for page in _PAGED_PAGES:
+        cfg = {"page_size": page}
+        feas, why = feasible.paged_page_ok(page, kv_heads, head_dim,
+                                           dtype, max_seq)
         (ok if feas else rejects).append(cfg if feas else (cfg, why))
     return ok, rejects
 

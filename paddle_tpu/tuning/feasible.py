@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Tuple
 BSH_VMEM_LIMIT = 112 * 1024 * 1024
 LN_VMEM_BUDGET = 10 * 1024 * 1024
 CONV_BN_VMEM_BUDGET = 12 * 1024 * 1024
+PAGED_ATTN_VMEM_BUDGET = 8 * 1024 * 1024
 
 _DTYPE_BYTES = {
     "float32": 4, "f32": 4, "bfloat16": 2, "bf16": 2, "float16": 2,
@@ -139,6 +140,39 @@ def conv_bn_rows_ok(r: int, width: int, rows: int, bytes_per_row_unit: int,
     if rows < 1 or r % rows:
         return False, f"row block {rows} does not tile r={r}"
     est = conv_bn_row_bytes(rows, width, bytes_per_row_unit)
+    if est > budget:
+        return False, f"VMEM estimate {est} > {budget}"
+    return True, "ok"
+
+
+def paged_attention_vmem_bytes(page: int, kv_heads: int, head_dim: int,
+                               dtype: Any = "float32") -> int:
+    """Per-grid-step footprint of the serving paged-attention kernel
+    (ops/pallas/paged_attention.py): one KV page streamed per step —
+    k+v page blocks double-buffered — plus the q/o head blocks and the
+    f32 online-softmax scratch (running max, running denominator, and
+    the [h, d] weighted-value accumulator). MHA-only kernel, so the q/o
+    head count equals kv_heads."""
+    b = dtype_bytes(dtype)
+    kv_pages = 2 * 2 * page * kv_heads * head_dim * b
+    q_out = 2 * 2 * kv_heads * head_dim * b
+    scratch = 4 * (kv_heads + kv_heads + kv_heads * head_dim)
+    return kv_pages + q_out + scratch
+
+
+def paged_page_ok(page: int, kv_heads: int, head_dim: int,
+                  dtype: Any = "float32", max_seq: int = 0,
+                  *, budget: int = PAGED_ATTN_VMEM_BUDGET
+                  ) -> Tuple[bool, str]:
+    """(feasible, reason) for a paged-attention page size. The tuned
+    page size doubles as the KV pool's page granularity (the kernel
+    streams pool pages directly), so a page longer than the model's
+    max sequence can never fill and only wastes pool bytes."""
+    if page < 1:
+        return False, "page size must be >= 1"
+    if max_seq and page > max_seq:
+        return False, f"page {page} exceeds max_seq {max_seq}"
+    est = paged_attention_vmem_bytes(page, kv_heads, head_dim, dtype)
     if est > budget:
         return False, f"VMEM estimate {est} > {budget}"
     return True, "ok"

@@ -150,6 +150,28 @@ def _conv_targets(spec: str, dtype: str):
     return targets
 
 
+def _paged_targets(spec: str, dtype: str):
+    """'b:maxseq:kvheads:headdim' -> one paged_attention SearchTarget.
+    The serving kernel is not a registry op (it is called directly by
+    the generation engine's decode step), so its spec carries a 'kind'
+    marker and _make_measure times it through a direct jax loop instead
+    of the op_bench fence. The key deliberately omits batch/seq: the
+    winner is the KV POOL page size, a model-geometry property that
+    kv_cache.from_budget looks up by (kv_heads, head_dim, dtype)."""
+    from paddle_tpu.tuning import configs, search
+
+    b, max_seq, kvh, d = (int(x) for x in spec.split(":"))
+    cands, rejected = configs.paged_attention_candidates(
+        kvh, d, dtype, max_seq)
+    return [search.SearchTarget(
+        kernel="paged_attention",
+        key={"kv_heads": kvh, "head_dim": d, "dtype": dtype},
+        candidates=cands, rejected=rejected,
+        spec={"kind": "paged_attention", "b": b, "max_seq": max_seq,
+              "kv_heads": kvh, "head_dim": d, "dtype": dtype},
+    )]
+
+
 def _smoke_targets():
     """Tiny CPU-interpret targets for the CI lane: every tunable kernel
     exercised end to end through the REAL lookup + measurement path in
@@ -159,12 +181,49 @@ def _smoke_targets():
         + _ln_targets("128:128", "float32")
         + _conv_targets("1:4:4:8:8:1:1:1:1", "float32")
         + _conv_targets("1:9:9:8:8:3:3:2:2", "float32")
+        + _paged_targets("2:32:2:8", "float32")
     )
 
 
 # ---------------------------------------------------------------------------
 # measurement
 # ---------------------------------------------------------------------------
+
+
+def _measure_paged_attention(spec: dict, config: dict, repeat: int) -> float:
+    """Direct jax timing loop for the serving paged-attention kernel:
+    build a KV pool layout at the candidate page size (pool page 0 is
+    the trash page, so the table starts at id 1), run the kernel once
+    to compile, then time `repeat` fenced iterations."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    b, max_seq = int(spec["b"]), int(spec["max_seq"])
+    kvh, d = int(spec["kv_heads"]), int(spec["head_dim"])
+    page = int(config["page_size"])
+    maxp = (max_seq + page - 1) // page
+    rng = np.random.default_rng(0)
+    dt = np.dtype(spec.get("dtype", "float32"))
+    q = jnp.asarray(rng.standard_normal((b, kvh, d)), dtype=dt.name)
+    kp = jnp.asarray(rng.standard_normal((b * maxp + 1, page, kvh, d)),
+                     dtype=dt.name)
+    vp = jnp.asarray(rng.standard_normal((b * maxp + 1, page, kvh, d)),
+                     dtype=dt.name)
+    table = jnp.asarray(
+        np.arange(b * maxp, dtype=np.int32).reshape(b, maxp) + 1)
+    lengths = jnp.full((b,), max_seq, dtype=jnp.int32)
+    fn = jax.jit(pa.paged_attention)
+    jax.block_until_ready(fn(q, kp, vp, table, lengths))  # compile
+    t0 = _time.perf_counter()
+    for _ in range(max(1, repeat)):
+        out = fn(q, kp, vp, table, lengths)
+    jax.block_until_ready(out)
+    return (_time.perf_counter() - t0) / max(1, repeat) * 1e6
 
 
 def _make_measure(objective: str, repeat: int, profile_steps: int):
@@ -182,6 +241,9 @@ def _make_measure(objective: str, repeat: int, profile_steps: int):
     import op_bench
 
     def measure(target, config):
+        if target.spec.get("kind") == "paged_attention":
+            # not a registry op: no op_bench program exists for it
+            return _measure_paged_attention(target.spec, config, repeat)
         with tuning.override(
                 {target.kernel: {target.canonical: {"config": config}}}):
             row = op_bench.run_case(
@@ -213,11 +275,13 @@ def cmd_search(args) -> int:
         targets += _ln_targets(spec, args.dtype)
     for spec in args.conv or []:
         targets += _conv_targets(spec, args.dtype)
+    for spec in args.paged or []:
+        targets += _paged_targets(spec, args.dtype)
     if args.smoke:
         targets += _smoke_targets()
     if not targets:
-        print("autotune search: no targets (use --flash/--ln/--conv or "
-              "--smoke)", file=sys.stderr)
+        print("autotune search: no targets (use --flash/--ln/--conv/"
+              "--paged or --smoke)", file=sys.stderr)
         return 1
 
     if args.force_pallas or args.smoke:
@@ -362,6 +426,10 @@ def main(argv=None) -> int:
     sp.add_argument("--ln", action="append", help="r:h add_ln target")
     sp.add_argument("--conv", action="append",
                     help="n:h:w:c:o:kh:kw:sh:sw[:pad] conv_bn target")
+    sp.add_argument("--paged", action="append",
+                    help="b:maxseq:kvheads:headdim paged_attention "
+                    "page-size target (winner feeds "
+                    "kv_cache.from_budget)")
     sp.add_argument("--smoke", action="store_true",
                     help="built-in tiny CPU-interpret targets (CI lane)")
     sp.add_argument("--dtype", default="float32")

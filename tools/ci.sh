@@ -458,7 +458,8 @@ assert r1["searched"] == r1["targets"] > 0 and r1["infeasible"] == 0, r1
 assert r2["cache_hits"] == r2["targets"] and r2["searched"] == 0, r2
 assert r1["fingerprint"] == r2["fingerprint"]
 cache = json.load(open("/tmp/ci_autotune.json"))
-for kernel in ("flash_bsh", "add_ln", "conv_bn", "conv_bn_s2d"):
+for kernel in ("flash_bsh", "add_ln", "conv_bn", "conv_bn_s2d",
+               "paged_attention"):
     assert cache["entries"].get(kernel), f"no {kernel} entries"
 print(f"autotune lane OK: {r1['targets']} targets searched, second run "
       f"100% cache hit, file byte-identical (chip={r1['chip']})")
@@ -492,6 +493,20 @@ echo "== autoregressive overload drill (paged KV vs padded recompute) =="
 # STRICTLY no more requests. Fast parity/pool/prefix/eviction units
 # run in tier-1 above (tests/test_kv_serving.py)
 python -m pytest tests/test_kv_serving.py -q -m slow
+
+echo "== crash-tolerant generation drills (mid-decode kill + KV preemption) =="
+# ISSUE 17 acceptance: (1) chaos drill — two generation replicas, one
+# armed with stall:gen_decode_step + crash:gen_decode_step (os._exit
+# mid-decode with multiple streams in flight): ZERO lost generations,
+# the books reconcile exactly (accepted == finished, no sheds), and
+# every resumed output is bit-identical to the no-fault baseline;
+# (2) KV-pressure drill — pool exhaustion preempts the victim with the
+# most remaining work and resumes it (never deadline-expires it),
+# preempt_positions == resume_positions exactly, and
+# PADDLE_SERVE_RESUME=0 restores the r21 FIFO token streams byte for
+# byte. Fast resume/dedup/failover/sampling units run in tier-1 above
+# (tests/test_gen_resume.py)
+python -m pytest tests/test_gen_resume.py -q -m slow
 
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \

@@ -9,8 +9,12 @@ micro-batch occupancy, and the weight epoch (is every replica serving
 the same model?).  Replicas with a generation engine attached also get
 TOK/S (generated tokens per second), DEC/PRE (decode-vs-prefill
 position split — the O(n) health check: decode should track tokens,
-not explode quadratically), KVRES (KV page-pool residency) and PFXHIT
-(prefix-cache page hit rate).
+not explode quadratically), KVRES (KV page-pool residency), PFXHIT
+(prefix-cache page hit rate), and RESUME/PREEMPT (r22 crash-tolerance
+counters: generations resumed from a carried prefix — failover or
+preemption — and active generations preempted for KV pressure; a
+climbing PREEMPT with flat RESUME means preempted work is starving,
+not resuming).
 
 Examples:
 
@@ -58,7 +62,8 @@ def _gen_columns(row: dict, prev_row: Optional[dict],
     cache hit rate.  Replicas without an engine render dashes."""
     g = row.get("generation")
     if not g:
-        return f"{'-':>7} {'-':>11} {'-':>6} {'-':>6}"
+        return (f"{'-':>7} {'-':>11} {'-':>6} {'-':>6} "
+                f"{'-':>6} {'-':>7}")
     toks = int(g.get("tokens_total", 0))
     if prev_row is not None and window_s:
         prev_toks = int(
@@ -75,7 +80,10 @@ def _gen_columns(row: dict, prev_row: Optional[dict],
              if kv else f"{'-':>6}")
     hit = (f"{100.0 * float(kv.get('prefix_hit_rate', 0.0)):5.1f}%"
            if kv else f"{'-':>6}")
-    return f"{tok_s} {split:>11} {resid:>6} {hit:>6}"
+    res = int(g.get("resumed_total", 0))
+    pre_t = int(g.get("preempted_total", 0))
+    return (f"{tok_s} {split:>11} {resid:>6} {hit:>6} "
+            f"{res:6d} {pre_t:7d}")
 
 
 def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
@@ -86,6 +94,7 @@ def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
     hdr = (f"{'ENDPOINT':22} {'QPS':>7} {'SERVED':>8} {'SHED':>7} "
            f"{'DEADLN':>7} {'QDEPTH':>6} {'P50MS':>8} {'P99MS':>8} "
            f"{'TOK/S':>7} {'DEC/PRE':>11} {'KVRES':>6} {'PFXHIT':>6} "
+           f"{'RESUME':>6} {'PREEMPT':>7} "
            f"{'EPOCH':>6} {'DRAIN':>5}")
     out.append(hdr)
     for row in rows:
