@@ -37,7 +37,11 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     RPC verbs never collide
             kill    server side: os._exit(1) the pserver process once it
                     has handled <nth> RPCs in total (method filter still
-                    applies): exercises supervision + snapshot recovery
+                    applies): exercises supervision + snapshot recovery.
+                    The durable job coordinator serves its verbs through
+                    the same on_server_call hook, so a kill rule scoped
+                    with PADDLE_PS_FAULT_TAGS=coord kills the
+                    coordinator after N handled verbs instead
             slow    server side, REPEATING: every <nth>-th handled RPC
                     matching the verb sleeps <arg> MILLISECONDS before
                     being served — deterministic tail-latency injection
@@ -71,7 +75,16 @@ Spec grammar (PADDLE_PS_FAULT_SPEC) — semicolon-separated rules:
                     Serving phase: "gen_decode_step" (between decode
                     steps in the generation engine's loop) kills a
                     replica mid-decode — the crash-tolerant-generation
-                    drill's deterministic mid-stream death
+                    drill's deterministic mid-stream death.
+                    Control-plane phase: "coord_verb" (entry of every
+                    coordinator verb dispatch) kills the job
+                    coordinator process after handling N verbs — the
+                    coordinator kill-and-respawn drill. Scope the rule
+                    with PADDLE_PS_FAULT_TAGS=coord so only the
+                    durable coordinator process (tag "coord"; a
+                    standby is "coord-standby") arms it: the launcher
+                    and every trainer/pserver share the same spec env
+                    but match different tags
             bitflip phase side, DATA-corrupting: at the Nth arrival at a
                     named data phase (bitflip_point(phase, array) call
                     sites: "push_grad" in the PS client push path,

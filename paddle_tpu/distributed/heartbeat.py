@@ -83,7 +83,14 @@ class HeartBeatWorker:
     carry the member's membership-epoch view (PADDLE_MEMBERSHIP_EPOCH)
     when the launcher exported one, and `renew_cb` — when the job
     control plane is armed — turns every stamp into a coordinator
-    lease renewal carrying the same payload (coordinator.py)."""
+    lease renewal carrying the same payload (coordinator.py).
+
+    Coordinator outages never stall the beat (ISSUE 18): the renewal
+    callback is CoordinatorClient.renew, which raises ConnectionError
+    on a transport failure AFTER entering grace mode — buffering the
+    payload and re-registering idempotently on reconnect — and the
+    `except` below swallows the raise, so file heartbeats keep stamping
+    and training keeps stepping while the control plane is down."""
 
     def __init__(self, directory: str, rank: Rank, interval: float = 1.0,
                  renew_cb=None):

@@ -140,6 +140,17 @@ def _parse_args(argv):
         "else off",
     )
     p.add_argument(
+        "--coordinator_standby", action="store_true",
+        help="control-plane HA (ISSUE 18): spawn a WARM-STANDBY "
+        "coordinator beside the durable primary. The standby follows "
+        "the primary's snapshot+WAL stream (repl_pull) and promotes "
+        "itself when the primary's incarnation lease lapses; clients "
+        "hold the ordered endpoint list (primary,standby) and fail "
+        "over, with split-brain fenced by the incarnation number. "
+        "Implies the process-hosted durable coordinator (as does "
+        "setting PADDLE_COORD_SNAPSHOT_SECS); requires --lease_secs",
+    )
+    p.add_argument(
         "--straggler_eject_factor", type=float, default=0.0,
         help="EJECT (kill + per-rank budget, reason 'straggler "
         "ejection') a trainer whose step time exceeds this multiple of "
@@ -462,6 +473,132 @@ class PServerSupervisor:
         return None
 
 
+def _spawn_coordinator(host: str, port: int, state_dir: Optional[str],
+                       lease_secs: float, per_rank: int,
+                       snapshot_secs: float,
+                       log_dir: Optional[str] = None,
+                       standby_of: Optional[str] = None,
+                       log_mode: str = "w",
+                       clear_fault_spec: bool = False) -> subprocess.Popen:
+    """Fork one process-hosted coordinator (durable control plane,
+    ISSUE 18) and wait for its bound-port banner — the _spawn_pserver
+    idiom: first spawns bind port 0 and report the bound port; respawns
+    pass the original port so clients reconnect in place. The caller
+    learns the port via proc.coord_bound_port."""
+    env = dict(os.environ)
+    role = "standby" if standby_of else "primary"
+    # fault tag-scoping identity: PADDLE_PS_FAULT_TAGS=coord arms kill/
+    # crash rules in the PRIMARY only (the standby answers to
+    # coord-standby)
+    env["PADDLE_PS_RANK_TAG"] = ("coord-standby" if standby_of
+                                 else "coord")
+    # the coordinator must not hold a lease on itself
+    env.pop("PADDLE_COORDINATOR_ENDPOINT", None)
+    env.pop("PADDLE_CKPT_BARRIER_ENDPOINT", None)
+    if clear_fault_spec:
+        # same rule as pserver respawns: a `crash:coord_verb:N` drill
+        # means "crash the coordinator once", not every incarnation
+        env.pop("PADDLE_PS_FAULT_SPEC", None)
+    cmd = [sys.executable, "-u", "-m",
+           "paddle_tpu.distributed.coordinator",
+           "--host", host, "--port", str(port),
+           "--lease_secs", str(lease_secs),
+           "--retries_per_rank", str(per_rank),
+           "--snapshot_secs", str(snapshot_secs)]
+    if state_dir:
+        cmd += ["--state_dir", state_dir]
+    if standby_of:
+        cmd += ["--standby_of", standby_of]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    line = proc.stdout.readline()  # "[coordinator] listening on h:p"
+    if "listening on" not in line:
+        proc.kill()
+        raise RuntimeError(
+            f"{role} coordinator failed to start: {line!r}")
+    proc.coord_bound_port = int(line.rsplit(":", 1)[1])
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        log = open(os.path.join(log_dir, f"coordlog.{role}"), log_mode)
+        log.write(line)
+
+        def drain(p=proc, f=log):
+            for ln in p.stdout:
+                f.write(ln)
+            f.close()
+    else:
+        def drain(p=proc):
+            for _ in p.stdout:
+                pass
+
+    threading.Thread(target=drain, daemon=True).start()
+    return proc
+
+
+class CoordinatorSupervisor:
+    """Respawn a dead process-hosted coordinator in place — same port,
+    same state dir, so the durable snapshot+WAL make the respawn resume
+    exactly where the dead one stopped (bumped incarnation,
+    reconciliation window armed). The budget is --elastic_retries, like
+    the pserver supervisor — but unlike a pserver, a coordinator dead
+    past its budget does NOT abort the job: the data plane keeps
+    training in grace mode, and a warm standby (when armed) promotes
+    itself."""
+
+    def __init__(self, children: dict, retries: int, ledger=None):
+        # children: role -> spawn record (proc + the _spawn_coordinator
+        # kwargs needed to respawn it in place)
+        self.children = children
+        self.retries_left = int(retries)
+        self.ledger = ledger
+
+    def check(self) -> None:
+        for role, ent in self.children.items():
+            proc = ent.get("proc")
+            if proc is None or proc.poll() is None:
+                continue
+            rc = proc.poll()
+            detect_ts = time.time()
+            if self.retries_left <= 0:
+                if not ent.get("dead_reported"):
+                    ent["dead_reported"] = True
+                    print(f"[launch] {role} coordinator exited with "
+                          f"{rc} and no restarts remain; clients stay "
+                          f"in grace mode"
+                          + (" (warm standby will promote itself)"
+                             if len(self.children) > 1
+                             and role == "primary" else ""),
+                          file=sys.stderr)
+                ent["proc"] = None
+                continue
+            self.retries_left -= 1
+            print(f"[launch] {role} coordinator (port {ent['port']}) "
+                  f"exited with {rc}; respawning on the same port from "
+                  f"its durable state ({self.retries_left} restarts "
+                  f"left)", file=sys.stderr)
+            try:
+                ent["proc"] = _spawn_coordinator(
+                    ent["host"], ent["port"], ent["state_dir"],
+                    ent["lease_secs"], ent["per_rank"],
+                    ent["snapshot_secs"], log_dir=ent.get("log_dir"),
+                    standby_of=ent.get("standby_of"), log_mode="a",
+                    clear_fault_spec=True)
+            except RuntimeError as e:
+                print(f"[launch] {role} coordinator respawn failed: "
+                      f"{e}; clients stay in grace mode",
+                      file=sys.stderr)
+                ent["proc"] = None
+                continue
+            if self.ledger is not None:
+                try:
+                    self.ledger.event(
+                        event="coord_respawn", role=role, rc=rc,
+                        detect_ts=round(detect_ts, 6),
+                        respawn_ts=round(time.time(), 6))
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
+
+
 class SigtermGrace:
     """Launcher-side preemption protocol: on SIGTERM, forward the signal
     to every live trainer (their training loops checkpoint and exit) and
@@ -628,6 +765,7 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                          coordinator=None, straggler_eject=False,
                          serve_respawner: Optional[ServeRespawner] = None,
                          fleet_ledger=None, incident_coord=None,
+                         coord_supervisor=None,
                          ) -> int:
     """Block until all trainers exit. Any nonzero exit — or a stale
     heartbeat when `monitor` (heartbeat.HeartBeatMonitor) is given —
@@ -767,6 +905,10 @@ def watch_local_trainers(trainers: List[Trainer], poll_interval=0.2,
                 if rc is not None:
                     terminate_local_trainers(trainers)
                     return rc
+            if coord_supervisor is not None:
+                # durable control plane (ISSUE 18): respawn a dead
+                # coordinator in place; never aborts the job
+                coord_supervisor.check()
             time.sleep(poll_interval)
     except KeyboardInterrupt:
         terminate_local_trainers(trainers)
@@ -849,23 +991,93 @@ def launch(argv=None) -> int:
 
     # the job control plane: the coordinator owns membership, epochs and
     # per-rank budgets whenever elastic supervision is on; it is SERVED
-    # over TCP (lease renewals) only when --lease_secs arms leases
-    from .coordinator import Coordinator, serve_coordinator, stop_coordinator
+    # over TCP (lease renewals) only when --lease_secs arms leases.
+    # DURABLE mode (ISSUE 18 — PADDLE_COORD_SNAPSHOT_SECS set, or
+    # --coordinator_standby): the coordinator moves OUT of the launcher
+    # into a supervised child process with snapshot+WAL state, and the
+    # launcher talks to it through CoordinatorProxy; neither armed =
+    # the in-process coordinator, byte-identical on the wire
+    from .coordinator import (Coordinator, CoordinatorProxy,
+                              serve_coordinator, stop_coordinator)
 
     per_rank = (args.elastic_retries_per_rank
                 if args.elastic_retries_per_rank is not None
                 else args.elastic_retries)
-    coord = Coordinator(lease_secs=lease_secs or 5.0,
-                        retries_per_rank=per_rank)
+    durable_snap_secs = None
+    raw_snap = os.environ.get("PADDLE_COORD_SNAPSHOT_SECS")
+    if raw_snap:
+        try:
+            durable_snap_secs = float(raw_snap)
+        except ValueError:
+            durable_snap_secs = None
+    durable_coord = lease_secs > 0 and (durable_snap_secs is not None
+                                        or args.coordinator_standby)
+    if args.coordinator_standby and lease_secs <= 0:
+        print("[launch] --coordinator_standby needs the lease plane; "
+              "arm it with --lease_secs", file=sys.stderr)
+        return 2
     coord_server = None
-    if lease_secs > 0:
-        coord_server, coord_ep = serve_coordinator(coord)
-        # children inherit both through the spawn env copies
+    coord_children = None
+    own_coord_state = False
+    coord_state_root = None
+    coord_ep = None
+    if durable_coord:
+        snap_secs = (durable_snap_secs
+                     if durable_snap_secs is not None else 1.0)
+        if args.log_dir:
+            coord_state_root = os.path.join(args.log_dir, "coord_state")
+        else:
+            import tempfile
+
+            coord_state_root = tempfile.mkdtemp(
+                prefix="paddle_tpu_coord_")
+            own_coord_state = True
+        os.makedirs(coord_state_root, exist_ok=True)
+        primary_state = os.path.join(coord_state_root, "primary")
+        primary = _spawn_coordinator(
+            "127.0.0.1", 0, primary_state, lease_secs, per_rank,
+            snap_secs, log_dir=args.log_dir)
+        primary_ep = f"127.0.0.1:{primary.coord_bound_port}"
+        coord_children = {"primary": {
+            "proc": primary, "host": "127.0.0.1",
+            "port": primary.coord_bound_port,
+            "state_dir": primary_state, "lease_secs": lease_secs,
+            "per_rank": per_rank, "snapshot_secs": snap_secs,
+            "log_dir": args.log_dir, "standby_of": None}}
+        endpoints = [primary_ep]
+        if args.coordinator_standby:
+            standby_state = os.path.join(coord_state_root, "standby")
+            standby = _spawn_coordinator(
+                "127.0.0.1", 0, standby_state, lease_secs, per_rank,
+                snap_secs, log_dir=args.log_dir, standby_of=primary_ep)
+            coord_children["standby"] = {
+                "proc": standby, "host": "127.0.0.1",
+                "port": standby.coord_bound_port,
+                "state_dir": standby_state, "lease_secs": lease_secs,
+                "per_rank": per_rank, "snapshot_secs": snap_secs,
+                "log_dir": args.log_dir, "standby_of": primary_ep}
+            endpoints.append(f"127.0.0.1:{standby.coord_bound_port}")
+        coord_ep = ",".join(endpoints)
+        # children inherit the ORDERED list through the spawn env copies
         os.environ["PADDLE_COORDINATOR_ENDPOINT"] = coord_ep
         os.environ["PADDLE_LEASE_SECS"] = str(lease_secs)
-        print(f"[launch] job coordinator on {coord_ep} (lease "
-              f"{lease_secs}s, per-rank budget {per_rank})",
-              file=sys.stderr)
+        coord = CoordinatorProxy(coord_ep, lease_secs, per_rank)
+        print(f"[launch] durable job coordinator on {coord_ep} (lease "
+              f"{lease_secs}s, per-rank budget {per_rank}, snapshots "
+              f"every {snap_secs}s"
+              + (", warm standby" if args.coordinator_standby else "")
+              + ")", file=sys.stderr)
+    else:
+        coord = Coordinator(lease_secs=lease_secs or 5.0,
+                            retries_per_rank=per_rank)
+        if lease_secs > 0:
+            coord_server, coord_ep = serve_coordinator(coord)
+            # children inherit both through the spawn env copies
+            os.environ["PADDLE_COORDINATOR_ENDPOINT"] = coord_ep
+            os.environ["PADDLE_LEASE_SECS"] = str(lease_secs)
+            print(f"[launch] job coordinator on {coord_ep} (lease "
+                  f"{lease_secs}s, per-rank budget {per_rank})",
+                  file=sys.stderr)
 
     # goodput ledgers (PADDLE_GOODPUT, armed by --fleetz_port or set by
     # the operator): children persist per-incarnation interval files and
@@ -890,6 +1102,10 @@ def launch(argv=None) -> int:
             fleet_ledger.event(event="job_start", world=len(cluster),
                                tags=[t.tag for t in cluster],
                                lease_secs=lease_secs)
+    if durable_coord:
+        # the proxy records coord_outage windows into the same ledger
+        # goodtop stitches (distinct from rank-death restarts)
+        coord.ledger = fleet_ledger
     if fleetz_port is not None:
         from ..telemetry import debugz as _debugz
 
@@ -928,7 +1144,12 @@ def launch(argv=None) -> int:
     # object is served standalone
     ckpt_barrier_server = None
     if len(cluster) > 1:
-        if coord_server is not None:
+        if durable_coord:
+            # the barrier rides the durable coordinator's port(s): the
+            # ORDERED endpoint list makes a mid-flight sharded
+            # checkpoint survive a coordinator respawn or promotion
+            os.environ["PADDLE_CKPT_BARRIER_ENDPOINT"] = coord_ep
+        elif coord_server is not None:
             os.environ["PADDLE_CKPT_BARRIER_ENDPOINT"] = coord_ep
         else:
             from .coordinator import serve_ckpt_barrier
@@ -994,10 +1215,16 @@ def launch(argv=None) -> int:
                     snapshot_dir, snapshot_secs,
                     heartbeat_dir=heartbeat_dir,
                     heartbeat_timeout=args.heartbeat_timeout)
+        coord_supervisor = None
+        if coord_children is not None:
+            coord_supervisor = CoordinatorSupervisor(
+                coord_children, args.elastic_retries,
+                ledger=fleet_ledger)
         rc = _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                               ps_supervisor, grace, coord=coord,
                               lease_armed=lease_secs > 0,
-                              fleet_ledger=fleet_ledger)
+                              fleet_ledger=fleet_ledger,
+                              coord_supervisor=coord_supervisor)
         if args.trace_dir:
             # pservers dump their span timelines on SIGTERM — stop them
             # BEFORE the merge so timeline.json spans the whole job
@@ -1033,6 +1260,29 @@ def launch(argv=None) -> int:
             stop_coordinator(coord_server)
         if ckpt_barrier_server is not None:
             stop_coordinator(ckpt_barrier_server)  # same teardown shape
+        if coord_children is not None:
+            # SIGTERM = graceful: the coordinator writes a final
+            # snapshot, so a follow-up job adopting the state dir
+            # restarts lossless
+            for ent in coord_children.values():
+                p = ent.get("proc")
+                if p is not None and p.poll() is None:
+                    p.terminate()
+            for ent in coord_children.values():
+                p = ent.get("proc")
+                if p is not None:
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            try:
+                coord.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if own_coord_state:
+            import shutil
+
+            shutil.rmtree(coord_state_root, ignore_errors=True)
         if own_heartbeat_dir:
             import shutil
 
@@ -1045,7 +1295,8 @@ def launch(argv=None) -> int:
 
 def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
                      ps_supervisor=None, grace=None, coord=None,
-                     lease_armed=False, fleet_ledger=None) -> int:
+                     lease_armed=False, fleet_ledger=None,
+                     coord_supervisor=None) -> int:
     """Supervision loop with per-rank budgets and elastic resize.
 
     Failure accounting lives in the coordinator: every group-ending
@@ -1161,7 +1412,8 @@ def _launch_attempts(args, ips, node_ip, cluster, heartbeat_dir,
             grace=grace, straggler=straggler, failure=failure,
             coordinator=coord if lease_armed else None,
             straggler_eject=eject, serve_respawner=serve_respawner,
-            fleet_ledger=fleet_ledger, incident_coord=coord)
+            fleet_ledger=fleet_ledger, incident_coord=coord,
+            coord_supervisor=coord_supervisor)
         detect_ts = time.time()  # the watch just noticed the death
         if (rc == 0
                 or rc == 128 + signal.SIGINT

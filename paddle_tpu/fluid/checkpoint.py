@@ -501,28 +501,74 @@ class _RPCBarrier:
     """Commit barrier over the ps_server RPC transport (the launcher
     hosts coordinator.CkptBarrier and exports
     PADDLE_CKPT_BARRIER_ENDPOINT). Rank 0 POLLS ckpt_status instead of
-    holding a handler thread in a long blocking wait."""
+    holding a handler thread in a long blocking wait.
+
+    The endpoint may be a comma-separated ordered list (durable
+    coordinator + warm standby): verbs rotate to the next endpoint on
+    transport failure AND on a ``{"standby": True}`` refusal — an
+    unpromoted standby or a stale-latched deposed primary must never
+    swallow a commit report."""
 
     def __init__(self, endpoint: str):
-        self.endpoint = endpoint
+        self.endpoints = [e.strip() for e in str(endpoint).split(",")
+                          if e.strip()]
+        self.endpoint = self.endpoints[0]
+        self._idx = 0
         self._conn = None
 
     def _c(self):
         if self._conn is None:
             from ..distributed.ps_server import _Conn
 
-            self._conn = _Conn(self.endpoint, deadline=10.0,
+            self._conn = _Conn(self.endpoints[self._idx], deadline=10.0,
                                io_timeout=30.0)
         return self._conn
 
+    def _rotate(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:  # noqa: BLE001 — best-effort close
+                pass
+        self._conn = None
+        self._idx = (self._idx + 1) % len(self.endpoints)
+        self.endpoint = self.endpoints[self._idx]
+
+    def _call(self, verb: str, **kw) -> dict:
+        last: Optional[BaseException] = None
+        for _ in range(max(2, len(self.endpoints) * 2)):
+            try:
+                out = self._c().call(verb, **kw)
+            except ConnectionError as e:
+                last = e
+                self._rotate()
+                time.sleep(0.05)
+                continue
+            if isinstance(out, dict) and out.get("standby"):
+                last = ConnectionError(
+                    f"barrier endpoint {self.endpoint} is not the "
+                    f"authoritative coordinator")
+                self._rotate()
+                time.sleep(0.05)
+                continue
+            return out
+        raise last if last is not None else ConnectionError(
+            "ckpt barrier unreachable")
+
     def shard_commit(self, step, rank, world, info) -> None:
-        self._c().call("ckpt_shard_commit", step=int(step), rank=int(rank),
-                       world_size=int(world), info=info)
+        self._call("ckpt_shard_commit", step=int(step), rank=int(rank),
+                   world_size=int(world), info=info)
 
     def wait_full(self, step, world, timeout) -> Optional[dict]:
         deadline = time.monotonic() + float(timeout)
         while True:
-            out = self._c().call("ckpt_status", step=int(step))
+            try:
+                out = self._call("ckpt_status", step=int(step))
+            except ConnectionError:
+                if time.monotonic() > deadline:
+                    return None
+                time.sleep(0.2)
+                continue
             shards = {int(r): dict(i)
                       for r, i in (out.get("shards") or {}).items()}
             if len(shards) >= int(world):
@@ -1076,8 +1122,16 @@ class CheckpointManager:
                 shutil.rmtree(path, ignore_errors=True)
         for name in os.listdir(self.root):
             m = _TMP_RE.match(name)
-            if m and (int(m.group(1)) < cutoff
-                      or int(m.group(2)) != os.getpid()):
+            if not m:
+                continue
+            t_step, t_pid = int(m.group(1)), int(m.group(2))
+            # another pid's tmp dir at a step NEWER than the newest
+            # commit may be a live sibling rank's shard write in flight
+            # (sharded ranks share the root); it only becomes provable
+            # trash once that step commits — a committed step means
+            # every rank renamed its tmp away already
+            if t_step < cutoff or (t_pid != os.getpid()
+                                   and t_step <= newest):
                 shutil.rmtree(os.path.join(self.root, name),
                               ignore_errors=True)
 

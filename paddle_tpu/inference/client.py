@@ -314,7 +314,7 @@ class InferenceClient:
 
     @staticmethod
     def _gen_kwargs(prompt, max_new_tokens, deadline_ms, eos_id,
-                    temperature, top_k, seed) -> dict:
+                    temperature, top_k, top_p, seed) -> dict:
         kwargs = {"prompt": [int(t) for t in prompt],
                   "max_new_tokens": int(max_new_tokens),
                   "request_id": uuid.uuid4().hex}
@@ -326,6 +326,8 @@ class InferenceClient:
             kwargs["temperature"] = float(temperature)
             if top_k is not None:
                 kwargs["top_k"] = int(top_k)
+            if top_p is not None:
+                kwargs["top_p"] = float(top_p)
             # Sampling without a caller seed: draw one HERE so a
             # failover resume replays the exact token sequence — the
             # seed must be fixed before the first attempt, not per
@@ -341,7 +343,8 @@ class InferenceClient:
                  eos_id: Optional[int] = None,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
-                 seed: Optional[int] = None) -> "GenerateResult":
+                 seed: Optional[int] = None,
+                 top_p: Optional[float] = None) -> "GenerateResult":
         """Blocking autoregressive generation on the primary replica.
         Generation is NOT hedged: a duplicate run would burn KV pages
         and decode slots on two replicas for one reply. Instead every
@@ -352,7 +355,8 @@ class InferenceClient:
         so the promoted replica charges the full request age against
         the deadline."""
         kwargs = self._gen_kwargs(prompt, max_new_tokens, deadline_ms,
-                                  eos_id, temperature, top_k, seed)
+                                  eos_id, temperature, top_k, top_p,
+                                  seed)
         t0 = time.perf_counter()
         abs_deadline = (None if deadline_ms is None
                         else t0 + float(deadline_ms) / 1e3)
@@ -394,7 +398,8 @@ class InferenceClient:
                         poll_s: float = 0.01,
                         temperature: Optional[float] = None,
                         top_k: Optional[int] = None,
-                        seed: Optional[int] = None):
+                        seed: Optional[int] = None,
+                        top_p: Optional[float] = None):
         """Incremental generation: yields lists of new tokens as the
         replica's decode loop produces them.  The PS transport is
         one-shot request/reply, so streaming is poll-based: `generate`
@@ -410,7 +415,7 @@ class InferenceClient:
         across an epoch boundary the server refuses and the caller gets
         ResumedOnNewWeightsError with the partial tokens attached."""
         base = self._gen_kwargs(prompt, max_new_tokens, deadline_ms,
-                                eos_id, temperature, top_k, seed)
+                                eos_id, temperature, top_k, top_p, seed)
         base["stream"] = True
         t0 = time.perf_counter()
         abs_deadline = (None if deadline_ms is None

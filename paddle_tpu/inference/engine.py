@@ -46,10 +46,12 @@ Crash tolerance (r22, gated on ``PADDLE_SERVE_RESUME``, default on):
   request, and resumes themselves never preempt — both rules together
   make the ladder livelock-free.  Preempt/resume wall-time latches
   into the goodput ledger's `serve_preempt`/`serve_resume` buckets.
-* **sampling** — temperature/top-k ride the single `_emit` choke point
-  (host-side, from the logits every step already returns); the
+* **sampling** — temperature/top-k/top-p ride the single `_emit` choke
+  point (host-side, from the logits every step already returns); the
   per-request seed and the token INDEX feed a counter-mode PRNG, so a
-  resumed sampled generation replays bit-identically.
+  resumed sampled generation replays bit-identically. Top-p (nucleus)
+  composes after top-k and, like top-k, is active only when a
+  temperature is set — greedy requests stay on the device argmax.
 """
 from __future__ import annotations
 
@@ -79,14 +81,22 @@ def kv_cache_enabled() -> bool:
 
 
 def _sample_token(logits: np.ndarray, temperature: float,
-                  top_k: Optional[int], seed: int, index: int) -> int:
-    """Deterministic temperature/top-k sampling at token ``index``.
+                  top_k: Optional[int], seed: int, index: int,
+                  top_p: Optional[float] = None) -> int:
+    """Deterministic temperature/top-k/top-p sampling at token ``index``.
 
     Counter-mode: the PRNG is keyed on (seed, index), never on call
     order or engine state — the token at index i depends only on the
     prefix (via logits) and the request seed, which is exactly what
     makes a resumed/preempted sampled generation replay the same
-    tokens the uninterrupted run produced."""
+    tokens the uninterrupted run produced.
+
+    Top-p (nucleus) filtering composes after top-k: the smallest set of
+    highest-probability tokens whose cumulative mass reaches ``top_p``
+    survives, the tail is zeroed, and the nucleus is renormalized. The
+    sort is stable on descending probability so ties resolve by token
+    id — the filter is a pure function of (logits, knobs), keeping the
+    resume-replay contract bit-exact."""
     scores = np.asarray(logits, np.float64) / max(float(temperature),
                                                   1e-6)
     if top_k and 0 < int(top_k) < scores.size:
@@ -95,6 +105,15 @@ def _sample_token(logits: np.ndarray, temperature: float,
     scores -= scores.max()
     probs = np.exp(scores)
     probs /= probs.sum()
+    if top_p is not None and 0.0 < float(top_p) < 1.0:
+        order = np.argsort(-probs, kind="stable")
+        csum = np.cumsum(probs[order])
+        # smallest prefix whose mass >= top_p (always >= 1 token)
+        cut = int(np.searchsorted(csum, float(top_p))) + 1
+        keep = order[:cut]
+        mask = np.zeros_like(probs)
+        mask[keep] = probs[keep]
+        probs = mask / mask.sum()
     rng = np.random.default_rng(
         [int(seed) & 0xFFFFFFFF, int(index) & 0xFFFFFFFF])
     return int(rng.choice(scores.size, p=probs))
@@ -107,7 +126,7 @@ class GenRequest:
                  "event", "tokens", "error", "weight_epoch", "t_admit",
                  "pages", "reuse", "pos", "cur_token", "slot",
                  "rc_tokens", "rc_len", "t_first_token",
-                 "temperature", "top_k", "seed", "resumed_from",
+                 "temperature", "top_k", "top_p", "seed", "resumed_from",
                  "expect_epoch", "is_resume", "t_preempt", "preempts")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
@@ -115,7 +134,8 @@ class GenRequest:
                  resume_tokens: Optional[List[int]] = None,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 top_p: Optional[float] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -141,6 +161,7 @@ class GenRequest:
         self.temperature = (float(temperature)
                             if temperature else None)
         self.top_k = int(top_k) if top_k else None
+        self.top_p = float(top_p) if top_p else None
         self.seed = int(seed) if seed is not None else 0
         self.expect_epoch: Optional[int] = None
         self.is_resume = resume_tokens is not None
@@ -238,7 +259,8 @@ class GenerationEngine:
                expect_epoch: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               seed: Optional[int] = None) -> GenRequest:
+               seed: Optional[int] = None,
+               top_p: Optional[float] = None) -> GenRequest:
         prompt = [int(t) for t in prompt]
         if not prompt or len(prompt) >= self.max_seq:
             raise ValueError(
@@ -262,7 +284,8 @@ class GenerationEngine:
         req = GenRequest(prompt, int(max_new_tokens),
                          self.eos_id if eos_id is None else int(eos_id),
                          deadline_t, resume_tokens=resume_tokens,
-                         temperature=temperature, top_k=top_k, seed=seed)
+                         temperature=temperature, top_k=top_k, seed=seed,
+                         top_p=top_p)
         if elapsed_ms:
             # carry the ORIGINAL arrival time across a failover: SLO
             # accounting (request latency, badput charges) never resets
@@ -711,7 +734,8 @@ class GenerationEngine:
         if not req.temperature or logits_row is None:
             return argmax_tok
         return _sample_token(logits_row, req.temperature, req.top_k,
-                             req.seed, len(req.tokens))
+                             req.seed, len(req.tokens),
+                             top_p=req.top_p)
 
     def _emit(self, req: GenRequest, tok: int, logits_row=None) -> None:
         """Append one generated token; retire on eos/max_new/capacity."""

@@ -500,7 +500,8 @@ class InferenceServer:
                  expect_epoch: Optional[int] = None,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
-                 seed: Optional[int] = None) -> dict:
+                 seed: Optional[int] = None,
+                 top_p: Optional[float] = None) -> dict:
         """Autoregressive generation (requires an attached engine).
 
         Blocking form returns the full token list; ``stream=True``
@@ -542,7 +543,7 @@ class InferenceServer:
                                  elapsed_ms=elapsed_ms,
                                  expect_epoch=expect_epoch,
                                  temperature=temperature, top_k=top_k,
-                                 seed=seed)
+                                 seed=seed, top_p=top_p)
         ent = None
         if rid and self._resume_on:
             ent = {"req": req, "stream_id": None, "reply": None}
@@ -641,7 +642,8 @@ class InferenceServer:
                 expect_epoch=kwargs.get("expect_epoch"),
                 temperature=kwargs.get("temperature"),
                 top_k=kwargs.get("top_k"),
-                seed=kwargs.get("seed"))
+                seed=kwargs.get("seed"),
+                top_p=kwargs.get("top_p"))
         if method == "generate_poll":
             return self.generate_poll(kwargs["stream_id"],
                                       int(kwargs.get("cursor", 0)))

@@ -172,6 +172,16 @@ def _statusz() -> dict:
     except Exception:  # noqa: BLE001
         out["membership"] = None
     try:
+        # control-plane HA (ISSUE 18): the coordinator's own health row
+        # — incarnation, role (primary/standby), durable on/off,
+        # snapshot seq + last-snapshot age, reconciliation-window
+        # remaining; None when no coordinator endpoint is armed
+        from ..distributed import coordinator as _coord
+
+        out["coordinator"] = _coord.query_coord_status(timeout=1.0)
+    except Exception:  # noqa: BLE001
+        out["coordinator"] = None
+    try:
         # inference serving (ISSUE 14): the active replica's SLO row —
         # queue depth, served/shed/deadline_exceeded, p50/p99, weight
         # epoch; None when this process serves no model

@@ -752,6 +752,18 @@ def stitch_job(directory: str) -> dict:
     for ev in job["launcher"]:
         if ev.get("event") == "stall":
             incidents.append(dict(ev, kind="stall"))
+        elif ev.get("event") == "coord_outage":
+            # control-plane outage (ISSUE 18): the coordinator died and
+            # was respawned/promoted — labeled distinctly from rank
+            # deaths because NO rank died: trainers rode it out in
+            # grace mode and the gap charges no trainer badput bucket
+            inc = dict(ev, kind="coord_outage")
+            if inc.get("gap_s") is None and (
+                    ev.get("detect_ts") is not None
+                    and ev.get("respawn_ts") is not None):
+                inc["gap_s"] = round(
+                    float(ev["respawn_ts"]) - float(ev["detect_ts"]), 3)
+            incidents.append(inc)
     job_buckets = {b: 0.0 for b in BUCKETS}
     for row in per_rank.values():
         for b, v in row["buckets_s"].items():

@@ -1,0 +1,708 @@
+"""Control-plane crash tolerance (distributed/coordinator.py, ISSUE 18).
+
+Fast layer (tier-1):
+  - durable state: snapshot→restore equality for EVERY table (lease
+    windows + budgets, membership epoch, election grants riding member
+    payloads, CkptBarrier partial shard reports, incident ring, SDC
+    eviction set), WAL replay of post-snapshot mutations, torn-newest
+    snapshot falling back to the previous intact one
+  - recovery semantics: incarnation bump on every respawn, the
+    reconciliation window in which no lease may be declared expired,
+    expiry authority returning once the window lapses
+  - split-brain fence: a deposed primary latches stale on a renewal
+    claiming a higher incarnation; the client rejects lower-incarnation
+    replies and rotates down its ordered endpoint list
+  - outage-tolerant clients: grace mode on coordinator-unreachable
+    (renew still raises; payload buffered), idempotent re-register on
+    reconnect, PADDLE_COORD_CALL_DEADLINE_SECS capping verb deadlines
+  - wire compatibility: incarnation 0 (the legacy in-launcher
+    coordinator) stamps nothing and clients send nothing extra — the
+    default single-coordinator wire format is byte-identical
+  - warm standby: repl_pull/repl_apply mirroring, authority refusal
+    before promotion, the +2 incarnation fence on promote, and the
+    sharded-checkpoint _RPCBarrier rotating off standby replies
+  - observability: the coord_status verb, /statusz row plumbing, and
+    goodput/goodtop labeling coord_outage incidents distinctly from
+    rank deaths
+
+Slow layer (tools/ci.sh control-plane lane):
+  - kill-and-respawn drill: the durable coordinator process is killed
+    mid-job (2 trainers + 1 pserver + sharded checkpoints in flight) —
+    zero evictions, the checkpoint stream reaches its final global
+    commit, and the loss trace is bit-identical to the no-fault run
+  - standby-promotion drill: the primary dies for good, the follower
+    promotes itself after the incarnation lease lapses, clients fail
+    over down the ordered endpoint list, and the promoted coordinator
+    still exercises PS election authority (a dead pserver's partition
+    is granted to the caught-up backup via a real promote RPC)
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import telemetry
+from paddle_tpu.distributed import coordinator as coord_mod
+from paddle_tpu.distributed import ps_server
+from paddle_tpu.distributed.coordinator import (
+    Coordinator, CoordinatorClient, CoordinatorFollower,
+    serve_coordinator, stop_coordinator)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHARD_WORKER = os.path.join(REPO, "tests", "dist_ckpt_shard_worker.py")
+_REG = telemetry.get_registry()
+
+
+def _populated(tmp_path=None, state_dir=None, lease=1.0, **kw):
+    """A coordinator with every table non-trivially populated."""
+    c = Coordinator(lease_secs=lease, retries_per_rank=2,
+                    startup_grace=5.0, state_dir=state_dir,
+                    snapshot_secs=kw.pop("snapshot_secs", 3600.0), **kw)
+    t0 = 1000.0
+    for i in range(3):
+        c.register(f"trainer{i}", kind="trainer", now=t0)
+        c.renew(f"trainer{i}", payload={"step": 7 + i}, epoch=0,
+                now=t0 + 0.5)
+    c.register("ps0", kind="pserver", endpoint="127.0.0.1:7001",
+               payload={"partitions": {"tab@p0": {"role": "primary",
+                                                  "epoch": 3, "seq": 41}}},
+               now=t0)
+    # one spent retry on trainer2: budgets must survive a restore
+    c.report_failure("trainer2", reason="exit 1")
+    c.register("trainer2", now=t0 + 1.0)
+    c.note_incident({"event": "stall", "rank": 1, "excess_ms": 1200.0})
+    # a partial (in-progress) sharded-checkpoint barrier report
+    c.ckpt_barrier.shard_commit(step=12, rank=0, world_size=2,
+                                info={"manifest_sha256": "abc"})
+    c._sdc_evicted.add("trainer9")
+    return c
+
+
+# ---------------------------------------------------------------------------
+# durable state: snapshot round-trip, WAL replay, torn fallback
+# ---------------------------------------------------------------------------
+
+
+def test_state_dict_roundtrip_every_table():
+    c = _populated()
+    now = 1002.0
+    st = c.state_dict(now=now)
+    c2 = Coordinator(lease_secs=1.0, retries_per_rank=2,
+                     startup_grace=5.0)
+    c2.load_state_dict(st, now=now)
+    # membership epoch + member tags + payloads (election grants ride
+    # the pserver payload) + budgets
+    assert c2.epoch == c.epoch
+    assert sorted(c2.members) == sorted(c.members)
+    assert c2.members["trainer1"].payload == {"step": 8}
+    assert c2.members["ps0"].payload["partitions"]["tab@p0"] == {
+        "role": "primary", "epoch": 3, "seq": 41}
+    assert c2.members["trainer2"].failures == 1
+    # lease windows restore as REMAINING time against the new clock
+    for tag, m in c.members.items():
+        assert c2.members[tag].expires == pytest.approx(m.expires)
+        assert c2.members[tag].evicted == m.evicted
+    # event + incident rings
+    assert [e["event"] for e in c2.incidents] == [
+        e["event"] for e in c.incidents]
+    assert len(c2.events) == len(c.events)
+    # CkptBarrier partial reports
+    assert c2.ckpt_barrier.status(12)["shards"][0][
+        "manifest_sha256"] == "abc"
+    assert not c2.ckpt_barrier.status(12)["complete"]
+    # SDC eviction set
+    assert c2._sdc_evicted == {"trainer9"}
+
+
+def test_durable_recovery_replays_wal_and_bumps_incarnation(tmp_path):
+    d = str(tmp_path / "state")
+    c = _populated(state_dir=d)
+    assert c.incarnation == 1  # fresh durable primary
+    c.snapshot(force=True)
+    # mutations AFTER the snapshot land only in the WAL
+    c.renew("trainer0", payload={"step": 99}, epoch=0, now=2000.0)
+    c.report_failure("trainer1", reason="post-snap")
+    c.ckpt_barrier.shard_commit(step=12, rank=1, world_size=2,
+                                info={"manifest_sha256": "def"})
+    c._mutated("ckpt_shard_commit", {"step": 12, "rank": 1,
+                                     "world_size": 2,
+                                     "info": {"manifest_sha256": "def"}})
+
+    r = Coordinator(lease_secs=1.0, retries_per_rank=2,
+                    startup_grace=5.0, state_dir=d, snapshot_secs=3600.0)
+    assert r.incarnation == 2  # prior + 1
+    assert r.members["trainer0"].payload == {"step": 99}
+    assert r.members["trainer1"].failures == 1
+    assert r.ckpt_barrier.status(12)["complete"]  # both shards replayed
+    # recovery is an incident-worthy event
+    assert any(e.get("event") == "coord_recovered" for e in r.incidents)
+
+
+def test_torn_newest_snapshot_falls_back_to_previous(tmp_path):
+    d = str(tmp_path / "state")
+    c = _populated(state_dir=d)
+    c.snapshot(force=True)
+    c.renew("trainer0", payload={"step": 50}, epoch=0, now=2000.0)
+    c.snapshot(force=True)
+    newest = max(int(f.split("-")[1].split(".")[0])
+                 for f in os.listdir(d) if f.endswith(".snap"))
+    # tear the newest snapshot mid-write (bad digest)
+    p = os.path.join(d, f"coord-{newest:08d}.snap")
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[:len(blob) // 2])
+
+    r = Coordinator(lease_secs=1.0, retries_per_rank=2,
+                    startup_grace=5.0, state_dir=d, snapshot_secs=3600.0)
+    # the previous intact snapshot + its WAL tail still carry the renew
+    assert r.members["trainer0"].payload == {"step": 50}
+    assert r.incarnation == 2
+
+
+def test_recovery_reconciliation_window_never_false_evicts(tmp_path):
+    d = str(tmp_path / "state")
+    lease = 0.2
+    c = Coordinator(lease_secs=lease, retries_per_rank=0,
+                    startup_grace=0.3, state_dir=d, snapshot_secs=3600.0)
+    c.register("trainer0", now=time.time())
+    c.renew("trainer0", epoch=0, now=time.time())
+    c.snapshot(force=True)
+
+    time.sleep(3 * lease)  # the "outage": well past the lease window
+    r = Coordinator(lease_secs=lease, retries_per_rank=0,
+                    startup_grace=0.3, state_dir=d, snapshot_secs=3600.0)
+    # inside the reconciliation window: NO lease may be declared
+    # expired, even though wall-clock says trainer0 lapsed long ago
+    assert r.sweep() == []
+    assert r.coord_status()["reconcile_remaining_s"] > 0
+    # trainer0 never renews against the recovered coordinator: once the
+    # window lapses the expiry is real
+    deadline = time.time() + 10 * lease
+    raised = []
+    while time.time() < deadline and not raised:
+        raised = r.sweep()
+        time.sleep(lease / 4)
+    assert [e["tag"] for e in raised] == ["trainer0"]
+
+
+# ---------------------------------------------------------------------------
+# incarnation fence + wire compatibility
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_incarnation_zero_wire_is_unchanged():
+    c = Coordinator(lease_secs=1.0)
+    out = c.handle("register", {"tag": "trainer0"})
+    assert "coord_incarnation" not in out
+    assert "stale_coordinator" not in out
+    out = c.handle("renew", {"tag": "trainer0"})
+    assert "coord_incarnation" not in out
+    # and the client sends no incarnation claim until it has seen one
+    client = CoordinatorClient.__new__(CoordinatorClient)
+    client.last_incarnation = 0
+    assert client._id_kwargs() == {}
+    client.last_incarnation = 3
+    assert client._id_kwargs() == {"coord_inc": 3}
+
+
+def test_durable_replies_stamp_incarnation(tmp_path):
+    c = Coordinator(lease_secs=1.0, state_dir=str(tmp_path / "s"),
+                    snapshot_secs=3600.0)
+    out = c.handle("register", {"tag": "trainer0"})
+    assert out["coord_incarnation"] == 1
+
+
+def test_deposed_primary_latches_stale(tmp_path):
+    c = Coordinator(lease_secs=1.0, state_dir=str(tmp_path / "s"),
+                    snapshot_secs=3600.0)
+    assert c.incarnation == 1
+    # a member that has talked to incarnation 3 proves we were deposed
+    out = c.handle("renew", {"tag": "trainer0", "coord_inc": 3})
+    assert out["stale_coordinator"] is True
+    assert c.stale_latched
+    # latched: authority replies keep carrying the stale marker and
+    # sweeps exercise no expiry authority
+    out = c.handle("register", {"tag": "trainer1", "coord_inc": 1})
+    assert out["stale_coordinator"] is True
+    assert c.sweep(now=time.time() + 1e6) == []
+    # the ckpt barrier on a deposed primary refuses like a standby, so
+    # _RPCBarrier rotates to the real primary
+    out = c.handle("ckpt_shard_commit",
+                   {"step": 1, "rank": 0, "world_size": 2, "info": {}})
+    assert out.get("standby") is True
+
+
+def test_client_rejects_lower_incarnation_reply(tmp_path):
+    """A client that has seen incarnation N treats a reply stamped < N
+    as a dead endpoint: rotate (split-brain fence, client side)."""
+    stale = Coordinator(lease_secs=1.0, state_dir=str(tmp_path / "a"),
+                        snapshot_secs=3600.0)  # incarnation 1
+    srv, ep = serve_coordinator(stale)
+    try:
+        client = CoordinatorClient(ep, tag="trainer0", kind="trainer")
+        client.last_incarnation = 3  # learned from the promoted standby
+        before = _REG.counter(
+            "coordinator_client_stale_replies_total").value
+        with pytest.raises(ConnectionError, match="stale coordinator"):
+            client.call("renew", tag="trainer0",
+                        **client._id_kwargs())
+        assert _REG.counter(
+            "coordinator_client_stale_replies_total").value > before
+        client.close()
+    finally:
+        stop_coordinator(srv)
+
+
+# ---------------------------------------------------------------------------
+# outage-tolerant clients: grace mode, fresh-socket reconnect, deadline
+# ---------------------------------------------------------------------------
+
+
+def test_client_grace_mode_buffers_and_reregisters(tmp_path):
+    d = str(tmp_path / "state")
+    c1 = Coordinator(lease_secs=1.0, retries_per_rank=1,
+                     startup_grace=5.0, state_dir=d, snapshot_secs=3600.0)
+    srv1, ep = serve_coordinator(c1)
+    port = int(ep.rsplit(":", 1)[1])
+    client = CoordinatorClient(ep, tag="trainer0", kind="trainer",
+                               deadline=0.5)
+    assert client.register({"step": 1})["evicted"] is False
+    assert client.last_incarnation == 1
+
+    # the coordinator dies: renew must RAISE (callers swallow it) and
+    # the client enters grace mode with the payload buffered
+    stop_coordinator(srv1)
+    with pytest.raises(ConnectionError):
+        client.renew({"step": 2})
+    assert client.grace is True
+    assert client._buffered_payload == {"step": 2}
+    # training continued; a second renewal during the outage just
+    # refreshes the buffer
+    with pytest.raises(ConnectionError):
+        client.renew({"step": 3})
+    assert client._buffered_payload == {"step": 3}
+
+    # respawn from durable state on the SAME port — the old socket is
+    # dead, so only a fresh-socket reconnect can succeed
+    c2 = Coordinator(lease_secs=1.0, retries_per_rank=1,
+                     startup_grace=5.0, state_dir=d, snapshot_secs=3600.0)
+    assert c2.incarnation == 2
+    srv2, _ = serve_coordinator(c2, port=port)
+    try:
+        out = client.renew({"step": 4})
+        assert out["evicted"] is False
+        assert client.grace is False
+        assert client.last_incarnation == 2
+        # the reconnect re-registered idempotently: the member exists
+        # with its payload and nothing evicted it
+        m = c2.membership()["members"]["trainer0"]
+        assert m["payload"] == {"step": 4}
+        client.close()
+    finally:
+        stop_coordinator(srv2)
+
+
+def test_call_deadline_env_caps_verb_deadline(monkeypatch):
+    monkeypatch.setenv(coord_mod.ENV_CALL_DEADLINE, "0.7")
+    client = CoordinatorClient("127.0.0.1:1", tag="t0")
+    assert client.deadline == 0.7
+    monkeypatch.delenv(coord_mod.ENV_CALL_DEADLINE)
+    client2 = CoordinatorClient("127.0.0.1:1", tag="t0")
+    assert client2.deadline == 3.0  # default
+
+
+def test_client_fails_over_down_ordered_endpoint_list():
+    c = Coordinator(lease_secs=1.0, startup_grace=5.0)
+    c.incarnation = 5  # pretend-durable so replies are stamped
+    srv, ep = serve_coordinator(c)
+    try:
+        # first endpoint is dead: the client rotates and succeeds on
+        # the second without exhausting retries against the corpse
+        client = CoordinatorClient(f"127.0.0.1:1,{ep}", tag="trainer0",
+                                   deadline=0.5)
+        out = client.register()
+        assert out["evicted"] is False
+        assert client.last_incarnation == 5
+        client.close()
+    finally:
+        stop_coordinator(srv)
+
+
+# ---------------------------------------------------------------------------
+# warm standby: replication, authority refusal, promotion fence
+# ---------------------------------------------------------------------------
+
+
+def test_standby_mirrors_refuses_then_promotes(tmp_path):
+    primary = _populated(state_dir=str(tmp_path / "p"))
+    primary.snapshot(force=True)
+    primary.renew("trainer0", payload={"step": 123}, epoch=0, now=3000.0)
+
+    standby = Coordinator(lease_secs=1.0, retries_per_rank=2,
+                          startup_grace=5.0, role="standby",
+                          state_dir=str(tmp_path / "s"),
+                          snapshot_secs=3600.0)
+    # first pull: seq mismatch → full snapshot + WAL tail
+    standby.repl_apply(primary.repl_pull(have_seq=-1, have_off=0))
+    assert standby.members["trainer0"].payload == {"step": 123}
+    assert standby.incarnation == primary.incarnation
+    assert standby._snap_seq == primary._snap_seq
+    # incremental pull: only the missing WAL records ship
+    off = len(primary._wal_mem)
+    primary.renew("trainer1", payload={"step": 124}, epoch=0, now=3001.0)
+    pulled = primary.repl_pull(have_seq=primary._snap_seq, have_off=off)
+    assert "snapshot" not in pulled and len(pulled["wal"]) == 1
+    standby.repl_apply(pulled)
+    assert standby.members["trainer1"].payload == {"step": 124}
+
+    # an unpromoted follower refuses authority and barrier verbs
+    for verb, kw in (("renew", {"tag": "trainer0"}),
+                     ("ckpt_shard_commit", {"step": 1, "rank": 0,
+                                            "world_size": 2, "info": {}})):
+        out = standby.handle(verb, kw)
+        assert out.get("standby") is True
+    assert standby.sweep(now=time.time() + 1e6) == []
+
+    # promotion: +2 always out-fences a crash-respawned old primary
+    # (which bumps by one), and the takeover arms the reconciliation
+    # window exactly like a respawn
+    old_inc = primary.incarnation
+    standby.promote()
+    assert standby.role == "primary"
+    assert standby.incarnation == old_inc + 2
+    assert standby.incarnation > old_inc + 1
+    assert standby.sweep() == []  # reconciliation window armed
+    assert any(e.get("event") == "coord_promoted"
+               for e in standby.incidents)
+    # the promoted standby now answers authority verbs
+    out = standby.handle("renew", {"tag": "trainer0"})
+    assert out["coord_incarnation"] == old_inc + 2
+    assert "standby" not in out
+
+
+def test_follower_thread_streams_and_promotes_on_silence():
+    lease = 0.2
+    primary = Coordinator(lease_secs=lease, startup_grace=1.0)
+    primary.incarnation = 1  # durable-mode primary (no disk needed)
+    srv, ep = serve_coordinator(primary)
+    standby = Coordinator(lease_secs=lease, startup_grace=1.0,
+                          role="standby")
+    follower = CoordinatorFollower(standby, ep,
+                                   interval=lease / 4).start()
+    try:
+        primary.register("trainer0", now=time.time())
+        primary.renew("trainer0", payload={"step": 5}, epoch=0,
+                      now=time.time())
+        deadline = time.time() + 20 * lease
+        while time.time() < deadline and \
+                "trainer0" not in standby.members:
+            time.sleep(lease / 5)
+        assert standby.members["trainer0"].payload == {"step": 5}
+        assert standby.incarnation == 1
+
+        # the primary dies for good: the follower's pulls fail and it
+        # promotes itself once the incarnation lease lapses
+        stop_coordinator(srv)
+        deadline = time.time() + 40 * lease
+        while time.time() < deadline and standby.role != "primary":
+            time.sleep(lease / 4)
+        assert standby.role == "primary"
+        assert standby.incarnation == 3  # 1 + 2: above any respawn
+    finally:
+        follower.stop()
+        stop_coordinator(srv)
+
+
+def test_rpc_barrier_rotates_off_standby_to_primary():
+    from paddle_tpu.fluid.checkpoint import _RPCBarrier
+
+    standby = Coordinator(lease_secs=1.0, role="standby")
+    primary = Coordinator(lease_secs=1.0)
+    s1, ep1 = serve_coordinator(standby)
+    s2, ep2 = serve_coordinator(primary)
+    try:
+        barrier = _RPCBarrier(f"{ep1},{ep2}")
+        barrier.shard_commit(3, 0, 2, {"manifest_sha256": "aa"})
+        barrier.shard_commit(3, 1, 2, {"manifest_sha256": "bb"})
+        # the reports landed on the PRIMARY (the standby refused)
+        assert primary.ckpt_barrier.status(3)["complete"]
+        assert not standby.ckpt_barrier.status(3)["shards"]
+        shards = barrier.wait_full(3, 2, timeout=2.0)
+        assert shards and shards[1]["manifest_sha256"] == "bb"
+    finally:
+        stop_coordinator(s1)
+        stop_coordinator(s2)
+
+
+# ---------------------------------------------------------------------------
+# observability: coord_status verb, goodput/goodtop labeling
+# ---------------------------------------------------------------------------
+
+
+def test_coord_status_verb_reports_ha_row(tmp_path):
+    c = Coordinator(lease_secs=1.0, state_dir=str(tmp_path / "s"),
+                    snapshot_secs=3600.0)
+    c.register("trainer0")
+    srv, ep = serve_coordinator(c)
+    try:
+        client = CoordinatorClient(ep, tag="probe")
+        st = client.call("coord_status")
+        assert st["incarnation"] == 1 and st["role"] == "primary"
+        assert st["durable"] is True and st["stale"] is False
+        assert st["members"] == 1
+        assert st["snapshot_seq"] >= 1
+        assert st["last_snapshot_age_s"] is not None
+        client.close()
+    finally:
+        stop_coordinator(srv)
+
+
+def test_goodput_labels_coord_outage_distinct_from_restart(tmp_path):
+    from paddle_tpu.telemetry import goodput
+
+    led = goodput.LauncherLedger(str(tmp_path))
+    led.event(event="coord_outage", detect_ts=100.0, respawn_ts=100.9,
+              incarnation=2)
+    led.event(event="coord_outage", detect_ts=200.0, respawn_ts=200.4)
+    view = goodput.stitch_job(str(tmp_path))
+    outages = [i for i in view["incidents"]
+               if i.get("kind") == "coord_outage"]
+    assert len(outages) == 2
+    # gap_s derived from the timestamps when the event lacks it
+    assert outages[0]["gap_s"] == pytest.approx(0.9, abs=0.01)
+    assert not any(i.get("kind") == "restart" for i in view["incidents"])
+
+    # goodtop renders the control-plane outage distinctly from a rank
+    # death (the "no rank died" line is the point)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import goodtop
+    finally:
+        sys.path.pop(0)
+    out = io.StringIO()
+    goodtop.render_incidents(view, out)
+    text = out.getvalue()
+    assert "control-plane outage" in text
+    assert "no rank died" in text
+    assert "incarnation 2" in text
+
+
+def test_fleet_status_carries_coord_outage_note():
+    c = Coordinator(lease_secs=1.0)
+    c.note_incident({"event": "coord_outage", "gap_s": 1.5,
+                     "incarnation": 2})
+    note = c.fleet_status().get("coord_outage_note")
+    assert note and "1.5" in note
+
+
+# ---------------------------------------------------------------------------
+# slow drills (tools/ci.sh control-plane lane)
+# ---------------------------------------------------------------------------
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    for k in ("PADDLE_PSERVERS_IP_PORT_LIST", "PADDLE_TRAINERS_NUM",
+              "PADDLE_PS_FAULT_SPEC", "FLAGS_ps_fault_injection",
+              "PADDLE_ELASTIC_RESTART", "PADDLE_CKPT_SHARDED",
+              "PADDLE_CKPT_ASYNC", "PADDLE_CKPT_BARRIER_ENDPOINT",
+              "PADDLE_PS_FAULT_TAGS", "PADDLE_TRAINER_ID",
+              "PADDLE_COORD_SNAPSHOT_SECS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.update(extra or {})
+    return env
+
+
+def _read_trace(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+@pytest.mark.slow
+def test_coordinator_kill_respawn_drill_bit_identical(tmp_path):
+    """Acceptance (CI lane): the durable coordinator process is killed
+    at its 25th handled verb while 2 trainers + 1 pserver train with
+    sharded checkpoints in flight. The launcher respawns it from its
+    snapshot+WAL on the same port; trainers ride the outage out in
+    grace mode — ZERO evictions, zero elastic restarts, the checkpoint
+    stream reaches its final global commit, and the loss trace is
+    bit-identical to the no-fault run's."""
+    from paddle_tpu.fluid.checkpoint import CheckpointManager
+
+    base = {
+        "PADDLE_CKPT_SHARDED": "1",
+        "PADDLE_COORD_SNAPSHOT_SECS": "0.2",
+    }
+    args = [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2", "--server_num", "1",
+            "--lease_secs", "2", "--elastic_retries", "1"]
+
+    # reference: the same durable-coordinator job with NO fault
+    ref = dict(base, CKPT_TEST_DIR=str(tmp_path / "ref_ck"),
+               CKPT_TEST_TRACE=str(tmp_path / "ref_trace"))
+    r = subprocess.run(args + ["--log_dir", str(tmp_path / "ref_logs"),
+                               SHARD_WORKER],
+                       env=_env(ref), capture_output=True, text=True,
+                       timeout=300, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+    # drill: kill the coordinator at its 25th verb (mid-job, with
+    # renewals and shard commits in flight)
+    drill = dict(base,
+                 CKPT_TEST_DIR=str(tmp_path / "ck"),
+                 CKPT_TEST_TRACE=str(tmp_path / "trace"),
+                 FLAGS_ps_fault_injection="1",
+                 PADDLE_PS_FAULT_SPEC="crash:coord_verb:25",
+                 PADDLE_PS_FAULT_TAGS="coord")
+    r = subprocess.run(args + ["--log_dir", str(tmp_path / "logs"),
+                               SHARD_WORKER],
+                       env=_env(drill), capture_output=True, text=True,
+                       timeout=300, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    # the coordinator actually died and was respawned from durable state
+    # (the "reachable again" outage incident only prints when a proxy
+    # verb happened to land inside the sub-second outage window, so the
+    # respawn line is the assertion)
+    assert "respawning on the same port" in out, out
+    # zero false evictions, zero elastic restarts: the data plane never
+    # noticed beyond the grace window
+    assert "member_evicted" not in out
+    assert "lease_expired" not in out
+    assert "elastic restart" not in out
+    # the ONLY process that died is the coordinator itself
+    assert not [ln for ln in out.splitlines()
+                if "exited with" in ln and "coordinator" not in ln], out
+
+    # the in-flight sharded checkpoint stream reached its final global
+    # commit after recovery
+    mgr = CheckpointManager(str(tmp_path / "ck"), world_size=2, rank=0,
+                            sharded=True)
+    assert mgr.steps() and max(mgr.steps()) == 24
+
+    # loss traces bit-identical to the no-fault run, both ranks
+    for rank in (0, 1):
+        got = _read_trace(f"{tmp_path}/trace.{rank}")
+        want = _read_trace(f"{tmp_path}/ref_trace.{rank}")
+        assert got == want, f"rank {rank} trace diverged"
+
+
+class _StubPS:
+    """A promote-accepting pserver stand-in on the real RPC transport:
+    the standby-promotion drill asserts the promoted coordinator's
+    election RPC actually lands."""
+
+    def __init__(self):
+        self.promotions = []
+        self.shutdown_event = threading.Event()
+
+    def handle(self, method, kwargs):
+        if method == "ping":
+            return "pong"
+        if method == "promote":
+            self.promotions.append(dict(kwargs))
+            return {"ok": True, "epoch": kwargs.get("epoch")}
+        raise ValueError(f"unexpected verb {method!r}")
+
+
+def _serve_stub():
+    srv = ps_server._TCPServer(("127.0.0.1", 0), ps_server._Handler)
+    stub = _StubPS()
+    srv.ps = stub
+    threading.Thread(target=srv.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    return srv, stub, f"127.0.0.1:{srv.server_address[1]}"
+
+
+@pytest.mark.slow
+def test_standby_promotion_drill_ps_election_survives(tmp_path):
+    """Acceptance (CI lane): the primary coordinator dies for good; the
+    warm standby (following over the snapshot+WAL stream) promotes
+    itself, clients fail over down the ordered endpoint list and reject
+    the deposed primary's replies, and the promoted coordinator still
+    exercises PS ELECTION authority: a dead pserver's partition is
+    granted to the caught-up backup via a real promote RPC."""
+    lease = 0.3
+    sa, stub_a, ep_a = _serve_stub()
+    sb, stub_b, ep_b = _serve_stub()
+
+    primary = Coordinator(lease_secs=lease, startup_grace=1.0,
+                          state_dir=str(tmp_path / "p"),
+                          snapshot_secs=0.1)
+    psrv, pep = serve_coordinator(primary)
+    standby = Coordinator(lease_secs=lease, startup_grace=1.0,
+                          role="standby",
+                          state_dir=str(tmp_path / "s"),
+                          snapshot_secs=0.1)
+    ssrv, sep = serve_coordinator(standby)
+    follower = CoordinatorFollower(standby, pep,
+                                   interval=lease / 4).start()
+    client = CoordinatorClient(f"{pep},{sep}", tag="trainer0",
+                               kind="trainer", deadline=0.5)
+    try:
+        # two pservers: ps0 is primary for tab@p0, ps1 the caught-up
+        # backup. Registered through the PRIMARY coordinator; the
+        # standby learns them through replication only.
+        client.register()
+        for tag, ep, role in (("ps0", ep_a, "primary"),
+                              ("ps1", ep_b, "backup")):
+            primary.register(tag, kind="pserver", endpoint=ep,
+                             payload={"partitions": {
+                                 "tab@p0": {"role": role, "epoch": 1,
+                                            "seq": 10, "stale": False}}})
+            primary.renew(tag, payload={"partitions": {
+                "tab@p0": {"role": role, "epoch": 1, "seq": 10,
+                           "stale": False}}}, epoch=0)
+        deadline = time.time() + 20 * lease
+        while time.time() < deadline and "ps1" not in standby.members:
+            time.sleep(lease / 5)
+        assert "ps1" in standby.members  # replication caught up
+        inc0 = client.last_incarnation
+        assert inc0 >= 1
+
+        # the primary dies for good (no respawn): the follower promotes
+        # itself once the incarnation lease lapses
+        stop_coordinator(psrv)
+        deadline = time.time() + 60 * lease
+        while time.time() < deadline and standby.role != "primary":
+            time.sleep(lease / 4)
+        assert standby.role == "primary"
+        assert standby.incarnation == inc0 + 2
+
+        # clients fail over down the ordered list and learn the fence
+        out = client.renew()
+        assert out["evicted"] is False
+        assert client.last_incarnation == inc0 + 2
+
+        # ps1 keeps renewing against the PROMOTED coordinator; ps0 is
+        # dead silent. After the reconciliation window lapses its lease
+        # expires and the promoted coordinator elects ps1 — the promote
+        # RPC lands on stub B.
+        promoted = []
+        deadline = time.time() + 80 * lease
+        while time.time() < deadline and not promoted:
+            standby.renew("ps1", payload={"partitions": {
+                "tab@p0": {"role": "backup", "epoch": 1, "seq": 10,
+                           "stale": False}}}, epoch=0)
+            promoted = [e for e in standby.sweep()
+                        if e.get("event") == "ps_promoted"]
+            time.sleep(lease / 5)
+        assert promoted, standby.drain_events()
+        assert promoted[0]["key"] == "tab@p0"
+        assert promoted[0]["to"] == "ps1"
+        assert stub_b.promotions and \
+            stub_b.promotions[0]["epoch"] == 2
+        assert not stub_a.promotions  # the dead primary got nothing
+    finally:
+        follower.stop()
+        client.close()
+        stop_coordinator(psrv)
+        stop_coordinator(ssrv)
+        for s in (sa, sb):
+            stop_coordinator(s)

@@ -376,6 +376,68 @@ def test_engine_sampling_deterministic_and_resume_replays():
         eng.stop()
 
 
+def test_sample_token_top_p_unit():
+    rng = np.random.default_rng(7)
+    logits = rng.standard_normal(64).astype(np.float32)
+    # top_p absent / >= 1.0 leaves the distribution untouched: the
+    # r22 wire (no top_p anywhere) stays bit-identical
+    for i in range(8):
+        base = _sample_token(logits, 1.3, None, seed=11, index=i)
+        assert _sample_token(logits, 1.3, None, seed=11, index=i,
+                             top_p=None) == base
+        assert _sample_token(logits, 1.3, None, seed=11, index=i,
+                             top_p=1.0) == base
+    # a dominant token (mass ~0.98 at temperature 1) is the whole
+    # nucleus at top_p=0.5: every draw collapses onto it
+    peaked = np.full(32, -4.0, np.float32)
+    peaked[17] = 4.0
+    for i in range(16):
+        assert _sample_token(peaked, 1.0, None, seed=3, index=i,
+                             top_p=0.5) == 17
+    # draws never leave the nucleus (the smallest prefix of the sorted
+    # distribution whose mass reaches top_p)
+    temp, top_p = 1.5, 0.6
+    probs = np.exp(logits.astype(np.float64) / temp
+                   - (logits.astype(np.float64) / temp).max())
+    probs /= probs.sum()
+    order = np.argsort(-probs, kind="stable")
+    cut = int(np.searchsorted(np.cumsum(probs[order]), top_p)) + 1
+    nucleus = set(int(t) for t in order[:cut])
+    assert 1 <= len(nucleus) < logits.size
+    for i in range(64):
+        tok = _sample_token(logits, temp, None, seed=5, index=i,
+                            top_p=top_p)
+        assert tok in nucleus
+    # counter-mode contract holds with the filter on: pure function of
+    # (logits, knobs, seed, index)
+    assert _sample_token(logits, temp, None, seed=5, index=9,
+                         top_p=top_p) \
+        == _sample_token(logits, temp, None, seed=5, index=9,
+                         top_p=top_p)
+    # composes after top-k: with top_k=2 the nucleus is a subset of the
+    # two highest-logit tokens
+    top2 = set(int(t) for t in np.argsort(-logits)[:2])
+    for i in range(32):
+        assert _sample_token(logits, 2.0, 2, seed=8, index=i,
+                             top_p=0.9) in top2
+
+
+def test_engine_top_p_resume_replays_bit_identical():
+    eng = _mk_engine(kv=True)
+    try:
+        kw = dict(max_new_tokens=6, temperature=1.2, top_p=0.8, seed=42)
+        a = eng.result(eng.submit(PROMPT, **kw), timeout=120)["tokens"]
+        b = eng.result(eng.submit(PROMPT, **kw), timeout=120)["tokens"]
+        assert a == b and len(a) == 6
+        # a mid-stream resume replays the nucleus-sampled tail exactly:
+        # token i depends on (prefix logits, seed, i) only
+        r = eng.result(eng.submit(PROMPT, resume_tokens=a[:2], **kw),
+                       timeout=120)
+        assert r["tokens"] == a and r["resumed_from"] == 2
+    finally:
+        eng.stop()
+
+
 # ---------------------------------------------------------------------------
 # server dedup: exactly-once generate
 # ---------------------------------------------------------------------------
@@ -465,6 +527,37 @@ def test_tcp_marked_retry_runs_model_once(gen_frozen, monkeypatch,
             == hits0 + 1
         assert _REG.counter("serve_retry_received_total",
                             verb="generate").value == retries0 + 1
+        cli.close()
+    finally:
+        _stop_tcp(srv)
+        inf.close()
+
+
+def test_client_plumbs_top_p_end_to_end(gen_frozen, monkeypatch):
+    """top_p rides beside temperature/top-k through the whole stack:
+    client kwargs -> server generate verb -> engine submit. The client
+    and a direct engine submit with the same knobs produce the same
+    nucleus-sampled stream, on both the blocking and streaming paths."""
+    from paddle_tpu.inference import weight_sync as ws
+
+    monkeypatch.setenv(ws.ENV_SYNC, "0")
+    eng = _mk_engine(kv=True)
+    inf = InferenceServer(gen_frozen, weight_subscribe=False, engine=eng)
+    srv, ep = _start_tcp(inf)
+    try:
+        want = eng.result(
+            eng.submit(PROMPT, max_new_tokens=6, temperature=1.2,
+                       top_p=0.8, seed=42), timeout=120)["tokens"]
+        cli = InferenceClient([ep])
+        res = cli.generate(PROMPT, max_new_tokens=6, temperature=1.2,
+                           top_p=0.8, seed=42)
+        assert res.tokens == want
+        got = []
+        for chunk in cli.generate_stream(PROMPT, max_new_tokens=6,
+                                         temperature=1.2, top_p=0.8,
+                                         seed=42):
+            got += chunk
+        assert got == want
         cli.close()
     finally:
         _stop_tcp(srv)

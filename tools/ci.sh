@@ -508,6 +508,22 @@ echo "== crash-tolerant generation drills (mid-decode kill + KV preemption) =="
 # (tests/test_gen_resume.py)
 python -m pytest tests/test_gen_resume.py -q -m slow
 
+echo "== control-plane lane (coordinator kill-and-respawn + standby promotion) =="
+# ISSUE 18 acceptance: (1) kill-and-respawn drill — the durable job
+# coordinator (PADDLE_COORD_SNAPSHOT_SECS armed) is killed at its 25th
+# handled verb while 2 trainers + 1 pserver train with sharded
+# checkpoints in flight; the launcher respawns it from its snapshot+WAL
+# on the same port, trainers ride the outage out in grace mode — ZERO
+# evictions, zero elastic restarts, the checkpoint stream reaches its
+# final global commit, and the loss trace is bit-identical to the
+# no-fault run; (2) standby-promotion drill — the primary dies for
+# good, the warm standby promotes itself behind the +2 incarnation
+# fence, clients fail over down the ordered endpoint list, and the
+# promoted coordinator still exercises PS election authority (the
+# promote RPC lands on the caught-up backup). Fast snapshot/WAL/fence/
+# grace units run in tier-1 above (tests/test_coordinator_ha.py)
+python -m pytest tests/test_coordinator_ha.py -q -m slow
+
 echo "== bench smoke (CPU, tiny shapes, 2 steps) =="
 BENCH_MODEL="${BENCH_SMOKE_MODEL:-resnet18}" python bench.py --smoke \
   | tee /tmp/ci_smoke.json

@@ -117,6 +117,14 @@ def render_incidents(view: dict, out) -> None:
                   f"  cause: {inc.get('cause', '?')}"
                   + (f"  trace: {inc['trace_id']}"
                      if inc.get("trace_id") else ""), file=out)
+        elif inc.get("kind") == "coord_outage":
+            gap = inc.get("gap_s")
+            print(f"coord    control-plane outage  gap "
+                  f"{f'{gap:.2f}s' if gap is not None else '?'}",
+                  file=out)
+            print(f"         no rank died: trainers rode it out in "
+                  f"grace mode; coordinator back at incarnation "
+                  f"{inc.get('incarnation', '?')}", file=out)
 
 
 def main(argv=None) -> int:
