@@ -119,11 +119,22 @@ EXPIRE_PERIODS = float(os.environ.get("PADDLE_LEASE_EXPIRE_PERIODS", 2.0))
 
 ENV_SNAPSHOT_SECS = "PADDLE_COORD_SNAPSHOT_SECS"
 ENV_CALL_DEADLINE = "PADDLE_COORD_CALL_DEADLINE_SECS"
+# size-based WAL compaction: once the current WAL segment exceeds this
+# many bytes a snapshot is taken and the WAL rotates, regardless of the
+# time-based snapshot cadence (0 = disabled, time/record triggers only)
+ENV_WAL_MAX_BYTES = "PADDLE_COORD_WAL_MAX_BYTES"
 
 
 def snapshot_secs_from_env(default: float = 1.0) -> float:
     try:
         return float(os.environ.get(ENV_SNAPSHOT_SECS) or default)
+    except ValueError:
+        return default
+
+
+def wal_max_bytes_from_env(default: int = 0) -> int:
+    try:
+        return int(os.environ.get(ENV_WAL_MAX_BYTES) or default)
     except ValueError:
         return default
 
@@ -400,6 +411,7 @@ class Coordinator:
                  startup_grace: Optional[float] = None,
                  state_dir: Optional[str] = None,
                  snapshot_secs: Optional[float] = None,
+                 wal_max_bytes: Optional[int] = None,
                  role: str = "primary"):
         self.lease_secs = float(lease_secs)
         self.retries_per_rank = int(retries_per_rank)
@@ -440,11 +452,15 @@ class Coordinator:
         self.snapshot_secs = (float(snapshot_secs)
                               if snapshot_secs is not None
                               else snapshot_secs_from_env())
+        self.wal_max_bytes = (int(wal_max_bytes)
+                              if wal_max_bytes is not None
+                              else wal_max_bytes_from_env())
         self._reconcile_until = 0.0  # no expiries before this instant
         self._snap_seq = 0
         self._last_snap = 0.0
         self._wal_f = None  # open WAL file (durable primary only)
         self._wal_mem: List[Tuple[str, dict]] = []  # repl_pull stream
+        self._wal_bytes = 0  # serialized bytes in the current segment
         self._replaying = False  # WAL/replication apply in progress
         if self.state_dir:
             os.makedirs(self.state_dir, exist_ok=True)
@@ -583,6 +599,7 @@ class Coordinator:
                     except OSError:
                         pass
         self._wal_mem = []
+        self._wal_bytes = 0
         _REG.counter("coordinator_snapshots_total").inc()
 
     def snapshot(self, force: bool = False,
@@ -603,16 +620,19 @@ class Coordinator:
         with self.lock:
             rec = (verb, kw)
             self._wal_mem.append(rec)
+            blob = pickle.dumps(rec)
+            self._wal_bytes += 4 + len(blob)  # length prefix + payload
             if self._wal_f is not None:
                 try:
-                    blob = pickle.dumps(rec)
                     self._wal_f.write(struct.pack(">I", len(blob)) + blob)
                     self._wal_f.flush()
                 except OSError:
                     pass
             now = time.time()
             if (now - self._last_snap >= self.snapshot_secs
-                    or len(self._wal_mem) > 4096):
+                    or len(self._wal_mem) > 4096
+                    or (self.wal_max_bytes > 0
+                        and self._wal_bytes >= self.wal_max_bytes)):
                 self._snapshot_locked(now)
 
     def _apply(self, verb: str, kw: dict) -> None:
@@ -760,6 +780,7 @@ class Coordinator:
                 "last_snapshot_age_s": (round(now - self._last_snap, 3)
                                         if self._last_snap else None),
                 "wal_records": len(self._wal_mem),
+                "wal_bytes": self._wal_bytes,
                 "reconcile_remaining_s": round(
                     max(0.0, self._reconcile_until - now), 3),
             }

@@ -361,12 +361,24 @@ class InferenceClient:
         abs_deadline = (None if deadline_ms is None
                         else t0 + float(deadline_ms) / 1e3)
         hops = 0
+        # root span for the WHOLE generation: the context rides every
+        # attempt's RPC payload, so after a failover both replicas'
+        # server+engine spans share this one trace_id
+        root = _tracing.begin(
+            "generate", kind="client",
+            attrs={"prompt_len": len(kwargs["prompt"]),
+                   "max_new_tokens": int(max_new_tokens),
+                   "request_id": kwargs["request_id"]})
+        ctx = (None if root is None
+               else (root.trace_id, root.span_id))
+        status = "error"
         try:
             while True:
                 with self._lock:
                     j = self._primary
                 try:
-                    reply = self._conns[j].call("generate", **kwargs)
+                    with _tracing.attach(ctx):
+                        reply = self._conns[j].call("generate", **kwargs)
                 except ConnectionError:
                     if hops >= len(self.endpoints):
                         raise
@@ -384,8 +396,12 @@ class InferenceClient:
                     raise _map_app_error(e) from None
                 with self._lock:
                     replica = self.endpoints[self._primary]
+                status = None
+                if root is not None:
+                    root.attrs.update(replica=replica, failovers=hops)
                 return self.GenerateResult(reply, replica)
         finally:
+            _tracing.finish(root, status=status)
             _REG.histogram(
                 "serve_client_generate_ms",
                 help="caller-observed generation latency").observe(
@@ -399,7 +415,8 @@ class InferenceClient:
                         temperature: Optional[float] = None,
                         top_k: Optional[int] = None,
                         seed: Optional[int] = None,
-                        top_p: Optional[float] = None):
+                        top_p: Optional[float] = None,
+                        timings: Optional[dict] = None):
         """Incremental generation: yields lists of new tokens as the
         replica's decode loop produces them.  The PS transport is
         one-shot request/reply, so streaming is poll-based: `generate`
@@ -413,7 +430,15 @@ class InferenceClient:
         one epoch the resumed tail is bit-identical (greedy decode is
         deterministic; sampling is counter-mode keyed on (seed, index));
         across an epoch boundary the server refuses and the caller gets
-        ResumedOnNewWeightsError with the partial tokens attached."""
+        ResumedOnNewWeightsError with the partial tokens attached.
+
+        ``timings``: an optional dict the client fills IN PLACE with
+        caller-observed SLO numbers — ``ttft_ms`` (call start to first
+        token arrival), ``tpot_avg_ms`` (mean inter-token gap),
+        ``token_ts_ms`` (per-token arrival offsets from call start; the
+        tokens of one poll chunk share an arrival), ``tokens``. The
+        server-observed ttft is measured at admission, so the delta is
+        exactly network + poll-cadence skew — measurable, not guessed."""
         base = self._gen_kwargs(prompt, max_new_tokens, deadline_ms,
                                 eos_id, temperature, top_k, top_p, seed)
         base["stream"] = True
@@ -423,62 +448,100 @@ class InferenceClient:
         delivered: List[int] = []
         last_epoch: Optional[int] = None
         hops = 0
-        while True:  # one iteration per (re)attach
-            with self._lock:
-                j = self._primary
-            kwargs = dict(base)
-            if hops:
-                kwargs["retry"] = True
-                kwargs["elapsed_ms"] = (time.perf_counter() - t0) * 1e3
-                if abs_deadline is not None:
-                    kwargs["deadline_ms"] = max(
-                        (abs_deadline - time.perf_counter()) * 1e3, 1.0)
-                if delivered:
-                    kwargs["resume_tokens"] = list(delivered)
-                    if last_epoch is not None:
-                        kwargs["expect_epoch"] = int(last_epoch)
-            try:
-                sid = self._conns[j].call("generate",
-                                          **kwargs)["stream_id"]
-                # dedup reattach and resume both pre-seed the stream
-                # with everything already delivered: skip past it
-                cursor = len(delivered)
-                while True:
-                    snap = self._conns[j].call("generate_poll",
-                                               stream_id=sid,
-                                               cursor=cursor)
-                    if snap["tokens"]:
-                        chunk = list(snap["tokens"])
-                        delivered.extend(chunk)
-                        yield chunk
-                    cursor = int(snap["cursor"])
-                    last_epoch = int(snap.get("weight_epoch") or 0)
-                    if snap["done"]:
-                        if snap.get("error"):
-                            err = _map_app_error(
-                                RuntimeError(snap["error"]))
-                            if isinstance(err, ResumedOnNewWeightsError):
-                                err.tokens = list(delivered)
-                            raise err
-                        return
-                    time.sleep(poll_s)
-            except ConnectionError:
-                if hops >= len(self.endpoints):
+        if timings is not None:
+            timings.clear()
+            timings.update(ttft_ms=None, tpot_avg_ms=None,
+                           token_ts_ms=[], tokens=0)
+
+        def _note_arrival(n_new: int) -> None:
+            if timings is None or n_new <= 0:
+                return
+            at_ms = (time.perf_counter() - t0) * 1e3
+            if timings["ttft_ms"] is None:
+                timings["ttft_ms"] = round(at_ms, 3)
+            timings["token_ts_ms"].extend([round(at_ms, 3)] * n_new)
+            timings["tokens"] += n_new
+            if timings["tokens"] > 1:
+                timings["tpot_avg_ms"] = round(
+                    (at_ms - timings["token_ts_ms"][0])
+                    / (timings["tokens"] - 1), 3)
+
+        root = _tracing.begin(
+            "generate_stream", kind="client",
+            attrs={"prompt_len": len(base["prompt"]),
+                   "max_new_tokens": int(max_new_tokens),
+                   "request_id": base["request_id"]})
+        ctx = (None if root is None
+               else (root.trace_id, root.span_id))
+        status = "error"
+        try:
+            while True:  # one iteration per (re)attach
+                with self._lock:
+                    j = self._primary
+                kwargs = dict(base)
+                if hops:
+                    kwargs["retry"] = True
+                    kwargs["elapsed_ms"] = (time.perf_counter() - t0) * 1e3
+                    if abs_deadline is not None:
+                        kwargs["deadline_ms"] = max(
+                            (abs_deadline - time.perf_counter()) * 1e3, 1.0)
+                    if delivered:
+                        kwargs["resume_tokens"] = list(delivered)
+                        if last_epoch is not None:
+                            kwargs["expect_epoch"] = int(last_epoch)
+                try:
+                    with _tracing.attach(ctx):
+                        sid = self._conns[j].call("generate",
+                                                  **kwargs)["stream_id"]
+                    # dedup reattach and resume both pre-seed the stream
+                    # with everything already delivered: skip past it
+                    cursor = len(delivered)
+                    while True:
+                        with _tracing.attach(ctx):
+                            snap = self._conns[j].call("generate_poll",
+                                                       stream_id=sid,
+                                                       cursor=cursor)
+                        if snap["tokens"]:
+                            chunk = list(snap["tokens"])
+                            _note_arrival(len(chunk))
+                            delivered.extend(chunk)
+                            yield chunk
+                        cursor = int(snap["cursor"])
+                        last_epoch = int(snap.get("weight_epoch") or 0)
+                        if snap["done"]:
+                            if snap.get("error"):
+                                err = _map_app_error(
+                                    RuntimeError(snap["error"]))
+                                if isinstance(err,
+                                              ResumedOnNewWeightsError):
+                                    err.tokens = list(delivered)
+                                raise err
+                            status = None
+                            if root is not None:
+                                root.attrs.update(
+                                    failovers=hops,
+                                    tokens=len(delivered))
+                            return
+                        time.sleep(poll_s)
+                except ConnectionError:
+                    if hops >= len(self.endpoints):
+                        raise
+                    hops += 1
+                    self._failover(j)
+                    if delivered:
+                        _REG.counter(
+                            "serve_client_stream_resumes_total").inc()
+                    continue
+                except (OverloadedError, DeadlineExceededError,
+                        ResumedOnNewWeightsError):
                     raise
-                hops += 1
-                self._failover(j)
-                if delivered:
-                    _REG.counter(
-                        "serve_client_stream_resumes_total").inc()
-                continue
-            except (OverloadedError, DeadlineExceededError,
-                    ResumedOnNewWeightsError):
-                raise
-            except RuntimeError as e:
-                err = _map_app_error(e)
-                if isinstance(err, ResumedOnNewWeightsError):
-                    err.tokens = list(delivered)
-                raise err from None
+                except RuntimeError as e:
+                    err = _map_app_error(e)
+                    if isinstance(err, ResumedOnNewWeightsError):
+                        err.tokens = list(delivered)
+                    raise err from None
+        finally:
+            _tracing.finish(root, status=status)
 
     def model_info(self) -> dict:
         return self._call("model_info")

@@ -64,6 +64,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..distributed import faults as _faults
+from ..telemetry import sink as _sink
+from ..telemetry import tracing as _tracing
 from . import decode_model as dm
 from .kv_cache import PagedKVPool
 from .server import (DeadlineExceeded, Overloaded, ResumedOnNewWeights,
@@ -127,7 +129,9 @@ class GenRequest:
                  "pages", "reuse", "pos", "cur_token", "slot",
                  "rc_tokens", "rc_len", "t_first_token",
                  "temperature", "top_k", "top_p", "seed", "resumed_from",
-                 "expect_epoch", "is_resume", "t_preempt", "preempts")
+                 "expect_epoch", "is_resume", "t_preempt", "preempts",
+                 "span", "queue_span", "t_enq", "t_last_token",
+                 "queue_ms")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  eos_id: Optional[int], deadline_t: Optional[float],
@@ -167,6 +171,15 @@ class GenRequest:
         self.is_resume = resume_tokens is not None
         self.t_preempt: Optional[float] = None
         self.preempts = 0
+        # ISSUE 19 request-lifecycle tracing: the umbrella span for the
+        # whole engine residency (parented under the propagated RPC
+        # context so one trace_id spans client -> replica(s)), the open
+        # queue_wait child, and the SLO clocks
+        self.span = None
+        self.queue_span = None
+        self.t_enq = time.monotonic()
+        self.t_last_token: Optional[float] = None
+        self.queue_ms = 0.0
 
     def snapshot(self, cursor: int = 0) -> dict:
         """Streaming poll: tokens generated past ``cursor`` + liveness.
@@ -242,6 +255,9 @@ class GenerationEngine:
         }
         self._t_start = time.monotonic()
         self._step_ewma_s: Optional[float] = None
+        # recent completions (newest last) for debugz /servez — kept
+        # tracing-on or off; records carry trace ids only when traced
+        self._recent: deque = deque(maxlen=64)
         from ..telemetry import get_registry
 
         self._reg = get_registry()
@@ -260,7 +276,8 @@ class GenerationEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                seed: Optional[int] = None,
-               top_p: Optional[float] = None) -> GenRequest:
+               top_p: Optional[float] = None,
+               trace_ctx=None) -> GenRequest:
         prompt = [int(t) for t in prompt]
         if not prompt or len(prompt) >= self.max_seq:
             raise ValueError(
@@ -292,6 +309,19 @@ class GenerationEngine:
             req.t_admit -= float(elapsed_ms) / 1e3
         req.expect_epoch = (int(expect_epoch)
                             if expect_epoch is not None else None)
+        if _tracing.enabled():
+            # umbrella span for the engine residency. The RPC handler
+            # thread dispatches inside the propagated `server:generate`
+            # scope, so "auto" parenting picks up the client's trace_id
+            # with zero extra wire plumbing; a failover resume carries
+            # the same trace, so ONE trace spans both replicas.
+            req.span = _tracing.begin(
+                "gen_request", kind="server",
+                parent=(trace_ctx if trace_ctx is not None else "auto"),
+                attrs={"prompt_len": len(prompt),
+                       "max_new_tokens": int(max_new_tokens),
+                       "resume": bool(req.is_resume),
+                       "resumed_from": req.resumed_from})
         if req.is_resume and (
                 len(req.tokens) >= req.max_new_tokens
                 or len(prompt) + len(req.tokens) >= self.max_seq
@@ -322,6 +352,9 @@ class GenerationEngine:
                     self._shed(req, f"Overloaded: kv pool full ({need} "
                                     f"pages needed, "
                                     f"{self.pool.available()} available)")
+            req.t_enq = time.monotonic()
+            req.queue_span = self._req_span(
+                req, "queue_wait", attrs={"resume": req.is_resume})
             q.append(req)
             self._gauge("serve_gen_queue_depth").set(len(self._q))
             self._cond.notify_all()
@@ -335,6 +368,7 @@ class GenerationEngine:
     def _shed(self, req: GenRequest, msg: str):
         self._count("shed")
         self._badput(req, "shed")
+        self._retire_trace(req, "shed", detail=msg)
         raise Overloaded(msg)
 
     def _pages_needed(self, req: GenRequest) -> int:
@@ -372,6 +406,10 @@ class GenerationEngine:
         self.weight_epoch += 1
         self._reg.gauge("serve_weight_epoch").set(self.weight_epoch)
         self._reg.counter("serve_weight_fences_total").inc()
+        for r in self._slots:
+            if r is not None:
+                self._event_span(r, "weight_fence",
+                                 attrs={"epoch": self.weight_epoch})
         # every live request's tail now decodes under the new epoch —
         # stream snapshots carry it so a client resuming elsewhere can
         # state which epoch its expectation belongs to
@@ -416,6 +454,10 @@ class GenerationEngine:
         for i, r in enumerate(self._slots):
             if r is not None and r.deadline_t is not None \
                     and now >= r.deadline_t:
+                self._event_span(r, "evict",
+                                 attrs={"reason": "deadline",
+                                        "tokens": len(r.tokens),
+                                        "pos": r.pos})
                 self._finish(r, error=DeadlineExceeded(
                     "DeadlineExceeded: request expired mid-decode"),
                     outcome="deadline_exceeded")
@@ -489,6 +531,10 @@ class GenerationEngine:
         self._reg.counter(
             "serve_gen_preempted_total",
             help="active generations preempted for KV pressure").inc()
+        self._event_span(victim, "preempt",
+                         attrs={"pages_freed": len(victim.pages),
+                                "tokens": len(victim.tokens),
+                                "pos": victim.pos})
         if victim.pages:
             self.pool.free(victim.pages)
             victim.pages = []
@@ -499,7 +545,12 @@ class GenerationEngine:
         victim.preempts += 1
         self._slots[slot] = None
         with self._cond:
+            victim.t_enq = time.monotonic()
+            victim.queue_span = self._req_span(
+                victim, "queue_wait", attrs={"resume": True,
+                                             "preempted": True})
             self._rq.append(victim)
+        _tracing.flight_dump("serve_preempt")
 
     def _try_admit(self, req: GenRequest, slot: int) -> bool:
         if req.expect_epoch is not None \
@@ -513,6 +564,23 @@ class GenerationEngine:
                 f"{self.weight_epoch}"), outcome="error")
             return True
         req.weight_epoch = self.weight_epoch
+        wait_ms = (time.monotonic() - req.t_enq) * 1e3
+        req.queue_ms += wait_ms
+        if req.queue_span is not None:
+            req.queue_span.attrs["wait_ms"] = round(wait_ms, 3)
+        _tracing.finish(req.queue_span)
+        req.queue_span = None
+        self._reg.histogram(
+            "serve_queue_wait_ms", buckets=_SERVE_BUCKETS,
+            help="generation admission wait (enqueue -> slot+pages)",
+        ).observe(wait_ms,
+                  trace_id=(req.span.trace_id if req.span is not None
+                            else None))
+        if req.is_resume:
+            self._event_span(req, "resume",
+                             attrs={"prefix_len": (len(req.prompt)
+                                                   + len(req.tokens)),
+                                    "preempts": req.preempts})
         # resume prefix: the prompt plus whatever tokens were already
         # delivered (empty for fresh requests — prefix == prompt)
         prefix = req.prompt + req.tokens
@@ -589,6 +657,22 @@ class GenerationEngine:
         # usually come back from the prefix cache)
         prefix = req.prompt + req.tokens
         n_valid = len(prefix) - req.reuse
+        psp = self._req_span(req, "prefill",
+                             attrs={"positions": n_valid,
+                                    "cached": req.reuse,
+                                    "prefix_hit": req.reuse > 0,
+                                    "pages": len(req.pages)})
+        # the decode loop is busy prefilling THIS request — every other
+        # active slot stalls for the same wall time. A peer_prefill span
+        # per co-batched request makes that bubble attributable ("my p99
+        # came from peer prefill"), and closes the coverage gap the
+        # >=90%-attribution drill measures.
+        peers = [(r, self._req_span(
+            r, "peer_prefill",
+            attrs={"peer_trace": (req.span.trace_id
+                                  if req.span is not None else None),
+                   "positions": n_valid}))
+            for r in self._slots if r is not None and r is not req]
         r = min(dm.prefill_bucket(n_valid), self.max_seq)
         window = np.zeros(r, np.int32)
         window[:n_valid] = prefix[req.reuse:]
@@ -607,6 +691,11 @@ class GenerationEngine:
         pool.set_arrays(*dm.scatter_kv(pool.k, pool.v, k_win, v_win,
                                        jnp.asarray(flat)))
         ms = (time.perf_counter() - t0) * 1e3
+        if psp is not None:
+            psp.attrs["prefill_ms"] = round(ms, 3)
+        _tracing.finish(psp)
+        for _, sp in peers:
+            _tracing.finish(sp)
         self._observe_ms("serve_prefill_ms", None, ms=ms)
         if req.is_resume:
             # the bounded extra prefill a preemption/failover costs
@@ -710,16 +799,39 @@ class GenerationEngine:
         active = [r for r in self._slots if r is not None]
         if not active:
             return
+        # one batched step = one span PER active slot, all sharing the
+        # same `step` index. Wall time is charged pro-rata (`charged_ms`
+        # = step wall / batch) so co-batching interference is
+        # attributable: a victim of a peer's stall carries the stalled
+        # step's index and its full `step_ms`. Spans open BEFORE the
+        # chaos sites so injected stalls land inside them.
+        step_idx = self.counters["decode_steps"]
+        spans = [(r, self._req_span(
+            r, "decode_step",
+            attrs={"step": step_idx, "batch": len(active),
+                   "slot": r.slot, "pos": r.pos}))
+            for r in active]
+        t_wall = time.perf_counter()
         # deterministic chaos sites: `stall:gen_decode_step:N:MS` delays
         # and `crash:gen_decode_step:N` kills this replica mid-decode —
         # the chaos drill's proof that in-flight generations survive a
         # replica death at the worst possible moment
         _faults.stall_point("gen_decode_step")
         _faults.crash_point("gen_decode_step")
-        if self.pool is not None:
-            self._step_paged(active)
-        else:
-            self._step_recompute(active)
+        try:
+            if self.pool is not None:
+                self._step_paged(active)
+            else:
+                self._step_recompute(active)
+        finally:
+            ms = (time.perf_counter() - t_wall) * 1e3
+            charged = ms / len(active)
+            for r, sp in spans:
+                if sp is None:
+                    continue
+                sp.attrs["step_ms"] = round(ms, 3)
+                sp.attrs["charged_ms"] = round(charged, 3)
+                _tracing.finish(sp)
         for i, r in enumerate(self._slots):
             if r is not None and r.event.is_set():
                 self._slots[i] = None
@@ -740,8 +852,21 @@ class GenerationEngine:
     def _emit(self, req: GenRequest, tok: int, logits_row=None) -> None:
         """Append one generated token; retire on eos/max_new/capacity."""
         tok = self._choose_token(req, tok, logits_row)
+        now = time.monotonic()
+        tid = req.span.trace_id if req.span is not None else None
         if req.t_first_token is None:
-            req.t_first_token = time.monotonic()
+            req.t_first_token = now
+            self._reg.histogram(
+                "serve_ttft_ms", buckets=_SERVE_BUCKETS,
+                help="time to first token (admission-backdated across "
+                     "failover resumes)",
+            ).observe((now - req.t_admit) * 1e3, trace_id=tid)
+        elif req.t_last_token is not None:
+            self._reg.histogram(
+                "serve_tpot_ms", buckets=_SERVE_BUCKETS,
+                help="inter-token latency (time per output token)",
+            ).observe((now - req.t_last_token) * 1e3, trace_id=tid)
+        req.t_last_token = now
         req.tokens.append(tok)
         self.counters["tokens_out"] += 1
         done = (len(req.tokens) >= req.max_new_tokens
@@ -769,6 +894,9 @@ class GenerationEngine:
             self._badput(req, "deadline")
         self._observe_ms("serve_gen_request_ms",
                          None, ms=(time.monotonic() - req.t_admit) * 1e3)
+        self._retire_trace(
+            req, outcome,
+            detail=(f"{error}" if error is not None else None))
         req.event.set()
         with self._cond:
             self._cond.notify_all()
@@ -845,11 +973,131 @@ class GenerationEngine:
             "step_ewma_ms": (None if self._step_ewma_s is None
                              else round(self._step_ewma_s * 1e3, 3)),
         }
+        # SLO quantiles (ISSUE 19): bucket-boundary estimates from the
+        # first-class histograms. servetop renders dashes when a replica
+        # predates these keys.
+        for hname, pfx in (("serve_ttft_ms", "ttft"),
+                           ("serve_tpot_ms", "tpot"),
+                           ("serve_queue_wait_ms", "queue_wait")):
+            hist = self._reg.histogram(hname, buckets=_SERVE_BUCKETS)
+            out[f"{pfx}_p50_ms"] = round(hist.quantile(0.5), 3)
+            out[f"{pfx}_p99_ms"] = round(hist.quantile(0.99), 3)
         if self.pool is not None:
             out["kv_pool"] = self.pool.stats()
         return out
 
+    def servez(self) -> dict:
+        """debugz /servez payload: active slots, queued requests, recent
+        completions slowest-first. Works tracing-on or off (trace ids
+        are null when untraced)."""
+        now = time.monotonic()
+
+        def _row(r: GenRequest, phase: str, slot=None) -> dict:
+            return {
+                "slot": slot,
+                "trace": (r.span.trace_id if r.span is not None
+                          else None),
+                "phase": phase,
+                "age_s": round(now - r.t_admit, 3),
+                "prompt_len": len(r.prompt),
+                "tokens": len(r.tokens),
+                "max_new_tokens": r.max_new_tokens,
+                "pages": len(r.pages),
+                "pos": r.pos,
+                "preempts": r.preempts,
+                "resumed_from": r.resumed_from,
+                "deadline_in_s": (None if r.deadline_t is None
+                                  else round(r.deadline_t - now, 3)),
+            }
+
+        active = [_row(r, "decode", slot=i)
+                  for i, r in enumerate(self._slots) if r is not None]
+        with self._cond:
+            queued = [_row(r, "queued") for r in self._q]
+            resumes = [_row(r, "queued_resume") for r in self._rq]
+        recent = sorted(self._recent,
+                        key=lambda rec: -(rec.get("total_ms") or 0.0))
+        return {
+            "mode": "paged" if self.pool is not None else "recompute",
+            "max_slots": self.max_slots,
+            "draining": self._draining,
+            "weight_epoch": self.weight_epoch,
+            "active": active,
+            "queued": queued,
+            "resume_queue": resumes,
+            "recent_slowest": recent[:32],
+        }
+
     # -- small helpers ---------------------------------------------------
+
+    def _req_span(self, req: GenRequest, name: str,
+                  attrs: Optional[dict] = None):
+        """Child span under the request's umbrella span (None when the
+        request is untraced — every consumer is None-safe)."""
+        if req.span is None:
+            return None
+        return _tracing.begin(name, parent=req.span, attrs=attrs)
+
+    def _event_span(self, req: GenRequest, name: str,
+                    attrs: Optional[dict] = None) -> None:
+        """Zero-duration lifecycle marker (preempt/resume/evict/
+        weight_fence) on the request's trace."""
+        _tracing.finish(self._req_span(req, name, attrs=attrs))
+
+    # outcome -> flight-recorder dump reason (the r9 post-mortem path)
+    _DUMP_REASONS = {"shed": "serve_shed",
+                     "deadline_exceeded": "serve_deadline"}
+
+    def _retire_trace(self, req: GenRequest, outcome: str,
+                      detail: Optional[str] = None) -> None:
+        """Close the request's open spans, append the /servez completion
+        record, note the per-request flight record, and trigger a flight
+        dump on bad outcomes."""
+        now = time.monotonic()
+        if req.queue_span is not None:
+            # retired straight out of the queue (queue deadline / epoch
+            # refusal): the whole residency was queue wait
+            req.queue_ms += (now - req.t_enq) * 1e3
+            _tracing.finish(req.queue_span,
+                            status=(None if outcome == "served"
+                                    else outcome))
+            req.queue_span = None
+        rec = {
+            "trace": req.span.trace_id if req.span is not None else None,
+            "outcome": outcome,
+            "prompt_len": len(req.prompt),
+            "tokens": len(req.tokens),
+            "queue_ms": round(req.queue_ms, 3),
+            "ttft_ms": (None if req.t_first_token is None else round(
+                (req.t_first_token - req.t_admit) * 1e3, 3)),
+            "total_ms": round((now - req.t_admit) * 1e3, 3),
+            "preempts": req.preempts,
+            "resumed_from": req.resumed_from,
+            "weight_epoch": req.weight_epoch,
+            "ts": round(time.time(), 3),
+        }
+        if detail:
+            rec["detail"] = detail
+        self._recent.append(rec)
+        _sink.emit({"kind": "serve_request", **rec})
+        if req.span is not None:
+            req.span.attrs.update(outcome=outcome,
+                                  tokens=len(req.tokens),
+                                  queue_ms=rec["queue_ms"],
+                                  preempts=req.preempts)
+            if detail:
+                req.span.attrs["detail"] = detail
+            _tracing.finish(req.span,
+                            status=(None if outcome == "served"
+                                    else outcome))
+            req.span = None
+            _tracing.note_request(rec)
+        reason = self._DUMP_REASONS.get(outcome)
+        if reason is None and outcome == "error" and detail \
+                and "ResumedOnNewWeights" in detail:
+            reason = "serve_epoch_refusal"
+        if reason is not None:
+            _tracing.flight_dump(reason)
 
     def _count(self, outcome: str) -> None:
         if outcome in self.counters:

@@ -530,6 +530,10 @@ class InferenceServer:
                     "serve_gen_dedup_hits_total",
                     help="marked-retry generates that reattached or "
                          "replayed instead of running twice").inc()
+                # the retry's own server span records that it replayed
+                # instead of decoding — the trace shows ONE engine
+                # residency plus a cheap reattach hop
+                _tracing.annotate(dedup_hit=True)
                 if ent.get("stream_id") is not None:
                     return {"stream_id": ent["stream_id"]}
                 if ent.get("reply") is not None:
@@ -606,6 +610,8 @@ class InferenceServer:
         }
         if self.engine is not None:
             out["generation"] = self.engine.stats()
+            out["generation"]["dedup_hits_total"] = _REG.counter(
+                "serve_gen_dedup_hits_total").value
         return out
 
     def handle(self, method: str, kwargs: dict):
@@ -695,6 +701,22 @@ def current_status() -> Optional[dict]:
         return None
     try:
         return srv.batcher.stats()
+    except Exception:  # noqa: BLE001 — status pages never crash
+        return None
+
+
+def current_servez() -> Optional[dict]:
+    """The active server's per-request generation view — the debugz
+    /servez payload (active slots, queued requests, recent completions
+    slowest-first). None when no server or no engine is attached."""
+    srv = _ACTIVE
+    if srv is None or srv.engine is None:
+        return None
+    try:
+        out = srv.engine.servez()
+        out["dedup_hits_total"] = _REG.counter(
+            "serve_gen_dedup_hits_total").value
+        return out
     except Exception:  # noqa: BLE001 — status pages never crash
         return None
 

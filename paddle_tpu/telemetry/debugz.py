@@ -29,6 +29,10 @@ sidecar, no log scraping:
   /tracez    recent causal traces from the span ring (PADDLE_TRACING),
              slowest-first with per-hop durations — the live view of
              what the flight recorder would dump (JSON)
+  /servez    per-request LLM serving view (ISSUE 19): active decode
+             slots (age / tokens / pages / phase / trace id), queued +
+             resume-queued requests, recent completions slowest-first
+             (JSON; 404-shaped when no generation engine is attached)
   /fleetz    fleet goodput rollup (ISSUE 15): per-rank rows merged
              from lease-renewal payloads, job goodput ratio, badput by
              cause, worst incidents (JSON; needs the job coordinator —
@@ -296,6 +300,21 @@ def _route(path: str):
 
         return (200, "application/json",
                 json.dumps(tracing.tracez(), default=str).encode())
+    if path == "/servez":
+        # per-request serving view (ISSUE 19): imports stay lazy AND
+        # optional — a trainer process with no serving plane loaded
+        # reports the 404 shape instead of importing inference
+        import sys as _sys
+
+        _srv = _sys.modules.get("paddle_tpu.inference.server")
+        payload = _srv.current_servez() if _srv is not None else None
+        if payload is None:
+            return (404, "application/json", json.dumps(
+                {"error": "no generation engine attached in this "
+                          "process (PADDLE_SERVE_GEN=1 arms one)"}
+            ).encode())
+        return (200, "application/json",
+                json.dumps(payload, default=str).encode())
     if path == "/flagz":
         return (200, "application/json",
                 json.dumps(_flagz_state()).encode())
@@ -328,8 +347,8 @@ def _route(path: str):
     if path in ("", "/", "/index.html"):
         return (200, "text/plain; charset=utf-8",
                 b"paddle_tpu debugz: /metrics /statusz /steps /proftop "
-                b"/memz /numericz /tracez /fleetz /fleetz/metrics "
-                b"/flagz /healthz\n")
+                b"/memz /numericz /tracez /servez /fleetz "
+                b"/fleetz/metrics /flagz /healthz\n")
     return 404, "text/plain; charset=utf-8", b"not found\n"
 
 

@@ -51,6 +51,23 @@ tools and tests parse it):
                   goodput.BUCKETS}}; the authoritative per-interval
                   rows live in goodput.<tag>.<incarnation>.jsonl under
                   PADDLE_GOODPUT_DIR (default PADDLE_TRACE_DIR)
+  kind="serve_request"  one RETIRED generation request
+                  (inference/engine.py, any outcome — the serving
+                  flight ledger): {"trace": str|null (the request's
+                  trace id when PADDLE_TRACING was on, else null),
+                   "outcome": "served"|"shed"|"deadline_exceeded"|
+                   "error", "prompt_len": int, "tokens": int delivered
+                   (including a resumed prefix), "queue_ms": float
+                   cumulative admission-queue wait (re-queues after
+                   preemption accumulate), "ttft_ms": float|null
+                   admission to first token, "total_ms": float
+                   admission to retire, "preempts": int,
+                   "resumed_from": int prefix length a resume carried
+                   in, "weight_epoch": int, "detail"?: str error
+                   text}; the same record feeds debugz /servez
+                  ("recent_slowest") and, when tracing is on, the
+                  flight-recorder dump's "requests" array that
+                  tools/reqtop.py joins onto the span reconstruction
   kind="mem_report"  one static memory attribution (telemetry/memory.py,
                   emitted per compile-cache miss under FLAGS_mem_profile
                   and by explicit memtop/bench joins):

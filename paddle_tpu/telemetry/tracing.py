@@ -64,6 +64,11 @@ _last_step_ctx: Optional[Tuple[str, str]] = None
 _hooks_installed = False
 _dumped_reasons: set = set()
 
+# per-request serving flight records (ISSUE 19): one dict per retired
+# generation, bounded; rides along in flight_dump payloads so
+# tools/reqtop.py can reconstruct where a slow request's wall time went
+_REQ_RECORDS: deque = deque(maxlen=256)
+
 
 def enabled() -> bool:
     """PADDLE_TRACING gate, resolved once per process (one bool read on
@@ -391,6 +396,22 @@ def tracez(limit: int = 50) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def note_request(rec: dict) -> None:
+    """Record one per-request serving flight record (retired generation:
+    trace id, outcome, tokens, queue/ttft/total ms, preempts...). Kept in
+    a bounded deque and included in flight_dump payloads under
+    "requests". No-op when tracing is off."""
+    if not enabled():
+        return
+    with _lock:
+        _REQ_RECORDS.append(dict(rec))
+
+
+def request_records() -> List[dict]:
+    with _lock:
+        return list(_REQ_RECORDS)
+
+
 def _recent_steps() -> List[dict]:
     try:
         from ..fluid import monitor
@@ -437,6 +458,7 @@ def flight_dump(reason: str, directory: Optional[str] = None,
         "ts": round(time.time(), 6),
         "spans": finished_spans(),
         "steps": _recent_steps(),
+        "requests": request_records(),
     }
     path = os.path.join(directory, f"flightrec.{tag}.json")
     try:
@@ -555,6 +577,7 @@ def _reset_for_tests() -> None:
     with _lock:
         _ring.clear()
         _dumped_reasons.clear()
+        _REQ_RECORDS.clear()
         _seq = 0
     _enabled = None
     _last_step_ctx = None

@@ -189,6 +189,58 @@ def test_recovery_reconciliation_window_never_false_evicts(tmp_path):
     assert [e["tag"] for e in raised] == ["trainer0"]
 
 
+def test_wal_byte_cap_forces_compaction(tmp_path, monkeypatch):
+    """PADDLE_COORD_WAL_MAX_BYTES (ISSUE 19 satellite): once the
+    current WAL segment exceeds the byte cap a snapshot is taken and
+    the WAL rotates — an unattended chatty job can no longer grow a
+    segment without bound between time-based snapshots."""
+    d = str(tmp_path / "capped")
+    c = Coordinator(lease_secs=1.0, startup_grace=5.0, state_dir=d,
+                    snapshot_secs=3600.0, wal_max_bytes=256)
+    assert c.wal_max_bytes == 256
+    c.register("trainer0", kind="trainer", now=1000.0)
+    seq0 = c._snap_seq
+    for i in range(50):
+        c.renew("trainer0", payload={"step": i}, epoch=0,
+                now=1000.0 + i * 0.001)
+    # one renew record is far under 256 bytes, so the time trigger
+    # (3600s away) never fires — every rotation below came from bytes
+    assert c._snap_seq > seq0
+    # the live segment resets at each rotation and stays under
+    # cap + one record
+    assert 0 <= c._wal_bytes < 512
+    assert c.coord_status()["wal_bytes"] == c._wal_bytes
+    # on-disk segments respect the cap too (cap + the record that
+    # tripped it)
+    for name in os.listdir(d):
+        if name.endswith(".wal"):
+            assert os.path.getsize(os.path.join(d, name)) < 512
+    # the capped coordinator's state still round-trips through recovery
+    r = Coordinator(lease_secs=1.0, startup_grace=5.0, state_dir=d,
+                    snapshot_secs=3600.0)
+    assert r.members["trainer0"].payload == {"step": 49}
+
+    # cap 0 (the default) disables the byte trigger entirely
+    d2 = str(tmp_path / "uncapped")
+    u = Coordinator(lease_secs=1.0, startup_grace=5.0, state_dir=d2,
+                    snapshot_secs=3600.0)
+    assert u.wal_max_bytes == 0
+    u.register("trainer0", kind="trainer", now=1000.0)
+    seq0 = u._snap_seq
+    for i in range(50):
+        u.renew("trainer0", payload={"step": i}, epoch=0,
+                now=1000.0 + i * 0.001)
+    assert u._snap_seq == seq0  # no rotation: bytes never trigger
+    assert u._wal_bytes > 256  # ...even though the segment grew past it
+
+    # the env knob feeds the constructor default
+    monkeypatch.setenv(coord_mod.ENV_WAL_MAX_BYTES, "128")
+    e = Coordinator(lease_secs=1.0, startup_grace=5.0)
+    assert e.wal_max_bytes == 128
+    monkeypatch.setenv(coord_mod.ENV_WAL_MAX_BYTES, "not-a-number")
+    assert Coordinator(lease_secs=1.0).wal_max_bytes == 0
+
+
 # ---------------------------------------------------------------------------
 # incarnation fence + wire compatibility
 # ---------------------------------------------------------------------------

@@ -1,0 +1,656 @@
+"""Request-lifecycle tracing and SLO attribution for the serving plane
+(ISSUE 19).
+
+Fast lane — shares the canonical tiny-decoder geometry with
+test_kv_serving.py / test_gen_resume.py (same jits):
+  * one trace over real TCP: client `generate` root -> rpc ->
+    server:generate -> engine `gen_request` umbrella -> queue_wait /
+    prefill / decode_step children -> retire, all on ONE trace_id with
+    zero extra wire plumbing
+  * pro-rata decode charging: co-batched slots' charged_ms sum to the
+    measured step wall per step
+  * failover-resume trace continuity: one trace, two `gen_request`
+    residencies (the second marked resume=True)
+  * client-observed ttft/tpot via `generate_stream(timings=...)`, skew
+    bounded against the server-observed record
+  * serve_ttft_ms / serve_tpot_ms SLO histograms with trace exemplars
+    on the tail, surfaced in stats() quantiles and /metrics
+  * PADDLE_TRACING off: wire bytes carry no `_trace` key, token stream
+    bit-identical, zero spans recorded
+  * debugz /servez scrape + servetop TTFT/TPOT/DEDUP columns (old
+    layout intact for replicas predating the keys)
+  * tools/reqtop.py reconstructs a request end-to-end from flightrec
+    dumps (residency attribution, engine flight records)
+
+Slow lane (tools/ci.sh serving-trace lane):
+  * traced 16-request burst with an injected `stall:gen_decode_step`
+    tail: >=90% of every completed request's engine wall time is
+    attributed to spans, the stalled step's co-batched victims cite it
+    through the serve_tpot_ms exemplar trace_id, and a no-tracing rerun
+    produces token-bit-identical output
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.distributed import faults  # noqa: E402
+from paddle_tpu.fluid import flags as fl  # noqa: E402
+from paddle_tpu.fluid import layers  # noqa: E402
+from paddle_tpu.inference import decode_model as dm  # noqa: E402
+from paddle_tpu.inference import server as srvmod  # noqa: E402
+from paddle_tpu.inference.client import InferenceClient  # noqa: E402
+from paddle_tpu.inference.engine import (GenerationEngine,  # noqa: E402
+                                         _SERVE_BUCKETS)
+from paddle_tpu.inference.server import InferenceServer  # noqa: E402
+from paddle_tpu.telemetry import get_registry  # noqa: E402
+from paddle_tpu.telemetry import tracing  # noqa: E402
+
+_REG = get_registry()
+
+# canonical geometry shared with test_kv_serving.py / test_gen_resume.py
+CFG = dm.DecoderConfig()          # vocab 64, d 32, L2 H2, max_seq 64
+PAGES, PSZ, SLOTS = 24, 4, 2
+PROMPT = [3, 9, 1, 4, 1, 5, 9]
+
+
+def _mk_engine(kv=True, seed=1, **kw):
+    kw.setdefault("n_pages", PAGES)
+    kw.setdefault("page_size", PSZ)
+    kw.setdefault("max_slots", SLOTS)
+    if not kv:
+        kw.pop("n_pages"), kw.pop("page_size")
+    return GenerationEngine(dm.TinyDecoderLM(CFG, seed=seed),
+                            kv_cache=kv, **kw)
+
+
+def _slow_decode(monkeypatch, delay_s=0.01):
+    real_step = dm.decode_step
+
+    def slow_step(*a, **kw):
+        time.sleep(delay_s)
+        return real_step(*a, **kw)
+
+    monkeypatch.setattr(dm, "decode_step", slow_step)
+
+
+def _start_tcp(handler_obj):
+    from paddle_tpu.distributed.ps_server import _Handler, _TCPServer
+
+    srv = _TCPServer(("127.0.0.1", 0), _Handler)
+    srv.ps = handler_obj
+    threading.Thread(target=srv.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+def _stop_tcp(srv):
+    srv.shutdown()
+    srv.close_all_connections()
+    srv.server_close()
+
+
+def _spans():
+    return tracing.finished_spans()
+
+
+def _named(spans, name):
+    return [s for s in spans if s["name"] == name]
+
+
+def _settle(name, n, timeout=5.0):
+    """Spans close on the engine loop thread a beat AFTER the result
+    event fires (the final decode_step span's finally block): poll
+    until `n` spans named `name` landed in the ring."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        spans = tracing.finished_spans()
+        if len(_named(spans, name)) >= n:
+            return spans
+        time.sleep(0.005)
+    return tracing.finished_spans()
+
+
+def _reqtop():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import reqtop
+    finally:
+        sys.path.pop(0)
+    return reqtop
+
+
+@pytest.fixture(scope="module")
+def gen_frozen():
+    """Tiny frozen fc model for the server's infer path (the generate
+    verbs only need SOME frozen model attached)."""
+    from paddle_tpu import inference
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        pred = layers.fc(x, 2)
+    exe = fluid.Executor()
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return inference.freeze_program(main, scope=scope, feed_names=["x"],
+                                    fetch_list=[pred])
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv(tracing.ENV_GATE, "1")
+    tracing._reset_for_tests()
+    yield
+    tracing._reset_for_tests()
+
+
+@pytest.fixture
+def untraced(monkeypatch):
+    monkeypatch.delenv(tracing.ENV_GATE, raising=False)
+    tracing._reset_for_tests()
+    yield
+    tracing._reset_for_tests()
+
+
+@pytest.fixture
+def inject(monkeypatch):
+    def _arm(spec: str):
+        monkeypatch.setenv(faults.ENV_SPEC, spec)
+        fl.set_flags({"FLAGS_ps_fault_injection": True})
+        faults.reset()
+
+    yield _arm
+    fl.set_flags({"FLAGS_ps_fault_injection": False})
+    faults.reset()
+
+
+@pytest.fixture
+def served(gen_frozen, monkeypatch):
+    """One engine + InferenceServer + real TCP endpoint, torn down in
+    order."""
+    from paddle_tpu.inference import weight_sync as ws
+
+    monkeypatch.setenv(ws.ENV_SYNC, "0")
+    eng = _mk_engine(kv=True)
+    inf = InferenceServer(gen_frozen, weight_subscribe=False, engine=eng)
+    srv, ep = _start_tcp(inf)
+    yield eng, inf, ep
+    _stop_tcp(srv)
+    inf.close()
+
+
+# ---------------------------------------------------------------------------
+# one trace, client -> queue -> prefill -> decode -> retire
+# ---------------------------------------------------------------------------
+
+
+def test_one_trace_client_to_retire_over_tcp(traced, served):
+    """The tentpole wiring: the client root's trace_id rides the
+    existing `_trace` RPC header, the handler thread dispatches inside
+    `server:generate`, and the engine umbrella + every lifecycle child
+    parent under it — one trace_id, client to retire."""
+    eng, inf, ep = served
+    cli = InferenceClient([ep])
+    res = cli.generate(PROMPT, max_new_tokens=4)
+    cli.close()
+    assert len(res.tokens) == 4
+
+    spans = _settle("decode_step", 3)
+    (root,) = _named(spans, "generate")
+    assert root["kind"] == "client" and root["status"] == "ok"
+    tid = root["trace"]
+    (hop,) = _named(spans, "server:generate")
+    assert hop["trace"] == tid
+    (gen,) = _named(spans, "gen_request")
+    assert gen["trace"] == tid
+    # the umbrella parents under the RPC hop: zero new wire plumbing
+    assert gen["parent"] == hop["span"]
+    a = gen["attrs"]
+    assert a["outcome"] == "served" and a["tokens"] == 4
+    assert a["prompt_len"] == len(PROMPT) and not a["resume"]
+    (qw,) = _named(spans, "queue_wait")
+    (pf,) = _named(spans, "prefill")
+    steps = _named(spans, "decode_step")
+    assert qw["parent"] == gen["span"] and qw["trace"] == tid
+    assert pf["parent"] == gen["span"] and pf["trace"] == tid
+    assert pf["attrs"]["positions"] == len(PROMPT)
+    # prefill emits token 1; each later token is one decode step
+    assert len(steps) >= 3
+    assert all(s["parent"] == gen["span"] and s["trace"] == tid
+               for s in steps)
+    # the engine's own completion ledger carries the same trace
+    recs = [r for r in tracing.request_records() if r["trace"] == tid]
+    assert recs and recs[0]["outcome"] == "served"
+    assert recs[0]["tokens"] == 4
+
+
+def test_decode_step_prorata_charging_sums_to_step_wall(traced,
+                                                        monkeypatch):
+    """Every co-batched slot gets its own decode_step span; the step's
+    measured wall is charged pro-rata, and the charges sum back to the
+    wall — device time is attributed exactly once."""
+    _slow_decode(monkeypatch, 0.005)
+    eng = _mk_engine(kv=True)
+    try:
+        r1 = eng.submit(PROMPT, max_new_tokens=8)
+        r2 = eng.submit([5, 1, 2], max_new_tokens=8)
+        eng.result(r1, timeout=120)
+        eng.result(r2, timeout=120)
+    finally:
+        eng.stop()
+    by_step = {}
+    for s in _named(_spans(), "decode_step"):
+        by_step.setdefault(s["attrs"]["step"], []).append(s)
+    shared = [g for g in by_step.values()
+              if len(g) == 2 and all(s["attrs"]["batch"] == 2
+                                     for s in g)]
+    assert shared, "the two requests never co-batched"
+    for group in shared:
+        walls = {s["attrs"]["step_ms"] for s in group}
+        assert len(walls) == 1  # one shared step wall
+        (wall,) = walls
+        charged = sum(s["attrs"]["charged_ms"] for s in group)
+        assert charged == pytest.approx(wall, abs=0.01)
+        # distinct slots, same step
+        assert {s["attrs"]["slot"] for s in group} == {0, 1}
+
+
+def test_failover_resume_is_one_trace_two_residencies(traced, gen_frozen,
+                                                      monkeypatch):
+    """Mid-stream replica death: the resume re-binds the ORIGINAL trace
+    context, so one trace spans both replicas — a client root plus two
+    gen_request residencies, the second marked resume."""
+    from paddle_tpu.inference import weight_sync as ws
+
+    monkeypatch.setenv(ws.ENV_SYNC, "0")
+    eng_a = _mk_engine(kv=True, seed=1)
+    eng_b = _mk_engine(kv=True, seed=1)
+    inf_a = InferenceServer(gen_frozen, weight_subscribe=False,
+                            engine=eng_a)
+    inf_b = InferenceServer(gen_frozen, weight_subscribe=False,
+                            engine=eng_b)
+    srv_a, ep_a = _start_tcp(inf_a)
+    srv_b, ep_b = _start_tcp(inf_b)
+    a_stopped = False
+    try:
+        _slow_decode(monkeypatch, 0.02)
+        cli = InferenceClient([ep_a, ep_b], deadline_secs=2.0)
+        stream = cli.generate_stream(PROMPT, max_new_tokens=12,
+                                     poll_s=0.005)
+        got = list(next(stream))
+        assert got
+        _stop_tcp(srv_a)
+        a_stopped = True
+        for chunk in stream:
+            got += chunk
+        assert len(got) == 12
+        cli.close()
+
+        spans = _spans()
+        (root,) = _named(spans, "generate_stream")
+        tid = root["trace"]
+        assert root["attrs"]["failovers"] == 1
+        residencies = [s for s in _named(spans, "gen_request")
+                       if s["trace"] == tid]
+        residencies.sort(key=lambda s: s["ts"])
+        assert len(residencies) == 2  # one per replica, ONE trace
+        assert not residencies[0]["attrs"]["resume"]
+        assert residencies[1]["attrs"]["resume"]
+        # the resume carried the delivered prefix and finished the rest
+        assert 0 < residencies[1]["attrs"]["resumed_from"] < 12
+        assert residencies[1]["attrs"]["tokens"] == 12
+        # the resume residency re-ran queue_wait + prefill on B
+        resumed_kids = [s for s in spans
+                        if s.get("parent") == residencies[1]["span"]]
+        names = {s["name"] for s in resumed_kids}
+        assert {"queue_wait", "prefill"} <= names
+    finally:
+        if not a_stopped:
+            _stop_tcp(srv_a)
+        _stop_tcp(srv_b)
+        inf_a.close()
+        inf_b.close()
+
+
+# ---------------------------------------------------------------------------
+# client-observed SLO timings (satellite: per-token timestamps)
+# ---------------------------------------------------------------------------
+
+
+def test_client_timings_skew_bounded_vs_server(traced, served,
+                                               monkeypatch):
+    """generate_stream(timings=...) hands the caller its OWN ttft/tpot;
+    over fast loopback TCP the client-vs-server ttft skew is network +
+    poll cadence — bounded, and never negative beyond clock grain."""
+    eng, inf, ep = served
+    _slow_decode(monkeypatch, 0.01)
+    cli = InferenceClient([ep])
+    timings: dict = {}
+    got = []
+    for chunk in cli.generate_stream(PROMPT, max_new_tokens=6,
+                                     poll_s=0.005, timings=timings):
+        got += chunk
+    cli.close()
+    assert len(got) == 6
+    assert timings["tokens"] == 6
+    assert len(timings["token_ts_ms"]) == 6
+    assert timings["token_ts_ms"] == sorted(timings["token_ts_ms"])
+    assert timings["ttft_ms"] is not None
+    assert timings["tpot_avg_ms"] is not None and timings["tpot_avg_ms"] > 0
+    # server-observed record for the same request (servez ledger)
+    recent = eng.servez()["recent_slowest"]
+    assert recent and recent[0]["outcome"] == "served"
+    server_ttft = recent[0]["ttft_ms"]
+    assert server_ttft is not None
+    # client clock starts BEFORE the submit RPC and sees the token a
+    # poll later: client ttft >= server ttft (minus clock grain), and
+    # the skew on loopback stays well inside half a second
+    skew = timings["ttft_ms"] - server_ttft
+    assert skew > -5.0
+    assert skew < 500.0
+
+
+# ---------------------------------------------------------------------------
+# SLO histograms + exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_slo_histograms_carry_tail_exemplars(traced, monkeypatch):
+    """serve_ttft_ms/serve_tpot_ms observe every request; a traced
+    request pins its trace_id to the tail sample, and the stats() /
+    /metrics surfaces hand it to the operator."""
+    _REG.reset()  # the exemplar contest must start from this test
+    _slow_decode(monkeypatch, 0.06)
+    eng = _mk_engine(kv=True)
+    try:
+        req = eng.submit(PROMPT, max_new_tokens=5)
+        eng.result(req, timeout=120)
+        tid = next(s["trace"] for s in _named(_spans(), "gen_request"))
+        st = eng.stats()
+        for pfx in ("ttft", "tpot", "queue_wait"):
+            assert st[f"{pfx}_p99_ms"] >= st[f"{pfx}_p50_ms"] >= 0.0
+        assert st["tpot_p50_ms"] >= 25.0  # the slow decode is visible
+        ex = _REG.histogram("serve_tpot_ms",
+                            buckets=_SERVE_BUCKETS).exemplar
+        assert ex is not None and ex["trace_id"] == tid
+        assert ex["value"] >= 50.0
+        # OpenMetrics exemplar syntax on the /metrics exposition
+        prom = _REG.to_prometheus()
+        assert f'# {{trace_id="{tid}"}}' in prom
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# flag-off bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_flag_off_wire_and_tokens_bit_identical(gen_frozen, monkeypatch):
+    """PADDLE_TRACING=0: the wire carries no `_trace` key, zero spans
+    are recorded, and the token stream is bit-identical to the traced
+    run — tracing observes, never perturbs."""
+    from paddle_tpu.inference import weight_sync as ws
+
+    monkeypatch.setenv(ws.ENV_SYNC, "0")
+
+    def run():
+        eng = _mk_engine(kv=True, seed=1)
+        inf = InferenceServer(gen_frozen, weight_subscribe=False,
+                              engine=eng)
+        srv, ep = _start_tcp(inf)
+        try:
+            cli = InferenceClient([ep])
+            toks = cli.generate(PROMPT, max_new_tokens=8).tokens
+            stream: list = []
+            for chunk in cli.generate_stream([5, 1, 2],
+                                             max_new_tokens=6,
+                                             poll_s=0.005):
+                stream += chunk
+            cli.close()
+            return toks, stream
+        finally:
+            _stop_tcp(srv)
+            inf.close()
+
+    monkeypatch.setenv(tracing.ENV_GATE, "1")
+    tracing._reset_for_tests()
+    try:
+        want = run()
+        assert _spans()  # the traced run really traced
+
+        monkeypatch.delenv(tracing.ENV_GATE)
+        tracing._reset_for_tests()
+        seen = []
+        orig = InferenceServer.handle
+
+        def spy(self, method, kwargs):
+            seen.append((method, dict(kwargs)))
+            return orig(self, method, kwargs)
+
+        monkeypatch.setattr(InferenceServer, "handle", spy)
+        got = run()
+        assert got == want
+        assert seen and all("_trace" not in kw for _, kw in seen)
+        assert _spans() == []
+        assert tracing.request_records() == []
+    finally:
+        tracing._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# /servez + servetop columns
+# ---------------------------------------------------------------------------
+
+
+def test_debugz_servez_scrape(traced, served):
+    eng, inf, ep = served
+    cli = InferenceClient([ep])
+    cli.generate(PROMPT, max_new_tokens=4)
+    cli.close()
+    from paddle_tpu.telemetry import debugz
+
+    status, ctype, body = debugz._route("/servez")
+    assert status == 200 and ctype == "application/json"
+    page = json.loads(body)
+    assert page["mode"] == "paged"
+    assert page["max_slots"] == SLOTS
+    assert "dedup_hits_total" in page
+    rec = page["recent_slowest"][0]
+    assert rec["outcome"] == "served" and rec["tokens"] == 4
+    assert rec["trace"]  # traced run: the row resolves to a trace
+    assert rec["total_ms"] >= rec["queue_ms"] >= 0.0
+    # the index advertises the endpoint
+    _, _, idx = debugz._route("/")
+    assert b"/servez" in idx
+
+
+def test_debugz_servez_404_without_engine(monkeypatch):
+    monkeypatch.setattr(srvmod, "_ACTIVE", None)
+    from paddle_tpu.telemetry import debugz
+
+    status, _, body = debugz._route("/servez")
+    assert status == 404
+    assert b"no generation engine" in body
+
+
+def test_servetop_slo_columns_and_old_layout():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import servetop
+    finally:
+        sys.path.pop(0)
+    new_gen = {"tokens_total": 640, "tokens_per_s": 123.4,
+               "decode_positions_total": 600,
+               "prefill_positions_total": 40,
+               "recompute_positions_total": 0,
+               "shed_total": 0, "deadline_exceeded_total": 0,
+               "queue_depth": 0, "resumed_total": 7,
+               "preempted_total": 3,
+               "ttft_p50_ms": 12.5, "ttft_p99_ms": 180.0,
+               "tpot_p50_ms": 4.2, "tpot_p99_ms": 9.9,
+               "dedup_hits_total": 2,
+               "kv_pool": {"residency": 0.42, "prefix_hit_rate": 0.8}}
+    old_gen = {k: v for k, v in new_gen.items()
+               if k not in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                            "tpot_p99_ms", "dedup_hits_total")}
+    rows = [
+        {"endpoint": "127.0.0.1:8500",
+         "serving": {"served_total": 5, "weight_epoch": 2},
+         "generation": new_gen},
+        {"endpoint": "127.0.0.1:8501",  # replica predating the keys
+         "serving": {"served_total": 5, "weight_epoch": 2},
+         "generation": old_gen},
+    ]
+    text = servetop.render(rows)
+    head, new_line, old_line = text.splitlines()
+    for col in ("TTFT50", "TTFT99", "TPOT50", "TPOT99", "DEDUP"):
+        assert col in head
+    assert "12.5" in new_line and "180.0" in new_line
+    assert "4.2" in new_line and f"{2:5d}" in new_line
+    # the old replica keeps every pre-existing column and dashes the new
+    assert "123.4" in old_line and f"{7:6d}" in old_line
+    assert "12.5" not in old_line
+    # same column positions either way
+    assert old_line.index("42.0%") == new_line.index("42.0%")
+    assert len(old_line.split()) == len(new_line.split())
+
+
+# ---------------------------------------------------------------------------
+# reqtop: flight-recorder reconstruction
+# ---------------------------------------------------------------------------
+
+
+def test_reqtop_reconstructs_from_flightrec(traced, served, monkeypatch,
+                                            tmp_path, capsys):
+    eng, inf, ep = served
+    monkeypatch.setenv(tracing.ENV_DIR, str(tmp_path))
+    cli = InferenceClient([ep])
+    cli.generate(PROMPT, max_new_tokens=4)
+    cli.close()
+    _settle("decode_step", 3)
+    assert tracing.flight_dump("test_dump")
+
+    reqtop = _reqtop()
+    dumps = reqtop.load_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    spans = reqtop.merged_spans(dumps)
+    reqs = reqtop.requests_report(spans, reqtop.merged_requests(dumps))
+    assert len(reqs) == 1
+    r = reqs[0]
+    assert r["root"] == "generate" and r["client_ms"] is not None
+    assert r["n_residencies"] == 1
+    res = r["residencies"][0]
+    assert res["outcome"] == "served" and not res["resume"]
+    assert res["decode_steps"] >= 3
+    assert res["prefill_attrs"]["positions"] == len(PROMPT)
+    assert res["attributed_frac"] is not None
+    assert res["attributed_ms"] <= res["wall_ms"] * 1.02
+    # the engine's own ledger rode the dump
+    assert r["flight_records"]
+    assert r["flight_records"][0]["outcome"] == "served"
+
+    # CLI entry point: --json round-trips
+    assert reqtop.main([str(tmp_path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["requests"][0]["trace"] == r["trace"]
+    # human format renders without blowing up
+    assert "engine residency" in reqtop.format_request(r)
+
+
+def test_reqtop_empty_dir_is_an_error(tmp_path, capsys):
+    reqtop = _reqtop()
+    assert reqtop.main([str(tmp_path)]) == 1
+    assert "no flightrec" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# slow lane: the ci.sh serving-trace drill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_traced_burst_attribution_drill(monkeypatch, inject, tmp_path):
+    """THE acceptance drill: a traced 16-request burst with one
+    injected `stall:gen_decode_step` tail. Every completed request's
+    engine wall time is >=90% attributed to spans, the stalled step's
+    co-batched victims cite it through the serve_tpot_ms exemplar, and
+    a no-tracing rerun is token-bit-identical."""
+    monkeypatch.setenv(tracing.ENV_GATE, "1")
+    monkeypatch.setenv(tracing.ENV_DIR, str(tmp_path))
+    tracing._reset_for_tests()
+    _REG.reset()  # the stall must own the tpot exemplar
+    _slow_decode(monkeypatch, 0.004)
+    # one fat tail mid-burst: 1.5s dwarfs even a cold decode_step jit
+    # compile, so the stall owns the tail unambiguously
+    inject("stall:gen_decode_step:20:1500")
+    prompts = [[10 + i, 3, 7, (i % 5) + 1] for i in range(16)]
+
+    def run_burst():
+        eng = _mk_engine(kv=True, max_slots=4, n_pages=48,
+                         queue_depth=32)
+        try:
+            reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            return [eng.result(r, timeout=180)["tokens"] for r in reqs]
+        finally:
+            eng.stop()
+
+    try:
+        tokens_traced = run_burst()
+        assert all(len(t) == 8 for t in tokens_traced)
+        spans = _spans()
+
+        # >=90% of every request's engine wall time attributed to spans
+        reqtop = _reqtop()
+        report = reqtop.requests_report(spans, {})
+        assert len(report) == 16
+        for r in report:
+            for res in r["residencies"]:
+                assert res["outcome"] == "served"
+                assert res["attributed_frac"] >= 0.90, (
+                    f"trace {r['trace']}: only "
+                    f"{res['attributed_frac']:.1%} attributed")
+
+        # the injected stall is visible as one shared fat step, and its
+        # co-batched victims cite it through the tpot tail exemplar
+        steps = _named(spans, "decode_step")
+        worst = max(s["attrs"]["step_ms"] for s in steps)
+        assert worst >= 1500.0
+        stalled = [s for s in steps
+                   if s["attrs"]["step_ms"] >= 0.8 * worst]
+        stalled_idx = {s["attrs"]["step"] for s in stalled}
+        assert len(stalled_idx) <= 2  # the stall, not general slowness
+        victims = {s["trace"] for s in stalled}
+        assert len(victims) >= 2  # co-batched: several requests paid
+        ex = _REG.histogram("serve_tpot_ms",
+                            buckets=_SERVE_BUCKETS).exemplar
+        assert ex is not None and ex["trace_id"] in victims
+        assert ex["value"] >= 1000.0
+
+        # flightrec -> reqtop end-to-end on the dumped ring
+        assert tracing.flight_dump("drill")
+        dumps = reqtop.load_dumps(str(tmp_path))
+        merged = reqtop.requests_report(reqtop.merged_spans(dumps),
+                                        reqtop.merged_requests(dumps))
+        assert merged and merged[0]["flight_records"]
+
+        # no-tracing, no-fault rerun: token-bit-identical
+        fl.set_flags({"FLAGS_ps_fault_injection": False})
+        faults.reset()
+        monkeypatch.delenv(tracing.ENV_GATE)
+        tracing._reset_for_tests()
+        tokens_plain = run_burst()
+        assert tokens_plain == tokens_traced
+        assert _spans() == []
+    finally:
+        tracing._reset_for_tests()

@@ -508,6 +508,18 @@ echo "== crash-tolerant generation drills (mid-decode kill + KV preemption) =="
 # (tests/test_gen_resume.py)
 python -m pytest tests/test_gen_resume.py -q -m slow
 
+echo "== serving-trace lane (traced burst + stall attribution) =="
+# ISSUE 19 acceptance: a traced 16-request burst with one injected
+# stall:gen_decode_step tail — >=90% of every completed request's
+# engine wall time is attributed to spans (queue_wait / prefill /
+# pro-rata decode_step / peer_prefill), the stalled step's co-batched
+# victims cite it through the serve_tpot_ms exemplar trace_id, the
+# flightrec dumps reconstruct end-to-end through tools/reqtop.py, and
+# a no-tracing rerun is token-bit-identical. Fast span-parentage /
+# SLO-histogram / flag-off-bit-identity / servez / reqtop units run in
+# tier-1 above (tests/test_serving_trace.py)
+python -m pytest tests/test_serving_trace.py -q -m slow
+
 echo "== control-plane lane (coordinator kill-and-respawn + standby promotion) =="
 # ISSUE 18 acceptance: (1) kill-and-respawn drill — the durable job
 # coordinator (PADDLE_COORD_SNAPSHOT_SECS armed) is killed at its 25th

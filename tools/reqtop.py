@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""reqtop: reconstruct where each serving request's wall time went
+(ISSUE 19).
+
+Input: a directory of `flightrec.<tag>.json` flight-recorder dumps
+(telemetry/tracing.py) from the serving CLIENT process and every
+serving REPLICA — the same files tracetop merges, read request-first
+instead of round-first. Dumps are merged by the wire-propagated
+trace_id, so one generation shows up as ONE record spanning the
+client's `generate`/`generate_stream` root, each replica's RPC hops,
+and each engine residency's `gen_request` umbrella with its
+queue_wait / prefill / per-decode-step / lifecycle-event children —
+including BOTH replicas of a mid-stream failover resume.
+
+Per request reqtop reports:
+
+  client_ms      the caller-observed wall time (the root span)
+  residencies    one row per engine residency (per replica): queue
+                 wait, prefill (positions / cached / prefix-hit),
+                 decode wall + pro-rata charged ms + step count,
+                 peer-prefill bubbles, preempt/resume/evict/
+                 weight_fence events, and the attributed fraction of
+                 the residency's wall time (the >=90% acceptance bar)
+  slow steps     the decode steps that cost the most (their `step`
+                 index names the co-batched victims of a stall)
+
+Usage:
+  python tools/reqtop.py <trace_dir>              # slowest-first report
+  python tools/reqtop.py <trace_dir> --json       # machine-readable
+  python tools/reqtop.py <trace_dir> --topk 5     # only the 5 slowest
+  python tools/reqtop.py <trace_dir> --trace ID   # one request in full
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+# client roots and the engine umbrella that anchor a serving trace
+_CLIENT_ROOTS = ("generate", "generate_stream")
+_ENGINE_SPAN = "gen_request"
+# residency children summed into the attribution numerator
+_ATTRIBUTED = ("queue_wait", "prefill", "decode_step", "peer_prefill")
+_EVENTS = ("preempt", "resume", "evict", "weight_fence")
+
+
+def load_dumps(directory: str) -> List[dict]:
+    """Every parseable flightrec.<tag>.json in `directory` (unreadable
+    files are skipped with a warning — a torn dump from a crashing
+    replica must not cost the survivors' report)."""
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "flightrec.*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"[reqtop] skipping unreadable dump {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if isinstance(d, dict) and isinstance(d.get("spans"), list):
+            dumps.append(d)
+    return dumps
+
+
+def merged_spans(dumps: List[dict]) -> List[dict]:
+    """All spans across dumps, stamped with the dump's process tag (a
+    span's own `proc` wins when present), time-ordered."""
+    out = []
+    for d in dumps:
+        tag = d.get("process", "?")
+        for s in d["spans"]:
+            s = dict(s)
+            s.setdefault("proc", tag)
+            out.append(s)
+    out.sort(key=lambda s: s.get("ts", 0.0))
+    return out
+
+
+def merged_requests(dumps: List[dict]) -> Dict[str, List[dict]]:
+    """Per-request engine flight records (tracing.note_request), keyed
+    by trace id — the engine's own completion ledger, joined onto the
+    span reconstruction."""
+    out: Dict[str, List[dict]] = {}
+    for d in dumps:
+        for rec in d.get("requests") or []:
+            tid = rec.get("trace")
+            if tid:
+                out.setdefault(tid, []).append(dict(rec))
+    return out
+
+
+def _residency(umbrella: dict, spans: List[dict]) -> dict:
+    """Break one engine residency (a gen_request span + its children)
+    into attributed buckets."""
+    kids = [s for s in spans if s.get("parent") == umbrella["span"]]
+    buckets = {k: 0.0 for k in _ATTRIBUTED}
+    steps: List[dict] = []
+    events: List[dict] = []
+    charged = 0.0
+    # the retiring decode_step span closes a beat AFTER the umbrella
+    # (the result event fires mid-step): clip every child to the
+    # residency window so attributed_ms can never exceed wall_ms
+    u0 = umbrella.get("ts") or 0.0
+    u1 = u0 + (umbrella.get("dur_ms") or 0.0) / 1e3
+
+    def _clipped(c: dict) -> float:
+        d = c.get("dur_ms") or 0.0
+        c0 = c.get("ts")
+        if c0 is None or not u1:
+            return d
+        return max(0.0, (min(c0 + d / 1e3, u1) - max(c0, u0)) * 1e3)
+
+    for c in kids:
+        name = c["name"]
+        if name in buckets:
+            buckets[name] += _clipped(c)
+        if name == "decode_step":
+            a = c.get("attrs") or {}
+            full = c.get("dur_ms") or 0.0
+            frac = (_clipped(c) / full) if full > 0 else 1.0
+            charged += float(a.get("charged_ms") or 0.0) * frac
+            steps.append({"step": a.get("step"), "ms": c.get("dur_ms"),
+                          "charged_ms": a.get("charged_ms"),
+                          "batch": a.get("batch"),
+                          "status": c.get("status", "ok")})
+        elif name in _EVENTS:
+            events.append({"event": name, "ts": c.get("ts"),
+                           **(c.get("attrs") or {})})
+    wall = umbrella.get("dur_ms") or 0.0
+    attributed = sum(buckets.values())
+    a = umbrella.get("attrs") or {}
+    prefill = next((s for s in kids if s["name"] == "prefill"), None)
+    return {
+        "proc": umbrella.get("proc", "?"),
+        "trace": umbrella.get("trace"),
+        "wall_ms": round(wall, 3),
+        "outcome": a.get("outcome", umbrella.get("status", "ok")),
+        "resume": bool(a.get("resume")),
+        "tokens": a.get("tokens"),
+        "queue_wait_ms": round(buckets["queue_wait"], 3),
+        "prefill_ms": round(buckets["prefill"], 3),
+        "prefill_attrs": (prefill.get("attrs") if prefill else None),
+        "decode_ms": round(buckets["decode_step"], 3),
+        "decode_charged_ms": round(charged, 3),
+        "decode_steps": len(steps),
+        "peer_prefill_ms": round(buckets["peer_prefill"], 3),
+        "events": events,
+        "attributed_ms": round(attributed, 3),
+        "attributed_frac": (round(attributed / wall, 4) if wall > 0
+                            else None),
+        "slowest_steps": sorted(steps,
+                                key=lambda s: -(s["ms"] or 0.0))[:3],
+    }
+
+
+def requests_report(spans: List[dict],
+                    records: Optional[Dict[str, List[dict]]] = None
+                    ) -> List[dict]:
+    """One record per serving trace, slowest-first: the client root,
+    every engine residency's attribution breakdown, and the engine's
+    own flight records when present."""
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    out = []
+    for tid, ss in by_trace.items():
+        umbrellas = [s for s in ss if s["name"] == _ENGINE_SPAN]
+        roots = [s for s in ss if s["name"] in _CLIENT_ROOTS]
+        if not umbrellas and not roots:
+            continue  # not a serving trace
+        umbrellas.sort(key=lambda s: s.get("ts", 0.0))
+        residencies = [_residency(u, ss) for u in umbrellas]
+        root = roots[0] if roots else None
+        client_ms = root.get("dur_ms") if root else None
+        total = (client_ms if client_ms is not None
+                 else sum(r["wall_ms"] for r in residencies))
+        rec = {
+            "trace": tid,
+            "root": (root["name"] if root else _ENGINE_SPAN),
+            "client_proc": (root.get("proc") if root else None),
+            "client_ms": client_ms,
+            "failovers": ((root.get("attrs") or {}).get("failovers")
+                          if root else None),
+            "total_ms": round(total or 0.0, 3),
+            "n_residencies": len(residencies),
+            "residencies": residencies,
+            "procs": sorted({s.get("proc", "?") for s in ss}),
+        }
+        if records:
+            rec["flight_records"] = records.get(tid) or []
+        out.append(rec)
+    out.sort(key=lambda r: -(r["total_ms"] or 0.0))
+    return out
+
+
+def format_request(r: dict) -> str:
+    head = (f"trace {str(r['trace'])[:16]} root={r['root']} "
+            f"{r['total_ms']:.1f}ms total, "
+            f"{r['n_residencies']} engine residenc"
+            f"{'y' if r['n_residencies'] == 1 else 'ies'}"
+            f" ({', '.join(r['procs'])})")
+    if r.get("failovers"):
+        head += f" failovers={r['failovers']}"
+    lines = [head]
+    for res in r["residencies"]:
+        frac = res["attributed_frac"]
+        lines.append(
+            f"  [{res['proc']}] {res['outcome']}"
+            f"{' (resume)' if res['resume'] else ''}: "
+            f"wall={res['wall_ms']:.1f}ms = "
+            f"queue {res['queue_wait_ms']:.1f} + "
+            f"prefill {res['prefill_ms']:.1f} + "
+            f"decode {res['decode_ms']:.1f} "
+            f"(charged {res['decode_charged_ms']:.1f} over "
+            f"{res['decode_steps']} steps) + "
+            f"peer_prefill {res['peer_prefill_ms']:.1f}  "
+            f"[attributed "
+            f"{('%.0f%%' % (100 * frac)) if frac is not None else '?'}]")
+        for ev in res["events"]:
+            kv = " ".join(f"{k}={v}" for k, v in ev.items()
+                          if k not in ("event", "ts"))
+            lines.append(f"      event {ev['event']} {kv}")
+        for st in res["slowest_steps"]:
+            if st["ms"] and st["ms"] >= 2 * max(
+                    1e-9, res["decode_ms"] / max(1, res["decode_steps"])):
+                lines.append(
+                    f"      slow step {st['step']}: {st['ms']:.1f}ms "
+                    f"(charged {st['charged_ms']}, "
+                    f"batch {st['batch']})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="reqtop",
+        description="merge client+replica flight-recorder dumps by "
+                    "trace_id; reconstruct where each serving "
+                    "request's wall time went")
+    p.add_argument("trace_dir", help="directory of flightrec.<tag>.json "
+                                     "dumps (PADDLE_TRACE_DIR)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--topk", type=int, default=0,
+                   help="only the K slowest requests")
+    p.add_argument("--trace", default=None,
+                   help="only this trace id (prefix match)")
+    args = p.parse_args(argv)
+
+    dumps = load_dumps(args.trace_dir)
+    if not dumps:
+        print(f"[reqtop] no flightrec.*.json dumps in "
+              f"{args.trace_dir!r} — run with PADDLE_TRACING=1 and "
+              f"PADDLE_TRACE_DIR set on the client and every replica",
+              file=sys.stderr)
+        return 1
+    spans = merged_spans(dumps)
+    reqs = requests_report(spans, merged_requests(dumps))
+    if args.trace:
+        reqs = [r for r in reqs
+                if str(r["trace"]).startswith(args.trace)]
+    if args.topk:
+        reqs = reqs[:args.topk]
+    if args.json:
+        json.dump({"processes": sorted({d.get("process", "?")
+                                        for d in dumps}),
+                   "n_spans": len(spans),
+                   "requests": reqs}, sys.stdout, default=str)
+        print()
+        return 0
+    print(f"[reqtop] {len(dumps)} process dumps, {len(spans)} spans, "
+          f"{len(reqs)} serving requests (slowest first)")
+    for r in reqs:
+        print(format_request(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

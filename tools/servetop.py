@@ -16,6 +16,13 @@ preemption — and active generations preempted for KV pressure; a
 climbing PREEMPT with flat RESUME means preempted work is starving,
 not resuming).
 
+Per-request SLO columns (ISSUE 19): TTFT50/TTFT99 and TPOT50/TPOT99
+from the first-class `serve_ttft_ms`/`serve_tpot_ms` histograms, and
+DEDUP (`serve_gen_dedup_hits_total` — marked retries that reattached
+instead of decoding twice).  A replica that predates these stats keys
+renders dashes in the new columns; everything it does report keeps its
+old column position.
+
 Examples:
 
     python tools/servetop.py --endpoints 127.0.0.1:8500,127.0.0.1:8501
@@ -63,7 +70,8 @@ def _gen_columns(row: dict, prev_row: Optional[dict],
     g = row.get("generation")
     if not g:
         return (f"{'-':>7} {'-':>11} {'-':>6} {'-':>6} "
-                f"{'-':>6} {'-':>7}")
+                f"{'-':>6} {'-':>7} {'-':>7} {'-':>7} {'-':>7} "
+                f"{'-':>7} {'-':>5}")
     toks = int(g.get("tokens_total", 0))
     if prev_row is not None and window_s:
         prev_toks = int(
@@ -83,7 +91,22 @@ def _gen_columns(row: dict, prev_row: Optional[dict],
     res = int(g.get("resumed_total", 0))
     pre_t = int(g.get("preempted_total", 0))
     return (f"{tok_s} {split:>11} {resid:>6} {hit:>6} "
-            f"{res:6d} {pre_t:7d}")
+            f"{res:6d} {pre_t:7d} {_slo_columns(g)}")
+
+
+def _q_col(g: dict, key: str) -> str:
+    """One SLO quantile column; a replica that predates the key (old
+    stats schema) renders a dash in the same width."""
+    v = g.get(key)
+    return f"{'-':>7}" if v is None else f"{float(v):7.1f}"
+
+
+def _slo_columns(g: dict) -> str:
+    dedup = g.get("dedup_hits_total")
+    dd = f"{'-':>5}" if dedup is None else f"{int(dedup):5d}"
+    return (f"{_q_col(g, 'ttft_p50_ms')} {_q_col(g, 'ttft_p99_ms')} "
+            f"{_q_col(g, 'tpot_p50_ms')} {_q_col(g, 'tpot_p99_ms')} "
+            f"{dd}")
 
 
 def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
@@ -95,6 +118,8 @@ def render(rows: List[dict], prev: Optional[Dict[str, dict]] = None,
            f"{'DEADLN':>7} {'QDEPTH':>6} {'P50MS':>8} {'P99MS':>8} "
            f"{'TOK/S':>7} {'DEC/PRE':>11} {'KVRES':>6} {'PFXHIT':>6} "
            f"{'RESUME':>6} {'PREEMPT':>7} "
+           f"{'TTFT50':>7} {'TTFT99':>7} {'TPOT50':>7} {'TPOT99':>7} "
+           f"{'DEDUP':>5} "
            f"{'EPOCH':>6} {'DRAIN':>5}")
     out.append(hdr)
     for row in rows:
