@@ -34,6 +34,22 @@ Check catalog (registered name -> module):
   subblock-persistable-write, subblock-rng            analysis/structure.py
   device-stage                                        analysis/structure.py
 
+Whole-job checks (not registered — they need state beyond one Program):
+
+  scope-missing-persistable, scope-uninitialized,     analysis/scopecheck.py
+  scope-shape-mismatch, scope-dtype-mismatch,           (verify_scope — a
+  scope-orphan-var                                       Program vs a live
+                                                         Scope/manifest)
+  startup-missing-init, startup-orphan-init           analysis/crosscheck.py
+  clone-param-mismatch, clone-train-mode,               (verify_pair —
+  clone-grad-op, clone-bn-stats                          startup/main +
+  ps-table-missing, ps-table-geometry                    train/eval pairs)
+
+Mechanical repair (proglint --fix): analysis/fixes.py `apply_fixes`
+runs torn-grads / dead-code / stale-last-writer / startup-init fixers,
+re-verifying after each — a fixer that introduces a NEW error raises
+attributed `fix:<name>`.
+
 Beyond the checks, the package hosts the static LIVE-RANGE pass
 (analysis/liverange.py, ISSUE 11): first-def/last-use and byte size per
 Variable, peak simultaneous-bytes estimate with donation awareness, and
@@ -57,6 +73,19 @@ from .core import (  # noqa: F401
     walk_blocks,
 )
 from .sandwich import pass_sandwich  # noqa: F401
+from .scopecheck import (  # noqa: F401
+    assert_scope_valid,
+    persistable_reads,
+    verify_scope,
+)
+from .crosscheck import (  # noqa: F401
+    assert_pair_valid,
+    check_ps_geometry,
+    check_startup_main,
+    check_train_eval,
+    verify_pair,
+)
+from .fixes import FIXERS, FixReport, apply_fixes  # noqa: F401
 from .liverange import (  # noqa: F401
     BufferInfo,
     LiveRangeAnalysis,

@@ -169,6 +169,20 @@ class CheckpointWriterError(CheckpointError):
     boundary instead of from a silent gap in the checkpoint chain."""
 
 
+class RestoreMismatchError(CheckpointError):
+    """The checkpoint's arrays disagree with the program's var metadata
+    (shape or dtype) — restoring them would fail deep inside the jitted
+    step, hundreds of frames from the var that caused it. The message
+    names every mismatched var and the layer that created it (scopecheck
+    findings), and NOTHING was applied to the scope. restore() does not
+    fall back past this: the program changed, not the checkpoint, so
+    every older step is equally mismatched."""
+
+    def __init__(self, message: str, findings=()):
+        super().__init__(message)
+        self.findings = list(findings)
+
+
 class CommitBarrierError(CheckpointError):
     """Rank 0 gave up waiting for every rank's shard-commit report:
     the step's checkpoint stays torn (no global manifest) and restore()
@@ -1196,6 +1210,11 @@ class CheckpointManager:
                 except Exception:  # noqa: BLE001 — accounting only
                     pass
                 return out
+            except RestoreMismatchError:
+                # program/checkpoint metadata disagreement: every older
+                # checkpoint is equally mismatched (the PROGRAM changed)
+                # — falling back would just repeat the error N times
+                raise
             except Exception as e:  # corrupt despite checksums: skip it
                 warnings.warn(
                     f"checkpoint ckpt-{s:08d} failed to load ({e}); "
@@ -1213,6 +1232,29 @@ class CheckpointManager:
         with open(os.path.join(d, "extra.pkl"), "rb") as f:
             extra = pickle.load(f)
         manifest = self.manifest(step)
+
+        # scope-aware lint BEFORE anything touches the scope: a restored
+        # array whose shape/dtype disagrees with the program var would
+        # otherwise fail inside jit on the next step. Only the
+        # intersection is checked — partial restores (a program that
+        # grew a layer since the save) are legitimate and the startup
+        # program owns the rest.
+        if program is not None:
+            from .analysis import ERROR as _AN_ERROR
+            from .analysis import verify_scope as _verify_scope
+
+            mismatched = [
+                f for f in _verify_scope(program, state["arrays"],
+                                         check_orphans=False)
+                if f.severity == _AN_ERROR and f.check in
+                ("scope-shape-mismatch", "scope-dtype-mismatch")]
+            if mismatched:
+                raise RestoreMismatchError(
+                    f"checkpoint ckpt-{step:08d} disagrees with the "
+                    f"program on {len(mismatched)} var(s); nothing was "
+                    f"restored:\n" + "\n".join(
+                        "  " + f.format() for f in mismatched),
+                    findings=mismatched)
 
         for n, a in state["arrays"].items():
             scope.set_var(n, jnp.asarray(a))

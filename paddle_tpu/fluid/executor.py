@@ -393,6 +393,19 @@ class Executor:
                     program,
                     live_out=set(feed_arrays) | set(fetch_names),
                     where="Executor compile (FLAGS_program_verify)")
+                # scope-aware lint (same flag, same first-touch site):
+                # every persistable the program reads before writing
+                # must already be in the scope, initialized, with
+                # matching shape/dtype — the finding names the var and
+                # the owning layer instead of failing inside jit.
+                # Orphan-scope warnings are skipped here: scopes are
+                # routinely shared across programs (startup then main).
+                from .analysis import assert_scope_valid
+
+                assert_scope_valid(
+                    program, scope, feed_names=set(feed_arrays),
+                    check_orphans=False,
+                    where="Executor compile (FLAGS_program_verify)")
             # a RETRACE is a recompile of a program the cache already
             # holds under another signature (shape change, new fetch
             # list, flag toggle) — the shape-instability tax telemetry

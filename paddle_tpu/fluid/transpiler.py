@@ -126,6 +126,19 @@ class DistributeTranspiler:
                 self._rewrite_lookup(blk, op, w, anchor)
             self._drop_param(program, startup, w)
             self.tables.append(w.name)
+        from .flags import flag
+
+        if flag("FLAGS_program_verify"):
+            # cross-program lint of the transpile result: every
+            # distributed_lookup_table op must name a registered table
+            # whose embedding dim matches the program's output var —
+            # catches a stale table left by a previous transpile at
+            # transpile time instead of as wrongly-sized rows mid-step
+            from .analysis import assert_pair_valid
+
+            assert_pair_valid(
+                program, where="DistributeTranspiler.transpile "
+                               "(FLAGS_program_verify)")
         return list(self.tables)
 
     # -- surgery ---------------------------------------------------------

@@ -96,6 +96,36 @@ class Model:
                 continue
             self._progs[mode] = self._build_program(mode, startup)
         self._startup = startup
+        from ..fluid.flags import flag
+
+        if flag("FLAGS_program_verify"):
+            # cross-program lint of the clone family (fluid/analysis/
+            # crosscheck.py): startup must initialize every persistable
+            # the train program reads, and the eval/test clones must
+            # share Parameters by name, run is_test semantics, and carry
+            # no optimizer/@GRAD ops. A violated clone contract raises
+            # HERE, naming the layer, not as a wrong number mid-fit.
+            from ..fluid.analysis import assert_pair_valid
+
+            train = self._progs.get("train")
+            for mode in ("eval", "test"):
+                if mode not in self._progs:
+                    continue
+                clone, feed_names = self._progs[mode][0], self._progs[mode][1]
+                assert_pair_valid(
+                    clone, startup=startup,
+                    feed_names=feed_names,
+                    where=f"Model.prepare {mode} clone "
+                          f"(FLAGS_program_verify)")
+                if train is not None:
+                    assert_pair_valid(
+                        train[0], eval_program=clone,
+                        where=f"Model.prepare train/{mode} pair "
+                              f"(FLAGS_program_verify)")
+            if train is not None:
+                assert_pair_valid(
+                    train[0], startup=startup, feed_names=train[1],
+                    where="Model.prepare train (FLAGS_program_verify)")
         with fluid.scope_guard(self._scope):
             self._exe.run(startup)
         self._prepared = True

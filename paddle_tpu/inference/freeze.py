@@ -33,8 +33,8 @@ from typing import Dict, List, Optional, Sequence
 
 from .. import fluid
 from ..fluid import framework
-from ..fluid.analysis import ERROR, ProgramVerifyError, pass_sandwich, \
-    verify_program
+from ..fluid.analysis import ERROR, ProgramVerifyError, \
+    assert_scope_valid, pass_sandwich, verify_program
 from ..fluid.executor import Scope
 from ..fluid.fusion_pass import apply_conv_bn_fusion
 from ..fluid.io import _prune_for_inference
@@ -220,6 +220,13 @@ def freeze_program(program, scope=None, feed_names: Optional[Sequence[str]]
             f"freeze_program: {len(missing)} persistable(s) are "
             f"uninitialized in the scope (run the startup program "
             f"first): {missing[:5]}")
+    # scope-aware lint of the CAPTURE (unconditional, like the result
+    # verify): the frozen program must read only its captured weights +
+    # detected state vars, and each captured array must match the var's
+    # shape/dtype — a serving replica is the worst place to learn a
+    # training-side rewrite changed a weight's geometry
+    assert_scope_valid(frozen, fscope, feed_names=feed_names,
+                       where="freeze_program captured scope")
     return FrozenModel(program=frozen, feed_names=list(feed_names),
                        fetch_names=fetch_names, param_names=param_names,
                        scope=fscope, fused_conv_bn=fused,

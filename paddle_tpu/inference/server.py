@@ -750,6 +750,19 @@ def serve(frozen: FrozenModel, port: int = 0, host: str = "0.0.0.0",
     from ..distributed.ps_server import _Handler, _TCPServer
 
     _tracing.maybe_install_hooks()
+    # span/metrics export off the replica (ps_server.serve pattern):
+    # PADDLE_TRACES_PUSH_URL drains the span ring — serving spans
+    # (prefill/decode/queue_wait/evict/preempt) land in the same ring
+    # as training spans — through the OTLP push exporter instead of
+    # only reaching disk via the flight recorder. Env unset = zero
+    # network, zero threads.
+    try:
+        from ..telemetry import export as _export
+
+        _export.maybe_start()
+        _export.maybe_start_traces()
+    except Exception:  # noqa: BLE001 — telemetry must not stop serving
+        _export = None
     srv = _TCPServer((host, port), _Handler)
     if engine is None:
         engine = _maybe_build_engine()
@@ -819,6 +832,13 @@ def serve(frozen: FrozenModel, port: int = 0, host: str = "0.0.0.0",
         srv.close_all_connections()
         srv.server_close()
         inf.close()
+        try:
+            # final synchronous flush: spans from the last requests
+            # leave the replica before the process does
+            if _export is not None and _export.active_traces():
+                _export.active_traces().flush()
+        except Exception:  # noqa: BLE001 — best-effort on the way out
+            pass
         _tracing.shutdown_dump()
 
 

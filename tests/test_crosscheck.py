@@ -1,0 +1,458 @@
+"""Whole-job verification (ISSUE 20): scope-aware lint, cross-program
+contracts, and the proglint --fix mechanical fixers.
+
+Every check has one deliberately-broken pair (missing startup init,
+un-flipped is_test, divergent BN stats, torn restore manifest, stale PS
+table) and the clean canonical pair; the fixers have a round-trip that
+re-lints clean and trains bit-identically where semantics are
+preserved. Fast lane: tiny graphs only.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import flags as fl
+from paddle_tpu.fluid import layers, unique_name
+from paddle_tpu.fluid.analysis import (
+    ERROR,
+    WARNING,
+    ProgramVerifyError,
+    apply_fixes,
+    verify_pair,
+    verify_program,
+    verify_scope,
+)
+from paddle_tpu.fluid.checkpoint import CheckpointManager, RestoreMismatchError
+from paddle_tpu.fluid.executor import Scope
+
+THIS_FILE = os.path.abspath(__file__)
+
+
+def _fresh():
+    return fluid.Program(), fluid.Program()
+
+
+def _small_train(batch=4, with_opt=True):
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [batch, 8], append_batch_size=False)
+        y = layers.data("y", [batch, 1], append_batch_size=False)
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 4, act="relu"), y))
+        if with_opt:
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(batch=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(batch, 8).astype(np.float32),
+            "y": rng.rand(batch, 1).astype(np.float32)}
+
+
+def _checks(findings, severity=None):
+    return sorted({f.check for f in findings
+                   if severity is None or f.severity == severity})
+
+
+@pytest.fixture
+def verify_flag():
+    fl.set_flags({"FLAGS_program_verify": True})
+    yield
+    fl.set_flags({"FLAGS_program_verify": False})
+
+
+# ---------------------------------------------------------------------------
+# scope-aware lint (analysis/scopecheck.py)
+# ---------------------------------------------------------------------------
+
+
+def test_scope_missing_and_uninitialized():
+    main, _startup, _loss = _small_train()
+    # empty scope: every read-before-write persistable is missing
+    fs = verify_scope(main, Scope(), feed_names=["x", "y"])
+    assert _checks(fs, ERROR) == ["scope-missing-persistable"]
+    assert {f.var for f in fs} >= {"fc_0.w_0", "fc_0.b_0"}
+    # Scope.var() placeholder: present but None
+    scope = Scope()
+    for f in fs:
+        scope.var(f.var)
+    fs2 = verify_scope(main, scope, feed_names=["x", "y"])
+    assert _checks(fs2, ERROR) == ["scope-uninitialized"]
+
+
+def test_scope_shape_dtype_mismatch_and_orphan():
+    main, startup, _loss = _small_train()
+    scope = Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    assert verify_scope(main, scope, feed_names=["x", "y"]) == []
+    # wrong shape
+    scope.set_var("fc_0.w_0", np.zeros((3, 3), np.float32))
+    fs = verify_scope(main, scope, feed_names=["x", "y"])
+    assert _checks(fs, ERROR) == ["scope-shape-mismatch"]
+    assert any(f.var == "fc_0.w_0" and "(8, 4)" in f.message for f in fs)
+    # wrong dtype (runtime-normalized: int32 vs float32 is real)
+    scope.set_var("fc_0.w_0", np.zeros((8, 4), np.int32))
+    fs = verify_scope(main, scope, feed_names=["x", "y"])
+    assert _checks(fs, ERROR) == ["scope-dtype-mismatch"]
+    # orphan: scope state no program var names
+    scope.set_var("fc_0.w_0", np.zeros((8, 4), np.float32))
+    scope.set_var("stale_from_other_program", np.zeros(2, np.float32))
+    fs = verify_scope(main, scope, feed_names=["x", "y"])
+    assert _checks(fs) == ["scope-orphan-var"]
+    assert all(f.severity == WARNING for f in fs)
+
+
+def test_scope_minus1_dims_tolerated():
+    main, _ = _fresh()
+    blk = main.global_block()
+    blk.create_var(name="p", shape=(-1, 4), dtype="float32",
+                   persistable=True)
+    blk.append_op(type="scale", inputs={"X": ["p"]},
+                  outputs={"Out": ["o"]}, attrs={"scale": 1.0})
+    scope = Scope()
+    scope.set_var("p", np.zeros((7, 4), np.float32))
+    assert verify_scope(main, scope) == []
+    scope.set_var("p", np.zeros((7, 5), np.float32))
+    assert _checks(verify_scope(main, scope), ERROR) == \
+        ["scope-shape-mismatch"]
+
+
+def test_scope_lint_names_user_layer():
+    main, _startup, _loss = _small_train()
+    fs = verify_scope(main, Scope(), feed_names=["x", "y"])
+    assert any(os.path.basename(THIS_FILE) in f.format() for f in fs)
+
+
+def test_executor_first_touch_scope_lint(verify_flag):
+    main, startup, loss = _small_train()
+    exe = fluid.Executor()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        # main before startup: raises naming the uninitialized var
+        # instead of failing inside jit
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert "scope-missing-persistable" in str(ei.value)
+        assert "fc_0.w_0" in str(ei.value)
+        # startup first: the same run compiles and executes
+        exe.run(startup)
+        out = exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# cross-program contracts (analysis/crosscheck.py)
+# ---------------------------------------------------------------------------
+
+
+def _train_eval_pair():
+    """The hapi-style clone family: eval cloned for_test from the
+    forward graph BEFORE minimize."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], append_batch_size=False)
+        y = layers.data("y", [4, 1], append_batch_size=False)
+        h = layers.fc(x, 6, act="relu")
+        h = layers.dropout(h, dropout_prob=0.3)
+        loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+        eval_prog = main.clone(for_test=True)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, eval_prog, loss
+
+
+def test_clean_canonical_pair():
+    main, startup, eval_prog, _loss = _train_eval_pair()
+    fs = verify_pair(main, startup=startup, eval_program=eval_prog,
+                     feed_names=["x", "y"])
+    assert _checks(fs, ERROR) == []
+
+
+def test_missing_startup_init():
+    main, startup, _loss = _small_train()
+    sblk = startup.global_block()
+    idx = next(i for i, op in enumerate(sblk.ops)
+               if "fc_0.b_0" in op.output_names())
+    sblk._remove_op(idx)
+    fs = verify_pair(main, startup=startup, feed_names=["x", "y"])
+    assert _checks(fs, ERROR) == ["startup-missing-init"]
+    assert any(f.var == "fc_0.b_0" for f in fs)
+    # restore-provided names are exempt (checkpoint owns them)
+    fs = verify_pair(main, startup=startup, feed_names=["x", "y"],
+                     restore_provided=["fc_0.b_0"])
+    assert _checks(fs, ERROR) == []
+
+
+def test_unflipped_is_test():
+    main, _startup, eval_prog, _loss = _train_eval_pair()
+    # a plain clone() keeps training semantics — the exact bug
+    # clone(for_test=True) exists to prevent
+    bad_eval = main.clone(for_test=False)
+    fs = verify_pair(main, eval_program=bad_eval)
+    checks = _checks(fs, ERROR)
+    assert "clone-train-mode" in checks      # dropout is_test=False
+    assert "clone-grad-op" in checks         # sgd/@GRAD ops survived
+    assert any(f.op_type == "dropout" for f in fs
+               if f.check == "clone-train-mode")
+    # the proper for_test clone is clean
+    assert _checks(verify_pair(main, eval_program=eval_prog), ERROR) == []
+
+
+def test_divergent_bn_stats():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 3, 8, 8], append_batch_size=False)
+        h = layers.conv2d(x, 4, 3, padding=1)
+        h = layers.batch_norm(h)
+        loss = layers.mean(h)
+        eval_prog = main.clone(for_test=True)
+    assert _checks(verify_pair(main, eval_program=eval_prog),
+                   ERROR) == []
+    eblk = eval_prog.global_block()
+    bn = next(op for op in eblk.ops if op.type == "batch_norm")
+    # eval reads moving stats under a name train never maintains:
+    # it would normalize with frozen init-time statistics
+    eblk.create_var(name="divergent_mean", shape=(4,), dtype="float32",
+                    persistable=True)
+    bn.inputs["Mean"] = ["divergent_mean"]
+    fs = verify_pair(main, eval_program=eval_prog)
+    assert "clone-bn-stats" in _checks(fs, ERROR)
+    assert any(f.var == "divergent_mean" for f in fs)
+
+
+def test_clone_param_mismatch():
+    def build(width):
+        main, startup = _fresh()
+        with unique_name.guard(), fluid.program_guard(main, startup):
+            x = layers.data("x", [4, 8], append_batch_size=False)
+            layers.fc(x, width)
+        return main
+
+    train, bad_eval = build(4), build(6)
+    fs = verify_pair(train, eval_program=bad_eval)
+    assert _checks(fs, ERROR) == ["clone-param-mismatch"]
+    assert any("(8, 4)" in f.message and "(8, 6)" in f.message
+               for f in fs)
+
+
+def test_ps_table_geometry():
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.fluid.transpiler import (
+        DistributeTranspiler,
+        DistributeTranspilerConfig,
+    )
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [4, 6], dtype="int64",
+                          append_batch_size=False)
+        emb = layers.embedding(ids, size=[100, 16])
+        layers.reduce_mean(emb)
+    cfg = DistributeTranspilerConfig()
+    cfg.min_rows_for_ps = 10
+    t = DistributeTranspiler(config=cfg)
+    (name,) = t.transpile(0, program=main, pservers="", trainers=1,
+                          startup_program=startup)
+    try:
+        assert verify_pair(main) == []
+        # stale table from a "previous transpile": wrong embedding dim
+        ps.get_table(name).dim = 8
+        fs = verify_pair(main)
+        assert _checks(fs, ERROR) == ["ps-table-geometry"]
+        ps.drop_table(name)
+        fs = verify_pair(main)
+        assert _checks(fs, ERROR) == ["ps-table-missing"]
+    finally:
+        try:
+            ps.drop_table(name)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# torn restore manifest (checkpoint.RestoreMismatchError)
+# ---------------------------------------------------------------------------
+
+
+def test_restore_mismatch_names_var_and_does_not_fall_back(tmp_path):
+    main, startup, _loss = _small_train()
+    scope = Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    good_w = np.asarray(scope.find_var("fc_0.w_0")).copy()
+    mgr = CheckpointManager(str(tmp_path), scope=scope)
+    # two steps saved with a DIFFERENT fc geometry than `main` expects:
+    # both are equally mismatched, so restore must raise, not walk the
+    # chain emitting the same error per step
+    scope.set_var("fc_0.w_0", np.zeros((8, 9), np.float32))
+    mgr.save(1)
+    mgr.save(2)
+    scope.set_var("fc_0.w_0", good_w)
+    with pytest.raises(RestoreMismatchError) as ei:
+        mgr.restore(program=main)
+    msg = str(ei.value)
+    assert "fc_0.w_0" in msg and "(8, 4)" in msg and "(8, 9)" in msg
+    # NOTHING was applied: the scope still holds the good array
+    np.testing.assert_array_equal(
+        np.asarray(scope.find_var("fc_0.w_0")), good_w)
+
+
+def test_restore_partial_manifest_ok(tmp_path):
+    """A checkpoint missing a var the program grew since the save is a
+    legitimate partial restore — only the intersection is checked."""
+    main, startup, _loss = _small_train()
+    scope = Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    small = Scope()
+    small.set_var("fc_0.w_0", np.asarray(scope.find_var("fc_0.w_0")))
+    mgr = CheckpointManager(str(tmp_path), scope=small)
+    mgr.save(1)
+    out = CheckpointManager(str(tmp_path), scope=scope).restore(
+        program=main)
+    assert out is not None and out["step"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mechanical fixers (analysis/fixes.py)
+# ---------------------------------------------------------------------------
+
+
+def _losses(main, startup, loss, steps=3):
+    exe = fluid.Executor()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return [np.asarray(exe.run(main, feed=_feed(seed=s),
+                                   fetch_list=[loss])[0]).item()
+                for s in range(steps)]
+
+
+def test_fix_roundtrip_bit_identical():
+    """Semantics-preserving breakage (dead op, stale last-writer link):
+    --fix re-lints clean and the loss trace is bit-identical to the
+    never-broken program."""
+    clean_main, startup, loss = _small_train()
+    ref = _losses(clean_main, startup, loss)
+
+    broken = clean_main.clone()
+    blk = broken.global_block()
+    blk.append_op(type="scale", inputs={"X": [loss.name]},
+                  outputs={"Out": ["debris_0"]}, attrs={"scale": 2.0})
+    blk.vars["x"].op = blk.ops[0]  # stale link: ops[0] doesn't write x
+    fs = verify_program(broken, live_out={"x", "y", loss.name})
+    assert "stale-last-writer" in _checks(fs, ERROR)
+    assert "dead-op" in _checks(fs, WARNING)
+
+    reports = apply_fixes(broken, live_out={"x", "y", loss.name})
+    assert {r.name for r in reports if r.changed} == \
+        {"dead-code", "stale-last-writer"}
+    assert verify_program(broken, live_out={"x", "y", loss.name}) == []
+    assert _losses(broken, startup, loss) == ref
+
+
+def test_fix_torn_grads_relints_clean():
+    main, startup, loss = _small_train()
+    blk = main.global_block()
+    idx = next(i for i, op in enumerate(blk.ops)
+               if "fc_0.w_0@GRAD" in op.output_names())
+    blk._remove_op(idx)
+    fs = verify_program(main, live_out={"x", "y", loss.name})
+    assert "grad-integrity" in _checks(fs, ERROR)
+    apply_fixes(main, live_out={"x", "y", loss.name})
+    fs = verify_program(main, live_out={"x", "y", loss.name})
+    assert _checks(fs, ERROR) == []
+    # the repaired program still runs (forward + surviving updates)
+    vals = _losses(main, startup, loss, steps=2)
+    assert all(np.isfinite(v) for v in vals)
+
+
+def test_fix_missing_startup_init():
+    main, startup, loss = _small_train()
+    sblk = startup.global_block()
+    idx = next(i for i, op in enumerate(sblk.ops)
+               if "fc_0.b_0" in op.output_names())
+    sblk._remove_op(idx)
+    assert _checks(verify_pair(main, startup=startup,
+                               feed_names=["x", "y"]), ERROR) == \
+        ["startup-missing-init"]
+    reports = apply_fixes(main, startup=startup, feed_names=["x", "y"],
+                          live_out={"x", "y", loss.name})
+    (init_rep,) = [r for r in reports if r.name == "startup-init"]
+    assert init_rep.changed and "fc_0.b_0" in init_rep.actions[0]
+    assert _checks(verify_pair(main, startup=startup,
+                               feed_names=["x", "y"]), ERROR) == []
+    vals = _losses(main, startup, loss, steps=2)
+    assert all(np.isfinite(v) for v in vals)
+
+
+def test_fix_sandwich_rejects_bad_fixer(monkeypatch):
+    from paddle_tpu.fluid.analysis import fixes as fx
+
+    main, _startup, loss = _small_train()
+
+    def evil(program, live_out=()):
+        program.global_block().append_op(
+            type="scale", inputs={"X": ["never_defined"]},
+            outputs={"Out": ["evil_out"]}, attrs={"scale": 1.0},
+            infer=False)
+        return ["introduced a dangling ref"]
+
+    monkeypatch.setattr(fx, "FIXERS", (("evil", evil, False),))
+    with pytest.raises(ProgramVerifyError) as ei:
+        fx.apply_fixes(main, live_out={loss.name})
+    assert "fix:evil" in str(ei.value)
+    assert "dangling-ref" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# proglint CLI: --fix --in-place on a saved pickle, --pair
+# ---------------------------------------------------------------------------
+
+
+def _proglint():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(THIS_FILE)),
+                        "tools", "proglint.py")
+    spec = importlib.util.spec_from_file_location("proglint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_proglint_fix_in_place_roundtrip(tmp_path, capsys):
+    main, startup, loss = _small_train()
+    blk = main.global_block()
+    idx = next(i for i, op in enumerate(blk.ops)
+               if "fc_0.w_0@GRAD" in op.output_names())
+    blk._remove_op(idx)  # torn grads: survives (de)serialization
+    exe = fluid.Executor()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_train_model(exe, str(tmp_path), ["x", "y"], loss,
+                                  main_program=main,
+                                  startup_program=startup)
+    pl = _proglint()
+    assert pl.main(["--program", str(tmp_path)]) == 1
+    capsys.readouterr()
+    assert pl.main(["--program", str(tmp_path), "--fix",
+                    "--in-place"]) == 0
+    out = capsys.readouterr()
+    assert "fix[torn-grads]" in out.err
+    # the repair persisted: a plain re-lint of the pickle is clean
+    assert pl.main(["--program", str(tmp_path)]) == 0
+
+
+def test_proglint_pair_lane(capsys):
+    assert _proglint().main(["--model", "resnet18", "--backward",
+                             "--pair", "--image-size", "32"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out

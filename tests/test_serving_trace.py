@@ -527,6 +527,80 @@ def test_servetop_slo_columns_and_old_layout():
 
 
 # ---------------------------------------------------------------------------
+# span export off the replica (ISSUE 20): engine spans drain through the
+# OTLP trace push instead of only reaching disk via the flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_serving_spans_drain_through_otlp_push(traced):
+    from paddle_tpu.telemetry import export
+
+    posts = []
+
+    class _Exp(export.PushExporter):
+        def _post_once(self, body, ctype):
+            posts.append(json.loads(body.decode()))
+
+    eng = _mk_engine(kv=True)
+    try:
+        r = eng.result(eng.submit(PROMPT, max_new_tokens=3), timeout=120)
+        assert len(r["tokens"]) == 3
+        _settle("gen_request", 1)
+        exp = _Exp("http://127.0.0.1:1/v1/traces", interval_s=3600,
+                   body_fn=export._traces_body_fn(),
+                   counter_prefix="traces")
+        assert exp.flush() is True
+        names = {s["name"] for p in posts
+                 for s in p["resourceSpans"][0]["scopeSpans"][0]["spans"]}
+        # the serving lifecycle left the replica: umbrella + children
+        assert {"gen_request", "queue_wait", "prefill",
+                "decode_step"} <= names
+        exp.stop()
+    finally:
+        eng.stop()
+
+
+def test_serve_arms_trace_push_from_env(traced, gen_frozen, monkeypatch):
+    """server.serve mirrors ps_server.serve: PADDLE_TRACES_PUSH_URL
+    arms the exporter at startup, and the teardown finally flushes it —
+    the last requests' spans leave the replica before the process
+    does. serve_forever is stubbed to one in-process generation so the
+    whole serve() lifecycle (arm -> serve -> flush) runs inline."""
+    from paddle_tpu.distributed import ps_server as psrv
+    from paddle_tpu.telemetry import export
+
+    posts = []
+
+    class _Exp(export.PushExporter):
+        def _post_once(self, body, ctype):
+            posts.append(json.loads(body.decode()))
+
+    monkeypatch.setenv(export.ENV_TRACES_URL, "http://127.0.0.1:1/x")
+    monkeypatch.setattr(export, "PushExporter", _Exp)
+    export.stop()  # reset the once-only arming latch
+    eng = _mk_engine(kv=True)
+
+    def fake_serve_forever(self, poll_interval=0.1):
+        # inside serve(): the env URL must have armed the exporter
+        assert export.active_traces() is not None
+        r = eng.result(eng.submit(PROMPT, max_new_tokens=3),
+                       timeout=120)
+        assert len(r["tokens"]) == 3
+        _settle("gen_request", 1)
+
+    monkeypatch.setattr(psrv._TCPServer, "serve_forever",
+                        fake_serve_forever)
+    try:
+        srvmod.serve(gen_frozen, port=0, host="127.0.0.1", engine=eng)
+        names = {s["name"] for p in posts
+                 for s in p["resourceSpans"][0]["scopeSpans"][0]["spans"]}
+        # serve()'s teardown flushed the serving lifecycle off-replica
+        assert {"gen_request", "prefill", "decode_step"} <= names
+    finally:
+        export.stop()
+
+
+# ---------------------------------------------------------------------------
 # reqtop: flight-recorder reconstruction
 # ---------------------------------------------------------------------------
 

@@ -135,9 +135,160 @@ echo "== proglint (static program verification over bench models) =="
 # refs, dtype clashes, stale last-writer links, torn grad graphs, ...).
 # The same checks run flag-gated in the Executor (FLAGS_program_verify);
 # this is the standalone CI entry. Exit is nonzero on any error finding.
+# --pair additionally builds the for_test eval clone and verifies the
+# whole-job train/eval contract (startup pairing, is_test flips, no
+# grad/optimizer leakage, BN moving stats aliased).
 JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50
-JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50 --fuse --backward
-JAX_PLATFORMS=cpu python tools/proglint.py --model bert --backward
+JAX_PLATFORMS=cpu python tools/proglint.py --model resnet50 --fuse --backward --pair
+JAX_PLATFORMS=cpu python tools/proglint.py --model bert --backward --pair
+
+echo "== proglint over saved artifacts (frozen decode program + saved model dir) =="
+# ISSUE 20 acceptance: the SHIPPED artifacts lint clean too — the
+# frozen serving decode program (state-carrying KV write-back pattern)
+# and a save_inference_model dir, both through the --program loader
+rm -rf /tmp/ci_proglint_frozen /tmp/ci_proglint_saved
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io as fio
+from paddle_tpu.fluid import layers
+from paddle_tpu.inference.freeze import freeze_program
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.data(name="x", shape=[1, 4], dtype="float32")
+    blk = main.global_block()
+    cache = blk.create_var(name="decode_cache", shape=[1, 4],
+                           dtype="float32", persistable=True)
+    sblk = startup.global_block()
+    sc = sblk.create_var(name="decode_cache", shape=[1, 4],
+                         dtype="float32", persistable=True)
+    sblk.append_op(type="fill_constant", inputs={}, outputs={"Out": [sc]},
+                   attrs={"shape": [1, 4], "dtype": "float32", "value": 0.0})
+    t = layers.elementwise_add(cache, x)   # read decode state
+    layers.assign(t, output=cache)         # write new state back
+    out = layers.scale(t, scale=2.0)
+
+exe = fluid.Executor()
+scope = fluid.executor.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    # freeze_program itself runs verify_program + the scope-aware lint
+    # of the captured weights unconditionally; this lane re-lints the
+    # SAVED artifact through the same CLI a serving operator would use
+    fm = freeze_program(main, scope=scope, feed_names=["x"],
+                        fetch_list=[out])
+    assert fm.meta["state_vars"] == ["decode_cache"]
+    import os
+
+    os.makedirs("/tmp/ci_proglint_frozen", exist_ok=True)
+    fio._atomic_write_bytes("/tmp/ci_proglint_frozen/__model__",
+                            fio._serialize_program(fm.program))
+    fio._atomic_write_bytes(
+        "/tmp/ci_proglint_frozen/__meta__.json",
+        json.dumps({"feed_names": fm.feed_names,
+                    "fetch_names": fm.fetch_names}).encode())
+    fio.save_inference_model("/tmp/ci_proglint_saved", ["x"], [out], exe,
+                             main_program=main)
+print("frozen decode program + save_inference_model dir written")
+PY
+JAX_PLATFORMS=cpu python tools/proglint.py --program /tmp/ci_proglint_frozen
+JAX_PLATFORMS=cpu python tools/proglint.py --program /tmp/ci_proglint_saved
+
+echo "== proglint --fix round-trip (saved train pickle repair, bit-identical) =="
+# ISSUE 20 acceptance: a deliberately-torn saved training program must
+# (1) fail the lint, (2) repair via --fix --in-place, (3) re-lint clean
+# with NO flags, and (4) — the breakage being entirely off the live
+# graph — train to a loss trace BIT-identical to the pristine save
+rm -rf /tmp/ci_proglint_fix
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import pickle
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io as fio
+from paddle_tpu.fluid import layers
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = layers.data("x", [16, 8], append_batch_size=False)
+    y = layers.data("y", [16, 1], append_batch_size=False)
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor()
+exe.run(startup)
+fio.save_train_model(exe, "/tmp/ci_proglint_fix", ["x", "y"], loss,
+                     main_program=main, startup_program=startup)
+
+
+def losses(dirname):
+    e = fluid.Executor()
+    sc = fluid.executor.Scope()
+    with fluid.scope_guard(sc):
+        m, s, feeds, loss_name = fio.load_train_model(e, dirname)
+        rng = np.random.RandomState(0)
+        xa = rng.rand(16, 8).astype(np.float32)
+        ya = xa.sum(1, keepdims=True).astype(np.float32)
+        out = []
+        for _ in range(3):
+            (lv,) = e.run(m, feed={"x": xa, "y": ya},
+                          fetch_list=[loss_name])
+            out.append(float(np.asarray(lv).ravel()[0]))
+    return out
+
+
+base = losses("/tmp/ci_proglint_fix")
+json.dump(base, open("/tmp/ci_proglint_fix/baseline.json", "w"))
+
+# tear the saved program: a consumer of a @GRAD no op produces (the
+# orphaned-grad-chain shape a forward rewrite leaves behind) — an
+# ERROR-severity finding, but entirely off the live graph, so the
+# mechanical repair must preserve training semantics exactly
+with open("/tmp/ci_proglint_fix/__train_model__", "rb") as f:
+    meta = pickle.load(f)
+m = fio._deserialize_program(meta["main"])
+blk = m.global_block()
+blk.create_var(name="phantom@GRAD", shape=(16, 1), dtype="float32")
+blk.append_op(type="scale", inputs={"X": ["phantom@GRAD"]},
+              outputs={"Out": ["ci_debris_0"]}, attrs={"scale": 1.0})
+meta["main"] = fio._serialize_program(m)
+fio._atomic_write_bytes("/tmp/ci_proglint_fix/__train_model__",
+                        pickle.dumps(meta))
+print("pristine baseline recorded; saved program torn")
+PY
+if JAX_PLATFORMS=cpu python tools/proglint.py --program /tmp/ci_proglint_fix; then
+  echo "proglint: the torn train pickle must exit nonzero"; exit 1
+fi
+JAX_PLATFORMS=cpu python tools/proglint.py --program /tmp/ci_proglint_fix \
+  --fix --in-place
+JAX_PLATFORMS=cpu python tools/proglint.py --program /tmp/ci_proglint_fix
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io as fio
+
+e = fluid.Executor()
+sc = fluid.executor.Scope()
+with fluid.scope_guard(sc):
+    m, s, feeds, loss_name = fio.load_train_model(e, "/tmp/ci_proglint_fix")
+    rng = np.random.RandomState(0)
+    xa = rng.rand(16, 8).astype(np.float32)
+    ya = xa.sum(1, keepdims=True).astype(np.float32)
+    fixed = []
+    for _ in range(3):
+        (lv,) = e.run(m, feed={"x": xa, "y": ya}, fetch_list=[loss_name])
+        fixed.append(float(np.asarray(lv).ravel()[0]))
+base = json.load(open("/tmp/ci_proglint_fix/baseline.json"))
+assert fixed == base, f"fix round-trip not bit-identical: {fixed} vs {base}"
+print(f"fix round-trip OK: repaired program re-lints clean, "
+      f"3-step loss trace bit-identical {fixed}")
+PY
 
 echo "== proftop smoke (per-op device-time attribution + debugz) =="
 # slow-lane proftop/memtop CLI drills (wall-time triage: the resnet18

@@ -7,12 +7,26 @@ save_train_model), and exits nonzero on error-severity findings. The
 same checks run flag-gated inside the Executor (FLAGS_program_verify)
 and around the rewrite passes; this CLI is the standalone/CI entry.
 
+Cross-program contracts (fluid/analysis/crosscheck.py) ride along for
+free where the inputs allow: a `__train_model__` lints its startup/main
+pairing, and `--pair` builds the bench model's for_test eval clone and
+verifies the train/eval contract too.
+
+`--fix` applies the mechanical fixers (fluid/analysis/fixes.py): torn
+@GRAD chains dropped, dead ops/vars swept, stale last-writer links
+relinked, missing startup initializers inserted — each re-verified so a
+fix that introduces a NEW error aborts attributed to it. With
+`--in-place` the repaired program is written back into the saved
+`__model__` / `__train_model__` pickle.
+
 Examples:
 
     python tools/proglint.py --model resnet50
     python tools/proglint.py --model resnet50 --fuse --backward
     python tools/proglint.py --model bert --backward
+    python tools/proglint.py --model resnet18 --backward --pair
     python tools/proglint.py --program path/to/model_dir   # __model__ inside
+    python tools/proglint.py --program dir --fix --in-place
     python tools/proglint.py --model resnet18 --json --werror
 """
 from __future__ import annotations
@@ -61,11 +75,24 @@ def build_bench_model(model: str, batch: int = 2, image_size: int = 64,
     return main, startup, feeds, loss, cfg
 
 
+def _target(label, program, live, startup=None, eval_program=None,
+            feed_names=(), save_fn=None):
+    return {"label": label, "program": program, "live": set(live),
+            "startup": startup, "eval": eval_program,
+            "feed_names": list(feed_names), "save_fn": save_fn}
+
+
 def _build_model(args):
-    """Returns [(label, program, live_out)] for the requested model."""
+    """Returns lint targets for the requested bench model."""
     main, startup, feeds, loss, _cfg = build_bench_model(
         args.model, args.batch, args.image_size, args.seq, args.max_preds)
 
+    eval_prog = None
+    if args.pair:
+        # the canonical eval clone is taken from the FORWARD graph
+        # (hapi clones before minimize; clone(for_test=True) does not
+        # prune a backward that already ran)
+        eval_prog = main.clone(for_test=True)
     if args.fuse:
         from paddle_tpu.fluid.fusion_pass import apply_conv_bn_fusion
 
@@ -76,8 +103,9 @@ def _build_model(args):
 
         append_backward(loss)
     live = set(feeds) | {loss.name}
-    return [(f"{args.model}:main", main, live),
-            (f"{args.model}:startup", startup, set())]
+    return [_target(f"{args.model}:main", main, live, startup=startup,
+                    eval_program=eval_prog, feed_names=feeds),
+            _target(f"{args.model}:startup", startup, set())]
 
 
 def _load_program(path):
@@ -104,13 +132,29 @@ def _load_program(path):
         import pickle
 
         meta = pickle.loads(data)
-        live = set(meta.get("feed_names", ())) | {meta.get("loss_name")}
+        main = fio._deserialize_program(meta["main"])
+        startup = fio._deserialize_program(meta["startup"])
+        feeds = list(meta.get("feed_names", ()))
+        live = set(feeds) | {meta.get("loss_name")}
         live = {n for n in live if n}
-        return [(f"{path}:main", fio._deserialize_program(meta["main"]),
-                 live),
-                (f"{path}:startup",
-                 fio._deserialize_program(meta["startup"]), set())]
-    return [(path, fio._deserialize_program(data), meta_live)]
+
+        def save_train(main=main, startup=startup, meta=meta, path=path):
+            meta = dict(meta)
+            meta["main"] = fio._serialize_program(main)
+            meta["startup"] = fio._serialize_program(startup)
+            fio._atomic_write_bytes(path, pickle.dumps(meta))
+
+        return [_target(f"{path}:main", main, live, startup=startup,
+                        feed_names=feeds, save_fn=save_train),
+                _target(f"{path}:startup", startup, set())]
+
+    program = fio._deserialize_program(data)
+
+    def save_model(program=program, path=path):
+        fio._atomic_write_bytes(path, fio._serialize_program(program))
+
+    return [_target(path, program, meta_live, feed_names=meta_live,
+                    save_fn=save_model)]
 
 
 def main(argv=None) -> int:
@@ -127,6 +171,16 @@ def main(argv=None) -> int:
                     "linting (grad-graph checks get a real graph)")
     ap.add_argument("--fuse", action="store_true",
                     help="apply conv+BN fusion before linting")
+    ap.add_argument("--pair", action="store_true",
+                    help="build the for_test eval clone and verify the "
+                    "train/eval contract too (bench models only)")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply the mechanical fixers before linting "
+                    "(torn grads, dead code, stale links, missing "
+                    "startup inits)")
+    ap.add_argument("--in-place", action="store_true",
+                    help="with --fix on a saved program: write the "
+                    "repaired program back into the pickle")
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--image-size", type=int, default=64)
     ap.add_argument("--seq", type=int, default=64)
@@ -140,12 +194,18 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="one JSON object per finding on stdout")
     args = ap.parse_args(argv)
+    if args.in_place and not args.fix:
+        ap.error("--in-place requires --fix")
+    if args.in_place and not args.program:
+        ap.error("--in-place only applies to --program (saved pickles)")
 
     from paddle_tpu.fluid.analysis import (
         ERROR,
         WARNING,
         all_checks,
+        apply_fixes,
         format_findings,
+        verify_pair,
         verify_program,
     )
 
@@ -160,9 +220,27 @@ def main(argv=None) -> int:
     targets = (_build_model(args) if args.model
                else _load_program(args.program))
     n_err = n_warn = 0
-    for label, program, live in targets:
+    for t in targets:
+        label, program, live = t["label"], t["program"], t["live"]
+        if args.fix:
+            reports = apply_fixes(program, live_out=live | extra_live,
+                                  startup=t["startup"],
+                                  feed_names=t["feed_names"])
+            for r in reports:
+                for line in r.actions:
+                    print(f"# fix[{r.name}] {label}: {line}",
+                          file=sys.stderr)
+            if args.in_place and t["save_fn"] and any(
+                    r.changed for r in reports):
+                t["save_fn"]()
+                print(f"# fix: wrote repaired program back to {label}",
+                      file=sys.stderr)
         findings = verify_program(program, checks=checks,
                                   live_out=live | extra_live)
+        if t["startup"] is not None or t["eval"] is not None:
+            findings = findings + verify_pair(
+                program, startup=t["startup"], eval_program=t["eval"],
+                feed_names=t["feed_names"])
         n_err += sum(1 for f in findings if f.severity == ERROR)
         n_warn += sum(1 for f in findings if f.severity == WARNING)
         if args.json:
